@@ -1,0 +1,120 @@
+"""Headline benchmark: BERT-large MLM pretraining samples/sec/chip.
+
+The reference publishes no numbers (BASELINE.md); the driver-defined target is
+"TFJob BERT-large samples/sec/chip on v5e" (BASELINE.json "metric").  This
+script measures the platform's optimized training step (bfloat16 MXU matmuls,
+per-layer remat, flash attention) and reports speedup over a naive
+reference-style implementation (float32, unfused attention) measured on the
+same chip — the stand-in for the torch-eager baseline the reference ecosystem
+would run.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def measure_bert(dtype: str, use_flash: bool, batch: int, seq: int,
+                 steps: int, warmup: int = 2) -> float:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.models import bert
+    from kubeflow_tpu.parallel import make_mesh
+    from kubeflow_tpu.parallel import train_step as ts
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev, dp=n_dev, fsdp=1, tp=1, sp=1)
+    cfg = bert.bert_large(dtype=dtype, use_flash=use_flash)
+    model = bert.BertModel(cfg)
+    tx = optax.adamw(1e-4, weight_decay=0.01)
+    rng = jax.random.PRNGKey(0)
+    ids = jnp.zeros((batch, seq), jnp.int32)
+
+    state, shardings = ts.init_train_state(model, tx, rng, (ids,), mesh)
+
+    def forward(params, b):
+        out = model.apply({"params": params}, b["input_ids"])
+        return bert.mlm_loss(out, b["labels"], b["weights"])
+
+    dspec = NamedSharding(mesh, P("dp"))
+    bshard = {"input_ids": dspec, "labels": dspec, "weights": dspec}
+    step = ts.build_train_step(forward, tx, mesh, shardings, bshard)
+
+    k1, k2, k3 = jax.random.split(rng, 3)
+    batch_data = {
+        "input_ids": jax.random.randint(k1, (batch, seq), 0, cfg.vocab_size),
+        "labels": jax.random.randint(k2, (batch, seq), 0, cfg.vocab_size),
+        "weights": (jax.random.uniform(k3, (batch, seq)) < 0.15
+                    ).astype(jnp.float32),
+    }
+    batch_data = jax.device_put(batch_data, bshard)
+
+    # NOTE: a device->host transfer (float()) is the sync point each step;
+    # block_until_ready alone does not flush on the tunneled TPU platform.
+    with mesh:
+        for _ in range(warmup):
+            state, metrics = step(state, batch_data)
+        loss = float(metrics["loss"])
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            state, metrics = step(state, batch_data)
+            loss = float(metrics["loss"])
+            times.append(time.perf_counter() - t0)
+    if not loss == loss:
+        raise RuntimeError("NaN loss during benchmark")
+    times.sort()
+    median = times[len(times) // 2]
+    sps = batch / median
+    _log(f"dtype={dtype} flash={use_flash} batch={batch}: "
+         f"{sps:.2f} samples/s total over {n_dev} chip(s), loss={loss:.3f}")
+    return sps / n_dev
+
+
+def main() -> None:
+    import jax
+
+    seq = 512
+    backend = jax.default_backend()
+    _log(f"backend={backend} devices={jax.devices()}")
+
+    # optimized path: bf16 + flash attention + remat
+    value = None
+    for batch in (32, 16, 8):
+        try:
+            value = measure_bert("bfloat16", True, batch, seq, steps=10)
+            break
+        except Exception as e:  # OOM on smaller chips -> shrink batch
+            _log(f"batch {batch} failed ({type(e).__name__}); retrying")
+    if value is None:
+        raise SystemExit("benchmark failed at all batch sizes")
+
+    # naive reference-style baseline: fp32, unfused attention
+    try:
+        naive_batch = 8
+        naive = measure_bert("float32", False, naive_batch, seq, steps=4)
+    except Exception as e:
+        _log(f"naive baseline failed: {e}; reporting vs_baseline=1.0")
+        naive = value
+    print(json.dumps({
+        "metric": "bert_large_pretrain_samples_per_sec_per_chip",
+        "value": round(value, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(value / max(naive, 1e-9), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

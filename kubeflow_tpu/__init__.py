@@ -1,0 +1,39 @@
+"""kubeflow_tpu — a TPU-native ML platform with the capabilities of kubeflow/kubeflow.
+
+A brand-new, TPU-first rebuild of the Kubeflow core platform
+(reference: /root/reference — Go controllers, Flask CRUD apps, Node dashboard),
+re-designed around JAX/XLA/pjit/Pallas so TPU slices are the first-class
+compute substrate:
+
+- ``kubeflow_tpu.core``        controller runtime + in-memory API server
+                               (reference: components/common/reconcilehelper, envtest)
+- ``kubeflow_tpu.api``         resource schemas: JAXJob, Notebook, Profile,
+                               Tensorboard, PodDefault (reference: components/*/api)
+- ``kubeflow_tpu.controllers`` reconcilers (reference: components/*-controller)
+- ``kubeflow_tpu.admission``   PodDefault mutating admission
+                               (reference: components/admission-webhook)
+- ``kubeflow_tpu.kfam``        access management REST
+                               (reference: components/access-management)
+- ``kubeflow_tpu.webapps``     CRUD REST backends (reference: components/crud-web-apps)
+- ``kubeflow_tpu.dashboard``   aggregation server (reference: components/centraldashboard)
+- ``kubeflow_tpu.models``      JAX/Flax model zoo (MLP, ConvNet, ResNet, BERT, Llama)
+- ``kubeflow_tpu.ops``         TPU kernels: flash attention (Pallas), ring attention
+- ``kubeflow_tpu.parallel``    device meshes, sharding rules, pjit train steps
+- ``kubeflow_tpu.training``    trainer, optimizers, checkpointing, data
+- ``kubeflow_tpu.hpo``         Katib-equivalent hyperparameter optimization
+- ``kubeflow_tpu.serving``     KServe-equivalent JAX inference
+
+The heavy ML modules are imported lazily so control-plane components start fast.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "api",
+    "core",
+    "models",
+    "ops",
+    "parallel",
+    "training",
+    "utils",
+]

@@ -1,0 +1,11 @@
+"""JAX/Flax model zoo backing the platform's training and serving examples.
+
+Covers the reference ecosystem's example workloads (BASELINE.json configs):
+MNIST MLP, CIFAR ConvNet (HPO trials), ResNet-50, BERT (base/large pretrain),
+Llama-2 (text-generation serving).  Every model tags parameters with logical
+axis names consumed by kubeflow_tpu.parallel.sharding.
+"""
+
+from kubeflow_tpu.models import registry
+
+__all__ = ["registry"]

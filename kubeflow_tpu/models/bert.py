@@ -1,0 +1,189 @@
+"""BERT encoder (base/large) — the platform's flagship pretraining model.
+
+Fills the reference ecosystem's "BERT TFJob / PyTorchJob DDP pretraining"
+slots (BASELINE.json configs; /root/reference has no model code — SURVEY.md §6
+says this repo must establish the baseline itself).  TPU-first choices:
+
+- bfloat16 activations/matmuls (MXU native), float32 params + softmax/LN;
+- per-layer ``jax.checkpoint`` (remat) so long sequences trade FLOPs for HBM;
+- logical-axis partitioning on every weight so the same module runs dp-only,
+  ZeRO-3 (fsdp), tensor-parallel (tp), or sequence-parallel (sp) unchanged;
+- attention routed through ops.attention (Pallas flash kernel on TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models import layers as kl
+from kubeflow_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: str = "bfloat16"
+    remat: bool = True
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+def bert_large(**kw) -> BertConfig:
+    return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                      intermediate_size=4096, **kw)
+
+
+def bert_tiny(**kw) -> BertConfig:
+    """For tests and CPU dry runs."""
+    kw.setdefault("use_flash", False)
+    return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                      num_heads=4, intermediate_size=128, max_position=128,
+                      **kw)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array | None) -> jax.Array:
+        cfg = self.config
+        dtype = cfg.jnp_dtype
+        proj = lambda name: kl.DenseGeneral(  # noqa: E731
+            features=(cfg.num_heads, cfg.head_dim),
+            axis_names=("embed", "heads", "kv"),
+            dtype=dtype, name=name)
+        q = proj("query")(x)
+        k = proj("key")(x)
+        v = proj("value")(x)
+        use_flash = cfg.use_flash and mask is None
+        out = dot_product_attention(q, k, v, mask=mask, use_flash=use_flash)
+        out = out.reshape(out.shape[:-2] + (cfg.hidden_size,))
+        return kl.DenseGeneral(features=cfg.hidden_size,
+                               axis_names=("heads", "embed"),
+                               dtype=dtype, name="out")(out)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array | None) -> jax.Array:
+        cfg = self.config
+        dtype = cfg.jnp_dtype
+        attn = BertSelfAttention(cfg, name="attention")(x, mask)
+        x = kl.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                         name="attention_ln")(x + attn)
+        h = kl.DenseGeneral(cfg.intermediate_size,
+                            axis_names=("embed", "mlp"), dtype=dtype,
+                            name="intermediate")(x)
+        h = nn.gelu(h, approximate=True)
+        h = kl.DenseGeneral(cfg.hidden_size, axis_names=("mlp", "embed"),
+                            dtype=dtype, name="output")(h)
+        return kl.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                            name="output_ln")(x + h)
+
+
+class BertModel(nn.Module):
+    """Encoder + tied MLM head + NSP head.
+
+    call(input_ids, token_type_ids, attention_mask) ->
+        {"logits": [B,S,V] f32, "pooled": [B,H]}
+    """
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array,
+                 token_type_ids: jax.Array | None = None,
+                 attention_mask: jax.Array | None = None) -> dict:
+        cfg = self.config
+        dtype = cfg.jnp_dtype
+        b, s = input_ids.shape
+
+        embed = kl.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+                         name="word_embeddings")
+        x = embed(input_ids)
+        positions = jnp.arange(s)[None, :]
+        pos_emb = self.param(
+            "position_embeddings",
+            nn.with_partitioning(kl.default_embed_init, (None, "embed")),
+            (cfg.max_position, cfg.hidden_size), jnp.float32)
+        x = x + jnp.asarray(pos_emb, dtype)[positions]
+        if cfg.type_vocab_size:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            type_emb = self.param(
+                "token_type_embeddings",
+                nn.with_partitioning(kl.default_embed_init, (None, "embed")),
+                (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
+            x = x + jnp.asarray(type_emb, dtype)[token_type_ids]
+        x = kl.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                         name="embeddings_ln")(x)
+
+        mask = None
+        if attention_mask is not None:
+            # [B, S] -> [B, 1, 1, S] boolean
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, mask)
+
+        pooled = kl.DenseGeneral(cfg.hidden_size,
+                                 axis_names=("embed", None), dtype=dtype,
+                                 name="pooler")(x[:, 0])
+        pooled = jnp.tanh(pooled)
+
+        # MLM transform + tied decoder
+        h = kl.DenseGeneral(cfg.hidden_size, axis_names=("embed", None),
+                            dtype=dtype, name="mlm_transform")(x)
+        h = nn.gelu(h, approximate=True)
+        h = kl.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                         name="mlm_ln")(h)
+        logits = embed.attend(h)
+        mlm_bias = self.param("mlm_bias",
+                              nn.with_partitioning(
+                                  nn.initializers.zeros_init(), ("vocab",)),
+                              (cfg.vocab_size,), jnp.float32)
+        logits = logits + mlm_bias
+        nsp_logits = kl.DenseGeneral(2, axis_names=("embed", None),
+                                     dtype=dtype, name="nsp")(pooled)
+        return {"logits": logits, "pooled": pooled,
+                "nsp_logits": nsp_logits.astype(jnp.float32)}
+
+
+def mlm_loss(outputs: dict, labels: jax.Array,
+             label_weights: jax.Array) -> jax.Array:
+    """Masked-LM cross entropy; labels -100 or weight 0 positions ignored."""
+    logits = outputs["logits"]
+    vocab = logits.shape[-1]
+    labels_safe = jnp.clip(labels, 0, vocab - 1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels_safe[..., None], axis=-1)[..., 0]
+    weights = label_weights.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(weights), 1.0)
+    return jnp.sum(nll * weights) / total

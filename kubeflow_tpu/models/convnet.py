@@ -1,0 +1,44 @@
+"""CIFAR-10 ConvNet — the HPO (Katib-equivalent) trial workload.
+
+Fills "Katib Bayesian HPO sweep over CIFAR-10 ConvNet trials"
+(BASELINE.json configs[3]).  Hyperparameters exposed as config fields are the
+search dimensions the HPO controller sweeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvNetConfig:
+    num_classes: int = 10
+    channels: tuple[int, ...] = (32, 64, 128)
+    dense_width: int = 256
+    dropout: float = 0.0
+    dtype: str = "float32"
+
+
+class ConvNet(nn.Module):
+    config: ConvNetConfig = ConvNetConfig()
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        x = x.astype(dtype)
+        for i, ch in enumerate(cfg.channels):
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=dtype,
+                        name=f"conv_{i}")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(cfg.dense_width, dtype=dtype, name="dense")(x)
+        x = nn.relu(x)
+        if cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        return nn.Dense(cfg.num_classes, dtype=dtype, name="logits")(x)

@@ -1,0 +1,147 @@
+"""Shared flax building blocks with logical-axis partitioning metadata.
+
+Every kernel is boxed with ``nn.with_partitioning`` using the logical names
+defined in kubeflow_tpu.parallel.sharding; the train-step builder maps them
+onto the ('dp','fsdp','tp','sp') mesh.  Computation runs in a configurable
+dtype (bfloat16 on TPU so matmuls hit the MXU at full rate) while parameters
+stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+Initializer = Callable[..., jax.Array]
+
+default_kernel_init = nn.initializers.lecun_normal()
+default_embed_init = nn.initializers.normal(stddev=0.02)
+
+
+def _partitioned(init: Initializer, names: tuple[str | None, ...]):
+    return nn.with_partitioning(init, names)
+
+
+class DenseGeneral(nn.Module):
+    """Dense layer over the trailing axis with arbitrary output shape.
+
+    features: output dims (int or tuple); axis_names: logical names for the
+    kernel, length = 1 + len(features).
+    """
+
+    features: int | Sequence[int]
+    axis_names: tuple[str | None, ...]
+    use_bias: bool = True
+    dtype: Dtype = jnp.bfloat16
+    kernel_init: Initializer = default_kernel_init
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        features = ((self.features,) if isinstance(self.features, int)
+                    else tuple(self.features))
+        kernel = self.param(
+            "kernel",
+            _partitioned(self.kernel_init, self.axis_names),
+            (x.shape[-1],) + features, jnp.float32)
+        kernel = jnp.asarray(kernel, self.dtype)
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), kernel,
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                _partitioned(nn.initializers.zeros_init(),
+                             self.axis_names[1:]),
+                features, jnp.float32)
+            y = y + jnp.asarray(bias, self.dtype)
+        return y
+
+
+class Embed(nn.Module):
+    """Token embedding with optional logit projection (weight tying)."""
+
+    num_embeddings: int
+    features: int
+    dtype: Dtype = jnp.bfloat16
+    embedding_init: Initializer = default_embed_init
+
+    @nn.compact
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        embedding = self.param(
+            "embedding",
+            _partitioned(self.embedding_init, ("vocab", "embed")),
+            (self.num_embeddings, self.features), jnp.float32)
+        return jnp.asarray(embedding, self.dtype)[ids]
+
+    def attend(self, x: jax.Array) -> jax.Array:
+        """Project hidden states onto the vocabulary (tied LM head)."""
+        embedding = self.get_variable("params", "embedding")
+        if isinstance(embedding, nn.Partitioned):
+            embedding = embedding.unbox()
+        embedding = jnp.asarray(embedding, self.dtype)
+        return jnp.einsum("...d,vd->...v", x, embedding,
+                          preferred_element_type=jnp.float32)
+
+
+class LayerNorm(nn.Module):
+    epsilon: float = 1e-12
+    dtype: Dtype = jnp.bfloat16
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        orig_dtype = x.dtype
+        x = x.astype(jnp.float32)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        scale = self.param("scale",
+                           _partitioned(nn.initializers.ones_init(),
+                                        ("embed",)),
+                           (x.shape[-1],), jnp.float32)
+        y = y * scale
+        if self.use_bias:
+            bias = self.param("bias",
+                              _partitioned(nn.initializers.zeros_init(),
+                                           ("embed",)),
+                              (x.shape[-1],), jnp.float32)
+            y = y + bias
+        return y.astype(orig_dtype)
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-6
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        orig_dtype = x.dtype
+        x = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        y = x * jax.lax.rsqrt(var + self.epsilon)
+        scale = self.param("scale",
+                           _partitioned(nn.initializers.ones_init(),
+                                        ("embed",)),
+                           (x.shape[-1],), jnp.float32)
+        return (y * scale).astype(orig_dtype)
+
+
+def rotary_embedding(x: jax.Array, positions: jax.Array,
+                     base: float = 10000.0) -> jax.Array:
+    """RoPE over [B, S, H, D] given integer positions [B, S]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(
+        -jnp.log(base) * jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)

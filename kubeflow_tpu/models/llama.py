@@ -1,0 +1,176 @@
+"""Llama-2 style decoder — the text-generation serving model.
+
+Fills "KServe InferenceService: Llama-2-7B text-generation predictor"
+(BASELINE.json configs[4]).  TPU-first: bfloat16 MXU matmuls, RoPE, GQA,
+SwiGLU, causal flash attention (Pallas) for prefill, and a static-shape KV
+cache decode step that jits once and runs under lax control flow only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models import layers as kl
+from kubeflow_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    intermediate_size: int = 11008
+    max_seq_len: int = 4096
+    rope_base: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = True
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def llama2_7b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama2_13b(**kw) -> LlamaConfig:
+    return LlamaConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                       num_kv_heads=40, intermediate_size=13824, **kw)
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    kw.setdefault("use_flash", False)
+    return LlamaConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                       num_heads=4, num_kv_heads=2, intermediate_size=128,
+                       max_seq_len=128, **kw)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache=None, attn_mask=None):
+        cfg = self.config
+        dtype = cfg.jnp_dtype
+        q = kl.DenseGeneral((cfg.num_heads, cfg.head_dim), use_bias=False,
+                            axis_names=("embed", "heads", "kv"),
+                            dtype=dtype, name="q")(x)
+        k = kl.DenseGeneral((cfg.num_kv_heads, cfg.head_dim), use_bias=False,
+                            axis_names=("embed", "heads", "kv"),
+                            dtype=dtype, name="k")(x)
+        v = kl.DenseGeneral((cfg.num_kv_heads, cfg.head_dim), use_bias=False,
+                            axis_names=("embed", "heads", "kv"),
+                            dtype=dtype, name="v")(x)
+        q = kl.rotary_embedding(q, positions, cfg.rope_base)
+        k = kl.rotary_embedding(k, positions, cfg.rope_base)
+
+        if cache is not None:
+            # decode: cache is dict(k=[B,S,Hkv,D], v=..., index scalar)
+            idx = cache["index"]
+            ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, idx, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v, idx, axis=1)
+            cache = {"k": ck, "v": cv, "index": idx + x.shape[1]}
+            s_total = ck.shape[1]
+            # causal per query: key slot j visible to the query at absolute
+            # position p iff j <= p (also hides never-written cache slots)
+            pos_k = jnp.arange(s_total)[None, None, None, :]
+            valid = pos_k <= positions[:, None, :, None]
+            out = dot_product_attention(q, ck, cv, mask=valid)
+        else:
+            out = dot_product_attention(q, k, v, causal=True,
+                                        mask=attn_mask,
+                                        use_flash=cfg.use_flash)
+        out = out.reshape(out.shape[:-2] + (cfg.num_heads * cfg.head_dim,))
+        out = kl.DenseGeneral(cfg.hidden_size, use_bias=False,
+                              axis_names=("heads", "embed"),
+                              dtype=dtype, name="o")(out)
+        return out, cache
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache=None, attn_mask=None):
+        cfg = self.config
+        dtype = cfg.jnp_dtype
+        h, cache = LlamaAttention(cfg, name="attention")(
+            kl.RMSNorm(cfg.rms_eps, dtype, name="attention_norm")(x),
+            positions, cache, attn_mask)
+        x = x + h
+        y = kl.RMSNorm(cfg.rms_eps, dtype, name="ffn_norm")(x)
+        gate = kl.DenseGeneral(cfg.intermediate_size, use_bias=False,
+                               axis_names=("embed", "mlp"), dtype=dtype,
+                               name="gate")(y)
+        up = kl.DenseGeneral(cfg.intermediate_size, use_bias=False,
+                             axis_names=("embed", "mlp"), dtype=dtype,
+                             name="up")(y)
+        y = nn.silu(gate) * up
+        y = kl.DenseGeneral(cfg.hidden_size, use_bias=False,
+                            axis_names=("mlp", "embed"), dtype=dtype,
+                            name="down")(y)
+        return x + y, cache
+
+
+class LlamaModel(nn.Module):
+    """Decoder-only LM.
+
+    Prefill: ``model.apply(params, ids)`` -> {"logits": [B,S,V]}.
+    Decode:  pass ``cache`` (from ``init_cache``) and one-token ids.
+    """
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, cache=None, attn_mask=None):
+        cfg = self.config
+        dtype = cfg.jnp_dtype
+        b, s = input_ids.shape
+        if positions is None:
+            start = (cache["layers"][0]["index"]
+                     if cache is not None else jnp.zeros((), jnp.int32))
+            positions = jnp.broadcast_to(start + jnp.arange(s)[None, :],
+                                         (b, s))
+        embed = kl.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+                         name="tok_embeddings")
+        x = embed(input_ids)
+        block_cls = LlamaBlock
+        if cfg.remat and cache is None:
+            block_cls = nn.remat(LlamaBlock, static_argnums=())
+        new_cache = []
+        for i in range(cfg.num_layers):
+            layer_cache = None if cache is None else cache["layers"][i]
+            x, layer_cache = block_cls(cfg, name=f"layer_{i}")(
+                x, positions, layer_cache, attn_mask)
+            new_cache.append(layer_cache)
+        x = kl.RMSNorm(cfg.rms_eps, dtype, name="final_norm")(x)
+        logits = embed.attend(x)
+        out = {"logits": logits}
+        if cache is not None:
+            out["cache"] = {"layers": new_cache}
+        return out
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None):
+    max_len = max_len or cfg.max_seq_len
+    layer = lambda: {  # noqa: E731
+        "k": jnp.zeros((batch, max_len, cfg.num_kv_heads, cfg.head_dim),
+                       cfg.jnp_dtype),
+        "v": jnp.zeros((batch, max_len, cfg.num_kv_heads, cfg.head_dim),
+                       cfg.jnp_dtype),
+        "index": jnp.zeros((), jnp.int32),
+    }
+    return {"layers": [layer() for _ in range(cfg.num_layers)]}

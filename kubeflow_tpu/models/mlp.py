@@ -1,0 +1,49 @@
+"""MNIST MLP — the platform's smallest end-to-end example.
+
+Fills the "MNIST TFJob e2e example (single-worker, CPU-capable)" slot
+(BASELINE.json configs[0]); runs on CPU in CI and on any slice via pmap/pjit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models import layers as kl
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    input_dim: int = 784
+    hidden_dims: tuple[int, ...] = (512, 256)
+    num_classes: int = 10
+    dtype: str = "float32"
+
+
+class MLP(nn.Module):
+    config: MLPConfig = MLPConfig()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        x = x.reshape(x.shape[0], -1).astype(dtype)
+        for i, width in enumerate(cfg.hidden_dims):
+            x = kl.DenseGeneral(width, axis_names=("embed", "mlp"),
+                                dtype=dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return kl.DenseGeneral(cfg.num_classes, axis_names=("mlp", None),
+                               dtype=dtype, name="logits")(x)
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

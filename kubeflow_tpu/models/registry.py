@@ -1,0 +1,210 @@
+"""Model registry: name -> (module, loss, synthetic batch) used by the JAXJob
+launcher, the HPO controller, and the serving runtime.
+
+The reference platform wraps arbitrary user payloads (PodSpec in NotebookSpec,
+notebook_types.go:27-35); the training analog here is a registry key plus a
+config dict in the JAXJob spec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelEntry:
+    name: str
+    make_model: Callable[..., Any]          # (**config) -> nn.Module
+    make_inputs: Callable[..., tuple]       # (batch, rng, module) -> example inputs
+    make_batch: Callable[..., dict]         # (batch, rng, module) -> train batch
+    forward_loss: Callable[..., Any]        # (module, params, batch) -> scalar
+
+
+_REGISTRY: dict[str, ModelEntry] = {}
+
+
+def register(entry: ModelEntry) -> None:
+    _REGISTRY[entry.name] = entry
+
+
+def get(name: str) -> ModelEntry:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# --- MNIST MLP ---------------------------------------------------------------
+
+def _make_mlp(**cfg):
+    from kubeflow_tpu.models.mlp import MLP, MLPConfig
+
+    return MLP(MLPConfig(**cfg))
+
+
+def _mlp_batch(batch_size, rng, module):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "image": jax.random.normal(k1, (batch_size, 28, 28, 1)),
+        "label": jax.random.randint(k2, (batch_size,), 0, 10),
+    }
+
+
+def _mlp_loss(module, params, batch):
+    from kubeflow_tpu.models.mlp import softmax_cross_entropy
+
+    logits = module.apply({"params": params}, batch["image"])
+    return softmax_cross_entropy(logits, batch["label"])
+
+
+register(ModelEntry(
+    "mnist_mlp", _make_mlp,
+    make_inputs=lambda b, rng, m: (jnp.zeros((b, 28, 28, 1)),),
+    make_batch=_mlp_batch, forward_loss=_mlp_loss))
+
+
+# --- CIFAR ConvNet -----------------------------------------------------------
+
+def _make_convnet(**cfg):
+    from kubeflow_tpu.models.convnet import ConvNet, ConvNetConfig
+
+    fields = {f.name for f in dataclasses.fields(ConvNetConfig)}
+    cfg = {k: v for k, v in cfg.items() if k in fields}
+    if "channels" in cfg:
+        cfg["channels"] = tuple(cfg["channels"])
+    return ConvNet(ConvNetConfig(**cfg))
+
+
+def _convnet_batch(batch_size, rng, module):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "image": jax.random.normal(k1, (batch_size, 32, 32, 3)),
+        "label": jax.random.randint(k2, (batch_size,), 0, 10),
+    }
+
+
+def _convnet_loss(module, params, batch):
+    from kubeflow_tpu.models.mlp import softmax_cross_entropy
+
+    logits = module.apply({"params": params}, batch["image"])
+    return softmax_cross_entropy(logits, batch["label"])
+
+
+register(ModelEntry(
+    "cifar_convnet", _make_convnet,
+    make_inputs=lambda b, rng, m: (jnp.zeros((b, 32, 32, 3)),),
+    make_batch=_convnet_batch, forward_loss=_convnet_loss))
+
+
+# --- ResNet-50 ---------------------------------------------------------------
+
+def _make_resnet(**cfg):
+    from kubeflow_tpu.models.resnet import ResNet, ResNetConfig
+
+    if "stage_sizes" in cfg:
+        cfg["stage_sizes"] = tuple(cfg["stage_sizes"])
+    return ResNet(ResNetConfig(**cfg))
+
+
+def _resnet_batch(batch_size, rng, module):
+    k1, k2 = jax.random.split(rng)
+    n_cls = module.config.num_classes
+    return {
+        "image": jax.random.normal(k1, (batch_size, 224, 224, 3)),
+        "label": jax.random.randint(k2, (batch_size,), 0, n_cls),
+    }
+
+
+def _resnet_loss(module, params, batch):
+    from kubeflow_tpu.models.mlp import softmax_cross_entropy
+
+    # BatchNorm uses minibatch statistics (train mode); the running-average
+    # updates are recomputed here and discarded — the trainer's full path
+    # threads batch_stats through the TrainState.
+    logits, _ = module.apply({"params": params}, batch["image"], train=True,
+                             mutable=["batch_stats"])
+    return softmax_cross_entropy(logits, batch["label"])
+
+
+register(ModelEntry(
+    "resnet50", _make_resnet,
+    make_inputs=lambda b, rng, m: (jnp.zeros((b, 224, 224, 3)),),
+    make_batch=_resnet_batch, forward_loss=_resnet_loss))
+
+
+# --- BERT --------------------------------------------------------------------
+
+def _make_bert(size: str = "base", **cfg):
+    from kubeflow_tpu.models import bert
+
+    factory = {"tiny": bert.bert_tiny, "base": bert.bert_base,
+               "large": bert.bert_large}[size]
+    return bert.BertModel(factory(**cfg))
+
+
+def _bert_batch(batch_size, rng, module, seq_len: int | None = None):
+    cfg = module.config
+    s = seq_len or cfg.max_position
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "input_ids": jax.random.randint(k1, (batch_size, s), 0,
+                                        cfg.vocab_size),
+        "labels": jax.random.randint(k2, (batch_size, s), 0, cfg.vocab_size),
+        # standard BERT masks 15% of positions
+        "weights": (jax.random.uniform(k3, (batch_size, s)) < 0.15
+                    ).astype(jnp.float32),
+    }
+
+
+def _bert_loss(module, params, batch):
+    from kubeflow_tpu.models.bert import mlm_loss
+
+    out = module.apply({"params": params}, batch["input_ids"])
+    return mlm_loss(out, batch["labels"], batch["weights"])
+
+
+register(ModelEntry(
+    "bert", _make_bert,
+    make_inputs=lambda b, rng, m: (
+        jnp.zeros((b, m.config.max_position), jnp.int32),),
+    make_batch=_bert_batch, forward_loss=_bert_loss))
+
+
+# --- Llama -------------------------------------------------------------------
+
+def _make_llama(size: str = "tiny", **cfg):
+    from kubeflow_tpu.models import llama
+
+    factory = {"tiny": llama.llama_tiny, "7b": llama.llama2_7b,
+               "13b": llama.llama2_13b}[size]
+    return llama.LlamaModel(factory(**cfg))
+
+
+def _llama_batch(batch_size, rng, module, seq_len: int | None = None):
+    cfg = module.config
+    s = seq_len or min(cfg.max_seq_len, 512)
+    k1 = rng
+    ids = jax.random.randint(k1, (batch_size, s + 1), 0, cfg.vocab_size)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+def _llama_loss(module, params, batch):
+    out = module.apply({"params": params}, batch["input_ids"])
+    logits = out["logits"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["labels"][..., None],
+                               axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+register(ModelEntry(
+    "llama", _make_llama,
+    make_inputs=lambda b, rng, m: (jnp.zeros((b, 64), jnp.int32),),
+    make_batch=_llama_batch, forward_loss=_llama_loss))

@@ -1,0 +1,90 @@
+"""ResNet-50 — the distributed data-parallel training example.
+
+Fills "ResNet-50 distributed TFJob (MultiWorkerMirroredStrategy -> jax.pmap)"
+(BASELINE.json configs[1]).  TPU-first: NHWC layout (XLA's preferred conv
+layout on TPU), bfloat16 convolutions on the MXU, BatchNorm statistics in
+float32 with cross-replica axis reduction when a data axis name is given.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: str = "bfloat16"
+    axis_name: str | None = None  # cross-replica BN reduction axis
+
+
+def resnet50(**kw) -> ResNetConfig:
+    return ResNetConfig(**kw)
+
+
+def resnet18(**kw) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(2, 2, 2, 2), **kw)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int]
+    dtype: jnp.dtype
+    axis_name: str | None
+    use_bn: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32, axis_name=self.axis_name)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = norm(name="bn1")(y).astype(self.dtype)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), strides=self.strides, name="conv2")(y)
+        y = norm(name="bn2")(y).astype(self.dtype)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros_init())(y)
+        y = y.astype(self.dtype)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), strides=self.strides,
+                            name="proj_conv")(residual)
+            residual = norm(name="proj_bn")(residual).astype(self.dtype)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig = ResNetConfig()
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        x = x.astype(dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32,
+                         axis_name=cfg.axis_name, name="stem_bn")(x)
+        x = nn.relu(x.astype(dtype))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, num_blocks in enumerate(cfg.stage_sizes):
+            for block in range(num_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BottleneckBlock(cfg.width * 2 ** stage, strides, dtype,
+                                    cfg.axis_name,
+                                    name=f"stage{stage}_block{block}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32, name="classifier")(x)
+        return x

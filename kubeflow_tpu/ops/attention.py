@@ -1,0 +1,72 @@
+"""Multi-head attention core.
+
+The XLA path keeps the whole softmax(QK^T)V contraction inside one jit region
+so XLA fuses mask+softmax+scale into the MXU matmuls; models wrap it in
+``jax.checkpoint`` per block so activations are rematerialized instead of
+stored (HBM is the bottleneck, SURVEY.md build notes).  A Pallas flash-attention
+kernel (ops.flash_attention) is used instead when running on TPU with shapes
+aligned to the MXU; this module is the dispatcher.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(q, k, v, *, causal: bool, mask, softmax_dtype):
+    """Reference attention: [B, S, H, D] inputs, fused by XLA."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=softmax_dtype))
+    # [B, H, Sq, Sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=softmax_dtype)
+    logits = logits * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        # offset supports decode: query positions are the last sq of sk
+        causal_mask = (
+            jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
+            >= jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1))
+        logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
+    if mask is not None:
+        # mask: [B, 1|H, Sq|1, Sk] boolean, True = attend
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    weights = weights.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "use_flash"))
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+    use_flash: bool = False,
+    softmax_dtype=jnp.float32,
+) -> jax.Array:
+    """Attention over [batch, seq, heads, head_dim] tensors.
+
+    Args:
+      q, k, v: [B, S, H, D] (K/V may have fewer heads for GQA — they are
+        broadcast up to the query head count).
+      causal: apply causal masking (decode-aware when Sq < Sk).
+      mask: optional boolean mask broadcastable to [B, H, Sq, Sk]; True=keep.
+      use_flash: route to the Pallas flash kernel when shapes allow (TPU).
+    """
+    if k.shape[-2] != q.shape[-2]:
+        group = q.shape[-2] // k.shape[-2]
+        k = jnp.repeat(k, group, axis=-2)
+        v = jnp.repeat(v, group, axis=-2)
+    if use_flash and mask is None:
+        from kubeflow_tpu.ops import flash_attention as fa
+
+        if fa.supported(q, k):
+            return fa.flash_attention(q, k, v, causal=causal)
+    return _xla_attention(q, k, v, causal=causal, mask=mask,
+                          softmax_dtype=softmax_dtype)

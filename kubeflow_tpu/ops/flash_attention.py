@@ -1,0 +1,213 @@
+"""Flash attention for TPU, written in Pallas.
+
+Forward pass is a Pallas kernel: grid over (batch*heads, query blocks), online
+softmax over key blocks held in VMEM, accumulation in float32, output cast back
+to the input dtype.  Backward is a blockwise lax.scan (XLA) using the saved
+log-sum-exp, so peak memory stays O(S * block) instead of O(S^2) — on TPU the
+backward matmuls are MXU-bound either way and XLA fuses the elementwise chain.
+
+Kernel playbook follows /opt/skills/guides/pallas_guide.md (online-softmax +
+VMEM blocking + MXU-aligned tiles).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def supported(q: jax.Array, k: jax.Array) -> bool:
+    """Whether the Pallas kernel can serve these shapes on this backend."""
+    if not _HAS_PLTPU or jax.default_backend() not in ("tpu", "axon"):
+        return False
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    return (d in (64, 128, 256) and sq % 128 == 0 and sk % 128 == 0
+            and q.dtype in (jnp.float32, jnp.bfloat16))
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, sm_scale: float, pos_offset: int):
+    # q_ref: [BQ, D]; k_ref, v_ref: [S, D]; o_ref: [BQ, D]; lse_ref: [BQ, 1].
+    # pos_offset = sk - sq: with causal decode-style calls (sq < sk) query i
+    # sits at absolute position i + pos_offset, matching _xla_attention.
+    block_q, d = q_ref.shape
+    seq_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = seq_k // block_k
+    if causal:
+        # only key blocks whose start is <= the last query's absolute position
+        num_kb_eff = jnp.minimum(
+            (qi * block_q + block_q + pos_offset + block_k - 1) // block_k,
+            num_kb)
+    else:
+        num_kb_eff = num_kb
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = (qi * block_q + pos_offset
+                     + jax.lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 0))
+            k_pos = (kb * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p, v_blk,
+                                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l)).astype(jnp.float32)
+
+
+def _pick_block(requested: int, seq: int) -> int:
+    """Largest MXU-aligned block <= requested that divides seq (seq % 128 == 0
+    is guaranteed by supported())."""
+    for cand in (requested, 256, 128):
+        if cand <= requested and seq % cand == 0:
+            return cand
+    return 128
+
+
+def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(min(block_q, sq), sq)
+    block_k = _pick_block(min(block_k, sk), sk)
+    sm_scale = 1.0 / (d ** 0.5)
+    # fold batch and heads: [B*H, S, D]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    grid = (b * h, sq // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                               sm_scale=sm_scale, pos_offset=sk - sq)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, i: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+    )(qr, kr, vr)
+    o4 = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return o4, (qr, kr, vr, o, lse, b, h, sm_scale)
+
+
+def _flash_bwd(causal: bool, block_q: int, block_k: int, res, g):
+    qr, kr, vr, o, lse, b, h, sm_scale = res
+    bh, sq, d = qr.shape
+    sk = kr.shape[1]
+    gr = g.transpose(0, 2, 1, 3).reshape(bh, sq, d).astype(jnp.float32)
+    qf = qr.astype(jnp.float32)
+    kf = kr.astype(jnp.float32)
+    vf = vr.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    # delta_i = rowsum(dO_i * O_i)
+    delta = jnp.sum(gr * of, axis=-1, keepdims=True)  # [BH, Sq, 1]
+
+    nqb = max(1, sq // min(block_q, sq))
+    bq = sq // nqb
+
+    def scan_body(carry, idx):
+        dk_acc, dv_acc = carry
+        qb = jax.lax.dynamic_slice_in_dim(qf, idx * bq, bq, axis=1)
+        gb = jax.lax.dynamic_slice_in_dim(gr, idx * bq, bq, axis=1)
+        lseb = jax.lax.dynamic_slice_in_dim(lse, idx * bq, bq, axis=1)
+        deltab = jax.lax.dynamic_slice_in_dim(delta, idx * bq, bq, axis=1)
+        s = jnp.einsum("bqd,bkd->bqk", qb, kf) * sm_scale
+        if causal:
+            q_pos = (idx * bq + (sk - sq)
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 0))
+            k_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 1)
+            s = jnp.where((q_pos >= k_pos)[None], s, _NEG_INF)
+        p = jnp.exp(s - lseb)  # [BH, bq, Sk]
+        dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p, gb)
+        dp = jnp.einsum("bqd,bkd->bqk", gb, vf)
+        ds = p * (dp - deltab) * sm_scale
+        dq_b = jnp.einsum("bqk,bkd->bqd", ds, kf)
+        dk_acc = dk_acc + jnp.einsum("bqk,bqd->bkd", ds, qb)
+        return (dk_acc, dv_acc), dq_b
+
+    (dk, dv), dq_blocks = jax.lax.scan(
+        scan_body,
+        (jnp.zeros((bh, sk, d), jnp.float32),
+         jnp.zeros((bh, sk, d), jnp.float32)),
+        jnp.arange(nqb))
+    # dq_blocks: [nqb, BH, bq, D] -> [BH, Sq, D]
+    dq = dq_blocks.transpose(1, 0, 2, 3).reshape(bh, sq, d)
+
+    def unfold(x, s):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return (unfold(dq, sq).astype(jnp.float32).astype(qr.dtype),
+            unfold(dk, sk).astype(kr.dtype),
+            unfold(dv, sk).astype(vr.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                        block_k=block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                      block_k=block_k)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, res, g):
+    return _flash_bwd(causal, block_q, block_k, res, g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Flash attention over [B, S, H, D]; same contract as
+    ops.attention.dot_product_attention (no explicit mask support)."""
+    return _flash(q, k, v, causal, block_q, block_k)

@@ -1,0 +1,23 @@
+from kubeflow_tpu.parallel.mesh import (
+    MeshAxes,
+    SliceTopology,
+    TOPOLOGIES,
+    make_mesh,
+)
+from kubeflow_tpu.parallel.sharding import (
+    ShardingRules,
+    batch_spec,
+    named_sharding,
+    shard_params_specs,
+)
+
+__all__ = [
+    "MeshAxes",
+    "SliceTopology",
+    "TOPOLOGIES",
+    "make_mesh",
+    "ShardingRules",
+    "batch_spec",
+    "named_sharding",
+    "shard_params_specs",
+]

@@ -1,0 +1,150 @@
+"""TPU slice topologies and device-mesh construction.
+
+The reference's platform treats accelerators as opaque ``nvidia.com/gpu``
+counts in ResourceQuota / spawner config (profile_controller.go:246-261,
+spawner_ui_config.yaml "gpus").  A TPU-native platform must instead reason
+about *slices*: a ``v5e-32`` is 8 hosts x 4 chips wired by ICI, scheduled
+atomically, and programmed as a single ``jax.sharding.Mesh``.
+
+This module is the single source of truth for:
+- the catalogue of slice shapes (``TOPOLOGIES``), used by the JAXJob
+  controller for gang scheduling and by ResourceQuota accounting;
+- mapping a slice + parallelism config to a named ``Mesh`` with the standard
+  axes ``('dp', 'fsdp', 'tp', 'sp')`` (data, fully-sharded-data, tensor,
+  sequence parallelism).
+
+Axis convention (scaling-book style): collectives for fsdp/tp/sp ride ICI
+within a slice; the dp axis is laid out outermost so multi-slice data
+parallelism rides DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical mesh axis names, outermost first. dp is outermost so that
+# cross-slice (DCN) traffic is pure data-parallel gradient reduction.
+MeshAxes = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """A TPU slice type the platform can schedule (one gang unit)."""
+
+    name: str           # accelerator type string, e.g. "v5e-32"
+    chips: int          # total chips in the slice
+    hosts: int          # number of TPU-VM hosts (gang size for the controller)
+    chips_per_host: int
+    hbm_gb_per_chip: int
+    bf16_tflops_per_chip: float
+    resource_name: str  # k8s-style extended resource (replaces nvidia.com/gpu)
+
+    @property
+    def chips_per_host_check(self) -> bool:
+        return self.hosts * self.chips_per_host == self.chips
+
+
+def _v5e(chips: int) -> SliceTopology:
+    hosts = max(1, chips // 4)
+    return SliceTopology(
+        name=f"v5e-{chips}", chips=chips, hosts=hosts,
+        chips_per_host=chips if chips < 4 else 4,
+        hbm_gb_per_chip=16, bf16_tflops_per_chip=197.0,
+        resource_name="cloud-tpu.google.com/v5e")
+
+
+def _v4(chips: int) -> SliceTopology:
+    return SliceTopology(
+        name=f"v4-{chips * 2}", chips=chips, hosts=max(1, chips // 4),
+        chips_per_host=min(chips, 4), hbm_gb_per_chip=32,
+        bf16_tflops_per_chip=275.0,
+        resource_name="cloud-tpu.google.com/v4")
+
+
+TOPOLOGIES: dict[str, SliceTopology] = {}
+for _c in (1, 4, 8, 16, 32, 64, 128, 256):
+    _t = _v5e(_c)
+    TOPOLOGIES[_t.name] = _t
+for _c in (4, 8, 16, 32, 64):
+    _t = _v4(_c)
+    TOPOLOGIES[_t.name] = _t
+
+
+def factor_axes(
+    n_devices: int,
+    dp: int = -1,
+    fsdp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+) -> tuple[int, int, int, int]:
+    """Resolve axis sizes; at most one axis may be -1 (inferred)."""
+    sizes = [dp, fsdp, tp, sp]
+    n_infer = sum(1 for s in sizes if s == -1)
+    if n_infer > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if n_infer == 1:
+        known = math.prod(s for s in sizes if s != -1)
+        if n_devices % known != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {known}")
+        sizes[sizes.index(-1)] = n_devices // known
+    if math.prod(sizes) != n_devices:
+        raise ValueError(
+            f"mesh axes {dict(zip(MeshAxes, sizes))} do not multiply to "
+            f"{n_devices} devices")
+    return tuple(sizes)  # type: ignore[return-value]
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    *,
+    dp: int = -1,
+    fsdp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the standard 4-axis mesh over the given (or all) devices.
+
+    ``jax.experimental.mesh_utils.create_device_mesh`` is used when the
+    requested device count matches the full process view so physical ICI
+    topology informs the layout; otherwise devices are reshaped in order.
+    """
+    explicit_devices = devices is not None
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = list(devices)[:n_devices]
+    if len(devices) < n_devices:
+        raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
+    shape = factor_axes(n_devices, dp=dp, fsdp=fsdp, tp=tp, sp=sp)
+    if not explicit_devices and n_devices == len(jax.devices()):
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape)
+            return Mesh(dev_array, MeshAxes)
+        except (ValueError, AssertionError):
+            pass
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MeshAxes)
+
+
+def best_mesh_for(topology: SliceTopology | str, *, model_parallel: int = 1,
+                  seq_parallel: int = 1) -> tuple[int, int, int, int]:
+    """Heuristic axis assignment for a slice: tp/sp as requested, the rest fsdp
+    within a slice, dp across slices (handled by the multi-slice layer)."""
+    if isinstance(topology, str):
+        topology = TOPOLOGIES[topology]
+    chips = topology.chips
+    if chips % (model_parallel * seq_parallel) != 0:
+        raise ValueError("model_parallel*seq_parallel must divide slice size")
+    fsdp = chips // (model_parallel * seq_parallel)
+    return (1, fsdp, model_parallel, seq_parallel)

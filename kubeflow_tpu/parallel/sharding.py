@@ -1,0 +1,115 @@
+"""Parameter/activation sharding rules for the 4-axis mesh.
+
+Instead of hand-annotating every parameter, models tag each weight with
+*logical axis names* (flax ``nn.with_partitioning`` metadata) and this module
+maps logical names -> mesh axes.  This is the pjit analog of the reference's
+pattern of wrapping a raw PodSpec in a CR: the model is the payload, the
+platform supplies the placement.
+
+Default rules (transformer-oriented, scaling-book layouts):
+
+  logical axis     mesh axes        meaning
+  ---------------  ---------------  ----------------------------------------
+  "batch"          ("dp", "fsdp")   data parallel over dp and fsdp
+  "seq"            "sp"             sequence/context parallelism
+  "embed"          "fsdp"           d_model dim: sharded for ZeRO-3 weights
+  "heads"          "tp"             attention heads: tensor parallel
+  "kv"             None             per-head dim: replicated
+  "mlp"            "tp"             FFN hidden dim: tensor parallel
+  "vocab"          "tp"             embedding/LM-head vocab dim
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Mapping from logical axis names to mesh axis (or axes, or None)."""
+
+    rules: tuple[tuple[str, Any], ...] = (
+        ("batch", ("dp", "fsdp")),
+        ("seq", "sp"),
+        ("embed", "fsdp"),
+        ("heads", "tp"),
+        ("kv", None),
+        ("mlp", "tp"),
+        ("vocab", "tp"),
+        ("stage", None),
+        ("expert", None),
+    )
+
+    def mesh_axes(self, logical_name: str | None):
+        if logical_name is None:
+            return None
+        for name, axes in self.rules:
+            if name == logical_name:
+                return axes
+        return None
+
+    def spec(self, logical_axes: tuple[str | None, ...]) -> P:
+        return P(*(self.mesh_axes(a) for a in logical_axes))
+
+    def replace(self, **kv: Any) -> "ShardingRules":
+        rules = tuple((k, kv[k]) if k in kv else (k, v) for k, v in self.rules)
+        extra = tuple((k, v) for k, v in kv.items()
+                      if k not in dict(self.rules))
+        return ShardingRules(rules + extra)
+
+
+DEFAULT_RULES = ShardingRules()
+
+
+def batch_spec(rules: ShardingRules = DEFAULT_RULES, *,
+               seq_sharded: bool = False) -> P:
+    """PartitionSpec for a [batch, seq, ...] input batch."""
+    if seq_sharded:
+        return P(rules.mesh_axes("batch"), rules.mesh_axes("seq"))
+    return P(rules.mesh_axes("batch"))
+
+
+def shard_params_specs(params: Any,
+                       rules: ShardingRules = DEFAULT_RULES) -> Any:
+    """Turn a pytree of flax params (possibly with nn.Partitioned metadata)
+    into a matching pytree of PartitionSpec.
+
+    Leaves carrying flax ``nn.Partitioned`` metadata use their logical names;
+    plain arrays are replicated.
+    """
+    import flax.linen as nn
+
+    def to_spec(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return rules.spec(leaf.names)
+        return P()
+
+    return jax.tree_util.tree_map(
+        to_spec, params,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def unbox_params(params: Any) -> Any:
+    """Strip flax Partitioned boxes, returning plain arrays."""
+    import flax.linen as nn
+
+    return jax.tree_util.tree_map(
+        lambda x: x.unbox() if isinstance(x, nn.Partitioned) else x,
+        params,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def logical_to_sharding(params: Any, mesh: Mesh,
+                        rules: ShardingRules = DEFAULT_RULES) -> Any:
+    """Pytree of NamedSharding for a boxed param tree."""
+    specs = shard_params_specs(params, rules)
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                  is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,174 @@
+"""Sharded training-step construction (pjit over the 4-axis mesh).
+
+This replaces the reference ecosystem's per-framework distribution strategies
+(TF_CONFIG + MultiWorkerMirroredStrategy, torch DDP + NCCL — SURVEY.md §5.8):
+one jitted step function whose in/out shardings place parameters per the
+ShardingRules and batches over the data axes; XLA inserts the collectives
+(psum over dp/fsdp for gradients, all-gathers for fsdp weights) and routes
+them over ICI/DCN.
+
+Usage::
+
+    mesh = make_mesh(dp=2, fsdp=2, tp=2, sp=1)
+    tx = optax.adamw(1e-4)
+    state, step_fn = build_train(model, loss_fn, tx, mesh, rng, example_batch)
+    state, metrics = step_fn(state, batch)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    ShardingRules,
+    shard_params_specs,
+    unbox_params,
+)
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def state_shardings(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    example_inputs: tuple,
+    mesh: Mesh,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> tuple[Any, Any]:
+    """(abstract_state, shardings) for a TrainState, via eval_shape.
+
+    model.init keeps flax Partitioned boxes in the abstract params, and the
+    optimizer state built from those boxed params mirrors them, so
+    shard_params_specs resolves the same logical names for both; plain
+    (unboxed) leaves like step counters come back replicated.
+    """
+
+    def make_state(r):
+        params = model.init(r, *example_inputs)["params"]
+        opt_state = tx.init(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state)
+
+    abstract = jax.eval_shape(make_state, rng)
+    specs = shard_params_specs(abstract, rules)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    return abstract, shardings
+
+
+def init_train_state(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    example_inputs: tuple,
+    mesh: Mesh,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> tuple[TrainState, Any]:
+    """Initialize a TrainState already sharded across the mesh."""
+    _, shardings = state_shardings(model, tx, rng, example_inputs, mesh, rules)
+
+    def make_state(r):
+        params = unbox_params(model.init(r, *example_inputs)["params"])
+        opt_state = tx.init(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state)
+
+    init_fn = jax.jit(make_state, out_shardings=shardings)
+    with mesh:
+        state = init_fn(rng)
+    return state, shardings
+
+
+def build_train_step(
+    forward: Callable[[Any, Any], jax.Array],
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state_sharding: Any,
+    batch_spec: P | Any,
+    *,
+    donate: bool = True,
+    grad_accum: int = 1,
+) -> Callable[[TrainState, Any], tuple[TrainState, dict]]:
+    """Compile forward/backward/update as one pjit'd function.
+
+    forward(params, batch) -> scalar loss.  Gradient reduction across dp/fsdp
+    is implicit in the sharding propagation.  ``grad_accum`` > 1 scans over
+    leading microbatch chunks to decouple global batch from memory.
+    """
+    if isinstance(batch_spec, P):
+        batch_sharding = NamedSharding(mesh, batch_spec)
+    else:
+        batch_sharding = batch_spec
+
+    def loss_and_grad(params, batch):
+        return jax.value_and_grad(forward)(params, batch)
+
+    def step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+        if grad_accum > 1:
+            def micro(carry, mb):
+                loss_sum, grad_sum = carry
+                loss, grads = loss_and_grad(state.params, mb)
+                return (loss_sum + loss,
+                        jax.tree_util.tree_map(jnp.add, grad_sum, grads)), None
+
+            zero_grads = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p), state.params)
+            microbatches = jax.tree_util.tree_map(
+                lambda x: x.reshape((grad_accum, x.shape[0] // grad_accum)
+                                    + x.shape[1:]), batch)
+            (loss, grads), _ = jax.lax.scan(
+                micro, (jnp.zeros(()), zero_grads), microbatches)
+            loss = loss / grad_accum
+            grads = jax.tree_util.tree_map(lambda g: g / grad_accum, grads)
+        else:
+            loss, grads = loss_and_grad(state.params, batch)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+        }
+        return TrainState(step=state.step + 1, params=new_params,
+                          opt_state=new_opt), metrics
+
+    jit_kwargs: dict[str, Any] = dict(
+        in_shardings=(state_sharding, batch_sharding),
+        out_shardings=(state_sharding, NamedSharding(mesh, P())),
+    )
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    return jax.jit(step, **jit_kwargs)
+
+
+def build_eval_step(
+    forward_metrics: Callable[[Any, Any], dict],
+    mesh: Mesh,
+    state_sharding: Any,
+    batch_spec: P,
+) -> Callable:
+    params_sharding = (state_sharding.params
+                       if hasattr(state_sharding, "params") else state_sharding)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(params_sharding, NamedSharding(mesh, batch_spec)),
+        out_shardings=NamedSharding(mesh, P()))
+    def eval_step(params, batch):
+        return forward_metrics(params, batch)
+
+    return eval_step

@@ -1,0 +1,5 @@
+from kubeflow_tpu.utils.config import Config, ConfigField, config_field
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.status import Phase
+
+__all__ = ["Config", "ConfigField", "config_field", "get_logger", "Phase"]

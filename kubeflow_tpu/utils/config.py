@@ -1,0 +1,187 @@
+"""Unified typed configuration with flag / env / file layering.
+
+The reference scatters configuration across Go stdlib flags, env-var toggles,
+YAML app config, and ConfigMaps (survey of notebook-controller main.go:50-57,
+culler.go:24-27, crud_backend/settings.py, spawner_ui_config.yaml).  This module
+replaces all of that with one declarative system: a ``Config`` subclass declares
+typed fields once and values resolve with precedence
+
+    explicit kwargs > CLI flags > environment > config file > default.
+
+Example::
+
+    class CullerConfig(Config):
+        enable_culling: bool = config_field(False, env="ENABLE_CULLING",
+                                            help="cull idle notebooks")
+        idle_time_min: int = config_field(1440, env="IDLE_TIME")
+        check_period_min: int = config_field(1, env="CULLING_CHECK_PERIOD")
+
+    cfg = CullerConfig.load(argv=sys.argv[1:], config_file="culler.yaml")
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import typing
+from typing import Any, Mapping, Sequence
+
+
+@dataclasses.dataclass
+class ConfigField:
+    default: Any
+    env: str | None = None
+    flag: str | None = None
+    help: str = ""
+    read_only: bool = False  # spawner_ui_config.yaml-style per-field policy
+    choices: Sequence[Any] | None = None
+
+
+def config_field(
+    default: Any,
+    *,
+    env: str | None = None,
+    flag: str | None = None,
+    help: str = "",
+    read_only: bool = False,
+    choices: Sequence[Any] | None = None,
+) -> Any:
+    """Declare a config field. Returned value is a marker consumed by Config."""
+    return ConfigField(default, env=env, flag=flag, help=help,
+                       read_only=read_only, choices=choices)
+
+
+def _coerce(value: Any, typ: Any) -> Any:
+    if typ is bool:
+        if isinstance(value, bool):
+            return value
+        return str(value).strip().lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ is str:
+        return str(value)
+    origin = typing.get_origin(typ)
+    if origin in (list, dict, tuple):
+        if isinstance(value, str):
+            return origin(json.loads(value))
+        return origin(value)
+    if origin is typing.Union:  # Optional[...]
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        if value is None:
+            return None
+        return _coerce(value, args[0]) if args else value
+    return value
+
+
+class Config:
+    """Base class: subclasses declare fields via ``config_field`` defaults."""
+
+    def __init__(self, **overrides: Any):
+        fields = self._fields()
+        unknown = set(overrides) - set(fields)
+        if unknown:
+            raise TypeError(f"unknown config fields: {sorted(unknown)}")
+        for name, spec in fields.items():
+            if name in overrides:
+                value = overrides[name]
+            else:
+                value = spec.default
+            typ = self._annotations().get(name, type(spec.default))
+            value = _coerce(value, typ)
+            if spec.choices is not None and value not in spec.choices:
+                raise ValueError(
+                    f"{name}={value!r} not in allowed choices {list(spec.choices)}")
+            object.__setattr__(self, name, value)
+
+    # -- declaration introspection -------------------------------------------
+    @classmethod
+    def _annotations(cls) -> dict[str, Any]:
+        anns: dict[str, Any] = {}
+        for klass in reversed(cls.__mro__):
+            anns.update(getattr(klass, "__annotations__", {}))
+        return anns
+
+    @classmethod
+    def _fields(cls) -> dict[str, ConfigField]:
+        out: dict[str, ConfigField] = {}
+        for name in cls._annotations():
+            spec = getattr(cls, name, None)
+            if isinstance(spec, ConfigField):
+                out[name] = spec
+            else:
+                out[name] = ConfigField(default=spec)
+        return out
+
+    # -- layered loading ------------------------------------------------------
+    @classmethod
+    def load(
+        cls,
+        argv: Sequence[str] | None = None,
+        config_file: str | None = None,
+        env: Mapping[str, str] | None = None,
+        **overrides: Any,
+    ):
+        env = os.environ if env is None else env
+        values: dict[str, Any] = {}
+        file_keys: set[str] = set()
+        # layer 1: config file (JSON or simple YAML subset)
+        if config_file and os.path.exists(config_file):
+            file_values = _load_config_file(config_file)
+            values.update(file_values)
+            file_keys = set(file_values)
+        # layer 2: environment
+        for name, spec in cls._fields().items():
+            if spec.env and spec.env in env:
+                values[name] = env[spec.env]
+        # layer 3: CLI flags
+        if argv is not None:
+            parser = argparse.ArgumentParser(prog=cls.__name__, add_help=False)
+            for name, spec in cls._fields().items():
+                flag = spec.flag or "--" + name.replace("_", "-")
+                parser.add_argument(flag, dest=name, default=None, help=spec.help)
+            parsed, _ = parser.parse_known_args(list(argv))
+            for name, val in vars(parsed).items():
+                if val is not None:
+                    values[name] = val
+        # layer 4: explicit overrides, respecting read_only file policy
+        for name, val in overrides.items():
+            spec = cls._fields().get(name)
+            if spec is not None and spec.read_only and name in file_keys:
+                continue  # field pinned by config file (spawner readOnly semantics)
+            values[name] = val
+        return cls(**values)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {name: getattr(self, name) for name in self._fields()}
+
+    def __repr__(self) -> str:
+        body = ", ".join(f"{k}={v!r}" for k, v in self.to_dict().items())
+        return f"{type(self).__name__}({body})"
+
+
+def _load_config_file(path: str) -> dict[str, Any]:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        return json.loads(text)
+    try:
+        import yaml  # type: ignore
+
+        return yaml.safe_load(text) or {}
+    except ImportError:
+        # minimal "key: value" parser so YAML files work without pyyaml
+        out: dict[str, Any] = {}
+        for line in text.splitlines():
+            line = line.split("#", 1)[0].strip()
+            if ":" in line:
+                k, v = line.split(":", 1)
+                v = v.strip()
+                try:
+                    out[k.strip()] = json.loads(v)
+                except (ValueError, json.JSONDecodeError):
+                    out[k.strip()] = v
+        return out

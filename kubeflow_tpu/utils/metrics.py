@@ -1,0 +1,119 @@
+"""Prometheus-style metrics registry (text exposition format).
+
+The reference registers Prometheus counters/gauges per component
+(notebook-controller pkg/metrics/metrics.go:13-99, KFAM kfam/monitoring.go:24-77).
+This is a dependency-free equivalent producing the standard text format, so any
+component can expose ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, label_names: Iterable[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *label_values: str) -> "_MetricHandle":
+        if len(label_values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} labels, "
+                f"got {len(label_values)}")
+        return _MetricHandle(self, tuple(str(v) for v in label_values))
+
+    def _add(self, key: tuple, delta: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def _set(self, key: tuple, value: float) -> None:
+        with self._lock:
+            self._values[key] = value
+
+    def get(self, *label_values: str) -> float:
+        return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def expose(self, kind: str) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {kind}"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, value in items:
+            if key:
+                labels = ",".join(
+                    f'{n}="{v}"' for n, v in zip(self.label_names, key))
+                lines.append(f"{self.name}{{{labels}}} {value}")
+            else:
+                lines.append(f"{self.name} {value}")
+        return "\n".join(lines)
+
+
+class _MetricHandle:
+    def __init__(self, metric: _Metric, key: tuple):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, delta: float = 1.0) -> None:
+        self._metric._add(self._key, delta)
+
+    def set(self, value: float) -> None:
+        self._metric._set(self._key, value)
+
+
+class Counter(_Metric):
+    def inc(self, delta: float = 1.0) -> None:
+        self._add((), delta)
+
+
+class Gauge(_Metric):
+    def set(self, value: float) -> None:
+        self._set((), value)
+
+    def inc(self, delta: float = 1.0) -> None:
+        self._add((), delta)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._collect_fn = fn
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, tuple[str, _Metric]] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "",
+                labels: Iterable[str] = ()) -> Counter:
+        return self._register(name, "counter", Counter(name, help_text, labels))
+
+    def gauge(self, name: str, help_text: str = "",
+              labels: Iterable[str] = ()) -> Gauge:
+        return self._register(name, "gauge", Gauge(name, help_text, labels))
+
+    def _register(self, name: str, kind: str, metric: _Metric):
+        with self._lock:
+            if name in self._metrics:
+                existing_kind, existing = self._metrics[name]
+                if existing_kind != kind:
+                    raise ValueError(f"metric {name} already registered as "
+                                     f"{existing_kind}")
+                return existing
+            self._metrics[name] = (kind, metric)
+            return metric
+
+    def expose(self) -> str:
+        with self._lock:
+            items = sorted(self._metrics.items())
+        chunks = []
+        for _, (kind, metric) in items:
+            gauge_fn = getattr(metric, "_collect_fn", None)
+            if gauge_fn is not None:
+                metric._set((), float(gauge_fn()))
+            chunks.append(metric.expose(kind))
+        return "\n".join(chunks) + "\n"
+
+
+REGISTRY = Registry()

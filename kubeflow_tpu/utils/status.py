@@ -1,0 +1,23 @@
+"""Normalized resource status phases.
+
+Mirrors the reference's shared status enum used by every CRUD backend
+(crud-web-apps/common/backend/.../crud_backend/status.py:1-22).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Phase(str, enum.Enum):
+    READY = "ready"
+    WAITING = "waiting"
+    WARNING = "warning"
+    ERROR = "error"
+    UNINITIALIZED = "uninitialized"
+    STOPPED = "stopped"
+    TERMINATING = "terminating"
+
+
+def make_status(phase: Phase, message: str = "", key: str = "") -> dict:
+    return {"phase": phase.value, "message": message, "key": key}

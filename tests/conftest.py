@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax backends init.
+
+This is the platform's envtest analog for the compute path (SURVEY.md §4: the
+reference validates distributed behavior only on live clusters; we validate
+sharding/collectives on virtual devices in every test run).
+
+Note: the environment's sitecustomize pre-registers a TPU ('axon') PJRT
+platform and pins jax_platforms; backends initialize lazily, so flipping the
+config back to cpu here (before any jax.devices() call) is sufficient.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from kubeflow_tpu.parallel import make_mesh
+
+    return make_mesh(8, dp=2, fsdp=2, tp=2, sp=1)

@@ -1,0 +1,106 @@
+"""End-to-end sharded training smoke tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models import bert, registry
+from kubeflow_tpu.parallel import make_mesh, train_step as ts
+
+
+def test_bert_sharded_training_decreases_loss(mesh8):
+    cfg = bert.bert_tiny()
+    model = bert.BertModel(cfg)
+    tx = optax.adamw(1e-3)
+    rng = jax.random.PRNGKey(0)
+    B, S = 8, 64
+    ids = jnp.zeros((B, S), jnp.int32)
+    state, shardings = ts.init_train_state(model, tx, rng, (ids,), mesh8)
+
+    def forward(params, b):
+        out = model.apply({"params": params}, b["input_ids"])
+        return bert.mlm_loss(out, b["labels"], b["weights"])
+
+    d = NamedSharding(mesh8, P(("dp", "fsdp")))
+    bs = {"input_ids": d, "labels": d, "weights": d}
+    step = ts.build_train_step(forward, tx, mesh8, shardings, bs)
+    k1, k2 = jax.random.split(rng)
+    batch = {
+        "input_ids": jax.random.randint(k1, (B, S), 0, cfg.vocab_size),
+        "labels": jax.random.randint(k2, (B, S), 0, cfg.vocab_size),
+        "weights": jnp.ones((B, S), jnp.float32),
+    }
+    batch = jax.device_put(batch, bs)
+    with mesh8:
+        state, m0 = step(state, batch)
+        for _ in range(3):
+            state, m = step(state, batch)
+    assert float(m["loss"]) < float(m0["loss"])
+    assert int(state.step) == 4
+
+
+def test_grad_accumulation_matches_full_batch(mesh8):
+    cfg = bert.bert_tiny()
+    model = bert.BertModel(cfg)
+    tx = optax.sgd(1e-2)
+    rng = jax.random.PRNGKey(1)
+    B, S = 8, 32
+    ids = jnp.zeros((B, S), jnp.int32)
+
+    def forward(params, b):
+        out = model.apply({"params": params}, b["input_ids"])
+        return bert.mlm_loss(out, b["labels"], b["weights"])
+
+    d = NamedSharding(mesh8, P())
+    bs = {"input_ids": d, "labels": d, "weights": d}
+    k1, k2 = jax.random.split(rng)
+    batch = {
+        "input_ids": jax.random.randint(k1, (B, S), 0, cfg.vocab_size),
+        "labels": jax.random.randint(k2, (B, S), 0, cfg.vocab_size),
+        "weights": jnp.ones((B, S), jnp.float32),
+    }
+    state1, sh = ts.init_train_state(model, tx, rng, (ids,), mesh8)
+    state2, _ = ts.init_train_state(model, tx, rng, (ids,), mesh8)
+    step1 = ts.build_train_step(forward, tx, mesh8, sh, bs, donate=False)
+    step2 = ts.build_train_step(forward, tx, mesh8, sh, bs, donate=False,
+                                grad_accum=2)
+    with mesh8:
+        s1, m1 = step1(state1, batch)
+        s2, m2 = step2(state2, batch)
+    # grad-accum averages microbatch losses; full batch averages everything —
+    # equal weights => identical up to float error
+    assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-4
+    p1 = jax.tree_util.tree_leaves(s1.params)
+    p2 = jax.tree_util.tree_leaves(s2.params)
+    for a, b in zip(p1, p2):
+        # bf16 matmul accumulation order differs between the scan and the
+        # full-batch pass; updates agree to ~1e-4
+        assert jnp.allclose(a, b, atol=1e-4), "accum params diverged"
+
+
+@pytest.mark.parametrize("name", ["mnist_mlp", "cifar_convnet", "llama"])
+def test_registry_models_train_step(name, mesh8):
+    entry = registry.get(name)
+    module = entry.make_model()
+    rng = jax.random.PRNGKey(0)
+    tx = optax.adam(1e-3)
+    inputs = entry.make_inputs(8, rng, module)
+    state, sh = ts.init_train_state(module, tx, rng, inputs, mesh8)
+
+    def forward(params, b):
+        return entry.forward_loss(module, params, b)
+
+    batch = entry.make_batch(8, rng, module)
+    bs = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh8, P()), batch)
+    step = ts.build_train_step(forward, tx, mesh8, sh, bs, donate=False)
+    losses = []
+    with mesh8:
+        for _ in range(5):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+    assert all(l == l and abs(l) < 1e6 for l in losses), losses
+    # memorizing a fixed synthetic batch must make progress within 5 steps
+    assert min(losses[1:]) < losses[0], losses

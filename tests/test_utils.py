@@ -1,0 +1,59 @@
+"""Config / metrics / logging unit tests."""
+
+import json
+
+from kubeflow_tpu.utils.config import Config, config_field
+from kubeflow_tpu.utils.metrics import Registry
+
+
+class CullerConfig(Config):
+    enable_culling: bool = config_field(False, env="ENABLE_CULLING")
+    idle_time_min: int = config_field(1440, env="IDLE_TIME")
+    name: str = config_field("nb", read_only=True)
+
+
+def test_config_defaults():
+    cfg = CullerConfig()
+    assert cfg.enable_culling is False and cfg.idle_time_min == 1440
+
+
+def test_config_env_layer():
+    cfg = CullerConfig.load(env={"ENABLE_CULLING": "true", "IDLE_TIME": "30"})
+    assert cfg.enable_culling is True and cfg.idle_time_min == 30
+
+
+def test_config_flag_beats_env():
+    cfg = CullerConfig.load(argv=["--idle-time-min", "5"],
+                            env={"IDLE_TIME": "30"})
+    assert cfg.idle_time_min == 5
+
+
+def test_config_file_layer(tmp_path):
+    f = tmp_path / "c.json"
+    f.write_text(json.dumps({"idle_time_min": 99, "name": "pinned"}))
+    cfg = CullerConfig.load(config_file=str(f), env={})
+    assert cfg.idle_time_min == 99
+    # read_only: file value wins over explicit override (spawner semantics)
+    cfg2 = CullerConfig.load(config_file=str(f), env={}, name="user-pick")
+    assert cfg2.name == "pinned"
+
+
+def test_config_read_only_without_file_value():
+    # read_only only pins when the value came from the config FILE
+    cfg = CullerConfig.load(env={}, name="user-pick")
+    assert cfg.name == "user-pick"
+
+
+def test_metrics_exposition():
+    reg = Registry()
+    c = reg.counter("requests_total", "reqs", labels=("code",))
+    c.labels("200").inc()
+    c.labels("200").inc()
+    c.labels("500").inc()
+    g = reg.gauge("up", "liveness")
+    g.set(1)
+    text = reg.expose()
+    assert 'requests_total{code="200"} 2.0' in text
+    assert 'requests_total{code="500"} 1.0' in text
+    assert "# TYPE up gauge" in text
+    assert c.get("200") == 2.0
