@@ -1,0 +1,94 @@
+"""Ring attention: exact attention over sequence shards on the 'sp' mesh axis.
+
+Long-context scaling (SURVEY.md §5.7 — absent from the reference; first-class
+here): the sequence is sharded across devices, K/V blocks rotate around the
+ring via ``jax.lax.ppermute`` (ICI neighbor exchange) while each device keeps
+a running online-softmax accumulator, so no device ever materializes the full
+[S, S] score matrix or the full K/V.  Compute for the current block overlaps
+the DMA of the next — XLA pipelines the ppermute with the matmuls.
+
+Used inside ``shard_map`` over a mesh with an 'sp' axis; ``ring_attention``
+is the per-shard function, ``make_ring_attention`` wires the shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_off, k_off, causal, sm_scale, m, l, acc):
+    """One online-softmax accumulation step against a K/V block."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp",
+                   causal: bool = False) -> jax.Array:
+    """Per-shard ring attention. q, k, v: local [B, S_local, H, D] shards.
+
+    Must run inside shard_map over a mesh axis ``axis_name``.  Returns the
+    local output shard [B, S_local, H, D].
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    sm_scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+
+    m0 = jnp.full((b, h, s_local, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_off = idx * s_local
+
+    # n is static (mesh axis size): unroll so XLA overlaps each step's
+    # ppermute with the previous block's matmuls, and the final block needs
+    # no rotation at all.
+    m, l, acc, kb, vb = m0, l0, acc0, k, v
+    for step in range(n):
+        # the block we currently hold originated on device (idx - step) % n
+        k_off = ((idx - step) % n) * s_local
+        if step + 1 < n:
+            kb_next = jax.lax.ppermute(kb, axis_name, perm)
+            vb_next = jax.lax.ppermute(vb, axis_name, perm)
+        m, l, acc = _block_attend(qf, kb.astype(jnp.float32),
+                                  vb, q_off, k_off, causal, sm_scale,
+                                  m, l, acc)
+        if step + 1 < n:
+            kb, vb = kb_next, vb_next
+    l = jnp.maximum(l, 1e-30)
+    out = (acc / l).astype(q.dtype)  # [B, H, Sq, D]
+    return out.transpose(0, 2, 1, 3)
+
+
+def make_ring_attention(mesh: Mesh, *, causal: bool = False,
+                        axis_name: str = "sp",
+                        batch_axes=("dp", "fsdp"), head_axis="tp"):
+    """shard_map-wrapped ring attention over [B, S, H, D] global arrays with
+    seq sharded on ``axis_name``."""
+    from jax import shard_map
+
+    spec = P(batch_axes, axis_name, head_axis, None)
+
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)

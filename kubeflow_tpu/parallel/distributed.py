@@ -1,0 +1,57 @@
+"""Multi-host rendezvous: the NCCL/TF_CONFIG replacement.
+
+The reference ecosystem's training operator injects ``TF_CONFIG`` or
+``MASTER_ADDR``+NCCL env into worker pods (SURVEY.md §5.8).  The TPU-native
+contract is three env vars, injected by the JAXJob controller into every pod
+of a gang, consumed here by ``initialize_from_env()`` at worker startup:
+
+    JAXJOB_COORDINATOR    host:port of process 0
+    JAXJOB_NUM_PROCESSES  total processes in the gang (hosts x 1)
+    JAXJOB_PROCESS_ID     this process's rank
+
+After ``jax.distributed.initialize`` every host sees the full slice's devices
+via jax.devices(); collectives ride ICI within a slice and DCN across slices,
+inserted by XLA from the mesh shardings — no application-level comm library.
+"""
+
+from __future__ import annotations
+
+import os
+
+COORDINATOR_ENV = "JAXJOB_COORDINATOR"
+NUM_PROCESSES_ENV = "JAXJOB_NUM_PROCESSES"
+PROCESS_ID_ENV = "JAXJOB_PROCESS_ID"
+
+
+def rendezvous_env(coordinator: str, num_processes: int,
+                   process_id: int) -> dict[str, str]:
+    """The env block the JAXJob controller injects into pod ``process_id``."""
+    return {
+        COORDINATOR_ENV: coordinator,
+        NUM_PROCESSES_ENV: str(num_processes),
+        PROCESS_ID_ENV: str(process_id),
+    }
+
+
+def initialize_from_env(env: dict[str, str] | None = None) -> dict:
+    """Join the gang described by the injected env (no-op single process).
+
+    Returns a summary dict (coordinator, num_processes, process_id,
+    initialized) for logging/status mirroring.
+    """
+    env = os.environ if env is None else env
+    coordinator = env.get(COORDINATOR_ENV)
+    num_processes = int(env.get(NUM_PROCESSES_ENV, "1"))
+    process_id = int(env.get(PROCESS_ID_ENV, "0"))
+    if coordinator is None or num_processes <= 1:
+        return {"coordinator": None, "num_processes": 1, "process_id": 0,
+                "initialized": False}
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return {"coordinator": coordinator, "num_processes": num_processes,
+            "process_id": process_id, "initialized": True}

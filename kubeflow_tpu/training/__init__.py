@@ -1,0 +1,3 @@
+from kubeflow_tpu.training.trainer import Trainer, TrainerConfig
+
+__all__ = ["Trainer", "TrainerConfig"]

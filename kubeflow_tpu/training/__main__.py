@@ -1,0 +1,53 @@
+"""Worker entrypoint: ``python -m kubeflow_tpu.training``.
+
+This is the command the JAXJob controller bakes into worker pods.  It joins
+the gang rendezvous from the injected env (parallel.distributed), then runs
+the Trainer described by ``--config`` (JSON file) plus flag overrides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubeflow_tpu.parallel.distributed import initialize_from_env
+from kubeflow_tpu.training.trainer import Trainer, TrainerConfig
+from kubeflow_tpu.utils.logging import get_logger
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("kubeflow_tpu.training")
+    parser.add_argument("--config", help="JSON TrainerConfig file")
+    parser.add_argument("--model", help="registry model name")
+    parser.add_argument("--steps", type=int)
+    parser.add_argument("--global-batch", type=int, dest="global_batch")
+    parser.add_argument("--checkpoint-dir", dest="checkpoint_dir")
+    parser.add_argument("--learning-rate", type=float, dest="learning_rate")
+    args = parser.parse_args(argv)
+
+    cfg_dict: dict = {}
+    if args.config:
+        with open(args.config) as f:
+            cfg_dict = json.load(f)
+    for key in ("model", "steps", "global_batch", "checkpoint_dir"):
+        val = getattr(args, key)
+        if val is not None:
+            cfg_dict[key] = val
+    if args.learning_rate is not None:
+        cfg_dict.setdefault("optimizer", {})["learning_rate"] = (
+            args.learning_rate)
+
+    log = get_logger("worker")
+    rdv = initialize_from_env()
+    log.info("rendezvous", **rdv)
+
+    cfg = TrainerConfig.from_dict(cfg_dict)
+    result = Trainer(cfg).run()
+    log.info("done", **result)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

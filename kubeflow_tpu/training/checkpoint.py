@@ -1,0 +1,66 @@
+"""Checkpoint/resume for training state (orbax-backed).
+
+The reference has no model checkpointing (SURVEY.md §5.4 — its "checkpoint"
+story is PVC workspace volumes and a stop annotation).  Here checkpointing is
+first-class: the Trainer saves sharded TrainState snapshots and restores them
+with the correct shardings after preemption — the mechanism Katib-equivalent
+trials on preemptible slices rely on.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: save(step, state), restore latest into shardings."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: Any, *, wait: bool = False) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, abstract_state: Any, step: int | None = None) -> Any:
+        """Restore into the sharding/structure of ``abstract_state`` (a pytree
+        of jax.ShapeDtypeStruct with shardings, e.g. from eval_shape)."""
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self._dir}")
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def abstract_like(state: Any, shardings: Any | None = None) -> Any:
+    """ShapeDtypeStruct pytree matching ``state`` (optionally with shardings)
+    for use as the restore target."""
+    def leaf(x, s=None):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+
+    if shardings is None:
+        return jax.tree_util.tree_map(leaf, state)
+    return jax.tree_util.tree_map(leaf, state, shardings)

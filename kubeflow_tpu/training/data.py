@@ -1,0 +1,98 @@
+"""Input pipelines: deterministic synthetic data + on-disk array datasets.
+
+The platform's example workloads (MNIST/CIFAR/BERT) run anywhere — CI has no
+dataset downloads (zero egress), so every registry model has a synthetic
+generator; real data can be supplied as .npz files on a PVC.  Batches are
+host-sharded: each JAXJob process loads only its slice of the global batch
+(process_index-strided), the pjit data sharding does the rest.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+
+class SyntheticDataset:
+    """Infinite deterministic batches from a registry model's generator."""
+
+    def __init__(self, model_name: str, module: Any, global_batch: int,
+                 seed: int = 0, **kw: Any):
+        from kubeflow_tpu.models import registry
+
+        self._entry = registry.get(model_name)
+        self._module = module
+        self._batch = global_batch
+        self._seed = seed
+        self._kw = kw
+
+    def __iter__(self) -> Iterator[dict]:
+        return self.iter_from(0)
+
+    def iter_from(self, start_step: int) -> Iterator[dict]:
+        """Resume-aware iteration: batch k is PRNGKey(seed + k) regardless of
+        where iteration starts, so a resumed run continues the schedule."""
+        step = start_step
+        while True:
+            rng = jax.random.PRNGKey(self._seed + step)
+            yield self._entry.make_batch(self._batch, rng, self._module,
+                                         **self._kw)
+            step += 1
+
+
+class NpzDataset:
+    """Epochs over an .npz file of arrays sharing a leading example axis.
+
+    Each process yields its process_index-strided rows of every global batch
+    (multi-host input sharding without a distributed filesystem protocol).
+    """
+
+    def __init__(self, path: str, global_batch: int, *, shuffle: bool = True,
+                 seed: int = 0, process_index: int | None = None,
+                 process_count: int | None = None):
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        self._arrays = dict(np.load(path))
+        sizes = {k: v.shape[0] for k, v in self._arrays.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"ragged dataset: {sizes}")
+        self._n = next(iter(sizes.values()))
+        self._batch = global_batch
+        self._shuffle = shuffle
+        self._seed = seed
+        self._pi = (jax.process_index() if process_index is None
+                    else process_index)
+        self._pc = (jax.process_count() if process_count is None
+                    else process_count)
+        if global_batch % self._pc:
+            raise ValueError("global batch must divide by process count")
+        if self._n < global_batch:
+            raise ValueError(
+                f"dataset {path} has {self._n} rows < global batch "
+                f"{global_batch}")
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self._n // self._batch
+
+    def __iter__(self) -> Iterator[dict]:
+        return self.iter_from(0)
+
+    def iter_from(self, start_step: int) -> Iterator[dict]:
+        """Resume-aware: global batch k is deterministic in (seed, k), so a
+        resumed run sees the remainder of the schedule, not a replay."""
+        bpe = self.batches_per_epoch
+        epoch, offset = divmod(start_step, bpe)
+        while True:
+            order = np.arange(self._n)
+            if self._shuffle:
+                np.random.default_rng(self._seed + epoch).shuffle(order)
+            for b in range(offset, bpe):
+                idx = order[b * self._batch:(b + 1) * self._batch]
+                idx = idx[self._pi::self._pc]
+                yield {k: v[idx] for k, v in self._arrays.items()}
+            offset = 0
+            epoch += 1

@@ -1,0 +1,54 @@
+"""Optimizer construction from declarative config (JAXJob spec payload)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import optax
+
+
+def make_schedule(cfg: dict[str, Any]):
+    kind = cfg.get("schedule", "constant")
+    lr = float(cfg.get("learning_rate", 1e-3))
+    if kind == "constant":
+        return lr
+    warmup = int(cfg.get("warmup_steps", 0))
+    total = int(cfg.get("total_steps", 10000))
+    if kind == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=warmup,
+            decay_steps=total, end_value=float(cfg.get("end_lr", 0.0)))
+    if kind == "linear":
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, warmup),
+             optax.linear_schedule(lr, 0.0, max(total - warmup, 1))],
+            [warmup])
+    raise ValueError(f"unknown schedule {kind!r}")
+
+
+def make_optimizer(cfg: dict[str, Any] | None = None
+                   ) -> optax.GradientTransformation:
+    """cfg: {name: adamw|adam|sgd|lamb, learning_rate, weight_decay,
+    schedule: constant|cosine|linear, warmup_steps, total_steps,
+    grad_clip_norm}."""
+    cfg = dict(cfg or {})
+    name = cfg.get("name", "adamw")
+    sched = make_schedule(cfg)
+    wd = float(cfg.get("weight_decay", 0.0))
+    if name == "adamw":
+        tx = optax.adamw(sched, weight_decay=wd,
+                         b1=float(cfg.get("b1", 0.9)),
+                         b2=float(cfg.get("b2", 0.999)))
+    elif name == "adam":
+        tx = optax.adam(sched, b1=float(cfg.get("b1", 0.9)),
+                        b2=float(cfg.get("b2", 0.999)))
+    elif name == "sgd":
+        tx = optax.sgd(sched, momentum=float(cfg.get("momentum", 0.9)))
+    elif name == "lamb":
+        tx = optax.lamb(sched, weight_decay=wd)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    clip = cfg.get("grad_clip_norm")
+    if clip:
+        tx = optax.chain(optax.clip_by_global_norm(float(clip)), tx)
+    return tx

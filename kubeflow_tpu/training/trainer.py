@@ -1,0 +1,147 @@
+"""The training loop: what a JAXJob worker process actually runs.
+
+Ties together registry model + optimizer config + mesh + data + checkpointing.
+This is the payload the JAXJob controller launches (one Trainer per host,
+gang-rendezvoused via parallel.distributed), and the function HPO trials call
+in-process.  Mirrors the reference's pattern of keeping the platform (CR spec)
+thin and the payload self-describing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.utils.logging import get_logger
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    model: str = "mnist_mlp"                      # registry key
+    model_config: dict = dataclasses.field(default_factory=dict)
+    optimizer: dict = dataclasses.field(default_factory=dict)
+    global_batch: int = 32
+    steps: int = 100
+    log_every: int = 10
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0                     # 0 = only at end
+    resume: bool = True
+    seed: int = 0
+    # mesh axes; -1 infers dp from the device count
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    grad_accum: int = 1
+    data_path: str | None = None                  # .npz on a PVC; else synthetic
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TrainerConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+class Trainer:
+    def __init__(self, cfg: TrainerConfig,
+                 metrics_hook: Callable[[int, dict], None] | None = None):
+        self.cfg = cfg
+        self.log = get_logger("trainer", model=cfg.model)
+        self._metrics_hook = metrics_hook
+        self.history: list[dict] = []
+
+    def run(self) -> dict:
+        """Train to cfg.steps; returns final metrics summary."""
+        import optax  # noqa: F401  (transitively used via make_optimizer)
+
+        from kubeflow_tpu.models import registry
+        from kubeflow_tpu.parallel import make_mesh
+        from kubeflow_tpu.parallel import train_step as ts
+        from kubeflow_tpu.training.data import NpzDataset, SyntheticDataset
+        from kubeflow_tpu.training.optim import make_optimizer
+
+        cfg = self.cfg
+        entry = registry.get(cfg.model)
+        module = entry.make_model(**cfg.model_config)
+        mesh = make_mesh(dp=cfg.dp, fsdp=cfg.fsdp, tp=cfg.tp, sp=cfg.sp)
+        tx = make_optimizer(cfg.optimizer)
+        rng = jax.random.PRNGKey(cfg.seed)
+
+        local_batch = cfg.global_batch // jax.process_count()
+        inputs = entry.make_inputs(cfg.global_batch, rng, module)
+        state, shardings = ts.init_train_state(module, tx, rng, inputs, mesh)
+
+        start_step = 0
+        ckpt = None
+        if cfg.checkpoint_dir:
+            from kubeflow_tpu.training.checkpoint import (
+                CheckpointManager, abstract_like)
+
+            ckpt = CheckpointManager(cfg.checkpoint_dir)
+            if cfg.resume and ckpt.latest_step() is not None:
+                state = ckpt.restore(abstract_like(state, shardings))
+                start_step = int(state.step)
+                self.log.info("resumed", step=start_step)
+
+        def forward(params, batch):
+            return entry.forward_loss(module, params, batch)
+
+        if cfg.data_path:
+            dataset = NpzDataset(cfg.data_path, cfg.global_batch,
+                                 seed=cfg.seed)
+        else:
+            dataset = SyntheticDataset(cfg.model, module, local_batch,
+                                       seed=cfg.seed)
+        # resume continues the data schedule instead of replaying batch 0
+        data_iter = dataset.iter_from(start_step)
+
+        example = next(data_iter)
+        bshard = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P(("dp", "fsdp"))), example)
+        step_fn = ts.build_train_step(forward, tx, mesh, shardings, bshard,
+                                      grad_accum=cfg.grad_accum)
+
+        import numpy as np
+
+        def put_batch(batch):
+            if jax.process_count() == 1:
+                return jax.device_put(batch, bshard)
+            # each process holds its local rows of the global batch; assemble
+            # the global sharded array across hosts
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.make_array_from_process_local_data(
+                    s, np.asarray(x)), batch, bshard)
+
+        t0 = time.perf_counter()
+        metrics = {}
+        with mesh:
+            for step in range(start_step, cfg.steps):
+                batch = example if step == start_step else next(data_iter)
+                state, metrics = step_fn(state, put_batch(batch))
+                if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                    loss = float(metrics["loss"])  # sync point
+                    dt = time.perf_counter() - t0
+                    done = step + 1 - start_step
+                    rec = {"step": step + 1, "loss": loss,
+                           "samples_per_sec": cfg.global_batch * done / dt}
+                    self.history.append(rec)
+                    self.log.info("train", **rec)
+                    if self._metrics_hook:
+                        self._metrics_hook(step + 1, rec)
+                if (ckpt and cfg.checkpoint_every
+                        and (step + 1) % cfg.checkpoint_every == 0):
+                    ckpt.save(step + 1, state)
+        if ckpt:
+            ckpt.save(cfg.steps, state, wait=True)
+            ckpt.close()
+        final_loss = float(metrics["loss"]) if metrics else float("nan")
+        return {
+            "final_loss": final_loss,
+            "steps": cfg.steps,
+            "samples_per_sec": (self.history[-1]["samples_per_sec"]
+                                if self.history else 0.0),
+        }
