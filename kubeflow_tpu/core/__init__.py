@@ -1,0 +1,32 @@
+"""Control-plane core: typed objects, a watchable in-memory API server, and a
+controller runtime (workqueue + reconcile loops + leader election).
+
+This layer is the platform's equivalent of the reference's L0/L1 stack
+(CRDs + common/reconcilehelper + controller-runtime) plus the envtest harness
+its controller tests depend on (suite_test.go:46-105): the API server runs
+in-process for tests and behind an HTTP facade in deployment.
+"""
+
+from kubeflow_tpu.core.objects import api_object, meta, owner_ref, set_condition
+from kubeflow_tpu.core.store import APIServer, Conflict, NotFound, WatchEvent
+from kubeflow_tpu.core.controller import (
+    Controller,
+    Manager,
+    Request,
+    Result,
+)
+
+__all__ = [
+    "APIServer",
+    "Conflict",
+    "Controller",
+    "Manager",
+    "NotFound",
+    "Request",
+    "Result",
+    "WatchEvent",
+    "api_object",
+    "meta",
+    "owner_ref",
+    "set_condition",
+]

@@ -1,0 +1,332 @@
+"""Controller runtime: workqueue, reconcile loops, leader election.
+
+The platform's controller-runtime equivalent.  Semantics mirrored from the
+reference's Go stack:
+
+- level-triggered reconcile keyed by (namespace, name): any watch event for
+  the primary kind or an owned child re-enqueues the owner's key, deduped
+  while pending (controller-runtime's single-reconcile-per-key model,
+  SURVEY.md §5.2);
+- per-key exponential backoff on reconcile error (5ms..30s), reset on
+  success;
+- Result(requeue_after=...) for periodic work (culling checks,
+  notebook_controller.go:269);
+- leader election: only the lease holder runs reconcile loops
+  (notebook-controller main.go:55-66).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from kubeflow_tpu.core.store import APIServer, WatchEvent
+from kubeflow_tpu.core import objects as ob
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+RECONCILE_TOTAL = REGISTRY.counter(
+    "controller_reconcile_total", "reconcile invocations",
+    labels=("controller", "outcome"))
+QUEUE_DEPTH = REGISTRY.gauge(
+    "controller_workqueue_depth", "pending keys", labels=("controller",))
+
+
+@dataclass(frozen=True)
+class Request:
+    namespace: str | None
+    name: str
+
+
+@dataclass
+class Result:
+    requeue_after: float | None = None
+
+
+class WorkQueue:
+    """Deduplicating delay queue with per-key exponential failure backoff."""
+
+    BASE_DELAY = 0.005
+    MAX_DELAY = 30.0
+
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self._heap: list[tuple[float, int, Request]] = []
+        self._pending: set[Request] = set()
+        self._failures: dict[Request, int] = {}
+        self._seq = 0
+        self._shutdown = False
+
+    def add(self, req: Request, delay: float = 0.0) -> None:
+        with self._lock:
+            if req in self._pending and delay == 0.0:
+                return
+            self._pending.add(req)
+            self._seq += 1
+            heapq.heappush(self._heap, (time.monotonic() + delay, self._seq,
+                                        req))
+            self._lock.notify_all()
+
+    def add_rate_limited(self, req: Request) -> None:
+        with self._lock:
+            n = self._failures.get(req, 0)
+            self._failures[req] = n + 1
+        delay = min(self.BASE_DELAY * (2 ** n), self.MAX_DELAY)
+        self.add(req, delay)
+
+    def forget(self, req: Request) -> None:
+        with self._lock:
+            self._failures.pop(req, None)
+
+    def get(self, timeout: float = 0.5) -> Request | None:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while not self._shutdown:
+                now = time.monotonic()
+                if self._heap and self._heap[0][0] <= now:
+                    _, _, req = heapq.heappop(self._heap)
+                    self._pending.discard(req)
+                    return req
+                wait = min(self._heap[0][0] - now if self._heap else timeout,
+                           deadline - now)
+                if wait <= 0:
+                    return None
+                self._lock.wait(wait)
+            return None
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+
+class Controller:
+    """Subclass contract:
+
+    kind: primary resource kind (watch + reconcile key source)
+    owns: child kinds — events map to the controller ownerRef's key
+    watch_mappers: {kind: fn(event) -> Iterable[Request]} custom routing
+    reconcile(request) -> Result | None
+    """
+
+    kind: str = ""
+    owns: tuple[str, ...] = ()
+    watch_mappers: dict[str, Callable[[WatchEvent], Iterable[Request]]] = {}
+
+    def __init__(self, server: APIServer):
+        self.server = server
+        self.log = get_logger(f"controller.{self.name}")
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def reconcile(self, req: Request) -> Result | None:  # pragma: no cover
+        raise NotImplementedError
+
+    # -- event routing ---------------------------------------------------------
+    def requests_for(self, ev: WatchEvent) -> Iterable[Request]:
+        md = ev.object.get("metadata", {})
+        if ev.kind == self.kind:
+            yield Request(md.get("namespace"), md["name"])
+            return
+        if ev.kind in self.owns:
+            ref = ob.controller_owner(ev.object)
+            if ref is not None and ref.get("kind") == self.kind:
+                yield Request(md.get("namespace"), ref["name"])
+            return
+        mapper = self.watch_mappers.get(ev.kind)
+        if mapper:
+            yield from mapper(ev)
+
+
+class Manager:
+    """Runs controllers against one APIServer; one worker thread per
+    controller plus a shared watch-dispatch thread."""
+
+    def __init__(self, server: APIServer, *, leader_election: bool = False,
+                 identity: str = "manager-0"):
+        self.server = server
+        self.controllers: list[Controller] = []
+        self._queues: dict[str, WorkQueue] = {}
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._leader_election = leader_election
+        self._identity = identity
+        self.log = get_logger("manager", identity=identity)
+
+    def add(self, controller: Controller) -> None:
+        self.controllers.append(controller)
+        self._queues[controller.name] = WorkQueue()
+
+    def _watched_kinds(self) -> set[str]:
+        kinds: set[str] = set()
+        for c in self.controllers:
+            kinds.add(c.kind)
+            kinds.update(c.owns)
+            kinds.update(c.watch_mappers)
+        return kinds
+
+    def start(self) -> None:
+        if self._leader_election and not acquire_lease(
+                self.server, "manager-leader", self._identity):
+            self.log.info("standing by; another leader holds the lease")
+            t = threading.Thread(target=self._lease_waiter, daemon=True)
+            t.start()
+            self._threads.append(t)
+            return
+        self._start_loops()
+
+    def _start_loops(self) -> None:
+        if self._leader_election:
+            t = threading.Thread(target=self._lease_renewer, daemon=True,
+                                 name="lease-renew")
+            t.start()
+            self._threads.append(t)
+        # seed queues with existing objects (level triggering on startup)
+        for c in self.controllers:
+            for obj in self.server.list(c.kind):
+                md = obj["metadata"]
+                self._queues[c.name].add(Request(md.get("namespace"),
+                                                 md["name"]))
+        watch = self.server.watch(self._watched_kinds())
+
+        def dispatch() -> None:
+            for ev in watch:
+                if self._stop.is_set():
+                    return
+                for c in self.controllers:
+                    for req in c.requests_for(ev):
+                        self._queues[c.name].add(req)
+
+        t = threading.Thread(target=dispatch, daemon=True, name="watch")
+        t.start()
+        self._threads.append(t)
+        self._watch = watch
+
+        for c in self.controllers:
+            t = threading.Thread(target=self._worker, args=(c,), daemon=True,
+                                 name=c.name)
+            t.start()
+            self._threads.append(t)
+        self.log.info("manager started",
+                      controllers=[c.name for c in self.controllers])
+
+    def _lease_renewer(self) -> None:
+        """Renew the leadership lease; losing it stops this manager so two
+        leaders never reconcile concurrently."""
+        while not self._stop.is_set():
+            time.sleep(LEASE_TTL / 3)
+            if self._stop.is_set():
+                return
+            if not acquire_lease(self.server, "manager-leader",
+                                 self._identity):
+                self.log.error("lost leadership lease; stopping")
+                self.stop()
+                return
+
+    def _lease_waiter(self) -> None:
+        while not self._stop.is_set():
+            if acquire_lease(self.server, "manager-leader", self._identity):
+                self.log.info("acquired leadership")
+                self._start_loops()
+                return
+            time.sleep(0.2)
+
+    def _worker(self, controller: Controller) -> None:
+        q = self._queues[controller.name]
+        while not self._stop.is_set():
+            req = q.get(timeout=0.3)
+            QUEUE_DEPTH.labels(controller.name).set(q.depth())
+            if req is None:
+                continue
+            try:
+                result = controller.reconcile(req)
+            except Exception:
+                RECONCILE_TOTAL.labels(controller.name, "error").inc()
+                controller.log.error(
+                    "reconcile failed", key=f"{req.namespace}/{req.name}",
+                    exc_info=True)
+                q.add_rate_limited(req)
+                continue
+            q.forget(req)
+            RECONCILE_TOTAL.labels(controller.name, "success").inc()
+            if result and result.requeue_after:
+                q.add(req, result.requeue_after)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for q in self._queues.values():
+            q.shutdown()
+        if hasattr(self, "_watch"):
+            self._watch.stop()
+        if self._leader_election:
+            release_lease(self.server, "manager-leader", self._identity)
+
+    def wait_idle(self, timeout: float = 10.0, settle: float = 0.15) -> bool:
+        """Test helper: wait until all queues drain and stay drained."""
+        deadline = time.monotonic() + timeout
+        quiet_since = None
+        while time.monotonic() < deadline:
+            if all(q.depth() == 0 for q in self._queues.values()):
+                if quiet_since is None:
+                    quiet_since = time.monotonic()
+                elif time.monotonic() - quiet_since >= settle:
+                    return True
+            else:
+                quiet_since = None
+            time.sleep(0.02)
+        return False
+
+
+# -- leader election -----------------------------------------------------------
+
+LEASE_KIND = "Lease"
+LEASE_TTL = 15.0
+
+
+def acquire_lease(server: APIServer, name: str, identity: str,
+                  ttl: float = LEASE_TTL) -> bool:
+    """Acquire or renew a lease object; returns True when ``identity`` holds
+    it (k8s coordination.k8s.io Lease semantics, simplified)."""
+    from kubeflow_tpu.core.store import Conflict, NotFound
+
+    now = time.time()
+    try:
+        lease = server.get(LEASE_KIND, name, "kube-system")
+    except NotFound:
+        try:
+            server.create(ob.api_object(
+                LEASE_KIND, name, "kube-system",
+                spec={"holder": identity, "renewTime": now, "ttl": ttl}))
+            return True
+        except Conflict:
+            return False
+    spec = lease["spec"]
+    if spec["holder"] != identity and now - spec["renewTime"] < spec["ttl"]:
+        return False
+    spec.update(holder=identity, renewTime=now, ttl=ttl)
+    try:
+        server.update(lease)
+        return True
+    except Conflict:
+        return False
+
+
+def release_lease(server: APIServer, name: str, identity: str) -> None:
+    from kubeflow_tpu.core.store import Conflict, NotFound
+
+    try:
+        lease = server.get(LEASE_KIND, name, "kube-system")
+        if lease["spec"]["holder"] == identity:
+            lease["spec"]["renewTime"] = 0
+            server.update(lease)
+    except (NotFound, Conflict):
+        pass

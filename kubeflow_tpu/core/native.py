@@ -1,0 +1,105 @@
+"""ctypes bindings to the native reconcile/admission engine (native/).
+
+The reference implements its admission merge and reconcile diffing in
+compiled Go (admission-webhook main.go, common/reconcilehelper/util.go); this
+platform's equivalents live in C++ (native/engine.cpp) behind a C ABI.  The
+library is built on demand with g++ and cached; ``ENGINE.available`` is False
+only if no compiler exists, in which case callers raise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from typing import Any
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libkfengine.so")
+
+
+class EngineError(RuntimeError):
+    pass
+
+
+class MergeConflict(EngineError):
+    """A PodDefault merge conflict — admission must reject the pod."""
+
+
+class _Engine:
+    def __init__(self) -> None:
+        self._lib: ctypes.CDLL | None = None
+        self._lock = threading.Lock()
+
+    def _build(self) -> None:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                       capture_output=True, text=True)
+
+    @property
+    def lib(self) -> ctypes.CDLL:
+        with self._lock:
+            if self._lib is None:
+                if not os.path.exists(_SO_PATH):
+                    self._build()
+                lib = ctypes.CDLL(_SO_PATH)
+                for fn in ("kf_apply_poddefaults", "kf_filter_poddefaults",
+                           "kf_match_selector", "kf_reconcile_merge"):
+                    getattr(lib, fn).restype = ctypes.c_void_p
+                    getattr(lib, fn).argtypes = [ctypes.c_char_p,
+                                                 ctypes.c_char_p]
+                lib.kf_free.argtypes = [ctypes.c_void_p]
+                lib.kf_version.restype = ctypes.c_char_p
+                self._lib = lib
+            return self._lib
+
+    @property
+    def available(self) -> bool:
+        try:
+            return self.lib is not None
+        except (OSError, subprocess.CalledProcessError):
+            return False
+
+    def version(self) -> str:
+        return self.lib.kf_version().decode()
+
+    def _call(self, fn_name: str, *args: Any) -> Any:
+        fn = getattr(self.lib, fn_name)
+        raw = fn(*(json.dumps(a).encode() for a in args))
+        if not raw:
+            raise EngineError(f"{fn_name} returned NULL")
+        try:
+            text = ctypes.string_at(raw).decode()
+        finally:
+            self.lib.kf_free(raw)
+        result = json.loads(text)
+        if "error" in result:
+            msg = result["error"]
+            if "conflict" in msg:
+                raise MergeConflict(msg)
+            raise EngineError(msg)
+        return result["ok"]
+
+    # -- public API -------------------------------------------------------------
+    def apply_poddefaults(self, pod: dict, poddefaults: list[dict]) -> dict:
+        """{"pod": mutated_pod, "applied": [names]}; raises MergeConflict."""
+        return self._call("kf_apply_poddefaults", pod, poddefaults)
+
+    def filter_poddefaults(self, pod: dict,
+                           poddefaults: list[dict]) -> list[dict]:
+        return self._call("kf_filter_poddefaults", pod, poddefaults)
+
+    def match_selector(self, selector: dict | None, labels: dict | None,
+                       ) -> bool:
+        return self._call("kf_match_selector", selector or {}, labels or {})
+
+    def reconcile_merge(self, live: dict, desired: dict) -> tuple[dict, bool]:
+        """Copy desired fields onto live; (merged, changed)."""
+        out = self._call("kf_reconcile_merge", live, desired)
+        return out["object"], out["changed"]
+
+
+ENGINE = _Engine()

@@ -1,0 +1,118 @@
+"""API object helpers: k8s-shaped dict resources.
+
+Every resource is a plain dict with apiVersion/kind/metadata/spec/status so
+arbitrary payloads (full PodSpecs, the reference's NotebookSpec pattern —
+notebook_types.go:27-35) round-trip untouched.  Helpers here keep metadata
+handling (uids, ownerReferences, conditions) in one place.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+import uuid
+from typing import Any
+
+
+def api_object(kind: str, name: str, namespace: str | None = None, *,
+               spec: dict | None = None, labels: dict | None = None,
+               annotations: dict | None = None,
+               api_version: str = "kubeflow-tpu.org/v1") -> dict:
+    obj: dict[str, Any] = {
+        "apiVersion": api_version,
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": copy.deepcopy(spec) if spec else {},
+    }
+    if namespace is not None:
+        obj["metadata"]["namespace"] = namespace
+    if labels:
+        obj["metadata"]["labels"] = dict(labels)
+    if annotations:
+        obj["metadata"]["annotations"] = dict(annotations)
+    return obj
+
+
+def meta(obj: dict) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def name_of(obj: dict) -> str:
+    return obj["metadata"]["name"]
+
+
+def namespace_of(obj: dict) -> str | None:
+    return obj["metadata"].get("namespace")
+
+
+def uid_of(obj: dict) -> str | None:
+    return obj["metadata"].get("uid")
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+def owner_ref(owner: dict, *, controller: bool = True) -> dict:
+    """ownerReference to ``owner`` (which must have been created, i.e. has a
+    uid).  Children with a controller ownerRef are garbage-collected with the
+    owner, mirroring SetControllerReference (notebook_controller.go:120)."""
+    return {
+        "apiVersion": owner["apiVersion"],
+        "kind": owner["kind"],
+        "name": name_of(owner),
+        "uid": owner["metadata"]["uid"],
+        "controller": controller,
+    }
+
+
+def set_owner(child: dict, owner: dict) -> dict:
+    refs = meta(child).setdefault("ownerReferences", [])
+    ref = owner_ref(owner)
+    if not any(r.get("uid") == ref["uid"] for r in refs):
+        refs.append(ref)
+    return child
+
+
+def controller_owner(obj: dict) -> dict | None:
+    for ref in meta(obj).get("ownerReferences", []):
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def set_condition(obj: dict, type_: str, status: str, reason: str = "",
+                  message: str = "") -> None:
+    """Upsert a status condition (type/status/reason/message/time)."""
+    conds = obj.setdefault("status", {}).setdefault("conditions", [])
+    now = time.time()
+    for c in conds:
+        if c["type"] == type_:
+            if c["status"] != status or c.get("reason") != reason:
+                c.update(status=status, reason=reason, message=message,
+                         lastTransitionTime=now)
+            return
+    conds.append({"type": type_, "status": status, "reason": reason,
+                  "message": message, "lastTransitionTime": now})
+
+
+def get_condition(obj: dict, type_: str) -> dict | None:
+    for c in obj.get("status", {}).get("conditions", []):
+        if c["type"] == type_:
+            return c
+    return None
+
+
+def match_labels(selector: dict | None, labels: dict | None) -> bool:
+    """k8s label-selector semantics: matchLabels + matchExpressions
+    (In/NotIn/Exists/DoesNotExist).  Empty/None selector matches everything
+    (admission-webhook main.go filterPodDefaults uses the same contract).
+
+    Delegates to the native engine so LIST filtering and admission filtering
+    share one implementation and cannot drift.
+    """
+    if not selector:
+        return True
+    from kubeflow_tpu.core.native import ENGINE
+
+    return ENGINE.match_selector(selector, labels or {})

@@ -1,0 +1,314 @@
+"""In-memory watchable API server — the platform's etcd + apiserver.
+
+Replaces the reference's dependency stack (k8s API server + envtest binaries,
+suite_test.go:46-105) with one process-local implementation offering the same
+semantics the controllers rely on:
+
+- optimistic concurrency via resourceVersion (Conflict on stale update);
+- label-selector LIST, namespace scoping, cluster-scoped kinds;
+- WATCH streams (ADDED/MODIFIED/DELETED) with per-watcher queues;
+- finalizers: DELETE sets deletionTimestamp, object is removed only when the
+  finalizer list drains (profile_controller.go:277-312 contract);
+- ownerReference garbage collection: deleting an owner cascades to children
+  holding its uid (SetControllerReference contract);
+- admission hooks: mutating webhooks run on CREATE before storage
+  (admission-webhook main.go flow).
+
+Thread-safe; controllers and web backends share one instance in-process, and
+core.httpapi exposes the same store over REST for out-of-process clients.
+"""
+
+from __future__ import annotations
+
+import copy
+import fnmatch
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable
+
+from kubeflow_tpu.core import objects as ob
+
+
+class NotFound(KeyError):
+    pass
+
+
+class Conflict(RuntimeError):
+    pass
+
+
+class Invalid(ValueError):
+    pass
+
+
+@dataclass
+class WatchEvent:
+    type: str          # ADDED | MODIFIED | DELETED
+    object: dict
+
+    @property
+    def kind(self) -> str:
+        return self.object["kind"]
+
+
+# kinds that live outside any namespace (mirrors k8s built-ins + our CRDs)
+CLUSTER_SCOPED = {"Namespace", "Profile", "ClusterRole", "PersistentVolume"}
+
+
+class APIServer:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # (kind, namespace or "", name) -> object
+        self._objects: dict[tuple[str, str, str], dict] = {}
+        self._rv = 0
+        self._watchers: list[tuple[Callable[[WatchEvent], bool], queue.Queue]] = []
+        self._mutating_hooks: list[Callable[[dict], dict | None]] = []
+        self._validating_hooks: list[Callable[[dict], None]] = []
+
+    # -- helpers --------------------------------------------------------------
+    def _key(self, kind: str, namespace: str | None, name: str):
+        if kind in CLUSTER_SCOPED:
+            return (kind, "", name)
+        return (kind, namespace or "default", name)
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _emit(self, event: WatchEvent) -> None:
+        for pred, q in list(self._watchers):
+            if pred(event):
+                q.put(event)
+
+    # -- admission ------------------------------------------------------------
+    def register_mutating_hook(self, hook: Callable[[dict], dict | None],
+                               ) -> None:
+        """hook(obj) -> mutated obj (or None = no change); runs on CREATE."""
+        self._mutating_hooks.append(hook)
+
+    def register_validating_hook(self, hook: Callable[[dict], None]) -> None:
+        """hook(obj) raises Invalid to reject a CREATE/UPDATE."""
+        self._validating_hooks.append(hook)
+
+    # -- CRUD -----------------------------------------------------------------
+    def create(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        kind = obj["kind"]
+        md = ob.meta(obj)
+        if "name" not in md:
+            raise Invalid(f"{kind}: metadata.name required")
+        for hook in self._mutating_hooks:
+            mutated = hook(obj)
+            if mutated is not None:
+                obj = mutated
+        md = ob.meta(obj)  # hooks may return a new object; re-resolve metadata
+        for hook in self._validating_hooks:
+            hook(obj)
+        with self._lock:
+            key = self._key(kind, md.get("namespace"), md["name"])
+            if key in self._objects:
+                raise Conflict(f"{kind} {key[1]}/{key[2]} already exists")
+            if kind not in CLUSTER_SCOPED:
+                md.setdefault("namespace", "default")
+            md["uid"] = ob.new_uid()
+            md["resourceVersion"] = self._next_rv()
+            md.setdefault("labels", {})
+            md.setdefault("annotations", {})
+            self._objects[key] = obj
+            out = copy.deepcopy(obj)
+        self._emit(WatchEvent("ADDED", copy.deepcopy(obj)))
+        return out
+
+    def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
+        with self._lock:
+            key = self._key(kind, namespace, name)
+            if key not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(self._objects[key])
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict | None = None,
+             field_match: dict | None = None) -> list[dict]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if (namespace is not None and kind not in CLUSTER_SCOPED
+                        and ns != namespace):
+                    continue
+                if not ob.match_labels(label_selector,
+                                       obj["metadata"].get("labels")):
+                    continue
+                if field_match and not _match_fields(obj, field_match):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return sorted(out, key=lambda o: (o["metadata"].get("namespace")
+                                              or "", o["metadata"]["name"]))
+
+    def update(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        kind = obj["kind"]
+        md = obj["metadata"]
+        for hook in self._validating_hooks:
+            hook(obj)
+        with self._lock:
+            key = self._key(kind, md.get("namespace"), md.get("name"))
+            existing = self._objects.get(key)
+            if existing is None:
+                raise NotFound(f"{kind} {key[1]}/{key[2]} not found")
+            if (md.get("resourceVersion")
+                    and md["resourceVersion"]
+                    != existing["metadata"]["resourceVersion"]):
+                raise Conflict(
+                    f"{kind} {key[2]}: stale resourceVersion "
+                    f"{md['resourceVersion']} != "
+                    f"{existing['metadata']['resourceVersion']}")
+            md["uid"] = existing["metadata"]["uid"]
+            # preserve deletion state across writes
+            if "deletionTimestamp" in existing["metadata"]:
+                md["deletionTimestamp"] = (
+                    existing["metadata"]["deletionTimestamp"])
+            # no-op writes don't bump resourceVersion or emit events
+            # (prevents status-mirroring reconcile hot-loops)
+            md["resourceVersion"] = existing["metadata"]["resourceVersion"]
+            if obj == existing:
+                return copy.deepcopy(existing)
+            md["resourceVersion"] = self._next_rv()
+            self._objects[key] = obj
+            finalize = ("deletionTimestamp" in md
+                        and not md.get("finalizers"))
+            out = copy.deepcopy(obj)
+        self._emit(WatchEvent("MODIFIED", copy.deepcopy(obj)))
+        if finalize:
+            self._remove(kind, md.get("namespace"), md["name"])
+        return out
+
+    def patch_status(self, kind: str, name: str, namespace: str | None,
+                     status: dict) -> dict:
+        """Status subresource update (no spec changes, no conflict check) —
+        the controllers' status-mirroring write path."""
+        with self._lock:
+            key = self._key(kind, namespace, name)
+            if key not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            obj = self._objects[key]
+            if obj.get("status") == status:
+                return copy.deepcopy(obj)
+            obj["status"] = copy.deepcopy(status)
+            obj["metadata"]["resourceVersion"] = self._next_rv()
+            snapshot = copy.deepcopy(obj)
+        self._emit(WatchEvent("MODIFIED", snapshot))
+        return copy.deepcopy(snapshot)
+
+    def delete(self, kind: str, name: str, namespace: str | None = None,
+               ) -> None:
+        with self._lock:
+            key = self._key(kind, namespace, name)
+            obj = self._objects.get(key)
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            if obj["metadata"].get("finalizers"):
+                # finalizer protocol: mark, let controllers drain finalizers
+                if "deletionTimestamp" not in obj["metadata"]:
+                    import time as _t
+
+                    obj["metadata"]["deletionTimestamp"] = _t.time()
+                    obj["metadata"]["resourceVersion"] = self._next_rv()
+                    snapshot = copy.deepcopy(obj)
+                else:
+                    return
+            else:
+                snapshot = None
+        if snapshot is not None:
+            self._emit(WatchEvent("MODIFIED", snapshot))
+            return
+        self._remove(kind, namespace, name)
+
+    def _remove(self, kind: str, namespace: str | None, name: str) -> None:
+        with self._lock:
+            key = self._key(kind, namespace, name)
+            obj = self._objects.pop(key, None)
+            if obj is None:
+                return
+            uid = obj["metadata"]["uid"]
+            # collect dependents for cascade delete
+            dependents = [
+                (o["kind"], o["metadata"].get("namespace"),
+                 o["metadata"]["name"])
+                for o in self._objects.values()
+                if any(r.get("uid") == uid
+                       for r in o["metadata"].get("ownerReferences", []))
+            ]
+        self._emit(WatchEvent("DELETED", copy.deepcopy(obj)))
+        for dkind, dns, dname in dependents:
+            try:
+                self.delete(dkind, dname, dns)
+            except NotFound:
+                pass
+
+    # -- watch ----------------------------------------------------------------
+    def watch(self, kinds: Iterable[str] | None = None,
+              namespace: str | None = None) -> "Watch":
+        kinds = set(kinds) if kinds else None
+
+        def pred(ev: WatchEvent) -> bool:
+            if kinds and ev.kind not in kinds:
+                return False
+            if namespace and ev.object["metadata"].get("namespace") not in (
+                    namespace, None):
+                return False
+            return True
+
+        q: queue.Queue = queue.Queue()
+        entry = (pred, q)
+        with self._lock:
+            self._watchers.append(entry)
+        return Watch(self, entry)
+
+    def _unwatch(self, entry) -> None:
+        with self._lock:
+            if entry in self._watchers:
+                self._watchers.remove(entry)
+
+
+class Watch:
+    def __init__(self, server: APIServer, entry):
+        self._server = server
+        self._entry = entry
+        self._queue: queue.Queue = entry[1]
+        self._stopped = False
+
+    def next(self, timeout: float | None = None) -> WatchEvent | None:
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._server._unwatch(self._entry)
+
+    def __iter__(self):
+        while not self._stopped:
+            ev = self.next(timeout=0.2)
+            if ev is not None:
+                yield ev
+
+
+def _match_fields(obj: dict, fields: dict[str, Any]) -> bool:
+    """Dotted-path equality match, e.g. {"spec.nodeName": "host-3"};
+    values support fnmatch globs."""
+    for path, want in fields.items():
+        cur: Any = obj
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return False
+            cur = cur[part]
+        if isinstance(want, str) and isinstance(cur, str):
+            if not fnmatch.fnmatch(cur, want):
+                return False
+        elif cur != want:
+            return False
+    return True

@@ -17,27 +17,36 @@ import numpy as np
 
 
 class SyntheticDataset:
-    """Infinite deterministic batches from a registry model's generator."""
+    """Infinite deterministic batches from a registry model's generator.
 
-    def __init__(self, model_name: str, module: Any, global_batch: int,
-                 seed: int = 0, **kw: Any):
+    ``local_batch`` rows are generated per process; the per-process RNG is
+    folded with process_index so hosts contribute disjoint slices of the
+    global batch rather than duplicates.
+    """
+
+    def __init__(self, model_name: str, module: Any, local_batch: int,
+                 seed: int = 0, process_index: int | None = None, **kw: Any):
         from kubeflow_tpu.models import registry
 
         self._entry = registry.get(model_name)
         self._module = module
-        self._batch = global_batch
+        self._batch = local_batch
         self._seed = seed
+        self._pi = (jax.process_index() if process_index is None
+                    else process_index)
         self._kw = kw
 
     def __iter__(self) -> Iterator[dict]:
         return self.iter_from(0)
 
     def iter_from(self, start_step: int) -> Iterator[dict]:
-        """Resume-aware iteration: batch k is PRNGKey(seed + k) regardless of
-        where iteration starts, so a resumed run continues the schedule."""
+        """Resume-aware iteration: batch k derives from fold_in(seed+k, rank)
+        regardless of where iteration starts, so a resumed run continues the
+        schedule and ranks never collide."""
         step = start_step
         while True:
-            rng = jax.random.PRNGKey(self._seed + step)
+            rng = jax.random.fold_in(jax.random.PRNGKey(self._seed + step),
+                                     self._pi)
             yield self._entry.make_batch(self._batch, rng, self._module,
                                          **self._kw)
             step += 1

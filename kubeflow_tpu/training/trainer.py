@@ -71,6 +71,10 @@ class Trainer:
         tx = make_optimizer(cfg.optimizer)
         rng = jax.random.PRNGKey(cfg.seed)
 
+        if cfg.global_batch % jax.process_count():
+            raise ValueError(
+                f"global_batch {cfg.global_batch} must divide by process "
+                f"count {jax.process_count()}")
         local_batch = cfg.global_batch // jax.process_count()
         inputs = entry.make_inputs(cfg.global_batch, rng, module)
         state, shardings = ts.init_train_state(module, tx, rng, inputs, mesh)
@@ -86,6 +90,11 @@ class Trainer:
                 state = ckpt.restore(abstract_like(state, shardings))
                 start_step = int(state.step)
                 self.log.info("resumed", step=start_step)
+                if start_step >= cfg.steps:
+                    self.log.info("already complete", step=start_step)
+                    ckpt.close()
+                    return {"final_loss": None, "steps": cfg.steps,
+                            "samples_per_sec": 0.0, "already_complete": True}
 
         def forward(params, batch):
             return entry.forward_loss(module, params, batch)
@@ -138,7 +147,7 @@ class Trainer:
         if ckpt:
             ckpt.save(cfg.steps, state, wait=True)
             ckpt.close()
-        final_loss = float(metrics["loss"]) if metrics else float("nan")
+        final_loss = float(metrics["loss"]) if metrics else None
         return {
             "final_loss": final_loss,
             "steps": cfg.steps,

@@ -1,0 +1,321 @@
+// kfengine: the platform's native reconcile/admission engine.
+//
+// Compiled-language equivalents of the reference's Go hot paths:
+//  - PodDefault admission merge with exact conflict semantics
+//    (reference: components/admission-webhook/main.go:98-424 — env merged by
+//    name with value-equality conflicts, envFrom append-only, volumeMounts
+//    keyed by name AND mountPath, volumes by name, tolerations by key,
+//    annotations/labels maps with per-key equality);
+//  - create-or-update field copy for reconciled children
+//    (reference: components/common/reconcilehelper/util.go — copy desired
+//    spec/labels into the live object, report whether anything changed);
+//  - label-selector matching (matchLabels + matchExpressions).
+//
+// C ABI: every function takes JSON strings and returns a malloc'd JSON
+// string {"ok": ..., "error": ...}; caller frees via kf_free.
+
+#include <cstring>
+#include <string>
+
+#include "json.hpp"
+
+using kjson::Array;
+using kjson::Object;
+using kjson::Value;
+
+namespace {
+
+char* dup_result(const Value& v) {
+  std::string s = v.dump();
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+char* ok_result(Value payload) {
+  Object o;
+  o["ok"] = std::move(payload);
+  return dup_result(Value(std::move(o)));
+}
+
+char* err_result(const std::string& message) {
+  Object o;
+  o["error"] = Value(message);
+  return dup_result(Value(std::move(o)));
+}
+
+// ---------------------------------------------------------------------------
+// label selector
+// ---------------------------------------------------------------------------
+
+bool contains(const Array& values, const std::string& v) {
+  for (const auto& x : values)
+    if (x.is_string() && x.as_string() == v) return true;
+  return false;
+}
+
+bool match_selector(const Value& selector, const Value& labels) {
+  if (selector.is_null() ||
+      (selector.is_object() && selector.obj().empty()))
+    return true;
+  const Value& ml = selector.at("matchLabels");
+  if (ml.is_object()) {
+    for (const auto& kv : ml.obj()) {
+      if (!labels.is_object() || labels.at(kv.first) != kv.second)
+        return false;
+    }
+  }
+  const Value& mes = selector.at("matchExpressions");
+  if (mes.is_array()) {
+    for (const auto& expr : mes.arr()) {
+      std::string key = expr.at("key").as_string();
+      std::string op = expr.at("operator").as_string();
+      bool has = labels.is_object() && labels.has(key);
+      std::string val = has ? labels.at(key).as_string() : "";
+      const Value& values = expr.at("values");
+      Array empty;
+      const Array& vals = values.is_array() ? values.arr() : empty;
+      if (op == "In") {
+        if (!has || !contains(vals, val)) return false;
+      } else if (op == "NotIn") {
+        if (has && contains(vals, val)) return false;
+      } else if (op == "Exists") {
+        if (!has) return false;
+      } else if (op == "DoesNotExist") {
+        if (has) return false;
+      } else {
+        throw std::runtime_error("unknown selector operator: " + op);
+      }
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// PodDefault merge (admission-webhook main.go semantics)
+// ---------------------------------------------------------------------------
+
+// merge list items keyed by key fields; equal duplicates pass, unequal
+// duplicates conflict.  Returns merged list or throws.
+Array merge_keyed(const Array& existing, const Array& added,
+                  const std::vector<std::string>& key_fields,
+                  const std::string& what) {
+  Array out = existing;
+  for (const auto& item : added) {
+    bool dup = false;
+    for (const auto& have : out) {
+      bool same_key = true;
+      for (const auto& kf : key_fields) {
+        if (have.at(kf) != item.at(kf)) {
+          same_key = false;
+          break;
+        }
+      }
+      if (same_key) {
+        if (have != item)
+          throw std::runtime_error(
+              "conflict on " + what + " " +
+              item.at(key_fields[0]).as_string());
+        dup = true;
+        break;
+      }
+    }
+    if (!dup) out.push_back(item);
+  }
+  return out;
+}
+
+Object merge_maps(const Value& existing, const Value& added,
+                  const std::string& what) {
+  Object out = existing.is_object() ? existing.obj() : Object{};
+  if (added.is_object()) {
+    for (const auto& kv : added.obj()) {
+      auto it = out.find(kv.first);
+      if (it != out.end() && it->second != kv.second)
+        throw std::runtime_error("conflict on " + what + " key " + kv.first);
+      out[kv.first] = kv.second;
+    }
+  }
+  return out;
+}
+
+Value get_path(const Value& v, std::initializer_list<const char*> path) {
+  const Value* cur = &v;
+  for (const char* p : path) {
+    if (!cur->is_object()) return Value();
+    cur = &cur->at(p);
+  }
+  return *cur;
+}
+
+// apply a list of PodDefaults to a pod; throws on conflict.
+Value apply_poddefaults(Value pod, const Array& poddefaults) {
+  Value& spec = pod["spec"];
+  Object& meta = pod["metadata"].obj();
+
+  // annotations/labels across all poddefaults and the pod
+  Value ann = meta.count("annotations") ? meta["annotations"] : Value(Object{});
+  Value lab = meta.count("labels") ? meta["labels"] : Value(Object{});
+  Value volumes = spec.has("volumes") ? spec.at("volumes") : Value(Array{});
+  Value tolerations =
+      spec.has("tolerations") ? spec.at("tolerations") : Value(Array{});
+
+  Array applied_names;
+  for (const auto& pd : poddefaults) {
+    const Value& pdspec = pd.at("spec");
+    ann = Value(merge_maps(ann, pdspec.at("annotations"), "annotation"));
+    lab = Value(merge_maps(lab, pdspec.at("labels"), "label"));
+    if (pdspec.at("volumes").is_array())
+      volumes = Value(merge_keyed(volumes.arr(), pdspec.at("volumes").arr(),
+                                  {"name"}, "volume"));
+    if (pdspec.at("tolerations").is_array())
+      tolerations =
+          Value(merge_keyed(tolerations.arr(), pdspec.at("tolerations").arr(),
+                            {"key"}, "toleration"));
+    applied_names.push_back(pd.at("metadata").at("name"));
+    // record application annotation: poddefault.admission.kubeflow.org/
+    // poddefault-<name> = resourceVersion (main.go:416-419)
+    std::string akey = "poddefault.admission.kubeflow-tpu.org/poddefault-" +
+                       pd.at("metadata").at("name").as_string();
+    Value rv = get_path(pd, {"metadata", "resourceVersion"});
+    Object annobj = ann.obj();
+    annobj[akey] = rv.is_null() ? Value("0") : rv;
+    ann = Value(std::move(annobj));
+  }
+
+  // containers: env (keyed by name, value-equality), envFrom (append),
+  // volumeMounts (keyed by name AND mountPath)
+  if (spec.has("containers") && spec.at("containers").is_array()) {
+    Array containers = spec.at("containers").arr();
+    for (auto& c : containers) {
+      Value env = c.has("env") ? c.at("env") : Value(Array{});
+      Value envFrom = c.has("envFrom") ? c.at("envFrom") : Value(Array{});
+      Value mounts =
+          c.has("volumeMounts") ? c.at("volumeMounts") : Value(Array{});
+      for (const auto& pd : poddefaults) {
+        const Value& pdspec = pd.at("spec");
+        if (pdspec.at("env").is_array())
+          env = Value(
+              merge_keyed(env.arr(), pdspec.at("env").arr(), {"name"}, "env"));
+        if (pdspec.at("envFrom").is_array())
+          for (const auto& ef : pdspec.at("envFrom").arr())
+            envFrom.arr().push_back(ef);
+        if (pdspec.at("volumeMounts").is_array())
+          mounts = Value(merge_keyed(mounts.arr(),
+                                     pdspec.at("volumeMounts").arr(),
+                                     {"name", "mountPath"}, "volumeMount"));
+      }
+      c["env"] = env;
+      c["envFrom"] = envFrom;
+      c["volumeMounts"] = mounts;
+    }
+    spec["containers"] = Value(std::move(containers));
+  }
+
+  spec["volumes"] = volumes;
+  spec["tolerations"] = tolerations;
+  pod["metadata"]["annotations"] = ann;
+  pod["metadata"]["labels"] = lab;
+
+  Object result;
+  result["pod"] = pod;
+  result["applied"] = Value(std::move(applied_names));
+  return Value(std::move(result));
+}
+
+// ---------------------------------------------------------------------------
+// reconcile field copy (common/reconcilehelper/util.go semantics)
+// ---------------------------------------------------------------------------
+
+Value reconcile_merge(Value live, const Value& desired) {
+  bool changed = false;
+  // metadata labels/annotations
+  Value live_meta = live.at("metadata");
+  const Value& want_meta = desired.at("metadata");
+  for (const char* key : {"labels", "annotations"}) {
+    if (!want_meta.at(key).is_null() &&
+        live_meta.at(key) != want_meta.at(key)) {
+      live["metadata"][key] = want_meta.at(key);
+      changed = true;
+    }
+  }
+  // spec: field-by-field copy (preserves fields the server set that the
+  // desired object omits — e.g. clusterIP on Services)
+  if (desired.at("spec").is_object()) {
+    for (const auto& kv : desired.at("spec").obj()) {
+      Value& live_spec = live["spec"];
+      if (live_spec.at(kv.first) != kv.second) {
+        live_spec[kv.first] = kv.second;
+        changed = true;
+      }
+    }
+  }
+  Object result;
+  result["object"] = live;
+  result["changed"] = Value(changed);
+  return Value(std::move(result));
+}
+
+}  // namespace
+
+extern "C" {
+
+void kf_free(char* p) { free(p); }
+
+const char* kf_version() { return "kfengine/0.1.0"; }
+
+// pod_json: Pod object; poddefaults_json: JSON array of PodDefault objects
+// (caller pre-filters by label selector or leaves that to us via
+// kf_filter_poddefaults).
+char* kf_apply_poddefaults(const char* pod_json,
+                           const char* poddefaults_json) {
+  try {
+    Value pod = kjson::parse(pod_json);
+    Value pds = kjson::parse(poddefaults_json);
+    if (!pds.is_array()) return err_result("poddefaults must be an array");
+    return ok_result(apply_poddefaults(std::move(pod), pds.arr()));
+  } catch (const std::exception& e) {
+    return err_result(e.what());
+  }
+}
+
+// returns the sub-array of poddefaults whose spec.selector matches the pod's
+// labels (admission-webhook main.go:69-94)
+char* kf_filter_poddefaults(const char* pod_json,
+                            const char* poddefaults_json) {
+  try {
+    Value pod = kjson::parse(pod_json);
+    Value pds = kjson::parse(poddefaults_json);
+    Value labels = get_path(pod, {"metadata", "labels"});
+    Array out;
+    for (const auto& pd : pds.arr()) {
+      if (match_selector(get_path(pd, {"spec", "selector"}), labels))
+        out.push_back(pd);
+    }
+    return ok_result(Value(std::move(out)));
+  } catch (const std::exception& e) {
+    return err_result(e.what());
+  }
+}
+
+char* kf_match_selector(const char* selector_json, const char* labels_json) {
+  try {
+    Value sel = kjson::parse(selector_json);
+    Value labels = kjson::parse(labels_json);
+    return ok_result(Value(match_selector(sel, labels)));
+  } catch (const std::exception& e) {
+    return err_result(e.what());
+  }
+}
+
+char* kf_reconcile_merge(const char* live_json, const char* desired_json) {
+  try {
+    Value live = kjson::parse(live_json);
+    Value desired = kjson::parse(desired_json);
+    return ok_result(reconcile_merge(std::move(live), desired));
+  } catch (const std::exception& e) {
+    return err_result(e.what());
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,347 @@
+// Minimal JSON value / parser / serializer for the native engine.
+// Self-contained (no external deps are available in this environment).
+// Supports the full JSON grammar; numbers are stored as double plus an
+// integer flag so round-trips of counts/ports stay integral.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace kjson {
+
+class Value;
+using Object = std::map<std::string, Value>;
+using Array = std::vector<Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+  Value() : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(int64_t i) : type_(Type::Int), int_(i) {}
+  Value(double d) : type_(Type::Double), double_(d) {}
+  Value(const std::string& s) : type_(Type::String), str_(s) {}
+  Value(const char* s) : type_(Type::String), str_(s) {}
+  Value(Array a) : type_(Type::Array), arr_(std::move(a)) {}
+  Value(Object o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+
+  bool as_bool() const { return bool_; }
+  int64_t as_int() const {
+    return type_ == Type::Int ? int_ : static_cast<int64_t>(double_);
+  }
+  double as_double() const {
+    return type_ == Type::Double ? double_ : static_cast<double>(int_);
+  }
+  const std::string& as_string() const { return str_; }
+
+  Array& arr() { return arr_; }
+  const Array& arr() const { return arr_; }
+  Object& obj() { return obj_; }
+  const Object& obj() const { return obj_; }
+
+  bool has(const std::string& k) const {
+    return type_ == Type::Object && obj_.count(k) > 0;
+  }
+  const Value& at(const std::string& k) const {
+    static Value null_value;
+    auto it = obj_.find(k);
+    return it == obj_.end() ? null_value : it->second;
+  }
+  Value& operator[](const std::string& k) {
+    if (type_ == Type::Null) type_ = Type::Object;
+    return obj_[k];
+  }
+
+  bool operator==(const Value& o) const {
+    if (type_ != o.type_) {
+      // ints and doubles compare numerically
+      if ((type_ == Type::Int && o.type_ == Type::Double) ||
+          (type_ == Type::Double && o.type_ == Type::Int))
+        return as_double() == o.as_double();
+      return false;
+    }
+    switch (type_) {
+      case Type::Null: return true;
+      case Type::Bool: return bool_ == o.bool_;
+      case Type::Int: return int_ == o.int_;
+      case Type::Double: return double_ == o.double_;
+      case Type::String: return str_ == o.str_;
+      case Type::Array: return arr_ == o.arr_;
+      case Type::Object: return obj_ == o.obj_;
+    }
+    return false;
+  }
+  bool operator!=(const Value& o) const { return !(*this == o); }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+ private:
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Int: os << int_; break;
+      case Type::Double: {
+        if (std::isfinite(double_)) {
+          std::ostringstream tmp;
+          tmp.precision(17);
+          tmp << double_;
+          os << tmp.str();
+        } else {
+          os << "null";
+        }
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) os << ',';
+          arr_[i].write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& kv : obj_) {
+          if (!first) os << ',';
+          first = false;
+          write_string(os, kv.first);
+          os << ':';
+          kv.second.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\b': os << "\\b"; break;
+        case '\f': os << "\\f"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0;
+  std::string str_;
+  Array arr_;
+  Object obj_;
+};
+
+Value number_from(const std::string& s, size_t& pos);
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  Value parse() {
+    Value v = value();
+    skip_ws();
+    if (pos_ != s_.size()) throw std::runtime_error("trailing JSON data");
+    return v;
+  }
+
+ private:
+  void skip_ws() {
+    while (pos_ < s_.size() &&
+           (s_[pos_] == ' ' || s_[pos_] == '\t' || s_[pos_] == '\n' ||
+            s_[pos_] == '\r'))
+      ++pos_;
+  }
+  char peek() {
+    skip_ws();
+    if (pos_ >= s_.size()) throw std::runtime_error("unexpected end of JSON");
+    return s_[pos_];
+  }
+  void expect(char c) {
+    if (peek() != c)
+      throw std::runtime_error(std::string("expected '") + c + "'");
+    ++pos_;
+  }
+
+  Value value() {
+    switch (peek()) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return Value(string());
+      case 't': literal("true"); return Value(true);
+      case 'f': literal("false"); return Value(false);
+      case 'n': literal("null"); return Value();
+      default: return number();
+    }
+  }
+
+  void literal(const char* lit) {
+    skip_ws();
+    size_t n = strlen(lit);
+    if (s_.compare(pos_, n, lit) != 0)
+      throw std::runtime_error("invalid JSON literal");
+    pos_ += n;
+  }
+
+  Value object() {
+    expect('{');
+    Object o;
+    if (peek() == '}') { ++pos_; return Value(std::move(o)); }
+    while (true) {
+      std::string key = string();
+      expect(':');
+      o[key] = value();
+      char c = peek();
+      ++pos_;
+      if (c == '}') break;
+      if (c != ',') throw std::runtime_error("expected ',' in object");
+    }
+    return Value(std::move(o));
+  }
+
+  Value number() {
+    skip_ws();
+    return number_from(s_, pos_);
+  }
+
+  Value array() {
+    expect('[');
+    Array a;
+    if (peek() == ']') { ++pos_; return Value(std::move(a)); }
+    while (true) {
+      a.push_back(value());
+      char c = peek();
+      ++pos_;
+      if (c == ']') break;
+      if (c != ',') throw std::runtime_error("expected ',' in array");
+    }
+    return Value(std::move(a));
+  }
+
+  std::string string() {
+    skip_ws();
+    if (s_[pos_] != '"') throw std::runtime_error("expected string");
+    ++pos_;
+    std::string out;
+    while (pos_ < s_.size()) {
+      char c = s_[pos_++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) break;
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos_ + 4 > s_.size())
+              throw std::runtime_error("bad \\u escape");
+            unsigned cp = std::stoul(s_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // surrogate pair
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos_ + 6 <= s_.size() &&
+                s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+              unsigned lo = std::stoul(s_.substr(pos_ + 2, 4), nullptr, 16);
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                pos_ += 6;
+              }
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: throw std::runtime_error("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    throw std::runtime_error("unterminated string");
+  }
+
+  static void append_utf8(std::string& out, unsigned cp) {
+    if (cp < 0x80) {
+      out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+inline Value parse(const std::string& text) { return Parser(text).parse(); }
+
+inline Value number_from(const std::string& s, size_t& pos) {
+  size_t start = pos;
+  if (pos < s.size() && (s[pos] == '-' || s[pos] == '+')) ++pos;
+  bool is_int = true;
+  while (pos < s.size() &&
+         (isdigit(static_cast<unsigned char>(s[pos])) || s[pos] == '.' ||
+          s[pos] == 'e' || s[pos] == 'E' || s[pos] == '-' || s[pos] == '+')) {
+    if (s[pos] == '.' || s[pos] == 'e' || s[pos] == 'E') is_int = false;
+    ++pos;
+  }
+  std::string tok = s.substr(start, pos - start);
+  if (is_int) {
+    try {
+      return Value(static_cast<int64_t>(std::stoll(tok)));
+    } catch (...) {
+    }
+  }
+  return Value(std::stod(tok));
+}
+
+}  // namespace kjson

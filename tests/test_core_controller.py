@@ -1,0 +1,160 @@
+"""Controller runtime: reconcile loops, child ownership, backoff, leases."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.core import (
+    APIServer,
+    Controller,
+    Manager,
+    Request,
+    Result,
+    api_object,
+)
+from kubeflow_tpu.core.controller import WorkQueue, acquire_lease
+from kubeflow_tpu.core.objects import set_owner
+from kubeflow_tpu.core.store import NotFound
+
+
+class WidgetController(Controller):
+    """Materializes a Gadget child per Widget and mirrors status."""
+
+    kind = "Widget"
+    owns = ("Gadget",)
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            widget = self.server.get("Widget", req.name, req.namespace)
+        except NotFound:
+            return None
+        try:
+            self.server.get("Gadget", req.name, req.namespace)
+        except NotFound:
+            child = set_owner(
+                api_object("Gadget", req.name, req.namespace,
+                           spec={"size": widget["spec"].get("size", 1)}),
+                widget)
+            self.server.create(child)
+        self.server.patch_status("Widget", req.name, req.namespace,
+                                 {"phase": "Ready"})
+        return None
+
+
+@pytest.fixture()
+def harness():
+    server = APIServer()
+    mgr = Manager(server)
+    mgr.add(WidgetController(server))
+    mgr.start()
+    yield server, mgr
+    mgr.stop()
+
+
+def test_reconcile_creates_child_and_status(harness):
+    server, mgr = harness
+    server.create(api_object("Widget", "w1", "ns", spec={"size": 3}))
+    assert mgr.wait_idle()
+    child = server.get("Gadget", "w1", "ns")
+    assert child["spec"]["size"] == 3
+    assert child["metadata"]["ownerReferences"][0]["kind"] == "Widget"
+    assert server.get("Widget", "w1", "ns")["status"]["phase"] == "Ready"
+
+
+def test_child_deletion_reconverges(harness):
+    server, mgr = harness
+    server.create(api_object("Widget", "w1", "ns"))
+    assert mgr.wait_idle()
+    server.delete("Gadget", "w1", "ns")
+    assert mgr.wait_idle()
+    # level-triggered: child recreated after drift
+    assert server.get("Gadget", "w1", "ns")
+
+
+def test_preexisting_objects_reconciled_on_start():
+    server = APIServer()
+    server.create(api_object("Widget", "w0", "ns"))
+    mgr = Manager(server)
+    mgr.add(WidgetController(server))
+    mgr.start()
+    try:
+        assert mgr.wait_idle()
+        assert server.get("Gadget", "w0", "ns")
+    finally:
+        mgr.stop()
+
+
+def test_workqueue_dedup_and_backoff():
+    q = WorkQueue()
+    r = Request("ns", "a")
+    q.add(r)
+    q.add(r)  # deduped while pending
+    assert q.get(timeout=0.1) == r
+    assert q.get(timeout=0.05) is None
+    q.add_rate_limited(r)
+    q.add_rate_limited(r)
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == r
+    # second failure: delay doubled (>= BASE_DELAY * 2 from the first add)
+    assert time.monotonic() - t0 >= q.BASE_DELAY
+    q.shutdown()
+
+
+def test_requeue_after():
+    server = APIServer()
+    counts = {}
+
+    class Periodic(Controller):
+        kind = "Widget"
+
+        def reconcile(self, req):
+            counts[req.name] = counts.get(req.name, 0) + 1
+            return Result(requeue_after=0.05)
+
+    mgr = Manager(server)
+    mgr.add(Periodic(server))
+    mgr.start()
+    try:
+        server.create(api_object("Widget", "tick", "ns"))
+        time.sleep(0.5)
+        assert counts.get("tick", 0) >= 3, counts
+    finally:
+        mgr.stop()
+
+
+def test_leader_election_single_holder():
+    server = APIServer()
+    assert acquire_lease(server, "mgr", "node-a")
+    assert not acquire_lease(server, "mgr", "node-b")
+    assert acquire_lease(server, "mgr", "node-a")  # renew
+    # expire the lease -> node-b can take it
+    lease = server.get("Lease", "mgr", "kube-system")
+    lease["spec"]["renewTime"] = 0
+    server.update(lease)
+    assert acquire_lease(server, "mgr", "node-b")
+
+
+def test_error_backoff_retries():
+    server = APIServer()
+    attempts = []
+
+    class Flaky(Controller):
+        kind = "Widget"
+
+        def reconcile(self, req):
+            attempts.append(time.monotonic())
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+            return None
+
+    mgr = Manager(server)
+    mgr.add(Flaky(server))
+    mgr.start()
+    try:
+        server.create(api_object("Widget", "w", "ns"))
+        deadline = time.monotonic() + 5
+        while len(attempts) < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(attempts) >= 3
+    finally:
+        mgr.stop()

@@ -1,0 +1,149 @@
+"""API server semantics: CRUD, conflict, watch, finalizers, owner GC."""
+
+import threading
+
+import pytest
+
+from kubeflow_tpu.core import APIServer, Conflict, NotFound, api_object
+from kubeflow_tpu.core.objects import set_owner
+from kubeflow_tpu.core.store import Invalid
+
+
+@pytest.fixture()
+def server():
+    return APIServer()
+
+
+def test_crud_roundtrip(server):
+    nb = api_object("Notebook", "nb1", "user-ns", spec={"image": "jax:latest"})
+    created = server.create(nb)
+    assert created["metadata"]["uid"]
+    got = server.get("Notebook", "nb1", "user-ns")
+    assert got["spec"]["image"] == "jax:latest"
+    got["spec"]["image"] = "jax:v2"
+    server.update(got)
+    assert server.get("Notebook", "nb1", "user-ns")["spec"]["image"] == "jax:v2"
+    server.delete("Notebook", "nb1", "user-ns")
+    with pytest.raises(NotFound):
+        server.get("Notebook", "nb1", "user-ns")
+
+
+def test_optimistic_concurrency(server):
+    server.create(api_object("Notebook", "nb", "ns"))
+    a = server.get("Notebook", "nb", "ns")
+    b = server.get("Notebook", "nb", "ns")
+    a["spec"]["x"] = 1
+    server.update(a)
+    b["spec"]["x"] = 2
+    with pytest.raises(Conflict):
+        server.update(b)
+
+
+def test_create_duplicate_conflicts(server):
+    server.create(api_object("Notebook", "nb", "ns"))
+    with pytest.raises(Conflict):
+        server.create(api_object("Notebook", "nb", "ns"))
+
+
+def test_list_label_selector_and_namespaces(server):
+    server.create(api_object("Notebook", "a", "ns1", labels={"team": "x"}))
+    server.create(api_object("Notebook", "b", "ns1", labels={"team": "y"}))
+    server.create(api_object("Notebook", "c", "ns2", labels={"team": "x"}))
+    assert len(server.list("Notebook")) == 3
+    assert len(server.list("Notebook", namespace="ns1")) == 2
+    sel = {"matchLabels": {"team": "x"}}
+    assert [o["metadata"]["name"]
+            for o in server.list("Notebook", label_selector=sel)] == ["a", "c"]
+
+
+def test_watch_stream(server):
+    w = server.watch(["Notebook"])
+    server.create(api_object("Notebook", "nb", "ns"))
+    ev = w.next(timeout=1)
+    assert ev.type == "ADDED" and ev.object["metadata"]["name"] == "nb"
+    obj = server.get("Notebook", "nb", "ns")
+    server.update(obj)  # no-op write: must NOT emit an event
+    obj["spec"]["image"] = "jax:v2"
+    server.update(obj)
+    ev = w.next(timeout=1)
+    assert ev.type == "MODIFIED"
+    assert ev.object["spec"]["image"] == "jax:v2"
+    server.delete("Notebook", "nb", "ns")
+    assert w.next(timeout=1).type == "DELETED"
+    w.stop()
+
+
+def test_finalizer_blocks_deletion(server):
+    obj = api_object("Profile", "team-a")
+    obj["metadata"]["finalizers"] = ["profile-cleanup"]
+    server.create(obj)
+    server.delete("Profile", "team-a")
+    # still present, marked for deletion
+    got = server.get("Profile", "team-a")
+    assert got["metadata"]["deletionTimestamp"]
+    # controller drains the finalizer -> object goes away
+    got["metadata"]["finalizers"] = []
+    server.update(got)
+    with pytest.raises(NotFound):
+        server.get("Profile", "team-a")
+
+
+def test_owner_gc_cascades(server):
+    nb = server.create(api_object("Notebook", "nb", "ns"))
+    sts = set_owner(api_object("StatefulSet", "nb", "ns"), nb)
+    svc = set_owner(api_object("Service", "nb", "ns"), nb)
+    server.create(sts)
+    server.create(svc)
+    grandchild = set_owner(api_object("Pod", "nb-0", "ns"),
+                           server.get("StatefulSet", "nb", "ns"))
+    server.create(grandchild)
+    server.delete("Notebook", "nb", "ns")
+    for kind, name in [("StatefulSet", "nb"), ("Service", "nb"),
+                       ("Pod", "nb-0")]:
+        with pytest.raises(NotFound):
+            server.get(kind, name, "ns")
+
+
+def test_mutating_and_validating_hooks(server):
+    def mutate(obj):
+        if obj["kind"] == "Pod":
+            obj["metadata"].setdefault("labels", {})["mutated"] = "yes"
+            return obj
+        return None
+
+    def validate(obj):
+        if obj["kind"] == "Pod" and not obj["spec"].get("containers"):
+            raise Invalid("pod needs containers")
+
+    server.register_mutating_hook(mutate)
+    server.register_validating_hook(validate)
+    with pytest.raises(Invalid):
+        server.create(api_object("Pod", "bad", "ns"))
+    good = api_object("Pod", "good", "ns",
+                      spec={"containers": [{"name": "c"}]})
+    created = server.create(good)
+    assert created["metadata"]["labels"]["mutated"] == "yes"
+
+
+def test_watch_concurrent_writers(server):
+    w = server.watch(["Notebook"])
+    n_threads, per_thread = 4, 25
+
+    def writer(t):
+        for i in range(per_thread):
+            server.create(api_object("Notebook", f"nb-{t}-{i}", "ns"))
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    seen = 0
+    while True:
+        ev = w.next(timeout=0.5)
+        if ev is None:
+            break
+        seen += 1
+    assert seen == n_threads * per_thread
+    assert len(server.list("Notebook", namespace="ns")) == seen

@@ -1,0 +1,138 @@
+"""Native (C++) engine: selector matching, PodDefault merges, reconcile diff.
+
+Merge/conflict matrix mirrors the reference's admission-webhook main_test.go
+table tests (SURVEY.md §4).
+"""
+
+import pytest
+
+from kubeflow_tpu.core.native import ENGINE, MergeConflict
+
+
+def pd(name, **spec):
+    spec.setdefault("selector", {})
+    return {"kind": "PodDefault",
+            "metadata": {"name": name, "resourceVersion": "1"},
+            "spec": spec}
+
+
+def pod(**kw):
+    base = {"kind": "Pod", "metadata": {"name": "p", "labels": {}},
+            "spec": {"containers": [{"name": "main"}]}}
+    base["metadata"]["labels"].update(kw.pop("labels", {}))
+    base["spec"].update(kw)
+    return base
+
+
+def test_version():
+    assert ENGINE.version().startswith("kfengine/")
+
+
+@pytest.mark.parametrize("selector,labels,want", [
+    ({}, {"a": "1"}, True),
+    ({"matchLabels": {"a": "1"}}, {"a": "1"}, True),
+    ({"matchLabels": {"a": "1"}}, {"a": "2"}, False),
+    ({"matchLabels": {"a": "1"}}, {}, False),
+    ({"matchExpressions": [{"key": "a", "operator": "Exists"}]},
+     {"a": "x"}, True),
+    ({"matchExpressions": [{"key": "a", "operator": "DoesNotExist"}]},
+     {"a": "x"}, False),
+    ({"matchExpressions": [{"key": "a", "operator": "In",
+                            "values": ["1", "2"]}]}, {"a": "2"}, True),
+    ({"matchExpressions": [{"key": "a", "operator": "NotIn",
+                            "values": ["1"]}]}, {"a": "1"}, False),
+])
+def test_selector_matrix(selector, labels, want):
+    assert ENGINE.match_selector(selector, labels) is want
+
+
+def test_env_merge_and_equal_duplicate():
+    p = pod()
+    p["spec"]["containers"][0]["env"] = [{"name": "A", "value": "1"}]
+    out = ENGINE.apply_poddefaults(
+        p, [pd("one", env=[{"name": "A", "value": "1"},
+                           {"name": "B", "value": "2"}])])
+    env = out["pod"]["spec"]["containers"][0]["env"]
+    assert env == [{"name": "A", "value": "1"}, {"name": "B", "value": "2"}]
+
+
+def test_env_conflict_rejects():
+    p = pod()
+    p["spec"]["containers"][0]["env"] = [{"name": "A", "value": "1"}]
+    with pytest.raises(MergeConflict):
+        ENGINE.apply_poddefaults(p, [pd("x", env=[{"name": "A",
+                                                   "value": "other"}])])
+
+
+def test_volume_mounts_keyed_by_name_and_path():
+    # same name, same path, identical -> ok (dedup)
+    p = pod()
+    p["spec"]["containers"][0]["volumeMounts"] = [
+        {"name": "v", "mountPath": "/data"}]
+    out = ENGINE.apply_poddefaults(
+        p, [pd("a", volumeMounts=[{"name": "v", "mountPath": "/data"}])])
+    assert len(out["pod"]["spec"]["containers"][0]["volumeMounts"]) == 1
+    # same name+path but different options -> conflict
+    with pytest.raises(MergeConflict):
+        ENGINE.apply_poddefaults(
+            p, [pd("b", volumeMounts=[{"name": "v", "mountPath": "/data",
+                                       "readOnly": True}])])
+    # same name different path -> both kept (reference keys by name AND path)
+    out = ENGINE.apply_poddefaults(
+        p, [pd("c", volumeMounts=[{"name": "v", "mountPath": "/other"}])])
+    assert len(out["pod"]["spec"]["containers"][0]["volumeMounts"]) == 2
+
+
+def test_tolerations_keyed_by_key():
+    p = pod(tolerations=[{"key": "tpu", "operator": "Exists"}])
+    with pytest.raises(MergeConflict):
+        ENGINE.apply_poddefaults(
+            p, [pd("t", tolerations=[{"key": "tpu", "operator": "Equal",
+                                      "value": "v5e"}])])
+
+
+def test_envfrom_appends():
+    p = pod()
+    p["spec"]["containers"][0]["envFrom"] = [{"configMapRef": {"name": "a"}}]
+    out = ENGINE.apply_poddefaults(
+        p, [pd("e", envFrom=[{"configMapRef": {"name": "a"}}])])
+    # append-only, duplicates allowed (reference main.go:189-198)
+    assert len(out["pod"]["spec"]["containers"][0]["envFrom"]) == 2
+
+
+def test_application_annotation_recorded():
+    out = ENGINE.apply_poddefaults(pod(), [pd("gcp-sa")])
+    ann = out["pod"]["metadata"]["annotations"]
+    assert ann[
+        "poddefault.admission.kubeflow-tpu.org/poddefault-gcp-sa"] == "1"
+    assert out["applied"] == ["gcp-sa"]
+
+
+def test_filter_by_selector():
+    p = pod(labels={"team": "ml"})
+    pds = [pd("match", selector={"matchLabels": {"team": "ml"}}),
+           pd("nomatch", selector={"matchLabels": {"team": "web"}})]
+    got = ENGINE.filter_poddefaults(p, pds)
+    assert [x["metadata"]["name"] for x in got] == ["match"]
+
+
+def test_reconcile_merge_preserves_server_fields():
+    live = {"kind": "Service", "metadata": {"name": "s"},
+            "spec": {"clusterIP": "10.1.2.3", "ports": [{"port": 80}]}}
+    desired = {"kind": "Service", "metadata": {"name": "s"},
+               "spec": {"ports": [{"port": 80, "targetPort": 8888}],
+                        "selector": {"app": "nb"}}}
+    merged, changed = ENGINE.reconcile_merge(live, desired)
+    assert changed
+    assert merged["spec"]["clusterIP"] == "10.1.2.3"
+    assert merged["spec"]["selector"] == {"app": "nb"}
+    merged2, changed2 = ENGINE.reconcile_merge(merged, desired)
+    assert not changed2
+
+
+def test_unicode_roundtrip():
+    p = pod()
+    p["metadata"]["labels"]["note"] = "tpü-nativé ✓"
+    out = ENGINE.apply_poddefaults(p, [pd("u", labels={"emoji": "🚀"})])
+    assert out["pod"]["metadata"]["labels"]["note"] == "tpü-nativé ✓"
+    assert out["pod"]["metadata"]["labels"]["emoji"] == "🚀"
