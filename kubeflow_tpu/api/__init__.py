@@ -1,0 +1,11 @@
+"""Resource schemas (the platform's CRD layer).
+
+Follows the reference's pattern of wrapping raw pod payloads in thin typed
+specs (NotebookSpec embeds a full PodSpec, notebook_types.go:27-35): each
+schema module provides ``new_*`` constructors, validation, and status helpers
+over plain dict resources served by core.APIServer.
+"""
+
+from kubeflow_tpu.api import jaxjob
+
+__all__ = ["jaxjob"]

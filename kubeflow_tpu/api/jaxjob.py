@@ -1,0 +1,135 @@
+"""JAXJob: the gang-scheduled TPU training job resource.
+
+The TFJob/PyTorchJob equivalent (SURVEY.md §2.12) redesigned TPU-first: the
+unit of scheduling is a whole TPU slice (one pod per host, placed atomically),
+worker wiring is the jax.distributed rendezvous env (parallel.distributed)
+instead of TF_CONFIG/NCCL, and parallelism (dp/fsdp/tp/sp axis sizes) is part
+of the spec the way the reference exposes PodSpec in NotebookSpec.
+
+spec:
+  topology: slice name from parallel.mesh.TOPOLOGIES (e.g. "v5e-32")
+  parallelism: {dp, fsdp, tp, sp}          # mesh axes over the slice
+  trainer: TrainerConfig dict               # the payload
+  podTemplate: extra PodSpec fields merged into worker pods
+  maxRestarts: gang restarts before Failed (default 3)
+status:
+  phase: Pending | Running | Succeeded | Failed | Restarting
+  conditions, restarts, workers: {ready, total}, result (trainer summary)
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.parallel.mesh import TOPOLOGIES
+
+KIND = "JAXJob"
+COORDINATOR_PORT = 8476
+
+
+def new(name: str, namespace: str, *, topology: str = "v5e-4",
+        trainer: dict | None = None, parallelism: dict | None = None,
+        pod_template: dict | None = None, max_restarts: int = 3,
+        image: str = "kubeflow-tpu/worker:latest") -> dict:
+    if topology not in TOPOLOGIES:
+        raise ValueError(
+            f"unknown topology {topology!r}; known: {sorted(TOPOLOGIES)}")
+    return api_object(KIND, name, namespace, spec={
+        "topology": topology,
+        "parallelism": parallelism or {},
+        "trainer": trainer or {},
+        "podTemplate": pod_template or {},
+        "maxRestarts": max_restarts,
+        "image": image,
+    })
+
+
+def validate(job: dict) -> None:
+    spec = job.get("spec", {})
+    topo = spec.get("topology")
+    if topo not in TOPOLOGIES:
+        raise ValueError(f"JAXJob {job['metadata'].get('name')}: unknown "
+                         f"topology {topo!r}")
+    par = spec.get("parallelism") or {}
+    sizes = [par.get(a, 1) for a in ("dp", "fsdp", "tp", "sp")]
+    if any(not isinstance(s, int) or s < 1 for s in sizes):
+        raise ValueError("parallelism axes must be positive integers")
+    chips = TOPOLOGIES[topo].chips
+    prod = 1
+    for s in sizes:
+        prod *= s
+    if par and prod != chips:
+        raise ValueError(
+            f"parallelism {par} multiplies to {prod}, topology {topo} has "
+            f"{chips} chips")
+
+
+def worker_pod_name(job_name: str, index: int) -> str:
+    return f"{job_name}-worker-{index}"
+
+
+def coordinator_address(job: dict) -> str:
+    """process-0 rendezvous endpoint (stable headless-service DNS name)."""
+    name = job["metadata"]["name"]
+    ns = job["metadata"]["namespace"]
+    return (f"{worker_pod_name(name, 0)}.{name}.{ns}.svc:"
+            f"{COORDINATOR_PORT}")
+
+
+def build_worker_pod(job: dict, index: int) -> dict:
+    """Worker pod for host ``index`` of the slice gang, with TPU resources
+    and rendezvous env injected (the §5.8 contract)."""
+    from kubeflow_tpu.parallel.distributed import rendezvous_env
+
+    spec = job["spec"]
+    topo = TOPOLOGIES[spec["topology"]]
+    name = job["metadata"]["name"]
+    ns = job["metadata"]["namespace"]
+
+    env = [{"name": k, "value": v} for k, v in rendezvous_env(
+        coordinator_address(job), topo.hosts, index).items()]
+    env.append({"name": "JAXJOB_NAME", "value": name})
+    env.append({"name": "JAXJOB_TRAINER_CONFIG", "value": _json(spec)})
+
+    container = {
+        "name": "worker",
+        "image": spec.get("image", "kubeflow-tpu/worker:latest"),
+        "command": ["python", "-m", "kubeflow_tpu.training"],
+        "env": env,
+        "resources": {"limits": {topo.resource_name: topo.chips_per_host}},
+        "ports": [{"containerPort": COORDINATOR_PORT}] if index == 0 else [],
+    }
+    pod = api_object("Pod", worker_pod_name(name, index), ns, labels={
+        "jaxjob": name,
+        "jaxjob-worker-index": str(index),
+        "gang": name,  # atomic placement unit for the scheduler
+    }, spec={
+        "containers": [container],
+        "restartPolicy": "Never",
+        # per-pod DNS under the headless service requires hostname+subdomain
+        # (the coordinator_address name resolves only with these set)
+        "hostname": worker_pod_name(name, index),
+        "subdomain": name,
+        # all hosts of one slice: the scheduler must place all or none
+        "schedulingGates": [{"name": "gang-scheduling"}],
+        "nodeSelector": {"cloud-tpu.google.com/slice": spec["topology"]},
+    })
+    template = spec.get("podTemplate") or {}
+    for key, val in template.items():
+        if key == "containers":
+            continue  # the worker container is controller-owned
+        pod["spec"][key] = copy.deepcopy(val)
+    return pod
+
+
+def _json(spec: dict) -> str:
+    import json
+
+    trainer = dict(spec.get("trainer") or {})
+    par = spec.get("parallelism") or {}
+    for axis in ("dp", "fsdp", "tp", "sp"):
+        if axis in par:
+            trainer[axis] = par[axis]
+    return json.dumps(trainer)

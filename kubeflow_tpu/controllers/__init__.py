@@ -1,0 +1,1 @@
+"""Reconcilers (the reference's components/*-controller layer, TPU-first)."""

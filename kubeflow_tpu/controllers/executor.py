@@ -1,0 +1,157 @@
+"""Pod executors: the node layer under the controllers.
+
+The reference leaves pod execution to kubelet and validates controller
+behavior only against envtest (no pods ever run, SURVEY.md §4 "multi-node
+without real cluster: they don't").  This platform improves on that with two
+in-tree executors:
+
+- ``FakeExecutor``: deterministic lifecycle driver (Pending -> Running ->
+  Succeeded, scriptable failures) for integration tests of gang semantics;
+- ``LocalExecutor``: actually runs a pod's container command as a local
+  subprocess with the pod's env injected — the single-host e2e path where a
+  JAXJob really trains (MNIST on one host, BASELINE.json configs[0]).
+
+Both honor schedulingGates (a gated pod does not start) so the JAXJob
+controller's atomic gang release is observable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.store import Conflict, NotFound
+
+
+class FakeExecutor(Controller):
+    """Drives pod phases without running anything.
+
+    fail_once: pod names that fail on their first Running->terminal
+    transition (subsequent incarnations succeed) — exercises gang restart.
+    always_fail: pod names that always fail.
+    """
+
+    kind = "Pod"
+
+    def __init__(self, server, *, fail_once: set[str] | None = None,
+                 always_fail: set[str] | None = None):
+        super().__init__(server)
+        self.fail_once = set(fail_once or ())
+        self.always_fail = set(always_fail or ())
+        self._failed_already: set[str] = set()
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            pod = self.server.get("Pod", req.name, req.namespace)
+        except NotFound:
+            return None
+        if pod["spec"].get("schedulingGates"):
+            return None  # not released yet
+        phase = pod.get("status", {}).get("phase", "Pending")
+        if phase == "Pending":
+            self.server.patch_status("Pod", req.name, req.namespace,
+                                     {**pod.get("status", {}),
+                                      "phase": "Running"})
+            return Result(requeue_after=0.01)
+        if phase == "Running":
+            name = req.name
+            if name in self.always_fail or (
+                    name in self.fail_once
+                    and name not in self._failed_already):
+                self._failed_already.add(name)  # by name: next gang
+                # incarnation of this worker succeeds
+                new_phase = "Failed"
+            else:
+                new_phase = "Succeeded"
+            self.server.patch_status(
+                "Pod", req.name, req.namespace,
+                {**pod.get("status", {}), "phase": new_phase,
+                 "result": {"final_loss": 0.1, "samples_per_sec": 100.0}
+                 if new_phase == "Succeeded" else None})
+        return None
+
+
+class LocalExecutor(Controller):
+    """Runs released pods as local subprocesses (the one-host kubelet).
+
+    The container's command runs with the pod's env merged over the parent
+    env (plus ``extra_env`` overrides); the last stdout line parseable as
+    JSON becomes status.result.  Exit 0 -> Succeeded, else Failed.
+    """
+
+    kind = "Pod"
+
+    def __init__(self, server, *, extra_env: dict[str, str] | None = None,
+                 timeout: float = 600.0):
+        super().__init__(server)
+        self.extra_env = extra_env or {}
+        self.timeout = timeout
+        self._running: set[str] = set()
+        self._lock = threading.Lock()
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            pod = self.server.get("Pod", req.name, req.namespace)
+        except NotFound:
+            return None
+        if pod["spec"].get("schedulingGates"):
+            return None
+        phase = pod.get("status", {}).get("phase", "Pending")
+        if phase != "Pending":
+            return None
+        uid = pod["metadata"]["uid"]
+        with self._lock:
+            if uid in self._running:
+                return None
+            self._running.add(uid)
+        self.server.patch_status("Pod", req.name, req.namespace,
+                                 {"phase": "Running"})
+        t = threading.Thread(target=self._run, args=(pod,), daemon=True)
+        t.start()
+        return None
+
+    def _run(self, pod: dict) -> None:
+        try:
+            self._run_inner(pod)
+        finally:
+            with self._lock:
+                self._running.discard(pod["metadata"]["uid"])
+
+    def _run_inner(self, pod: dict) -> None:
+        md = pod["metadata"]
+        container = pod["spec"]["containers"][0]
+        env = dict(os.environ)
+        for item in container.get("env", []):
+            env[item["name"]] = str(item.get("value", ""))
+        env.update(self.extra_env)
+        result = None
+        try:
+            proc = subprocess.run(
+                container["command"] + container.get("args", []),
+                env=env, capture_output=True, text=True,
+                timeout=self.timeout)
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    result = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            phase = "Succeeded" if proc.returncode == 0 else "Failed"
+            message = "" if proc.returncode == 0 else proc.stderr[-2000:]
+        except subprocess.TimeoutExpired:
+            phase, message = "Failed", "timeout"
+        except Exception as e:  # command not found etc.
+            phase, message = "Failed", str(e)
+        status = {"phase": phase, "result": result}
+        if message:
+            status["message"] = message
+        try:
+            current = self.server.get("Pod", md["name"], md.get("namespace"))
+            if current["metadata"]["uid"] == md["uid"]:
+                self.server.patch_status("Pod", md["name"],
+                                         md.get("namespace"), status)
+        except (NotFound, Conflict):
+            pass  # pod replaced/deleted while we ran

@@ -1,0 +1,148 @@
+"""JAXJob controller: atomic slice gangs with all-or-nothing restart.
+
+The hard part the reference never faced (SURVEY.md §7 "hard parts" #1): its
+controllers place single pods; a TPU slice is useless partially placed.  The
+gang protocol here:
+
+1. reconcile creates ALL worker pods (one per slice host) plus a headless
+   Service for stable rendezvous DNS, every pod gated by a
+   ``gang-scheduling`` schedulingGate;
+2. once every pod of the gang is scheduled-pending, the controller lifts all
+   gates in one pass (atomic release — the in-tree stand-in for a
+   coscheduling plugin);
+3. any worker failing fails the gang: all pods are deleted and recreated
+   (jax.distributed cannot survive member loss), counted against
+   spec.maxRestarts;
+4. Succeeded requires every worker Succeeded; worker-0's recorded result is
+   mirrored into status.result (samples/sec, final loss).
+
+Status mirroring follows notebook_controller.go:200-250's pattern.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import jaxjob as api
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.objects import api_object, set_condition, set_owner
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.parallel.mesh import TOPOLOGIES
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+JOBS_CREATED = REGISTRY.counter("jaxjob_gangs_created_total",
+                                "worker gangs created")
+JOB_RESTARTS = REGISTRY.counter("jaxjob_gang_restarts_total",
+                                "gang restarts after worker failure")
+
+
+class JAXJobController(Controller):
+    kind = api.KIND
+    owns = ("Pod", "Service")
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            job = self.server.get(api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if job["metadata"].get("deletionTimestamp"):
+            return None  # children GC'd via ownerReferences
+
+        api.validate(job)
+        spec = job["spec"]
+        topo = TOPOLOGIES[spec["topology"]]
+        status = dict(job.get("status") or {})
+        phase = status.get("phase", "Pending")
+        if phase in ("Succeeded", "Failed"):
+            return None
+
+        self._ensure_service(job)
+        pods = self._ensure_gang(job, topo.hosts)
+
+        phases = [p.get("status", {}).get("phase", "Pending") for p in pods]
+        ready = sum(1 for ph in phases if ph in ("Running", "Succeeded"))
+        status["workers"] = {"ready": ready, "total": topo.hosts}
+
+        if any(ph == "Failed" for ph in phases):
+            restarts = int(status.get("restarts", 0))
+            terminal = restarts >= int(spec.get("maxRestarts", 3))
+            # tear down every worker either way: surviving workers of a
+            # failed gang only hold the slice hostage (rendezvous is dead)
+            for p in pods:
+                try:
+                    self.server.delete("Pod", p["metadata"]["name"],
+                                       req.namespace)
+                except NotFound:
+                    pass
+            if terminal:
+                status["phase"] = "Failed"
+                set_condition(job, "Complete", "False", reason="MaxRestarts",
+                              message=f"gang failed {restarts + 1} times")
+                status["conditions"] = job["status"]["conditions"]
+                self.server.patch_status(api.KIND, req.name, req.namespace,
+                                         status)
+                return None
+            JOB_RESTARTS.inc()
+            status["phase"] = "Restarting"
+            status["restarts"] = restarts + 1
+            self.server.patch_status(api.KIND, req.name, req.namespace,
+                                     status)
+            return Result(requeue_after=0.05)
+
+        # atomic gate release once the whole gang is admitted
+        gated = [p for p in pods if p["spec"].get("schedulingGates")]
+        if gated and len(pods) == topo.hosts:
+            for p in gated:
+                p["spec"]["schedulingGates"] = []
+                self.server.update(p)
+
+        if all(ph == "Succeeded" for ph in phases) and pods:
+            status["phase"] = "Succeeded"
+            result = pods[0].get("status", {}).get("result")
+            if result is not None:
+                status["result"] = result
+            set_condition(job, "Complete", "True", reason="AllWorkersDone")
+            status["conditions"] = job["status"]["conditions"]
+        elif all(ph == "Running" for ph in phases) and pods:
+            status["phase"] = "Running"
+        else:
+            status["phase"] = ("Restarting"
+                               if status.get("phase") == "Restarting"
+                               else "Pending")
+        self.server.patch_status(api.KIND, req.name, req.namespace, status)
+        return None
+
+    # -- children ------------------------------------------------------------
+    def _ensure_service(self, job: dict) -> None:
+        name = job["metadata"]["name"]
+        ns = job["metadata"]["namespace"]
+        try:
+            self.server.get("Service", name, ns)
+        except NotFound:
+            svc = set_owner(api_object("Service", name, ns, spec={
+                "clusterIP": "None",  # headless: per-pod DNS for rendezvous
+                # workers must resolve each other before readiness (the
+                # rendezvous happens during startup)
+                "publishNotReadyAddresses": True,
+                "selector": {"jaxjob": name},
+                "ports": [{"port": api.COORDINATOR_PORT}],
+            }), job)
+            self.server.create(svc)
+
+    def _ensure_gang(self, job: dict, hosts: int) -> list[dict]:
+        ns = job["metadata"]["namespace"]
+        name = job["metadata"]["name"]
+        pods = []
+        missing = []
+        for i in range(hosts):
+            try:
+                pods.append(self.server.get(
+                    "Pod", api.worker_pod_name(name, i), ns))
+            except NotFound:
+                missing.append(i)
+        if missing and len(missing) == hosts:
+            JOBS_CREATED.inc()  # fresh gang (vs. mid-restart backfill)
+        for i in missing:
+            pod = set_owner(api.build_worker_pod(job, i), job)
+            pods.append(self.server.create(pod))
+        pods.sort(key=lambda p: int(
+            p["metadata"]["labels"]["jaxjob-worker-index"]))
+        return pods

@@ -190,13 +190,14 @@ class Manager:
                                  name="lease-renew")
             t.start()
             self._threads.append(t)
-        # seed queues with existing objects (level triggering on startup)
+        # register the watch BEFORE the seed list so objects created in
+        # between are not lost (the queue dedups the overlap)
+        watch = self.server.watch(self._watched_kinds())
         for c in self.controllers:
             for obj in self.server.list(c.kind):
                 md = obj["metadata"]
                 self._queues[c.name].add(Request(md.get("namespace"),
                                                  md["name"]))
-        watch = self.server.watch(self._watched_kinds())
 
         def dispatch() -> None:
             for ev in watch:

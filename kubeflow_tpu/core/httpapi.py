@@ -1,0 +1,186 @@
+"""REST facade over the APIServer (WSGI, stdlib only).
+
+Routes (k8s-flavored, kind-addressed):
+    GET    /apis/{kind}?namespace=&labelSelector=k%3Dv    list
+    POST   /apis/{kind}                                   create (body=object)
+    GET    /apis/{kind}/{namespace}/{name}                get
+    PUT    /apis/{kind}/{namespace}/{name}                update
+    DELETE /apis/{kind}/{namespace}/{name}                delete
+    PUT    /apis/{kind}/{namespace}/{name}/status         status subresource
+    GET    /healthz | /readyz                             probes
+    GET    /metrics                                       Prometheus text
+
+Cluster-scoped kinds use namespace ``_``.  The authenticated user arrives as
+a trusted header (default ``x-goog-authenticated-user-email``) exactly like
+the reference's Istio/IAP contract (SURVEY.md §1 traffic path); it is exposed
+to authorization hooks via ``environ['kubeflow.user']``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable
+from urllib.parse import parse_qs
+
+from kubeflow_tpu.core.store import APIServer, Conflict, Invalid, NotFound
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+USERID_HEADER = "HTTP_X_GOOG_AUTHENTICATED_USER_EMAIL"
+USERID_PREFIX = "accounts.google.com:"
+
+HTTP_REQS = REGISTRY.counter("apiserver_http_requests_total",
+                             "REST requests", labels=("method", "code"))
+
+
+def _selector_from_query(qs: dict) -> dict | None:
+    raw = qs.get("labelSelector", [None])[0]
+    if not raw:
+        return None
+    match = {}
+    for part in raw.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            match[k.strip()] = v.strip()
+    return {"matchLabels": match}
+
+
+class RestAPI:
+    """WSGI application; optionally guarded by an authorize callback
+    (user, verb, kind, namespace) -> None | raises PermissionError."""
+
+    def __init__(self, server: APIServer,
+                 authorize: Callable[[str | None, str, str, str | None],
+                                     None] | None = None):
+        self.server = server
+        self.authorize = authorize
+
+    # -- WSGI ------------------------------------------------------------------
+    def __call__(self, environ, start_response):
+        try:
+            status, body = self._route(environ)
+        except NotFound as e:
+            status, body = "404 Not Found", {"error": str(e)}
+        except Conflict as e:
+            status, body = "409 Conflict", {"error": str(e)}
+        except (Invalid, ValueError) as e:
+            status, body = "422 Unprocessable Entity", {"error": str(e)}
+        except PermissionError as e:
+            status, body = "403 Forbidden", {"error": str(e)}
+        except Exception as e:  # pragma: no cover
+            status, body = "500 Internal Server Error", {"error": str(e)}
+        HTTP_REQS.labels(environ.get("REQUEST_METHOD", "?"),
+                         status.split()[0]).inc()
+        if isinstance(body, str):
+            payload = body.encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            payload = json.dumps(body).encode()
+            ctype = "application/json"
+        start_response(status, [("Content-Type", ctype),
+                                ("Content-Length", str(len(payload)))])
+        return [payload]
+
+    # -- routing ---------------------------------------------------------------
+    def _route(self, environ) -> tuple[str, Any]:
+        method = environ["REQUEST_METHOD"]
+        path = environ.get("PATH_INFO", "/").rstrip("/")
+        qs = parse_qs(environ.get("QUERY_STRING", ""))
+        user = self._user(environ)
+        environ["kubeflow.user"] = user
+
+        if path in ("/healthz", "/readyz"):
+            return "200 OK", {"status": "ok"}
+        if path == "/metrics":
+            return "200 OK", REGISTRY.expose()
+
+        parts = [p for p in path.split("/") if p]
+        if not parts or parts[0] != "apis":
+            raise NotFound(f"no route {path}")
+        parts = parts[1:]
+
+        if len(parts) == 1:
+            kind = parts[0]
+            if method == "GET":
+                self._authz(user, "list", kind, qs.get("namespace",
+                                                       [None])[0])
+                return "200 OK", {"items": self.server.list(
+                    kind, namespace=qs.get("namespace", [None])[0],
+                    label_selector=_selector_from_query(qs))}
+            if method == "POST":
+                obj = self._body(environ)
+                ns = obj.get("metadata", {}).get("namespace")
+                self._authz(user, "create", kind, ns)
+                obj["kind"] = kind
+                return "201 Created", self.server.create(obj)
+        elif len(parts) == 3 or (len(parts) == 4 and parts[3] == "status"):
+            kind, ns, name = parts[0], parts[1], parts[2]
+            if ns == "_":
+                ns = None
+            if len(parts) == 4:
+                if method == "PUT":
+                    self._authz(user, "update", kind, ns)
+                    body = self._body(environ)
+                    return "200 OK", self.server.patch_status(
+                        kind, name, ns, body.get("status", body))
+                raise NotFound("status supports PUT only")
+            if method == "GET":
+                self._authz(user, "get", kind, ns)
+                return "200 OK", self.server.get(kind, name, ns)
+            if method == "PUT":
+                self._authz(user, "update", kind, ns)
+                obj = self._body(environ)
+                obj["kind"] = kind
+                body_md = obj.get("metadata", {})
+                # the path is the authorization subject; the body must match
+                if (body_md.get("name", name) != name
+                        or body_md.get("namespace", ns) != ns):
+                    raise Invalid(
+                        "body metadata must match the request path")
+                body_md["name"] = name
+                if ns is not None:
+                    body_md["namespace"] = ns
+                obj["metadata"] = body_md
+                return "200 OK", self.server.update(obj)
+            if method == "DELETE":
+                self._authz(user, "delete", kind, ns)
+                self.server.delete(kind, name, ns)
+                return "200 OK", {"status": "deleted"}
+        raise NotFound(f"no route {method} {path}")
+
+    def _user(self, environ) -> str | None:
+        raw = environ.get(USERID_HEADER)
+        if raw and raw.startswith(USERID_PREFIX):
+            return raw[len(USERID_PREFIX):]
+        return raw
+
+    def _authz(self, user, verb, kind, namespace) -> None:
+        if self.authorize is not None:
+            self.authorize(user, verb, kind, namespace)
+
+    def _body(self, environ) -> dict:
+        try:
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            length = 0
+        raw = environ["wsgi.input"].read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+
+def serve(app, port: int, host: str = "127.0.0.1"):
+    """Run a WSGI app on a threading HTTP server; returns (server, thread)."""
+    from socketserver import ThreadingMixIn
+    from wsgiref.simple_server import WSGIServer, make_server, WSGIRequestHandler
+
+    class ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
+        daemon_threads = True
+
+    class QuietHandler(WSGIRequestHandler):
+        def log_message(self, *args):  # route access logs to our logger
+            pass
+
+    httpd = make_server(host, port, app, server_class=ThreadingWSGIServer,
+                        handler_class=QuietHandler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, thread

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from kubeflow_tpu.parallel.distributed import initialize_from_env
@@ -27,6 +28,9 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     cfg_dict: dict = {}
+    env_cfg = os.environ.get("JAXJOB_TRAINER_CONFIG")
+    if env_cfg:  # injected by the JAXJob controller into worker pods
+        cfg_dict = json.loads(env_cfg)
     if args.config:
         with open(args.config) as f:
             cfg_dict = json.load(f)

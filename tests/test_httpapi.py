@@ -1,0 +1,92 @@
+"""REST facade over the API server."""
+
+import json
+import urllib.request
+import urllib.error
+
+import pytest
+
+from kubeflow_tpu.core import APIServer, api_object
+from kubeflow_tpu.core.httpapi import RestAPI, serve
+
+
+@pytest.fixture()
+def endpoint():
+    server = APIServer()
+    httpd, _ = serve(RestAPI(server), 0)  # ephemeral port
+    port = httpd.server_address[1]
+    yield server, f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+def req(url, method="GET", body=None, headers=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers=headers or {})
+    with urllib.request.urlopen(r) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+def test_rest_crud_roundtrip(endpoint):
+    _, base = endpoint
+    code, created = req(f"{base}/apis/Notebook", "POST",
+                        api_object("Notebook", "nb", "team",
+                                   spec={"image": "jax:v1"}))
+    assert code == 201 and created["metadata"]["uid"]
+    code, got = req(f"{base}/apis/Notebook/team/nb")
+    assert got["spec"]["image"] == "jax:v1"
+    got["spec"]["image"] = "jax:v2"
+    code, _ = req(f"{base}/apis/Notebook/team/nb", "PUT", got)
+    assert code == 200
+    code, listing = req(f"{base}/apis/Notebook?namespace=team")
+    assert len(listing["items"]) == 1
+    code, _ = req(f"{base}/apis/Notebook/team/nb", "DELETE")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(f"{base}/apis/Notebook/team/nb")
+    assert e.value.code == 404
+
+
+def test_rest_label_selector(endpoint):
+    _, base = endpoint
+    for name, team in [("a", "x"), ("b", "y")]:
+        req(f"{base}/apis/Notebook", "POST",
+            api_object("Notebook", name, "ns", labels={"team": team}))
+    code, out = req(f"{base}/apis/Notebook?labelSelector=team%3Dx")
+    assert [o["metadata"]["name"] for o in out["items"]] == ["a"]
+
+
+def test_rest_conflict_and_invalid(endpoint):
+    server, base = endpoint
+    req(f"{base}/apis/Notebook", "POST", api_object("Notebook", "nb", "ns"))
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(f"{base}/apis/Notebook", "POST",
+            api_object("Notebook", "nb", "ns"))
+    assert e.value.code == 409
+
+
+def test_metrics_and_probes(endpoint):
+    _, base = endpoint
+    code, body = req(f"{base}/healthz")
+    assert body["status"] == "ok"
+    with urllib.request.urlopen(f"{base}/metrics") as r:
+        text = r.read().decode()
+    assert "apiserver_http_requests_total" in text
+
+
+def test_identity_header_and_authz(endpoint):
+    server, base = endpoint
+
+    def deny_bob(user, verb, kind, namespace):
+        if user == "bob@corp.com" and verb != "get":
+            raise PermissionError(f"{user} may not {verb} {kind}")
+
+    api = RestAPI(server, authorize=deny_bob)
+    from kubeflow_tpu.core.httpapi import serve as serve2
+    httpd, _ = serve2(api, 0)
+    base2 = f"http://127.0.0.1:{httpd.server_address[1]}"
+    hdr = {"X-Goog-Authenticated-User-Email": "accounts.google.com:bob@corp.com"}
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(f"{base2}/apis/Notebook", "POST",
+            api_object("Notebook", "nb2", "ns"), headers=hdr)
+    assert e.value.code == 403
+    httpd.shutdown()

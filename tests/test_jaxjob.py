@@ -1,0 +1,158 @@
+"""JAXJob controller: gang creation, atomic release, restart, real training.
+
+This is the platform's minimum end-to-end slice (SURVEY.md §7.3): JAXJob CR
+-> controller -> gang pods -> executor -> status back on the CR.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api import jaxjob as api
+from kubeflow_tpu.controllers.executor import FakeExecutor, LocalExecutor
+from kubeflow_tpu.controllers.jaxjob import JAXJobController
+from kubeflow_tpu.core import APIServer, Manager
+from kubeflow_tpu.core.store import NotFound
+
+
+def wait_phase(server, name, ns, phases, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            job = server.get(api.KIND, name, ns)
+            last = job.get("status", {}).get("phase")
+            if last in phases:
+                return job
+        except NotFound:
+            pass
+        time.sleep(0.02)
+    raise AssertionError(f"job never reached {phases}; last={last}")
+
+
+@pytest.fixture()
+def harness():
+    server = APIServer()
+    server.register_validating_hook(
+        lambda o: api.validate(o) if o.get("kind") == api.KIND else None)
+    mgr = Manager(server)
+    mgr.add(JAXJobController(server))
+    yield server, mgr
+    mgr.stop()
+
+
+def test_gang_created_with_rendezvous_env(harness):
+    server, mgr = harness
+    mgr.add(FakeExecutor(server))
+    mgr.start()
+    job = api.new("bert-pretrain", "ml", topology="v5e-8",
+                  parallelism={"dp": 1, "fsdp": 2, "tp": 2, "sp": 2},
+                  trainer={"model": "bert", "steps": 10})
+    server.create(job)
+    done = wait_phase(server, "bert-pretrain", "ml", {"Succeeded"})
+
+    pods = server.list("Pod", namespace="ml",
+                       label_selector={"matchLabels": {"jaxjob":
+                                                       "bert-pretrain"}})
+    assert len(pods) == 2  # v5e-8 = 2 hosts x 4 chips
+    for pod in pods:
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["JAXJOB_NUM_PROCESSES"] == "2"
+        assert env["JAXJOB_COORDINATOR"].endswith(":8476")
+        assert "bert-pretrain-worker-0" in env["JAXJOB_COORDINATOR"]
+        res = pod["spec"]["containers"][0]["resources"]["limits"]
+        assert res["cloud-tpu.google.com/v5e"] == 4
+    idxs = sorted(int(p["metadata"]["labels"]["jaxjob-worker-index"])
+                  for p in pods)
+    assert idxs == [0, 1]
+    # headless service for rendezvous DNS
+    svc = server.get("Service", "bert-pretrain", "ml")
+    assert svc["spec"]["clusterIP"] == "None"
+    assert done["status"]["workers"] == {"ready": 2, "total": 2}
+    assert done["status"]["result"]["samples_per_sec"] == 100.0
+
+
+def test_invalid_parallelism_rejected(harness):
+    server, _ = harness
+    with pytest.raises(ValueError, match="multiplies to"):
+        server.create(api.new("bad", "ml", topology="v5e-8",
+                              parallelism={"dp": 3, "fsdp": 1,
+                                           "tp": 1, "sp": 1}))
+
+
+def test_gang_restart_on_worker_failure(harness):
+    server, mgr = harness
+    mgr.add(FakeExecutor(server,
+                         fail_once={api.worker_pod_name("job", 1)}))
+    mgr.start()
+    server.create(api.new("job", "ml", topology="v5e-8"))
+    done = wait_phase(server, "job", "ml", {"Succeeded"}, timeout=15)
+    assert done["status"]["restarts"] == 1
+    # whole gang was replaced: worker-0 (which succeeded first time) was
+    # also recreated
+    pod0 = server.get("Pod", api.worker_pod_name("job", 0), "ml")
+    assert pod0["status"]["phase"] == "Succeeded"
+
+
+def test_gang_fails_after_max_restarts(harness):
+    server, mgr = harness
+    mgr.add(FakeExecutor(server,
+                         always_fail={api.worker_pod_name("doomed", 0)}))
+    mgr.start()
+    server.create(api.new("doomed", "ml", topology="v5e-4", max_restarts=2))
+    done = wait_phase(server, "doomed", "ml", {"Failed"}, timeout=15)
+    assert done["status"]["restarts"] == 2
+    cond = done["status"]["conditions"][0]
+    assert cond["reason"] == "MaxRestarts"
+
+
+def test_scheduling_gates_released_atomically(harness):
+    """Pods must stay gated until the full gang exists, then all release."""
+    server, mgr = harness
+
+    release_log = []
+
+    class GateWatcher(FakeExecutor):
+        def reconcile(self, req):
+            try:
+                pod = self.server.get("Pod", req.name, req.namespace)
+                if not pod["spec"].get("schedulingGates"):
+                    release_log.append(req.name)
+            except NotFound:
+                pass
+            return super().reconcile(req)
+
+    mgr.add(GateWatcher(server))
+    mgr.start()
+    server.create(api.new("gangjob", "ml", topology="v5e-16"))  # 4 hosts
+    wait_phase(server, "gangjob", "ml", {"Succeeded"}, timeout=15)
+    # all 4 workers were created and released
+    released = {n for n in release_log}
+    assert len(released) == 4
+
+
+def test_local_executor_really_trains_mnist(harness):
+    """The BASELINE.json configs[0] milestone: MNIST e2e on one host with a
+    real subprocess running the actual Trainer."""
+    server, mgr = harness
+    mgr.add(LocalExecutor(server, extra_env={
+        "PALLAS_AXON_POOL_IPS": "",       # don't attach the TPU tunnel
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "JAXJOB_COORDINATOR": "",          # single host: no rendezvous
+    }))
+    mgr.start()
+    job = api.new("mnist-e2e", "ml", topology="v5e-1",
+                  trainer={"model": "mnist_mlp", "steps": 4,
+                           "global_batch": 16, "log_every": 2,
+                           "optimizer": {"name": "adam",
+                                         "learning_rate": 1e-3}})
+    server.create(job)
+    done = wait_phase(server, "mnist-e2e", "ml", {"Succeeded", "Failed"},
+                      timeout=180)
+    assert done["status"]["phase"] == "Succeeded", done["status"]
+    result = done["status"]["result"]
+    assert result["steps"] == 4
+    assert result["final_loss"] == result["final_loss"]
+    assert result["samples_per_sec"] > 0
