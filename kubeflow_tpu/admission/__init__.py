@@ -1,0 +1,1 @@
+"""Admission plane (reference: components/admission-webhook)."""

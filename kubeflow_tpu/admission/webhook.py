@@ -1,0 +1,92 @@
+"""PodDefault mutating admission.
+
+Reference flow (admission-webhook/main.go:443-544): on pod CREATE — skip if
+excluded or mirror pod, list PodDefaults in the pod's namespace, filter by
+label selector, detect merge conflicts (conflict = reject the pod), apply,
+record per-PodDefault application annotations.  Merge semantics live in the
+native C++ engine (native/engine.cpp), shared with nothing reimplemented in
+Python.
+
+Runs as an in-process mutating hook on the API server (the single-binary
+deployment); ``serve_webhook`` exposes the same logic as an HTTPS-style
+``POST /apply-poddefault`` endpoint for out-of-process API servers.
+"""
+
+from __future__ import annotations
+
+import json
+
+from kubeflow_tpu.api.poddefault import EXCLUDE_ANNOTATION, KIND
+from kubeflow_tpu.core.native import ENGINE, MergeConflict
+from kubeflow_tpu.core.store import APIServer, Invalid
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+MUTATIONS = REGISTRY.counter("poddefault_mutations_total",
+                             "pods mutated by PodDefaults")
+CONFLICTS = REGISTRY.counter("poddefault_conflicts_total",
+                             "pods rejected for PodDefault merge conflicts")
+
+log = get_logger("admission")
+
+
+def mutate_pod(server: APIServer, pod: dict) -> dict | None:
+    """The hook body: returns the mutated pod, or None for no change.
+    Raises Invalid on merge conflict (pod rejected)."""
+    if pod.get("kind") != "Pod":
+        return None
+    md = pod.get("metadata", {})
+    if md.get("annotations", {}).get(EXCLUDE_ANNOTATION) == "true":
+        return None
+    # the hook runs before the store defaults the namespace: resolve it here
+    # so tenant A's PodDefaults can never leak into tenant B's pods
+    namespace = md.get("namespace") or "default"
+    pds = server.list(KIND, namespace=namespace)
+    if not pds:
+        return None
+    matched = ENGINE.filter_poddefaults(pod, pds)
+    if not matched:
+        return None
+    try:
+        out = ENGINE.apply_poddefaults(pod, matched)
+    except MergeConflict as e:
+        CONFLICTS.inc()
+        log.warning("poddefault conflict", pod=md.get("name"), error=str(e))
+        raise Invalid(f"PodDefault merge conflict: {e}")
+    MUTATIONS.inc()
+    log.info("pod mutated", pod=md.get("name"),
+             applied=out["applied"])
+    return out["pod"]
+
+
+def register(server: APIServer, mgr=None) -> None:
+    server.register_mutating_hook(lambda obj: mutate_pod(server, obj))
+
+
+class WebhookApp:
+    """WSGI ``POST /apply-poddefault``: AdmissionReview-shaped request/response
+    for API servers running out of process (reference main.go:599)."""
+
+    def __init__(self, server: APIServer):
+        self.server = server
+
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "")
+        if path != "/apply-poddefault" or (
+                environ["REQUEST_METHOD"] != "POST"):
+            start_response("404 Not Found", [])
+            return [b"{}"]
+        length = int(environ.get("CONTENT_LENGTH") or 0)
+        review = json.loads(environ["wsgi.input"].read(length) or b"{}")
+        pod = review.get("request", {}).get("object", {})
+        pod.setdefault("kind", "Pod")
+        try:
+            mutated = mutate_pod(self.server, pod)
+            response = {"allowed": True,
+                        "patched": mutated if mutated is not None else pod}
+        except Invalid as e:
+            response = {"allowed": False, "status": {"message": str(e)}}
+        payload = json.dumps({"response": response}).encode()
+        start_response("200 OK", [("Content-Type", "application/json"),
+                                  ("Content-Length", str(len(payload)))])
+        return [payload]

@@ -6,6 +6,6 @@ schema module provides ``new_*`` constructors, validation, and status helpers
 over plain dict resources served by core.APIServer.
 """
 
-from kubeflow_tpu.api import jaxjob
+from kubeflow_tpu.api import jaxjob, notebook, poddefault, profile, tensorboard
 
-__all__ = ["jaxjob"]
+__all__ = ["jaxjob", "notebook", "poddefault", "profile", "tensorboard"]

@@ -1,0 +1,49 @@
+"""Notebook: a user-owned Jupyter/IDE server wrapping a raw PodSpec.
+
+Reference: notebook-controller api/v1beta1/notebook_types.go:27-45 — the spec
+is a full pod template so arbitrary images work; status carries conditions,
+readyReplicas, and the first container's state.  TPU-first: the template may
+request ``cloud-tpu.google.com/*`` chips and the controller passes them
+through to the StatefulSet; TPU-VM images replace the CUDA image variants
+(SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.objects import api_object
+
+KIND = "Notebook"
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+DEFAULT_PORT = 8888
+NB_PREFIX_ENV = "NB_PREFIX"
+
+
+def new(name: str, namespace: str, *, image: str,
+        cpu: str = "0.5", memory: str = "1Gi",
+        tpu_resource: str | None = None, tpu_chips: int = 0,
+        workspace_pvc: str | None = None, labels: dict | None = None,
+        env: list | None = None) -> dict:
+    resources: dict = {"requests": {"cpu": cpu, "memory": memory}}
+    if tpu_resource and tpu_chips:
+        resources.setdefault("limits", {})[tpu_resource] = tpu_chips
+    container = {"name": name, "image": image, "resources": resources,
+                 "env": list(env or [])}
+    volumes = []
+    if workspace_pvc:
+        container["volumeMounts"] = [{"name": "workspace",
+                                      "mountPath": "/home/jovyan"}]
+        volumes.append({"name": "workspace",
+                        "persistentVolumeClaim": {"claimName": workspace_pvc}})
+    return api_object(KIND, name, namespace, labels=labels, spec={
+        "template": {"spec": {"containers": [container],
+                              "volumes": volumes}},
+    })
+
+
+def is_stopped(nb: dict) -> bool:
+    return STOP_ANNOTATION in nb["metadata"].get("annotations", {})
+
+
+def url_prefix(nb: dict) -> str:
+    md = nb["metadata"]
+    return f"/notebook/{md['namespace']}/{md['name']}/"

@@ -1,0 +1,33 @@
+"""PodDefault: label-selected pod mutation bundles (PodPreset successor).
+
+Reference: admission-webhook/pkg/apis/settings/v1alpha1/poddefault_types.go.
+The spawner surfaces these as "configurations" checkboxes; the admission
+plane injects env/volumes/tolerations into matching pods — on TPU the common
+bundles are TPU env (TPU_WORKER_HOSTNAMES etc.), dataset volumes, and cloud
+credentials.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.objects import api_object
+
+KIND = "PodDefault"
+EXCLUDE_ANNOTATION = "poddefault.admission.kubeflow-tpu.org/exclude"
+
+
+def new(name: str, namespace: str, *, selector: dict | None = None,
+        desc: str = "", env: list | None = None, env_from: list | None = None,
+        volumes: list | None = None, volume_mounts: list | None = None,
+        tolerations: list | None = None, labels: dict | None = None,
+        annotations: dict | None = None) -> dict:
+    return api_object(KIND, name, namespace, spec={
+        "desc": desc or name,
+        "selector": selector or {},
+        "env": env or [],
+        "envFrom": env_from or [],
+        "volumes": volumes or [],
+        "volumeMounts": volume_mounts or [],
+        "tolerations": tolerations or [],
+        "labels": labels or {},
+        "annotations": annotations or {},
+    })

@@ -1,0 +1,49 @@
+"""Profile: per-user/team tenancy root (cluster-scoped).
+
+Reference: profile-controller api/v1/profile_types.go:38-47 — spec carries the
+owner subject, plugin list, and a ResourceQuota spec.  TPU-first difference:
+quota accounting is in ``cloud-tpu.google.com/*`` chip resources instead of
+``nvidia.com/gpu`` (SURVEY.md §5.8), expressed per slice type.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.objects import api_object
+
+KIND = "Profile"
+FINALIZER = "profile-controller.kubeflow-tpu.org/cleanup"
+
+# labels stamped on every profile namespace (profile_controller.go:68-73)
+NAMESPACE_LABELS = {
+    "katib.kubeflow-tpu.org/metrics-collector-injection": "enabled",
+    "serving.kubeflow-tpu.org/inferenceservice": "enabled",
+    "pipelines.kubeflow-tpu.org/enabled": "true",
+    "app.kubernetes.io/part-of": "kubeflow-tpu-profile",
+    "istio-injection": "enabled",
+}
+
+
+def new(name: str, owner_email: str, *,
+        tpu_quota: dict[str, int] | None = None,
+        plugins: list[dict] | None = None) -> dict:
+    """tpu_quota: {"cloud-tpu.google.com/v5e": 32, ...} chip budgets."""
+    quota = {}
+    if tpu_quota:
+        quota["hard"] = {str(k): v for k, v in tpu_quota.items()}
+    return api_object(KIND, name, spec={
+        "owner": {"kind": "User", "name": owner_email},
+        "plugins": plugins or [],
+        "resourceQuotaSpec": quota,
+    })
+
+
+def validate(profile: dict) -> None:
+    owner = profile.get("spec", {}).get("owner", {})
+    if owner.get("kind") != "User" or not owner.get("name"):
+        raise ValueError(
+            f"Profile {profile['metadata'].get('name')}: spec.owner must be "
+            "a User subject with a name")
+
+
+def owner_of(profile: dict) -> str:
+    return profile["spec"]["owner"]["name"]

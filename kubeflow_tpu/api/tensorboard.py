@@ -1,0 +1,35 @@
+"""Tensorboard: a managed TensorBoard server over a logs location.
+
+Reference: tensorboard-controller api/v1alpha1/tensorboard_types.go — spec is
+just ``logspath``; ``pvc://claim/sub/path`` mounts a PVC, ``gs://`` mounts
+cloud credentials (tensorboard_controller.go:159-228).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.objects import api_object
+
+KIND = "Tensorboard"
+DEFAULT_IMAGE = "tensorflow/tensorflow:2.15.0"
+LOGS_MOUNT = "/tensorboard_logs/"
+PORT = 6006
+
+
+def new(name: str, namespace: str, logspath: str,
+        image: str = DEFAULT_IMAGE) -> dict:
+    return api_object(KIND, name, namespace,
+                      spec={"logspath": logspath, "image": image})
+
+
+def parse_logspath(logspath: str) -> dict:
+    """-> {"kind": "pvc"|"cloud"|"local", ...}."""
+    if logspath.startswith("pvc://"):
+        rest = logspath[len("pvc://"):]
+        claim, _, sub = rest.partition("/")
+        if not claim:
+            raise ValueError(f"bad pvc logspath {logspath!r}")
+        return {"kind": "pvc", "claim": claim, "subPath": sub,
+                "logdir": LOGS_MOUNT + sub}
+    if logspath.startswith(("gs://", "s3://", "/cns/")):
+        return {"kind": "cloud", "logdir": logspath}
+    return {"kind": "local", "logdir": logspath}

@@ -1,0 +1,62 @@
+"""Idle-notebook culling (reference: notebook-controller/pkg/culler).
+
+Probes the live Jupyter activity API for ``last_activity`` and stamps the
+stop annotation when idle past the threshold; the notebook reconcile sees the
+annotation and scales to zero (culler.go:91-108, 138-189).  The probe is
+injectable so tests and non-HTTP notebook runtimes plug in their own.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import urllib.request
+from typing import Callable
+
+from kubeflow_tpu.utils.config import Config, config_field
+
+
+class CullerConfig(Config):
+    enable_culling: bool = config_field(False, env="ENABLE_CULLING")
+    idle_time_min: int = config_field(1440, env="IDLE_TIME")
+    check_period_min: int = config_field(1, env="CULLING_CHECK_PERIOD")
+
+
+def http_activity_probe(nb: dict) -> dt.datetime | None:
+    """GET the notebook's Jupyter status endpoint inside the mesh
+    (culler.go:138-169); None = unreachable (treated as active)."""
+    md = nb["metadata"]
+    url = (f"http://{md['name']}.{md['namespace']}.svc"
+           f"/notebook/{md['namespace']}/{md['name']}/api/status")
+    try:
+        with urllib.request.urlopen(url, timeout=2) as r:
+            data = json.loads(r.read())
+        return dt.datetime.fromisoformat(
+            data["last_activity"].replace("Z", "+00:00"))
+    except Exception:
+        return None
+
+
+class Culler:
+    def __init__(self, cfg: CullerConfig | None = None,
+                 probe: Callable[[dict], dt.datetime | None] | None = None,
+                 now: Callable[[], dt.datetime] | None = None):
+        self.cfg = cfg or CullerConfig.load()
+        self.probe = probe or http_activity_probe
+        self.now = now or (lambda: dt.datetime.now(dt.timezone.utc))
+
+    @property
+    def check_period_s(self) -> float:
+        return self.cfg.check_period_min * 60.0
+
+    def needs_culling(self, nb: dict) -> bool:
+        """True when the notebook is running and idle past the threshold."""
+        from kubeflow_tpu.api.notebook import is_stopped
+
+        if not self.cfg.enable_culling or is_stopped(nb):
+            return False
+        last = self.probe(nb)
+        if last is None:
+            return False  # unreachable: trust it's busy (no flapping)
+        idle = self.now() - last
+        return idle >= dt.timedelta(minutes=self.cfg.idle_time_min)

@@ -37,10 +37,14 @@ class FakeExecutor(Controller):
     kind = "Pod"
 
     def __init__(self, server, *, fail_once: set[str] | None = None,
-                 always_fail: set[str] | None = None):
+                 always_fail: set[str] | None = None,
+                 complete: bool = True):
         super().__init__(server)
         self.fail_once = set(fail_once or ())
         self.always_fail = set(always_fail or ())
+        # complete=False models long-running servers (notebooks,
+        # tensorboards): pods stay Running instead of finishing
+        self.complete = complete
         self._failed_already: set[str] = set()
 
     def reconcile(self, req: Request) -> Result | None:
@@ -58,6 +62,9 @@ class FakeExecutor(Controller):
             return Result(requeue_after=0.01)
         if phase == "Running":
             name = req.name
+            if not self.complete and name not in self.always_fail and (
+                    name not in self.fail_once):
+                return None
             if name in self.always_fail or (
                     name in self.fail_once
                     and name not in self._failed_already):

@@ -1,0 +1,193 @@
+"""Notebook controller (reference: notebook-controller, ~SURVEY.md §2.1).
+
+Notebook CR -> StatefulSet(1 replica; 0 when stop-annotated) + Service
+(80 -> 8888, Istio-style name) + VirtualService (/notebook/<ns>/<name>/
+route, 300s timeout) + status mirroring from the pod + idle culling.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kubeflow_tpu.api import notebook as api
+from kubeflow_tpu.controllers.culler import Culler
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.objects import api_object, set_condition, set_owner
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.utils.config import Config, config_field
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+RUNNING = REGISTRY.gauge("notebook_running", "notebooks currently running")
+CREATED = REGISTRY.counter("notebook_create_total", "notebooks created")
+CULLED = REGISTRY.counter("notebook_culling_total", "notebooks culled")
+
+
+class NotebookControllerConfig(Config):
+    use_istio: bool = config_field(True, env="USE_ISTIO")
+    istio_gateway: str = config_field("kubeflow/kubeflow-gateway",
+                                      env="ISTIO_GATEWAY")
+    cluster_domain: str = config_field("cluster.local", env="CLUSTER_DOMAIN")
+    add_fsgroup: bool = config_field(True, env="ADD_FSGROUP")
+
+
+class NotebookController(Controller):
+    kind = api.KIND
+    owns = ("StatefulSet", "Service", "VirtualService")
+
+    def __init__(self, server, cfg: NotebookControllerConfig | None = None,
+                 culler: Culler | None = None):
+        super().__init__(server)
+        self.cfg = cfg or NotebookControllerConfig.load()
+        self.culler = culler or Culler()
+        self._seen: set[str] = set()
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            nb = self.server.get(api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if nb["metadata"].get("deletionTimestamp"):
+            return None
+
+        uid = nb["metadata"]["uid"]
+        if uid not in self._seen:
+            self._seen.add(uid)
+            CREATED.inc()
+
+        self._ensure_statefulset(nb)
+        self._ensure_service(nb)
+        if self.cfg.use_istio:
+            self._ensure_virtualservice(nb)
+        self._mirror_status(nb)
+
+        # culling tail (notebook_controller.go:252-270)
+        if self.culler.cfg.enable_culling:
+            if self.culler.needs_culling(nb):
+                fresh = self.server.get(api.KIND, req.name, req.namespace)
+                anns = fresh["metadata"].setdefault("annotations", {})
+                if api.STOP_ANNOTATION not in anns:
+                    import datetime as dt
+
+                    anns[api.STOP_ANNOTATION] = dt.datetime.now(
+                        dt.timezone.utc).isoformat()
+                    self.server.update(fresh)
+                    CULLED.inc()
+            return Result(requeue_after=self.culler.check_period_s)
+        return None
+
+    # -- children -------------------------------------------------------------
+    def _ensure_statefulset(self, nb: dict) -> None:
+        from kubeflow_tpu.core.native import ENGINE
+
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        replicas = 0 if api.is_stopped(nb) else 1
+
+        template = copy.deepcopy(nb["spec"].get("template", {}))
+        pod_spec = template.setdefault("spec", {})
+        containers = pod_spec.setdefault("containers", [{}])
+        c0 = containers[0]
+        c0.setdefault("name", name)
+        # NB_PREFIX env + default port (notebook_controller.go:339-351)
+        env = c0.setdefault("env", [])
+        if not any(e.get("name") == api.NB_PREFIX_ENV for e in env):
+            env.append({"name": api.NB_PREFIX_ENV,
+                        "value": api.url_prefix(nb).rstrip("/")})
+        if not c0.get("ports"):
+            c0["ports"] = [{"containerPort": api.DEFAULT_PORT,
+                            "name": "notebook-port"}]
+        if self.cfg.add_fsgroup:
+            pod_spec.setdefault("securityContext", {}).setdefault(
+                "fsGroup", 100)
+        tmeta = template.setdefault("metadata", {})
+        tmeta.setdefault("labels", {})["statefulset"] = name
+        tmeta["labels"]["notebook-name"] = name
+
+        desired = set_owner(api_object("StatefulSet", name, ns, spec={
+            "replicas": replicas,
+            "selector": {"matchLabels": {"statefulset": name}},
+            "template": template,
+        }), nb)
+        try:
+            live = self.server.get("StatefulSet", name, ns)
+            merged, changed = ENGINE.reconcile_merge(live, desired)
+            if changed:
+                self.server.update(merged)
+        except NotFound:
+            self.server.create(desired)
+
+    def _ensure_service(self, nb: dict) -> None:
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        try:
+            self.server.get("Service", name, ns)
+        except NotFound:
+            self.server.create(set_owner(api_object("Service", name, ns,
+                                                    spec={
+                "selector": {"statefulset": name},
+                "ports": [{"name": f"http-{name}", "port": 80,
+                           "targetPort": api.DEFAULT_PORT,
+                           "protocol": "TCP"}],
+            }), nb))
+
+    def _ensure_virtualservice(self, nb: dict) -> None:
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        prefix = api.url_prefix(nb)
+        try:
+            self.server.get("VirtualService", f"notebook-{name}", ns)
+        except NotFound:
+            host = f"{name}.{ns}.svc.{self.cfg.cluster_domain}"
+            self.server.create(set_owner(api_object(
+                "VirtualService", f"notebook-{name}", ns, spec={
+                    "hosts": ["*"],
+                    "gateways": [self.cfg.istio_gateway],
+                    "http": [{
+                        "match": [{"uri": {"prefix": prefix}}],
+                        "rewrite": {"uri": "/"},
+                        "route": [{"destination": {
+                            "host": host, "port": {"number": 80}}}],
+                        "timeout": "300s",
+                        "headers": {"request": {"set": {
+                            "X-RSC-Request": prefix}}},
+                    }],
+                }), nb))
+
+    def _mirror_status(self, nb: dict) -> None:
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        status: dict = {"readyReplicas": 0, "containerState": {}}
+        try:
+            sts = self.server.get("StatefulSet", name, ns)
+            sts_status = sts.get("status", {})
+            status["readyReplicas"] = sts_status.get("readyReplicas", 0)
+            pod_phase = sts_status.get("podPhase")
+            if pod_phase == "Running":
+                status["containerState"] = {"running": {}}
+            elif pod_phase == "Failed":
+                status["containerState"] = {"terminated": {
+                    "message": sts_status.get("podMessage", "")}}
+            elif pod_phase is not None:
+                status["containerState"] = {"waiting": {"reason": pod_phase}}
+            for cond in sts_status.get("conditions", []):
+                if cond.get("type") == "ReplicaFailure":
+                    status["containerState"] = {"waiting": {
+                        "reason": "AdmissionRejected",
+                        "message": cond.get("message", "")}}
+        except NotFound:
+            pass
+        set_condition(nb, "Ready",
+                      "True" if status["readyReplicas"] else "False")
+        status["conditions"] = nb["status"]["conditions"]
+        RUNNING.set(sum(
+            1 for n in self.server.list(api.KIND)
+            if n.get("status", {}).get("readyReplicas")))
+        self.server.patch_status(api.KIND, name, ns, status)
+
+
+def register(server, mgr) -> None:
+    from kubeflow_tpu.controllers import workloads
+
+    mgr.add(NotebookController(server))
+    if not any(c.kind == "StatefulSet" for c in mgr.controllers):
+        workloads.register(server, mgr)

@@ -1,0 +1,200 @@
+"""Profile controller: namespace + RBAC + authz policy + TPU quota + plugins.
+
+Mirrors profile_controller.go:105-315 behavior on the TPU-native stack:
+- create/adopt the namespace (owner annotation; conflict -> Failed condition);
+- AuthorizationPolicy ``ns-owner-access-istio`` keyed on the identity header;
+- ServiceAccounts default-editor/default-viewer bound to kubeflow-edit/view;
+- owner RoleBinding ``namespaceAdmin`` -> kubeflow-admin;
+- ResourceQuota ``kf-resource-quota`` carrying cloud-tpu.google.com/* chips;
+- plugin apply/revoke (idempotent), finalizer-driven external cleanup.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import profile as api
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.objects import (
+    api_object,
+    set_condition,
+    set_owner,
+)
+from kubeflow_tpu.core.store import Conflict, NotFound
+
+USERID_HEADER = "x-goog-authenticated-user-email"
+USERID_PREFIX = "accounts.google.com:"
+
+
+class ProfilePlugin:
+    """ApplyPlugin/RevokePlugin contract (profile_controller.go:78-84)."""
+
+    kind = ""
+
+    def apply(self, server, profile: dict, spec: dict) -> None:
+        raise NotImplementedError
+
+    def revoke(self, server, profile: dict, spec: dict) -> None:
+        raise NotImplementedError
+
+
+class TpuWorkloadIdentity(ProfilePlugin):
+    """GcpWorkloadIdentity analog: annotate the namespace service accounts so
+    TPU-VM workloads impersonate the team's cloud identity."""
+
+    kind = "TpuWorkloadIdentity"
+
+    def apply(self, server, profile, spec):
+        gsa = spec.get("serviceAccount", "")
+        ns = profile["metadata"]["name"]
+        for sa_name in ("default-editor", "default-viewer"):
+            try:
+                sa = server.get("ServiceAccount", sa_name, ns)
+            except NotFound:
+                continue
+            ann = sa["metadata"].setdefault("annotations", {})
+            if ann.get("iam.gke.io/gcp-service-account") != gsa:
+                ann["iam.gke.io/gcp-service-account"] = gsa
+                server.update(sa)
+
+    def revoke(self, server, profile, spec):
+        ns = profile["metadata"]["name"]
+        for sa_name in ("default-editor", "default-viewer"):
+            try:
+                sa = server.get("ServiceAccount", sa_name, ns)
+            except NotFound:
+                continue
+            ann = sa["metadata"].get("annotations", {})
+            if ann.pop("iam.gke.io/gcp-service-account", None) is not None:
+                server.update(sa)
+
+
+PLUGINS: dict[str, ProfilePlugin] = {
+    TpuWorkloadIdentity.kind: TpuWorkloadIdentity(),
+}
+
+
+class ProfileController(Controller):
+    kind = api.KIND
+    owns = ("Namespace",)
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            profile = self.server.get(api.KIND, req.name)
+        except NotFound:
+            return None
+        name = req.name
+        owner = api.owner_of(profile)
+
+        if profile["metadata"].get("deletionTimestamp"):
+            return self._finalize(profile)
+
+        # ensure finalizer before creating external state
+        fins = profile["metadata"].setdefault("finalizers", [])
+        if api.FINALIZER not in fins:
+            fins.append(api.FINALIZER)
+            profile = self.server.update(profile)
+
+        # 1. namespace (adopt or create; foreign owner -> Failed condition)
+        try:
+            ns = self.server.get("Namespace", name)
+            ns_owner = ns["metadata"].get("annotations", {}).get("owner")
+            if ns_owner and ns_owner != owner:
+                set_condition(profile, "Ready", "False",
+                              reason="NamespaceOwnedByOthers",
+                              message=f"namespace owned by {ns_owner}")
+                self.server.patch_status(api.KIND, name, None,
+                                         profile["status"])
+                return None
+        except NotFound:
+            ns = set_owner(api_object(
+                "Namespace", name,
+                labels=dict(api.NAMESPACE_LABELS),
+                annotations={"owner": owner}), profile)
+            try:
+                self.server.create(ns)
+            except Conflict:
+                return Result(requeue_after=0.2)
+
+        # 2. authorization policy bound to the identity header (update=True:
+        # owner changes and drift on security objects must re-converge)
+        self._ensure(profile, "AuthorizationPolicy", "ns-owner-access-istio",
+                     name, update=True, spec={
+                         "action": "ALLOW",
+                         "rules": [
+                             {"when": [{
+                                 "key": f"request.headers[{USERID_HEADER}]",
+                                 "values": [USERID_PREFIX + owner]}]},
+                             {"from": [{"source": {
+                                 "namespaces": [name]}}]},
+                         ]})
+
+        # 3. service accounts + bindings
+        for sa, role in (("default-editor", "kubeflow-edit"),
+                         ("default-viewer", "kubeflow-view")):
+            self._ensure(profile, "ServiceAccount", sa, name)
+            self._ensure(profile, "RoleBinding", sa, name, spec={
+                "subjects": [{"kind": "ServiceAccount", "name": sa,
+                              "namespace": name}],
+                "roleRef": {"kind": "ClusterRole", "name": role}})
+        self._ensure(profile, "RoleBinding", "namespaceAdmin", name,
+                     update=True, spec={
+                         "subjects": [{"kind": "User", "name": owner}],
+                         "roleRef": {"kind": "ClusterRole",
+                                     "name": "kubeflow-admin"}})
+
+        # 4. TPU resource quota
+        quota_spec = profile["spec"].get("resourceQuotaSpec") or {}
+        if quota_spec.get("hard"):
+            self._ensure(profile, "ResourceQuota", "kf-resource-quota", name,
+                         spec=quota_spec, update=True)
+
+        # 5. plugins
+        for plug in profile["spec"].get("plugins", []):
+            impl = PLUGINS.get(plug.get("kind", ""))
+            if impl is None:
+                self.log.warning("unknown plugin", kind=plug.get("kind"))
+                continue
+            impl.apply(self.server, profile, plug.get("spec", {}))
+
+        set_condition(profile, "Ready", "True", reason="Reconciled")
+        self.server.patch_status(api.KIND, name, None, profile["status"])
+        return None
+
+    def _ensure(self, profile: dict, kind: str, name: str, namespace: str,
+                spec: dict | None = None, update: bool = False) -> None:
+        from kubeflow_tpu.core.native import ENGINE
+
+        desired = set_owner(
+            api_object(kind, name, namespace, spec=spec or {}), profile)
+        try:
+            live = self.server.get(kind, name, namespace)
+            if update:
+                merged, changed = ENGINE.reconcile_merge(live, desired)
+                if changed:
+                    self.server.update(merged)
+        except NotFound:
+            self.server.create(desired)
+
+    def _finalize(self, profile: dict) -> Result | None:
+        # revoke plugins (external state), then drop our finalizer; namespace
+        # and children are ownerReference-GC'd with the profile.
+        for plug in profile["spec"].get("plugins", []):
+            impl = PLUGINS.get(plug.get("kind", ""))
+            if impl is not None:
+                impl.revoke(self.server, profile, plug.get("spec", {}))
+        fins = profile["metadata"].get("finalizers", [])
+        if api.FINALIZER in fins:
+            fins.remove(api.FINALIZER)
+            try:
+                self.server.update(profile)
+            except Conflict:
+                return Result(requeue_after=0.05)
+        return None
+
+
+def register(server, mgr) -> None:
+    from kubeflow_tpu.core.rbac import ensure_builtin_roles
+
+    ensure_builtin_roles(server)
+    server.register_validating_hook(
+        lambda o: api.validate(o) if o.get("kind") == api.KIND else None)
+    mgr.add(ProfileController(server))

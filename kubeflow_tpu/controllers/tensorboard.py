@@ -1,0 +1,176 @@
+"""Tensorboard controller (reference: tensorboard-controller, SURVEY.md §2.3).
+
+Tensorboard CR -> Deployment (tensorboard --logdir) + Service (80 -> 6006) +
+VirtualService /tensorboard/<ns>/<name>/.  PVC logs mount the claim at
+/tensorboard_logs; cloud paths mount the namespace's cloud-credentials
+secret.  The RWO co-scheduling trick (tensorboard_controller.go:188-212):
+when the logs PVC is ReadWriteOnce and already mounted by a running pod, add
+preferred node affinity to that pod's node.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import tensorboard as api
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.objects import api_object, set_condition, set_owner
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.utils.config import Config, config_field
+
+
+class TensorboardControllerConfig(Config):
+    use_istio: bool = config_field(True, env="USE_ISTIO")
+    istio_gateway: str = config_field("kubeflow/kubeflow-gateway",
+                                      env="ISTIO_GATEWAY")
+    rwo_pvc_scheduling: bool = config_field(True, env="RWO_PVC_SCHEDULING")
+
+
+class TensorboardController(Controller):
+    kind = api.KIND
+    owns = ("Deployment", "Service", "VirtualService")
+
+    def __init__(self, server, cfg=None):
+        super().__init__(server)
+        self.cfg = cfg or TensorboardControllerConfig.load()
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            tb = self.server.get(api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if tb["metadata"].get("deletionTimestamp"):
+            return None
+        parsed = api.parse_logspath(tb["spec"]["logspath"])
+        self._ensure_deployment(tb, parsed)
+        self._ensure_service(tb)
+        if self.cfg.use_istio:
+            self._ensure_virtualservice(tb)
+        self._mirror_status(tb)
+        return None
+
+    def _ensure_deployment(self, tb: dict, parsed: dict) -> None:
+        name = tb["metadata"]["name"]
+        ns = tb["metadata"]["namespace"]
+        container = {
+            "name": "tensorboard",
+            "image": tb["spec"].get("image", api.DEFAULT_IMAGE),
+            "command": ["/usr/local/bin/tensorboard",
+                        f"--logdir={parsed['logdir']}",
+                        "--bind_all", f"--port={api.PORT}"],
+            "ports": [{"containerPort": api.PORT}],
+        }
+        volumes = []
+        affinity = None
+        if parsed["kind"] == "pvc":
+            container["volumeMounts"] = [{"name": "logs",
+                                          "mountPath": api.LOGS_MOUNT}]
+            volumes.append({"name": "logs", "persistentVolumeClaim":
+                            {"claimName": parsed["claim"]}})
+            if self.cfg.rwo_pvc_scheduling:
+                affinity = self._rwo_affinity(ns, parsed["claim"])
+        elif parsed["kind"] == "cloud":
+            container["volumeMounts"] = [{"name": "cloud-sa",
+                                          "mountPath": "/secrets"}]
+            container["env"] = [{"name": "GOOGLE_APPLICATION_CREDENTIALS",
+                                 "value": "/secrets/sa.json"}]
+            volumes.append({"name": "cloud-sa",
+                            "secret": {"secretName": "user-gcp-sa"}})
+        pod_spec = {"containers": [container], "volumes": volumes}
+        if affinity:
+            pod_spec["affinity"] = affinity
+        desired = set_owner(api_object(
+            "Deployment", name, ns, spec={
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {"metadata": {"labels": {"app": name}},
+                             "spec": pod_spec},
+            }), tb)
+        from kubeflow_tpu.core.native import ENGINE
+
+        try:
+            live = self.server.get("Deployment", name, ns)
+            merged, changed = ENGINE.reconcile_merge(live, desired)
+            if changed:
+                self.server.update(merged)
+        except NotFound:
+            self.server.create(desired)
+
+    def _rwo_affinity(self, ns: str, claim: str) -> dict | None:
+        """Prefer the node of a running pod already mounting the RWO claim."""
+        try:
+            pvc = self.server.get("PersistentVolumeClaim", claim, ns)
+        except NotFound:
+            return None
+        modes = pvc.get("spec", {}).get("accessModes", [])
+        if "ReadWriteOnce" not in modes:
+            return None
+        for pod in self.server.list("Pod", namespace=ns):
+            if pod.get("status", {}).get("phase") != "Running":
+                continue
+            node = pod["spec"].get("nodeName")
+            if not node:
+                continue
+            for vol in pod["spec"].get("volumes", []):
+                if (vol.get("persistentVolumeClaim", {})
+                        .get("claimName") == claim):
+                    return {"nodeAffinity": {
+                        "preferredDuringSchedulingIgnoredDuringExecution": [{
+                            "weight": 100,
+                            "preference": {"matchExpressions": [{
+                                "key": "kubernetes.io/hostname",
+                                "operator": "In", "values": [node]}]}}]}}
+        return None
+
+    def _ensure_service(self, tb: dict) -> None:
+        name = tb["metadata"]["name"]
+        ns = tb["metadata"]["namespace"]
+        try:
+            self.server.get("Service", name, ns)
+        except NotFound:
+            self.server.create(set_owner(api_object("Service", name, ns,
+                                                    spec={
+                "selector": {"app": name},
+                "ports": [{"port": 80, "targetPort": api.PORT}],
+            }), tb))
+
+    def _ensure_virtualservice(self, tb: dict) -> None:
+        name = tb["metadata"]["name"]
+        ns = tb["metadata"]["namespace"]
+        try:
+            self.server.get("VirtualService", f"tensorboard-{name}", ns)
+        except NotFound:
+            self.server.create(set_owner(api_object(
+                "VirtualService", f"tensorboard-{name}", ns, spec={
+                    "hosts": ["*"],
+                    "gateways": [self.cfg.istio_gateway],
+                    "http": [{
+                        "match": [{"uri": {"prefix":
+                                           f"/tensorboard/{ns}/{name}/"}}],
+                        "rewrite": {"uri": "/"},
+                        "route": [{"destination": {"host":
+                                                   f"{name}.{ns}.svc",
+                                                   "port": {"number": 80}}}],
+                        "timeout": "300s",
+                    }],
+                }), tb))
+
+    def _mirror_status(self, tb: dict) -> None:
+        name = tb["metadata"]["name"]
+        ns = tb["metadata"]["namespace"]
+        ready = 0
+        try:
+            dep = self.server.get("Deployment", name, ns)
+            ready = dep.get("status", {}).get("readyReplicas", 0)
+        except NotFound:
+            pass
+        set_condition(tb, "Ready", "True" if ready else "False")
+        self.server.patch_status(api.KIND, name, ns, {
+            "readyReplicas": ready,
+            "conditions": tb["status"]["conditions"]})
+
+
+def register(server, mgr) -> None:
+    from kubeflow_tpu.controllers import workloads
+
+    mgr.add(TensorboardController(server))
+    if not any(c.kind == "Deployment" for c in mgr.controllers):
+        workloads.register(server, mgr)

@@ -1,0 +1,113 @@
+"""StatefulSet/Deployment controllers: template -> pods.
+
+The reference relies on kube's built-in workload controllers (envtest tests
+explicitly note "statefulset controllers aren't running within envtest",
+suite_test.go).  Running our own means notebook/tensorboard behavior is
+testable end to end in-process: pods materialize from templates, flow through
+admission (PodDefault injection!), get phases from an executor, and roll up
+into readyReplicas.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.objects import api_object, set_owner
+from kubeflow_tpu.core.store import Conflict, Invalid, NotFound
+
+
+def _pod_from_template(owner: dict, name: str, template: dict) -> dict:
+    tmeta = template.get("metadata", {})
+    pod = api_object("Pod", name, owner["metadata"]["namespace"],
+                     labels=dict(tmeta.get("labels", {})),
+                     annotations=dict(tmeta.get("annotations", {})) or None,
+                     spec=copy.deepcopy(template.get("spec", {})))
+    return set_owner(pod, owner)
+
+
+class _TemplateWorkloadController(Controller):
+    """Shared replicas/template reconcile for StatefulSet and Deployment."""
+
+    owns = ("Pod",)
+
+    def _pod_name(self, name: str, ordinal: int) -> str:
+        raise NotImplementedError
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            obj = self.server.get(self.kind, req.name, req.namespace)
+        except NotFound:
+            return None
+        spec = obj.get("spec", {})
+        replicas = int(spec.get("replicas", 1))
+        template = spec.get("template", {})
+        selector = spec.get("selector") or {"matchLabels":
+                                            template.get("metadata", {})
+                                            .get("labels", {})}
+
+        pods = [p for p in self.server.list(
+            "Pod", namespace=req.namespace,
+            label_selector=selector)
+            if any(r.get("uid") == obj["metadata"]["uid"]
+                   for r in p["metadata"].get("ownerReferences", []))]
+        by_name = {p["metadata"]["name"]: p for p in pods}
+
+        want_names = [self._pod_name(req.name, i) for i in range(replicas)]
+        for name in want_names:
+            if name not in by_name:
+                try:
+                    self.server.create(
+                        _pod_from_template(obj, name, template))
+                except (Conflict, Invalid) as e:
+                    # admission rejection surfaces on workload status
+                    self.server.patch_status(
+                        self.kind, req.name, req.namespace,
+                        {**obj.get("status", {}),
+                         "conditions": [{"type": "ReplicaFailure",
+                                         "status": "True",
+                                         "message": str(e)}]})
+                    return None
+        for name, pod in by_name.items():
+            if name not in want_names:
+                try:
+                    self.server.delete("Pod", name, req.namespace)
+                except NotFound:
+                    pass
+
+        ready = sum(1 for n in want_names
+                    if by_name.get(n, {}).get("status", {}).get("phase")
+                    in ("Running", "Succeeded"))
+        status = {
+            "replicas": replicas,
+            "readyReplicas": ready,
+            "availableReplicas": ready,
+        }
+        # surface the first pod's container state (notebook status source)
+        first = by_name.get(want_names[0]) if want_names else None
+        if first is not None:
+            status["podPhase"] = first.get("status", {}).get("phase",
+                                                             "Pending")
+            if first.get("status", {}).get("message"):
+                status["podMessage"] = first["status"]["message"]
+        self.server.patch_status(self.kind, req.name, req.namespace, status)
+        return None
+
+
+class StatefulSetController(_TemplateWorkloadController):
+    kind = "StatefulSet"
+
+    def _pod_name(self, name: str, ordinal: int) -> str:
+        return f"{name}-{ordinal}"
+
+
+class DeploymentController(_TemplateWorkloadController):
+    kind = "Deployment"
+
+    def _pod_name(self, name: str, ordinal: int) -> str:
+        return f"{name}-{ordinal}"
+
+
+def register(server, mgr) -> None:
+    mgr.add(StatefulSetController(server))
+    mgr.add(DeploymentController(server))

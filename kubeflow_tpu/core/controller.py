@@ -55,19 +55,22 @@ class WorkQueue:
     def __init__(self) -> None:
         self._lock = threading.Condition()
         self._heap: list[tuple[float, int, Request]] = []
-        self._pending: set[Request] = set()
+        # earliest scheduled run per key; duplicate heap entries later than
+        # this are stale and skipped on pop
+        self._due: dict[Request, float] = {}
         self._failures: dict[Request, int] = {}
         self._seq = 0
         self._shutdown = False
 
     def add(self, req: Request, delay: float = 0.0) -> None:
+        when = time.monotonic() + delay
         with self._lock:
-            if req in self._pending and delay == 0.0:
-                return
-            self._pending.add(req)
+            existing = self._due.get(req)
+            if existing is not None and existing <= when:
+                return  # already scheduled at least as early
+            self._due[req] = when
             self._seq += 1
-            heapq.heappush(self._heap, (time.monotonic() + delay, self._seq,
-                                        req))
+            heapq.heappush(self._heap, (when, self._seq, req))
             self._lock.notify_all()
 
     def add_rate_limited(self, req: Request) -> None:
@@ -86,9 +89,11 @@ class WorkQueue:
         with self._lock:
             while not self._shutdown:
                 now = time.monotonic()
-                if self._heap and self._heap[0][0] <= now:
-                    _, _, req = heapq.heappop(self._heap)
-                    self._pending.discard(req)
+                while self._heap and self._heap[0][0] <= now:
+                    when, _, req = heapq.heappop(self._heap)
+                    if self._due.get(req) != when:
+                        continue  # superseded by an earlier reschedule
+                    del self._due[req]
                     return req
                 wait = min(self._heap[0][0] - now if self._heap else timeout,
                            deadline - now)
@@ -99,7 +104,14 @@ class WorkQueue:
 
     def depth(self) -> int:
         with self._lock:
-            return len(self._heap)
+            return len(self._due)
+
+    def due_now(self, horizon: float = 0.0) -> int:
+        """Keys due to run within ``horizon`` seconds (excludes far-future
+        periodic requeues, e.g. hourly culling checks)."""
+        cutoff = time.monotonic() + horizon
+        with self._lock:
+            return sum(1 for when in self._due.values() if when <= cutoff)
 
     def shutdown(self) -> None:
         with self._lock:
@@ -276,7 +288,8 @@ class Manager:
         deadline = time.monotonic() + timeout
         quiet_since = None
         while time.monotonic() < deadline:
-            if all(q.depth() == 0 for q in self._queues.values()):
+            if all(q.due_now(horizon=settle) == 0
+                   for q in self._queues.values()):
                 if quiet_since is None:
                     quiet_since = time.monotonic()
                 elif time.monotonic() - quiet_since >= settle:

@@ -112,6 +112,7 @@ class RestAPI:
                 ns = obj.get("metadata", {}).get("namespace")
                 self._authz(user, "create", kind, ns)
                 obj["kind"] = kind
+                obj.setdefault("apiVersion", "kubeflow-tpu.org/v1")
                 return "201 Created", self.server.create(obj)
         elif len(parts) == 3 or (len(parts) == 4 and parts[3] == "status"):
             kind, ns, name = parts[0], parts[1], parts[2]

@@ -58,7 +58,7 @@ def owner_ref(owner: dict, *, controller: bool = True) -> dict:
     uid).  Children with a controller ownerRef are garbage-collected with the
     owner, mirroring SetControllerReference (notebook_controller.go:120)."""
     return {
-        "apiVersion": owner["apiVersion"],
+        "apiVersion": owner.get("apiVersion", "kubeflow-tpu.org/v1"),
         "kind": owner["kind"],
         "name": name_of(owner),
         "uid": owner["metadata"]["uid"],

@@ -1,0 +1,116 @@
+"""RBAC evaluation — the SubjectAccessReview the CRUD backends depend on.
+
+The reference guards every backend k8s call with a SubjectAccessReview as the
+end user (crud_backend/authz.py:25-81): the backend's own service account has
+broad rights, but each request is authorized as the requesting user.  Here
+the evaluator walks RoleBinding/ClusterRoleBinding objects to ClusterRole/
+Role rules stored in the same API server.
+
+Objects used:
+    ClusterRole   {rules: [{verbs: [], kinds: [] }]}  (cluster-scoped)
+    Role          namespaced, same shape
+    RoleBinding   namespaced {subjects: [{kind: User|Group, name}],
+                   roleRef: {kind: ClusterRole|Role, name}}
+    ClusterRoleBinding  cluster-scoped, same shape
+
+Built-in roles mirror kubeflow-admin / kubeflow-edit / kubeflow-view.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.core.store import APIServer, Conflict
+
+WILDCARD = "*"
+
+BUILTIN_ROLES = {
+    "kubeflow-admin": [{"verbs": [WILDCARD], "kinds": [WILDCARD]}],
+    "kubeflow-edit": [
+        {"verbs": ["get", "list", "create", "update", "delete"],
+         "kinds": ["Notebook", "Tensorboard", "PersistentVolumeClaim",
+                   "JAXJob", "Experiment", "PodDefault", "Pod", "Event",
+                   "Secret", "ConfigMap", "InferenceService"]},
+    ],
+    "kubeflow-view": [
+        {"verbs": ["get", "list"],
+         "kinds": [WILDCARD]},
+    ],
+}
+
+
+def ensure_builtin_roles(server: APIServer) -> None:
+    for name, rules in BUILTIN_ROLES.items():
+        try:
+            server.create(api_object("ClusterRole", name,
+                                     spec={"rules": rules}))
+        except Conflict:
+            pass
+
+
+def _rule_allows(rule: dict, verb: str, kind: str) -> bool:
+    verbs = rule.get("verbs", [])
+    kinds = rule.get("kinds", [])
+    return ((WILDCARD in verbs or verb in verbs)
+            and (WILDCARD in kinds or kind in kinds))
+
+
+def _binding_subjects_match(binding: dict, user: str,
+                            groups: set[str]) -> bool:
+    for sub in binding.get("spec", {}).get("subjects", []):
+        if sub.get("kind") == "User" and sub.get("name") == user:
+            return True
+        if sub.get("kind") == "Group" and sub.get("name") in groups:
+            return True
+    return False
+
+
+def _role_rules(server: APIServer, role_ref: dict,
+                namespace: str | None) -> list[dict]:
+    from kubeflow_tpu.core.store import NotFound
+
+    kind = role_ref.get("kind", "ClusterRole")
+    name = role_ref.get("name", "")
+    try:
+        if kind == "ClusterRole":
+            role = server.get("ClusterRole", name)
+        else:
+            role = server.get("Role", name, namespace)
+    except NotFound:
+        return BUILTIN_ROLES.get(name, [])
+    return role.get("spec", {}).get("rules", [])
+
+
+def can_i(server: APIServer, user: str | None, verb: str, kind: str,
+          namespace: str | None = None,
+          groups: set[str] | None = None) -> bool:
+    """Evaluate whether ``user`` may ``verb`` ``kind`` in ``namespace``."""
+    if user is None:
+        return False
+    groups = groups or set()
+
+    bindings = []
+    bindings.extend(server.list("ClusterRoleBinding"))
+    if namespace is not None:
+        bindings.extend(server.list("RoleBinding", namespace=namespace))
+    for b in bindings:
+        if not _binding_subjects_match(b, user, groups):
+            continue
+        for rule in _role_rules(server, b["spec"].get("roleRef", {}),
+                                namespace):
+            if _rule_allows(rule, verb, kind):
+                return True
+    return False
+
+
+def ensure_authorized(server: APIServer, user: str | None, verb: str,
+                      kind: str, namespace: str | None = None) -> None:
+    """Raise PermissionError unless allowed (decorator-equivalent of
+    crud_backend/authz.py ensure_authorized)."""
+    if not can_i(server, user, verb, kind, namespace):
+        raise PermissionError(
+            f"user {user!r} is not authorized to {verb} {kind} "
+            f"in namespace {namespace!r}")
+
+
+def is_cluster_admin(server: APIServer, user: str | None) -> bool:
+    return can_i(server, user, WILDCARD, WILDCARD, None)

@@ -1,0 +1,3 @@
+from kubeflow_tpu.kfam.app import KfamApp
+
+__all__ = ["KfamApp"]

@@ -1,0 +1,232 @@
+"""KFAM — access management REST API (reference: components/access-management).
+
+Routes (kfam/routers.go:33-96):
+    POST   /kfam/v1/profiles                  self-serve namespace creation
+    DELETE /kfam/v1/profiles/{profile}
+    GET    /kfam/v1/bindings?namespace=       list contributors
+    POST   /kfam/v1/bindings                  add contributor
+    DELETE /kfam/v1/bindings                  remove contributor (body)
+    GET    /kfam/v1/role/clusteradmin         is the caller cluster admin
+    GET    /metrics | /healthz
+
+A binding materializes as a RoleBinding (name = sanitized
+``user-{kind}-{name}-role-{role}``, bindings.go:61-77) plus an
+AuthorizationPolicy admitting the user's identity header.  AuthZ model:
+profile owner or cluster admin may manage bindings (api_default.go:295-310).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from urllib.parse import parse_qs
+
+from kubeflow_tpu.api import profile as profile_api
+from kubeflow_tpu.core.rbac import is_cluster_admin
+from kubeflow_tpu.core.store import APIServer, Conflict, Invalid, NotFound
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+USERID_HEADER = "HTTP_X_GOOG_AUTHENTICATED_USER_EMAIL"
+USERID_PREFIX = "accounts.google.com:"
+
+# dashboard role <-> ClusterRole (bindings.go:39-46, api_workgroup.ts:40-48)
+ROLE_MAP = {"admin": "kubeflow-admin", "edit": "kubeflow-edit",
+            "view": "kubeflow-view"}
+ROLE_MAP_REV = {v: k for k, v in ROLE_MAP.items()}
+
+REQUESTS = REGISTRY.counter("kfam_requests_total", "KFAM requests",
+                            labels=("path", "code"))
+HEARTBEAT = REGISTRY.counter("kfam_heartbeat_total", "liveness heartbeats")
+
+log = get_logger("kfam")
+
+
+def binding_name(user: str, role: str) -> str:
+    import hashlib
+
+    raw = f"user-{user}-clusterrole-{ROLE_MAP[role]}"
+    sanitized = re.sub(r"[^a-z0-9\-]", "-", raw.lower()).strip("-")
+    # distinct users can sanitize to the same string; a digest of the raw
+    # identity keeps names collision-free
+    digest = hashlib.sha256(raw.encode()).hexdigest()[:8]
+    return f"{sanitized}-{digest}"
+
+
+class KfamApp:
+    def __init__(self, server: APIServer):
+        self.server = server
+
+    # -- WSGI -----------------------------------------------------------------
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "/").rstrip("/")
+        method = environ["REQUEST_METHOD"]
+        user = self._user(environ)
+        try:
+            status, body = self._route(method, path, environ, user)
+        except PermissionError as e:
+            status, body = "403 Forbidden", {"error": str(e)}
+        except NotFound as e:
+            status, body = "404 Not Found", {"error": str(e)}
+        except Conflict as e:
+            status, body = "409 Conflict", {"error": str(e)}
+        except (Invalid, ValueError, KeyError) as e:
+            status, body = "422 Unprocessable Entity", {"error": str(e)}
+        REQUESTS.labels(path, status.split()[0]).inc()
+        if isinstance(body, str):
+            payload = body.encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            payload = json.dumps(body).encode()
+            ctype = "application/json"
+        start_response(status, [("Content-Type", ctype),
+                                ("Content-Length", str(len(payload)))])
+        return [payload]
+
+    def _route(self, method, path, environ, user):
+        # when mounted under the platform front door, probes arrive as
+        # /kfam/healthz -- normalize both spellings
+        if path.startswith("/kfam/") and not path.startswith("/kfam/v1"):
+            path = path[len("/kfam"):]
+        if path == "/healthz":
+            HEARTBEAT.inc()
+            return "200 OK", {"status": "ok"}
+        if path == "/metrics":
+            return "200 OK", REGISTRY.expose()
+        if path == "/kfam/v1/role/clusteradmin" and method == "GET":
+            return "200 OK", is_cluster_admin(self.server, user)
+        if path == "/kfam/v1/profiles" and method == "POST":
+            return self._create_profile(environ, user)
+        m = re.fullmatch(r"/kfam/v1/profiles/([^/]+)", path)
+        if m and method == "DELETE":
+            return self._delete_profile(m.group(1), user)
+        if path == "/kfam/v1/bindings":
+            if method == "GET":
+                qs = parse_qs(environ.get("QUERY_STRING", ""))
+                namespace = qs.get("namespace", [None])[0]
+                if user is None:
+                    raise PermissionError("identity header required")
+                if namespace is None and not is_cluster_admin(self.server,
+                                                              user):
+                    raise PermissionError(
+                        "listing bindings across all namespaces requires "
+                        "cluster admin")
+                return self._list_bindings(namespace)
+            if method == "POST":
+                return self._create_binding(self._body(environ), user)
+            if method == "DELETE":
+                return self._delete_binding(self._body(environ), user)
+        raise NotFound(f"no route {method} {path}")
+
+    # -- profiles -------------------------------------------------------------
+    def _create_profile(self, environ, user):
+        body = self._body(environ)
+        name = body.get("metadata", {}).get("name") or body.get("name")
+        if not name:
+            raise Invalid("profile name required")
+        owner = (body.get("spec", {}).get("owner", {}).get("name")
+                 or user)
+        if user is None:
+            raise PermissionError("identity header required")
+        # self-serve: you may only create a profile owned by yourself unless
+        # cluster admin
+        if owner != user and not is_cluster_admin(self.server, user):
+            raise PermissionError(
+                f"{user} may not create a profile for {owner}")
+        profile = profile_api.new(name, owner,
+                                  tpu_quota=body.get("tpuQuota"))
+        created = self.server.create(profile)
+        log.info("profile created", name=name, owner=owner)
+        return "201 Created", created
+
+    def _delete_profile(self, name, user):
+        profile = self.server.get(profile_api.KIND, name)
+        self._require_owner_or_admin(profile, user)
+        self.server.delete(profile_api.KIND, name)
+        return "200 OK", {"status": "deleted"}
+
+    # -- bindings -------------------------------------------------------------
+    def _create_binding(self, body, user):
+        ns = body["referredNamespace"]
+        target = body["user"]["name"]
+        role = ROLE_MAP_REV.get(body.get("roleRef", {}).get("name"),
+                                body.get("roleRef", {}).get("name", "edit"))
+        if role not in ROLE_MAP:
+            raise Invalid(f"unknown role {role!r}")
+        profile = self.server.get(profile_api.KIND, ns)
+        self._require_owner_or_admin(profile, user)
+
+        from kubeflow_tpu.core.objects import api_object
+
+        rb = api_object("RoleBinding", binding_name(target, role), ns, spec={
+            "subjects": [{"kind": "User", "name": target}],
+            "roleRef": {"kind": "ClusterRole", "name": ROLE_MAP[role]},
+        }, annotations={"user": target, "role": role})
+        try:
+            self.server.create(rb)
+        except Conflict:
+            pass  # idempotent add
+        pol = api_object("AuthorizationPolicy",
+                         f"user-{binding_name(target, role)}", ns, spec={
+                             "action": "ALLOW",
+                             "rules": [{"when": [{
+                                 "key": "request.headers"
+                                        "[x-goog-authenticated-user-email]",
+                                 "values": [USERID_PREFIX + target]}]}]})
+        try:
+            self.server.create(pol)
+        except Conflict:
+            pass
+        log.info("binding created", namespace=ns, user=target, role=role)
+        return "201 Created", {"status": "created"}
+
+    def _delete_binding(self, body, user):
+        ns = body["referredNamespace"]
+        target = body["user"]["name"]
+        role = ROLE_MAP_REV.get(body.get("roleRef", {}).get("name"),
+                                body.get("roleRef", {}).get("name", "edit"))
+        profile = self.server.get(profile_api.KIND, ns)
+        self._require_owner_or_admin(profile, user)
+        for kind, name in (("RoleBinding", binding_name(target, role)),
+                           ("AuthorizationPolicy",
+                            f"user-{binding_name(target, role)}")):
+            try:
+                self.server.delete(kind, name, ns)
+            except NotFound:
+                pass
+        return "200 OK", {"status": "deleted"}
+
+    def _list_bindings(self, namespace):
+        out = []
+        for rb in self.server.list("RoleBinding", namespace=namespace):
+            ann = rb["metadata"].get("annotations", {})
+            if "user" not in ann:
+                continue  # not a KFAM-managed binding
+            out.append({
+                "user": {"kind": "User", "name": ann["user"]},
+                "referredNamespace": rb["metadata"]["namespace"],
+                "roleRef": rb["spec"]["roleRef"],
+            })
+        return "200 OK", {"bindings": out}
+
+    # -- helpers --------------------------------------------------------------
+    def _require_owner_or_admin(self, profile, user):
+        if user is None:
+            raise PermissionError("identity header required")
+        if profile_api.owner_of(profile) == user:
+            return
+        if is_cluster_admin(self.server, user):
+            return
+        raise PermissionError(
+            f"{user} is neither owner of {profile['metadata']['name']} "
+            "nor cluster admin")
+
+    def _user(self, environ):
+        raw = environ.get(USERID_HEADER)
+        if raw and raw.startswith(USERID_PREFIX):
+            return raw[len(USERID_PREFIX):]
+        return raw
+
+    def _body(self, environ):
+        length = int(environ.get("CONTENT_LENGTH") or 0)
+        return json.loads(environ["wsgi.input"].read(length) or b"{}")

@@ -86,6 +86,49 @@ def _register_optional(server, mgr, enable: set[str] | None) -> None:
         reg(server, mgr)
 
 
+def build_wsgi_app(server, *, secure_api: bool = True):
+    """One HTTP front door: /apis (REST), /kfam (access management),
+    /apply-poddefault (webhook), plus whatever web apps have landed.
+
+    With ``secure_api`` (default) the raw /apis routes enforce RBAC for the
+    identity-header user — otherwise the KFAM/webapp authz models would be
+    bypassable by raw writes on the same listener.
+    """
+    from kubeflow_tpu.admission.webhook import WebhookApp
+    from kubeflow_tpu.core.rbac import ensure_authorized
+    from kubeflow_tpu.kfam import KfamApp
+
+    def rbac_authorize(user, verb, kind, namespace):
+        if user is None:
+            raise PermissionError("identity header required for /apis")
+        ensure_authorized(server, user, verb, kind, namespace)
+
+    rest = RestAPI(server, authorize=rbac_authorize if secure_api else None)
+    mounts = {"/kfam": KfamApp(server),
+              "/apply-poddefault": WebhookApp(server)}
+    try:
+        from kubeflow_tpu.webapps import mount_all
+
+        mounts.update(mount_all(server))
+    except ImportError:
+        pass
+    try:
+        from kubeflow_tpu.dashboard import mount as dash_mount
+
+        mounts.update(dash_mount(server))
+    except ImportError:
+        pass
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        for prefix, handler in mounts.items():
+            if path == prefix or path.startswith(prefix + "/"):
+                return handler(environ, start_response)
+        return rest(environ, start_response)
+
+    return app
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser("kubeflow_tpu.platform")
     parser.add_argument("--port", type=int, default=8134)
@@ -93,13 +136,29 @@ def main(argv=None) -> int:
     parser.add_argument("--executor", choices=["fake", "local", "none"],
                         default="local")
     parser.add_argument("--leader-election", action="store_true")
+    parser.add_argument("--insecure-api", action="store_true",
+                        help="disable RBAC on raw /apis routes (dev only)")
+    parser.add_argument("--bootstrap-admin", metavar="EMAIL",
+                        help="grant cluster-admin to this user at startup")
     args = parser.parse_args(argv)
 
     log = get_logger("platform")
     server, mgr = build_platform(executor=args.executor,
                                  leader_election=args.leader_election)
+    if args.bootstrap_admin:
+        from kubeflow_tpu.core import api_object
+        from kubeflow_tpu.core.rbac import ensure_builtin_roles
+
+        ensure_builtin_roles(server)
+        server.create(api_object(
+            "ClusterRoleBinding", "bootstrap-admin", spec={
+                "subjects": [{"kind": "User", "name": args.bootstrap_admin}],
+                "roleRef": {"kind": "ClusterRole",
+                            "name": "kubeflow-admin"}}))
     mgr.start()
-    httpd, _ = serve(RestAPI(server), args.port, args.host)
+    httpd, _ = serve(build_wsgi_app(server,
+                                    secure_api=not args.insecure_api),
+                     args.port, args.host)
     log.info("platform ready", port=args.port, executor=args.executor)
     print(f"kubeflow-tpu platform listening on "
           f"http://{args.host}:{args.port}", flush=True)

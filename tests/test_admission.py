@@ -1,0 +1,84 @@
+"""PodDefault admission: in-process hook + webhook endpoint."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.admission.webhook import WebhookApp, register
+from kubeflow_tpu.api import poddefault
+from kubeflow_tpu.core import APIServer, api_object
+from kubeflow_tpu.core.httpapi import serve
+from kubeflow_tpu.core.store import Invalid
+
+
+@pytest.fixture()
+def server():
+    s = APIServer()
+    register(s)
+    s.create(poddefault.new(
+        "tpu-credentials", "ml",
+        selector={"matchLabels": {"inject-tpu-creds": "true"}},
+        env=[{"name": "GOOGLE_APPLICATION_CREDENTIALS",
+              "value": "/secrets/sa.json"}],
+        volumes=[{"name": "sa", "secret": {"secretName": "tpu-sa"}}],
+        volume_mounts=[{"name": "sa", "mountPath": "/secrets"}]))
+    return s
+
+
+def make_pod(name="p", labels=None, annotations=None):
+    return api_object("Pod", name, "ml", labels=labels or {},
+                      annotations=annotations,
+                      spec={"containers": [{"name": "main"}]})
+
+
+def test_matching_pod_mutated_on_create(server):
+    pod = server.create(make_pod(labels={"inject-tpu-creds": "true"}))
+    c = pod["spec"]["containers"][0]
+    assert c["env"][0]["name"] == "GOOGLE_APPLICATION_CREDENTIALS"
+    assert c["volumeMounts"][0]["mountPath"] == "/secrets"
+    assert pod["spec"]["volumes"][0]["name"] == "sa"
+    anns = pod["metadata"]["annotations"]
+    assert any("poddefault-tpu-credentials" in k for k in anns)
+
+
+def test_non_matching_pod_untouched(server):
+    pod = server.create(make_pod(name="plain"))
+    assert "env" not in pod["spec"]["containers"][0]
+
+
+def test_excluded_pod_untouched(server):
+    pod = server.create(make_pod(
+        name="excluded", labels={"inject-tpu-creds": "true"},
+        annotations={poddefault.EXCLUDE_ANNOTATION: "true"}))
+    assert "env" not in pod["spec"]["containers"][0]
+
+
+def test_conflict_rejects_pod(server):
+    server.create(poddefault.new(
+        "conflicting", "ml",
+        selector={"matchLabels": {"inject-tpu-creds": "true"}},
+        env=[{"name": "GOOGLE_APPLICATION_CREDENTIALS",
+              "value": "/other/path.json"}]))
+    with pytest.raises(Invalid, match="conflict"):
+        server.create(make_pod(name="c",
+                               labels={"inject-tpu-creds": "true"}))
+
+
+def test_webhook_http_endpoint(server):
+    httpd, _ = serve(WebhookApp(server), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    review = {"request": {"object": {
+        "metadata": {"name": "p", "namespace": "ml",
+                     "labels": {"inject-tpu-creds": "true"}},
+        "spec": {"containers": [{"name": "main"}]}}}}
+    r = urllib.request.Request(f"{base}/apply-poddefault",
+                               data=json.dumps(review).encode(),
+                               method="POST")
+    with urllib.request.urlopen(r) as resp:
+        out = json.loads(resp.read())
+    assert out["response"]["allowed"] is True
+    env = out["response"]["patched"]["spec"]["containers"][0]["env"]
+    assert env[0]["name"] == "GOOGLE_APPLICATION_CREDENTIALS"
+    httpd.shutdown()

@@ -1,0 +1,162 @@
+"""Notebook controller: materialization, stop/start, culling, admission."""
+
+import datetime as dt
+
+import pytest
+
+from kubeflow_tpu.api import notebook as api
+from kubeflow_tpu.api import poddefault
+from kubeflow_tpu.controllers.culler import Culler, CullerConfig
+from kubeflow_tpu.controllers.executor import FakeExecutor
+from kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from kubeflow_tpu.controllers import workloads
+from kubeflow_tpu.core import APIServer, Manager
+from kubeflow_tpu.core.store import NotFound
+
+
+def make_harness(culler=None):
+    server = APIServer()
+    mgr = Manager(server)
+    mgr.add(NotebookController(server, culler=culler))
+    workloads.register(server, mgr)
+    mgr.add(FakeExecutor(server, complete=False))
+    mgr.start()
+    return server, mgr
+
+
+def test_notebook_materializes_and_becomes_ready():
+    server, mgr = make_harness()
+    try:
+        server.create(api.new("my-nb", "team", image="jax-notebook:v1",
+                              tpu_resource="cloud-tpu.google.com/v5e",
+                              tpu_chips=4, workspace_pvc="ws"))
+        assert mgr.wait_idle(timeout=15)
+        sts = server.get("StatefulSet", "my-nb", "team")
+        c0 = sts["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in c0["env"]}
+        assert env["NB_PREFIX"] == "/notebook/team/my-nb"
+        assert c0["resources"]["limits"]["cloud-tpu.google.com/v5e"] == 4
+        assert c0["ports"][0]["containerPort"] == 8888
+        svc = server.get("Service", "my-nb", "team")
+        assert svc["spec"]["ports"][0]["targetPort"] == 8888
+        vs = server.get("VirtualService", "notebook-my-nb", "team")
+        assert (vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+                == "/notebook/team/my-nb/")
+        assert vs["spec"]["http"][0]["timeout"] == "300s"
+        pod = server.get("Pod", "my-nb-0", "team")
+        assert pod["status"]["phase"] == "Running"
+        nb = server.get(api.KIND, "my-nb", "team")
+        assert nb["status"]["readyReplicas"] == 1
+        assert nb["status"]["containerState"] == {"running": {}}
+    finally:
+        mgr.stop()
+
+
+def test_stop_annotation_scales_to_zero_and_back():
+    server, mgr = make_harness()
+    try:
+        server.create(api.new("nb", "team", image="img"))
+        assert mgr.wait_idle(timeout=15)
+        nb = server.get(api.KIND, "nb", "team")
+        nb["metadata"].setdefault("annotations", {})[
+            api.STOP_ANNOTATION] = "2026-07-28T00:00:00Z"
+        server.update(nb)
+        assert mgr.wait_idle(timeout=15)
+        sts = server.get("StatefulSet", "nb", "team")
+        assert sts["spec"]["replicas"] == 0
+        with pytest.raises(NotFound):
+            server.get("Pod", "nb-0", "team")
+        nb = server.get(api.KIND, "nb", "team")
+        assert nb["status"]["readyReplicas"] == 0
+        # restart: remove the annotation (jupyter patch.py:44-80)
+        del nb["metadata"]["annotations"][api.STOP_ANNOTATION]
+        server.update(nb)
+        assert mgr.wait_idle(timeout=15)
+        assert server.get("StatefulSet", "nb", "team")["spec"]["replicas"] == 1
+        assert server.get("Pod", "nb-0", "team")
+    finally:
+        mgr.stop()
+
+
+def test_idle_notebook_gets_culled():
+    now = dt.datetime(2026, 7, 28, 12, 0, tzinfo=dt.timezone.utc)
+    stale = now - dt.timedelta(hours=30)
+    culler = Culler(
+        CullerConfig(enable_culling=True, idle_time_min=1440,
+                     check_period_min=1),
+        probe=lambda nb: stale, now=lambda: now)
+    # make the culling check cadence test-fast
+    server, mgr = make_harness(culler=culler)
+    culler.cfg = CullerConfig(enable_culling=True, idle_time_min=1440,
+                              check_period_min=1)
+    try:
+        server.create(api.new("idle-nb", "team", image="img"))
+        import time
+
+        deadline = time.monotonic() + 10
+        culled = False
+        while time.monotonic() < deadline:
+            nb = server.get(api.KIND, "idle-nb", "team")
+            if api.STOP_ANNOTATION in nb["metadata"].get("annotations", {}):
+                culled = True
+                break
+            time.sleep(0.05)
+        assert culled, "notebook was not culled"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if server.get("StatefulSet", "idle-nb",
+                          "team")["spec"]["replicas"] == 0:
+                break
+            time.sleep(0.05)
+        assert server.get("StatefulSet", "idle-nb",
+                          "team")["spec"]["replicas"] == 0
+    finally:
+        mgr.stop()
+
+
+def test_active_notebook_not_culled():
+    now = dt.datetime(2026, 7, 28, 12, 0, tzinfo=dt.timezone.utc)
+    culler = Culler(
+        CullerConfig(enable_culling=True, idle_time_min=1440),
+        probe=lambda nb: now - dt.timedelta(minutes=5), now=lambda: now)
+    server, mgr = make_harness(culler=culler)
+    try:
+        server.create(api.new("busy-nb", "team", image="img"))
+        import time
+
+        time.sleep(1.0)
+        nb = server.get(api.KIND, "busy-nb", "team")
+        assert api.STOP_ANNOTATION not in nb["metadata"].get(
+            "annotations", {})
+    finally:
+        mgr.stop()
+
+
+def test_notebook_pod_gets_poddefaults():
+    """The L2/L2' seam: STS pods pass through admission on materialization."""
+    from kubeflow_tpu.admission.webhook import register as register_admission
+
+    server = APIServer()
+    register_admission(server)
+    mgr = Manager(server)
+    mgr.add(NotebookController(server))
+    workloads.register(server, mgr)
+    mgr.add(FakeExecutor(server, complete=False))
+    mgr.start()
+    try:
+        server.create(poddefault.new(
+            "tpu-env", "team",
+            selector={"matchLabels": {"notebook-name": "nb"}},
+            env=[{"name": "TPU_ML_PLATFORM", "value": "kubeflow-tpu"}]))
+        server.create(api.new("nb", "team", image="img"))
+        assert mgr.wait_idle(timeout=15)
+        pod = server.get("Pod", "nb-0", "team")
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["TPU_ML_PLATFORM"] == "kubeflow-tpu"
+        assert env["NB_PREFIX"] == "/notebook/team/nb"
+    finally:
+        mgr.stop()

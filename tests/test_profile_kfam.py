@@ -1,0 +1,180 @@
+"""Profile controller + RBAC + KFAM integration (reference: profiles_test.py
+e2e pattern — create profile, assert namespace/SA/rolebindings, delete,
+assert GC)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api import profile as profile_api
+from kubeflow_tpu.controllers.profile import ProfileController, register
+from kubeflow_tpu.core import APIServer, Manager
+from kubeflow_tpu.core.httpapi import serve
+from kubeflow_tpu.core.rbac import can_i, ensure_builtin_roles
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.kfam import KfamApp
+
+
+@pytest.fixture()
+def harness():
+    server = APIServer()
+    mgr = Manager(server)
+    register(server, mgr)
+    mgr.start()
+    yield server, mgr
+    mgr.stop()
+
+
+def test_profile_materializes_tenancy(harness):
+    server, mgr = harness
+    server.create(profile_api.new(
+        "team-ml", "alice@corp.com",
+        tpu_quota={"cloud-tpu.google.com/v5e": 32}))
+    assert mgr.wait_idle()
+
+    ns = server.get("Namespace", "team-ml")
+    assert ns["metadata"]["annotations"]["owner"] == "alice@corp.com"
+    assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+    for sa in ("default-editor", "default-viewer"):
+        assert server.get("ServiceAccount", sa, "team-ml")
+    rb = server.get("RoleBinding", "namespaceAdmin", "team-ml")
+    assert rb["spec"]["roleRef"]["name"] == "kubeflow-admin"
+    quota = server.get("ResourceQuota", "kf-resource-quota", "team-ml")
+    assert quota["spec"]["hard"]["cloud-tpu.google.com/v5e"] == 32
+    pol = server.get("AuthorizationPolicy", "ns-owner-access-istio",
+                     "team-ml")
+    assert "alice@corp.com" in json.dumps(pol["spec"])
+    prof = server.get(profile_api.KIND, "team-ml")
+    assert prof["status"]["conditions"][0]["status"] == "True"
+
+    # RBAC: owner is namespace admin
+    assert can_i(server, "alice@corp.com", "delete", "Notebook", "team-ml")
+    assert not can_i(server, "mallory@corp.com", "get", "Notebook", "team-ml")
+
+
+def test_profile_delete_gcs_children(harness):
+    server, mgr = harness
+    server.create(profile_api.new("team-x", "bob@corp.com"))
+    assert mgr.wait_idle()
+    server.delete(profile_api.KIND, "team-x")
+    assert mgr.wait_idle()
+    for kind, name in [("Namespace", "team-x"),
+                       ("Profile", "team-x")]:
+        with pytest.raises(NotFound):
+            server.get(kind, name)
+
+
+def test_namespace_ownership_conflict(harness):
+    server, mgr = harness
+    server.create({"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": "stolen",
+                                "annotations": {"owner": "someone@else.com"}},
+                   "spec": {}})
+    server.create(profile_api.new("stolen", "alice@corp.com"))
+    assert mgr.wait_idle()
+    prof = server.get(profile_api.KIND, "stolen")
+    cond = prof["status"]["conditions"][0]
+    assert cond["status"] == "False"
+    assert cond["reason"] == "NamespaceOwnedByOthers"
+
+
+def test_workload_identity_plugin(harness):
+    server, mgr = harness
+    p = profile_api.new("team-wi", "carol@corp.com", plugins=[
+        {"kind": "TpuWorkloadIdentity",
+         "spec": {"serviceAccount": "ml-sa@proj.iam.gserviceaccount.com"}}])
+    server.create(p)
+    assert mgr.wait_idle()
+    sa = server.get("ServiceAccount", "default-editor", "team-wi")
+    assert (sa["metadata"]["annotations"]["iam.gke.io/gcp-service-account"]
+            == "ml-sa@proj.iam.gserviceaccount.com")
+
+
+# -- KFAM over HTTP ------------------------------------------------------------
+
+
+@pytest.fixture()
+def kfam():
+    server = APIServer()
+    ensure_builtin_roles(server)
+    mgr = Manager(server)
+    register(server, mgr)
+    mgr.start()
+    httpd, _ = serve(KfamApp(server), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield server, mgr, base
+    httpd.shutdown()
+    mgr.stop()
+
+
+def kreq(base, path, method="GET", body=None, user=None):
+    headers = {}
+    if user:
+        headers["X-Goog-Authenticated-User-Email"] = (
+            "accounts.google.com:" + user)
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(base + path, data=data, method=method,
+                               headers=headers)
+    with urllib.request.urlopen(r) as resp:
+        return resp.status, json.loads(resp.read() or b"null")
+
+
+def test_kfam_self_serve_and_contributors(kfam):
+    server, mgr, base = kfam
+    # alice registers her own namespace
+    code, prof = kreq(base, "/kfam/v1/profiles", "POST",
+                      {"name": "alice"}, user="alice@corp.com")
+    assert code == 201 and prof["spec"]["owner"]["name"] == "alice@corp.com"
+    assert mgr.wait_idle()
+
+    # alice shares with bob as editor
+    body = {"user": {"kind": "User", "name": "bob@corp.com"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": "edit"}}
+    code, _ = kreq(base, "/kfam/v1/bindings", "POST", body,
+                   user="alice@corp.com")
+    assert code == 201
+    assert can_i(server, "bob@corp.com", "create", "Notebook", "alice")
+
+    code, listing = kreq(base, "/kfam/v1/bindings?namespace=alice",
+                         user="alice@corp.com")
+    assert listing["bindings"][0]["user"]["name"] == "bob@corp.com"
+
+    # mallory cannot share alice's namespace
+    body["user"]["name"] = "mallory@corp.com"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        kreq(base, "/kfam/v1/bindings", "POST", body, user="mallory@corp.com")
+    assert e.value.code == 403
+
+    # remove bob
+    body["user"]["name"] = "bob@corp.com"
+    code, _ = kreq(base, "/kfam/v1/bindings", "DELETE", body,
+                   user="alice@corp.com")
+    assert not can_i(server, "bob@corp.com", "create", "Notebook", "alice")
+
+
+def test_kfam_cannot_create_for_others(kfam):
+    _, _, base = kfam
+    with pytest.raises(urllib.error.HTTPError) as e:
+        kreq(base, "/kfam/v1/profiles", "POST",
+             {"name": "evil", "spec": {"owner": {"kind": "User",
+                                                 "name": "victim@corp.com"}}},
+             user="mallory@corp.com")
+    assert e.value.code == 403
+
+
+def test_kfam_clusteradmin_route(kfam):
+    server, _, base = kfam
+    from kubeflow_tpu.core.objects import api_object
+
+    server.create(api_object("ClusterRoleBinding", "root-admin", spec={
+        "subjects": [{"kind": "User", "name": "root@corp.com"}],
+        "roleRef": {"kind": "ClusterRole", "name": "kubeflow-admin"}}))
+    code, is_admin = kreq(base, "/kfam/v1/role/clusteradmin",
+                          user="root@corp.com")
+    assert is_admin is True
+    code, is_admin = kreq(base, "/kfam/v1/role/clusteradmin",
+                          user="pleb@corp.com")
+    assert is_admin is False

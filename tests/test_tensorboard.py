@@ -1,0 +1,81 @@
+"""Tensorboard controller: logspath handling, children, RWO co-scheduling."""
+
+import pytest
+
+from kubeflow_tpu.api import tensorboard as api
+from kubeflow_tpu.controllers.executor import FakeExecutor
+from kubeflow_tpu.controllers.tensorboard import TensorboardController
+from kubeflow_tpu.controllers import workloads
+from kubeflow_tpu.core import APIServer, Manager, api_object
+
+
+@pytest.fixture()
+def harness():
+    server = APIServer()
+    mgr = Manager(server)
+    mgr.add(TensorboardController(server))
+    workloads.register(server, mgr)
+    mgr.add(FakeExecutor(server, complete=False))
+    mgr.start()
+    yield server, mgr
+    mgr.stop()
+
+
+def test_parse_logspath():
+    p = api.parse_logspath("pvc://training-logs/bert/run1")
+    assert p == {"kind": "pvc", "claim": "training-logs",
+                 "subPath": "bert/run1",
+                 "logdir": "/tensorboard_logs/bert/run1"}
+    assert api.parse_logspath("gs://bucket/logs")["kind"] == "cloud"
+    assert api.parse_logspath("/local/path")["kind"] == "local"
+    with pytest.raises(ValueError):
+        api.parse_logspath("pvc://")
+
+
+def test_tensorboard_pvc_materializes(harness):
+    server, mgr = harness
+    server.create(api.new("tb", "team", "pvc://logs-pvc/run1"))
+    assert mgr.wait_idle(timeout=15)
+    dep = server.get("Deployment", "tb", "team")
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert "--logdir=/tensorboard_logs/run1" in c["command"]
+    assert (dep["spec"]["template"]["spec"]["volumes"][0]
+            ["persistentVolumeClaim"]["claimName"] == "logs-pvc")
+    svc = server.get("Service", "tb", "team")
+    assert svc["spec"]["ports"][0]["targetPort"] == 6006
+    vs = server.get("VirtualService", "tensorboard-tb", "team")
+    assert (vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+            == "/tensorboard/team/tb/")
+    tb = server.get(api.KIND, "tb", "team")
+    assert tb["status"]["readyReplicas"] == 1
+
+
+def test_tensorboard_cloud_logspath_mounts_credentials(harness):
+    server, mgr = harness
+    server.create(api.new("tb-gs", "team", "gs://bucket/experiments"))
+    assert mgr.wait_idle(timeout=15)
+    dep = server.get("Deployment", "tb-gs", "team")
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert "--logdir=gs://bucket/experiments" in c["command"]
+    vols = dep["spec"]["template"]["spec"]["volumes"]
+    assert vols[0]["secret"]["secretName"] == "user-gcp-sa"
+
+
+def test_rwo_pvc_coscheduling(harness):
+    server, mgr = harness
+    server.create(api_object("PersistentVolumeClaim", "rwo-logs", "team",
+                             spec={"accessModes": ["ReadWriteOnce"]}))
+    writer = api_object("Pod", "trainer-0", "team", spec={
+        "nodeName": "tpu-host-7",
+        "containers": [{"name": "t"}],
+        "volumes": [{"name": "l", "persistentVolumeClaim":
+                     {"claimName": "rwo-logs"}}]})
+    server.create(writer)
+    server.patch_status("Pod", "trainer-0", "team", {"phase": "Running"})
+    server.create(api.new("tb-rwo", "team", "pvc://rwo-logs/"))
+    assert mgr.wait_idle(timeout=15)
+    dep = server.get("Deployment", "tb-rwo", "team")
+    aff = dep["spec"]["template"]["spec"]["affinity"]["nodeAffinity"]
+    pref = aff["preferredDuringSchedulingIgnoredDuringExecution"][0]
+    assert pref["preference"]["matchExpressions"][0]["values"] == [
+        "tpu-host-7"]
