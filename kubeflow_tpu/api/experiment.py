@@ -1,0 +1,89 @@
+"""Experiment / Trial resources (the Katib CRD equivalents).
+
+spec:
+  objective: {type: maximize|minimize, metric: "final_loss"|...,
+              goal: optional float}
+  algorithm: {name: random|grid|bayesian, seed}
+  parameters: [{name, type, min, max, step, values, logScale}]
+  trialTemplate:
+    topology: slice type (trials gang onto PREEMPTIBLE slices)
+    trainer: TrainerConfig dict; "${param}" placeholders substitute
+             assignments (model_config/optimizer fields)
+  parallelTrials, maxTrials, maxFailedTrials
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.hpo.search_space import SearchSpace
+
+KIND = "Experiment"
+TRIAL_KIND = "Trial"
+
+
+def new(name: str, namespace: str, *, objective: dict | None = None,
+        algorithm: dict | None = None, parameters: list[dict] | None = None,
+        trial_template: dict | None = None, parallel_trials: int = 2,
+        max_trials: int = 8, max_failed_trials: int = 3) -> dict:
+    return api_object(KIND, name, namespace, spec={
+        "objective": objective or {"type": "minimize",
+                                   "metric": "final_loss"},
+        "algorithm": algorithm or {"name": "bayesian"},
+        "parameters": parameters or [],
+        "trialTemplate": trial_template or {},
+        "parallelTrials": parallel_trials,
+        "maxTrials": max_trials,
+        "maxFailedTrials": max_failed_trials,
+    })
+
+
+def validate(exp: dict) -> None:
+    spec = exp.get("spec", {})
+    if spec.get("objective", {}).get("type") not in ("maximize", "minimize"):
+        raise ValueError("objective.type must be maximize|minimize")
+    SearchSpace(spec.get("parameters", []))  # validates each parameter
+    from kubeflow_tpu.hpo.suggestion import ALGORITHMS
+
+    algo = spec.get("algorithm", {}).get("name", "random")
+    if algo not in ALGORITHMS:
+        raise ValueError(f"unknown algorithm {algo!r}")
+
+
+def substitute(template: Any, assignment: dict[str, Any]) -> Any:
+    """Replace "${name}" placeholders anywhere in the template; a value that
+    is exactly a placeholder keeps the parameter's native type."""
+    if isinstance(template, dict):
+        return {k: substitute(v, assignment) for k, v in template.items()}
+    if isinstance(template, list):
+        return [substitute(v, assignment) for v in template]
+    if isinstance(template, str):
+        for name, value in assignment.items():
+            token = "${" + name + "}"
+            if template == token:
+                return value
+            if token in template:
+                template = template.replace(token, str(value))
+        return template
+    return template
+
+
+def trial_name(exp_name: str, index: int) -> str:
+    return f"{exp_name}-trial-{index}"
+
+
+def new_trial(exp: dict, index: int, assignment: dict[str, Any]) -> dict:
+    spec = exp["spec"]
+    template = copy.deepcopy(spec.get("trialTemplate", {}))
+    trainer = substitute(template.get("trainer", {}), assignment)
+    return api_object(TRIAL_KIND, trial_name(exp["metadata"]["name"], index),
+                      exp["metadata"]["namespace"], spec={
+        "experiment": exp["metadata"]["name"],
+        "index": index,
+        "assignment": assignment,
+        "topology": template.get("topology", "v5e-1"),
+        "trainer": trainer,
+        "objectiveMetric": spec["objective"]["metric"],
+    })

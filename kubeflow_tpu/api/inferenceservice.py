@@ -1,0 +1,39 @@
+"""InferenceService: a served model (the KServe CRD equivalent).
+
+spec.predictor: {model: registry key, size, modelConfig, checkpointDir,
+topology (single-host slice for the predictor pod), minReplicas}.
+status: url, ready, conditions.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.parallel.mesh import TOPOLOGIES
+
+KIND = "InferenceService"
+PORT = 8602
+
+
+def new(name: str, namespace: str, *, model: str = "llama",
+        size: str = "tiny", topology: str = "v5e-4",
+        model_config: dict | None = None,
+        checkpoint_dir: str | None = None, min_replicas: int = 1) -> dict:
+    return api_object(KIND, name, namespace, spec={
+        "predictor": {
+            "model": model,
+            "size": size,
+            "modelConfig": model_config or {},
+            "checkpointDir": checkpoint_dir,
+            "topology": topology,
+            "minReplicas": min_replicas,
+        }})
+
+
+def validate(isvc: dict) -> None:
+    pred = isvc.get("spec", {}).get("predictor", {})
+    topo = pred.get("topology", "v5e-4")
+    if topo not in TOPOLOGIES:
+        raise ValueError(f"unknown topology {topo!r}")
+    if TOPOLOGIES[topo].hosts != 1:
+        raise ValueError("predictors run on single-host slices; shard "
+                         "bigger models with tp over in-host chips")

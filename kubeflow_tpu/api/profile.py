@@ -37,12 +37,19 @@ def new(name: str, owner_email: str, *,
     })
 
 
+# namespaces the platform itself occupies; profiles may not claim them
+RESERVED_NAMESPACES = {"default", "kube-system", "kube-public", "kubeflow",
+                       "istio-system"}
+
+
 def validate(profile: dict) -> None:
+    name = profile.get("metadata", {}).get("name", "")
+    if name in RESERVED_NAMESPACES:
+        raise ValueError(f"Profile name {name!r} is reserved")
     owner = profile.get("spec", {}).get("owner", {})
     if owner.get("kind") != "User" or not owner.get("name"):
         raise ValueError(
-            f"Profile {profile['metadata'].get('name')}: spec.owner must be "
-            "a User subject with a name")
+            f"Profile {name}: spec.owner must be a User subject with a name")
 
 
 def owner_of(profile: dict) -> str:

@@ -1,0 +1,121 @@
+"""InferenceService controller: predictor Deployment + Service + route.
+
+Mirrors the KServe integration point the reference only labels namespaces
+for (profile_controller.go:70): here the predictor runtime is in-tree
+(serving.predictor), so an InferenceService materializes fully.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import inferenceservice as api
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.native import ENGINE
+from kubeflow_tpu.core.objects import api_object, set_condition, set_owner
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.parallel.mesh import TOPOLOGIES
+
+
+class InferenceServiceController(Controller):
+    kind = api.KIND
+    owns = ("Deployment", "Service", "VirtualService")
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            isvc = self.server.get(api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if isvc["metadata"].get("deletionTimestamp"):
+            return None
+        api.validate(isvc)
+        self._ensure_deployment(isvc)
+        self._ensure_service(isvc)
+        self._ensure_route(isvc)
+        self._mirror_status(isvc)
+        return None
+
+    def _ensure_deployment(self, isvc: dict) -> None:
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"]["namespace"]
+        pred = isvc["spec"]["predictor"]
+        topo = TOPOLOGIES[pred.get("topology", "v5e-4")]
+        args = ["--model", pred.get("model", "llama"),
+                "--size", pred.get("size", "tiny"),
+                "--port", str(api.PORT)]
+        if pred.get("checkpointDir"):
+            args += ["--checkpoint-dir", pred["checkpointDir"]]
+        container = {
+            "name": "predictor",
+            "image": pred.get("image", "kubeflow-tpu/predictor:latest"),
+            "command": ["python", "-m", "kubeflow_tpu.serving.predictor"]
+            + args,
+            "ports": [{"containerPort": api.PORT}],
+            "resources": {"limits": {topo.resource_name: topo.chips}},
+        }
+        desired = set_owner(api_object("Deployment", name, ns, spec={
+            "replicas": int(pred.get("minReplicas", 1)),
+            "selector": {"matchLabels": {"isvc": name}},
+            "template": {"metadata": {"labels": {"isvc": name}},
+                         "spec": {"containers": [container],
+                                  "nodeSelector": {
+                                      "cloud-tpu.google.com/slice":
+                                      topo.name}}},
+        }), isvc)
+        try:
+            live = self.server.get("Deployment", name, ns)
+            merged, changed = ENGINE.reconcile_merge(live, desired)
+            if changed:
+                self.server.update(merged)
+        except NotFound:
+            self.server.create(desired)
+
+    def _ensure_service(self, isvc: dict) -> None:
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"]["namespace"]
+        try:
+            self.server.get("Service", name, ns)
+        except NotFound:
+            self.server.create(set_owner(api_object("Service", name, ns,
+                                                    spec={
+                "selector": {"isvc": name},
+                "ports": [{"port": 80, "targetPort": api.PORT}],
+            }), isvc))
+
+    def _ensure_route(self, isvc: dict) -> None:
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"]["namespace"]
+        try:
+            self.server.get("VirtualService", f"isvc-{name}", ns)
+        except NotFound:
+            self.server.create(set_owner(api_object(
+                "VirtualService", f"isvc-{name}", ns, spec={
+                    "hosts": ["*"],
+                    "gateways": ["kubeflow/kubeflow-gateway"],
+                    "http": [{"match": [{"uri": {"prefix":
+                                                 f"/models/{ns}/{name}/"}}],
+                              "rewrite": {"uri": "/"},
+                              "route": [{"destination": {
+                                  "host": f"{name}.{ns}.svc",
+                                  "port": {"number": 80}}}],
+                              "timeout": "300s"}],
+                }), isvc))
+
+    def _mirror_status(self, isvc: dict) -> None:
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"]["namespace"]
+        ready = 0
+        try:
+            dep = self.server.get("Deployment", name, ns)
+            ready = dep.get("status", {}).get("readyReplicas", 0)
+        except NotFound:
+            pass
+        set_condition(isvc, "Ready", "True" if ready else "False")
+        self.server.patch_status(api.KIND, name, ns, {
+            "ready": bool(ready),
+            "url": f"/models/{ns}/{name}/",
+            "conditions": isvc["status"]["conditions"]})
+
+
+def register(server, mgr) -> None:
+    server.register_validating_hook(
+        lambda o: api.validate(o) if o.get("kind") == api.KIND else None)
+    mgr.add(InferenceServiceController(server))

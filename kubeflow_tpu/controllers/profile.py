@@ -93,14 +93,19 @@ class ProfileController(Controller):
             fins.append(api.FINALIZER)
             profile = self.server.update(profile)
 
-        # 1. namespace (adopt or create; foreign owner -> Failed condition)
+        # 1. namespace (create, or adopt only with a MATCHING owner
+        # annotation — adopting un-annotated namespaces would let self-serve
+        # profile creation seize pre-existing namespaces)
         try:
             ns = self.server.get("Namespace", name)
             ns_owner = ns["metadata"].get("annotations", {}).get("owner")
-            if ns_owner and ns_owner != owner:
+            ours = any(r.get("uid") == profile["metadata"]["uid"]
+                       for r in ns["metadata"].get("ownerReferences", []))
+            if ns_owner != owner and not ours:
                 set_condition(profile, "Ready", "False",
                               reason="NamespaceOwnedByOthers",
-                              message=f"namespace owned by {ns_owner}")
+                              message=f"namespace owned by "
+                                      f"{ns_owner or 'the cluster'}")
                 self.server.patch_status(api.KIND, name, None,
                                          profile["status"])
                 return None

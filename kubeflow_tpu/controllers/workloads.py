@@ -54,20 +54,17 @@ class _TemplateWorkloadController(Controller):
         by_name = {p["metadata"]["name"]: p for p in pods}
 
         want_names = [self._pod_name(req.name, i) for i in range(replicas)]
+        admission_failure: str | None = None
         for name in want_names:
             if name not in by_name:
                 try:
                     self.server.create(
                         _pod_from_template(obj, name, template))
                 except (Conflict, Invalid) as e:
-                    # admission rejection surfaces on workload status
-                    self.server.patch_status(
-                        self.kind, req.name, req.namespace,
-                        {**obj.get("status", {}),
-                         "conditions": [{"type": "ReplicaFailure",
-                                         "status": "True",
-                                         "message": str(e)}]})
-                    return None
+                    # admission rejection: surface it, keep reconciling, and
+                    # retry periodically (the conflicting PodDefault may be
+                    # removed and nothing else would requeue us)
+                    admission_failure = str(e)
         for name, pod in by_name.items():
             if name not in want_names:
                 try:
@@ -83,6 +80,10 @@ class _TemplateWorkloadController(Controller):
             "readyReplicas": ready,
             "availableReplicas": ready,
         }
+        if admission_failure is not None:
+            status["conditions"] = [{"type": "ReplicaFailure",
+                                     "status": "True",
+                                     "message": admission_failure}]
         # surface the first pod's container state (notebook status source)
         first = by_name.get(want_names[0]) if want_names else None
         if first is not None:
@@ -91,6 +92,8 @@ class _TemplateWorkloadController(Controller):
             if first.get("status", {}).get("message"):
                 status["podMessage"] = first["status"]["message"]
         self.server.patch_status(self.kind, req.name, req.namespace, status)
+        if admission_failure is not None:
+            return Result(requeue_after=2.0)
         return None
 
 

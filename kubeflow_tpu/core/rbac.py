@@ -31,9 +31,13 @@ BUILTIN_ROLES = {
                    "JAXJob", "Experiment", "PodDefault", "Pod", "Event",
                    "Secret", "ConfigMap", "InferenceService"]},
     ],
+    # view enumerates kinds (NOT a wildcard): a view-only contributor must
+    # not read Secrets
     "kubeflow-view": [
         {"verbs": ["get", "list"],
-         "kinds": [WILDCARD]},
+         "kinds": ["Notebook", "Tensorboard", "PersistentVolumeClaim",
+                   "JAXJob", "Experiment", "Trial", "PodDefault", "Pod",
+                   "Event", "ConfigMap", "InferenceService"]},
     ],
 }
 

@@ -1,0 +1,9 @@
+from kubeflow_tpu.dashboard.app import DashboardApp
+
+
+def mount(server) -> dict:
+    app = DashboardApp(server)
+    return {"/dashboard": app, "/ui": app}
+
+
+__all__ = ["DashboardApp", "mount"]

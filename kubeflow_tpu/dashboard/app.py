@@ -1,0 +1,223 @@
+"""Central dashboard server (reference: centraldashboard app/server.ts).
+
+API surface (api.ts:29-102 + api_workgroup.ts:116-386):
+    GET  /dashboard/api/namespaces            namespaces visible to the user
+    GET  /dashboard/api/activities/<ns>       event feed
+    GET  /dashboard/api/metrics/<type>?interval=Last15m
+    GET  /dashboard/api/dashboard-links       from ConfigMap
+    GET  /dashboard/api/dashboard-settings
+    GET  /dashboard/api/workgroup/exists      self-registration check
+    POST /dashboard/api/workgroup/create
+    POST /dashboard/api/workgroup/add-contributor
+    POST /dashboard/api/workgroup/remove-contributor
+    GET  /dashboard/api/workgroup/get-all-namespaces   (admin)
+    GET  /dashboard/api/workgroup/env-info
+plus a server-rendered shell at /ui that composes the web apps by iframe
+(main-page pattern).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import profile as profile_api
+from kubeflow_tpu.core.rbac import can_i, is_cluster_admin
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.dashboard.metrics_service import (
+    Interval,
+    make_metrics_service,
+)
+from kubeflow_tpu.webapps.crud_backend import CrudApp, HTTPError, Request
+
+CONFIGMAP = "centraldashboard-config"
+
+DEFAULT_LINKS = {
+    "menuLinks": [
+        {"type": "item", "link": "/jupyter/", "text": "Notebooks",
+         "icon": "book"},
+        {"type": "item", "link": "/tensorboards/", "text": "Tensorboards",
+         "icon": "assessment"},
+        {"type": "item", "link": "/volumes/", "text": "Volumes",
+         "icon": "device:storage"},
+        {"type": "item", "link": "/jaxjobs/", "text": "JAXJobs (Training)",
+         "icon": "donut-large"},
+        {"type": "item", "link": "/experiments/", "text": "Experiments (HPO)",
+         "icon": "timeline"},
+        {"type": "item", "link": "/models/", "text": "Models (Serving)",
+         "icon": "extension"},
+    ],
+    "externalLinks": [],
+    "quickLinks": [
+        {"text": "Create a new Notebook server",
+         "desc": "Jupyter on TPU-VM", "link": "/jupyter/"},
+        {"text": "Submit a JAXJob", "desc": "Gang-scheduled slice training",
+         "link": "/jaxjobs/"},
+    ],
+    "documentationItems": [],
+}
+
+
+class DashboardApp(CrudApp):
+    prefix = "/dashboard"
+    prefixes = ("/dashboard", "/ui")
+
+    def __init__(self, server, metrics=None, project: str | None = None):
+        super().__init__(server)
+        self.metrics = metrics or make_metrics_service(server, project)
+        self.add_route("GET", "/api/namespaces", self.namespaces)
+        self.add_route("GET", "/api/activities/<ns>", self.activities)
+        self.add_route("GET", "/api/metrics/<mtype>", self.metrics_route)
+        self.add_route("GET", "/api/dashboard-links", self.links,
+                       no_auth=True)
+        self.add_route("GET", "/api/dashboard-settings", self.settings,
+                       no_auth=True)
+        self.add_route("GET", "/api/workgroup/exists", self.wg_exists)
+        self.add_route("POST", "/api/workgroup/create", self.wg_create)
+        self.add_route("POST", "/api/workgroup/add-contributor",
+                       self.wg_add_contributor)
+        self.add_route("POST", "/api/workgroup/remove-contributor",
+                       self.wg_remove_contributor)
+        self.add_route("GET", "/api/workgroup/get-all-namespaces",
+                       self.wg_all_namespaces)
+        self.add_route("GET", "/api/workgroup/env-info", self.env_info)
+        self.add_route("GET", "/", self.shell, no_auth=True)
+
+    # -- api.ts ---------------------------------------------------------------
+    def namespaces(self, req: Request):
+        out = []
+        for ns in self.server.list("Namespace"):
+            name = ns["metadata"]["name"]
+            owner = ns["metadata"].get("annotations", {}).get("owner")
+            if owner == req.user:
+                out.append({"namespace": name, "role": "owner"})
+            elif can_i(self.server, req.user, "get", "Notebook", name):
+                out.append({"namespace": name, "role": "contributor"})
+        return "200 OK", out
+
+    def activities(self, req: Request):
+        ns = req.params["ns"]
+        req.authorize("list", "Event", ns)
+        events = self.server.list("Event", namespace=ns)
+        events.sort(key=lambda e: e["spec"].get("lastTimestamp", 0),
+                    reverse=True)
+        return "200 OK", events[:100]
+
+    def metrics_route(self, req: Request):
+        mtype = req.params["mtype"]
+        interval = req.query.get("interval", ["Last15m"])[0]
+        span = Interval.get(interval)
+        if span is None:
+            raise HTTPError("422 Unprocessable Entity",
+                            f"unknown interval {interval}")
+        series = {
+            "node": self.metrics.get_node_cpu_utilization,
+            "podcpu": self.metrics.get_pod_cpu_utilization,
+            "podmem": self.metrics.get_pod_memory_usage,
+            "tpuduty": self.metrics.get_tpu_duty_cycle,
+        }.get(mtype)
+        if series is None:
+            raise HTTPError("422 Unprocessable Entity",
+                            f"unknown metric {mtype}")
+        return "200 OK", series(span)
+
+    def links(self, req: Request):
+        return "200 OK", self._config("links", DEFAULT_LINKS)
+
+    def settings(self, req: Request):
+        return "200 OK", self._config("settings", {"DASHBOARD_FORCE_IFRAME":
+                                                   True})
+
+    def _config(self, key: str, default):
+        try:
+            cm = self.server.get("ConfigMap", CONFIGMAP, "kubeflow")
+            import json as _json
+
+            return _json.loads(cm["spec"]["data"][key])
+        except (NotFound, KeyError):
+            return default
+
+    # -- api_workgroup.ts -----------------------------------------------------
+    def wg_exists(self, req: Request):
+        owned = [p for p in self.server.list(profile_api.KIND)
+                 if profile_api.owner_of(p) == req.user]
+        return "200 OK", {"user": req.user, "hasAuth": True,
+                          "hasWorkgroup": bool(owned),
+                          "registrationFlowAllowed": True}
+
+    def wg_create(self, req: Request):
+        body = req.json()
+        name = body.get("namespace") or (req.user or "").split("@")[0]
+        self.server.create(profile_api.new(name, req.user))
+        return "200 OK", {"message": f"Created profile {name}"}
+
+    def wg_add_contributor(self, req: Request):
+        return self._contributor(req, add=True)
+
+    def wg_remove_contributor(self, req: Request):
+        return self._contributor(req, add=False)
+
+    def _contributor(self, req: Request, add: bool):
+        from kubeflow_tpu.kfam.app import KfamApp
+
+        body = req.json()
+        ns = body["namespace"]
+        contributor = body["contributor"]
+        kfam = KfamApp(self.server)
+        profile = self.server.get(profile_api.KIND, ns)
+        kfam._require_owner_or_admin(profile, req.user)
+        binding = {"user": {"kind": "User", "name": contributor},
+                   "referredNamespace": ns,
+                   "roleRef": {"kind": "ClusterRole", "name": "edit"}}
+        if add:
+            kfam._create_binding(binding, req.user)
+        else:
+            kfam._delete_binding(binding, req.user)
+        _, listing = kfam._list_bindings(ns)
+        return "200 OK", [b["user"]["name"] for b in listing["bindings"]]
+
+    def wg_all_namespaces(self, req: Request):
+        if not is_cluster_admin(self.server, req.user):
+            raise PermissionError("cluster admin required")
+        out = []
+        for p in self.server.list(profile_api.KIND):
+            out.append({"namespace": p["metadata"]["name"],
+                        "owner": profile_api.owner_of(p)})
+        return "200 OK", out
+
+    def env_info(self, req: Request):
+        _, ns_list = self.namespaces(req)
+        return "200 OK", {
+            "user": req.user,
+            "platform": {"kubeflowVersion": "tpu-native-0.1.0",
+                         "provider": "tpu", "providerName": "tpu"},
+            "namespaces": ns_list,
+            "isClusterAdmin": is_cluster_admin(self.server, req.user),
+        }
+
+    # -- shell ----------------------------------------------------------------
+    def shell(self, req: Request):
+        html = """<!doctype html>
+<html><head><title>Kubeflow TPU</title>
+<style>
+ body { font-family: sans-serif; margin: 0; display: flex; height: 100vh; }
+ nav { width: 220px; background: #1e2a3a; color: #fff; padding: 16px; }
+ nav a { color: #9db2cb; display: block; padding: 8px 0;
+         text-decoration: none; }
+ main { flex: 1; } iframe { width: 100%; height: 100%; border: 0; }
+</style></head>
+<body>
+<nav><h2>Kubeflow TPU</h2><div id="links"></div></nav>
+<main><iframe id="content" src="about:blank"></iframe></main>
+<script>
+fetch('/dashboard/api/dashboard-links').then(r => r.json()).then(cfg => {
+  const nav = document.getElementById('links');
+  for (const item of cfg.menuLinks) {
+    const a = document.createElement('a');
+    a.textContent = item.text; a.href = '#';
+    a.onclick = () => {
+      document.getElementById('content').src = item.link; return false;
+    };
+    nav.appendChild(a);
+  }
+});
+</script>
+</body></html>"""
+        return "200 OK", html.encode()

@@ -1,0 +1,144 @@
+"""Pluggable cluster-metrics providers for the dashboard.
+
+Reference: centraldashboard app/metrics_service.ts:17-42 defines the
+interface; only a Stackdriver implementation exists and the factory picks it
+on GCP (metrics_service_factory.ts:13-35).  Here the interface is the same
+three series (node CPU, pod CPU, pod memory) plus TPU duty cycle — the
+TPU-first addition — with a local implementation that aggregates from the
+platform's own state, and a Cloud Monitoring implementation that shells the
+same queries to the Google Monitoring API when credentials exist.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Protocol
+
+Interval = {"Last5m": 300, "Last15m": 900, "Last30m": 1800,
+            "Last60m": 3600, "Last180m": 10800}
+
+
+class MetricsService(Protocol):
+    def get_node_cpu_utilization(self, span_s: int) -> list[dict]: ...
+
+    def get_pod_cpu_utilization(self, span_s: int) -> list[dict]: ...
+
+    def get_pod_memory_usage(self, span_s: int) -> list[dict]: ...
+
+    def get_tpu_duty_cycle(self, span_s: int) -> list[dict]: ...
+
+
+class LocalMetricsService:
+    """Derives series from the in-memory API server (pod counts as a proxy
+    for utilization) — the no-cloud default so the dashboard always renders."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def _series(self, value: float, span_s: int, step: int = 60) -> list[dict]:
+        now = time.time()
+        return [{"timestamp": now - t, "value": value}
+                for t in range(span_s, -1, -step)]
+
+    def _running_pods(self) -> list[dict]:
+        return [p for p in self.server.list("Pod")
+                if p.get("status", {}).get("phase") == "Running"]
+
+    def get_node_cpu_utilization(self, span_s: int) -> list[dict]:
+        return self._series(min(1.0, len(self._running_pods()) / 100.0),
+                            span_s)
+
+    def get_pod_cpu_utilization(self, span_s: int) -> list[dict]:
+        return self._series(float(len(self._running_pods())), span_s)
+
+    def get_pod_memory_usage(self, span_s: int) -> list[dict]:
+        total = 0.0
+        for p in self._running_pods():
+            for c in p["spec"].get("containers", []):
+                mem = c.get("resources", {}).get("requests", {}).get(
+                    "memory", "0")
+                total += _parse_mem(mem)
+        return self._series(total, span_s)
+
+    def get_tpu_duty_cycle(self, span_s: int) -> list[dict]:
+        chips = 0
+        for p in self._running_pods():
+            for c in p["spec"].get("containers", []):
+                for k, v in (c.get("resources", {}).get("limits", {})
+                             .items()):
+                    if "cloud-tpu" in k:
+                        chips += int(v)
+        return self._series(float(chips), span_s)
+
+
+class CloudMonitoringMetricsService:
+    """Google Cloud Monitoring implementation (Stackdriver successor).
+
+    Constructed by the factory only when a project id + credentials are
+    available; queries the timeSeries API for the same four series.  Import
+    and network access are deferred so the class is inert elsewhere.
+    """
+
+    NODE_CPU = "kubernetes.io/node/cpu/allocatable_utilization"
+    POD_CPU = "kubernetes.io/container/cpu/core_usage_time"
+    POD_MEM = "kubernetes.io/container/memory/used_bytes"
+    TPU_DUTY = "tpu.googleapis.com/tpu/mxu/utilization"
+
+    def __init__(self, project: str):
+        self.project = project
+
+    def _query(self, metric: str, span_s: int) -> list[dict]:
+        from google.cloud import monitoring_v3  # type: ignore
+
+        client = monitoring_v3.MetricServiceClient()
+        now = time.time()
+        interval = monitoring_v3.TimeInterval(
+            {"end_time": {"seconds": int(now)},
+             "start_time": {"seconds": int(now - span_s)}})
+        results = client.list_time_series(
+            request={"name": f"projects/{self.project}",
+                     "filter": f'metric.type = "{metric}"',
+                     "interval": interval})
+        out = []
+        for ts in results:
+            for point in ts.points:
+                out.append({"timestamp": point.interval.end_time.timestamp(),
+                            "value": point.value.double_value})
+        return out
+
+    def get_node_cpu_utilization(self, span_s):
+        return self._query(self.NODE_CPU, span_s)
+
+    def get_pod_cpu_utilization(self, span_s):
+        return self._query(self.POD_CPU, span_s)
+
+    def get_pod_memory_usage(self, span_s):
+        return self._query(self.POD_MEM, span_s)
+
+    def get_tpu_duty_cycle(self, span_s):
+        return self._query(self.TPU_DUTY, span_s)
+
+
+def make_metrics_service(server, project: str | None = None) -> MetricsService:
+    """Factory (metrics_service_factory.ts pattern): Cloud Monitoring when a
+    project is configured and importable, local otherwise."""
+    if project:
+        try:
+            return CloudMonitoringMetricsService(project)
+        except ImportError:
+            pass
+    return LocalMetricsService(server)
+
+
+def _parse_mem(s) -> float:
+    if isinstance(s, (int, float)):
+        return float(s)
+    units = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+             "K": 1e3, "M": 1e6, "G": 1e9, "T": 1e12}
+    for suffix, mult in units.items():
+        if s.endswith(suffix):
+            return float(s[:-len(suffix)]) * mult
+    try:
+        return float(s)
+    except ValueError:
+        return 0.0

@@ -1,0 +1,5 @@
+"""Hyperparameter optimization (the Katib-equivalent, SURVEY.md §2.12).
+
+Experiment -> Suggestion service -> Trials -> JAXJobs on preemptible TPU
+slices, with gang restart absorbing preemptions.
+"""

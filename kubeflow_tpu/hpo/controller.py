@@ -1,0 +1,186 @@
+"""Experiment/Trial controllers: HPO over gang-scheduled preemptible slices.
+
+ExperimentController keeps ``parallelTrials`` trials in flight, feeding each
+completed (assignment, objective) pair back into the suggestion service, and
+finishes with the best trial in status.  TrialController materializes each
+trial as a JAXJob whose pods tolerate preemptible slices; JAXJob's gang
+restart (maxRestarts) absorbs slice preemptions — the elastic-recovery story
+the reference lacks (SURVEY.md §7 hard parts #2).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import experiment as api
+from kubeflow_tpu.api import jaxjob as jaxjob_api
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.objects import set_condition, set_owner
+from kubeflow_tpu.core.store import Conflict, NotFound
+from kubeflow_tpu.hpo.search_space import SearchSpace
+from kubeflow_tpu.hpo.suggestion import make_suggester
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+TRIALS_TOTAL = REGISTRY.counter("hpo_trials_total", "trials by outcome",
+                                labels=("outcome",))
+
+PREEMPTIBLE_TOLERATION = {"key": "cloud.google.com/gke-preemptible",
+                          "operator": "Equal", "value": "true",
+                          "effect": "NoSchedule"}
+
+
+class ExperimentController(Controller):
+    kind = api.KIND
+    owns = (api.TRIAL_KIND,)
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            exp = self.server.get(api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if exp["metadata"].get("deletionTimestamp"):
+            return None
+        spec = exp["spec"]
+        status = dict(exp.get("status") or {})
+        if status.get("phase") in ("Succeeded", "Failed"):
+            return None
+
+        trials = [t for t in self.server.list(api.TRIAL_KIND,
+                                              namespace=req.namespace)
+                  if t["spec"].get("experiment") == req.name]
+        trials.sort(key=lambda t: t["spec"]["index"])
+
+        done = [t for t in trials
+                if t.get("status", {}).get("phase") in ("Succeeded",
+                                                        "Failed")]
+        succeeded = [t for t in done
+                     if t["status"]["phase"] == "Succeeded"]
+        failed = [t for t in done if t["status"]["phase"] == "Failed"]
+        running = [t for t in trials if t not in done]
+
+        maximize = spec["objective"]["type"] == "maximize"
+        history = [(t["spec"]["assignment"], float(t["status"]["objective"]))
+                   for t in succeeded
+                   if t.get("status", {}).get("objective") is not None]
+
+        # terminal checks
+        if len(failed) > int(spec.get("maxFailedTrials", 3)):
+            status["phase"] = "Failed"
+            set_condition(exp, "Complete", "False", reason="TooManyFailures")
+            status.update(self._summary(trials, history, maximize,
+                                        exp=exp))
+            self.server.patch_status(api.KIND, req.name, req.namespace,
+                                     status)
+            return None
+        if len(succeeded) >= int(spec.get("maxTrials", 8)):
+            status["phase"] = "Succeeded"
+            set_condition(exp, "Complete", "True", reason="MaxTrialsReached")
+            status.update(self._summary(trials, history, maximize, exp=exp))
+            self.server.patch_status(api.KIND, req.name, req.namespace,
+                                     status)
+            return None
+
+        # spawn up to parallelTrials
+        budget = (int(spec.get("maxTrials", 8)) + len(failed)
+                  - len(trials))
+        slots = int(spec.get("parallelTrials", 2)) - len(running)
+        next_index = (max((t["spec"]["index"] for t in trials), default=-1)
+                      + 1)
+        suggester = self._suggester(exp, history)
+        for i in range(min(slots, max(budget, 0))):
+            assignment = suggester.suggest(history)
+            trial = set_owner(api.new_trial(exp, next_index + i, assignment),
+                              exp)
+            try:
+                self.server.create(trial)
+            except Conflict:
+                pass
+            history.append((assignment, float("nan")))  # avoid dup suggests
+
+        status["phase"] = "Running"
+        status.update(self._summary(trials, [h for h in history
+                                             if h[1] == h[1]], maximize,
+                                    exp=exp))
+        self.server.patch_status(api.KIND, req.name, req.namespace, status)
+        return None
+
+    def _suggester(self, exp: dict, history):
+        spec = exp["spec"]
+        space = SearchSpace(spec.get("parameters", []))
+        return make_suggester(
+            spec.get("algorithm", {}).get("name", "random"), space,
+            seed=int(spec.get("algorithm", {}).get("seed", 0)),
+            maximize=spec["objective"]["type"] == "maximize")
+
+    def _summary(self, trials, history, maximize, exp=None):
+        out = {
+            "trials": len(trials),
+            "trialsSucceeded": sum(
+                1 for t in trials
+                if t.get("status", {}).get("phase") == "Succeeded"),
+            "trialsFailed": sum(
+                1 for t in trials
+                if t.get("status", {}).get("phase") == "Failed"),
+            "conditions": (exp or {}).get("status", {}).get("conditions",
+                                                            []),
+        }
+        if history:
+            best = (max if maximize else min)(history, key=lambda h: h[1])
+            out["bestTrial"] = {"assignment": best[0], "objective": best[1]}
+        return out
+
+
+class TrialController(Controller):
+    kind = api.TRIAL_KIND
+    owns = (jaxjob_api.KIND,)
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            trial = self.server.get(api.TRIAL_KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if trial["metadata"].get("deletionTimestamp"):
+            return None
+        status = dict(trial.get("status") or {})
+        if status.get("phase") in ("Succeeded", "Failed"):
+            return None
+
+        job = self._ensure_job(trial)
+        jphase = job.get("status", {}).get("phase", "Pending")
+        if jphase == "Succeeded":
+            result = job.get("status", {}).get("result") or {}
+            metric = trial["spec"].get("objectiveMetric", "final_loss")
+            status["phase"] = "Succeeded"
+            status["objective"] = result.get(metric)
+            status["result"] = result
+            TRIALS_TOTAL.labels("succeeded").inc()
+        elif jphase == "Failed":
+            status["phase"] = "Failed"
+            TRIALS_TOTAL.labels("failed").inc()
+        else:
+            status["phase"] = "Running"
+        self.server.patch_status(api.TRIAL_KIND, req.name, req.namespace,
+                                 status)
+        return None
+
+    def _ensure_job(self, trial: dict) -> dict:
+        name = trial["metadata"]["name"]
+        ns = trial["metadata"]["namespace"]
+        try:
+            return self.server.get(jaxjob_api.KIND, name, ns)
+        except NotFound:
+            job = jaxjob_api.new(
+                name, ns,
+                topology=trial["spec"].get("topology", "v5e-1"),
+                trainer=trial["spec"].get("trainer", {}),
+                # preemption shows up as worker failure; generous gang
+                # restarts ride it out
+                max_restarts=5,
+                pod_template={"tolerations": [PREEMPTIBLE_TOLERATION]},
+            )
+            return self.server.create(set_owner(job, trial))
+
+
+def register(server, mgr) -> None:
+    server.register_validating_hook(
+        lambda o: api.validate(o) if o.get("kind") == api.KIND else None)
+    mgr.add(ExperimentController(server))
+    mgr.add(TrialController(server))

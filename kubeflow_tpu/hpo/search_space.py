@@ -1,0 +1,103 @@
+"""Search-space definition and parameter encoding shared by all suggesters."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Parameter:
+    name: str
+    type: str                      # "double" | "int" | "categorical"
+    min: float | None = None
+    max: float | None = None
+    step: float | None = None
+    values: tuple = ()
+    log_scale: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Parameter":
+        return cls(name=d["name"], type=d["type"],
+                   min=d.get("min"), max=d.get("max"), step=d.get("step"),
+                   values=tuple(d.get("values", ())),
+                   log_scale=bool(d.get("logScale", False)))
+
+    def validate(self) -> None:
+        if self.type in ("double", "int"):
+            if self.min is None or self.max is None or self.min > self.max:
+                raise ValueError(f"parameter {self.name}: min/max invalid")
+            if self.log_scale and self.min <= 0:
+                raise ValueError(f"parameter {self.name}: logScale needs "
+                                 "min > 0")
+        elif self.type == "categorical":
+            if not self.values:
+                raise ValueError(f"parameter {self.name}: values required")
+        else:
+            raise ValueError(f"parameter {self.name}: unknown type "
+                             f"{self.type}")
+
+    # -- encoding to/from the unit cube (for GP-based suggestion) -------------
+    def encode(self, value: Any) -> float:
+        import math
+
+        if self.type == "categorical":
+            idx = self.values.index(value)
+            return idx / max(len(self.values) - 1, 1)
+        if self.log_scale:
+            return ((math.log(value) - math.log(self.min))
+                    / (math.log(self.max) - math.log(self.min)))
+        return (float(value) - self.min) / (self.max - self.min or 1.0)
+
+    def decode(self, unit: float) -> Any:
+        import math
+
+        unit = min(max(unit, 0.0), 1.0)
+        if self.type == "categorical":
+            idx = round(unit * (len(self.values) - 1))
+            return self.values[idx]
+        if self.log_scale:
+            raw = math.exp(math.log(self.min)
+                           + unit * (math.log(self.max)
+                                     - math.log(self.min)))
+        else:
+            raw = self.min + unit * (self.max - self.min)
+        if self.type == "int":
+            return int(round(raw))
+        if self.step:
+            raw = self.min + round((raw - self.min) / self.step) * self.step
+        return raw
+
+    def sample(self, rng: random.Random) -> Any:
+        return self.decode(rng.random())
+
+
+class SearchSpace:
+    def __init__(self, parameters: list[dict] | list[Parameter]):
+        self.params = [p if isinstance(p, Parameter)
+                       else Parameter.from_dict(p) for p in parameters]
+        for p in self.params:
+            p.validate()
+
+    def sample(self, rng: random.Random) -> dict[str, Any]:
+        return {p.name: p.sample(rng) for p in self.params}
+
+    def encode(self, assignment: dict[str, Any]) -> list[float]:
+        return [p.encode(assignment[p.name]) for p in self.params]
+
+    def decode(self, units: list[float]) -> dict[str, Any]:
+        return {p.name: p.decode(u) for p, u in zip(self.params, units)}
+
+    def grid(self, points_per_axis: int = 3) -> list[dict[str, Any]]:
+        import itertools
+
+        axes = []
+        for p in self.params:
+            if p.type == "categorical":
+                axes.append(list(p.values))
+            else:
+                n = points_per_axis
+                axes.append([p.decode(i / max(n - 1, 1)) for i in range(n)])
+        return [dict(zip((p.name for p in self.params), combo))
+                for combo in itertools.product(*axes)]

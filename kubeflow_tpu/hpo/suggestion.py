@@ -1,0 +1,132 @@
+"""Suggestion services: random, grid, and Gaussian-process Bayesian
+optimization (the reference ecosystem's Katib suggestion algorithms).
+
+The Bayesian suggester is a dependency-light GP with an RBF kernel and
+expected-improvement acquisition maximized over random candidates — adequate
+for the low-dimensional HPO spaces trials sweep (BASELINE.json configs[3]).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any
+
+import numpy as np
+
+from kubeflow_tpu.hpo.search_space import SearchSpace
+
+
+class Suggester:
+    def __init__(self, space: SearchSpace, *, seed: int = 0,
+                 maximize: bool = True):
+        self.space = space
+        self.rng = random.Random(seed)
+        self.maximize = maximize
+
+    def suggest(self, history: list[tuple[dict, float]]) -> dict[str, Any]:
+        raise NotImplementedError
+
+
+class RandomSearch(Suggester):
+    def suggest(self, history):
+        return self.space.sample(self.rng)
+
+
+class GridSearch(Suggester):
+    def __init__(self, space, *, seed: int = 0, maximize: bool = True,
+                 points_per_axis: int = 3):
+        super().__init__(space, seed=seed, maximize=maximize)
+        self._grid = space.grid(points_per_axis)
+        self._next = 0
+
+    def suggest(self, history):
+        tried = [h[0] for h in history]
+        while self._next < len(self._grid):
+            cand = self._grid[self._next]
+            self._next += 1
+            if cand not in tried:
+                return cand
+        return self.space.sample(self.rng)  # grid exhausted
+
+
+class _GP:
+    """Tiny exact GP: RBF kernel + noise, Cholesky solves."""
+
+    def __init__(self, length_scale: float = 0.25, noise: float = 1e-4):
+        self.ls = length_scale
+        self.noise = noise
+
+    def _k(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / self.ls**2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        self.x = x
+        self.y_mean = y.mean()
+        self.y_std = y.std() or 1.0
+        yn = (y - self.y_mean) / self.y_std
+        k = self._k(x, x) + self.noise * np.eye(len(x))
+        self.chol = np.linalg.cholesky(k)
+        self.alpha = np.linalg.solve(
+            self.chol.T, np.linalg.solve(self.chol, yn))
+
+    def predict(self, xc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        ks = self._k(xc, self.x)
+        mu = ks @ self.alpha
+        v = np.linalg.solve(self.chol, ks.T)
+        var = np.clip(1.0 - (v**2).sum(0), 1e-12, None)
+        return (mu * self.y_std + self.y_mean,
+                np.sqrt(var) * self.y_std)
+
+
+class BayesianOptimization(Suggester):
+    def __init__(self, space, *, seed: int = 0, maximize: bool = True,
+                 n_initial: int = 4, n_candidates: int = 256):
+        super().__init__(space, seed=seed, maximize=maximize)
+        self.n_initial = n_initial
+        self.n_candidates = n_candidates
+
+    def suggest(self, history):
+        if len(history) < self.n_initial:
+            return self.space.sample(self.rng)
+        x = np.array([self.space.encode(h[0]) for h in history])
+        y = np.array([h[1] for h in history], dtype=float)
+        if not self.maximize:
+            y = -y
+        gp = _GP()
+        try:
+            gp.fit(x, y)
+        except np.linalg.LinAlgError:
+            return self.space.sample(self.rng)
+        cands = np.array([[self.rng.random() for _ in self.space.params]
+                          for _ in range(self.n_candidates)])
+        mu, sigma = gp.predict(cands)
+        best = y.max()
+        # expected improvement
+        z = (mu - best) / sigma
+        ei = (mu - best) * _ncdf(z) + sigma * _npdf(z)
+        return self.space.decode(list(cands[int(np.argmax(ei))]))
+
+
+def _ncdf(z: np.ndarray) -> np.ndarray:
+    return 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+
+
+def _npdf(z: np.ndarray) -> np.ndarray:
+    return np.exp(-0.5 * z**2) / math.sqrt(2 * math.pi)
+
+
+ALGORITHMS = {
+    "random": RandomSearch,
+    "grid": GridSearch,
+    "bayesian": BayesianOptimization,
+}
+
+
+def make_suggester(name: str, space: SearchSpace, *, seed: int = 0,
+                   maximize: bool = True) -> Suggester:
+    if name not in ALGORITHMS:
+        raise ValueError(f"unknown algorithm {name!r}; "
+                         f"known: {sorted(ALGORITHMS)}")
+    return ALGORITHMS[name](space, seed=seed, maximize=maximize)

@@ -82,19 +82,28 @@ def _register_optional(server, mgr, enable: set[str] | None) -> None:
         registry.append(_hpo.register)
     except ImportError:
         pass
+    try:
+        from kubeflow_tpu.controllers import inferenceservice as _isvc
+
+        registry.append(_isvc.register)
+    except ImportError:
+        pass
     for reg in registry:
         reg(server, mgr)
 
 
-def build_wsgi_app(server, *, secure_api: bool = True):
-    """One HTTP front door: /apis (REST), /kfam (access management),
-    /apply-poddefault (webhook), plus whatever web apps have landed.
+def build_wsgi_app(server, *, secure_api: bool = True,
+                   expose_webhook: bool = False):
+    """One HTTP front door: /apis (REST), /kfam (access management), plus
+    whatever web apps have landed.
 
     With ``secure_api`` (default) the raw /apis routes enforce RBAC for the
     identity-header user — otherwise the KFAM/webapp authz models would be
-    bypassable by raw writes on the same listener.
+    bypassable by raw writes on the same listener.  The admission webhook
+    endpoint is only mounted on request (``expose_webhook``): it exists for
+    out-of-process API servers on a cluster-internal listener; on a public
+    door it would disclose any tenant's PodDefault contents.
     """
-    from kubeflow_tpu.admission.webhook import WebhookApp
     from kubeflow_tpu.core.rbac import ensure_authorized
     from kubeflow_tpu.kfam import KfamApp
 
@@ -104,8 +113,11 @@ def build_wsgi_app(server, *, secure_api: bool = True):
         ensure_authorized(server, user, verb, kind, namespace)
 
     rest = RestAPI(server, authorize=rbac_authorize if secure_api else None)
-    mounts = {"/kfam": KfamApp(server),
-              "/apply-poddefault": WebhookApp(server)}
+    mounts = {"/kfam": KfamApp(server)}
+    if expose_webhook:
+        from kubeflow_tpu.admission.webhook import WebhookApp
+
+        mounts["/apply-poddefault"] = WebhookApp(server)
     try:
         from kubeflow_tpu.webapps import mount_all
 

@@ -1,0 +1,2 @@
+"""Model serving (the KServe-equivalent, SURVEY.md §2.12): InferenceService
+resources materialized as JAX predictor deployments."""

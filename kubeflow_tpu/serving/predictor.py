@@ -1,0 +1,250 @@
+"""JAX predictor runtime: the container process behind an InferenceService.
+
+TPU-first inference path:
+- prefill jitted per (batch, padded-seq) bucket: flash attention over the
+  whole prompt, KV cache written in one pass;
+- decode step jitted once with a static-shape cache (lax dynamic-update
+  slicing), greedy or temperature sampling;
+- bfloat16 weights on the MXU; orbax checkpoint restore when a model dir is
+  given, otherwise seeded random weights (CI/dev).
+
+Serves V1-style routes:
+    GET  /v1/models                       list
+    GET  /v1/models/<name>                readiness/metadata
+    POST /v1/models/<name>:predict        {"instances": [...]} -> logits
+    POST /v1/models/<name>:generate       {"ids": [[...]], "max_new_tokens"}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.utils.logging import get_logger
+
+
+class GenerativePredictor:
+    """Llama-style decoder serving (text generation)."""
+
+    def __init__(self, model_name: str = "llama", size: str = "tiny",
+                 model_config: dict | None = None,
+                 checkpoint_dir: str | None = None,
+                 max_batch: int = 4, max_seq: int = 512, seed: int = 0):
+        from kubeflow_tpu.models import registry
+
+        self.log = get_logger("predictor", model=model_name, size=size)
+        entry = registry.get(model_name)
+        self.module = entry.make_model(size=size, **(model_config or {}))
+        self.cfg = self.module.config
+        self.max_batch = max_batch
+        self.max_seq = min(max_seq, self.cfg.max_seq_len)
+        rng = jax.random.PRNGKey(seed)
+        example = jnp.zeros((1, 8), jnp.int32)
+        params = self.module.init(rng, example)["params"]
+        from kubeflow_tpu.parallel.sharding import unbox_params
+
+        self.params = unbox_params(params)
+        if checkpoint_dir:
+            self._restore(checkpoint_dir)
+        self._prefill_cache: dict[tuple, Any] = {}
+        self._decode_fn = None
+        self.log.info("predictor ready",
+                      params=sum(x.size for x in
+                                 jax.tree_util.tree_leaves(self.params)))
+
+    def _restore(self, directory: str) -> None:
+        import orbax.checkpoint as ocp
+
+        from kubeflow_tpu.training.checkpoint import abstract_like
+
+        ckptr = ocp.StandardCheckpointer()
+        self.params = ckptr.restore(directory,
+                                    abstract_like(self.params))
+        self.log.info("restored checkpoint", directory=directory)
+
+    # -- compiled steps --------------------------------------------------------
+    def _prefill(self, batch: int, seq: int):
+        key = (batch, seq)
+        if key not in self._prefill_cache:
+            def fn(params, ids, cache):
+                out = self.module.apply({"params": params}, ids, cache=cache)
+                return out["logits"], out["cache"]
+
+            self._prefill_cache[key] = jax.jit(fn)
+        return self._prefill_cache[key]
+
+    def _decode(self):
+        if self._decode_fn is None:
+            def fn(params, ids, cache):
+                out = self.module.apply({"params": params}, ids, cache=cache)
+                return out["logits"], out["cache"]
+
+            self._decode_fn = jax.jit(fn)
+        return self._decode_fn
+
+    # -- API -------------------------------------------------------------------
+    def generate(self, ids: list[list[int]], max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0) -> dict:
+        from kubeflow_tpu.models import llama as llama_mod
+
+        t0 = time.perf_counter()
+        batch = len(ids)
+        if batch > self.max_batch:
+            raise ValueError(f"batch {batch} > max_batch {self.max_batch}")
+        lengths = {len(x) for x in ids}
+        if len(lengths) != 1:
+            # right-padding would write junk keys into the cache at valid
+            # positions; batched prompts must share a length (clients chunk
+            # or pad upstream with their tokenizer's semantics)
+            raise ValueError("all prompts in a batch must have equal length")
+        prompt_len = lengths.pop()
+        total = prompt_len + max_new_tokens
+        if total > self.max_seq:
+            raise ValueError(f"prompt+new ({total}) > max_seq "
+                             f"{self.max_seq}")
+        arr = jnp.asarray(ids, jnp.int32)
+
+        cache = llama_mod.init_cache(self.cfg, batch, max_len=self.max_seq)
+        logits, cache = self._prefill(batch, prompt_len)(self.params, arr,
+                                                         cache)
+        next_logits = logits[:, -1]
+
+        rng = jax.random.PRNGKey(seed)
+        out_ids = [list(x) for x in ids]
+        decode = self._decode()
+        token = self._sample(next_logits, temperature, rng)
+        for i in range(batch):
+            out_ids[i].append(int(token[i]))
+        for step in range(max_new_tokens - 1):
+            rng, sub = jax.random.split(rng)
+            logits, cache = decode(self.params, token[:, None], cache)
+            token = self._sample(logits[:, -1], temperature, sub)
+            for i in range(batch):
+                out_ids[i].append(int(token[i]))
+        dt = time.perf_counter() - t0
+        return {
+            "ids": out_ids,
+            "tokens_generated": batch * max_new_tokens,
+            "tokens_per_sec": batch * max_new_tokens / dt,
+        }
+
+    def _sample(self, logits: jax.Array, temperature: float,
+                rng: jax.Array) -> jax.Array:
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(rng, logits / temperature, axis=-1)
+
+
+class ClassifierPredictor:
+    """Generic :predict path for non-generative registry models."""
+
+    def __init__(self, model_name: str, model_config: dict | None = None,
+                 checkpoint_dir: str | None = None, seed: int = 0):
+        from kubeflow_tpu.models import registry
+
+        entry = registry.get(model_name)
+        self.module = entry.make_model(**(model_config or {}))
+        rng = jax.random.PRNGKey(seed)
+        inputs = entry.make_inputs(1, rng, self.module)
+        from kubeflow_tpu.parallel.sharding import unbox_params
+
+        self.params = unbox_params(
+            self.module.init(rng, *inputs)["params"])
+        self._fn = jax.jit(
+            lambda p, x: self.module.apply({"params": p}, x))
+
+    def predict(self, instances: list) -> dict:
+        x = jnp.asarray(instances)
+        logits = self._fn(self.params, x)
+        if isinstance(logits, dict):
+            logits = logits.get("logits")
+        return {"predictions": jnp.argmax(logits, -1).tolist(),
+                "logits": logits.tolist()}
+
+
+class PredictorApp:
+    """WSGI wrapper exposing one or more predictors."""
+
+    def __init__(self, predictors: dict[str, Any]):
+        self.predictors = predictors
+        self.log = get_logger("predictor.http")
+
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        method = environ["REQUEST_METHOD"]
+        try:
+            status, body = self._route(method, path, environ)
+        except KeyError as e:
+            status, body = "404 Not Found", {"error": f"no model {e}"}
+        except ValueError as e:
+            status, body = "422 Unprocessable Entity", {"error": str(e)}
+        except Exception as e:  # pragma: no cover
+            status, body = "500 Internal Server Error", {"error": str(e)}
+        payload = json.dumps(body).encode()
+        start_response(status, [("Content-Type", "application/json"),
+                                ("Content-Length", str(len(payload)))])
+        return [payload]
+
+    def _route(self, method, path, environ):
+        if path == "/healthz":
+            return "200 OK", {"status": "ok"}
+        if path == "/v1/models" and method == "GET":
+            return "200 OK", {"models": sorted(self.predictors)}
+        if path.startswith("/v1/models/"):
+            rest = path[len("/v1/models/"):]
+            if ":" in rest:
+                name, verb = rest.split(":", 1)
+                pred = self.predictors[name]
+                body = self._body(environ)
+                if verb == "generate":
+                    return "200 OK", pred.generate(
+                        body["ids"],
+                        max_new_tokens=int(body.get("max_new_tokens", 32)),
+                        temperature=float(body.get("temperature", 0.0)))
+                if verb == "predict":
+                    return "200 OK", pred.predict(body["instances"])
+            else:
+                pred = self.predictors[rest]
+                return "200 OK", {"name": rest, "ready": True}
+        raise KeyError(path)
+
+    def _body(self, environ) -> dict:
+        length = int(environ.get("CONTENT_LENGTH") or 0)
+        return json.loads(environ["wsgi.input"].read(length) or b"{}")
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from kubeflow_tpu.core.httpapi import serve
+
+    parser = argparse.ArgumentParser("kubeflow_tpu.serving")
+    parser.add_argument("--model", default="llama")
+    parser.add_argument("--size", default="tiny")
+    parser.add_argument("--checkpoint-dir")
+    parser.add_argument("--port", type=int, default=8602)
+    parser.add_argument("--max-batch", type=int, default=4)
+    parser.add_argument("--max-seq", type=int, default=512)
+    args = parser.parse_args(argv)
+
+    if args.model == "llama":
+        pred = GenerativePredictor(
+            args.model, size=args.size, checkpoint_dir=args.checkpoint_dir,
+            max_batch=args.max_batch, max_seq=args.max_seq)
+    else:
+        pred = ClassifierPredictor(args.model,
+                                   checkpoint_dir=args.checkpoint_dir)
+    httpd, thread = serve(PredictorApp({args.model: pred}), args.port)
+    print(f"predictor serving {args.model} on :{args.port}", flush=True)
+    thread.join()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,21 @@
+"""Per-resource CRUD web backends (reference: components/crud-web-apps).
+
+Each app is a WSGI application built on the shared ``crud_backend`` base
+(authn from the trusted identity header, SubjectAccessReview-style authz per
+request, CSRF double-submit, normalized status).  ``mount_all`` returns the
+path->app mapping the platform front door serves.
+"""
+
+from __future__ import annotations
+
+
+def mount_all(server) -> dict:
+    from kubeflow_tpu.webapps.jupyter import JupyterApp
+    from kubeflow_tpu.webapps.tensorboards import TensorboardsApp
+    from kubeflow_tpu.webapps.volumes import VolumesApp
+
+    return {
+        "/jupyter": JupyterApp(server),
+        "/volumes": VolumesApp(server),
+        "/tensorboards": TensorboardsApp(server),
+    }

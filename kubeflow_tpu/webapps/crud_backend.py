@@ -1,0 +1,216 @@
+"""Shared CRUD backend base (reference: crud-web-apps/common/backend/...).
+
+Implements the reference's security model precisely (SURVEY.md §2.7):
+- AuthN: trusted identity header injected by the mesh (authn.py:12-67);
+  routes can opt out via ``no_auth`` (probes).
+- AuthZ: every data access re-checks the END USER via the RBAC evaluator —
+  the SubjectAccessReview-per-request model (authz.py:25-81): the backend
+  itself is privileged, the user may not be.
+- CSRF: double-submit cookie + custom header on mutating methods
+  (csrf.py:1-111).
+- Status normalization: one Phase enum for every resource (status.py:1-22).
+"""
+
+from __future__ import annotations
+
+import http.cookies
+import json
+import re
+import secrets
+from typing import Any, Callable
+from urllib.parse import parse_qs
+
+from kubeflow_tpu.core.rbac import ensure_authorized
+from kubeflow_tpu.core.store import APIServer, Conflict, Invalid, NotFound
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.status import Phase, make_status
+
+USERID_HEADER = "HTTP_X_GOOG_AUTHENTICATED_USER_EMAIL"
+USERID_PREFIX = "accounts.google.com:"
+CSRF_COOKIE = "XSRF-TOKEN"
+CSRF_HEADER = "HTTP_X_XSRF_TOKEN"
+SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
+
+
+class HTTPError(Exception):
+    def __init__(self, status: str, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, fn: Callable,
+                 no_auth: bool = False):
+        self.method = method
+        self.regex = re.compile("^" + re.sub(
+            r"<([a-z_]+)>", r"(?P<\1>[^/]+)", pattern) + "$")
+        self.fn = fn
+        self.no_auth = no_auth
+
+
+class CrudApp:
+    """Base WSGI app: subclasses call add_route in __init__ and implement
+    handlers(req) -> (status, body)."""
+
+    prefix = ""  # mount prefix stripped before routing
+    app_disable_auth = False  # APP_DISABLE_AUTH escape hatch (dev mode)
+
+    def __init__(self, server: APIServer):
+        self.server = server
+        self.routes: list[Route] = []
+        self.log = get_logger(f"webapp{self.prefix.replace('/', '.')}")
+        self.add_route("GET", "/healthz", self._healthz, no_auth=True)
+
+    def add_route(self, method: str, pattern: str, fn: Callable,
+                  no_auth: bool = False) -> None:
+        self.routes.append(Route(method, pattern, fn, no_auth))
+
+    # -- request plumbing ------------------------------------------------------
+    def __call__(self, environ, start_response):
+        method = environ["REQUEST_METHOD"]
+        path = environ.get("PATH_INFO", "/")
+        for prefix in getattr(self, "prefixes", None) or (self.prefix,):
+            if prefix and path.startswith(prefix):
+                path = path[len(prefix):] or "/"
+                break
+        headers: list[tuple[str, str]] = []
+        try:
+            route, params = self._match(method, path)
+            user = self._authn(environ, route)
+            self._csrf(environ, method, headers)
+            req = Request(self, environ, user, params)
+            status, body = route.fn(req)
+        except HTTPError as e:
+            status, body = e.status, {"error": e.message,
+                                      "success": False}
+        except PermissionError as e:
+            status, body = "403 Forbidden", {"error": str(e),
+                                             "success": False}
+        except NotFound as e:
+            status, body = "404 Not Found", {"error": str(e),
+                                             "success": False}
+        except Conflict as e:
+            status, body = "409 Conflict", {"error": str(e),
+                                            "success": False}
+        except (Invalid, ValueError, KeyError) as e:
+            status, body = "422 Unprocessable Entity", {"error": str(e),
+                                                        "success": False}
+        payload = (body if isinstance(body, bytes)
+                   else json.dumps(body).encode())
+        ctype = ("text/html; charset=utf-8" if isinstance(body, bytes)
+                 else "application/json")
+        headers += [("Content-Type", ctype),
+                    ("Content-Length", str(len(payload)))]
+        start_response(status, headers)
+        return [payload]
+
+    def _match(self, method: str, path: str):
+        path_exists = False
+        for route in self.routes:
+            m = route.regex.match(path)
+            if m:
+                path_exists = True
+                if route.method == method:
+                    return route, m.groupdict()
+        if path_exists:
+            raise HTTPError("405 Method Not Allowed",
+                            f"{method} not allowed on {path}")
+        raise NotFound(f"no route {path}")
+
+    def _authn(self, environ, route) -> str | None:
+        if route.no_auth:
+            return None
+        if self.app_disable_auth:
+            # dev mode: a fixed identity that authorize() also waves through
+            return "anonymous@kubeflow.org"
+        raw = environ.get(USERID_HEADER)
+        if not raw:
+            raise HTTPError("401 Unauthorized",
+                            "identity header missing (is the mesh/IAP "
+                            "in front of this backend?)")
+        return raw[len(USERID_PREFIX):] if raw.startswith(USERID_PREFIX) \
+            else raw
+
+    def _csrf(self, environ, method: str, headers: list) -> None:
+        cookies = http.cookies.SimpleCookie(environ.get("HTTP_COOKIE", ""))
+        if CSRF_COOKIE not in cookies:
+            token = secrets.token_urlsafe(32)
+            headers.append(("Set-Cookie",
+                            f"{CSRF_COOKIE}={token}; SameSite=Strict; Path=/"))
+            if method not in SAFE_METHODS:
+                raise HTTPError("403 Forbidden", "missing CSRF cookie")
+            return
+        if method in SAFE_METHODS:
+            return
+        if environ.get(CSRF_HEADER) != cookies[CSRF_COOKIE].value:
+            raise HTTPError("403 Forbidden", "CSRF token mismatch")
+
+    def _healthz(self, req) -> tuple[str, Any]:
+        return "200 OK", {"status": "ok"}
+
+
+class Request:
+    def __init__(self, app: CrudApp, environ, user: str | None,
+                 params: dict[str, str]):
+        self.app = app
+        self.environ = environ
+        self.user = user
+        self.params = params
+
+    @property
+    def query(self) -> dict:
+        return parse_qs(self.environ.get("QUERY_STRING", ""))
+
+    def json(self) -> dict:
+        length = int(self.environ.get("CONTENT_LENGTH") or 0)
+        raw = self.environ["wsgi.input"].read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    def authorize(self, verb: str, kind: str, namespace: str | None) -> None:
+        """The SubjectAccessReview: check the END USER, not the backend."""
+        if self.app.app_disable_auth:
+            return  # APP_DISABLE_AUTH dev mode skips authz too
+        ensure_authorized(self.app.server, self.user, verb, kind, namespace)
+
+
+# -- status normalization ------------------------------------------------------
+
+def notebook_status(nb: dict, events: list[dict] | None = None) -> dict:
+    """READY/WAITING/WARNING/STOPPED per the reference's
+    jupyter common/status.py:9-99 derivation."""
+    from kubeflow_tpu.api.notebook import STOP_ANNOTATION
+
+    md = nb.get("metadata", {})
+    status = nb.get("status", {})
+    if STOP_ANNOTATION in md.get("annotations", {}):
+        if status.get("readyReplicas", 0) == 0:
+            return make_status(Phase.STOPPED, "Notebook is stopped.")
+        return make_status(Phase.TERMINATING, "Notebook is stopping.")
+    if md.get("deletionTimestamp"):
+        return make_status(Phase.TERMINATING, "Notebook is being deleted.")
+    if status.get("readyReplicas", 0) >= 1:
+        return make_status(Phase.READY, "Notebook is running.")
+    state = status.get("containerState", {})
+    if "terminated" in state:
+        return make_status(Phase.ERROR,
+                           state["terminated"].get("message",
+                                                   "container terminated"))
+    if "waiting" in state and state["waiting"].get("reason") not in (
+            None, "Pending", "ContainerCreating"):
+        reason = state["waiting"].get("reason", "")
+        msg = state["waiting"].get("message", reason)
+        return make_status(Phase.WARNING, msg, key=reason)
+    for ev in events or []:
+        if ev.get("type") == "Warning":
+            return make_status(Phase.WARNING, ev.get("message", ""))
+    return make_status(Phase.WAITING, "Notebook is starting up.")
+
+
+def workload_status(obj: dict) -> dict:
+    status = obj.get("status", {})
+    if obj.get("metadata", {}).get("deletionTimestamp"):
+        return make_status(Phase.TERMINATING, "Deleting.")
+    if status.get("readyReplicas", 0) >= 1:
+        return make_status(Phase.READY, "Running.")
+    return make_status(Phase.WAITING, "Starting up.")

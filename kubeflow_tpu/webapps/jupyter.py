@@ -1,0 +1,212 @@
+"""Jupyter spawner backend (reference: crud-web-apps/jupyter/backend).
+
+Routes (mirroring default/routes/*):
+    GET    /api/config                                   spawner form config
+    GET    /api/namespaces/<ns>/notebooks                list + status
+    GET    /api/namespaces/<ns>/notebooks/<name>         detail
+    GET    /api/namespaces/<ns>/notebooks/<name>/pod     backing pod
+    GET    /api/namespaces/<ns>/notebooks/<name>/events  warning events
+    POST   /api/namespaces/<ns>/notebooks                create (form body)
+    PATCH  /api/namespaces/<ns>/notebooks/<name>         start/stop
+    DELETE /api/namespaces/<ns>/notebooks/<name>
+    GET    /api/namespaces/<ns>/poddefaults              "configurations"
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Any
+
+from kubeflow_tpu.api import notebook as nb_api
+from kubeflow_tpu.api.poddefault import KIND as PODDEFAULT_KIND
+from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.webapps import spawner_config
+from kubeflow_tpu.webapps.crud_backend import CrudApp, Request, notebook_status
+
+
+class JupyterApp(CrudApp):
+    prefix = "/jupyter"
+
+    def __init__(self, server, config: dict | None = None):
+        super().__init__(server)
+        self.config = config or spawner_config.get_config()
+        self.add_route("GET", "/api/config", self.get_config)
+        self.add_route("GET", "/api/namespaces/<ns>/notebooks", self.list_)
+        self.add_route("POST", "/api/namespaces/<ns>/notebooks", self.post)
+        self.add_route("GET", "/api/namespaces/<ns>/notebooks/<name>",
+                       self.get)
+        self.add_route("GET", "/api/namespaces/<ns>/notebooks/<name>/pod",
+                       self.get_pod)
+        self.add_route("GET", "/api/namespaces/<ns>/notebooks/<name>/events",
+                       self.get_events)
+        self.add_route("PATCH", "/api/namespaces/<ns>/notebooks/<name>",
+                       self.patch)
+        self.add_route("DELETE", "/api/namespaces/<ns>/notebooks/<name>",
+                       self.delete)
+        self.add_route("GET", "/api/namespaces/<ns>/poddefaults",
+                       self.list_poddefaults)
+
+    # -- reads ----------------------------------------------------------------
+    def get_config(self, req: Request):
+        return "200 OK", {"config": self.config}
+
+    def list_(self, req: Request):
+        ns = req.params["ns"]
+        req.authorize("list", nb_api.KIND, ns)
+        items = [self._view(nb) for nb in
+                 self.server.list(nb_api.KIND, namespace=ns)]
+        return "200 OK", {"notebooks": items}
+
+    def get(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("get", nb_api.KIND, ns)
+        nb = self.server.get(nb_api.KIND, name, ns)
+        return "200 OK", {"notebook": self._view(nb, detail=True)}
+
+    def get_pod(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("get", "Pod", ns)
+        try:
+            pod = self.server.get("Pod", f"{name}-0", ns)
+        except NotFound:
+            return "200 OK", {"pod": None}
+        return "200 OK", {"pod": pod}
+
+    def get_events(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("list", "Event", ns)
+        events = [e for e in self.server.list("Event", namespace=ns)
+                  if e["spec"].get("involvedObject", {}).get("name",
+                                                             "").startswith(
+                      name)]
+        return "200 OK", {"events": events}
+
+    def list_poddefaults(self, req: Request):
+        ns = req.params["ns"]
+        req.authorize("list", PODDEFAULT_KIND, ns)
+        pds = self.server.list(PODDEFAULT_KIND, namespace=ns)
+        return "200 OK", {"poddefaults": [
+            {"name": pd["metadata"]["name"],
+             "desc": pd["spec"].get("desc", pd["metadata"]["name"]),
+             "labels": (pd["spec"].get("selector", {})
+                        .get("matchLabels", {}))}
+            for pd in pds]}
+
+    # -- writes ---------------------------------------------------------------
+    def post(self, req: Request):
+        ns = req.params["ns"]
+        req.authorize("create", nb_api.KIND, ns)
+        body = req.json()
+        name = body.get("name")
+        if not name:
+            raise ValueError("notebook name required")
+        gfv = lambda f, bf=None: spawner_config.get_form_value(  # noqa: E731
+            body, self.config, f, bf)
+
+        image = body.get("customImage") or gfv("image")
+        if isinstance(image, dict):
+            image = image.get("value")
+        cpu = gfv("cpu")
+        if isinstance(cpu, dict):
+            cpu = cpu.get("value")
+        memory = gfv("memory")
+        if isinstance(memory, dict):
+            memory = memory.get("value")
+
+        tpu = gfv("tpu") or {}
+        tpu_resource = None
+        tpu_chips = 0
+        if isinstance(tpu, dict) and tpu.get("slice") not in (None, "none"):
+            from kubeflow_tpu.parallel.mesh import TOPOLOGIES
+
+            topo = TOPOLOGIES.get(tpu["slice"])
+            if topo is None:
+                raise ValueError(f"unknown TPU slice {tpu['slice']!r}")
+            if topo.hosts != 1:
+                raise ValueError(
+                    f"notebooks attach single-host slices only; "
+                    f"{topo.name} has {topo.hosts} hosts — use a JAXJob")
+            tpu_resource = topo.resource_name
+            tpu_chips = topo.chips
+
+        # volumes: create new PVCs, collect mounts (post.py:38-62)
+        workspace_pvc = None
+        ws = gfv("workspaceVolume")
+        if ws and body.get("noWorkspace") is not True:
+            pvc_spec = ws.get("newPvc") or {}
+            pvc_name = (pvc_spec.get("metadata", {}).get("name",
+                                                         "{notebook-name}")
+                        .replace("{notebook-name}", name))
+            req.authorize("create", "PersistentVolumeClaim", ns)
+            try:
+                self.server.get("PersistentVolumeClaim", pvc_name, ns)
+            except NotFound:
+                self.server.create(api_object(
+                    "PersistentVolumeClaim", pvc_name, ns,
+                    spec=pvc_spec.get("spec", {})))
+            workspace_pvc = pvc_name
+
+        labels = {"notebook-name": name}
+        for conf_name in (gfv("configurations") or []):
+            # PodDefault selectors match on their own matchLabels
+            try:
+                pd = self.server.get(PODDEFAULT_KIND, conf_name, ns)
+                labels.update(pd["spec"].get("selector", {})
+                              .get("matchLabels", {}))
+            except NotFound:
+                raise ValueError(f"unknown configuration {conf_name!r}")
+
+        nb = nb_api.new(name, ns, image=image, cpu=str(cpu),
+                        memory=str(memory), tpu_resource=tpu_resource,
+                        tpu_chips=tpu_chips, workspace_pvc=workspace_pvc,
+                        labels=labels)
+        # propagate labels onto the pod template so admission matches
+        tmeta = nb["spec"]["template"].setdefault("metadata", {})
+        tmeta.setdefault("labels", {}).update(labels)
+        created = self.server.create(nb)
+        return "201 Created", {"notebook": self._view(created),
+                               "success": True}
+
+    def patch(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("update", nb_api.KIND, ns)
+        body = req.json()
+        nb = self.server.get(nb_api.KIND, name, ns)
+        if "stopped" in body:
+            anns = nb["metadata"].setdefault("annotations", {})
+            if body["stopped"]:
+                anns[nb_api.STOP_ANNOTATION] = dt.datetime.now(
+                    dt.timezone.utc).isoformat()
+            else:
+                anns.pop(nb_api.STOP_ANNOTATION, None)
+            self.server.update(nb)
+        return "200 OK", {"success": True}
+
+    def delete(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("delete", nb_api.KIND, ns)
+        self.server.delete(nb_api.KIND, name, ns)
+        return "200 OK", {"success": True}
+
+    # -- helpers --------------------------------------------------------------
+    def _view(self, nb: dict, detail: bool = False) -> dict[str, Any]:
+        md = nb["metadata"]
+        c0 = nb["spec"]["template"]["spec"]["containers"][0]
+        limits = c0.get("resources", {}).get("limits", {})
+        tpus = {k: v for k, v in limits.items() if "cloud-tpu" in k}
+        out = {
+            "name": md["name"],
+            "namespace": md.get("namespace"),
+            "image": c0.get("image"),
+            "shortImage": (c0.get("image") or "").split("/")[-1],
+            "cpu": c0.get("resources", {}).get("requests", {}).get("cpu"),
+            "memory": c0.get("resources", {}).get("requests", {}).get(
+                "memory"),
+            "tpus": tpus,
+            "status": notebook_status(nb),
+            "url": nb_api.url_prefix(nb),
+        }
+        if detail:
+            out["notebook"] = nb
+        return out

@@ -1,0 +1,82 @@
+"""Spawner UI configuration (reference: jupyter/.../spawner_ui_config.yaml).
+
+Every form field carries {value, readOnly}: readOnly pins the admin default
+and ignores user input (form.py:17-49 ``get_form_value`` semantics).  The TPU
+section replaces the reference's ``gpus`` vendor list: users pick a slice
+type from parallel.mesh.TOPOLOGIES instead of an nvidia.com/gpu count.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+from kubeflow_tpu.parallel.mesh import TOPOLOGIES
+
+DEFAULT_CONFIG: dict[str, Any] = {
+    "image": {
+        "value": "kubeflow-tpu/jupyter-jax:latest",
+        "options": [
+            # TPU-VM-ready images (SURVEY.md §2.9: replace the CUDA "-full"
+            # variants with jax[tpu] images)
+            "kubeflow-tpu/jupyter-jax:latest",
+            "kubeflow-tpu/jupyter-jax-full:latest",
+            "kubeflow-tpu/jupyter-scipy:latest",
+            "kubeflow-tpu/codeserver-jax:latest",
+            "kubeflow-tpu/rstudio-tidyverse:latest",
+        ],
+        "readOnly": False,
+    },
+    "cpu": {"value": "0.5", "limitFactor": 1.2, "readOnly": False},
+    "memory": {"value": "1.0Gi", "limitFactor": 1.2, "readOnly": False},
+    "tpu": {
+        "value": {"count": 0, "slice": "none"},
+        "options": ["none"] + sorted(
+            t for t in TOPOLOGIES if TOPOLOGIES[t].hosts == 1),
+        "resource": "cloud-tpu.google.com/v5e",
+        "readOnly": False,
+    },
+    "workspaceVolume": {
+        "value": {
+            "mount": "/home/jovyan",
+            "newPvc": {
+                "metadata": {"name": "{notebook-name}-workspace"},
+                "spec": {"resources": {"requests": {"storage": "10Gi"}},
+                         "accessModes": ["ReadWriteOnce"]},
+            },
+        },
+        "readOnly": False,
+    },
+    "dataVolumes": {"value": [], "readOnly": False},
+    "affinityConfig": {"value": "", "options": [], "readOnly": False},
+    "tolerationGroup": {
+        "value": "none",
+        "options": [
+            {"groupKey": "none", "displayName": "No toleration",
+             "tolerations": []},
+            {"groupKey": "tpu-preemptible",
+             "displayName": "Preemptible TPU slice",
+             "tolerations": [{"key": "cloud.google.com/gke-preemptible",
+                              "operator": "Equal", "value": "true",
+                              "effect": "NoSchedule"}]},
+        ],
+        "readOnly": False,
+    },
+    "configurations": {"value": [], "readOnly": False},
+    "shm": {"value": True, "readOnly": False},
+    "environment": {"value": {}, "readOnly": True},
+}
+
+
+def get_config() -> dict:
+    return copy.deepcopy(DEFAULT_CONFIG)
+
+
+def get_form_value(body: dict, config: dict, field: str,
+                   body_field: str | None = None) -> Any:
+    """User input unless the field is readOnly (then the admin default wins);
+    mirrors apps/common/form.py get_form_value."""
+    spec = config.get(field, {})
+    if spec.get("readOnly"):
+        return spec.get("value")
+    return body.get(body_field or field, spec.get("value"))

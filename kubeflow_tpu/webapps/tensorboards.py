@@ -1,0 +1,61 @@
+"""Tensorboards backend (reference: crud-web-apps/tensorboards)."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import tensorboard as tb_api
+from kubeflow_tpu.webapps.crud_backend import CrudApp, Request, workload_status
+
+
+class TensorboardsApp(CrudApp):
+    prefix = "/tensorboards"
+
+    def __init__(self, server):
+        super().__init__(server)
+        self.add_route("GET", "/api/namespaces/<ns>/tensorboards", self.list_)
+        self.add_route("POST", "/api/namespaces/<ns>/tensorboards", self.post)
+        self.add_route("GET", "/api/namespaces/<ns>/tensorboards/<name>",
+                       self.get)
+        self.add_route("DELETE", "/api/namespaces/<ns>/tensorboards/<name>",
+                       self.delete)
+
+    def list_(self, req: Request):
+        ns = req.params["ns"]
+        req.authorize("list", tb_api.KIND, ns)
+        return "200 OK", {"tensorboards": [
+            self._view(tb) for tb in
+            self.server.list(tb_api.KIND, namespace=ns)]}
+
+    def get(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("get", tb_api.KIND, ns)
+        return "200 OK", {"tensorboard":
+                          self._view(self.server.get(tb_api.KIND, name, ns))}
+
+    def post(self, req: Request):
+        ns = req.params["ns"]
+        req.authorize("create", tb_api.KIND, ns)
+        body = req.json()
+        name = body.get("name")
+        logspath = body.get("logspath")
+        if not name or not logspath:
+            raise ValueError("name and logspath required")
+        tb_api.parse_logspath(logspath)  # validate before creating
+        created = self.server.create(tb_api.new(name, ns, logspath))
+        return "201 Created", {"tensorboard": self._view(created),
+                               "success": True}
+
+    def delete(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("delete", tb_api.KIND, ns)
+        self.server.delete(tb_api.KIND, name, ns)
+        return "200 OK", {"success": True}
+
+    def _view(self, tb: dict) -> dict:
+        md = tb["metadata"]
+        return {
+            "name": md["name"],
+            "namespace": md.get("namespace"),
+            "logspath": tb["spec"].get("logspath"),
+            "status": workload_status(tb),
+            "url": f"/tensorboard/{md.get('namespace')}/{md['name']}/",
+        }

@@ -1,0 +1,81 @@
+"""Volumes backend (reference: crud-web-apps/volumes): PVC CRUD + usage."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.utils.status import Phase, make_status
+from kubeflow_tpu.webapps.crud_backend import CrudApp, Request
+
+KIND = "PersistentVolumeClaim"
+
+
+class VolumesApp(CrudApp):
+    prefix = "/volumes"
+
+    def __init__(self, server):
+        super().__init__(server)
+        self.add_route("GET", "/api/namespaces/<ns>/pvcs", self.list_)
+        self.add_route("POST", "/api/namespaces/<ns>/pvcs", self.post)
+        self.add_route("GET", "/api/namespaces/<ns>/pvcs/<name>", self.get)
+        self.add_route("DELETE", "/api/namespaces/<ns>/pvcs/<name>",
+                       self.delete)
+
+    def list_(self, req: Request):
+        ns = req.params["ns"]
+        req.authorize("list", KIND, ns)
+        pods = self.server.list("Pod", namespace=ns)
+        out = []
+        for pvc in self.server.list(KIND, namespace=ns):
+            out.append(self._view(pvc, pods))
+        return "200 OK", {"pvcs": out}
+
+    def get(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("get", KIND, ns)
+        pvc = self.server.get(KIND, name, ns)
+        pods = self.server.list("Pod", namespace=ns)
+        return "200 OK", {"pvc": self._view(pvc, pods)}
+
+    def post(self, req: Request):
+        ns = req.params["ns"]
+        req.authorize("create", KIND, ns)
+        body = req.json()
+        name = body.get("name") or body.get("metadata", {}).get("name")
+        if not name:
+            raise ValueError("pvc name required")
+        spec = body.get("spec") or {
+            "accessModes": [body.get("mode", "ReadWriteOnce")],
+            "resources": {"requests": {"storage":
+                                       body.get("size", "10Gi")}},
+            "storageClassName": body.get("class"),
+        }
+        created = self.server.create(api_object(KIND, name, ns, spec=spec))
+        return "201 Created", {"pvc": self._view(created, []),
+                               "success": True}
+
+    def delete(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("delete", KIND, ns)
+        self.server.delete(KIND, name, ns)
+        return "200 OK", {"success": True}
+
+    def _view(self, pvc: dict, pods: list[dict]) -> dict:
+        md = pvc["metadata"]
+        used_by = [p["metadata"]["name"] for p in pods
+                   if any(v.get("persistentVolumeClaim", {})
+                          .get("claimName") == md["name"]
+                          for v in p["spec"].get("volumes", []))]
+        if md.get("deletionTimestamp"):
+            status = make_status(Phase.TERMINATING, "Deleting.")
+        else:
+            status = make_status(Phase.READY, "Bound.")
+        return {
+            "name": md["name"],
+            "namespace": md.get("namespace"),
+            "size": (pvc["spec"].get("resources", {})
+                     .get("requests", {}).get("storage")),
+            "modes": pvc["spec"].get("accessModes", []),
+            "class": pvc["spec"].get("storageClassName"),
+            "usedBy": used_by,
+            "status": status,
+        }

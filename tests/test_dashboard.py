@@ -1,0 +1,143 @@
+"""Dashboard aggregation server (reference: centraldashboard behavior)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api import profile as profile_api
+from kubeflow_tpu.controllers.profile import register as register_profile
+from kubeflow_tpu.core import APIServer, Manager, api_object
+from kubeflow_tpu.core.httpapi import serve
+from kubeflow_tpu.platform import build_wsgi_app
+
+
+@pytest.fixture()
+def stack():
+    server = APIServer()
+    mgr = Manager(server)
+    register_profile(server, mgr)
+    mgr.start()
+    httpd, _ = serve(build_wsgi_app(server), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    server.create(profile_api.new("team-a", "alice@corp.com"))
+    server.create(profile_api.new("team-b", "bob@corp.com"))
+    assert mgr.wait_idle(timeout=15)
+    yield server, mgr, base
+    httpd.shutdown()
+    mgr.stop()
+
+
+def req(base, path, method="GET", body=None, user=None):
+    headers = {}
+    if user:
+        headers["X-Goog-Authenticated-User-Email"] = (
+            "accounts.google.com:" + user)
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(base + path, data=data, method=method,
+                               headers=headers)
+    with urllib.request.urlopen(r) as resp:
+        return resp.status, json.loads(resp.read() or b"null")
+
+
+def test_namespaces_visible_by_role(stack):
+    server, mgr, base = stack
+    _, ns = req(base, "/dashboard/api/namespaces", user="alice@corp.com")
+    assert {"namespace": "team-a", "role": "owner"} in ns
+    assert all(n["namespace"] != "team-b" for n in ns)
+
+
+def test_workgroup_exists_and_envinfo(stack):
+    _, _, base = stack
+    _, out = req(base, "/dashboard/api/workgroup/exists",
+                 user="alice@corp.com")
+    assert out["hasWorkgroup"] is True
+    _, out = req(base, "/dashboard/api/workgroup/exists",
+                 user="newbie@corp.com")
+    assert out["hasWorkgroup"] is False
+    _, info = req(base, "/dashboard/api/workgroup/env-info",
+                  user="alice@corp.com")
+    assert info["platform"]["provider"] == "tpu"
+    assert info["isClusterAdmin"] is False
+
+
+def test_metrics_endpoint(stack):
+    server, _, base = stack
+    pod = api_object("Pod", "p", "team-a", spec={
+        "containers": [{"name": "c", "resources": {
+            "requests": {"memory": "2Gi"},
+            "limits": {"cloud-tpu.google.com/v5e": 4}}}]})
+    server.create(pod)
+    server.patch_status("Pod", "p", "team-a", {"phase": "Running"})
+    _, series = req(base, "/dashboard/api/metrics/tpuduty?interval=Last5m",
+                    user="alice@corp.com")
+    assert series[-1]["value"] == 4.0
+    _, series = req(base, "/dashboard/api/metrics/podmem?interval=Last5m",
+                    user="alice@corp.com")
+    assert series[-1]["value"] == 2 * 2**30
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "/dashboard/api/metrics/bogus", user="alice@corp.com")
+    assert e.value.code == 422
+
+
+def test_dashboard_links_and_shell(stack):
+    _, _, base = stack
+    _, links = req(base, "/dashboard/api/dashboard-links")
+    texts = [l["text"] for l in links["menuLinks"]]
+    assert "Notebooks" in texts and "JAXJobs (Training)" in texts
+    with urllib.request.urlopen(base + "/ui/") as r:
+        html = r.read().decode()
+    assert "Kubeflow TPU" in html and "iframe" in html
+
+
+class Session:
+    """Cookie-carrying client (browser-style CSRF double-submit)."""
+
+    def __init__(self, base, user):
+        self.base, self.user, self.cookie = base, user, None
+        self.req("/dashboard/api/dashboard-links")  # prime CSRF cookie
+
+    def req(self, path, method="GET", body=None):
+        headers = {"X-Goog-Authenticated-User-Email":
+                   "accounts.google.com:" + self.user}
+        if self.cookie:
+            headers["Cookie"] = f"XSRF-TOKEN={self.cookie}"
+            headers["X-XSRF-TOKEN"] = self.cookie
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(self.base + path, data=data,
+                                   method=method, headers=headers)
+        with urllib.request.urlopen(r) as resp:
+            sc = resp.headers.get("Set-Cookie", "")
+            if "XSRF-TOKEN=" in sc:
+                self.cookie = sc.split("XSRF-TOKEN=")[1].split(";")[0]
+            return resp.status, json.loads(resp.read() or b"null")
+
+
+def test_contributor_flow_via_dashboard(stack):
+    server, _, base = stack
+    alice = Session(base, "alice@corp.com")
+    code, contributors = alice.req(
+        "/dashboard/api/workgroup/add-contributor", "POST",
+        {"namespace": "team-a", "contributor": "carol@corp.com"})
+    assert "carol@corp.com" in contributors
+    _, ns = req(base, "/dashboard/api/namespaces", user="carol@corp.com")
+    assert {"namespace": "team-a", "role": "contributor"} in ns
+    code, contributors = alice.req(
+        "/dashboard/api/workgroup/remove-contributor", "POST",
+        {"namespace": "team-a", "contributor": "carol@corp.com"})
+    assert contributors == []
+
+
+def test_all_namespaces_admin_only(stack):
+    server, _, base = stack
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "/dashboard/api/workgroup/get-all-namespaces",
+            user="alice@corp.com")
+    assert e.value.code == 403
+    server.create(api_object("ClusterRoleBinding", "root", spec={
+        "subjects": [{"kind": "User", "name": "root@corp.com"}],
+        "roleRef": {"kind": "ClusterRole", "name": "kubeflow-admin"}}))
+    _, out = req(base, "/dashboard/api/workgroup/get-all-namespaces",
+                 user="root@corp.com")
+    assert {"namespace": "team-a", "owner": "alice@corp.com"} in out

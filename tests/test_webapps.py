@@ -1,0 +1,171 @@
+"""CRUD web backends over HTTP: authn, SubjectAccessReview authz, CSRF,
+spawner flow (reference: crud-web-apps behavior)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api import profile as profile_api
+from kubeflow_tpu.controllers.executor import FakeExecutor
+from kubeflow_tpu.controllers.notebook import register as register_nb
+from kubeflow_tpu.controllers.profile import register as register_profile
+from kubeflow_tpu.controllers.tensorboard import register as register_tb
+from kubeflow_tpu.core import APIServer, Manager
+from kubeflow_tpu.core.httpapi import serve
+from kubeflow_tpu.platform import build_wsgi_app
+
+
+@pytest.fixture()
+def stack():
+    server = APIServer()
+    mgr = Manager(server)
+    register_profile(server, mgr)
+    register_nb(server, mgr)
+    register_tb(server, mgr)
+    from kubeflow_tpu.admission.webhook import register as register_adm
+
+    register_adm(server)
+    mgr.add(FakeExecutor(server, complete=False))
+    mgr.start()
+    httpd, _ = serve(build_wsgi_app(server), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    # tenancy bootstrap: alice owns namespace team
+    server.create(profile_api.new("team", "alice@corp.com"))
+    assert mgr.wait_idle(timeout=15)
+    yield server, mgr, base
+    httpd.shutdown()
+    mgr.stop()
+
+
+class Client:
+    """Carries identity + CSRF cookie like a browser session."""
+
+    def __init__(self, base, user=None):
+        self.base = base
+        self.user = user
+        self.cookie = None
+        # prime the CSRF cookie with a safe request
+        self.req("/jupyter/healthz")
+
+    def req(self, path, method="GET", body=None):
+        headers = {}
+        if self.user:
+            headers["X-Goog-Authenticated-User-Email"] = (
+                "accounts.google.com:" + self.user)
+        if self.cookie:
+            headers["Cookie"] = f"XSRF-TOKEN={self.cookie}"
+            headers["X-XSRF-TOKEN"] = self.cookie
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(self.base + path, data=data,
+                                   method=method, headers=headers)
+        with urllib.request.urlopen(r) as resp:
+            set_cookie = resp.headers.get("Set-Cookie", "")
+            if "XSRF-TOKEN=" in set_cookie:
+                self.cookie = set_cookie.split("XSRF-TOKEN=")[1].split(";")[0]
+            return resp.status, json.loads(resp.read() or b"null")
+
+
+def test_spawner_full_flow(stack):
+    server, mgr, base = stack
+    alice = Client(base, "alice@corp.com")
+
+    code, cfg = alice.req("/jupyter/api/config")
+    assert "kubeflow-tpu/jupyter-jax:latest" in cfg["config"]["image"][
+        "options"]
+
+    code, created = alice.req("/jupyter/api/namespaces/team/notebooks",
+                              "POST", {"name": "nb1",
+                                       "image": "kubeflow-tpu/jupyter-jax:latest",
+                                       "tpu": {"slice": "v5e-4"}})
+    assert code == 201
+    assert mgr.wait_idle(timeout=15)
+
+    # workspace PVC was created and mounted
+    pvc = server.get("PersistentVolumeClaim", "nb1-workspace", "team")
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "10Gi"
+
+    code, listing = alice.req("/jupyter/api/namespaces/team/notebooks")
+    nb = listing["notebooks"][0]
+    assert nb["name"] == "nb1"
+    assert nb["tpus"] == {"cloud-tpu.google.com/v5e": 4}
+    assert nb["status"]["phase"] == "ready"
+    assert nb["url"] == "/notebook/team/nb1/"
+
+    # stop -> status stopped
+    code, _ = alice.req("/jupyter/api/namespaces/team/notebooks/nb1",
+                        "PATCH", {"stopped": True})
+    import time
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        _, listing = alice.req("/jupyter/api/namespaces/team/notebooks")
+        if listing["notebooks"][0]["status"]["phase"] == "stopped":
+            break
+        time.sleep(0.1)
+    assert listing["notebooks"][0]["status"]["phase"] == "stopped"
+
+    code, _ = alice.req("/jupyter/api/namespaces/team/notebooks/nb1",
+                        "DELETE")
+    _, listing = alice.req("/jupyter/api/namespaces/team/notebooks")
+    assert listing["notebooks"] == []
+
+
+def test_authz_blocks_non_members(stack):
+    server, mgr, base = stack
+    mallory = Client(base, "mallory@corp.com")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        mallory.req("/jupyter/api/namespaces/team/notebooks")
+    assert e.value.code == 403
+
+
+def test_missing_identity_rejected(stack):
+    _, _, base = stack
+    anon = Client(base)  # healthz works without identity (no_auth)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        anon.req("/jupyter/api/namespaces/team/notebooks")
+    assert e.value.code == 401
+
+
+def test_csrf_required_for_writes(stack):
+    _, _, base = stack
+    headers = {"X-Goog-Authenticated-User-Email":
+               "accounts.google.com:alice@corp.com"}
+    r = urllib.request.Request(
+        base + "/jupyter/api/namespaces/team/notebooks",
+        data=b"{}", method="POST", headers=headers)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(r)
+    assert e.value.code == 403  # no CSRF cookie/header
+
+
+def test_multihost_slice_rejected_for_notebook(stack):
+    _, _, base = stack
+    alice = Client(base, "alice@corp.com")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        alice.req("/jupyter/api/namespaces/team/notebooks", "POST",
+                  {"name": "big", "tpu": {"slice": "v5e-32"}})
+    assert e.value.code == 422
+    body = json.loads(e.value.read())
+    assert "JAXJob" in body["error"]
+
+
+def test_volumes_and_tensorboards_apps(stack):
+    server, mgr, base = stack
+    alice = Client(base, "alice@corp.com")
+    code, _ = alice.req("/volumes/api/namespaces/team/pvcs", "POST",
+                        {"name": "data", "size": "50Gi"})
+    assert code == 201
+    code, out = alice.req("/volumes/api/namespaces/team/pvcs")
+    assert out["pvcs"][0]["size"] == "50Gi"
+
+    code, _ = alice.req("/tensorboards/api/namespaces/team/tensorboards",
+                        "POST", {"name": "tb", "logspath": "pvc://data/logs"})
+    assert code == 201
+    assert mgr.wait_idle(timeout=15)
+    code, out = alice.req("/tensorboards/api/namespaces/team/tensorboards")
+    assert out["tensorboards"][0]["status"]["phase"] == "ready"
+    # volumes app reports the tensorboard pod as a user
+    code, out = alice.req("/volumes/api/namespaces/team/pvcs")
+    assert out["pvcs"][0]["usedBy"] == ["tb-0"]
