@@ -1,0 +1,15 @@
+"""CI/CD tooling (reference: py/kubeflow/kubeflow/{ci,cd} + prow_config.yaml).
+
+Path-filtered, per-component pipelines: ``COMPONENTS`` maps component names
+to include_dirs (the prow_config.yaml pattern); ``generate_workflow`` emits a
+declarative workflow spec per component (the ArgoTestBuilder analog); the CLI
+runs the affected pipelines locally (`python -m kubeflow_tpu.ci --changed`).
+"""
+
+from kubeflow_tpu.ci.pipelines import (
+    COMPONENTS,
+    changed_components,
+    generate_workflow,
+)
+
+__all__ = ["COMPONENTS", "changed_components", "generate_workflow"]

@@ -1,0 +1,54 @@
+"""CI CLI: ``python -m kubeflow_tpu.ci [--changed BASE | --all | names...]``"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubeflow_tpu.ci.pipelines import (
+    COMPONENTS,
+    changed_components,
+    generate_workflow,
+    git_changed_files,
+    run_local,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("kubeflow_tpu.ci")
+    parser.add_argument("components", nargs="*",
+                        help="component pipelines to run")
+    parser.add_argument("--changed", metavar="BASE",
+                        help="run pipelines affected since git BASE")
+    parser.add_argument("--all", action="store_true")
+    parser.add_argument("--emit", action="store_true",
+                        help="print workflow specs instead of running")
+    args = parser.parse_args(argv)
+
+    if args.all:
+        selected = sorted(COMPONENTS)
+    elif args.changed:
+        selected = changed_components(git_changed_files(args.changed))
+    elif args.components:
+        unknown = set(args.components) - set(COMPONENTS)
+        if unknown:
+            parser.error(f"unknown components: {sorted(unknown)}")
+        selected = args.components
+    else:
+        parser.error("give component names, --changed BASE, or --all")
+
+    if args.emit:
+        for name in selected:
+            print(json.dumps(generate_workflow(name)))
+        return 0
+
+    print(f"running pipelines: {', '.join(selected)}", flush=True)
+    results = run_local(selected)
+    for name, ok in results.items():
+        print(f"  {name}: {'PASS' if ok else 'FAIL'}")
+    return 0 if all(results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from kubeflow_tpu.api import jaxjob as api
 from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.events import record_event
 from kubeflow_tpu.core.objects import api_object, set_condition, set_owner
 from kubeflow_tpu.core.store import NotFound
 from kubeflow_tpu.parallel.mesh import TOPOLOGIES
@@ -81,6 +82,9 @@ class JAXJobController(Controller):
                                          status)
                 return None
             JOB_RESTARTS.inc()
+            record_event(self.server, job, "Warning", "GangRestart",
+                         f"worker failed; restarting gang "
+                         f"(attempt {restarts + 1})")
             status["phase"] = "Restarting"
             status["restarts"] = restarts + 1
             self.server.patch_status(api.KIND, req.name, req.namespace,

@@ -12,6 +12,7 @@ import copy
 from kubeflow_tpu.api import notebook as api
 from kubeflow_tpu.controllers.culler import Culler
 from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.events import record_event
 from kubeflow_tpu.core.objects import api_object, set_condition, set_owner
 from kubeflow_tpu.core.store import NotFound
 from kubeflow_tpu.utils.config import Config, config_field
@@ -53,6 +54,8 @@ class NotebookController(Controller):
         if uid not in self._seen:
             self._seen.add(uid)
             CREATED.inc()
+            record_event(self.server, nb, "Normal", "Created",
+                         "Notebook resources are being provisioned")
 
         self._ensure_statefulset(nb)
         self._ensure_service(nb)
@@ -72,6 +75,8 @@ class NotebookController(Controller):
                         dt.timezone.utc).isoformat()
                     self.server.update(fresh)
                     CULLED.inc()
+                    record_event(self.server, fresh, "Normal", "Culled",
+                                 "Notebook idle past threshold; stopping")
             return Result(requeue_after=self.culler.check_period_s)
         return None
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 import copy
 
 from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.events import record_event
 from kubeflow_tpu.core.objects import api_object, set_owner
 from kubeflow_tpu.core.store import Conflict, Invalid, NotFound
 
@@ -64,6 +65,9 @@ class _TemplateWorkloadController(Controller):
                     # admission rejection: surface it, keep reconciling, and
                     # retry periodically (the conflicting PodDefault may be
                     # removed and nothing else would requeue us)
+                    if admission_failure is None:
+                        record_event(self.server, obj, "Warning",
+                                     "AdmissionRejected", str(e))
                     admission_failure = str(e)
         for name, pod in by_name.items():
             if name not in want_names:

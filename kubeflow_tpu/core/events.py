@@ -1,0 +1,67 @@
+"""Event recording: the activity feed's data source.
+
+The reference surfaces k8s Events as the dashboard activity feed (api.ts:66)
+and re-emits child events onto Notebook CRs (notebook_controller.go:90-109).
+Controllers here record Events directly against the involved object.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.core.store import APIServer, Conflict, NotFound
+
+
+def record_event(server: APIServer, involved: dict, type_: str, reason: str,
+                 message: str = "") -> None:
+    """type_: Normal | Warning (k8s convention).
+
+    Repeats of the same (object, reason) aggregate into one Event with a
+    bumped count/lastTimestamp (k8s EventRecorder behavior) — a stuck
+    controller retrying every few seconds must not flood the store.
+    """
+    md = involved["metadata"]
+    slug = re.sub(r"[^a-z0-9.-]", "-", reason.lower())
+    name = f"{md['name']}.{slug}"
+    now = time.time()
+    try:
+        existing = server.get("Event", name, md.get("namespace"))
+        if existing["spec"].get("involvedObject", {}).get("uid") == \
+                md.get("uid"):
+            existing["spec"]["count"] = existing["spec"].get("count", 1) + 1
+            existing["spec"]["lastTimestamp"] = now
+            existing["spec"]["message"] = message
+            try:
+                server.update(existing)
+                return
+            except Conflict:
+                return  # racing writer already bumped it
+        server.delete("Event", name, md.get("namespace"))  # stale incarnation
+    except NotFound:
+        pass
+    event = api_object("Event", name, md.get("namespace"), spec={
+        "involvedObject": {"kind": involved.get("kind"),
+                           "name": md["name"],
+                           "namespace": md.get("namespace"),
+                           "uid": md.get("uid")},
+        "type": type_,
+        "reason": reason,
+        "message": message,
+        "count": 1,
+        "lastTimestamp": now,
+    })
+    try:
+        server.create(event)
+    except Conflict:
+        pass  # racing writer created it first
+
+
+def events_for(server: APIServer, kind: str, name: str,
+               namespace: str | None) -> list[dict]:
+    out = [e for e in server.list("Event", namespace=namespace)
+           if e["spec"].get("involvedObject", {}).get("name") == name
+           and e["spec"]["involvedObject"].get("kind") == kind]
+    out.sort(key=lambda e: e["spec"].get("lastTimestamp", 0), reverse=True)
+    return out

@@ -39,6 +39,8 @@ class TrainerConfig:
     sp: int = 1
     grad_accum: int = 1
     data_path: str | None = None                  # .npz on a PVC; else synthetic
+    profile_dir: str | None = None                # XLA trace capture window
+    profile_steps: int = 5                        # window length in steps
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "TrainerConfig":
@@ -125,10 +127,17 @@ class Trainer:
                 lambda x, s: jax.make_array_from_process_local_data(
                     s, np.asarray(x)), batch, bshard)
 
+        from kubeflow_tpu.utils.profiler import StepWindowTracer
+
+        # capture a bounded trace window (step 1 onward skips the compile)
+        tracer = StepWindowTracer(cfg.profile_dir,
+                                  start_step=start_step + 1,
+                                  num_steps=cfg.profile_steps)
         t0 = time.perf_counter()
         metrics = {}
         with mesh:
             for step in range(start_step, cfg.steps):
+                tracer.on_step(step)
                 batch = example if step == start_step else next(data_iter)
                 state, metrics = step_fn(state, put_batch(batch))
                 if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
@@ -144,6 +153,7 @@ class Trainer:
                 if (ckpt and cfg.checkpoint_every
                         and (step + 1) % cfg.checkpoint_every == 0):
                     ckpt.save(step + 1, state)
+        tracer.close()
         if ckpt:
             ckpt.save(cfg.steps, state, wait=True)
             ckpt.close()

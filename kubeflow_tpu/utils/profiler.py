@@ -1,0 +1,92 @@
+"""XLA/JAX profiling as a first-class subsystem (SURVEY.md §5.1 gap: the
+reference has none; the TPU build plans trace export from day one).
+
+Traces are viewable in TensorBoard's profile plugin or Perfetto; the Trainer
+captures a window of steps when ``profile_dir`` is set, and the Tensorboard
+controller can point at the same directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger("profiler")
+
+
+@contextlib.contextmanager
+def trace(directory: str | None):
+    """Capture an XLA trace into ``directory`` (no-op when None).  Callers
+    must bound the region to a few steps — trace buffers grow with every
+    dispatched op (see StepWindowTracer for loop integration)."""
+    if not directory:
+        yield
+        return
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    log.info("profiler trace start", directory=directory)
+    with jax.profiler.trace(directory):
+        yield
+    log.info("profiler trace written", directory=directory)
+
+
+class StepWindowTracer:
+    """Captures exactly ``num_steps`` loop iterations starting at
+    ``start_step`` — call ``on_step(step)`` at the top of each iteration and
+    ``close()`` after the loop (idempotent)."""
+
+    def __init__(self, directory: str | None, start_step: int,
+                 num_steps: int = 5):
+        self.directory = directory
+        self.start = start_step
+        self.stop_at = start_step + num_steps
+        self._active = False
+
+    def on_step(self, step: int) -> None:
+        if not self.directory:
+            return
+        import jax
+
+        if step == self.start and not self._active:
+            os.makedirs(self.directory, exist_ok=True)
+            jax.profiler.start_trace(self.directory)
+            self._active = True
+            log.info("profiler window start", step=step,
+                     directory=self.directory)
+        elif step >= self.stop_at and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler window written", directory=self.directory)
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler window written", directory=self.directory)
+
+
+def annotate(name: str):
+    """Named region for the trace timeline (TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats() -> dict:
+    """Per-device HBM usage as reported by the runtime (bytes)."""
+    import jax
+
+    out = {}
+    for d in jax.devices():
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            out[str(d)] = {"bytes_in_use": stats.get("bytes_in_use"),
+                           "peak_bytes_in_use":
+                           stats.get("peak_bytes_in_use"),
+                           "bytes_limit": stats.get("bytes_limit")}
+    return out

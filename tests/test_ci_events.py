@@ -1,0 +1,62 @@
+"""CI pipeline generation + event recording."""
+
+import json
+import subprocess
+import sys
+
+from kubeflow_tpu.ci.pipelines import (
+    COMPONENTS,
+    changed_components,
+    generate_workflow,
+)
+from kubeflow_tpu.core import APIServer, api_object
+from kubeflow_tpu.core.events import events_for, record_event
+
+
+def test_changed_components_path_filtering():
+    assert changed_components(["kubeflow_tpu/hpo/suggestion.py"]) == ["hpo"]
+    assert changed_components(
+        ["kubeflow_tpu/controllers/jaxjob.py"]) == ["jaxjob"]
+    # a file outside every component triggers everything
+    assert changed_components(["bench.py"]) == sorted(COMPONENTS)
+    both = changed_components(["kubeflow_tpu/hpo/controller.py",
+                               "kubeflow_tpu/serving/predictor.py"])
+    assert both == ["hpo", "serving"]
+
+
+def test_generate_workflow_dag():
+    wf = generate_workflow("core")
+    names = [s["name"] for s in wf["spec"]["steps"]]
+    assert names == ["checkout", "build", "test"]
+    wf = generate_workflow("serving")
+    assert [s["name"] for s in wf["spec"]["steps"]][-1] == "build-image"
+
+
+def test_ci_cli_emit():
+    out = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.ci", "hpo", "--emit"],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    wf = json.loads(out.stdout.strip())
+    assert wf["metadata"]["name"] == "ci-hpo"
+
+
+def test_event_recording_and_lookup():
+    server = APIServer()
+    nb = server.create(api_object("Notebook", "nb", "team"))
+    record_event(server, nb, "Normal", "Created", "hello")
+    record_event(server, nb, "Warning", "Broken", "oh no")
+    evs = events_for(server, "Notebook", "nb", "team")
+    assert len(evs) == 2
+    assert evs[0]["spec"]["reason"] == "Broken"  # newest first
+    assert events_for(server, "Notebook", "other", "team") == []
+
+
+def test_event_repeats_aggregate_not_flood():
+    server = APIServer()
+    nb = server.create(api_object("Notebook", "nb", "team"))
+    for _ in range(50):
+        record_event(server, nb, "Warning", "AdmissionRejected", "conflict")
+    evs = events_for(server, "Notebook", "nb", "team")
+    assert len(evs) == 1
+    assert evs[0]["spec"]["count"] == 50
