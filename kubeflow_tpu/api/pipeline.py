@@ -1,0 +1,59 @@
+"""PipelineRun: DAG workflow execution (the Pipelines integration point).
+
+The reference only labels namespaces for pipelines (profile_controller.go:71)
+— the engine lives elsewhere.  Here a minimal-but-real one is in-tree: a
+PipelineRun is a DAG of steps, each materialized as a pod when its
+dependencies succeed.  The CI workflow specs (ci/pipelines.generate_workflow)
+are directly runnable as PipelineRuns — same step shape {name, run, depends}.
+
+spec:
+  steps: [{name, run: [argv], image?, env?, depends: [step names]}]
+status:
+  phase: Pending|Running|Succeeded|Failed
+  steps: {name: {phase, podName}}
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.objects import api_object
+
+KIND = "PipelineRun"
+
+
+def new(name: str, namespace: str, steps: list[dict]) -> dict:
+    return api_object(KIND, name, namespace, spec={"steps": steps})
+
+
+def from_workflow(workflow: dict, namespace: str) -> dict:
+    """Adapt a ci.generate_workflow spec into a PipelineRun."""
+    return new(workflow["metadata"]["name"], namespace,
+               workflow["spec"]["steps"])
+
+
+def validate(run: dict) -> None:
+    steps = run.get("spec", {}).get("steps", [])
+    if not steps:
+        raise ValueError("PipelineRun needs at least one step")
+    names = [s.get("name") for s in steps]
+    if len(set(names)) != len(names) or not all(names):
+        raise ValueError("step names must be unique and non-empty")
+    known = set(names)
+    for s in steps:
+        for dep in s.get("depends", []):
+            if dep not in known:
+                raise ValueError(f"step {s['name']}: unknown dependency "
+                                 f"{dep!r}")
+    # cycle check (Kahn)
+    remaining = {s["name"]: set(s.get("depends", [])) for s in steps}
+    while remaining:
+        ready = [n for n, deps in remaining.items() if not deps]
+        if not ready:
+            raise ValueError(f"dependency cycle among {sorted(remaining)}")
+        for n in ready:
+            del remaining[n]
+        for deps in remaining.values():
+            deps.difference_update(ready)
+
+
+def step_pod_name(run_name: str, step_name: str) -> str:
+    return f"{run_name}-{step_name}"

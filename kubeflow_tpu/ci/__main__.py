@@ -29,7 +29,10 @@ def main(argv=None) -> int:
     if args.all:
         selected = sorted(COMPONENTS)
     elif args.changed:
-        selected = changed_components(git_changed_files(args.changed))
+        try:
+            selected = changed_components(git_changed_files(args.changed))
+        except RuntimeError as e:
+            parser.error(str(e))
     elif args.components:
         unknown = set(args.components) - set(COMPONENTS)
         if unknown:

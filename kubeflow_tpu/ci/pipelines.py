@@ -92,24 +92,31 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                      "tests/test_serving.py"],
         "image": "images/predictor",
     },
+    "pipelines": {
+        "include_dirs": ["kubeflow_tpu/controllers/pipeline.py",
+                         "kubeflow_tpu/api/pipeline.py",
+                         "kubeflow_tpu/core/events.py",
+                         "kubeflow_tpu/ci/*"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q",
+                     "tests/test_pipeline.py", "tests/test_ci_events.py"],
+    },
 }
 
 
 def changed_components(changed_files: list[str]) -> list[str]:
     """Path-filtered selection (prow_config.yaml include_dirs semantics);
     changes outside every component (e.g. bench.py) run everything."""
-    out = []
-    matched_any = set()
-    for name, spec in COMPONENTS.items():
-        for f in changed_files:
+    out: set[str] = set()
+    matched: set[str] = set()
+    for f in changed_files:
+        for name, spec in COMPONENTS.items():
             if any(fnmatch.fnmatch(f, pat) or f.startswith(
                     pat.rstrip("*")) for pat in spec["include_dirs"]):
-                out.append(name)
-                matched_any.add(f)
-                break
-    if any(f not in matched_any for f in changed_files):
+                out.add(name)
+                matched.add(f)
+    if set(changed_files) - matched:
         return sorted(COMPONENTS)
-    return sorted(set(out))
+    return sorted(out)
 
 
 def generate_workflow(component: str, *, no_push: bool = True) -> dict:
@@ -123,10 +130,12 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     steps.append({"name": "test", "run": spec["test_cmd"],
                   "depends": [steps[-1]["name"]]})
     if spec.get("image"):
+        # kaniko executor (the reference's builder): --no-push is the
+        # presubmit mode (ci/notebook_servers pattern)
         steps.append({"name": "build-image",
-                      "run": ["docker", "build", "-t",
-                              f"kubeflow-tpu/{component}:${{COMMIT_SHA}}",
-                              spec["image"]]
+                      "run": ["kaniko", "--context", spec["image"],
+                              "--destination",
+                              f"kubeflow-tpu/{component}:${{COMMIT_SHA}}"]
                       + (["--no-push"] if no_push else []),
                       "depends": ["test"]})
     return {"apiVersion": "kubeflow-tpu.org/v1", "kind": "Workflow",
@@ -151,4 +160,7 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
 def git_changed_files(base: str = "HEAD~1") -> list[str]:
     out = subprocess.run(["git", "diff", "--name-only", base],
                          capture_output=True, text=True)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"git diff against {base!r} failed: {out.stderr.strip()}")
     return [f for f in out.stdout.splitlines() if f]

@@ -1,0 +1,120 @@
+"""PipelineRun controller: DAG steps -> pods, dependency-gated.
+
+Level-triggered like everything else: each reconcile reads pod phases,
+creates pods for steps whose dependencies Succeeded, and rolls statuses up;
+a failed step fails the run and skips its dependents.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import pipeline as api
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.objects import api_object, set_condition, set_owner
+from kubeflow_tpu.core.store import Conflict, NotFound
+
+
+class PipelineRunController(Controller):
+    kind = api.KIND
+    owns = ("Pod",)
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            run = self.server.get(api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if run["metadata"].get("deletionTimestamp"):
+            return None
+        status = dict(run.get("status") or {})
+        if status.get("phase") in ("Succeeded", "Failed"):
+            return None
+        api.validate(run)
+
+        steps = run["spec"]["steps"]
+        step_status: dict[str, dict] = {
+            s["name"]: dict(status.get("steps", {}).get(
+                s["name"], {"phase": "Pending"}))
+            for s in steps}
+
+        # read pod phases into step statuses
+        for s in steps:
+            pod_name = api.step_pod_name(req.name, s["name"])
+            try:
+                pod = self.server.get("Pod", pod_name, req.namespace)
+                step_status[s["name"]] = {
+                    "phase": pod.get("status", {}).get("phase", "Pending"),
+                    "podName": pod_name,
+                }
+                if pod.get("status", {}).get("message"):
+                    step_status[s["name"]]["message"] = (
+                        pod["status"]["message"][-500:])
+            except NotFound:
+                pass
+
+        # propagate failure: dependents of a failed step are skipped
+        failed = {n for n, st in step_status.items()
+                  if st["phase"] == "Failed"}
+        changed = True
+        while changed:
+            changed = False
+            for s in steps:
+                if s["name"] in failed:
+                    continue
+                if any(d in failed for d in s.get("depends", [])):
+                    step_status[s["name"]] = {"phase": "Skipped"}
+                    failed.add(s["name"])
+                    changed = True
+
+        # launch ready steps
+        for s in steps:
+            st = step_status[s["name"]]
+            if st["phase"] != "Pending" or "podName" in st:
+                continue
+            deps_done = all(
+                step_status[d]["phase"] == "Succeeded"
+                for d in s.get("depends", []))
+            if not deps_done:
+                continue
+            pod = set_owner(api_object(
+                "Pod", api.step_pod_name(req.name, s["name"]), req.namespace,
+                labels={"pipelinerun": req.name, "step": s["name"]},
+                spec={"containers": [{
+                    "name": "step",
+                    "image": s.get("image", "kubeflow-tpu/ci:latest"),
+                    "command": list(s.get("run", [])),
+                    "env": [{"name": k, "value": str(v)}
+                            for k, v in (s.get("env") or {}).items()],
+                }], "restartPolicy": "Never"}), run)
+            try:
+                self.server.create(pod)
+                step_status[s["name"]] = {
+                    "phase": "Pending",
+                    "podName": pod["metadata"]["name"]}
+            except Conflict:
+                pass
+
+        phases = [st["phase"] for st in step_status.values()]
+        if any(p in ("Failed", "Skipped") for p in phases) and all(
+                p in ("Succeeded", "Failed", "Skipped") for p in phases):
+            status["phase"] = "Failed"
+            set_condition(run, "Complete", "False", reason="StepFailed")
+            status["conditions"] = run["status"]["conditions"]
+        elif all(p == "Succeeded" for p in phases):
+            status["phase"] = "Succeeded"
+            set_condition(run, "Complete", "True", reason="AllStepsDone")
+            status["conditions"] = run["status"]["conditions"]
+        elif any(p == "Running" for p in phases):
+            status["phase"] = "Running"
+        else:
+            status["phase"] = status.get("phase", "Pending") \
+                if status.get("phase") != "Pending" else (
+                    "Running" if any(p != "Pending" for p in phases)
+                    else "Pending")
+        status["steps"] = step_status
+        self.server.patch_status(api.KIND, req.name, req.namespace, status)
+        return None
+
+
+def register(server, mgr) -> None:
+    server.register_validating_hook(
+        lambda o: api.validate(o) if o.get("kind") == api.KIND else None)
+    mgr.add(PipelineRunController(server))

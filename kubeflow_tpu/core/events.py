@@ -24,7 +24,9 @@ def record_event(server: APIServer, involved: dict, type_: str, reason: str,
     """
     md = involved["metadata"]
     slug = re.sub(r"[^a-z0-9.-]", "-", reason.lower())
-    name = f"{md['name']}.{slug}"
+    # kind in the name: a Notebook and a JAXJob sharing a name must not
+    # fight over one Event object
+    name = f"{(involved.get('kind') or 'object').lower()}.{md['name']}.{slug}"
     now = time.time()
     try:
         existing = server.get("Event", name, md.get("namespace"))

@@ -88,6 +88,12 @@ def _register_optional(server, mgr, enable: set[str] | None) -> None:
         registry.append(_isvc.register)
     except ImportError:
         pass
+    try:
+        from kubeflow_tpu.controllers import pipeline as _pl
+
+        registry.append(_pl.register)
+    except ImportError:
+        pass
     for reg in registry:
         reg(server, mgr)
 

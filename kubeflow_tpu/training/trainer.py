@@ -135,25 +135,31 @@ class Trainer:
                                   num_steps=cfg.profile_steps)
         t0 = time.perf_counter()
         metrics = {}
-        with mesh:
-            for step in range(start_step, cfg.steps):
-                tracer.on_step(step)
-                batch = example if step == start_step else next(data_iter)
-                state, metrics = step_fn(state, put_batch(batch))
-                if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
-                    loss = float(metrics["loss"])  # sync point
-                    dt = time.perf_counter() - t0
-                    done = step + 1 - start_step
-                    rec = {"step": step + 1, "loss": loss,
-                           "samples_per_sec": cfg.global_batch * done / dt}
-                    self.history.append(rec)
-                    self.log.info("train", **rec)
-                    if self._metrics_hook:
-                        self._metrics_hook(step + 1, rec)
-                if (ckpt and cfg.checkpoint_every
-                        and (step + 1) % cfg.checkpoint_every == 0):
-                    ckpt.save(step + 1, state)
-        tracer.close()
+        try:
+            with mesh:
+                for step in range(start_step, cfg.steps):
+                    tracer.on_step(step)
+                    batch = (example if step == start_step
+                             else next(data_iter))
+                    state, metrics = step_fn(state, put_batch(batch))
+                    if ((step + 1) % cfg.log_every == 0
+                            or step + 1 == cfg.steps):
+                        loss = float(metrics["loss"])  # sync point
+                        dt = time.perf_counter() - t0
+                        done = step + 1 - start_step
+                        rec = {"step": step + 1, "loss": loss,
+                               "samples_per_sec":
+                               cfg.global_batch * done / dt}
+                        self.history.append(rec)
+                        self.log.info("train", **rec)
+                        if self._metrics_hook:
+                            self._metrics_hook(step + 1, rec)
+                    if (ckpt and cfg.checkpoint_every
+                            and (step + 1) % cfg.checkpoint_every == 0):
+                        ckpt.save(step + 1, state)
+        finally:
+            # a failing step is exactly when the trace matters: always flush
+            tracer.close()
         if ckpt:
             ckpt.save(cfg.steps, state, wait=True)
             ckpt.close()

@@ -1,0 +1,87 @@
+"""Control-plane load test (reference: notebook-controller/loadtest/
+start_notebooks.py — which only applied YAMLs against a live cluster and left
+observation to the operator).  This one measures: spawn N notebooks, record
+time-to-ready for each, print percentiles — the reconcile-latency baseline
+BASELINE.md says this repo must establish.
+
+Usage: python loadtest/load_notebooks.py [N] [--stop-start]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+    do_stop_start = "--stop-start" in sys.argv
+
+    from kubeflow_tpu.admission.webhook import register as register_adm
+    from kubeflow_tpu.api import notebook as nb_api
+    from kubeflow_tpu.controllers.executor import FakeExecutor
+    from kubeflow_tpu.controllers.notebook import register as register_nb
+    from kubeflow_tpu.core import APIServer, Manager
+
+    server = APIServer()
+    register_adm(server)
+    mgr = Manager(server)
+    register_nb(server, mgr)
+    mgr.add(FakeExecutor(server, complete=False))
+    mgr.start()
+
+    t_created = {}
+    t_ready = {}
+    t0 = time.perf_counter()
+    for i in range(n):
+        name = f"nb-{i:04d}"
+        server.create(nb_api.new(name, "loadtest", image="jax-nb:v1"))
+        t_created[name] = time.perf_counter()
+
+    deadline = time.perf_counter() + max(60, n * 0.5)
+    while len(t_ready) < n and time.perf_counter() < deadline:
+        for nb in server.list(nb_api.KIND, namespace="loadtest"):
+            name = nb["metadata"]["name"]
+            if name not in t_ready and nb.get("status", {}).get(
+                    "readyReplicas"):
+                t_ready[name] = time.perf_counter()
+        time.sleep(0.05)
+    total = time.perf_counter() - t0
+
+    lat = sorted(t_ready[k] - t_created[k] for k in t_ready)
+    if not lat:
+        print("FAIL: no notebook became ready")
+        return 1
+
+    def pct(p):
+        return lat[min(int(len(lat) * p / 100), len(lat) - 1)]
+
+    print(f"notebooks: {n}  ready: {len(t_ready)}  wall: {total:.2f}s  "
+          f"throughput: {len(t_ready) / total:.1f} ready/s")
+    print(f"time-to-ready  p50={pct(50) * 1000:.0f}ms  "
+          f"p90={pct(90) * 1000:.0f}ms  p99={pct(99) * 1000:.0f}ms  "
+          f"max={lat[-1] * 1000:.0f}ms")
+
+    if do_stop_start:
+        t1 = time.perf_counter()
+        for i in range(n):
+            nb = server.get(nb_api.KIND, f"nb-{i:04d}", "loadtest")
+            nb["metadata"].setdefault("annotations", {})[
+                nb_api.STOP_ANNOTATION] = "now"
+            server.update(nb)
+        stopped = 0
+        deadline = time.perf_counter() + 60
+        while stopped < n and time.perf_counter() < deadline:
+            stopped = sum(
+                1 for s in server.list("StatefulSet", namespace="loadtest")
+                if s["spec"].get("replicas") == 0)
+            time.sleep(0.05)
+        print(f"stop-all: {stopped}/{n} scaled to zero in "
+              f"{time.perf_counter() - t1:.2f}s")
+
+    mgr.stop()
+    return 0 if len(t_ready) == n else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

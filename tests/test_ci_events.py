@@ -15,6 +15,9 @@ from kubeflow_tpu.core.events import events_for, record_event
 
 def test_changed_components_path_filtering():
     assert changed_components(["kubeflow_tpu/hpo/suggestion.py"]) == ["hpo"]
+    # multiple files within one component stay filtered (regression)
+    assert changed_components(["kubeflow_tpu/hpo/suggestion.py",
+                               "kubeflow_tpu/hpo/controller.py"]) == ["hpo"]
     assert changed_components(
         ["kubeflow_tpu/controllers/jaxjob.py"]) == ["jaxjob"]
     # a file outside every component triggers everything

@@ -1,0 +1,110 @@
+"""PipelineRun DAG engine: ordering, failure propagation, real execution."""
+
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.api import pipeline as api
+from kubeflow_tpu.controllers.executor import FakeExecutor, LocalExecutor
+from kubeflow_tpu.controllers.pipeline import register
+from kubeflow_tpu.core import APIServer, Manager
+
+
+def wait_run(server, name, ns, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        run = server.get(api.KIND, name, ns)
+        if run.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            return run
+        time.sleep(0.05)
+    raise AssertionError(server.get(api.KIND, name, ns).get("status"))
+
+
+def make_stack(executor):
+    server = APIServer()
+    mgr = Manager(server)
+    register(server, mgr)
+    mgr.add(executor(server) if callable(executor) else executor)
+    mgr.start()
+    return server, mgr
+
+
+def test_dag_validation():
+    with pytest.raises(ValueError, match="cycle"):
+        api.validate(api.new("x", "ns", [
+            {"name": "a", "depends": ["b"]},
+            {"name": "b", "depends": ["a"]}]))
+    with pytest.raises(ValueError, match="unknown dependency"):
+        api.validate(api.new("x", "ns", [{"name": "a", "depends": ["z"]}]))
+
+
+def test_diamond_dag_runs_in_order():
+    server, mgr = make_stack(FakeExecutor)
+    try:
+        server.create(api.new("diamond", "ci", [
+            {"name": "checkout", "run": ["true"]},
+            {"name": "build", "run": ["true"], "depends": ["checkout"]},
+            {"name": "lint", "run": ["true"], "depends": ["checkout"]},
+            {"name": "test", "run": ["true"], "depends": ["build", "lint"]},
+        ]))
+        done = wait_run(server, "diamond", "ci")
+        assert done["status"]["phase"] == "Succeeded"
+        assert all(s["phase"] == "Succeeded"
+                   for s in done["status"]["steps"].values())
+    finally:
+        mgr.stop()
+
+
+def test_failure_skips_dependents():
+    server = APIServer()
+    mgr = Manager(server)
+    register(server, mgr)
+    mgr.add(FakeExecutor(server,
+                         always_fail={api.step_pod_name("run", "build")}))
+    mgr.start()
+    try:
+        server.create(api.new("run", "ci", [
+            {"name": "checkout", "run": ["true"]},
+            {"name": "build", "run": ["true"], "depends": ["checkout"]},
+            {"name": "test", "run": ["true"], "depends": ["build"]},
+        ]))
+        done = wait_run(server, "run", "ci")
+        assert done["status"]["phase"] == "Failed"
+        st = done["status"]["steps"]
+        assert st["checkout"]["phase"] == "Succeeded"
+        assert st["build"]["phase"] == "Failed"
+        assert st["test"]["phase"] == "Skipped"
+    finally:
+        mgr.stop()
+
+
+def test_real_execution_with_local_executor(tmp_path):
+    marker = tmp_path / "out.txt"
+    server = APIServer()
+    mgr = Manager(server)
+    register(server, mgr)
+    mgr.add(LocalExecutor(server, timeout=30))
+    mgr.start()
+    try:
+        server.create(api.new("real", "ci", [
+            {"name": "write", "run": [sys.executable, "-c",
+                                      f"open(r'{marker}','w').write('a')"]},
+            {"name": "append", "depends": ["write"],
+             "run": [sys.executable, "-c",
+                     f"f=open(r'{marker}','a'); f.write('b')"]},
+        ]))
+        done = wait_run(server, "real", "ci", timeout=60)
+        assert done["status"]["phase"] == "Succeeded"
+        assert marker.read_text() == "ab"  # dependency order was honored
+    finally:
+        mgr.stop()
+
+
+def test_ci_workflow_adapts_to_pipelinerun():
+    from kubeflow_tpu.ci.pipelines import generate_workflow
+
+    run = api.from_workflow(generate_workflow("hpo"), "ci")
+    api.validate(run)
+    names = [s["name"] for s in run["spec"]["steps"]]
+    assert names == ["checkout", "test"]
