@@ -32,12 +32,17 @@ COORDINATOR_PORT = 8476
 def new(name: str, namespace: str, *, topology: str = "v5e-4",
         trainer: dict | None = None, parallelism: dict | None = None,
         pod_template: dict | None = None, max_restarts: int = 3,
+        num_slices: int = 1,
         image: str = "kubeflow-tpu/worker:latest") -> dict:
     if topology not in TOPOLOGIES:
         raise ValueError(
             f"unknown topology {topology!r}; known: {sorted(TOPOLOGIES)}")
     return api_object(KIND, name, namespace, spec={
         "topology": topology,
+        # multi-slice (DCN) data parallelism: numSlices independent ICI
+        # domains; the dp mesh axis spans slices so only gradient reduction
+        # crosses DCN (scaling-book layout)
+        "numSlices": num_slices,
         "parallelism": parallelism or {},
         "trainer": trainer or {},
         "podTemplate": pod_template or {},
@@ -46,24 +51,41 @@ def new(name: str, namespace: str, *, topology: str = "v5e-4",
     })
 
 
+def num_slices_of(job: dict) -> int:
+    return int(job["spec"].get("numSlices", 1))
+
+
+def total_hosts(job: dict) -> int:
+    topo = TOPOLOGIES[job["spec"]["topology"]]
+    return topo.hosts * num_slices_of(job)
+
+
 def validate(job: dict) -> None:
     spec = job.get("spec", {})
     topo = spec.get("topology")
     if topo not in TOPOLOGIES:
         raise ValueError(f"JAXJob {job['metadata'].get('name')}: unknown "
                          f"topology {topo!r}")
+    n_slices = spec.get("numSlices", 1)
+    if not isinstance(n_slices, int) or n_slices < 1:
+        raise ValueError(f"numSlices must be a positive integer, got "
+                         f"{n_slices!r}")
     par = spec.get("parallelism") or {}
     sizes = [par.get(a, 1) for a in ("dp", "fsdp", "tp", "sp")]
     if any(not isinstance(s, int) or s < 1 for s in sizes):
         raise ValueError("parallelism axes must be positive integers")
-    chips = TOPOLOGIES[topo].chips
+    chips = TOPOLOGIES[topo].chips * n_slices
     prod = 1
     for s in sizes:
         prod *= s
     if par and prod != chips:
         raise ValueError(
-            f"parallelism {par} multiplies to {prod}, topology {topo} has "
-            f"{chips} chips")
+            f"parallelism {par} multiplies to {prod}, but {n_slices} x "
+            f"{topo} has {chips} chips")
+    if par and n_slices > 1 and par.get("dp", 1) % n_slices != 0:
+        raise ValueError(
+            f"dp={par.get('dp', 1)} must be a multiple of numSlices "
+            f"({n_slices}) so only data-parallel traffic crosses DCN")
 
 
 def worker_pod_name(job_name: str, index: int) -> str:
@@ -88,9 +110,12 @@ def build_worker_pod(job: dict, index: int) -> dict:
     name = job["metadata"]["name"]
     ns = job["metadata"]["namespace"]
 
+    n_slices = num_slices_of(job)
     env = [{"name": k, "value": v} for k, v in rendezvous_env(
-        coordinator_address(job), topo.hosts, index).items()]
+        coordinator_address(job), topo.hosts * n_slices, index).items()]
     env.append({"name": "JAXJOB_NAME", "value": name})
+    env.append({"name": "JAXJOB_SLICE_ID", "value": str(index // topo.hosts)})
+    env.append({"name": "JAXJOB_NUM_SLICES", "value": str(n_slices)})
     env.append({"name": "JAXJOB_TRAINER_CONFIG", "value": _json(spec)})
 
     container = {
@@ -116,6 +141,10 @@ def build_worker_pod(job: dict, index: int) -> dict:
         "schedulingGates": [{"name": "gang-scheduling"}],
         "nodeSelector": {"cloud-tpu.google.com/slice": spec["topology"]},
     })
+    if n_slices > 1:
+        # only multi-slice jobs require ordinal-labeled node pools
+        pod["spec"]["nodeSelector"][
+            "cloud-tpu.google.com/slice-ordinal"] = str(index // topo.hosts)
     template = spec.get("podTemplate") or {}
     for key, val in template.items():
         if key == "containers":

@@ -26,7 +26,6 @@ from kubeflow_tpu.core import Controller, Request, Result
 from kubeflow_tpu.core.events import record_event
 from kubeflow_tpu.core.objects import api_object, set_condition, set_owner
 from kubeflow_tpu.core.store import NotFound
-from kubeflow_tpu.parallel.mesh import TOPOLOGIES
 from kubeflow_tpu.utils.metrics import REGISTRY
 
 JOBS_CREATED = REGISTRY.counter("jaxjob_gangs_created_total",
@@ -49,18 +48,18 @@ class JAXJobController(Controller):
 
         api.validate(job)
         spec = job["spec"]
-        topo = TOPOLOGIES[spec["topology"]]
+        gang_size = api.total_hosts(job)  # hosts x slices: one atomic gang
         status = dict(job.get("status") or {})
         phase = status.get("phase", "Pending")
         if phase in ("Succeeded", "Failed"):
             return None
 
         self._ensure_service(job)
-        pods = self._ensure_gang(job, topo.hosts)
+        pods = self._ensure_gang(job, gang_size)
 
         phases = [p.get("status", {}).get("phase", "Pending") for p in pods]
         ready = sum(1 for ph in phases if ph in ("Running", "Succeeded"))
-        status["workers"] = {"ready": ready, "total": topo.hosts}
+        status["workers"] = {"ready": ready, "total": gang_size}
 
         if any(ph == "Failed" for ph in phases):
             restarts = int(status.get("restarts", 0))
@@ -93,7 +92,7 @@ class JAXJobController(Controller):
 
         # atomic gate release once the whole gang is admitted
         gated = [p for p in pods if p["spec"].get("schedulingGates")]
-        if gated and len(pods) == topo.hosts:
+        if gated and len(pods) == gang_size:
             for p in gated:
                 p["spec"]["schedulingGates"] = []
                 self.server.update(p)

@@ -108,14 +108,25 @@ def make_mesh(
     fsdp: int = 1,
     tp: int = 1,
     sp: int = 1,
+    num_slices: int | None = None,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
     """Build the standard 4-axis mesh over the given (or all) devices.
 
-    ``jax.experimental.mesh_utils.create_device_mesh`` is used when the
+    ``num_slices > 1`` builds a hybrid ICI x DCN mesh: the dp axis's leading
+    blocks map one-to-one onto slices so only data-parallel gradient
+    reduction crosses DCN (fsdp/tp/sp collectives stay on ICI).  Defaults to
+    the ``JAXJOB_NUM_SLICES`` env injected by the JAXJob controller, so
+    workers of a multi-slice gang lay out correctly with no extra config.
+
+    Single-slice: ``mesh_utils.create_device_mesh`` is used when the
     requested device count matches the full process view so physical ICI
     topology informs the layout; otherwise devices are reshaped in order.
     """
+    import os
+
+    if num_slices is None:
+        num_slices = int(os.environ.get("JAXJOB_NUM_SLICES", "1") or 1)
     explicit_devices = devices is not None
     if devices is None:
         devices = jax.devices()
@@ -125,6 +136,27 @@ def make_mesh(
     if len(devices) < n_devices:
         raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
     shape = factor_axes(n_devices, dp=dp, fsdp=fsdp, tp=tp, sp=sp)
+
+    if num_slices > 1:
+        if shape[0] % num_slices:
+            raise ValueError(
+                f"dp={shape[0]} must be a multiple of num_slices "
+                f"({num_slices}): only the dp axis may cross DCN")
+        ici_shape = (shape[0] // num_slices,) + shape[1:]
+        dcn_shape = (num_slices, 1, 1, 1)
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+            return Mesh(dev_array, MeshAxes)
+        except (ValueError, AssertionError, AttributeError, KeyError):
+            # no slice_index metadata (CPU tests / virtual devices): fall
+            # back to ordered blocking — device order groups by process,
+            # which IS slice order under the JAXJob gang launch
+            dev_array = np.asarray(devices).reshape(shape)
+            return Mesh(dev_array, MeshAxes)
+
     if not explicit_devices and n_devices == len(jax.devices()):
         try:
             from jax.experimental import mesh_utils

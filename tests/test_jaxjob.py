@@ -156,3 +156,36 @@ def test_local_executor_really_trains_mnist(harness):
     assert result["steps"] == 4
     assert result["final_loss"] == result["final_loss"]
     assert result["samples_per_sec"] > 0
+
+
+def test_multislice_gang(harness):
+    """numSlices > 1: one atomic gang of hosts x slices pods; dp crosses
+    DCN, everything else stays within a slice."""
+    server, mgr = harness
+    mgr.add(FakeExecutor(server))
+    mgr.start()
+    job = api.new("megajob", "ml", topology="v5e-8", num_slices=2,
+                  parallelism={"dp": 2, "fsdp": 4, "tp": 2, "sp": 1})
+    server.create(job)
+    done = wait_phase(server, "megajob", "ml", {"Succeeded"}, timeout=15)
+    pods = server.list("Pod", namespace="ml",
+                       label_selector={"matchLabels": {"jaxjob": "megajob"}})
+    assert len(pods) == 4  # 2 hosts x 2 slices
+    assert done["status"]["workers"]["total"] == 4
+    by_idx = {int(p["metadata"]["labels"]["jaxjob-worker-index"]): p
+              for p in pods}
+    for i, pod in by_idx.items():
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["JAXJOB_NUM_PROCESSES"] == "4"
+        assert env["JAXJOB_SLICE_ID"] == str(i // 2)
+        assert (pod["spec"]["nodeSelector"]
+                ["cloud-tpu.google.com/slice-ordinal"] == str(i // 2))
+
+
+def test_multislice_dp_must_span_slices(harness):
+    server, _ = harness
+    with pytest.raises(ValueError, match="multiple of numSlices"):
+        server.create(api.new("bad", "ml", topology="v5e-8", num_slices=2,
+                              parallelism={"dp": 1, "fsdp": 8,
+                                           "tp": 2, "sp": 1}))

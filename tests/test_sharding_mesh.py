@@ -1,0 +1,45 @@
+"""Mesh construction: axis factoring and multi-slice hybrid layout."""
+
+import os
+
+import pytest
+
+from kubeflow_tpu.parallel.mesh import TOPOLOGIES, factor_axes, make_mesh
+
+
+def test_factor_axes_inference():
+    assert factor_axes(8, dp=-1, fsdp=2, tp=2, sp=1) == (2, 2, 2, 1)
+    with pytest.raises(ValueError, match="not divisible"):
+        factor_axes(8, dp=-1, fsdp=3)
+    with pytest.raises(ValueError, match="multiply"):
+        factor_axes(8, dp=3, fsdp=1, tp=1, sp=1)
+
+
+def test_topology_catalogue():
+    t = TOPOLOGIES["v5e-32"]
+    assert t.hosts == 8 and t.chips_per_host == 4
+    assert t.resource_name == "cloud-tpu.google.com/v5e"
+
+
+def test_multislice_mesh_dp_blocks_align_with_slices():
+    # 8 virtual devices as 2 "slices": dp=4 -> leading dp blocks of size 2
+    # per slice; device order groups by slice under the gang launch
+    mesh = make_mesh(8, dp=4, fsdp=2, tp=1, sp=1, num_slices=2)
+    assert mesh.shape == {"dp": 4, "fsdp": 2, "tp": 1, "sp": 1}
+    devs = mesh.devices
+    flat = [d.id for d in devs.reshape(-1)]
+    assert flat == sorted(flat)  # ordered blocking: slice 0 then slice 1
+
+
+def test_multislice_mesh_rejects_dp_not_divisible():
+    with pytest.raises(ValueError, match="multiple of num_slices"):
+        make_mesh(8, dp=2, fsdp=4, tp=1, sp=1, num_slices=4)
+
+
+def test_num_slices_env_default(monkeypatch):
+    monkeypatch.setenv("JAXJOB_NUM_SLICES", "2")
+    mesh = make_mesh(8, dp=2, fsdp=4, tp=1, sp=1)
+    assert mesh.shape["dp"] == 2
+    monkeypatch.setenv("JAXJOB_NUM_SLICES", "4")
+    with pytest.raises(ValueError, match="multiple of num_slices"):
+        make_mesh(8, dp=2, fsdp=4, tp=1, sp=1)
