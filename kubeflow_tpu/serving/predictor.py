@@ -27,6 +27,18 @@ import jax.numpy as jnp
 from kubeflow_tpu.utils.logging import get_logger
 
 
+def _sample(logits: jax.Array, temperature: jax.Array,
+            rng: jax.Array) -> jax.Array:
+    """Shared trace-compatible sampling: identical numerics for the first
+    token (host call) and the scan body (f32, clamped temperature)."""
+    logits = logits.astype(jnp.float32)
+    return jax.lax.cond(
+        temperature > 0.0,
+        lambda: jax.random.categorical(
+            rng, logits / jnp.maximum(temperature, 1e-6), axis=-1),
+        lambda: jnp.argmax(logits, axis=-1))
+
+
 class GenerativePredictor:
     """Llama-style decoder serving (text generation)."""
 
@@ -78,12 +90,27 @@ class GenerativePredictor:
         return self._prefill_cache[key]
 
     def _decode(self):
+        """Scan-based multi-token decode: ONE dispatch generates the whole
+        continuation (per-token Python loops pay host->device latency per
+        token — ruinous over a network-attached TPU)."""
         if self._decode_fn is None:
-            def fn(params, ids, cache):
-                out = self.module.apply({"params": params}, ids, cache=cache)
-                return out["logits"], out["cache"]
+            import functools
 
-            self._decode_fn = jax.jit(fn)
+            @functools.partial(jax.jit, static_argnames=("n_tokens",))
+            def fn(params, first_token, cache, rng, temperature, n_tokens):
+                def body(carry, _):
+                    token, cache, rng = carry
+                    out = self.module.apply({"params": params},
+                                            token[:, None], cache=cache)
+                    rng, sub = jax.random.split(rng)
+                    nxt = _sample(out["logits"][:, -1], temperature, sub)
+                    return (nxt, out["cache"], rng), nxt
+
+                (_, cache, _), tokens = jax.lax.scan(
+                    body, (first_token, cache, rng), None, length=n_tokens)
+                return tokens  # [n_tokens, B]
+
+            self._decode_fn = fn
         return self._decode_fn
 
     # -- API -------------------------------------------------------------------
@@ -114,17 +141,27 @@ class GenerativePredictor:
         next_logits = logits[:, -1]
 
         rng = jax.random.PRNGKey(seed)
+        temp = jnp.asarray(temperature, jnp.float32)
         out_ids = [list(x) for x in ids]
-        decode = self._decode()
-        token = self._sample(next_logits, temperature, rng)
+        token = _sample(next_logits, temp, rng)
         for i in range(batch):
             out_ids[i].append(int(token[i]))
-        for step in range(max_new_tokens - 1):
+        if max_new_tokens > 1:
             rng, sub = jax.random.split(rng)
-            logits, cache = decode(self.params, token[:, None], cache)
-            token = self._sample(logits[:, -1], temperature, sub)
-            for i in range(batch):
-                out_ids[i].append(int(token[i]))
+            n_rest = max_new_tokens - 1
+            # bucket the scan length so distinct max_new_tokens values share
+            # compiled executables; padded steps run after every real token
+            # exists (the cache's clamped writes only affect discarded
+            # outputs), and the extras are sliced off host-side.  Cap at the
+            # cache room so padding never exceeds max_seq.
+            bucket = next(b for b in (8, 32, 128, 512, 2048) if b >= n_rest)
+            bucket = min(bucket, self.max_seq - prompt_len - 1)
+            tokens = self._decode()(
+                self.params, token, cache, sub, temp, n_tokens=bucket)
+            host_tokens = jax.device_get(tokens[:n_rest])  # [n_rest, B]
+            for step_tokens in host_tokens:
+                for i in range(batch):
+                    out_ids[i].append(int(step_tokens[i]))
         dt = time.perf_counter() - t0
         return {
             "ids": out_ids,
@@ -132,11 +169,6 @@ class GenerativePredictor:
             "tokens_per_sec": batch * max_new_tokens / dt,
         }
 
-    def _sample(self, logits: jax.Array, temperature: float,
-                rng: jax.Array) -> jax.Array:
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        return jax.random.categorical(rng, logits / temperature, axis=-1)
 
 
 class ClassifierPredictor:
