@@ -150,12 +150,13 @@ class GenerativePredictor:
             rng, sub = jax.random.split(rng)
             n_rest = max_new_tokens - 1
             # bucket the scan length so distinct max_new_tokens values share
-            # compiled executables; padded steps run after every real token
-            # exists (the cache's clamped writes only affect discarded
-            # outputs), and the extras are sliced off host-side.  Cap at the
-            # cache room so padding never exceeds max_seq.
-            bucket = next(b for b in (8, 32, 128, 512, 2048) if b >= n_rest)
-            bucket = min(bucket, self.max_seq - prompt_len - 1)
+            # compiled executables; the extras are sliced off host-side.
+            # Padded steps run after every real token exists — their clamped
+            # cache writes and outputs are never read by a real step — so no
+            # cap is needed (and a prompt-dependent cap would defeat the
+            # executable sharing).
+            bucket = next((b for b in (8, 32, 128, 512, 2048)
+                           if b >= n_rest), n_rest)
             tokens = self._decode()(
                 self.params, token, cache, sub, temp, n_tokens=bucket)
             host_tokens = jax.device_get(tokens[:n_rest])  # [n_rest, B]

@@ -1,0 +1,164 @@
+"""Platform readiness e2e (reference: testing/kfctl/kf_is_ready_test.py —
+deploy everything, then assert every component answers).
+
+Boots the FULL platform (every registered controller + front door) in-process
+and walks one user journey end to end across component boundaries.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.core.httpapi import serve
+from kubeflow_tpu.platform import build_platform, build_wsgi_app
+
+EXPECTED_CONTROLLERS = {
+    "JAXJobController", "FakeExecutor", "NotebookController",
+    "StatefulSetController", "DeploymentController", "ProfileController",
+    "TensorboardController", "ExperimentController", "TrialController",
+    "InferenceServiceController", "PipelineRunController",
+}
+
+
+@pytest.fixture()
+def platform():
+    server, mgr = build_platform(executor="fake")
+    mgr.start()
+    httpd, _ = serve(build_wsgi_app(server, secure_api=False), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield server, mgr, base
+    httpd.shutdown()
+    mgr.stop()
+
+
+def req(base, path, method="GET", body=None, user="alice@corp.com"):
+    headers = {"X-Goog-Authenticated-User-Email":
+               "accounts.google.com:" + user}
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(base + path, data=data, method=method,
+                               headers=headers)
+    with urllib.request.urlopen(r) as resp:
+        raw = resp.read()
+        if "json" in resp.headers.get("Content-Type", ""):
+            return resp.status, json.loads(raw or b"null")
+        return resp.status, raw.decode()
+
+
+def wait(fn, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out is not None:
+            return out
+        time.sleep(0.1)
+    raise AssertionError("condition never became true")
+
+
+def test_all_components_registered_and_ready(platform):
+    server, mgr, base = platform
+    names = {c.name for c in mgr.controllers}
+    missing = EXPECTED_CONTROLLERS - names
+    assert not missing, f"controllers missing from platform: {missing}"
+    # every HTTP mount answers
+    for path in ("/healthz", "/kfam/healthz", "/dashboard/api/dashboard-links",
+                 "/jupyter/healthz", "/volumes/healthz",
+                 "/tensorboards/healthz", "/metrics"):
+        code, _ = req(base, path)
+        assert code == 200, path
+
+
+def test_full_user_journey(platform):
+    """profile -> poddefault -> notebook -> jaxjob -> experiment ->
+    inferenceservice -> pipelinerun, all on one platform instance."""
+    server, mgr, base = platform
+
+    req(base, "/kfam/v1/profiles", "POST", {"name": "journey"})
+    wait(lambda: (server.get("Namespace", "journey")
+                  if _exists(server, "Namespace", "journey", None) else None))
+
+    req(base, "/apis/PodDefault", "POST", {
+        "metadata": {"name": "creds", "namespace": "journey"},
+        "spec": {"selector": {"matchLabels": {"notebook-name": "nb"}},
+                 "env": [{"name": "MARKER", "value": "injected"}],
+                 "envFrom": [], "volumes": [], "volumeMounts": [],
+                 "tolerations": [], "labels": {}, "annotations": {}}})
+    req(base, "/apis/Notebook", "POST", {
+        "metadata": {"name": "nb", "namespace": "journey"},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "nb", "image": "jax-nb:v1"}]}}}})
+    pod = wait(lambda: (server.get("Pod", "nb-0", "journey")
+                        if _exists(server, "Pod", "nb-0", "journey")
+                        else None))
+    env = {e["name"]: e.get("value")
+           for e in pod["spec"]["containers"][0]["env"]}
+    assert env["MARKER"] == "injected"      # admission seam
+    assert env["NB_PREFIX"] == "/notebook/journey/nb"  # controller seam
+
+    req(base, "/apis/JAXJob", "POST", {
+        "metadata": {"name": "train", "namespace": "journey"},
+        "spec": {"topology": "v5e-4", "trainer": {"model": "mnist_mlp"},
+                 "parallelism": {}, "podTemplate": {}, "maxRestarts": 1,
+                 "image": "w"}})
+    job = wait(lambda: _phase_is(server, "JAXJob", "train", "journey",
+                                 "Succeeded"))
+    assert job["status"]["result"]["samples_per_sec"] > 0
+
+    req(base, "/apis/Experiment", "POST", {
+        "metadata": {"name": "hpo", "namespace": "journey"},
+        "spec": {"objective": {"type": "minimize", "metric": "final_loss"},
+                 "algorithm": {"name": "random"},
+                 "parameters": [{"name": "lr", "type": "double",
+                                 "min": 0.001, "max": 0.1}],
+                 "trialTemplate": {"topology": "v5e-1",
+                                   "trainer": {"model": "mnist_mlp"}},
+                 "parallelTrials": 2, "maxTrials": 2,
+                 "maxFailedTrials": 1}})
+    exp = wait(lambda: _phase_is(server, "Experiment", "hpo", "journey",
+                                 "Succeeded"), timeout=30)
+    assert "bestTrial" in exp["status"]
+
+    req(base, "/apis/InferenceService", "POST", {
+        "metadata": {"name": "llm", "namespace": "journey"},
+        "spec": {"predictor": {"model": "llama", "size": "tiny",
+                               "topology": "v5e-4"}}})
+    isvc = wait(lambda: (server.get("InferenceService", "llm", "journey")
+                         if server.get("InferenceService", "llm", "journey")
+                         .get("status", {}).get("ready") else None))
+    assert isvc["status"]["url"] == "/models/journey/llm/"
+
+    req(base, "/apis/PipelineRun", "POST", {
+        "metadata": {"name": "pl", "namespace": "journey"},
+        "spec": {"steps": [{"name": "a", "run": ["true"]},
+                           {"name": "b", "run": ["true"],
+                            "depends": ["a"]}]}})
+    run = wait(lambda: _phase_is(server, "PipelineRun", "pl", "journey",
+                                 "Succeeded"))
+    assert run["status"]["steps"]["b"]["phase"] == "Succeeded"
+
+    # the dashboard sees it all
+    code, ns = req(base, "/dashboard/api/namespaces")
+    assert {"namespace": "journey", "role": "owner"} in ns
+    code, acts = req(base, "/dashboard/api/activities/journey")
+    assert any(a["spec"]["reason"] == "Created" for a in acts)
+
+
+def _exists(server, kind, name, ns):
+    from kubeflow_tpu.core.store import NotFound
+
+    try:
+        server.get(kind, name, ns)
+        return True
+    except NotFound:
+        return False
+
+
+def _phase_is(server, kind, name, ns, phase):
+    from kubeflow_tpu.core.store import NotFound
+
+    try:
+        obj = server.get(kind, name, ns)
+    except NotFound:
+        return None
+    return obj if obj.get("status", {}).get("phase") == phase else None
