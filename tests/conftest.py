@@ -28,3 +28,36 @@ def mesh8():
     from kubeflow_tpu.parallel import make_mesh
 
     return make_mesh(8, dp=2, fsdp=2, tp=2, sp=1)
+
+
+def http_request(base, path, method="GET", body=None,
+                 user="alice@corp.com"):
+    """Authenticated JSON request helper shared across platform tests."""
+    import json
+    import urllib.request
+
+    headers = {}
+    if user:
+        headers["X-Goog-Authenticated-User-Email"] = (
+            "accounts.google.com:" + user)
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(base + path, data=data, method=method,
+                               headers=headers)
+    with urllib.request.urlopen(r) as resp:
+        raw = resp.read()
+        if "json" in resp.headers.get("Content-Type", ""):
+            return resp.status, json.loads(raw or b"null")
+        return resp.status, raw.decode()
+
+
+def poll_until(fn, timeout=20.0, interval=0.1):
+    """Poll fn() until it returns non-None; raises on timeout."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out is not None:
+            return out
+        time.sleep(interval)
+    raise AssertionError("condition never became true")

@@ -5,11 +5,9 @@ Boots the FULL platform (every registered controller + front door) in-process
 and walks one user journey end to end across component boundaries.
 """
 
-import json
-import time
-import urllib.request
-
 import pytest
+from conftest import http_request as req
+from conftest import poll_until as wait
 
 from kubeflow_tpu.core.httpapi import serve
 from kubeflow_tpu.platform import build_platform, build_wsgi_app
@@ -31,29 +29,6 @@ def platform():
     yield server, mgr, base
     httpd.shutdown()
     mgr.stop()
-
-
-def req(base, path, method="GET", body=None, user="alice@corp.com"):
-    headers = {"X-Goog-Authenticated-User-Email":
-               "accounts.google.com:" + user}
-    data = json.dumps(body).encode() if body is not None else None
-    r = urllib.request.Request(base + path, data=data, method=method,
-                               headers=headers)
-    with urllib.request.urlopen(r) as resp:
-        raw = resp.read()
-        if "json" in resp.headers.get("Content-Type", ""):
-            return resp.status, json.loads(raw or b"null")
-        return resp.status, raw.decode()
-
-
-def wait(fn, timeout=20):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        out = fn()
-        if out is not None:
-            return out
-        time.sleep(0.1)
-    raise AssertionError("condition never became true")
 
 
 def test_all_components_registered_and_ready(platform):
