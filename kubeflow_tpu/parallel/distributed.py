@@ -37,21 +37,97 @@ def initialize_from_env(env: dict[str, str] | None = None) -> dict:
     """Join the gang described by the injected env (no-op single process).
 
     Returns a summary dict (coordinator, num_processes, process_id,
-    initialized) for logging/status mirroring.
+    initialized, process_count, local_devices, global_devices) for
+    logging/status mirroring.
     """
     env = os.environ if env is None else env
     coordinator = env.get(COORDINATOR_ENV)
     num_processes = int(env.get(NUM_PROCESSES_ENV, "1"))
     process_id = int(env.get(PROCESS_ID_ENV, "0"))
-    if coordinator is None or num_processes <= 1:
+    if num_processes <= 1:
         return {"coordinator": None, "num_processes": 1, "process_id": 0,
                 "initialized": False}
+    if not coordinator:
+        # a gang without a coordinator must fail loudly: silently training
+        # num_processes independent copies would "succeed" with wrong
+        # semantics (no gradient reduction)
+        raise RuntimeError(
+            f"{NUM_PROCESSES_ENV}={num_processes} but {COORDINATOR_ENV} "
+            "is empty; refusing to train an uncoordinated gang")
     import jax
 
+    try:
+        # CPU multi-process collectives need an explicit implementation;
+        # harmless on TPU (only configures the CPU client). This is what
+        # makes the rendezvous contract testable without a TPU pod.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
         process_id=process_id,
     )
     return {"coordinator": coordinator, "num_processes": num_processes,
-            "process_id": process_id, "initialized": True}
+            "process_id": process_id, "initialized": True,
+            "process_count": jax.process_count(),
+            "local_devices": jax.local_device_count(),
+            "global_devices": jax.device_count()}
+
+
+def free_port() -> int:
+    """A free localhost port for a test/dryrun coordinator."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_local_gang(script: str, num_processes: int, *,
+                     port: int | None = None, timeout: float = 180.0,
+                     extra_env: dict[str, str] | None = None) -> list[dict]:
+    """Run ``script`` in ``num_processes`` real OS processes joined by one
+    localhost coordinator, on 1-CPU-device backends (TPU tunnel detached).
+
+    Each worker must print a JSON object as its last stdout line; the parsed
+    objects are returned in rank order.  Any worker failing (or a launch
+    error) kills the surviving gang members before raising — a half-dead
+    gang would otherwise block at the coordinator barrier for minutes.
+
+    This is the in-repo analog of envtest for the §5.8 rendezvous contract:
+    used by tests/test_distributed_rendezvous.py and the driver's
+    dryrun_multichip.
+    """
+    import json
+    import subprocess
+    import sys
+
+    if port is None:
+        port = free_port()
+    procs: list[subprocess.Popen] = []
+    try:
+        for pid in range(num_processes):
+            env = dict(os.environ)
+            env.pop("PALLAS_AXON_POOL_IPS", None)  # detach the TPU tunnel
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = ""
+            env.update(rendezvous_env(f"127.0.0.1:{port}", num_processes,
+                                      pid))
+            env.update(extra_env or {})
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", script], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"gang worker exited {p.returncode}:\n{err[-3000:]}")
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        return outs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
