@@ -80,7 +80,10 @@ def _role_rules(server: APIServer, role_ref: dict,
         else:
             role = server.get("Role", name, namespace)
     except NotFound:
-        return BUILTIN_ROLES.get(name, [])
+        # k8s semantics: a missing role grants nothing (deleting e.g. the
+        # kubeflow-admin ClusterRole must revoke access).  Built-ins are
+        # materialized as store objects by ensure_builtin_roles.
+        return []
     return role.get("spec", {}).get("rules", [])
 
 

@@ -158,8 +158,14 @@ class APIServer:
             existing = self._objects.get(key)
             if existing is None:
                 raise NotFound(f"{kind} {key[1]}/{key[2]} not found")
-            if (md.get("resourceVersion")
-                    and md["resourceVersion"]
+            if not md.get("resourceVersion"):
+                # k8s semantics: updates without an observed resourceVersion
+                # are blind overwrites that can silently drop concurrent
+                # finalizer/status edits — reject them (ADVICE r1)
+                raise Invalid(
+                    f"{kind} {key[2]}: metadata.resourceVersion required "
+                    "on update (read-modify-write)")
+            if (md["resourceVersion"]
                     != existing["metadata"]["resourceVersion"]):
                 raise Conflict(
                     f"{kind} {key[2]}: stale resourceVersion "

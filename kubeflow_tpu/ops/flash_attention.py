@@ -30,6 +30,10 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
 
+# Tests flip this to run the kernel through the Pallas interpreter on CPU
+# (numerical parity vs _xla_attention without TPU hardware).
+INTERPRET = False
+
 
 def supported(q: jax.Array, k: jax.Array) -> bool:
     """Whether the Pallas kernel can serve these shapes on this backend."""
@@ -130,6 +134,7 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int):
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ],
+        interpret=INTERPRET,
     )(qr, kr, vr)
     o4 = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     return o4, (qr, kr, vr, o, lse, b, h, sm_scale)

@@ -140,14 +140,16 @@ class GenerativePredictor:
                                                          cache)
         next_logits = logits[:, -1]
 
-        rng = jax.random.PRNGKey(seed)
+        # split once up front: sampling with a key and then splitting the
+        # same key is JAX key reuse (ADVICE r1)
+        _, k_first, k_scan = jax.random.split(jax.random.PRNGKey(seed), 3)
         temp = jnp.asarray(temperature, jnp.float32)
         out_ids = [list(x) for x in ids]
-        token = _sample(next_logits, temp, rng)
+        token = _sample(next_logits, temp, k_first)
         for i in range(batch):
             out_ids[i].append(int(token[i]))
         if max_new_tokens > 1:
-            rng, sub = jax.random.split(rng)
+            sub = k_scan
             n_rest = max_new_tokens - 1
             # bucket the scan length so distinct max_new_tokens values share
             # compiled executables; the extras are sliced off host-side.

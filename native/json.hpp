@@ -6,6 +6,8 @@
 
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <cstring>
 #include <map>
 #include <memory>
 #include <sstream>
@@ -324,21 +326,41 @@ class Parser {
 
 inline Value parse(const std::string& text) { return Parser(text).parse(); }
 
+// Scans exactly the JSON number grammar: -?(0|[1-9][0-9]*)(\.[0-9]+)?
+// ([eE][+-]?[0-9]+)?.  Leading '+', interior signs, and "1." / ".5" style
+// tokens are rejected; any trailing garbage is left at pos for the caller
+// to choke on.
 inline Value number_from(const std::string& s, size_t& pos) {
   size_t start = pos;
-  if (pos < s.size() && (s[pos] == '-' || s[pos] == '+')) ++pos;
   bool is_int = true;
-  while (pos < s.size() &&
-         (isdigit(static_cast<unsigned char>(s[pos])) || s[pos] == '.' ||
-          s[pos] == 'e' || s[pos] == 'E' || s[pos] == '-' || s[pos] == '+')) {
-    if (s[pos] == '.' || s[pos] == 'e' || s[pos] == 'E') is_int = false;
+  auto digit = [&](size_t p) {
+    return p < s.size() && isdigit(static_cast<unsigned char>(s[p]));
+  };
+  if (pos < s.size() && s[pos] == '-') ++pos;
+  if (!digit(pos)) throw std::runtime_error("invalid JSON number");
+  if (s[pos] == '0') {
+    ++pos;  // leading zeros are not numbers; a following digit is garbage
+  } else {
+    while (digit(pos)) ++pos;
+  }
+  if (pos < s.size() && s[pos] == '.') {
+    is_int = false;
     ++pos;
+    if (!digit(pos)) throw std::runtime_error("invalid JSON number");
+    while (digit(pos)) ++pos;
+  }
+  if (pos < s.size() && (s[pos] == 'e' || s[pos] == 'E')) {
+    is_int = false;
+    ++pos;
+    if (pos < s.size() && (s[pos] == '+' || s[pos] == '-')) ++pos;
+    if (!digit(pos)) throw std::runtime_error("invalid JSON number");
+    while (digit(pos)) ++pos;
   }
   std::string tok = s.substr(start, pos - start);
   if (is_int) {
     try {
       return Value(static_cast<int64_t>(std::stoll(tok)));
-    } catch (...) {
+    } catch (...) {  // out of int64 range: fall through to double
     }
   }
   return Value(std::stod(tok));

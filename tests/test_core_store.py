@@ -1,5 +1,6 @@
 """API server semantics: CRUD, conflict, watch, finalizers, owner GC."""
 
+import copy
 import threading
 
 import pytest
@@ -147,3 +148,17 @@ def test_watch_concurrent_writers(server):
         seen += 1
     assert seen == n_threads * per_thread
     assert len(server.list("Notebook", namespace="ns")) == seen
+
+
+def test_update_without_resourceversion_rejected(server):
+    """Blind overwrites via the REST PUT path can drop concurrent finalizer
+    edits; k8s-style read-modify-write is required (ADVICE r1)."""
+    from kubeflow_tpu.core.store import Invalid
+
+    obj = server.create(api_object("ConfigMap", "cm", "ns"))
+    stripped = copy.deepcopy(obj)
+    del stripped["metadata"]["resourceVersion"]
+    with pytest.raises(Invalid, match="resourceVersion required"):
+        server.update(stripped)
+    obj["spec"] = {"data": {"k": "v"}}
+    assert server.update(obj)["spec"]["data"]["k"] == "v"

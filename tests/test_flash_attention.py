@@ -1,0 +1,85 @@
+"""Numerical parity for the Pallas flash-attention kernel (ADVICE r1 #1).
+
+The kernel is the default TPU attention path for bert/llama training; until
+now nothing validated it numerically.  These tests run the kernel through the
+Pallas interpreter on CPU and compare forward outputs AND gradients against
+the XLA reference (_xla_attention) across causal/non-causal, decode offset
+(sq < sk), and f32/bf16.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.ops import flash_attention as fa
+from kubeflow_tpu.ops.attention import _xla_attention
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode(monkeypatch):
+    monkeypatch.setattr(fa, "INTERPRET", True)
+
+
+def make_qkv(rng, b, sq, sk, h, d, dtype):
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, sq, h, d), jnp.float32).astype(dtype)
+    k = jax.random.normal(kk, (b, sk, h, d), jnp.float32).astype(dtype)
+    v = jax.random.normal(kv, (b, sk, h, d), jnp.float32).astype(dtype)
+    return q, k, v
+
+
+CASES = [
+    # (causal, sq, sk, dtype, fwd_tol, grad_tol)
+    (False, 256, 256, jnp.float32, 1e-5, 1e-4),
+    (True, 256, 256, jnp.float32, 1e-5, 1e-4),
+    (True, 128, 384, jnp.float32, 1e-5, 1e-4),   # decode offset: sq < sk
+    (False, 256, 256, jnp.bfloat16, 2e-2, 4e-2),
+    (True, 256, 256, jnp.bfloat16, 2e-2, 4e-2),
+    (True, 128, 384, jnp.bfloat16, 2e-2, 4e-2),
+]
+
+
+@pytest.mark.parametrize("causal,sq,sk,dtype,fwd_tol,grad_tol", CASES)
+def test_flash_matches_xla_forward_and_grad(causal, sq, sk, dtype, fwd_tol,
+                                            grad_tol):
+    rng = jax.random.PRNGKey(0)
+    q, k, v = make_qkv(rng, 2, sq, sk, 2, 64, dtype)
+
+    out = fa.flash_attention(q, k, v, causal=causal)
+    ref = _xla_attention(q, k, v, causal=causal, mask=None,
+                         softmax_dtype=jnp.float32)
+    assert out.dtype == dtype
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                 - ref.astype(jnp.float32)))) < fwd_tol
+
+    # gradient parity through the custom VJP (weighted sum exercises all
+    # output positions asymmetrically)
+    w = jax.random.normal(jax.random.PRNGKey(1), out.shape, jnp.float32)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=causal)
+        return jnp.sum(o.astype(jnp.float32) * w)
+
+    def loss_ref(q, k, v):
+        o = _xla_attention(q, k, v, causal=causal, mask=None,
+                           softmax_dtype=jnp.float32)
+        return jnp.sum(o.astype(jnp.float32) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        assert gf.dtype == gr.dtype
+        err = float(jnp.max(jnp.abs(gf.astype(jnp.float32)
+                                    - gr.astype(jnp.float32))))
+        scale = float(jnp.max(jnp.abs(gr.astype(jnp.float32)))) + 1e-6
+        assert err / scale < grad_tol, f"d{name}: rel err {err / scale}"
+
+
+def test_flash_blocks_smaller_than_default():
+    """seq not divisible by 256 falls back to 128-blocks via _pick_block."""
+    rng = jax.random.PRNGKey(2)
+    q, k, v = make_qkv(rng, 1, 128, 128, 2, 64, jnp.float32)
+    out = fa.flash_attention(q, k, v, causal=True)
+    ref = _xla_attention(q, k, v, causal=True, mask=None,
+                         softmax_dtype=jnp.float32)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5

@@ -136,3 +136,20 @@ def test_unicode_roundtrip():
     out = ENGINE.apply_poddefaults(p, [pd("u", labels={"emoji": "🚀"})])
     assert out["pod"]["metadata"]["labels"]["note"] == "tpü-nativé ✓"
     assert out["pod"]["metadata"]["labels"]["emoji"] == "🚀"
+
+
+def test_malformed_json_numbers_rejected():
+    """The parser must reject non-JSON number tokens instead of silently
+    truncating them ({"a": 1-2} used to parse as {"a": 1}) — ADVICE r1."""
+    import ctypes
+    import json
+
+    lib = ENGINE.lib
+    for bad in (b'{"a": 1-2}', b'{"b": +5}', b'{"c": 01}', b'{"d": 1.}',
+                b'{"e": .5}', b'{"f": 1e}'):
+        raw = lib.kf_match_selector(b'{}', bad)
+        text = ctypes.string_at(raw).decode()
+        lib.kf_free(raw)
+        assert "error" in json.loads(text), bad
+    # valid numbers still parse
+    assert ENGINE.match_selector({}, {"x": "1"}) is True

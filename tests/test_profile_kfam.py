@@ -178,3 +178,20 @@ def test_kfam_clusteradmin_route(kfam):
     code, is_admin = kreq(base, "/kfam/v1/role/clusteradmin",
                           user="pleb@corp.com")
     assert is_admin is False
+
+
+def test_deleting_clusterrole_revokes_access():
+    """k8s semantics: a missing role grants nothing — no hardcoded fallback
+    (ADVICE r1)."""
+    from kubeflow_tpu.core import APIServer
+    from kubeflow_tpu.core.objects import api_object
+
+    server = APIServer()
+    ensure_builtin_roles(server)
+    server.create(api_object(
+        "RoleBinding", "alice-admin", "team",
+        spec={"subjects": [{"kind": "User", "name": "alice@corp.com"}],
+              "roleRef": {"kind": "ClusterRole", "name": "kubeflow-admin"}}))
+    assert can_i(server, "alice@corp.com", "create", "Notebook", "team")
+    server.delete("ClusterRole", "kubeflow-admin")
+    assert not can_i(server, "alice@corp.com", "create", "Notebook", "team")
