@@ -23,9 +23,15 @@ from __future__ import annotations
 
 from kubeflow_tpu.api import jaxjob as api
 from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core import quota
 from kubeflow_tpu.core.events import record_event
-from kubeflow_tpu.core.objects import api_object, set_condition, set_owner
-from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.core.objects import (
+    api_object,
+    get_condition,
+    set_condition,
+    set_owner,
+)
+from kubeflow_tpu.core.store import Invalid, NotFound
 from kubeflow_tpu.utils.metrics import REGISTRY
 
 JOBS_CREATED = REGISTRY.counter("jaxjob_gangs_created_total",
@@ -55,7 +61,26 @@ class JAXJobController(Controller):
             return None
 
         self._ensure_service(job)
-        pods = self._ensure_gang(job, gang_size)
+        pods, parked = self._ensure_gang(job, gang_size)
+        if parked is not None:
+            # over quota: the WHOLE gang stays un-created (a TPU slice is
+            # useless partially admitted); park and retry level-triggered
+            was = get_condition(job, "QuotaExceeded")
+            # capture before set_condition: it mutates the same dict in place
+            was_true = bool(was and was["status"] == "True")
+            set_condition(job, "QuotaExceeded", "True",
+                          reason="QuotaExceeded", message=parked)
+            if not was_true:
+                record_event(self.server, job, "Warning", "QuotaExceeded",
+                             parked)
+            status["phase"] = "Pending"
+            status["conditions"] = job["status"]["conditions"]
+            self.server.patch_status(api.KIND, req.name, req.namespace,
+                                     status)
+            return Result(requeue_after=0.25)
+        if get_condition(job, "QuotaExceeded"):
+            set_condition(job, "QuotaExceeded", "False", reason="Admitted")
+            status["conditions"] = job["status"]["conditions"]
 
         phases = [p.get("status", {}).get("phase", "Pending") for p in pods]
         ready = sum(1 for ph in phases if ph in ("Running", "Succeeded"))
@@ -130,7 +155,14 @@ class JAXJobController(Controller):
             }), job)
             self.server.create(svc)
 
-    def _ensure_gang(self, job: dict, hosts: int) -> list[dict]:
+    def _ensure_gang(self, job: dict,
+                     hosts: int) -> tuple[list[dict], str | None]:
+        """(pods, parked_reason): creates missing workers all-or-nothing.
+
+        Quota is pre-checked for the whole gang, and a mid-creation quota
+        loss (raced by another gang; the store's admission hook is the
+        authoritative gate) rolls back every pod created this pass.
+        """
         ns = job["metadata"]["namespace"]
         name = job["metadata"]["name"]
         pods = []
@@ -141,11 +173,34 @@ class JAXJobController(Controller):
                     "Pod", api.worker_pod_name(name, i), ns))
             except NotFound:
                 missing.append(i)
-        if missing and len(missing) == hosts:
+        if not missing:
+            return pods, None
+
+        to_create = [set_owner(api.build_worker_pod(job, i), job)
+                     for i in missing]
+        need: dict[str, int] = {}
+        for pod in to_create:
+            for key, val in quota.pod_tpu_requests(pod).items():
+                need[key] = need.get(key, 0) + val
+        reason = quota.check_fit(self.server, ns, need)
+        if reason is not None:
+            return pods, reason
+
+        if len(missing) == hosts:
             JOBS_CREATED.inc()  # fresh gang (vs. mid-restart backfill)
-        for i in missing:
-            pod = set_owner(api.build_worker_pod(job, i), job)
-            pods.append(self.server.create(pod))
+        created = []
+        for pod in to_create:
+            try:
+                created.append(self.server.create(pod))
+            except Invalid as e:
+                # lost the admission race: release what we took
+                for p in created:
+                    try:
+                        self.server.delete("Pod", p["metadata"]["name"], ns)
+                    except NotFound:
+                        pass
+                return pods, str(e)
+        pods.extend(created)
         pods.sort(key=lambda p: int(
             p["metadata"]["labels"]["jaxjob-worker-index"]))
-        return pods
+        return pods, None

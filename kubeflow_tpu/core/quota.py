@@ -1,0 +1,112 @@
+"""TPU ResourceQuota enforcement.
+
+The reference only *creates* the ResourceQuota object and delegates
+enforcement to the Kubernetes apiserver (profile_controller.go:245-261).
+Here the in-memory store IS the apiserver, so enforcement is this module's
+job: a validating hook charges every admitted Pod's
+``cloud-tpu.google.com/*`` requests (and pod count) against the namespace's
+``kf-resource-quota``, and the JAXJob controller uses the same accounting to
+admit or park whole gangs atomically (a TPU slice is useless partially
+admitted — all-or-nothing, unlike per-pod k8s quota).
+
+Accounting follows k8s semantics: terminal pods (Succeeded/Failed) do not
+count; usage is recomputed from live objects on every check (level-triggered,
+no cached counters to drift).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.store import APIServer, Invalid, NotFound
+
+QUOTA_NAME = "kf-resource-quota"
+TPU_PREFIX = "cloud-tpu.google.com/"
+POD_COUNT_KEY = "pods"
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+
+def pod_tpu_requests(pod: dict) -> dict[str, int]:
+    """Sum of TPU extended-resource limits across the pod's containers,
+    plus the implicit pod count."""
+    out: dict[str, int] = {POD_COUNT_KEY: 1}
+    for c in pod.get("spec", {}).get("containers", []):
+        res = c.get("resources", {})
+        limits = res.get("limits") or {}
+        requests = res.get("requests") or {}
+        # per-key precedence: a limit overrides a request for that key, but
+        # a TPU key present only under requests is still charged
+        for key in set(limits) | set(requests):
+            if key.startswith(TPU_PREFIX):
+                val = limits.get(key, requests.get(key, 0))
+                out[key] = out.get(key, 0) + int(val)
+    return out
+
+
+def quota_hard(server: APIServer, namespace: str) -> dict[str, int] | None:
+    """The namespace's enforced limits, or None when no quota exists."""
+    try:
+        rq = server.get("ResourceQuota", QUOTA_NAME, namespace)
+    except NotFound:
+        return None
+    hard = rq.get("spec", {}).get("hard") or {}
+    out = {}
+    for key, val in hard.items():
+        if key.startswith(TPU_PREFIX) or key == POD_COUNT_KEY:
+            out[key] = int(val)
+    return out or None
+
+
+def namespace_usage(server: APIServer, namespace: str) -> dict[str, int]:
+    """Charged usage: every non-terminal pod in the namespace."""
+    usage: dict[str, int] = {}
+    for pod in server.list("Pod", namespace=namespace):
+        if pod.get("status", {}).get("phase") in TERMINAL_PHASES:
+            continue
+        for key, val in pod_tpu_requests(pod).items():
+            usage[key] = usage.get(key, 0) + val
+    return usage
+
+
+def check_fit(server: APIServer, namespace: str,
+              need: dict[str, int]) -> str | None:
+    """None when ``need`` fits under the namespace quota, else a
+    human-readable reason."""
+    hard = quota_hard(server, namespace)
+    if hard is None:
+        return None
+    usage = namespace_usage(server, namespace)
+    for key, limit in hard.items():
+        wanted = usage.get(key, 0) + need.get(key, 0)
+        if wanted > limit:
+            return (f"quota {QUOTA_NAME} exceeded for {key}: "
+                    f"used {usage.get(key, 0)} + requested "
+                    f"{need.get(key, 0)} > hard {limit}")
+    return None
+
+
+def admission_hook(server: APIServer):
+    """Validating hook enforcing quota on Pod CREATE (the per-pod backstop;
+    gang atomicity is handled by the JAXJob controller on top of this)."""
+
+    def hook(obj: dict) -> None:
+        if obj.get("kind") != "Pod":
+            return
+        md = obj.get("metadata", {})
+        ns = md.get("namespace")
+        if ns is None:
+            return
+        # only CREATE is charged: updates to an existing pod (gate release,
+        # status) must not re-charge it
+        try:
+            server.get("Pod", md.get("name", ""), ns)
+            return
+        except NotFound:
+            pass
+        reason = check_fit(server, ns, pod_tpu_requests(obj))
+        if reason:
+            raise Invalid(f"pod {md.get('name')}: {reason}")
+
+    return hook
+
+
+def register(server: APIServer) -> None:
+    server.register_validating_hook(admission_hook(server))

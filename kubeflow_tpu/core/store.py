@@ -103,9 +103,12 @@ class APIServer:
             if mutated is not None:
                 obj = mutated
         md = ob.meta(obj)  # hooks may return a new object; re-resolve metadata
-        for hook in self._validating_hooks:
-            hook(obj)
         with self._lock:
+            # validating hooks run INSIDE the lock (RLock: hooks may read the
+            # store) so check-and-insert is atomic — quota admission must not
+            # race concurrent creates
+            for hook in self._validating_hooks:
+                hook(obj)
             key = self._key(kind, md.get("namespace"), md["name"])
             if key in self._objects:
                 raise Conflict(f"{kind} {key[1]}/{key[2]} already exists")
@@ -151,13 +154,15 @@ class APIServer:
         obj = copy.deepcopy(obj)
         kind = obj["kind"]
         md = obj["metadata"]
-        for hook in self._validating_hooks:
-            hook(obj)
         with self._lock:
             key = self._key(kind, md.get("namespace"), md.get("name"))
             existing = self._objects.get(key)
             if existing is None:
+                # 404 before admission (k8s): hooks that treat "absent" as
+                # CREATE must not fire for an update of a deleted object
                 raise NotFound(f"{kind} {key[1]}/{key[2]} not found")
+            for hook in self._validating_hooks:
+                hook(obj)
             if not md.get("resourceVersion"):
                 # k8s semantics: updates without an observed resourceVersion
                 # are blind overwrites that can silently drop concurrent

@@ -134,7 +134,14 @@ class KfamApp:
             raise PermissionError(
                 f"{user} may not create a profile for {owner}")
         profile = profile_api.new(name, owner,
-                                  tpu_quota=body.get("tpuQuota"))
+                                  tpu_quota=body.get("tpuQuota"),
+                                  plugins=body.get("spec", {}).get("plugins"))
+        # honor a full resourceQuotaSpec in the body (the reference's Profile
+        # spec carries corev1.ResourceQuotaSpec verbatim); tpuQuota is the
+        # dashboard's shorthand
+        rq = body.get("spec", {}).get("resourceQuotaSpec")
+        if rq:
+            profile["spec"]["resourceQuotaSpec"] = rq
         created = self.server.create(profile)
         log.info("profile created", name=name, owner=owner)
         return "201 Created", created

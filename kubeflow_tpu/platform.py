@@ -34,6 +34,9 @@ def build_platform(executor: str = "fake", *, extra_env: dict | None = None,
     server.register_validating_hook(
         lambda o: (jaxjob_api.validate(o)
                    if o.get("kind") == jaxjob_api.KIND else None))
+    from kubeflow_tpu.core import quota
+
+    quota.register(server)
 
     identity = identity or f"{socket.gethostname()}-{os.getpid()}"
     mgr = Manager(server, leader_election=leader_election, identity=identity)
