@@ -60,6 +60,13 @@ def total_hosts(job: dict) -> int:
     return topo.hosts * num_slices_of(job)
 
 
+def gang_need(job: dict) -> dict[str, int]:
+    """Quota demand of the full gang: TPU chips + pod count."""
+    topo = TOPOLOGIES[job["spec"]["topology"]]
+    n = num_slices_of(job)
+    return {topo.resource_name: topo.chips * n, "pods": topo.hosts * n}
+
+
 def validate(job: dict) -> None:
     spec = job.get("spec", {})
     topo = spec.get("topology")
@@ -130,6 +137,10 @@ def build_worker_pod(job: dict, index: int) -> dict:
         "jaxjob": name,
         "jaxjob-worker-index": str(index),
         "gang": name,  # atomic placement unit for the scheduler
+        # the slice scheduler accounts capacity from these controller-owned
+        # labels alone (spec.nodeSelector is user-overridable via podTemplate)
+        "jaxjob-num-slices": str(n_slices),
+        "jaxjob-topology": spec["topology"],
     }, spec={
         "containers": [container],
         "restartPolicy": "Never",
@@ -149,6 +160,13 @@ def build_worker_pod(job: dict, index: int) -> dict:
     for key, val in template.items():
         if key == "containers":
             continue  # the worker container is controller-owned
+        if key == "nodeSelector":
+            # merge: controller-owned keys (slice topology/ordinal) win, or
+            # the scheduler/placement layer loses sight of the gang
+            merged = copy.deepcopy(val)
+            merged.update(pod["spec"]["nodeSelector"])
+            pod["spec"]["nodeSelector"] = merged
+            continue
         pod["spec"][key] = copy.deepcopy(val)
     return pod
 

@@ -38,13 +38,17 @@ class FakeExecutor(Controller):
 
     def __init__(self, server, *, fail_once: set[str] | None = None,
                  always_fail: set[str] | None = None,
-                 complete: bool = True):
+                 complete: bool = True, run_for: float = 0.0):
         super().__init__(server)
         self.fail_once = set(fail_once or ())
         self.always_fail = set(always_fail or ())
         # complete=False models long-running servers (notebooks,
         # tensorboards): pods stay Running instead of finishing
         self.complete = complete
+        # run_for>0 holds each pod Running for that long before finishing
+        # (loadtests need gangs to actually occupy their slice for a while)
+        self.run_for = run_for
+        self._started: dict[str, float] = {}
         self._failed_already: set[str] = set()
 
     def reconcile(self, req: Request) -> Result | None:
@@ -65,6 +69,15 @@ class FakeExecutor(Controller):
             if not self.complete and name not in self.always_fail and (
                     name not in self.fail_once):
                 return None
+            if self.run_for > 0:
+                import time as _time
+
+                uid = pod["metadata"]["uid"]
+                started = self._started.setdefault(uid, _time.monotonic())
+                remaining = started + self.run_for - _time.monotonic()
+                if remaining > 0:
+                    return Result(requeue_after=remaining)
+                self._started.pop(uid, None)
             if name in self.always_fail or (
                     name in self.fail_once
                     and name not in self._failed_already):

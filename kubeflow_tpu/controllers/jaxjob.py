@@ -65,22 +65,9 @@ class JAXJobController(Controller):
         if parked is not None:
             # over quota: the WHOLE gang stays un-created (a TPU slice is
             # useless partially admitted); park and retry level-triggered
-            was = get_condition(job, "QuotaExceeded")
-            # capture before set_condition: it mutates the same dict in place
-            was_true = bool(was and was["status"] == "True")
-            set_condition(job, "QuotaExceeded", "True",
-                          reason="QuotaExceeded", message=parked)
-            if not was_true:
-                record_event(self.server, job, "Warning", "QuotaExceeded",
-                             parked)
-            status["phase"] = "Pending"
-            status["conditions"] = job["status"]["conditions"]
-            self.server.patch_status(api.KIND, req.name, req.namespace,
-                                     status)
-            return Result(requeue_after=0.25)
-        if get_condition(job, "QuotaExceeded"):
-            set_condition(job, "QuotaExceeded", "False", reason="Admitted")
-            status["conditions"] = job["status"]["conditions"]
+            return self._park(job, status, req, "QuotaExceeded",
+                              "QuotaExceeded", parked)
+        self._unpark(job, status, "QuotaExceeded", "Admitted")
 
         phases = [p.get("status", {}).get("phase", "Pending") for p in pods]
         ready = sum(1 for ph in phases if ph in ("Running", "Succeeded"))
@@ -115,9 +102,17 @@ class JAXJobController(Controller):
                                      status)
             return Result(requeue_after=0.05)
 
-        # atomic gate release once the whole gang is admitted
+        # atomic gate release once the whole gang is admitted AND the slice
+        # pool has room (strict FIFO per topology — scheduler.may_release)
         gated = [p for p in pods if p["spec"].get("schedulingGates")]
         if gated and len(pods) == gang_size:
+            from kubeflow_tpu.controllers import scheduler
+
+            ok, why = scheduler.may_release(self.server, job)
+            if not ok:
+                return self._park(job, status, req, "WaitingForSlices",
+                                  "NoCapacity", why)
+            self._unpark(job, status, "WaitingForSlices", "Scheduled")
             for p in gated:
                 p["spec"]["schedulingGates"] = []
                 self.server.update(p)
@@ -136,6 +131,59 @@ class JAXJobController(Controller):
                                if status.get("phase") == "Restarting"
                                else "Pending")
         self.server.patch_status(api.KIND, req.name, req.namespace, status)
+        return None
+
+    # -- parking -------------------------------------------------------------
+    def _park(self, job: dict, status: dict, req: Request, cond_type: str,
+              reason: str, message: str) -> Result:
+        """Park the job Pending under ``cond_type`` (event on transition),
+        polling for the blocking resource to free."""
+        was = get_condition(job, cond_type)
+        # capture before set_condition: it mutates the same dict in place
+        was_true = bool(was and was["status"] == "True")
+        set_condition(job, cond_type, "True", reason=reason, message=message)
+        if not was_true:
+            record_event(self.server, job, "Warning", cond_type, message)
+        status["phase"] = "Pending"
+        status["conditions"] = job["status"]["conditions"]
+        self.server.patch_status(api.KIND, req.name, req.namespace, status)
+        return Result(requeue_after=0.25)
+
+    def _unpark(self, job: dict, status: dict, cond_type: str,
+                reason: str) -> None:
+        if get_condition(job, cond_type):
+            set_condition(job, cond_type, "False", reason=reason)
+            status["conditions"] = job["status"]["conditions"]
+
+    def _older_quota_blocker(self, job: dict) -> str | None:
+        """FIFO for quota admission: the name of an older, still-active
+        JAXJob in this namespace parked on QuotaExceeded that could ever
+        fit, else None.  Without this a large parked gang is starved
+        forever by a stream of smaller gangs slipping into the quota
+        headroom first."""
+        ns = job["metadata"]["namespace"]
+        hard = quota.quota_hard(self.server, ns)
+        if hard is None:
+            return None
+        my_ts = float(job["metadata"].get("creationTimestamp", 0.0))
+        my_name = job["metadata"]["name"]
+        for other in self.server.list(api.KIND, namespace=ns):
+            omd = other["metadata"]
+            if omd["name"] == my_name or omd.get("deletionTimestamp"):
+                continue
+            ostatus = other.get("status") or {}
+            if ostatus.get("phase") in ("Succeeded", "Failed"):
+                continue
+            cond = get_condition(other, "QuotaExceeded")
+            if not cond or cond["status"] != "True":
+                continue
+            ots = float(omd.get("creationTimestamp", 0.0))
+            if (ots, omd["name"]) >= (my_ts, my_name):
+                continue
+            need = api.gang_need(other)
+            if any(need.get(k, 0) > lim for k, lim in hard.items()):
+                continue  # can never fit: must not wedge the queue
+            return omd["name"]
         return None
 
     # -- children ------------------------------------------------------------
@@ -176,6 +224,10 @@ class JAXJobController(Controller):
         if not missing:
             return pods, None
 
+        blocker = self._older_quota_blocker(job)
+        if blocker is not None:
+            return pods, (f"queued behind {blocker} for namespace quota "
+                          f"(FIFO)")
         to_create = [set_owner(api.build_worker_pod(job, i), job)
                      for i in missing]
         need: dict[str, int] = {}
@@ -186,8 +238,6 @@ class JAXJobController(Controller):
         if reason is not None:
             return pods, reason
 
-        if len(missing) == hosts:
-            JOBS_CREATED.inc()  # fresh gang (vs. mid-restart backfill)
         created = []
         for pod in to_create:
             try:
@@ -200,6 +250,8 @@ class JAXJobController(Controller):
                     except NotFound:
                         pass
                 return pods, str(e)
+        if len(missing) == hosts:
+            JOBS_CREATED.inc()  # fresh gang (vs. mid-restart backfill)
         pods.extend(created)
         pods.sort(key=lambda p: int(
             p["metadata"]["labels"]["jaxjob-worker-index"]))

@@ -1,0 +1,123 @@
+"""Slice-capacity gang scheduling: the coscheduling-plugin equivalent.
+
+The reference never schedules >1-pod units (SURVEY.md §7 hard-part #1); a
+TPU slice is useless partially placed, so this platform's schedulable unit
+is the GANG.  The capacity model is the cluster-scoped ``TpuSlicePool``
+(name ``default``) whose ``spec.capacity`` maps topology -> number of
+physical slices, e.g. ``{"v5e-8": 2, "v5e-32": 1}``.  No pool (or a
+topology absent from it) means unconstrained — the in-tree stand-in for "the
+real cluster autoscaler owns capacity".
+
+Release protocol (invoked from the JAXJob controller once the whole gang
+exists, so decisions serialize on its single worker thread):
+
+- a gang is RELEASED when its pods' scheduling gates are lifted; it holds
+  ``numSlices`` slices of its topology until every pod is terminal/deleted;
+- waiting gangs form a strict FIFO queue per topology ordered by JAXJob
+  creationTimestamp — a younger gang never jumps an older one (no
+  starvation), and all-or-nothing release means no partial holds, hence no
+  deadlock;
+- a gang whose numSlices exceeds the pool's TOTAL capacity can never run:
+  it is marked unschedulable and excluded from the queue so it does not
+  wedge everyone behind it.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.core.quota import TERMINAL_PHASES
+from kubeflow_tpu.core.store import APIServer, NotFound
+
+POOL_KIND = "TpuSlicePool"
+POOL_NAME = "default"
+TOPOLOGY_LABEL = "jaxjob-topology"
+
+
+def new_pool(capacity: dict[str, int]) -> dict:
+    """Cluster-scoped slice inventory, e.g. {"v5e-8": 2}."""
+    return api_object(POOL_KIND, POOL_NAME,
+                      spec={"capacity": dict(capacity)})
+
+
+def pool_capacity(server: APIServer) -> dict[str, int] | None:
+    try:
+        pool = server.get(POOL_KIND, POOL_NAME)
+    except NotFound:
+        return None
+    return pool.get("spec", {}).get("capacity") or None
+
+
+def _pod_topology(pod: dict) -> str | None:
+    # controller-owned label, NOT spec.nodeSelector: a user podTemplate can
+    # replace the nodeSelector, which must not hide the gang from accounting
+    return pod["metadata"].get("labels", {}).get(TOPOLOGY_LABEL)
+
+
+def _scan_gangs(server: APIServer,
+                topology: str) -> tuple[dict, dict]:
+    """(released, waiting): (ns, gang) -> slices held/needed, from the pod
+    view (level-triggered: recomputed every decision, no counters)."""
+    released: dict[tuple, int] = {}
+    waiting: dict[tuple, int] = {}
+    for pod in server.list("Pod"):
+        if _pod_topology(pod) != topology:
+            continue
+        if pod.get("status", {}).get("phase") in TERMINAL_PHASES:
+            continue
+        gang = pod["metadata"].get("labels", {}).get("gang")
+        if not gang:
+            continue
+        key = (pod["metadata"].get("namespace"), gang)
+        slices = int(pod["metadata"]["labels"].get("jaxjob-num-slices", "1"))
+        if pod["spec"].get("schedulingGates"):
+            waiting[key] = slices
+        else:
+            released[key] = slices
+    # a gang mid-release (some gates lifted) holds capacity already
+    for key in released:
+        waiting.pop(key, None)
+    return released, waiting
+
+
+def _job_created(server: APIServer, key: tuple) -> float:
+    ns, name = key
+    try:
+        job = server.get("JAXJob", name, ns)
+        return float(job["metadata"].get("creationTimestamp", 0.0))
+    except NotFound:
+        return 0.0
+
+
+def may_release(server: APIServer, job: dict) -> tuple[bool, str]:
+    """(ok, reason): whether this job's complete, gated gang may be released
+    under the slice pool — strict FIFO per topology, all-or-nothing."""
+    spec = job["spec"]
+    topology = spec["topology"]
+    need = int(spec.get("numSlices", 1))
+    cap_map = pool_capacity(server)
+    if cap_map is None or topology not in cap_map:
+        return True, ""
+    cap = int(cap_map[topology])
+    if need > cap:
+        return False, (f"unschedulable: needs {need} x {topology} but the "
+                       f"pool only has {cap} (will never fit)")
+
+    released, waiting = _scan_gangs(server, topology)
+    me = (job["metadata"]["namespace"], job["metadata"]["name"])
+    if me in released:
+        # this gang already holds its slices (backfilling a deleted worker):
+        # re-release unconditionally or it deadlocks against its own hold
+        return True, ""
+    free = cap - sum(released.values())
+    queue = sorted(
+        (key for key, slices in waiting.items() if slices <= cap),
+        key=lambda key: (_job_created(server, key), key))
+    for key in queue:
+        if key == me:
+            break
+        return False, (f"queued behind gang {key[0]}/{key[1]} "
+                       f"({free} of {cap} {topology} slices free)")
+    if need > free:
+        return False, (f"waiting for capacity: needs {need} x {topology}, "
+                       f"{free} of {cap} free")
+    return True, ""

@@ -24,6 +24,7 @@ import copy
 import fnmatch
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable
 
@@ -116,6 +117,9 @@ class APIServer:
                 md.setdefault("namespace", "default")
             md["uid"] = ob.new_uid()
             md["resourceVersion"] = self._next_rv()
+            # server-set unconditionally (k8s): a client-supplied timestamp
+            # could forge FIFO position in the slice scheduler
+            md["creationTimestamp"] = time.time()
             md.setdefault("labels", {})
             md.setdefault("annotations", {})
             self._objects[key] = obj

@@ -1,0 +1,199 @@
+"""Gang scheduling under contention (VERDICT r1 #7, SURVEY §7 hard-part #1).
+
+Two gangs racing for one slice is the scenario that makes gang scheduling
+hard: partial placement must never happen, the loser must stay gated with
+events, order must be FIFO, and nothing may deadlock.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api import jaxjob as api
+from kubeflow_tpu.controllers import scheduler
+from kubeflow_tpu.controllers.executor import FakeExecutor
+from kubeflow_tpu.controllers.jaxjob import JAXJobController
+from kubeflow_tpu.core import APIServer, Manager
+from kubeflow_tpu.core.objects import get_condition
+
+
+def wait_for(fn, timeout=15.0):
+    from tests.conftest import poll_until
+
+    return poll_until(fn, timeout=timeout, interval=0.03)
+
+
+def job_phase(server, name, ns="ml"):
+    return server.get(api.KIND, name, ns).get("status", {}).get("phase")
+
+
+def gang_pods(server, name, ns="ml"):
+    return server.list("Pod", namespace=ns, label_selector={
+        "matchLabels": {"jaxjob": name}})
+
+
+def finish_gang(server, name, ns="ml"):
+    for p in gang_pods(server, name, ns):
+        server.patch_status("Pod", p["metadata"]["name"], ns,
+                            {"phase": "Succeeded"})
+
+
+@pytest.fixture()
+def harness():
+    server = APIServer()
+    mgr = Manager(server)
+    mgr.add(JAXJobController(server))
+    executor = FakeExecutor(server, complete=False)
+    mgr.add(executor)
+    mgr.start()
+    yield server, mgr, executor
+    mgr.stop()
+
+
+def test_two_gangs_one_slice_fifo_no_deadlock(harness):
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 1}))
+
+    server.create(api.new("winner", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "winner") == "Running" or None)
+
+    server.create(api.new("loser", "ml", topology="v5e-8"))
+    parked = wait_for(lambda: (
+        lambda j: j if get_condition(j, "WaitingForSlices")
+        and get_condition(j, "WaitingForSlices")["status"] == "True"
+        else None)(server.get(api.KIND, "loser", "ml")))
+    assert parked["status"]["phase"] == "Pending"
+    # the loser's pods EXIST (quota passed) but every one stays gated
+    pods = gang_pods(server, "loser")
+    assert len(pods) == 2
+    assert all(p["spec"].get("schedulingGates") for p in pods)
+    events = [e for e in server.list("Event", namespace="ml")
+              if e["spec"]["involvedObject"].get("name") == "loser"]
+    assert any(e["spec"]["reason"] == "WaitingForSlices" for e in events)
+
+    # winner finishes -> slice frees -> loser runs to completion
+    executor.complete = True
+    finish_gang(server, "winner")
+    done = wait_for(
+        lambda: (lambda j: j if j.get("status", {}).get("phase")
+                 == "Succeeded" else None)(server.get(api.KIND, "loser",
+                                                      "ml")),
+        timeout=20)
+    assert get_condition(done, "WaitingForSlices")["status"] == "False"
+
+
+def test_fifo_order_across_waiters(harness):
+    """With two gangs queued behind a running one, the OLDER waiter runs
+    first when the slice frees; the younger stays parked behind it."""
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 1}))
+
+    server.create(api.new("running", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "running") == "Running" or None)
+    server.create(api.new("older", "ml", topology="v5e-8"))
+    wait_for(lambda: get_condition(server.get(api.KIND, "older", "ml"),
+                                   "WaitingForSlices") or None)
+    server.create(api.new("younger", "ml", topology="v5e-8"))
+    wait_for(lambda: get_condition(server.get(api.KIND, "younger", "ml"),
+                                   "WaitingForSlices") or None)
+
+    finish_gang(server, "running")
+    wait_for(lambda: job_phase(server, "older") == "Running" or None)
+    # the younger gang must still be gated, queued behind the older one
+    young = server.get(api.KIND, "younger", "ml")
+    assert job_phase(server, "younger") == "Pending"
+    assert "queued behind" in get_condition(young,
+                                            "WaitingForSlices")["message"]
+    assert all(p["spec"].get("schedulingGates")
+               for p in gang_pods(server, "younger"))
+
+    finish_gang(server, "older")
+    wait_for(lambda: job_phase(server, "younger") == "Running" or None)
+
+
+def test_impossible_gang_does_not_wedge_queue(harness):
+    """A gang needing more slices than the pool ever has is unschedulable
+    and must not block feasible gangs behind it."""
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 1}))
+
+    server.create(api.new("impossible", "ml", topology="v5e-8",
+                          num_slices=2,
+                          parallelism={"dp": 2, "fsdp": 8, "tp": 1,
+                                       "sp": 1}))
+    parked = wait_for(lambda: (
+        lambda j: j if get_condition(j, "WaitingForSlices") else None)(
+        server.get(api.KIND, "impossible", "ml")))
+    assert "will never fit" in get_condition(
+        parked, "WaitingForSlices")["message"]
+
+    # a feasible gang created AFTER the impossible one still runs
+    server.create(api.new("feasible", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "feasible") == "Running" or None)
+
+
+def test_multislice_gang_consumes_multiple_slices(harness):
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 2}))
+
+    server.create(api.new("double", "ml", topology="v5e-8", num_slices=2,
+                          parallelism={"dp": 2, "fsdp": 8, "tp": 1,
+                                       "sp": 1}))
+    wait_for(lambda: job_phase(server, "double") == "Running" or None)
+    # pool is now fully held: a single-slice gang must wait
+    server.create(api.new("single", "ml", topology="v5e-8"))
+    wait_for(lambda: get_condition(server.get(api.KIND, "single", "ml"),
+                                   "WaitingForSlices") or None)
+    finish_gang(server, "double")
+    wait_for(lambda: job_phase(server, "single") == "Running" or None)
+
+
+def test_no_pool_means_unconstrained(harness):
+    server, mgr, executor = harness
+    executor.complete = True
+    for i in range(3):
+        server.create(api.new(f"job{i}", "ml", topology="v5e-8"))
+    for i in range(3):
+        wait_for(lambda i=i: job_phase(server, f"job{i}") == "Succeeded"
+                 or None)
+
+
+def test_backfill_of_running_gang_does_not_deadlock(harness):
+    """A released gang that loses one pod (eviction) must re-admit the
+    backfilled worker against its OWN held slices (review finding: it used
+    to queue behind itself forever)."""
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 1}))
+    server.create(api.new("gang", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "gang") == "Running" or None)
+
+    # simulate eviction of one worker
+    server.delete("Pod", api.worker_pod_name("gang", 1), "ml")
+    # the gang must return to Running (backfilled + re-released), not park
+    wait_for(lambda: (
+        job_phase(server, "gang") == "Running"
+        and len([p for p in gang_pods(server, "gang")
+                 if not p["spec"].get("schedulingGates")]) == 2) or None)
+
+
+def test_podtemplate_nodeselector_cannot_hide_gang(harness):
+    """User podTemplate nodeSelector merges under the controller's topology
+    keys; capacity accounting uses controller-owned labels either way
+    (review finding: a template could make the gang invisible -> pool
+    overcommit)."""
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 1}))
+    server.create(api.new("sneaky", "ml", topology="v5e-8",
+                          pod_template={"nodeSelector": {"disk": "ssd"}}))
+    wait_for(lambda: job_phase(server, "sneaky") == "Running" or None)
+    pod = gang_pods(server, "sneaky")[0]
+    sel = pod["spec"]["nodeSelector"]
+    assert sel["disk"] == "ssd"
+    assert sel["cloud-tpu.google.com/slice"] == "v5e-8"
+    assert pod["metadata"]["labels"]["jaxjob-topology"] == "v5e-8"
+
+    # pool of 1 is held: a second gang must wait (it would run if sneaky
+    # were invisible to accounting)
+    server.create(api.new("waiter", "ml", topology="v5e-8"))
+    wait_for(lambda: get_condition(server.get(api.KIND, "waiter", "ml"),
+                                   "WaitingForSlices") or None)

@@ -73,13 +73,9 @@ def test_update_not_recharged(server):
 
 
 def wait_for(fn, timeout=15.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        out = fn()
-        if out is not None:
-            return out
-        time.sleep(0.03)
-    raise AssertionError("condition never became true")
+    from tests.conftest import poll_until
+
+    return poll_until(fn, timeout=timeout, interval=0.03)
 
 
 def test_second_gang_parked_then_admitted(server):
@@ -188,3 +184,44 @@ def test_tpu_requests_only_in_requests_section_charged(server):
     server.create(pod)
     with pytest.raises(Invalid, match="exceeded"):
         server.create(tpu_pod("more", "team", 1))
+
+
+def test_quota_fifo_big_gang_not_starved(server):
+    """A large parked gang must not be starved by younger smaller gangs
+    slipping into quota headroom (review finding)."""
+    make_quota(server, "ml", chips=8)
+    mgr = Manager(server)
+    mgr.add(JAXJobController(server))
+    executor = FakeExecutor(server, complete=False)
+    mgr.add(executor)
+    mgr.start()
+    try:
+        server.create(api.new("small-1", "ml", topology="v5e-4"))
+        wait_for(lambda: (server.get(api.KIND, "small-1", "ml")
+                          .get("status", {}).get("phase") == "Running")
+                 or None)
+        # big (8 chips) parks: only 4 free
+        server.create(api.new("big", "ml", topology="v5e-8"))
+        wait_for(lambda: get_condition(server.get(api.KIND, "big", "ml"),
+                                       "QuotaExceeded") or None)
+        # younger small gang would fit the 4 free chips but must queue
+        # behind big
+        server.create(api.new("small-2", "ml", topology="v5e-4"))
+        parked = wait_for(lambda: (
+            lambda j: j if get_condition(j, "QuotaExceeded") else None)(
+            server.get(api.KIND, "small-2", "ml")))
+        assert "queued behind big" in get_condition(
+            parked, "QuotaExceeded")["message"]
+
+        # small-1 finishes -> big admits first, then small-2
+        for p in server.list("Pod", namespace="ml", label_selector={
+                "matchLabels": {"jaxjob": "small-1"}}):
+            server.patch_status("Pod", p["metadata"]["name"], "ml",
+                                {"phase": "Succeeded"})
+        wait_for(lambda: (server.get(api.KIND, "big", "ml")
+                          .get("status", {}).get("phase") == "Running")
+                 or None)
+        assert (server.get(api.KIND, "small-2", "ml")["status"]["phase"]
+                == "Pending")
+    finally:
+        mgr.stop()
