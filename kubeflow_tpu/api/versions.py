@@ -1,0 +1,177 @@
+"""Versioned API schemas + conversion (the CRD conversion-webhook analog).
+
+The reference maintains v1alpha1/v1beta1/v1 per CRD with conversion functions
+(notebook-controller/api/v1/notebook_conversion.go, api/{v1alpha1,v1beta1}/
+notebook_types.go); its apiserver converts every write to the storage version
+and serves any requested version on read.  Same contract here:
+
+- the store holds ONLY storage-version (``v1``) objects — controllers never
+  see old shapes;
+- a mutating hook up-converts v1beta1 writes to v1 at admission;
+- the REST layer down-converts on read when ``?version=v1beta1`` is asked.
+
+v1beta1 shapes (this platform's actual history, not the reference's):
+
+  Notebook v1beta1  — flat spawner fields {image, cpu, memory, tpuResource,
+    tpuChips, workspacePvc, env}; v1 wraps a full PodSpec in
+    spec.template.spec (notebook_types.go:27-35 pattern).
+  JAXJob v1beta1    — {tpuSlice, sliceCount, mesh{dp,fsdp,tp,sp}, train{...}}
+    ; v1 renamed these to topology/numSlices/parallelism/trainer.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable
+
+GROUP = "kubeflow-tpu.org"
+STORAGE_VERSION = "v1"
+
+
+def _split(api_version: str | None) -> tuple[str, str]:
+    if not api_version or "/" not in api_version:
+        return GROUP, api_version or STORAGE_VERSION
+    group, version = api_version.split("/", 1)
+    return group, version
+
+
+# (kind, version) -> (to_storage, from_storage); each fn takes and returns a
+# full object and must be lossless for objects the version can express
+_CONVERSIONS: dict[tuple[str, str],
+                   tuple[Callable[[dict], dict], Callable[[dict], dict]]] = {}
+
+
+def register_conversion(kind: str, version: str,
+                        to_storage: Callable[[dict], dict],
+                        from_storage: Callable[[dict], dict]) -> None:
+    _CONVERSIONS[(kind, version)] = (to_storage, from_storage)
+
+
+def served_versions(kind: str) -> list[str]:
+    return [STORAGE_VERSION] + sorted(
+        v for (k, v) in _CONVERSIONS if k == kind)
+
+
+def to_storage(obj: dict) -> dict:
+    """Up-convert a write to the storage version (identity for v1 /
+    unversioned kinds)."""
+    kind = obj.get("kind", "")
+    group, version = _split(obj.get("apiVersion"))
+    if group != GROUP or version == STORAGE_VERSION:
+        return obj
+    conv = _CONVERSIONS.get((kind, version))
+    if conv is None:
+        raise ValueError(
+            f"{kind}: unknown API version {version!r}; served versions: "
+            f"{served_versions(kind)}")
+    out = conv[0](copy.deepcopy(obj))
+    out["apiVersion"] = f"{GROUP}/{STORAGE_VERSION}"
+    return out
+
+
+def from_storage(obj: dict, version: str) -> dict:
+    """Down-convert a stored object for a read requesting ``version``."""
+    kind = obj.get("kind", "")
+    if version == STORAGE_VERSION:
+        return obj
+    conv = _CONVERSIONS.get((kind, version))
+    if conv is None:
+        raise ValueError(
+            f"{kind}: cannot serve version {version!r}; served versions: "
+            f"{served_versions(kind)}")
+    out = conv[1](copy.deepcopy(obj))
+    out["apiVersion"] = f"{GROUP}/{version}"
+    return out
+
+
+def register(server) -> None:
+    """Admission-time storage-version normalization (conversion webhook)."""
+    server.register_mutating_hook(
+        lambda obj: to_storage(obj) if (obj.get("kind"), _split(
+            obj.get("apiVersion"))[1]) in _CONVERSIONS else None)
+
+
+# -- Notebook v1beta1 ---------------------------------------------------------
+
+def _notebook_beta_to_v1(obj: dict) -> dict:
+    spec = obj.get("spec", {})
+    resources: dict = {"requests": {"cpu": spec.get("cpu", "0.5"),
+                                    "memory": spec.get("memory", "1Gi")}}
+    if spec.get("tpuResource") and spec.get("tpuChips"):
+        resources["limits"] = {spec["tpuResource"]: spec["tpuChips"]}
+    container = {
+        "name": obj["metadata"]["name"],
+        "image": spec.get("image", ""),
+        "resources": resources,
+        "env": list(spec.get("env") or []),
+    }
+    volumes = []
+    if spec.get("workspacePvc"):
+        container["volumeMounts"] = [{"name": "workspace",
+                                      "mountPath": "/home/jovyan"}]
+        volumes.append({"name": "workspace", "persistentVolumeClaim": {
+            "claimName": spec["workspacePvc"]}})
+    obj["spec"] = {"template": {"spec": {"containers": [container],
+                                         "volumes": volumes}}}
+    return obj
+
+
+def _notebook_v1_to_beta(obj: dict) -> dict:
+    pod = obj.get("spec", {}).get("template", {}).get("spec", {})
+    cts = pod.get("containers") or [{}]
+    c0 = cts[0]
+    res = c0.get("resources", {})
+    beta: dict = {
+        "image": c0.get("image", ""),
+        "cpu": res.get("requests", {}).get("cpu", "0.5"),
+        "memory": res.get("requests", {}).get("memory", "1Gi"),
+        "env": list(c0.get("env") or []),
+    }
+    for key, val in (res.get("limits") or {}).items():
+        if key.startswith("cloud-tpu.google.com/"):
+            beta["tpuResource"] = key
+            beta["tpuChips"] = val
+            break
+    for vol in pod.get("volumes") or []:
+        pvc = vol.get("persistentVolumeClaim")
+        if pvc and vol.get("name") == "workspace":
+            beta["workspacePvc"] = pvc["claimName"]
+            break
+    obj["spec"] = beta
+    return obj
+
+
+# -- JAXJob v1beta1 -----------------------------------------------------------
+
+def _jaxjob_beta_to_v1(obj: dict) -> dict:
+    spec = obj.get("spec", {})
+    obj["spec"] = {
+        "topology": spec.get("tpuSlice", "v5e-4"),
+        "numSlices": spec.get("sliceCount", 1),
+        "parallelism": dict(spec.get("mesh") or {}),
+        "trainer": dict(spec.get("train") or {}),
+        "podTemplate": dict(spec.get("podTemplate") or {}),
+        "maxRestarts": spec.get("maxRestarts", 3),
+        "image": spec.get("image", "kubeflow-tpu/worker:latest"),
+    }
+    return obj
+
+
+def _jaxjob_v1_to_beta(obj: dict) -> dict:
+    spec = obj.get("spec", {})
+    obj["spec"] = {
+        "tpuSlice": spec.get("topology", "v5e-4"),
+        "sliceCount": spec.get("numSlices", 1),
+        "mesh": dict(spec.get("parallelism") or {}),
+        "train": dict(spec.get("trainer") or {}),
+        "podTemplate": dict(spec.get("podTemplate") or {}),
+        "maxRestarts": spec.get("maxRestarts", 3),
+        "image": spec.get("image", "kubeflow-tpu/worker:latest"),
+    }
+    return obj
+
+
+register_conversion("Notebook", "v1beta1",
+                    _notebook_beta_to_v1, _notebook_v1_to_beta)
+register_conversion("JAXJob", "v1beta1",
+                    _jaxjob_beta_to_v1, _jaxjob_v1_to_beta)

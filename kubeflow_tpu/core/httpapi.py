@@ -99,14 +99,18 @@ class RestAPI:
             raise NotFound(f"no route {path}")
         parts = parts[1:]
 
+        version = qs.get("version", [None])[0]
         if len(parts) == 1:
             kind = parts[0]
             if method == "GET":
                 self._authz(user, "list", kind, qs.get("namespace",
                                                        [None])[0])
-                return "200 OK", {"items": self.server.list(
+                items = self.server.list(
                     kind, namespace=qs.get("namespace", [None])[0],
-                    label_selector=_selector_from_query(qs))}
+                    label_selector=_selector_from_query(qs))
+                if version:
+                    items = [self._downconvert(o, version) for o in items]
+                return "200 OK", {"items": items}
             if method == "POST":
                 obj = self._body(environ)
                 ns = obj.get("metadata", {}).get("namespace")
@@ -127,11 +131,15 @@ class RestAPI:
                 raise NotFound("status supports PUT only")
             if method == "GET":
                 self._authz(user, "get", kind, ns)
-                return "200 OK", self.server.get(kind, name, ns)
+                obj = self.server.get(kind, name, ns)
+                if version:
+                    obj = self._downconvert(obj, version)
+                return "200 OK", obj
             if method == "PUT":
                 self._authz(user, "update", kind, ns)
                 obj = self._body(environ)
                 obj["kind"] = kind
+                obj = self._upconvert(obj)
                 body_md = obj.get("metadata", {})
                 # the path is the authorization subject; the body must match
                 if (body_md.get("name", name) != name
@@ -148,6 +156,16 @@ class RestAPI:
                 self.server.delete(kind, name, ns)
                 return "200 OK", {"status": "deleted"}
         raise NotFound(f"no route {method} {path}")
+
+    def _downconvert(self, obj: dict, version: str) -> dict:
+        from kubeflow_tpu.api import versions
+
+        return versions.from_storage(obj, version)
+
+    def _upconvert(self, obj: dict) -> dict:
+        from kubeflow_tpu.api import versions
+
+        return versions.to_storage(obj)
 
     def _user(self, environ) -> str | None:
         raw = environ.get(USERID_HEADER)

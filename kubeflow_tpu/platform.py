@@ -37,6 +37,9 @@ def build_platform(executor: str = "fake", *, extra_env: dict | None = None,
     from kubeflow_tpu.core import quota
 
     quota.register(server)
+    from kubeflow_tpu.api import versions
+
+    versions.register(server)  # v1beta1 -> v1 storage conversion
 
     identity = identity or f"{socket.gethostname()}-{os.getpid()}"
     mgr = Manager(server, leader_election=leader_election, identity=identity)
