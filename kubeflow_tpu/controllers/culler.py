@@ -1,40 +1,107 @@
 """Idle-notebook culling (reference: notebook-controller/pkg/culler).
 
-Probes the live Jupyter activity API for ``last_activity`` and stamps the
-stop annotation when idle past the threshold; the notebook reconcile sees the
-annotation and scales to zero (culler.go:91-108, 138-189).  The probe is
-injectable so tests and non-HTTP notebook runtimes plug in their own.
+The reference probes the live Jupyter activity API over the mesh
+(culler.go:138-169).  That probe cannot work in this platform's in-process
+execution model (LocalExecutor pods serve no mesh DNS), so the DEFAULT probe
+is a chain that matches how notebooks actually run here:
+
+1. ``notebooks.kubeflow.org/last-activity`` annotation on the Notebook CR
+   (runtimes that can reach the API server report activity directly);
+2. the activity FILE the notebook container writes at the path injected via
+   the ``NB_ACTIVITY_FILE`` env (LocalExecutor notebooks share the host
+   filesystem — this is the probe that fires in the single-binary platform);
+3. the Jupyter HTTP status endpoint (real-cluster deployments);
+4. otherwise None = unreachable = treated as active (no flapping,
+   culler.go:171-189 trusts notebook-reported activity).
 """
 
 from __future__ import annotations
 
 import datetime as dt
 import json
+import os
 import urllib.request
 from typing import Callable
 
 from kubeflow_tpu.utils.config import Config, config_field
 
+ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+ACTIVITY_FILE_ENV = "NB_ACTIVITY_FILE"
+
 
 class CullerConfig(Config):
     enable_culling: bool = config_field(False, env="ENABLE_CULLING")
-    idle_time_min: int = config_field(1440, env="IDLE_TIME")
-    check_period_min: int = config_field(1, env="CULLING_CHECK_PERIOD")
+    idle_time_min: float = config_field(1440.0, env="IDLE_TIME")
+    check_period_min: float = config_field(1.0, env="CULLING_CHECK_PERIOD")
+    activity_dir: str = config_field("/tmp/kubeflow-tpu-activity",
+                                     env="NB_ACTIVITY_DIR")
+
+
+def activity_file_path(activity_dir: str, nb: dict) -> str:
+    md = nb["metadata"]
+    return os.path.join(activity_dir, md.get("namespace") or "default",
+                        f"{md['name']}.json")
+
+
+def _parse_ts(raw: str) -> dt.datetime | None:
+    try:
+        ts = dt.datetime.fromisoformat(raw.replace("Z", "+00:00"))
+        if ts.tzinfo is None:
+            ts = ts.replace(tzinfo=dt.timezone.utc)
+        return ts
+    except (ValueError, AttributeError):
+        return None
+
+
+def annotation_activity_probe(nb: dict) -> dt.datetime | None:
+    raw = nb["metadata"].get("annotations", {}).get(ACTIVITY_ANNOTATION)
+    return _parse_ts(raw) if raw else None
+
+
+def file_activity_probe(nb: dict, activity_dir: str) -> dt.datetime | None:
+    """last_activity from the file the notebook container writes; falls back
+    to the file's mtime when the contents aren't parseable."""
+    path = activity_file_path(activity_dir, nb)
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        ts = _parse_ts(data.get("last_activity", ""))
+        if ts is not None:
+            return ts
+    except (OSError, json.JSONDecodeError):
+        pass
+    try:
+        return dt.datetime.fromtimestamp(os.path.getmtime(path),
+                                         dt.timezone.utc)
+    except OSError:
+        return None
 
 
 def http_activity_probe(nb: dict) -> dt.datetime | None:
     """GET the notebook's Jupyter status endpoint inside the mesh
-    (culler.go:138-169); None = unreachable (treated as active)."""
+    (culler.go:138-169); None = unreachable."""
     md = nb["metadata"]
     url = (f"http://{md['name']}.{md['namespace']}.svc"
            f"/notebook/{md['namespace']}/{md['name']}/api/status")
     try:
         with urllib.request.urlopen(url, timeout=2) as r:
             data = json.loads(r.read())
-        return dt.datetime.fromisoformat(
-            data["last_activity"].replace("Z", "+00:00"))
+        return _parse_ts(data["last_activity"])
     except Exception:
         return None
+
+
+def default_probe(cfg: CullerConfig) -> Callable[[dict], dt.datetime | None]:
+    def probe(nb: dict) -> dt.datetime | None:
+        for source in (annotation_activity_probe,
+                       lambda n: file_activity_probe(n, cfg.activity_dir),
+                       http_activity_probe):
+            ts = source(nb)
+            if ts is not None:
+                return ts
+        return None
+
+    return probe
 
 
 class Culler:
@@ -42,7 +109,7 @@ class Culler:
                  probe: Callable[[dict], dt.datetime | None] | None = None,
                  now: Callable[[], dt.datetime] | None = None):
         self.cfg = cfg or CullerConfig.load()
-        self.probe = probe or http_activity_probe
+        self.probe = probe or default_probe(self.cfg)
         self.now = now or (lambda: dt.datetime.now(dt.timezone.utc))
 
     @property

@@ -41,6 +41,32 @@ class NotebookController(Controller):
         self.cfg = cfg or NotebookControllerConfig.load()
         self.culler = culler or Culler()
         self._seen: set[str] = set()
+        # re-emission bookkeeping: (event uid) -> count already mirrored
+        self._emitted: dict[str, int] = {}
+        # map-function watches (notebook_controller.go:573-670): pod changes
+        # and pod/STS events route to the owning notebook's key
+        self.watch_mappers = {"Pod": self._map_pod,
+                              "Event": self._map_event}
+
+    @staticmethod
+    def _map_pod(ev):
+        md = ev.object.get("metadata", {})
+        nb_name = md.get("labels", {}).get("notebook-name")
+        if nb_name:
+            yield Request(md.get("namespace"), nb_name)
+
+    @staticmethod
+    def _map_event(ev):
+        """Events on a notebook's pod (<name>-N) or StatefulSet re-enqueue
+        the notebook; stale keys are harmless (reconcile no-ops)."""
+        spec = ev.object.get("spec", {})
+        involved = spec.get("involvedObject", {})
+        name = involved.get("name", "")
+        ns = ev.object.get("metadata", {}).get("namespace")
+        if involved.get("kind") == "StatefulSet" and name:
+            yield Request(ns, name)
+        elif involved.get("kind") == "Pod" and "-" in name:
+            yield Request(ns, name.rsplit("-", 1)[0])
 
     def reconcile(self, req: Request) -> Result | None:
         try:
@@ -98,6 +124,15 @@ class NotebookController(Controller):
         if not any(e.get("name") == api.NB_PREFIX_ENV for e in env):
             env.append({"name": api.NB_PREFIX_ENV,
                         "value": api.url_prefix(nb).rstrip("/")})
+        # the activity-file culling protocol: the container reports activity
+        # at this path; the default culler probe reads it (culler.py)
+        from kubeflow_tpu.controllers.culler import (
+            ACTIVITY_FILE_ENV, activity_file_path)
+
+        if not any(e.get("name") == ACTIVITY_FILE_ENV for e in env):
+            env.append({"name": ACTIVITY_FILE_ENV,
+                        "value": activity_file_path(
+                            self.culler.cfg.activity_dir, nb)})
         if not c0.get("ports"):
             c0["ports"] = [{"containerPort": api.DEFAULT_PORT,
                             "name": "notebook-port"}]
@@ -158,9 +193,37 @@ class NotebookController(Controller):
                     }],
                 }), nb))
 
+    def _reemit_child_events(self, nb: dict) -> None:
+        """Mirror pod/STS Warning events onto the Notebook CR
+        (notebook_controller.go:90-109) so users see 'why is my notebook
+        stuck' without pod access; the jupyter backend derives WARNING
+        status from these (crud-web-apps common/status.py:9-99)."""
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        for ev in self.server.list("Event", namespace=ns):
+            spec = ev["spec"]
+            if spec.get("type") != "Warning":
+                continue
+            involved = spec.get("involvedObject", {})
+            mine = (involved.get("kind") == "StatefulSet"
+                    and involved.get("name") == name) or (
+                involved.get("kind") == "Pod"
+                and involved.get("name", "").rsplit("-", 1)[0] == name)
+            if not mine:
+                continue
+            uid = ev["metadata"]["uid"]
+            count = spec.get("count", 1)
+            if self._emitted.get(uid) == count:
+                continue  # already mirrored this occurrence
+            self._emitted[uid] = count
+            record_event(self.server, nb, "Warning",
+                         spec.get("reason", "ChildWarning"),
+                         spec.get("message", ""))
+
     def _mirror_status(self, nb: dict) -> None:
         name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
+        self._reemit_child_events(nb)
         status: dict = {"readyReplicas": 0, "containerState": {}}
         try:
             sts = self.server.get("StatefulSet", name, ns)

@@ -190,6 +190,18 @@ class JupyterApp(CrudApp):
         return "200 OK", {"success": True}
 
     # -- helpers --------------------------------------------------------------
+    def _nb_events(self, nb: dict) -> list[dict]:
+        """Events the controller mirrored onto this Notebook CR, newest
+        first (the WARNING-status source, common/status.py:9-99)."""
+        md = nb["metadata"]
+        evs = [e["spec"] for e in self.server.list(
+            "Event", namespace=md.get("namespace"))
+            if e["spec"].get("involvedObject", {}).get("kind") == nb_api.KIND
+            and e["spec"]["involvedObject"].get("name") == md["name"]
+            and e["spec"]["involvedObject"].get("uid") == md.get("uid")]
+        return sorted(evs, key=lambda e: e.get("lastTimestamp", 0),
+                      reverse=True)
+
     def _view(self, nb: dict, detail: bool = False) -> dict[str, Any]:
         md = nb["metadata"]
         c0 = nb["spec"]["template"]["spec"]["containers"][0]
@@ -204,7 +216,7 @@ class JupyterApp(CrudApp):
             "memory": c0.get("resources", {}).get("requests", {}).get(
                 "memory"),
             "tpus": tpus,
-            "status": notebook_status(nb),
+            "status": notebook_status(nb, events=self._nb_events(nb)),
             "url": nb_api.url_prefix(nb),
         }
         if detail:
