@@ -93,13 +93,15 @@ def http_activity_probe(nb: dict) -> dt.datetime | None:
 
 def default_probe(cfg: CullerConfig) -> Callable[[dict], dt.datetime | None]:
     def probe(nb: dict) -> dt.datetime | None:
-        for source in (annotation_activity_probe,
-                       lambda n: file_activity_probe(n, cfg.activity_dir),
-                       http_activity_probe):
-            ts = source(nb)
-            if ts is not None:
-                return ts
-        return None
+        # MOST RECENT activity across all sources: a stale annotation left
+        # by one reporter must not shadow a fresh activity file (and vice
+        # versa) — taking the first non-None would cull in-use notebooks
+        stamps = [source(nb) for source in (
+            annotation_activity_probe,
+            lambda n: file_activity_probe(n, cfg.activity_dir),
+            http_activity_probe)]
+        stamps = [s for s in stamps if s is not None]
+        return max(stamps) if stamps else None
 
     return probe
 

@@ -78,10 +78,31 @@ class LlamaAttention(nn.Module):
         k = kl.rotary_embedding(k, positions, cfg.rope_base)
 
         if cache is not None:
-            # decode: cache is dict(k=[B,S,Hkv,D], v=..., index scalar)
+            # cache is dict(k=[B,S,Hkv,D], v=..., index) where index is a
+            # scalar (legacy equal-length batches) or [B] (ragged batches /
+            # continuous batching: every sequence sits at its own position)
             idx = cache["index"]
-            ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, idx, axis=1)
-            cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v, idx, axis=1)
+            if idx.ndim == 0:
+                ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, idx,
+                                                         axis=1)
+                cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v, idx,
+                                                         axis=1)
+            elif x.shape[1] == 1:
+                # per-sequence single-token decode: scatter row b's kv at
+                # its own slot index[b] (clamped so frozen/finished rows
+                # never write out of bounds)
+                b_idx = jnp.arange(x.shape[0])
+                write = jnp.clip(idx, 0, cache["k"].shape[1] - 1)
+                ck = cache["k"].at[b_idx, write].set(k[:, 0])
+                cv = cache["v"].at[b_idx, write].set(v[:, 0])
+            else:
+                # ragged prefill into fresh rows: the padded block writes at
+                # slot 0; junk beyond each row's true length stays masked
+                # until overwritten by decode
+                ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, 0,
+                                                         axis=1)
+                cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v, 0,
+                                                         axis=1)
             cache = {"k": ck, "v": cv, "index": idx + x.shape[1]}
             s_total = ck.shape[1]
             # causal per query: key slot j visible to the query at absolute
@@ -142,6 +163,8 @@ class LlamaModel(nn.Module):
         if positions is None:
             start = (cache["layers"][0]["index"]
                      if cache is not None else jnp.zeros((), jnp.int32))
+            if start.ndim == 1:  # [B] per-sequence positions
+                start = start[:, None]
             positions = jnp.broadcast_to(start + jnp.arange(s)[None, :],
                                          (b, s))
         embed = kl.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
@@ -164,13 +187,18 @@ class LlamaModel(nn.Module):
         return out
 
 
-def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None):
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
+               per_sequence: bool = False):
+    """per_sequence=True allocates a [B] position index so each row can sit
+    at its own length (ragged prompts, continuous batching)."""
     max_len = max_len or cfg.max_seq_len
+    index = (jnp.zeros((batch,), jnp.int32) if per_sequence
+             else jnp.zeros((), jnp.int32))
     layer = lambda: {  # noqa: E731
         "k": jnp.zeros((batch, max_len, cfg.num_kv_heads, cfg.head_dim),
                        cfg.jnp_dtype),
         "v": jnp.zeros((batch, max_len, cfg.num_kv_heads, cfg.head_dim),
                        cfg.jnp_dtype),
-        "index": jnp.zeros((), jnp.int32),
+        "index": index,
     }
     return {"layers": [layer() for _ in range(cfg.num_layers)]}

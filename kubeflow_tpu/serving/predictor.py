@@ -1,12 +1,13 @@
 """JAX predictor runtime: the container process behind an InferenceService.
 
 TPU-first inference path:
-- prefill jitted per (batch, padded-seq) bucket: flash attention over the
-  whole prompt, KV cache written in one pass;
-- decode step jitted once with a static-shape cache (lax dynamic-update
-  slicing), greedy or temperature sampling;
+- continuous batching (serving/engine.py): ragged prompts, per-request
+  prefill into shared cache slots, chunked scan decode, admission into
+  in-flight batches — concurrent HTTP callers share decode iterations;
 - bfloat16 weights on the MXU; orbax checkpoint restore when a model dir is
-  given, otherwise seeded random weights (CI/dev).
+  given, otherwise seeded random weights (CI/dev);
+- serving metrics (tokens/s, queue depth, TTFT) in the shared registry,
+  exposed on /metrics.
 
 Serves V1-style routes:
     GET  /v1/models                       list
@@ -25,18 +26,6 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.utils.logging import get_logger
-
-
-def _sample(logits: jax.Array, temperature: jax.Array,
-            rng: jax.Array) -> jax.Array:
-    """Shared trace-compatible sampling: identical numerics for the first
-    token (host call) and the scan body (f32, clamped temperature)."""
-    logits = logits.astype(jnp.float32)
-    return jax.lax.cond(
-        temperature > 0.0,
-        lambda: jax.random.categorical(
-            rng, logits / jnp.maximum(temperature, 1e-6), axis=-1),
-        lambda: jnp.argmax(logits, axis=-1))
 
 
 class GenerativePredictor:
@@ -62,8 +51,11 @@ class GenerativePredictor:
         self.params = unbox_params(params)
         if checkpoint_dir:
             self._restore(checkpoint_dir)
-        self._prefill_cache: dict[tuple, Any] = {}
-        self._decode_fn = None
+        from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+        self.engine = ContinuousBatcher(self.module, self.params, self.cfg,
+                                        max_batch=max_batch,
+                                        max_seq=self.max_seq)
         self.log.info("predictor ready",
                       params=sum(x.size for x in
                                  jax.tree_util.tree_leaves(self.params)))
@@ -78,98 +70,26 @@ class GenerativePredictor:
                                     abstract_like(self.params))
         self.log.info("restored checkpoint", directory=directory)
 
-    # -- compiled steps --------------------------------------------------------
-    def _prefill(self, batch: int, seq: int):
-        key = (batch, seq)
-        if key not in self._prefill_cache:
-            def fn(params, ids, cache):
-                out = self.module.apply({"params": params}, ids, cache=cache)
-                return out["logits"], out["cache"]
-
-            self._prefill_cache[key] = jax.jit(fn)
-        return self._prefill_cache[key]
-
-    def _decode(self):
-        """Scan-based multi-token decode: ONE dispatch generates the whole
-        continuation (per-token Python loops pay host->device latency per
-        token — ruinous over a network-attached TPU)."""
-        if self._decode_fn is None:
-            import functools
-
-            @functools.partial(jax.jit, static_argnames=("n_tokens",))
-            def fn(params, first_token, cache, rng, temperature, n_tokens):
-                def body(carry, _):
-                    token, cache, rng = carry
-                    out = self.module.apply({"params": params},
-                                            token[:, None], cache=cache)
-                    rng, sub = jax.random.split(rng)
-                    nxt = _sample(out["logits"][:, -1], temperature, sub)
-                    return (nxt, out["cache"], rng), nxt
-
-                (_, cache, _), tokens = jax.lax.scan(
-                    body, (first_token, cache, rng), None, length=n_tokens)
-                return tokens  # [n_tokens, B]
-
-            self._decode_fn = fn
-        return self._decode_fn
-
     # -- API -------------------------------------------------------------------
     def generate(self, ids: list[list[int]], max_new_tokens: int = 32,
-                 temperature: float = 0.0, seed: int = 0) -> dict:
-        from kubeflow_tpu.models import llama as llama_mod
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_id: int | None = None) -> dict:
+        """Generate continuations for a (possibly RAGGED) batch of prompts.
 
+        Routed through the continuous-batching engine: each prompt becomes a
+        request sharing decode iterations with any other in-flight traffic;
+        concurrent HTTP callers batch together automatically.
+        """
         t0 = time.perf_counter()
-        batch = len(ids)
-        if batch > self.max_batch:
-            raise ValueError(f"batch {batch} > max_batch {self.max_batch}")
-        lengths = {len(x) for x in ids}
-        if len(lengths) != 1:
-            # right-padding would write junk keys into the cache at valid
-            # positions; batched prompts must share a length (clients chunk
-            # or pad upstream with their tokenizer's semantics)
-            raise ValueError("all prompts in a batch must have equal length")
-        prompt_len = lengths.pop()
-        total = prompt_len + max_new_tokens
-        if total > self.max_seq:
-            raise ValueError(f"prompt+new ({total}) > max_seq "
-                             f"{self.max_seq}")
-        arr = jnp.asarray(ids, jnp.int32)
-
-        cache = llama_mod.init_cache(self.cfg, batch, max_len=self.max_seq)
-        logits, cache = self._prefill(batch, prompt_len)(self.params, arr,
-                                                         cache)
-        next_logits = logits[:, -1]
-
-        # split once up front: sampling with a key and then splitting the
-        # same key is JAX key reuse (ADVICE r1)
-        _, k_first, k_scan = jax.random.split(jax.random.PRNGKey(seed), 3)
-        temp = jnp.asarray(temperature, jnp.float32)
-        out_ids = [list(x) for x in ids]
-        token = _sample(next_logits, temp, k_first)
-        for i in range(batch):
-            out_ids[i].append(int(token[i]))
-        if max_new_tokens > 1:
-            sub = k_scan
-            n_rest = max_new_tokens - 1
-            # bucket the scan length so distinct max_new_tokens values share
-            # compiled executables; the extras are sliced off host-side.
-            # Padded steps run after every real token exists — their clamped
-            # cache writes and outputs are never read by a real step — so no
-            # cap is needed (and a prompt-dependent cap would defeat the
-            # executable sharing).
-            bucket = next((b for b in (8, 32, 128, 512, 2048)
-                           if b >= n_rest), n_rest)
-            tokens = self._decode()(
-                self.params, token, cache, sub, temp, n_tokens=bucket)
-            host_tokens = jax.device_get(tokens[:n_rest])  # [n_rest, B]
-            for step_tokens in host_tokens:
-                for i in range(batch):
-                    out_ids[i].append(int(step_tokens[i]))
+        out_ids = self.engine.generate_sync(
+            ids, max_new_tokens=max_new_tokens, temperature=temperature,
+            eos_id=eos_id, seed=seed)
         dt = time.perf_counter() - t0
+        generated = sum(len(o) - len(i) for o, i in zip(out_ids, ids))
         return {
             "ids": out_ids,
-            "tokens_generated": batch * max_new_tokens,
-            "tokens_per_sec": batch * max_new_tokens / dt,
+            "tokens_generated": generated,
+            "tokens_per_sec": generated / dt,
         }
 
 
@@ -219,14 +139,23 @@ class PredictorApp:
             status, body = "422 Unprocessable Entity", {"error": str(e)}
         except Exception as e:  # pragma: no cover
             status, body = "500 Internal Server Error", {"error": str(e)}
-        payload = json.dumps(body).encode()
-        start_response(status, [("Content-Type", "application/json"),
+        if isinstance(body, str):  # /metrics Prometheus text
+            payload = body.encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            payload = json.dumps(body).encode()
+            ctype = "application/json"
+        start_response(status, [("Content-Type", ctype),
                                 ("Content-Length", str(len(payload)))])
         return [payload]
 
     def _route(self, method, path, environ):
         if path == "/healthz":
             return "200 OK", {"status": "ok"}
+        if path == "/metrics":
+            from kubeflow_tpu.utils.metrics import REGISTRY
+
+            return "200 OK", REGISTRY.expose()
         if path == "/v1/models" and method == "GET":
             return "200 OK", {"models": sorted(self.predictors)}
         if path.startswith("/v1/models/"):
@@ -236,10 +165,12 @@ class PredictorApp:
                 pred = self.predictors[name]
                 body = self._body(environ)
                 if verb == "generate":
+                    eos = body.get("eos_id")
                     return "200 OK", pred.generate(
                         body["ids"],
                         max_new_tokens=int(body.get("max_new_tokens", 32)),
-                        temperature=float(body.get("temperature", 0.0)))
+                        temperature=float(body.get("temperature", 0.0)),
+                        eos_id=int(eos) if eos is not None else None)
                 if verb == "predict":
                     return "200 OK", pred.predict(body["instances"])
             else:

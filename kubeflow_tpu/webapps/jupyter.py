@@ -193,14 +193,12 @@ class JupyterApp(CrudApp):
     def _nb_events(self, nb: dict) -> list[dict]:
         """Events the controller mirrored onto this Notebook CR, newest
         first (the WARNING-status source, common/status.py:9-99)."""
+        from kubeflow_tpu.core.events import events_for
+
         md = nb["metadata"]
-        evs = [e["spec"] for e in self.server.list(
-            "Event", namespace=md.get("namespace"))
-            if e["spec"].get("involvedObject", {}).get("kind") == nb_api.KIND
-            and e["spec"]["involvedObject"].get("name") == md["name"]
-            and e["spec"]["involvedObject"].get("uid") == md.get("uid")]
-        return sorted(evs, key=lambda e: e.get("lastTimestamp", 0),
-                      reverse=True)
+        return [e["spec"] for e in events_for(
+            self.server, nb_api.KIND, md["name"], md.get("namespace"))
+            if e["spec"]["involvedObject"].get("uid") == md.get("uid")]
 
     def _view(self, nb: dict, detail: bool = False) -> dict[str, Any]:
         md = nb["metadata"]

@@ -52,14 +52,25 @@ def test_generate_matches_full_forward_argmax(llama_predictor):
         cur.append(nxt)
 
 
+def test_ragged_batch_matches_solo_runs(llama_predictor):
+    """Unequal prompt lengths in one batch (continuous batching) must
+    produce exactly what each prompt produces alone under greedy."""
+    p = llama_predictor
+    prompts = [[1, 2, 3, 4, 5, 6, 7], [9, 8]]
+    ragged = p.generate(prompts, max_new_tokens=5)
+    for prompt in prompts:
+        solo = p.generate([prompt], max_new_tokens=5)
+        assert solo["ids"][0] == ragged["ids"][prompts.index(prompt)]
+
+
 def test_generate_validations(llama_predictor):
     p = llama_predictor
-    with pytest.raises(ValueError, match="equal length"):
-        p.generate([[1, 2, 3], [1, 2]], max_new_tokens=2)
     with pytest.raises(ValueError, match="max_seq"):
         p.generate([[0] * 60], max_new_tokens=10)
-    with pytest.raises(ValueError, match="max_batch"):
-        p.generate([[1]] * 3, max_new_tokens=1)
+    # more prompts than slots is fine now: extras queue (continuous
+    # batching), they don't error
+    out = p.generate([[1], [2], [3]], max_new_tokens=1)
+    assert len(out["ids"]) == 3
 
 
 def test_predictor_http_api(llama_predictor):
