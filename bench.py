@@ -111,6 +111,56 @@ def _is_compile_oom(e: Exception) -> bool:
     return any(sig in str(e) for sig in _OOM_SIGNATURES)
 
 
+def measure_flash_longseq() -> dict:
+    """Long-sequence attention rows (VERDICT r1 #5a): the Pallas flash
+    kernel must beat XLA fused attention in the regime the dispatcher
+    routes to it (>= FLASH_MIN_SEQ)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.ops import flash_attention as fa
+    from kubeflow_tpu.ops.attention import _xla_attention
+
+    def med(fn, *args, iters=8):
+        fn(*args)
+        float(jnp.sum(fn(*args)[0].astype(jnp.float32)))
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            float(jnp.sum(out[0].astype(jnp.float32)))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    H, D = 16, 64
+    rows = {}
+    for S in (2048, 4096, 8192):
+        B = max(1, 8192 // S)
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(k1, (B, S, H, D), jnp.bfloat16)
+        k = jax.random.normal(k2, (B, S, H, D), jnp.bfloat16)
+        v = jax.random.normal(k3, (B, S, H, D), jnp.bfloat16)
+
+        def loss_x(q, k, v):
+            return (jnp.sum(_xla_attention(
+                q, k, v, causal=True, mask=None,
+                softmax_dtype=jnp.float32).astype(jnp.float32)),)
+
+        def loss_f(q, k, v):
+            return (jnp.sum(fa.flash_attention(
+                q, k, v, causal=True).astype(jnp.float32)),)
+
+        t_x = med(jax.jit(jax.grad(lambda *a: loss_x(*a)[0],
+                                   argnums=(0, 1, 2))), q, k, v)
+        t_f = med(jax.jit(jax.grad(lambda *a: loss_f(*a)[0],
+                                   argnums=(0, 1, 2))), q, k, v)
+        rows[f"attn_grad_seq{S}_flash_speedup"] = round(t_x / t_f, 2)
+        _log(f"attn grad S={S}: xla={t_x * 1e3:.1f}ms "
+             f"flash={t_f * 1e3:.1f}ms speedup={t_x / t_f:.2f}x")
+    return rows
+
+
 def measure_serving(max_new: int = 96, n_requests: int = 6) -> dict:
     """Continuous-batching decode throughput: ragged concurrent requests
     sharing one engine (tiny llama — this measures the serving runtime,
@@ -167,11 +217,15 @@ def main() -> None:
         _log(f"naive baseline hit compile OOM; reporting vs_baseline=1.0")
         naive = value
 
+    extra = {}
     try:
-        extra = measure_serving()
+        extra.update(measure_flash_longseq())
+    except Exception as e:
+        _log(f"flash long-seq bench failed ({type(e).__name__}: {e})")
+    try:
+        extra.update(measure_serving())
     except Exception as e:
         _log(f"serving bench failed ({type(e).__name__}: {e}); omitting")
-        extra = {}
     print(json.dumps({
         "metric": "bert_large_pretrain_samples_per_sec_per_chip",
         "value": round(value, 3),

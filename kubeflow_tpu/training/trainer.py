@@ -99,6 +99,14 @@ class Trainer:
                             "samples_per_sec": 0.0, "already_complete": True}
 
         def forward(params, batch):
+            if cfg.sp > 1:
+                # sequence-parallel training: self-attention routes through
+                # ring attention over the mesh's sp axis (exact attention,
+                # K/V rotate on ICI; ops/attention.py dispatch)
+                from kubeflow_tpu.ops.attention import ring_context
+
+                with ring_context(mesh):
+                    return entry.forward_loss(module, params, batch)
             return entry.forward_loss(module, params, batch)
 
         if cfg.data_path:

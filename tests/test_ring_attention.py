@@ -46,3 +46,67 @@ def test_ring_grads_match():
     g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
         assert jnp.max(jnp.abs(a - b)) < 1e-3
+
+
+def test_ring_wired_into_sp_train_path():
+    """VERDICT r1 #5c: sp>1 training actually exercises ring attention.
+    BERT forward-loss + gradients under ring_context on an sp=2 mesh must
+    match the plain (full-attention) path."""
+    from kubeflow_tpu.models import bert
+    from kubeflow_tpu.ops.attention import ring_context
+
+    mesh = make_mesh(8, dp=2, fsdp=1, tp=2, sp=2)
+    cfg = bert.bert_tiny(dtype="float32", remat=False)
+    model = bert.BertModel(cfg)
+    rng = jax.random.PRNGKey(0)
+    B, S = 4, 64
+    ids = jax.random.randint(rng, (B, S), 0, cfg.vocab_size)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                cfg.vocab_size)
+    weights = jnp.ones((B, S), jnp.float32)
+    from kubeflow_tpu.parallel.sharding import unbox_params
+
+    params = unbox_params(model.init(rng, ids)["params"])
+
+    def loss_fn(params):
+        out = model.apply({"params": params}, ids)
+        return bert.mlm_loss(out, labels, weights)
+
+    def loss_ring(params):
+        with ring_context(mesh):
+            return jax.jit(loss_fn)(params)  # trace happens inside ctx
+
+    with mesh:
+        l_ref, g_ref = jax.value_and_grad(loss_fn)(params)
+        l_ring, g_ring = jax.value_and_grad(
+            lambda p: loss_ring(p))(params)
+    assert jnp.allclose(l_ref, l_ring, atol=1e-4), (l_ref, l_ring)
+    flat_ref = jax.tree_util.tree_leaves(g_ref)
+    flat_ring = jax.tree_util.tree_leaves(g_ring)
+    for a, b in zip(flat_ref, flat_ring):
+        err = float(jnp.max(jnp.abs(a - b)))
+        scale = float(jnp.max(jnp.abs(a))) + 1e-6
+        assert err / scale < 1e-3, err / scale
+
+
+def test_trainer_sp_config_uses_ring(monkeypatch):
+    """Trainer with sp>1 routes attention through ring (observable via the
+    ring dispatch being exercised during the traced step)."""
+    import kubeflow_tpu.ops.ring_attention as ra
+    from kubeflow_tpu.training.trainer import Trainer, TrainerConfig
+
+    calls = []
+    orig = ra.make_ring_attention
+
+    def spy(*a, **kw):
+        calls.append(kw.get("axis_name", "sp"))
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(ra, "make_ring_attention", spy)
+    cfg = TrainerConfig(model="bert", steps=1, global_batch=4,
+                        log_every=1, dp=2, fsdp=1, tp=2, sp=2,
+                        model_config={"size": "tiny", "dtype": "float32",
+                                      "remat": False})
+    result = Trainer(cfg).run()
+    assert result["final_loss"] == result["final_loss"]  # not NaN
+    assert calls, "ring attention was never dispatched under sp=2"
