@@ -155,7 +155,11 @@ def measure_flash_longseq() -> dict:
                                    argnums=(0, 1, 2))), q, k, v)
         t_f = med(jax.jit(jax.grad(lambda *a: loss_f(*a)[0],
                                    argnums=(0, 1, 2))), q, k, v)
-        rows[f"attn_grad_seq{S}_flash_speedup"] = round(t_x / t_f, 2)
+        # sub-threshold rows validate the crossover (production routes them
+        # to XLA, fa.FLASH_MIN_SEQ); at/above threshold flash is the path
+        label = ("flash_speedup" if S >= fa.FLASH_MIN_SEQ
+                 else "crossover_check")
+        rows[f"attn_grad_seq{S}_{label}"] = round(t_x / t_f, 2)
         _log(f"attn grad S={S}: xla={t_x * 1e3:.1f}ms "
              f"flash={t_f * 1e3:.1f}ms speedup={t_x / t_f:.2f}x")
     return rows

@@ -194,30 +194,9 @@ class DashboardApp(CrudApp):
 
     # -- shell ----------------------------------------------------------------
     def shell(self, req: Request):
-        html = """<!doctype html>
-<html><head><title>Kubeflow TPU</title>
-<style>
- body { font-family: sans-serif; margin: 0; display: flex; height: 100vh; }
- nav { width: 220px; background: #1e2a3a; color: #fff; padding: 16px; }
- nav a { color: #9db2cb; display: block; padding: 8px 0;
-         text-decoration: none; }
- main { flex: 1; } iframe { width: 100%; height: 100%; border: 0; }
-</style></head>
-<body>
-<nav><h2>Kubeflow TPU</h2><div id="links"></div></nav>
-<main><iframe id="content" src="about:blank"></iframe></main>
-<script>
-fetch('/dashboard/api/dashboard-links').then(r => r.json()).then(cfg => {
-  const nav = document.getElementById('links');
-  for (const item of cfg.menuLinks) {
-    const a = document.createElement('a');
-    a.textContent = item.text; a.href = '#';
-    a.onclick = () => {
-      document.getElementById('content').src = item.link; return false;
-    };
-    nav.appendChild(a);
-  }
-});
-</script>
-</body></html>"""
-        return "200 OK", html.encode()
+        """The SPA shell (frontend/static/dashboard.js): sidebar, namespace
+        selector, iframe composition, home cards, registration,
+        manage-contributors — main-page.js equivalent."""
+        from kubeflow_tpu.frontend import page
+
+        return "200 OK", page("Kubeflow TPU", "dashboard.js")

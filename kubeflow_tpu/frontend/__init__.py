@@ -1,0 +1,90 @@
+"""Frontend layer (reference: ~19k LoC of Angular/Polymer — SURVEY.md L5).
+
+Framework-free JS applications served by the existing WSGI backends and
+driving their JSON APIs:
+
+- ``lib.js``      shared mini-library: DOM builder, fetch wrapper with the
+                  CSRF double-submit header, polling tables, status icons,
+                  dialogs (the kubeflow-common-lib equivalent);
+- ``dashboard.js``  shell: sidebar from dashboard-links, namespace selector,
+                  iframe composition, metric cards, activity feed,
+                  registration flow, manage-contributors
+                  (centraldashboard public/components/main-page.js);
+- ``jupyter.js``  notebook table + spawner form generated from the server's
+                  spawner config with per-field readOnly enforcement and
+                  image/TPU-slice pickers (jupyter frontend/src/app);
+- ``volumes.js``  PVC table + create dialog;
+- ``tensorboards.js``  tensorboard table + create dialog;
+- ``jobs.js``     JAXJob table over the raw /apis REST (TPU-native extra).
+
+Assets live in ``static/`` and are served by ``StaticApp`` (mounted at
+``/static`` by the platform front door).  ``page()`` renders the HTML shell
+each backend serves at its prefix root.
+"""
+
+from __future__ import annotations
+
+import os
+
+STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+
+_CTYPES = {
+    ".js": "application/javascript; charset=utf-8",
+    ".css": "text/css; charset=utf-8",
+    ".html": "text/html; charset=utf-8",
+    ".svg": "image/svg+xml",
+}
+
+
+class StaticApp:
+    """WSGI handler for /static/<asset> (shared by every app)."""
+
+    def __init__(self, directory: str = STATIC_DIR):
+        self.directory = directory
+
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        name = path.split("/static/", 1)[-1] if "/static/" in path else ""
+        # no traversal: a single flat asset directory
+        name = os.path.basename(name)
+        full = os.path.join(self.directory, name)
+        if not name or not os.path.isfile(full):
+            payload = b'{"error": "no such asset"}'
+            start_response("404 Not Found",
+                           [("Content-Type", "application/json"),
+                            ("Content-Length", str(len(payload)))])
+            return [payload]
+        with open(full, "rb") as f:
+            payload = f.read()
+        ctype = _CTYPES.get(os.path.splitext(name)[1],
+                            "application/octet-stream")
+        start_response("200 OK", [("Content-Type", ctype),
+                                  ("Content-Length", str(len(payload))),
+                                  ("Cache-Control", "no-cache")])
+        return [payload]
+
+
+def page(title: str, app_js: str, root_id: str = "app",
+         data: dict | None = None) -> bytes:
+    """The HTML shell each backend serves at its root: shared CSS + lib +
+    the app's script, all under /static.  ``data`` becomes data-* attrs on
+    the root node (how generic apps learn their kind/columns)."""
+    extra = "".join(f' data-{k}="{v}"' for k, v in (data or {}).items())
+    return (f"""<!doctype html>
+<html><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title} — Kubeflow TPU</title>
+<link rel="stylesheet" href="/static/app.css">
+</head><body>
+<div id="{root_id}" data-app="{app_js}"{extra}></div>
+<script src="/static/lib.js"></script>
+<script src="/static/{app_js}"></script>
+</body></html>""").encode()
+
+
+def attach_index(app, title: str, app_js: str,
+                 data: dict | None = None) -> None:
+    """Register GET / (and /index.html) on a CrudApp serving the shell."""
+    handler = lambda req: ("200 OK", page(title, app_js, data=data))  # noqa
+    app.add_route("GET", "/", handler, no_auth=True)
+    app.add_route("GET", "/index.html", handler, no_auth=True)

@@ -1,0 +1,273 @@
+/* Central dashboard shell (reference: centraldashboard
+ * public/components/main-page.js + dashboard-view + registration-page +
+ * manage-users-view).
+ *
+ * Composition: sidebar from /dashboard/api/dashboard-links, namespace
+ * selector from /dashboard/api/namespaces, sub-apps in an iframe with
+ * ?ns=<namespace> propagated.  Built-in views: Home (metric cards +
+ * activity feed + quick links), Manage Contributors, Registration (shown
+ * when the user has no workgroup yet).
+ */
+(function () {
+  "use strict";
+  const { el, api, snack, errorBox, age } = KF;
+  const root = document.getElementById("app");
+
+  const state = {
+    ns: localStorage.getItem("kf.ns") || "",
+    namespaces: [],
+    links: { menuLinks: [], quickLinks: [] },
+    env: null,
+    view: "home",      // home | iframe | contributors
+    iframeSrc: "",
+  };
+
+  /* ---------------- data ---------------- */
+
+  async function load() {
+    state.links = await api.get("/dashboard/api/dashboard-links");
+    state.env = await api.get("/dashboard/api/workgroup/env-info");
+    state.namespaces = state.env.namespaces || [];
+    if (!state.namespaces.length) {
+      const exists = await api.get("/dashboard/api/workgroup/exists");
+      if (!exists.hasWorkgroup && exists.registrationFlowAllowed) {
+        renderRegistration(exists.user);
+        return;
+      }
+    }
+    if (!state.ns || !state.namespaces.some(
+        (n) => n.namespace === state.ns)) {
+      state.ns = state.namespaces.length
+        ? state.namespaces[0].namespace : "";
+    }
+    localStorage.setItem("kf.ns", state.ns);
+    render();
+  }
+
+  /* ---------------- registration (registration-page) ---------------- */
+
+  function renderRegistration(user) {
+    const name = el("input", { type: "text",
+      value: (user || "").split("@")[0] });
+    const err = el("div");
+    const create = el("button", { class: "primary", onclick: async () => {
+      create.disabled = true;
+      err.replaceChildren();
+      try {
+        await api.post("/dashboard/api/workgroup/create",
+          { namespace: name.value.trim() });
+        // poll until the profile controller materializes the namespace
+        for (let i = 0; i < 50; i++) {
+          const ex = await api.get("/dashboard/api/workgroup/exists");
+          if (ex.hasWorkgroup) break;
+          await new Promise((r) => setTimeout(r, 300));
+        }
+        state.ns = name.value.trim();
+        localStorage.setItem("kf.ns", state.ns);
+        await load();
+      } catch (e) {
+        err.replaceChildren(errorBox(e.message));
+        create.disabled = false;
+      }
+    } }, "Create workspace");
+    root.replaceChildren(el("div", { class: "kf-content",
+                                     id: "registration" },
+      el("div", { class: "kf-form" },
+        el("h1", null, "Welcome to Kubeflow TPU"),
+        el("p", null, `Signed in as ${user}. Create your personal ` +
+          "workspace namespace to get started."),
+        err,
+        el("div", { class: "field" },
+          el("label", null, "Namespace name"), name),
+        create)));
+  }
+
+  /* ---------------- shell ---------------- */
+
+  function navItems() {
+    const items = [
+      { text: "Home", view: "home" },
+      ...state.links.menuLinks.map((l) => ({ text: l.text, link: l.link })),
+      { text: "Manage Contributors", view: "contributors" },
+    ];
+    return items.map((item) => el("a", {
+      href: "#",
+      class: (item.view && state.view === item.view) ||
+             (item.link && state.view === "iframe" &&
+              state.iframeSrc.startsWith(item.link)) ? "active" : null,
+      onclick: (ev) => {
+        ev.preventDefault();
+        if (item.view) {
+          state.view = item.view;
+          state.iframeSrc = "";
+        } else {
+          state.view = "iframe";
+          state.iframeSrc = item.link;
+        }
+        render();
+      } }, item.text));
+  }
+
+  function nsSelector() {
+    const sel = el("select", { id: "ns-select", onchange: () => {
+      state.ns = sel.value;
+      localStorage.setItem("kf.ns", state.ns);
+      render();
+    } }, state.namespaces.map((n) => el("option", {
+      value: n.namespace,
+      selected: n.namespace === state.ns ? "" : null },
+      `${n.namespace} (${n.role})`)));
+    return sel;
+  }
+
+  function render() {
+    const viewNode = state.view === "home" ? homeView()
+      : state.view === "contributors" ? contributorsView()
+      : el("iframe", { src: state.iframeSrc +
+          (state.iframeSrc.includes("?") ? "&" : "?") + "ns=" + state.ns });
+    root.replaceChildren(el("div", { class: "shell" },
+      el("nav", null,
+        el("div", { class: "brand" }, "Kubeflow TPU"),
+        navItems()),
+      el("main", null,
+        el("div", { class: "topbar" },
+          el("span", null, "Namespace:"), nsSelector(),
+          el("span", { class: "spacer", style: "flex:1" }),
+          el("span", { class: "muted" },
+            state.env ? state.env.user : "")),
+        state.view === "iframe" ? viewNode
+          : el("div", { class: "view" }, viewNode))));
+  }
+
+  /* ---------------- home view (dashboard-view cards) ---------------- */
+
+  function sparkline(points) {
+    const max = Math.max(1e-9, ...points.map((p) => p.value));
+    return el("div", { class: "spark" }, points.slice(-30).map((p) =>
+      el("i", { title: `${p.value.toFixed(2)}`,
+        style: `height:${Math.max(4, 100 * p.value / max)}%` })));
+  }
+
+  function homeView() {
+    const nsRole = state.namespaces.find((n) => n.namespace === state.ns);
+    const cards = el("div", { class: "cards" });
+
+    // quick links card
+    cards.append(el("div", { class: "card", id: "quick-links" },
+      el("h2", null, "Quick shortcuts"),
+      el("ul", null, state.links.quickLinks.map((q) =>
+        el("li", null, el("a", { href: "#", class: "connect",
+          onclick: (ev) => { ev.preventDefault();
+            state.view = "iframe"; state.iframeSrc = q.link; render(); } },
+          q.text), el("div", { class: "hint" }, q.desc || ""))))));
+
+    // notebooks card
+    const nbCard = el("div", { class: "card", id: "notebooks-card" },
+      el("h2", null, "Notebooks"), el("div", { class: "muted" }, "…"));
+    cards.append(nbCard);
+    api.get(`/jupyter/api/namespaces/${state.ns}/notebooks`)
+      .then((out) => {
+        const running = out.notebooks.filter(
+          (n) => n.status.phase === "ready").length;
+        nbCard.replaceChildren(el("h2", null, "Notebooks"),
+          el("div", { class: "big" },
+            `${running} / ${out.notebooks.length}`),
+          el("div", { class: "muted" }, "running / total"));
+      }).catch(() => nbCard.append(errorBox("unavailable")));
+
+    // metrics cards
+    for (const [mtype, title] of [["tpuduty", "TPU duty cycle"],
+                                  ["podcpu", "Pod CPU"]]) {
+      const card = el("div", { class: "card", dataset: { metric: mtype } },
+        el("h2", null, title), el("div", { class: "muted" }, "…"));
+      cards.append(card);
+      api.get(`/dashboard/api/metrics/${mtype}?interval=Last15m`)
+        .then((series) => {
+          card.replaceChildren(el("h2", null, title),
+            series.length ? sparkline(series)
+              : el("div", { class: "muted" }, "no samples"));
+        }).catch(() => card.append(errorBox("unavailable")));
+    }
+
+    // activity feed
+    const feed = el("div", { class: "card activity", id: "activity-feed" },
+      el("h2", null, `Recent activity in ${state.ns}`),
+      el("div", { class: "muted" }, "…"));
+    cards.append(feed);
+    api.get(`/dashboard/api/activities/${state.ns}`).then((events) => {
+      feed.replaceChildren(
+        el("h2", null, `Recent activity in ${state.ns}`),
+        events.length ? el("ul", null, events.slice(0, 12).map((e) =>
+          el("li", null,
+            `${e.spec.reason || ""}: ${e.spec.message || ""} `,
+            el("span", { class: "when" },
+              age(e.spec.lastTimestamp) + " ago"))))
+          : el("div", { class: "muted" }, "No recent events."));
+    }).catch(() => feed.append(errorBox("unavailable")));
+
+    return el("div", { class: "kf-content" },
+      el("h1", null, `Welcome${nsRole ? `, ${state.env.user}` : ""}`),
+      el("p", { class: "muted" },
+        nsRole ? `You are ${nsRole.role} of namespace ${state.ns}.` : ""),
+      cards);
+  }
+
+  /* -------------- contributors (manage-users-view) -------------- */
+
+  function contributorsView() {
+    const owned = state.namespaces.filter((n) => n.role === "owner");
+    const container = el("div", { class: "kf-content",
+                                  id: "contributors" },
+      el("h1", null, "Manage contributors"));
+    if (!owned.length) {
+      container.append(el("p", { class: "muted" },
+        "You own no namespaces."));
+      return container;
+    }
+    for (const { namespace } of owned) {
+      const chips = el("div", { class: "chips" },
+        el("span", { class: "muted" }, "…"));
+      const input = el("input", { type: "text",
+        placeholder: "teammate@example.com" });
+      const err = el("div");
+
+      function draw(list) {
+        chips.replaceChildren(list.length
+          ? list.map((email) => el("span", { class: "chip" }, email,
+              el("button", { title: "remove", onclick: async () => {
+                try {
+                  const updated = await api.post(
+                    "/dashboard/api/workgroup/remove-contributor",
+                    { namespace, contributor: email });
+                  draw(updated);
+                } catch (e) { snack(e.message); }
+              } }, "✕")))
+          : el("span", { class: "muted" }, "no contributors"));
+      }
+      api.get(`/kfam/v1/bindings?namespace=${namespace}`)
+        .then((out) => draw((out.bindings || [])
+          .map((b) => b.user.name)))
+        .catch((e) => chips.replaceChildren(errorBox(e.message)));
+
+      const add = el("button", { class: "primary", onclick: async () => {
+        err.replaceChildren();
+        try {
+          const updated = await api.post(
+            "/dashboard/api/workgroup/add-contributor",
+            { namespace, contributor: input.value.trim() });
+          input.value = "";
+          draw(updated);
+        } catch (e) { err.replaceChildren(errorBox(e.message)); }
+      } }, "Add");
+
+      container.append(el("div", { class: "card",
+                                   dataset: { ns: namespace } },
+        el("h2", null, namespace), err, chips,
+        el("div", { class: "row", style: "display:flex;gap:8px;" },
+          input, add)));
+    }
+    return container;
+  }
+
+  load().catch((e) => root.replaceChildren(errorBox(e.message)));
+})();

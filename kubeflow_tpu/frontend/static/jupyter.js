@@ -1,0 +1,168 @@
+/* Jupyter web app (reference: crud-web-apps/jupyter/frontend/src/app).
+ * Notebook table with status/connect/start/stop/delete + a spawner form
+ * generated from the server's spawner config, honoring per-field readOnly
+ * (admin-pinned values render disabled and are never sent). */
+(function () {
+  "use strict";
+  const { el, api, statusIcon, table, snack, confirmDialog, ns, age,
+          errorBox } = KF;
+
+  const root = document.getElementById("app");
+  const namespace = ns();
+  const base = `/jupyter/api/namespaces/${namespace}`;
+
+  if (!namespace) {
+    root.append(errorBox(
+      "No namespace selected. Open this app from the dashboard."));
+    return;
+  }
+
+  /* ---------------- notebook table ---------------- */
+
+  function connectCell(nb) {
+    if (nb.status.phase !== "ready") return el("span", { class: "muted" },
+      "—");
+    return el("a", { class: "connect", href: nb.url, target: "_blank" },
+      "Connect");
+  }
+
+  function actionsCell(nb, tbl) {
+    const stopped = nb.status.phase === "stopped";
+    const toggle = el("button", { class: "icon",
+      title: stopped ? "Start" : "Stop",
+      onclick: async () => {
+        try {
+          await api.patch(`${base}/notebooks/${nb.name}`,
+            { stopped: !stopped });
+          tbl.refresh();
+        } catch (e) { snack(e.message); }
+      } }, stopped ? "▶" : "⏸");
+    const del = el("button", { class: "icon danger", title: "Delete",
+      onclick: () => confirmDialog(
+        `Delete notebook "${nb.name}"? Its workspace volume survives.`,
+        async () => { await api.del(`${base}/notebooks/${nb.name}`);
+                      tbl.refresh(); }) }, "🗑");
+    return el("span", null, toggle, " ", del);
+  }
+
+  function tpuCell(nb) {
+    const entries = Object.entries(nb.tpus || {});
+    if (!entries.length) return el("span", { class: "muted" }, "none");
+    return entries.map(([k, v]) =>
+      `${v} × ${k.replace("cloud-tpu.google.com/", "")}`).join(", ");
+  }
+
+  const tbl = table({
+    columns: [
+      { title: "Status", render: (nb) => statusIcon(nb.status) },
+      { title: "Name", render: (nb) => nb.name },
+      { title: "Image", render: (nb) => nb.shortImage || "" },
+      { title: "CPU", render: (nb) => nb.cpu || "" },
+      { title: "Memory", render: (nb) => nb.memory || "" },
+      { title: "TPUs", render: tpuCell },
+      { title: "Age", render: (nb) => age(nb.createdAt) },
+      { title: "Connect", render: connectCell },
+      { title: "", render: (nb) => actionsCell(nb, tbl) },
+    ],
+    fetch: async () => (await api.get(`${base}/notebooks`)).notebooks,
+    empty: "No notebooks in this namespace. Create one!",
+  });
+
+  /* ---------------- spawner form ---------------- */
+
+  function field(label, input, opts) {
+    const lab = el("label", null, label);
+    if (opts && opts.readOnly) {
+      input.disabled = true;
+      lab.append(el("span", { class: "readonly-tag" }, "admin-pinned"));
+    }
+    const f = el("div", { class: "field" }, lab, input);
+    if (opts && opts.hint) f.append(el("div", { class: "hint" }, opts.hint));
+    return f;
+  }
+
+  function select(options, value) {
+    const s = el("select", null, options.map((o) =>
+      el("option", { value: o, selected: o === value ? "" : null }, o)));
+    s.value = value;
+    return s;
+  }
+
+  async function openSpawner() {
+    const cfg = (await api.get("/jupyter/api/config")).config;
+    const pds = (await api.get(`${base}/poddefaults`)).poddefaults;
+
+    const name = el("input", { type: "text",
+      placeholder: "my-notebook" });
+    const image = select(cfg.image.options, cfg.image.value);
+    const cpu = el("input", { type: "text", value: cfg.cpu.value });
+    const memory = el("input", { type: "text", value: cfg.memory.value });
+    const tpuSlice = select(cfg.tpu.options, cfg.tpu.value.slice || "none");
+    const workspace = el("input", { type: "checkbox", checked: "" });
+    const pdBoxes = pds.map((pd) => {
+      const box = el("input", { type: "checkbox" });
+      box.dataset.name = pd.name;
+      return el("label", { class: "chip" }, box, pd.desc || pd.name);
+    });
+
+    const err = el("div");
+    const form = el("div", { class: "kf-form" },
+      err,
+      field("Name", name),
+      field("Image", image, { readOnly: cfg.image.readOnly,
+        hint: "TPU-VM-ready images (jax preinstalled)" }),
+      el("div", { class: "row" },
+        field("CPU", cpu, { readOnly: cfg.cpu.readOnly }),
+        field("Memory", memory, { readOnly: cfg.memory.readOnly })),
+      field("TPU slice", tpuSlice, { readOnly: cfg.tpu.readOnly,
+        hint: "Single-host slice attached to this notebook " +
+              `(${cfg.tpu.resource})` }),
+      field("Workspace volume",
+        el("label", null, workspace, " create + mount a workspace PVC"),
+        { readOnly: cfg.workspaceVolume.readOnly }),
+      pds.length ? field("Configurations", el("div", null, pdBoxes),
+        { hint: "PodDefaults applied at admission" }) : null);
+
+    const create = el("button", { class: "primary", onclick: async () => {
+      create.disabled = true;
+      err.replaceChildren();
+      // readOnly fields are NOT submitted: the server re-pins them anyway
+      // (get_form_value semantics) — the UI just mirrors that contract
+      const body = { name: name.value.trim() };
+      if (!cfg.image.readOnly) body.image = image.value;
+      if (!cfg.cpu.readOnly) body.cpu = cpu.value;
+      if (!cfg.memory.readOnly) body.memory = memory.value;
+      if (!cfg.tpu.readOnly && tpuSlice.value !== "none") {
+        body.tpu = { slice: tpuSlice.value };
+      }
+      if (!workspace.checked) body.noWorkspace = true;
+      body.configurations = pdBoxes
+        .map((chip) => chip.querySelector("input"))
+        .filter((box) => box.checked)
+        .map((box) => box.dataset.name);
+      try {
+        await api.post(`${base}/notebooks`, body);
+        dlg.close();
+        tbl.refresh();
+        snack(`Notebook ${body.name} created`);
+      } catch (e) {
+        err.replaceChildren(errorBox(e.message));
+        create.disabled = false;
+      }
+    } }, "Create");
+
+    const dlg = KF.dialog("New notebook server", form, [
+      el("button", { onclick: () => dlg.close() }, "Cancel"), create]);
+  }
+
+  /* ---------------- page ---------------- */
+
+  root.append(
+    el("div", { class: "kf-toolbar" },
+      el("h1", null, "Notebooks"),
+      el("span", { class: "muted" }, `namespace: ${namespace}`),
+      el("span", { class: "spacer" }),
+      el("button", { class: "primary", id: "new-notebook",
+                     onclick: openSpawner }, "+ New Notebook")),
+    el("div", { class: "kf-content" }, tbl));
+})();

@@ -1,0 +1,170 @@
+/* Kubeflow TPU frontend shared library (kubeflow-common-lib equivalent).
+ *
+ * Exposes a single global `KF` with:
+ *   el(tag, attrs, ...children)  DOM builder
+ *   api.get/post/patch/del       fetch with CSRF double-submit header
+ *   statusIcon(status)           READY/WAITING/... indicator
+ *   poll(fn, ms)                 visibility-aware polling handle
+ *   table(spec)                  auto-refreshing resource table
+ *   dialog(title, body, actions) <dialog> helper
+ *   snack(msg)                   transient toast
+ *   ns()                         current namespace (?ns= query param)
+ *   age(ts)                      humanized age from epoch seconds
+ */
+(function () {
+  "use strict";
+
+  function el(tag, attrs) {
+    const node = document.createElement(tag);
+    if (attrs) {
+      for (const [k, v] of Object.entries(attrs)) {
+        if (k === "class") node.className = v;
+        else if (k === "dataset") Object.assign(node.dataset, v);
+        else if (k.startsWith("on") && typeof v === "function") {
+          node.addEventListener(k.slice(2), v);
+        } else if (v !== null && v !== undefined) node.setAttribute(k, v);
+      }
+    }
+    for (let i = 2; i < arguments.length; i++) {
+      const child = arguments[i];
+      if (child === null || child === undefined) continue;
+      if (Array.isArray(child)) {
+        for (const c of child) if (c) node.append(c);
+      } else node.append(child);
+    }
+    return node;
+  }
+
+  function csrfToken() {
+    const m = document.cookie.match(/(?:^|;\s*)XSRF-TOKEN=([^;]+)/);
+    return m ? m[1] : "";
+  }
+
+  async function call(method, url, body) {
+    const headers = { "Content-Type": "application/json" };
+    if (!["GET", "HEAD", "OPTIONS"].includes(method)) {
+      headers["X-XSRF-TOKEN"] = csrfToken();
+    }
+    const resp = await fetch(url, {
+      method,
+      headers,
+      credentials: "same-origin",
+      body: body === undefined ? undefined : JSON.stringify(body),
+    });
+    let data = null;
+    try { data = await resp.json(); } catch (e) { /* non-JSON */ }
+    if (!resp.ok) {
+      const msg = (data && (data.error || data.message)) ||
+        `${method} ${url}: HTTP ${resp.status}`;
+      throw new Error(msg);
+    }
+    return data;
+  }
+
+  const api = {
+    get: (url) => call("GET", url),
+    post: (url, body) => call("POST", url, body),
+    patch: (url, body) => call("PATCH", url, body),
+    del: (url) => call("DELETE", url),
+  };
+
+  function statusIcon(status) {
+    const phase = (status && status.phase) || "waiting";
+    const label = { ready: "Ready", waiting: "Waiting", warning: "Warning",
+      error: "Error", stopped: "Stopped", terminating: "Terminating",
+      uninitialized: "Waiting" }[phase] || phase;
+    return el("span", { class: "status " + phase,
+                        title: (status && status.message) || "" },
+      el("span", { class: "dot" }), label);
+  }
+
+  function poll(fn, ms) {
+    let timer = null;
+    let stopped = false;
+    async function tick() {
+      if (stopped) return;
+      try { await fn(); } catch (e) { console.warn("poll:", e.message); }
+      timer = setTimeout(tick, document.hidden ? ms * 4 : ms);
+    }
+    tick();
+    return { stop() { stopped = true; clearTimeout(timer); },
+             now() { clearTimeout(timer); tick(); } };
+  }
+
+  /* spec: {columns: [{title, render(row)}], fetch() -> rows,
+   *        empty: "message", interval} */
+  function table(spec) {
+    const tbody = el("tbody");
+    const node = el("table", { class: "kf-table" },
+      el("thead", null, el("tr", null,
+        spec.columns.map((c) => el("th", null, c.title)))),
+      tbody);
+    async function refresh() {
+      const rows = await spec.fetch();
+      tbody.replaceChildren();
+      if (!rows.length) {
+        tbody.append(el("tr", null,
+          el("td", { class: "empty", colspan: String(spec.columns.length) },
+            spec.empty || "Nothing here yet.")));
+        return;
+      }
+      for (const row of rows) {
+        tbody.append(el("tr", null,
+          spec.columns.map((c) => el("td", null, c.render(row)))));
+      }
+    }
+    const handle = poll(refresh, spec.interval || 3000);
+    node.refresh = () => handle.now();
+    node.stop = () => handle.stop();
+    return node;
+  }
+
+  function dialog(title, body, actions) {
+    const dlg = el("dialog", { class: "kf-dialog" },
+      el("div", { class: "head" }, title),
+      el("div", { class: "body" }, body),
+      el("div", { class: "foot" }, actions));
+    document.body.append(dlg);
+    dlg.addEventListener("close", () => dlg.remove());
+    dlg.showModal();
+    return dlg;
+  }
+
+  function confirmDialog(text, onYes) {
+    const yes = el("button", { class: "primary", onclick: async () => {
+      yes.disabled = true;
+      try { await onYes(); dlg.close(); }
+      catch (e) { snack(e.message); yes.disabled = false; }
+    } }, "Confirm");
+    const dlg = dialog("Please confirm", el("p", null, text), [
+      el("button", { onclick: () => dlg.close() }, "Cancel"), yes]);
+    return dlg;
+  }
+
+  function snack(msg) {
+    const node = el("div", { class: "kf-snack" }, msg);
+    document.body.append(node);
+    setTimeout(() => node.remove(), 4000);
+  }
+
+  function ns() {
+    const params = new URLSearchParams(location.search);
+    return params.get("ns") || localStorage.getItem("kf.ns") || "";
+  }
+
+  function age(ts) {
+    if (!ts) return "—";
+    const s = Math.max(0, Date.now() / 1000 - ts);
+    if (s < 90) return Math.round(s) + "s";
+    if (s < 5400) return Math.round(s / 60) + "m";
+    if (s < 129600) return Math.round(s / 3600) + "h";
+    return Math.round(s / 86400) + "d";
+  }
+
+  function errorBox(message) {
+    return el("div", { class: "kf-error" }, message);
+  }
+
+  window.KF = { el, api, statusIcon, poll, table, dialog, confirmDialog,
+                snack, ns, age, errorBox };
+})();

@@ -1,0 +1,104 @@
+/* Generic resource tables over the raw /apis REST facade — serves the
+ * JAXJobs / Experiments / Models menu entries (TPU-native additions with
+ * no reference counterpart; kind + columns configured by the page's
+ * data-kind attribute). */
+(function () {
+  "use strict";
+  const { el, api, table, confirmDialog, ns, age, errorBox } = KF;
+  const root = document.getElementById("app");
+  const namespace = ns();
+  const kind = root.dataset.kind;
+  const title = root.dataset.title || kind + "s";
+
+  if (!namespace) {
+    root.append(errorBox(
+      "No namespace selected. Open this app from the dashboard."));
+    return;
+  }
+
+  function phaseIcon(obj) {
+    const phase = (obj.status && obj.status.phase) || "Pending";
+    const map = { Succeeded: "ready", Running: "ready", Pending: "waiting",
+      Restarting: "warning", Failed: "error", Completed: "ready" };
+    return KF.statusIcon({ phase: map[phase] || "waiting",
+      message: blockingCondition(obj) || phase });
+  }
+
+  function blockingCondition(obj) {
+    for (const c of (obj.status && obj.status.conditions) || []) {
+      if (c.status === "True" &&
+          ["QuotaExceeded", "WaitingForSlices"].includes(c.type)) {
+        return `${c.type}: ${c.message}`;
+      }
+    }
+    return "";
+  }
+
+  const COLUMNS = {
+    JAXJob: [
+      { title: "Status", render: phaseIcon },
+      { title: "Name", render: (o) => o.metadata.name },
+      { title: "Phase", render: (o) =>
+          (o.status && o.status.phase) || "Pending" },
+      { title: "Topology", render: (o) => o.spec.numSlices > 1
+          ? `${o.spec.numSlices} × ${o.spec.topology}` : o.spec.topology },
+      { title: "Workers", render: (o) => o.status && o.status.workers
+          ? `${o.status.workers.ready}/${o.status.workers.total}` : "—" },
+      { title: "Restarts", render: (o) =>
+          String((o.status && o.status.restarts) || 0) },
+      { title: "Why waiting", render: (o) => blockingCondition(o) ||
+          el("span", { class: "muted" }, "—") },
+    ],
+    Experiment: [
+      { title: "Status", render: phaseIcon },
+      { title: "Name", render: (o) => o.metadata.name },
+      { title: "Phase", render: (o) =>
+          (o.status && o.status.phase) || "Pending" },
+      { title: "Trials", render: (o) => o.status
+          ? `${o.status.succeeded || 0}/${o.spec.maxTrials || "?"}` : "—" },
+      { title: "Best", render: (o) => (o.status && o.status.best
+          && o.status.best.value !== undefined)
+          ? String(o.status.best.value.toFixed
+              ? o.status.best.value.toFixed(4) : o.status.best.value)
+          : el("span", { class: "muted" }, "—") },
+    ],
+    InferenceService: [
+      { title: "Status", render: (o) => KF.statusIcon({
+          phase: o.status && o.status.ready ? "ready" : "waiting" }) },
+      { title: "Name", render: (o) => o.metadata.name },
+      { title: "Model", render: (o) =>
+          `${o.spec.model || ""} ${o.spec.size || ""}` },
+      { title: "Topology", render: (o) => o.spec.topology || "" },
+      { title: "URL", render: (o) => o.status && o.status.url
+          ? el("code", null, o.status.url)
+          : el("span", { class: "muted" }, "—") },
+    ],
+  };
+
+  const columns = [...(COLUMNS[kind] || [
+    { title: "Name", render: (o) => o.metadata.name },
+  ]),
+  { title: "Age", render: (o) => age(o.metadata.creationTimestamp) },
+  { title: "", render: (o) => el("button", {
+      class: "icon danger", title: "Delete",
+      onclick: () => confirmDialog(
+        `Delete ${kind} "${o.metadata.name}"?`,
+        async () => {
+          await api.del(`/apis/${kind}/${namespace}/${o.metadata.name}`);
+          tbl.refresh();
+        }) }, "🗑") }];
+
+  const tbl = table({
+    columns,
+    fetch: async () => (await api.get(
+      `/apis/${kind}?namespace=${namespace}`)).items,
+    empty: `No ${title.toLowerCase()} in this namespace.`,
+  });
+
+  root.append(
+    el("div", { class: "kf-toolbar" },
+      el("h1", null, title),
+      el("span", { class: "muted" }, `namespace: ${namespace}`),
+      el("span", { class: "spacer" })),
+    el("div", { class: "kf-content" }, tbl));
+})();

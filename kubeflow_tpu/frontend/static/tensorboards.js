@@ -1,0 +1,73 @@
+/* Tensorboards web app (reference: crud-web-apps/tensorboards/frontend). */
+(function () {
+  "use strict";
+  const { el, api, statusIcon, table, confirmDialog, ns, errorBox } = KF;
+  const root = document.getElementById("app");
+  const namespace = ns();
+  const base = `/tensorboards/api/namespaces/${namespace}`;
+
+  if (!namespace) {
+    root.append(errorBox(
+      "No namespace selected. Open this app from the dashboard."));
+    return;
+  }
+
+  const tbl = table({
+    columns: [
+      { title: "Status", render: (t) => statusIcon(t.status) },
+      { title: "Name", render: (t) => t.name },
+      { title: "Logspath", render: (t) => el("code", null, t.logspath) },
+      { title: "Connect", render: (t) => t.status.phase === "ready"
+          ? el("a", { class: "connect", href: t.url, target: "_blank" },
+              "Connect")
+          : el("span", { class: "muted" }, "—") },
+      { title: "", render: (t) => el("button", {
+          class: "icon danger", title: "Delete",
+          onclick: () => confirmDialog(
+            `Delete tensorboard "${t.name}"? (logs are not touched)`,
+            async () => { await api.del(`${base}/tensorboards/${t.name}`);
+                          tbl.refresh(); }) }, "🗑") },
+    ],
+    fetch: async () =>
+      (await api.get(`${base}/tensorboards`)).tensorboards,
+    empty: "No tensorboards in this namespace.",
+  });
+
+  function openCreate() {
+    const name = el("input", { type: "text", placeholder: "my-tboard" });
+    const logspath = el("input", { type: "text",
+      placeholder: "pvc://my-volume/logs or gs://bucket/logs" });
+    const err = el("div");
+    const create = el("button", { class: "primary", onclick: async () => {
+      create.disabled = true;
+      err.replaceChildren();
+      try {
+        await api.post(`${base}/tensorboards`,
+          { name: name.value.trim(), logspath: logspath.value.trim() });
+        dlg.close();
+        tbl.refresh();
+      } catch (e) {
+        err.replaceChildren(errorBox(e.message));
+        create.disabled = false;
+      }
+    } }, "Create");
+    const dlg = KF.dialog("New tensorboard",
+      el("div", { class: "kf-form" }, err,
+        el("div", { class: "field" }, el("label", null, "Name"), name),
+        el("div", { class: "field" }, el("label", null, "Logspath"),
+          logspath,
+          el("div", { class: "hint" },
+            "pvc://<volume>/<subpath> mounts a volume; gs:// reads from " +
+            "cloud storage"))),
+      [el("button", { onclick: () => dlg.close() }, "Cancel"), create]);
+  }
+
+  root.append(
+    el("div", { class: "kf-toolbar" },
+      el("h1", null, "Tensorboards"),
+      el("span", { class: "muted" }, `namespace: ${namespace}`),
+      el("span", { class: "spacer" }),
+      el("button", { class: "primary", id: "new-tensorboard",
+                     onclick: openCreate }, "+ New Tensorboard")),
+    el("div", { class: "kf-content" }, tbl));
+})();

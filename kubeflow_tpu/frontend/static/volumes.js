@@ -1,0 +1,76 @@
+/* Volumes web app (reference: crud-web-apps/volumes/frontend). */
+(function () {
+  "use strict";
+  const { el, api, statusIcon, table, snack, confirmDialog, ns,
+          errorBox } = KF;
+  const root = document.getElementById("app");
+  const namespace = ns();
+  const base = `/volumes/api/namespaces/${namespace}`;
+
+  if (!namespace) {
+    root.append(errorBox(
+      "No namespace selected. Open this app from the dashboard."));
+    return;
+  }
+
+  const tbl = table({
+    columns: [
+      { title: "Status", render: (p) => statusIcon(p.status) },
+      { title: "Name", render: (p) => p.name },
+      { title: "Size", render: (p) => p.size || "" },
+      { title: "Access modes", render: (p) => (p.modes || []).join(", ") },
+      { title: "Storage class", render: (p) =>
+          p.class || el("span", { class: "muted" }, "default") },
+      { title: "Used by", render: (p) => (p.usedBy || []).length
+          ? p.usedBy.join(", ") : el("span", { class: "muted" }, "—") },
+      { title: "", render: (p) => el("button", {
+          class: "icon danger", title: "Delete",
+          disabled: (p.usedBy || []).length ? "" : null,
+          onclick: () => confirmDialog(
+            `Delete volume "${p.name}" and its data?`,
+            async () => { await api.del(`${base}/pvcs/${p.name}`);
+                          tbl.refresh(); }) }, "🗑") },
+    ],
+    fetch: async () => (await api.get(`${base}/pvcs`)).pvcs,
+    empty: "No volumes in this namespace.",
+  });
+
+  function openCreate() {
+    const name = el("input", { type: "text", placeholder: "my-volume" });
+    const size = el("input", { type: "text", value: "10Gi" });
+    const mode = el("select", null,
+      ["ReadWriteOnce", "ReadOnlyMany", "ReadWriteMany"].map((m) =>
+        el("option", { value: m }, m)));
+    const err = el("div");
+    const create = el("button", { class: "primary", onclick: async () => {
+      create.disabled = true;
+      err.replaceChildren();
+      try {
+        await api.post(`${base}/pvcs`, { name: name.value.trim(),
+          size: size.value.trim(), mode: mode.value });
+        dlg.close();
+        tbl.refresh();
+      } catch (e) {
+        err.replaceChildren(errorBox(e.message));
+        create.disabled = false;
+      }
+    } }, "Create");
+    const dlg = KF.dialog("New volume",
+      el("div", { class: "kf-form" }, err,
+        el("div", { class: "field" }, el("label", null, "Name"), name),
+        el("div", { class: "row" },
+          el("div", { class: "field" }, el("label", null, "Size"), size),
+          el("div", { class: "field" }, el("label", null, "Access mode"),
+            mode))),
+      [el("button", { onclick: () => dlg.close() }, "Cancel"), create]);
+  }
+
+  root.append(
+    el("div", { class: "kf-toolbar" },
+      el("h1", null, "Volumes"),
+      el("span", { class: "muted" }, `namespace: ${namespace}`),
+      el("span", { class: "spacer" }),
+      el("button", { class: "primary", id: "new-volume",
+                     onclick: openCreate }, "+ New Volume")),
+    el("div", { class: "kf-content" }, tbl));
+})();

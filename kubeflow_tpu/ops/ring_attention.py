@@ -84,10 +84,16 @@ def make_ring_attention(mesh: Mesh, *, causal: bool = False,
                         axis_name: str = "sp",
                         batch_axes=("dp", "fsdp"), head_axis="tp"):
     """shard_map-wrapped ring attention over [B, S, H, D] global arrays with
-    seq sharded on ``axis_name``."""
+    seq sharded on ``axis_name``.  Batch/head axes absent from the mesh are
+    dropped (a custom mesh need only carry the sequence axis)."""
     from jax import shard_map
 
-    spec = P(batch_axes, axis_name, head_axis, None)
+    present = set(mesh.axis_names)
+    if axis_name not in present:
+        raise ValueError(f"mesh {mesh.axis_names} has no {axis_name!r} axis")
+    batch = tuple(a for a in batch_axes if a in present) or None
+    spec = P(batch, axis_name, head_axis if head_axis in present else None,
+             None)
 
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

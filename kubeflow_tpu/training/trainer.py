@@ -98,16 +98,17 @@ class Trainer:
                     return {"final_loss": None, "steps": cfg.steps,
                             "samples_per_sec": 0.0, "already_complete": True}
 
-        def forward(params, batch):
-            if cfg.sp > 1:
-                # sequence-parallel training: self-attention routes through
-                # ring attention over the mesh's sp axis (exact attention,
-                # K/V rotate on ICI; ops/attention.py dispatch)
-                from kubeflow_tpu.ops.attention import ring_context
+        import contextlib
 
-                with ring_context(mesh):
-                    return entry.forward_loss(module, params, batch)
-            return entry.forward_loss(module, params, batch)
+        from kubeflow_tpu.ops.attention import ring_context
+
+        def forward(params, batch):
+            # sp>1: self-attention routes through ring attention over the
+            # mesh's sp axis (exact attention, K/V rotate on ICI)
+            ctx = (ring_context(mesh) if cfg.sp > 1
+                   else contextlib.nullcontext())
+            with ctx:
+                return entry.forward_loss(module, params, batch)
 
         if cfg.data_path:
             dataset = NpzDataset(cfg.data_path, cfg.global_batch,

@@ -10,7 +10,13 @@ from __future__ import annotations
 
 
 def mount_all(server) -> dict:
+    from kubeflow_tpu.frontend import StaticApp
     from kubeflow_tpu.webapps.jupyter import JupyterApp
+    from kubeflow_tpu.webapps.resource_uis import (
+        make_experiments_ui,
+        make_jaxjobs_ui,
+        make_models_ui,
+    )
     from kubeflow_tpu.webapps.tensorboards import TensorboardsApp
     from kubeflow_tpu.webapps.volumes import VolumesApp
 
@@ -18,4 +24,8 @@ def mount_all(server) -> dict:
         "/jupyter": JupyterApp(server),
         "/volumes": VolumesApp(server),
         "/tensorboards": TensorboardsApp(server),
+        "/jaxjobs": make_jaxjobs_ui(server),
+        "/experiments": make_experiments_ui(server),
+        "/models": make_models_ui(server),
+        "/static": StaticApp(),
     }

@@ -31,6 +31,9 @@ class JupyterApp(CrudApp):
     def __init__(self, server, config: dict | None = None):
         super().__init__(server)
         self.config = config or spawner_config.get_config()
+        from kubeflow_tpu.frontend import attach_index
+
+        attach_index(self, "Notebooks", "jupyter.js")
         self.add_route("GET", "/api/config", self.get_config)
         self.add_route("GET", "/api/namespaces/<ns>/notebooks", self.list_)
         self.add_route("POST", "/api/namespaces/<ns>/notebooks", self.post)
@@ -216,6 +219,7 @@ class JupyterApp(CrudApp):
             "tpus": tpus,
             "status": notebook_status(nb, events=self._nb_events(nb)),
             "url": nb_api.url_prefix(nb),
+            "createdAt": md.get("creationTimestamp"),
         }
         if detail:
             out["notebook"] = nb

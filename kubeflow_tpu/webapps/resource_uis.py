@@ -1,0 +1,30 @@
+"""Thin UI mounts for resources served by the raw /apis REST facade:
+JAXJobs, Experiments (HPO), Models (InferenceServices).  Each serves only
+the HTML shell; the generic resources.js table drives /apis directly
+(authz enforced there per request)."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.frontend import attach_index
+from kubeflow_tpu.webapps.crud_backend import CrudApp
+
+
+def _ui_app(prefix: str, title: str, kind: str):
+    class ResourceUI(CrudApp):
+        pass
+
+    ResourceUI.prefix = prefix
+    ResourceUI.__name__ = f"{kind}UI"
+
+    def init(server):
+        app = ResourceUI(server)
+        attach_index(app, title, "resources.js",
+                     data={"kind": kind, "title": title})
+        return app
+
+    return init
+
+
+make_jaxjobs_ui = _ui_app("/jaxjobs", "JAXJobs", "JAXJob")
+make_experiments_ui = _ui_app("/experiments", "Experiments", "Experiment")
+make_models_ui = _ui_app("/models", "Models", "InferenceService")

@@ -11,6 +11,9 @@ class TensorboardsApp(CrudApp):
 
     def __init__(self, server):
         super().__init__(server)
+        from kubeflow_tpu.frontend import attach_index
+
+        attach_index(self, "Tensorboards", "tensorboards.js")
         self.add_route("GET", "/api/namespaces/<ns>/tensorboards", self.list_)
         self.add_route("POST", "/api/namespaces/<ns>/tensorboards", self.post)
         self.add_route("GET", "/api/namespaces/<ns>/tensorboards/<name>",

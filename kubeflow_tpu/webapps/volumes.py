@@ -14,6 +14,9 @@ class VolumesApp(CrudApp):
 
     def __init__(self, server):
         super().__init__(server)
+        from kubeflow_tpu.frontend import attach_index
+
+        attach_index(self, "Volumes", "volumes.js")
         self.add_route("GET", "/api/namespaces/<ns>/pvcs", self.list_)
         self.add_route("POST", "/api/namespaces/<ns>/pvcs", self.post)
         self.add_route("GET", "/api/namespaces/<ns>/pvcs/<name>", self.get)

@@ -88,7 +88,10 @@ def test_dashboard_links_and_shell(stack):
     assert "Notebooks" in texts and "JAXJobs (Training)" in texts
     with urllib.request.urlopen(base + "/ui/") as r:
         html = r.read().decode()
-    assert "Kubeflow TPU" in html and "iframe" in html
+    # the shell is now the SPA page; iframe composition lives in
+    # /static/dashboard.js (frontend layer)
+    assert "Kubeflow TPU" in html
+    assert "/static/dashboard.js" in html and "/static/lib.js" in html
 
 
 class Session:

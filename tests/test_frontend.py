@@ -1,0 +1,262 @@
+"""Frontend layer (VERDICT r1 #2): served pages + the full user journey
+the JS drives, asserted at HTTP level against the real platform stack.
+
+The journey mirrors exactly the fetch sequences in frontend/static/*.js:
+registration → spawner (readOnly honored) → table status → share with a
+contributor → contributor access → stop → delete.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.core.httpapi import serve
+from kubeflow_tpu.platform import build_platform, build_wsgi_app
+from tests.conftest import poll_until
+
+
+@pytest.fixture()
+def stack():
+    server, mgr = build_platform(executor="fake")
+    mgr.start()
+    httpd, _ = serve(build_wsgi_app(server), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield server, mgr, base
+    httpd.shutdown()
+    mgr.stop()
+
+
+class Browser:
+    """Carries identity + cookies + CSRF like frontend/static/lib.js."""
+
+    def __init__(self, base, user):
+        self.base = base
+        self.user = user
+        self.cookies = {}
+
+    def req(self, path, method="GET", body=None, raw=False):
+        headers = {"X-Goog-Authenticated-User-Email":
+                   "accounts.google.com:" + self.user}
+        if self.cookies:
+            headers["Cookie"] = "; ".join(
+                f"{k}={v}" for k, v in self.cookies.items())
+        if method not in ("GET", "HEAD", "OPTIONS"):
+            headers["X-XSRF-TOKEN"] = self.cookies.get("XSRF-TOKEN", "")
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(self.base + path, data=data,
+                                   method=method, headers=headers)
+        try:
+            resp = urllib.request.urlopen(r)
+        except urllib.error.HTTPError as e:
+            resp = e
+        for hdr in resp.headers.get_all("Set-Cookie") or []:
+            name, val = hdr.split(";")[0].split("=", 1)
+            self.cookies[name] = val
+        payload = resp.read()
+        if raw:
+            return resp.status, payload, resp.headers
+        return resp.status, (json.loads(payload) if payload else None)
+
+
+# ---------------------------------------------------------------- pages ----
+
+def test_pages_and_assets_served(stack):
+    _, _, base = stack
+    b = Browser(base, "alice@corp.com")
+    for path, app_js in [("/ui/", "dashboard.js"), ("/jupyter/",
+                                                    "jupyter.js"),
+                         ("/volumes/", "volumes.js"),
+                         ("/tensorboards/", "tensorboards.js"),
+                         ("/jaxjobs/", "resources.js"),
+                         ("/experiments/", "resources.js"),
+                         ("/models/", "resources.js")]:
+        st, html, headers = b.req(path, raw=True)
+        assert st == 200, path
+        assert "text/html" in headers["Content-Type"]
+        text = html.decode()
+        assert "/static/lib.js" in text and f"/static/{app_js}" in text, path
+    # resource UIs carry their kind for the generic table
+    _, html, _ = b.req("/jaxjobs/", raw=True)
+    assert 'data-kind="JAXJob"' in html.decode()
+
+    for asset, ctype in [("lib.js", "javascript"), ("app.css", "css"),
+                         ("dashboard.js", "javascript"),
+                         ("jupyter.js", "javascript")]:
+        st, payload, headers = b.req(f"/static/{asset}", raw=True)
+        assert st == 200 and ctype in headers["Content-Type"], asset
+        assert len(payload) > 500, asset
+    st, _, _ = b.req("/static/nope.js", raw=True)
+    assert st == 404
+    st, _, _ = b.req("/static/..%2F..%2Fpyproject.toml", raw=True)
+    assert st == 404
+
+
+def test_js_contracts(stack):
+    """The behaviors the backends rely on are present in the shipped JS."""
+    _, _, base = stack
+    b = Browser(base, "alice@corp.com")
+    _, lib, _ = b.req("/static/lib.js", raw=True)
+    lib = lib.decode()
+    assert "X-XSRF-TOKEN" in lib            # CSRF double-submit header
+    assert "XSRF-TOKEN" in lib              # reads the cookie
+    _, jup, _ = b.req("/static/jupyter.js", raw=True)
+    jup = jup.decode()
+    assert "readOnly" in jup and "admin-pinned" in jup
+    assert "/jupyter/api/config" in jup     # form generated from config
+    assert "poddefaults" in jup             # configurations checkboxes
+    _, dash, _ = b.req("/static/dashboard.js", raw=True)
+    dash = dash.decode()
+    assert "workgroup/create" in dash       # registration flow
+    assert "add-contributor" in dash and "remove-contributor" in dash
+    assert "?" in dash and "ns=" in dash    # namespace propagated to iframes
+
+
+# -------------------------------------------------------------- journey ----
+
+def test_full_user_journey(stack):
+    server, mgr, base = stack
+    alice = Browser(base, "alice@corp.com")
+    alice.req("/jupyter/healthz")  # prime CSRF cookie
+
+    # 1. registration: no workgroup yet -> create -> namespace materializes
+    st, exists = alice.req("/dashboard/api/workgroup/exists")
+    assert st == 200 and exists["hasWorkgroup"] is False
+    st, _ = alice.req("/dashboard/api/workgroup/create", "POST",
+                      {"namespace": "alice"})
+    assert st == 200
+    poll_until(lambda: (
+        alice.req("/dashboard/api/workgroup/exists")[1]["hasWorkgroup"]
+        or None))
+    poll_until(lambda: (
+        lambda r: r[1] if r[0] == 200 and any(
+            n["namespace"] == "alice" and n["role"] == "owner"
+            for n in r[1]) else None)(
+        alice.req("/dashboard/api/namespaces")))
+
+    # 2. spawner: form from config, readOnly honored server-side
+    st, cfg = alice.req("/jupyter/api/config")
+    body = {"name": "workbench",
+            "image": cfg["config"]["image"]["options"][1],
+            "cpu": "1", "memory": "2Gi",
+            "tpu": {"slice": "v5e-4"},
+            "configurations": []}
+    st, created = alice.req("/jupyter/api/namespaces/alice/notebooks",
+                            "POST", body)
+    assert st == 201, created
+    assert created["notebook"]["tpus"] == {"cloud-tpu.google.com/v5e": 4}
+
+    # 3. table shows it READY (fake executor runs the pod)
+    nb = poll_until(lambda: next(
+        (n for n in alice.req(
+            "/jupyter/api/namespaces/alice/notebooks")[1]["notebooks"]
+         if n["name"] == "workbench"
+         and n["status"]["phase"] == "ready"), None))
+    assert nb["shortImage"]
+    # the workspace PVC the spawner created shows in the volumes app
+    st, pvcs = alice.req("/volumes/api/namespaces/alice/pvcs")
+    assert any(p["name"] == "workbench-workspace" for p in pvcs["pvcs"])
+
+    # 4. share the namespace with bob (manage-contributors flow)
+    st, contributors = alice.req(
+        "/dashboard/api/workgroup/add-contributor", "POST",
+        {"namespace": "alice", "contributor": "bob@corp.com"})
+    assert st == 200 and contributors == ["bob@corp.com"]
+
+    bob = Browser(base, "bob@corp.com")
+    bob.req("/jupyter/healthz")
+    st, listing = bob.req("/jupyter/api/namespaces/alice/notebooks")
+    assert st == 200
+    assert [n["name"] for n in listing["notebooks"]] == ["workbench"]
+    # bob sees the namespace as contributor in HIS dashboard
+    st, namespaces = bob.req("/dashboard/api/namespaces")
+    assert {"namespace": "alice", "role": "contributor"} in namespaces
+    # but bob may not manage contributors (owner-or-admin)
+    st, err = bob.req("/dashboard/api/workgroup/add-contributor", "POST",
+                      {"namespace": "alice",
+                       "contributor": "eve@corp.com"})
+    assert st == 403
+
+    # 5. stop -> STOPPED; start again -> READY; delete -> gone
+    st, _ = alice.req("/jupyter/api/namespaces/alice/notebooks/workbench",
+                      "PATCH", {"stopped": True})
+    assert st == 200
+    poll_until(lambda: (
+        lambda n: n if n["status"]["phase"] == "stopped" else None)(
+        alice.req("/jupyter/api/namespaces/alice/notebooks/workbench")[1]
+        ["notebook"]))
+    st, _ = alice.req("/jupyter/api/namespaces/alice/notebooks/workbench",
+                      "PATCH", {"stopped": False})
+    poll_until(lambda: (
+        lambda n: n if n["status"]["phase"] == "ready" else None)(
+        alice.req("/jupyter/api/namespaces/alice/notebooks/workbench")[1]
+        ["notebook"]))
+    st, _ = alice.req("/jupyter/api/namespaces/alice/notebooks/workbench",
+                      "DELETE")
+    assert st == 200
+    poll_until(lambda: (
+        alice.req("/jupyter/api/namespaces/alice/notebooks")[1]["notebooks"]
+        == [] or None))
+
+    # 6. remove bob; his access is revoked
+    st, contributors = alice.req(
+        "/dashboard/api/workgroup/remove-contributor", "POST",
+        {"namespace": "alice", "contributor": "bob@corp.com"})
+    assert st == 200 and contributors == []
+    st, _ = bob.req("/jupyter/api/namespaces/alice/notebooks")
+    assert st == 403
+
+
+def test_mutation_without_csrf_rejected(stack):
+    _, _, base = stack
+    b = Browser(base, "alice@corp.com")
+    # no priming GET: no CSRF cookie yet
+    st, err = b.req("/dashboard/api/workgroup/create", "POST",
+                    {"namespace": "x"})
+    assert st == 403 and "CSRF" in err["error"]
+
+
+def test_js_assets_balanced():
+    """No JS runtime exists in this image, so guard at least against
+    truncated/unbalanced assets (strings, template literals, comments and
+    regex literals are skipped by a small tokenizer)."""
+    import os
+
+    from kubeflow_tpu.frontend import STATIC_DIR
+
+    for name in sorted(os.listdir(STATIC_DIR)):
+        if not name.endswith(".js"):
+            continue
+        src = open(os.path.join(STATIC_DIR, name)).read()
+        stack = []
+        pairs = {")": "(", "]": "[", "}": "{"}
+        i, n = 0, len(src)
+        prev_sig = ""
+        while i < n:
+            c = src[i]
+            if c in "\"'`":
+                quote = c
+                i += 1
+                while i < n and src[i] != quote:
+                    i += 2 if src[i] == "\\" else 1
+            elif c == "/" and i + 1 < n and src[i + 1] == "/":
+                while i < n and src[i] != "\n":
+                    i += 1
+            elif c == "/" and i + 1 < n and src[i + 1] == "*":
+                i = src.find("*/", i) + 1
+                assert i > 0, f"{name}: unterminated block comment"
+            elif c == "/" and prev_sig in "=(,:[!&|?{;\n" + "":
+                i += 1  # regex literal
+                while i < n and src[i] != "/":
+                    i += 2 if src[i] == "\\" else 1
+            elif c in "([{":
+                stack.append(c)
+            elif c in ")]}":
+                assert stack and stack[-1] == pairs[c], (
+                    f"{name}: unbalanced {c!r} at offset {i}")
+                stack.pop()
+            if not c.isspace():
+                prev_sig = c
+            i += 1
+        assert not stack, f"{name}: unclosed {stack}"
