@@ -131,11 +131,15 @@ class BertModel(nn.Module):
                          name="word_embeddings")
         x = embed(input_ids)
         positions = jnp.arange(s)[None, :]
+        from kubeflow_tpu.parallel.sharding import replicate
+
         pos_emb = self.param(
             "position_embeddings",
             nn.with_partitioning(kl.default_embed_init, (None, "embed")),
             (cfg.max_position, cfg.hidden_size), jnp.float32)
-        x = x + jnp.asarray(pos_emb, dtype)[positions]
+        # lookups index a REPLICATED bf16 copy (see layers.Embed): gathers
+        # from embed-sharded tables leak table sharding into activations
+        x = x + replicate(jnp.asarray(pos_emb, dtype))[positions]
         if cfg.type_vocab_size:
             if token_type_ids is None:
                 token_type_ids = jnp.zeros_like(input_ids)
@@ -143,9 +147,15 @@ class BertModel(nn.Module):
                 "token_type_embeddings",
                 nn.with_partitioning(kl.default_embed_init, (None, "embed")),
                 (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
-            x = x + jnp.asarray(type_emb, dtype)[token_type_ids]
+            x = x + replicate(jnp.asarray(type_emb, dtype))[token_type_ids]
         x = kl.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
                          name="embeddings_ln")(x)
+        # pin the residual stream to the canonical activation layout:
+        # without this XLA pulls tp-sharded layouts backwards from the
+        # embedding table and fully rematerializes per layer (r1 warning)
+        from kubeflow_tpu.parallel.sharding import shard_activation
+
+        x = shard_activation(x)
 
         mask = None
         if attention_mask is not None:
@@ -156,7 +166,7 @@ class BertModel(nn.Module):
         if cfg.remat:
             layer_cls = nn.remat(BertLayer, static_argnums=())
         for i in range(cfg.num_layers):
-            x = layer_cls(cfg, name=f"layer_{i}")(x, mask)
+            x = shard_activation(layer_cls(cfg, name=f"layer_{i}")(x, mask))
 
         pooled = kl.DenseGeneral(cfg.hidden_size,
                                  axis_names=("embed", None), dtype=dtype,

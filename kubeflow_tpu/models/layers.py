@@ -76,14 +76,35 @@ class Embed(nn.Module):
             "embedding",
             _partitioned(self.embedding_init, ("vocab", "embed")),
             (self.num_embeddings, self.features), jnp.float32)
-        return jnp.asarray(embedding, self.dtype)[ids]
+        # the bf16 working copy of the table is REPLICATED before the
+        # lookup: gathering straight from the (vocab x embed)-sharded f32
+        # master otherwise exports table sharding into the residual stream,
+        # which SPMD can only resolve by full rematerialization per layer
+        # (r1 warning).  The f32 master keeps its fsdp/tp sharding; only
+        # the bf16 copy is all-gathered, once per step.
+        from kubeflow_tpu.parallel.sharding import (
+            replicate,
+            shard_activation,
+        )
+
+        table = replicate(jnp.asarray(embedding, self.dtype))
+        return shard_activation(table[ids])
 
     def attend(self, x: jax.Array) -> jax.Array:
-        """Project hidden states onto the vocabulary (tied LM head)."""
+        """Project hidden states onto the vocabulary (tied LM head):
+        vocab-parallel — logits come out vocab-sharded (tp), the embed
+        contraction dim is replicated so the residual stream's layout is
+        not disturbed."""
+        from jax.sharding import PartitionSpec as P
+
+        from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, constrain
+
         embedding = self.get_variable("params", "embedding")
         if isinstance(embedding, nn.Partitioned):
             embedding = embedding.unbox()
-        embedding = jnp.asarray(embedding, self.dtype)
+        embedding = constrain(
+            jnp.asarray(embedding, self.dtype),
+            P(DEFAULT_RULES.mesh_axes("vocab"), None))
         return jnp.einsum("...d,vd->...v", x, embedding,
                           preferred_element_type=jnp.float32)
 

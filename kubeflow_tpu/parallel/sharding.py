@@ -97,6 +97,36 @@ def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+def constrain(x: jax.Array, spec: P) -> jax.Array:
+    """with_sharding_constraint that no-ops outside a mesh trace context."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError, TypeError):
+        return x
+
+
+def replicate(x: jax.Array) -> jax.Array:
+    """Constrain to fully replicated (no-op outside a mesh context)."""
+    return constrain(x, P(*([None] * x.ndim)))
+
+
+def shard_activation(x: jax.Array,
+                     rules: ShardingRules = DEFAULT_RULES) -> jax.Array:
+    """Constrain a [batch, seq, hidden] activation to the canonical layout:
+    batch over (dp, fsdp), seq over sp, hidden replicated.
+
+    Without this, XLA's sharding propagation can pull a tp-sharded layout
+    backwards from the embedding table into the residual stream and then
+    'involuntarily fully rematerialize' the tensor at every layer boundary
+    (the MULTICHIP_r01 warning).  No-op outside a mesh trace context.
+    """
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, P(rules.mesh_axes("batch"), rules.mesh_axes("seq"), None))
+    except (ValueError, RuntimeError, TypeError):
+        return x  # no mesh context (single-device eval/tests)
+
+
 def unbox_params(params: Any) -> Any:
     """Strip flax Partitioned boxes, returning plain arrays."""
     import flax.linen as nn
