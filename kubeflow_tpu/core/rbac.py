@@ -28,16 +28,18 @@ BUILTIN_ROLES = {
     "kubeflow-edit": [
         {"verbs": ["get", "list", "create", "update", "delete"],
          "kinds": ["Notebook", "Tensorboard", "PersistentVolumeClaim",
-                   "JAXJob", "Experiment", "PodDefault", "Pod", "Event",
-                   "Secret", "ConfigMap", "InferenceService"]},
+                   "VolumeSnapshot", "JAXJob", "Experiment", "PodDefault",
+                   "Pod", "Event", "Secret", "ConfigMap",
+                   "InferenceService"]},
     ],
     # view enumerates kinds (NOT a wildcard): a view-only contributor must
     # not read Secrets
     "kubeflow-view": [
         {"verbs": ["get", "list"],
          "kinds": ["Notebook", "Tensorboard", "PersistentVolumeClaim",
-                   "JAXJob", "Experiment", "Trial", "PodDefault", "Pod",
-                   "Event", "ConfigMap", "InferenceService"]},
+                   "VolumeSnapshot", "JAXJob", "Experiment", "Trial",
+                   "PodDefault", "Pod", "Event", "ConfigMap",
+                   "InferenceService"]},
     ],
 }
 

@@ -23,17 +23,70 @@
           p.class || el("span", { class: "muted" }, "default") },
       { title: "Used by", render: (p) => (p.usedBy || []).length
           ? p.usedBy.join(", ") : el("span", { class: "muted" }, "—") },
-      { title: "", render: (p) => el("button", {
-          class: "icon danger", title: "Delete",
-          disabled: (p.usedBy || []).length ? "" : null,
-          onclick: () => confirmDialog(
-            `Delete volume "${p.name}" and its data?`,
-            async () => { await api.del(`${base}/pvcs/${p.name}`);
-                          tbl.refresh(); }) }, "🗑") },
+      { title: "", render: (p) => el("span", null,
+          el("button", { class: "icon", title: "Snapshot",
+            onclick: async () => {
+              try {
+                await api.post(`${base}/pvcs/${p.name}/snapshot`, {});
+                KF.snack(`Snapshot of ${p.name} created`);
+                snaps.refresh();
+              } catch (e) { KF.snack(e.message); }
+            } }, "📷"), " ",
+          el("button", { class: "icon danger", title: "Delete",
+            disabled: (p.usedBy || []).length ? "" : null,
+            onclick: () => confirmDialog(
+              `Delete volume "${p.name}" and its data?`,
+              async () => { await api.del(`${base}/pvcs/${p.name}`);
+                            tbl.refresh(); }) }, "🗑")) },
     ],
     fetch: async () => (await api.get(`${base}/pvcs`)).pvcs,
     empty: "No volumes in this namespace.",
   });
+
+  /* snapshots table (rok flavor: snapshot + restore) */
+  const snaps = KF.table({
+    columns: [
+      { title: "Snapshot", render: (s) => s.name },
+      { title: "Source volume", render: (s) => s.source },
+      { title: "Size", render: (s) => s.size || "" },
+      { title: "Ready", render: (s) => s.readyToUse ? "yes" : "no" },
+      { title: "", render: (s) => el("span", null,
+          el("button", { class: "icon", title: "Restore to new volume",
+            onclick: () => openRestore(s) }, "♻"), " ",
+          el("button", { class: "icon danger", title: "Delete snapshot",
+            onclick: () => confirmDialog(
+              `Delete snapshot "${s.name}"?`,
+              async () => { await api.del(`${base}/snapshots/${s.name}`);
+                            snaps.refresh(); }) }, "🗑")) },
+    ],
+    fetch: async () => (await api.get(`${base}/snapshots`)).snapshots,
+    empty: "No snapshots.",
+    interval: 5000,
+  });
+
+  function openRestore(snapshot) {
+    const name = el("input", { type: "text",
+      value: `${snapshot.source}-restored` });
+    const err = el("div");
+    const create = el("button", { class: "primary", onclick: async () => {
+      create.disabled = true;
+      err.replaceChildren();
+      try {
+        await api.post(`${base}/pvcs`, { name: name.value.trim(),
+          fromSnapshot: snapshot.name });
+        dlg.close();
+        tbl.refresh();
+      } catch (e) {
+        err.replaceChildren(errorBox(e.message));
+        create.disabled = false;
+      }
+    } }, "Restore");
+    const dlg = KF.dialog(`Restore from ${snapshot.name}`,
+      el("div", { class: "kf-form" }, err,
+        el("div", { class: "field" },
+          el("label", null, "New volume name"), name)),
+      [el("button", { onclick: () => dlg.close() }, "Cancel"), create]);
+  }
 
   function openCreate() {
     const name = el("input", { type: "text", placeholder: "my-volume" });
@@ -72,5 +125,6 @@
       el("span", { class: "spacer" }),
       el("button", { class: "primary", id: "new-volume",
                      onclick: openCreate }, "+ New Volume")),
-    el("div", { class: "kf-content" }, tbl));
+    el("div", { class: "kf-content" }, tbl,
+      el("h2", null, "Snapshots"), snaps));
 })();

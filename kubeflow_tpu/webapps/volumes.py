@@ -1,12 +1,17 @@
-"""Volumes backend (reference: crud-web-apps/volumes): PVC CRUD + usage."""
+"""Volumes backend (reference: crud-web-apps/volumes): PVC CRUD + usage,
+plus snapshot/restore (the reference's rok flavor,
+crud-web-apps/volumes/backend/apps/rok/, rebuilt on the in-tree
+VolumeSnapshot kind instead of Arrikto Rok URLs)."""
 
 from __future__ import annotations
 
 from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.core.store import Invalid, NotFound
 from kubeflow_tpu.utils.status import Phase, make_status
-from kubeflow_tpu.webapps.crud_backend import CrudApp, Request
+from kubeflow_tpu.webapps.crud_backend import CrudApp, HTTPError, Request
 
 KIND = "PersistentVolumeClaim"
+SNAP_KIND = "VolumeSnapshot"
 
 
 class VolumesApp(CrudApp):
@@ -22,6 +27,12 @@ class VolumesApp(CrudApp):
         self.add_route("GET", "/api/namespaces/<ns>/pvcs/<name>", self.get)
         self.add_route("DELETE", "/api/namespaces/<ns>/pvcs/<name>",
                        self.delete)
+        self.add_route("GET", "/api/namespaces/<ns>/snapshots",
+                       self.list_snapshots)
+        self.add_route("POST", "/api/namespaces/<ns>/pvcs/<name>/snapshot",
+                       self.snapshot)
+        self.add_route("DELETE", "/api/namespaces/<ns>/snapshots/<name>",
+                       self.delete_snapshot)
 
     def list_(self, req: Request):
         ns = req.params["ns"]
@@ -46,15 +57,72 @@ class VolumesApp(CrudApp):
         name = body.get("name") or body.get("metadata", {}).get("name")
         if not name:
             raise ValueError("pvc name required")
-        spec = body.get("spec") or {
-            "accessModes": [body.get("mode", "ReadWriteOnce")],
-            "resources": {"requests": {"storage":
-                                       body.get("size", "10Gi")}},
-            "storageClassName": body.get("class"),
-        }
+        from_snapshot = body.get("fromSnapshot")
+        if from_snapshot:
+            # restore: new PVC hydrated from a snapshot (rok's snapshot-URL
+            # restore, k8s dataSource semantics)
+            try:
+                snap = self.server.get(SNAP_KIND, from_snapshot, ns)
+            except NotFound:
+                raise HTTPError("404 Not Found",
+                                f"snapshot {from_snapshot!r} not found")
+            if not snap.get("status", {}).get("readyToUse"):
+                raise Invalid(f"snapshot {from_snapshot!r} is not ready")
+            spec = {
+                "accessModes": body.get("modes") or ["ReadWriteOnce"],
+                "resources": {"requests": {"storage":
+                                           snap["status"]["restoreSize"]}},
+                "storageClassName": body.get("class"),
+                "dataSource": {"kind": SNAP_KIND, "name": from_snapshot},
+            }
+        else:
+            spec = body.get("spec") or {
+                "accessModes": [body.get("mode", "ReadWriteOnce")],
+                "resources": {"requests": {"storage":
+                                           body.get("size", "10Gi")}},
+                "storageClassName": body.get("class"),
+            }
         created = self.server.create(api_object(KIND, name, ns, spec=spec))
         return "201 Created", {"pvc": self._view(created, []),
                                "success": True}
+
+    # -- snapshots (rok flavor) ------------------------------------------------
+    def list_snapshots(self, req: Request):
+        ns = req.params["ns"]
+        req.authorize("list", SNAP_KIND, ns)
+        return "200 OK", {"snapshots": [
+            {"name": s["metadata"]["name"],
+             "source": s["spec"].get("source"),
+             "size": s.get("status", {}).get("restoreSize"),
+             "readyToUse": s.get("status", {}).get("readyToUse", False),
+             "createdAt": s["metadata"].get("creationTimestamp")}
+            for s in self.server.list(SNAP_KIND, namespace=ns)]}
+
+    def snapshot(self, req: Request):
+        ns, pvc_name = req.params["ns"], req.params["name"]
+        req.authorize("create", SNAP_KIND, ns)
+        pvc = self.server.get(KIND, pvc_name, ns)
+        body = req.json()
+        snap_name = body.get("name") or f"{pvc_name}-snapshot"
+        snap = api_object(SNAP_KIND, snap_name, ns,
+                          spec={"source": pvc_name})
+        # the in-memory store IS the CSI driver: the snapshot is
+        # immediately consistent, so status is set at creation
+        snap["status"] = {
+            "readyToUse": True,
+            "restoreSize": (pvc["spec"].get("resources", {})
+                            .get("requests", {}).get("storage", "10Gi")),
+        }
+        created = self.server.create(snap)
+        return "201 Created", {"snapshot": {
+            "name": created["metadata"]["name"], "source": pvc_name,
+            "readyToUse": True}, "success": True}
+
+    def delete_snapshot(self, req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("delete", SNAP_KIND, ns)
+        self.server.delete(SNAP_KIND, name, ns)
+        return "200 OK", {"success": True}
 
     def delete(self, req: Request):
         ns, name = req.params["ns"], req.params["name"]

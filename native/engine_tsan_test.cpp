@@ -1,0 +1,76 @@
+// ThreadSanitizer harness for the native engine (SURVEY.md §5.2: the
+// reference configures no race detection at all; the engine here is called
+// concurrently from every controller worker thread plus the admission path,
+// so its C API must be stateless/thread-safe).  Build + run via
+// `make tsan-run`; any data race makes TSan exit non-zero.
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+char* kf_apply_poddefaults(const char* pod_json, const char* pds_json);
+char* kf_filter_poddefaults(const char* pod_json, const char* pds_json);
+char* kf_match_selector(const char* selector_json, const char* labels_json);
+char* kf_reconcile_merge(const char* live_json, const char* desired_json);
+void kf_free(char* p);
+const char* kf_version();
+}
+
+static const char* POD =
+    "{\"kind\":\"Pod\",\"metadata\":{\"name\":\"p\",\"labels\":"
+    "{\"app\":\"nb\",\"team\":\"ml\"}},\"spec\":{\"containers\":"
+    "[{\"name\":\"main\",\"env\":[{\"name\":\"A\",\"value\":\"1\"}]}]}}";
+static const char* PDS =
+    "[{\"kind\":\"PodDefault\",\"metadata\":{\"name\":\"tpu-env\","
+    "\"resourceVersion\":\"7\"},\"spec\":{\"selector\":{\"matchLabels\":"
+    "{\"app\":\"nb\"}},\"env\":[{\"name\":\"TPU\",\"value\":\"v5e\"}],"
+    "\"tolerations\":[{\"key\":\"tpu\",\"operator\":\"Exists\"}]}}]";
+static const char* LIVE =
+    "{\"kind\":\"Service\",\"metadata\":{\"name\":\"s\"},\"spec\":"
+    "{\"clusterIP\":\"10.0.0.1\",\"ports\":[{\"port\":80}]}}";
+static const char* DESIRED =
+    "{\"kind\":\"Service\",\"metadata\":{\"name\":\"s\"},\"spec\":"
+    "{\"ports\":[{\"port\":80,\"targetPort\":8888}],\"selector\":"
+    "{\"app\":\"nb\"}}}";
+
+static bool has_error(const char* out) {
+  return out == nullptr || std::strstr(out, "\"error\"") != nullptr;
+}
+
+int main() {
+  const int kThreads = 8;
+  const int kIters = 500;
+  std::vector<std::thread> threads;
+  std::vector<int> failures(kThreads, 0);
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([t, &failures] {
+      for (int i = 0; i < kIters; ++i) {
+        char* a = kf_apply_poddefaults(POD, PDS);
+        char* f = kf_filter_poddefaults(POD, PDS);
+        char* m = kf_match_selector("{\"matchLabels\":{\"app\":\"nb\"}}",
+                                    "{\"app\":\"nb\"}");
+        char* r = kf_reconcile_merge(LIVE, DESIRED);
+        if (has_error(a) || has_error(f) || has_error(m) || has_error(r)) {
+          failures[t]++;
+        }
+        kf_free(a);
+        kf_free(f);
+        kf_free(m);
+        kf_free(r);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  int total = 0;
+  for (int f : failures) total += f;
+  if (total) {
+    std::fprintf(stderr, "engine returned errors under concurrency: %d\n",
+                 total);
+    return 1;
+  }
+  std::printf("tsan harness OK: %d threads x %d iters on %s\n", kThreads,
+              kIters, kf_version());
+  return 0;
+}

@@ -169,3 +169,41 @@ def test_volumes_and_tensorboards_apps(stack):
     # volumes app reports the tensorboard pod as a user
     code, out = alice.req("/volumes/api/namespaces/team/pvcs")
     assert out["pvcs"][0]["usedBy"] == ["tb-0"]
+
+
+def test_volume_snapshot_and_restore(stack):
+    """rok-flavor parity (crud-web-apps/volumes/backend/apps/rok):
+    snapshot a PVC, restore it into a new PVC with dataSource."""
+    server, mgr, base = stack
+    c = Client(base, "alice@corp.com")
+    st, _ = c.req("/volumes/api/namespaces/team/pvcs", "POST",
+                  {"name": "data", "size": "20Gi"})
+    assert st == 201
+    st, snap = c.req("/volumes/api/namespaces/team/pvcs/data/snapshot",
+                     "POST", {})
+    assert st == 201 and snap["snapshot"]["readyToUse"] is True
+
+    st, listing = c.req("/volumes/api/namespaces/team/snapshots")
+    assert [s["name"] for s in listing["snapshots"]] == ["data-snapshot"]
+    assert listing["snapshots"][0]["size"] == "20Gi"
+
+    st, restored = c.req("/volumes/api/namespaces/team/pvcs", "POST",
+                         {"name": "data-copy",
+                          "fromSnapshot": "data-snapshot"})
+    assert st == 201
+    pvc = server.get("PersistentVolumeClaim", "data-copy", "team")
+    assert pvc["spec"]["dataSource"] == {"kind": "VolumeSnapshot",
+                                         "name": "data-snapshot"}
+    assert (pvc["spec"]["resources"]["requests"]["storage"] == "20Gi")
+
+    # restore from a missing snapshot is a clean 404
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        c.req("/volumes/api/namespaces/team/pvcs", "POST",
+              {"name": "x", "fromSnapshot": "nope"})
+    assert exc.value.code == 404
+
+    st, _ = c.req("/volumes/api/namespaces/team/snapshots/data-snapshot",
+                  "DELETE")
+    assert st == 200
+    st, listing = c.req("/volumes/api/namespaces/team/snapshots")
+    assert listing["snapshots"] == []
