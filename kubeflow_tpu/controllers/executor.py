@@ -109,38 +109,61 @@ class LocalExecutor(Controller):
         super().__init__(server)
         self.extra_env = extra_env or {}
         self.timeout = timeout
-        self._running: set[str] = set()
+        # (ns, name) -> (uid, Popen): deleting a pod must KILL its process
+        # (kubelet semantics) — a dead gang's worker would otherwise hold
+        # the rendezvous port hostage across the restart
+        self._procs: dict[tuple, tuple[str, subprocess.Popen]] = {}
         self._lock = threading.Lock()
 
     def reconcile(self, req: Request) -> Result | None:
+        key = (req.namespace, req.name)
         try:
             pod = self.server.get("Pod", req.name, req.namespace)
         except NotFound:
+            self._kill(key, None)
             return None
+        uid = pod["metadata"]["uid"]
+        self._kill(key, keep_uid=uid)  # reap a stale incarnation
         if pod["spec"].get("schedulingGates"):
             return None
         phase = pod.get("status", {}).get("phase", "Pending")
         if phase != "Pending":
             return None
-        uid = pod["metadata"]["uid"]
         with self._lock:
-            if uid in self._running:
-                return None
-            self._running.add(uid)
+            if key in self._procs and self._procs[key][0] == uid:
+                return None  # already launched for this incarnation
+            # claim the slot before spawning so a duplicate reconcile
+            # cannot double-launch; the thread swaps in the real Popen
+            self._procs[key] = (uid, None)
         self.server.patch_status("Pod", req.name, req.namespace,
                                  {"phase": "Running"})
         t = threading.Thread(target=self._run, args=(pod,), daemon=True)
         t.start()
         return None
 
+    def _kill(self, key: tuple, keep_uid: str | None = None) -> None:
+        """Terminate the tracked process for ``key`` unless it belongs to
+        the incarnation ``keep_uid``."""
+        with self._lock:
+            entry = self._procs.get(key)
+            if entry is None or entry[0] == keep_uid:
+                return
+            uid, proc = self._procs.pop(key)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
     def _run(self, pod: dict) -> None:
+        md = pod["metadata"]
+        key = (md.get("namespace"), md["name"])
+        uid = md["uid"]
         try:
-            self._run_inner(pod)
+            self._run_inner(pod, key, uid)
         finally:
             with self._lock:
-                self._running.discard(pod["metadata"]["uid"])
+                if self._procs.get(key, ("",))[0] == uid:
+                    self._procs.pop(key, None)
 
-    def _run_inner(self, pod: dict) -> None:
+    def _run_inner(self, pod: dict, key: tuple, uid: str) -> None:
         md = pod["metadata"]
         container = pod["spec"]["containers"][0]
         env = dict(os.environ)
@@ -149,18 +172,35 @@ class LocalExecutor(Controller):
         env.update(self.extra_env)
         result = None
         try:
-            proc = subprocess.run(
+            proc = subprocess.Popen(
                 container["command"] + container.get("args", []),
-                env=env, capture_output=True, text=True,
-                timeout=self.timeout)
-            for line in reversed(proc.stdout.strip().splitlines()):
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            with self._lock:
+                if self._procs.get(key, (None,))[0] != uid:
+                    # pod deleted between claim and spawn: never run
+                    killed_before_start = True
+                else:
+                    self._procs[key] = (uid, proc)
+                    killed_before_start = False
+            if killed_before_start:
+                proc.kill()
+                proc.communicate()
+                return
+            try:
+                stdout, stderr = proc.communicate(timeout=self.timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+                raise
+            for line in reversed(stdout.strip().splitlines()):
                 try:
                     result = json.loads(line)
                     break
                 except json.JSONDecodeError:
                     continue
             phase = "Succeeded" if proc.returncode == 0 else "Failed"
-            message = "" if proc.returncode == 0 else proc.stderr[-2000:]
+            message = "" if proc.returncode == 0 else stderr[-2000:]
         except subprocess.TimeoutExpired:
             phase, message = "Failed", "timeout"
         except Exception as e:  # command not found etc.
@@ -170,7 +210,7 @@ class LocalExecutor(Controller):
             status["message"] = message
         try:
             current = self.server.get("Pod", md["name"], md.get("namespace"))
-            if current["metadata"]["uid"] == md["uid"]:
+            if current["metadata"]["uid"] == uid:
                 self.server.patch_status("Pod", md["name"],
                                          md.get("namespace"), status)
         except (NotFound, Conflict):

@@ -110,3 +110,74 @@ def test_jaxjob_two_process_gang_trains_e2e():
         assert done["status"]["result"]["final_loss"] == losses[0]
     finally:
         mgr.stop()
+
+
+def test_gang_restart_reestablishes_rendezvous(tmp_path):
+    """SURVEY §7 hard-part #3: the rendezvous contract across pod restarts.
+    Worker 1's first incarnation dies mid-gang; the controller tears down
+    the WHOLE gang (a half-dead jax.distributed cannot be rejoined) and
+    recreates it; the second incarnation rendezvouses again with the NEW
+    coordinator and the job succeeds."""
+    port = free_port()
+    marker = tmp_path / "first-attempt"
+    server = APIServer()
+    mgr = Manager(server)
+    mgr.add(JAXJobController(server))
+    mgr.add(LocalExecutor(server, timeout=240.0, extra_env={
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "JAXJOB_COORDINATOR": f"127.0.0.1:{port}",
+        "FAIL_ONCE_MARKER": str(marker),
+    }))
+    mgr.start()
+    try:
+        # worker wrapper: rank 1 dies BEFORE joining on its first life
+        # (marker file absent); every later incarnation trains normally.
+        # The worker container command is controller-owned, so the wrapper
+        # is injected by patching the pod builder (what a custom worker
+        # image would do in production).
+        crash_then_train = (
+            "import os, sys\n"
+            "marker = os.environ['FAIL_ONCE_MARKER']\n"
+            "rank = os.environ.get('JAXJOB_PROCESS_ID', '0')\n"
+            "if rank == '1' and not os.path.exists(marker):\n"
+            "    open(marker, 'w').write('died')\n"
+            "    sys.exit(1)\n"
+            "from kubeflow_tpu.training.__main__ import main\n"
+            "sys.exit(main([]))\n")
+        import kubeflow_tpu.api.jaxjob as jax_api
+
+        orig_build = jax_api.build_worker_pod
+
+        def build_with_crash(job_, index):
+            pod = orig_build(job_, index)
+            pod["spec"]["containers"][0]["command"] = [
+                "python", "-c", crash_then_train]
+            return pod
+
+        jax_api.build_worker_pod = build_with_crash
+        server.create(api.new("phoenix2", "ml", topology="v5e-8",
+                              trainer={"model": "mnist_mlp", "steps": 2,
+                                       "global_batch": 8, "log_every": 1}))
+        try:
+            deadline = time.monotonic() + 300
+            done = None
+            while time.monotonic() < deadline:
+                done = server.get(api.KIND, "phoenix2", "ml")
+                if done.get("status", {}).get("phase") in ("Succeeded",
+                                                           "Failed"):
+                    break
+                time.sleep(0.2)
+        finally:
+            jax_api.build_worker_pod = orig_build
+        assert done["status"]["phase"] == "Succeeded", done["status"]
+        assert done["status"]["restarts"] == 1
+        assert marker.exists()  # the first incarnation really died
+        # both final workers trained through the re-established rendezvous
+        pods = server.list("Pod", namespace="ml", label_selector={
+            "matchLabels": {"jaxjob": "phoenix2"}})
+        losses = [p["status"]["result"]["final_loss"] for p in pods]
+        assert losses[0] == pytest.approx(losses[1], abs=0.0)
+    finally:
+        mgr.stop()
