@@ -1,0 +1,118 @@
+"""Mixture-of-Experts FFN with expert parallelism (the 'ep' mesh axis).
+
+TPU-first formulation (GShard/Mesh-TF style): token routing is expressed as
+dense one-hot einsums with a fixed per-expert capacity, so every shape is
+static, everything lands on the MXU, and sharding the expert dimension over
+the ``ep`` axis turns the dispatch/combine einsums into XLA all-to-alls —
+no scatter/gather, no host control flow.
+
+  router:    logits [B,S,E] -> top-2 gates, renormalized
+  dispatch:  one-hot [B,S,E,C] x tokens [B,S,D] -> expert inputs [E,C,D]
+  experts:   batched SwiGLU-less FFN over E (weights ["expert",...] ->
+             sharded on ep)
+  combine:   gates [B,S,E,C] x expert outputs [E,C,D] -> [B,S,D]
+
+Aux load-balancing loss (Switch/GShard): mean(fraction_tokens * mean_gate)
+* E, returned so callers can add ``aux_weight * aux`` to the task loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models import layers as kl
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int = 64
+    ffn_size: int = 128
+    num_experts: int = 4
+    capacity_factor: float = 1.25
+    dtype: str = "bfloat16"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+class MoEBlock(nn.Module):
+    """Top-2 gated MoE FFN over [B, S, D] activations."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        dtype = cfg.jnp_dtype
+        b, s, d = x.shape
+        e = cfg.num_experts
+        tokens = b * s
+        # GShard top-2 sizing: 2*T (token, choice) assignments compete for
+        # the buffers, so capacity scales with BOTH choices — T/e would
+        # silently drop ~all second choices even under balanced routing
+        capacity = max(1, int(cfg.capacity_factor * 2 * tokens / e))
+
+        router = kl.DenseGeneral(e, axis_names=("embed", "expert"),
+                                 dtype=jnp.float32, name="router")
+        logits = router(x.astype(jnp.float32))          # [B,S,E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-2 selection, static shapes
+        gate1, idx1 = jax.lax.top_k(probs, 1)
+        masked = probs - jax.nn.one_hot(idx1[..., 0], e) * probs
+        gate2, idx2 = jax.lax.top_k(masked, 1)
+        gates = jnp.concatenate([gate1, gate2], -1)      # [B,S,2]
+        gates = gates / jnp.maximum(
+            jnp.sum(gates, -1, keepdims=True), 1e-9)
+        expert_idx = jnp.concatenate([idx1, idx2], -1)   # [B,S,2]
+
+        # position of each (token, choice) within its expert's capacity
+        # buffer; overflowing tokens are dropped (their one-hot rows zero)
+        choice_oh = jax.nn.one_hot(expert_idx, e,
+                                   dtype=jnp.int32)      # [B,S,2,E]
+        flat_oh = choice_oh.reshape(tokens, 2, e)
+        # order: all first choices before second choices (priority routing)
+        pri = flat_oh.transpose(1, 0, 2).reshape(2 * tokens, e)
+        pos_in_expert = jnp.cumsum(pri, axis=0) - pri    # [2T, E]
+        pos = jnp.sum(pri * pos_in_expert, axis=-1)      # [2T]
+        keep = pos < capacity
+        pos = jnp.where(keep, pos, 0)
+        pri_kept = pri * keep[:, None]
+        # dispatch/combine tensors [B,S,2,E,C]
+        cap_oh = jax.nn.one_hot(pos, capacity) * keep[:, None]
+        disp2 = (pri_kept[:, :, None] * cap_oh[:, None, :]).reshape(
+            2, tokens, e, capacity).transpose(1, 0, 2, 3)
+        dispatch = disp2.reshape(b, s, 2, e, capacity)
+        combine = dispatch * gates[..., None, None]
+
+        xd = x.astype(jnp.float32)
+        expert_in = jnp.einsum("bskec,bsd->ecd",
+                               dispatch.astype(jnp.float32), xd)
+        # batched experts: weights carry the "expert" logical axis -> ep
+        w_in = self.param("w_in", nn.with_partitioning(
+            nn.initializers.lecun_normal(), ("expert", "embed", "mlp")),
+            (e, d, cfg.ffn_size), jnp.float32)
+        w_out = self.param("w_out", nn.with_partitioning(
+            nn.initializers.lecun_normal(), ("expert", "mlp", "embed")),
+            (e, cfg.ffn_size, d), jnp.float32)
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       jnp.asarray(w_in, dtype).astype(jnp.float32))
+        h = nn.gelu(h, approximate=True)
+        expert_out = jnp.einsum("ecf,efd->ecd", h,
+                                jnp.asarray(w_out, dtype).astype(
+                                    jnp.float32))
+        y = jnp.einsum("bskec,ecd->bsd", combine.astype(jnp.float32),
+                       expert_out)
+
+        # load-balancing aux loss (Switch eq. 4): fraction of tokens
+        # routed to each expert (first choice) x mean router prob
+        frac_tokens = jnp.mean(
+            jax.nn.one_hot(expert_idx[..., 0], e), axis=(0, 1))
+        mean_probs = jnp.mean(probs, axis=(0, 1))
+        aux = jnp.sum(frac_tokens * mean_probs) * e
+        return y.astype(x.dtype), aux

@@ -10,8 +10,8 @@ This module is the single source of truth for:
 - the catalogue of slice shapes (``TOPOLOGIES``), used by the JAXJob
   controller for gang scheduling and by ResourceQuota accounting;
 - mapping a slice + parallelism config to a named ``Mesh`` with the standard
-  axes ``('dp', 'fsdp', 'tp', 'sp')`` (data, fully-sharded-data, tensor,
-  sequence parallelism).
+  axes ``('dp', 'fsdp', 'tp', 'sp', 'pp', 'ep')`` (data, fully-sharded-data,
+  tensor, sequence, pipeline, and expert parallelism; pp/ep default to 1).
 
 Axis convention (scaling-book style): collectives for fsdp/tp/sp ride ICI
 within a slice; the dp axis is laid out outermost so multi-slice data
@@ -30,7 +30,9 @@ from jax.sharding import Mesh
 
 # Canonical mesh axis names, outermost first. dp is outermost so that
 # cross-slice (DCN) traffic is pure data-parallel gradient reduction.
-MeshAxes = ("dp", "fsdp", "tp", "sp")
+# pp (pipeline stages) and ep (experts) default to size 1; specs that
+# ignore them are unaffected.
+MeshAxes = ("dp", "fsdp", "tp", "sp", "pp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,9 +84,11 @@ def factor_axes(
     fsdp: int = 1,
     tp: int = 1,
     sp: int = 1,
-) -> tuple[int, int, int, int]:
+    pp: int = 1,
+    ep: int = 1,
+) -> tuple[int, ...]:
     """Resolve axis sizes; at most one axis may be -1 (inferred)."""
-    sizes = [dp, fsdp, tp, sp]
+    sizes = [dp, fsdp, tp, sp, pp, ep]
     n_infer = sum(1 for s in sizes if s == -1)
     if n_infer > 1:
         raise ValueError("at most one mesh axis may be -1")
@@ -108,10 +112,12 @@ def make_mesh(
     fsdp: int = 1,
     tp: int = 1,
     sp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
     num_slices: int | None = None,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build the standard 4-axis mesh over the given (or all) devices.
+    """Build the standard 6-axis mesh over the given (or all) devices.
 
     ``num_slices > 1`` builds a hybrid ICI x DCN mesh: the dp axis's leading
     blocks map one-to-one onto slices so only data-parallel gradient
@@ -135,7 +141,8 @@ def make_mesh(
     devices = list(devices)[:n_devices]
     if len(devices) < n_devices:
         raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
-    shape = factor_axes(n_devices, dp=dp, fsdp=fsdp, tp=tp, sp=sp)
+    shape = factor_axes(n_devices, dp=dp, fsdp=fsdp, tp=tp, sp=sp, pp=pp,
+                        ep=ep)
 
     if num_slices > 1:
         if shape[0] % num_slices:
@@ -143,7 +150,7 @@ def make_mesh(
                 f"dp={shape[0]} must be a multiple of num_slices "
                 f"({num_slices}): only the dp axis may cross DCN")
         ici_shape = (shape[0] // num_slices,) + shape[1:]
-        dcn_shape = (num_slices, 1, 1, 1)
+        dcn_shape = (num_slices,) + (1,) * (len(shape) - 1)
         try:
             from jax.experimental import mesh_utils
 
@@ -170,7 +177,7 @@ def make_mesh(
 
 
 def best_mesh_for(topology: SliceTopology | str, *, model_parallel: int = 1,
-                  seq_parallel: int = 1) -> tuple[int, int, int, int]:
+                  seq_parallel: int = 1) -> tuple[int, ...]:
     """Heuristic axis assignment for a slice: tp/sp as requested, the rest fsdp
     within a slice, dp across slices (handled by the multi-slice layer)."""
     if isinstance(topology, str):

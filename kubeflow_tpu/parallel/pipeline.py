@@ -1,0 +1,99 @@
+"""Pipeline parallelism over the 'pp' mesh axis (GPipe schedule).
+
+The reference ecosystem's pipeline story is external (DeepSpeed/Megatron on
+GPU); here it is a first-class mesh axis like dp/fsdp/tp/sp/ep, built the
+TPU way: layers are STACKED on a leading axis sharded over ``pp`` (logical
+axis "stage"), and the schedule runs inside ``shard_map`` — each stage
+executes its local layers every tick and hands its activation to the next
+stage with a single ``ppermute`` neighbor exchange on ICI.  Everything is
+``lax.scan`` over ticks (static trip count M + P - 1), so the whole
+pipeline — bubbles and all — is one XLA program, reverse-differentiable for
+free (ppermute transposes to the reverse permutation).
+
+    out = pipeline_forward(block_fn, stacked_params, x, mesh=mesh,
+                           num_microbatches=M)
+
+block_fn(layer_params, h) -> h applies ONE layer; stacked_params' leaves
+have leading dim L (total layers, L % pp == 0).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_layer_params(per_layer: list) -> object:
+    """[L params pytrees] -> one pytree with leading layer axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_layer)
+
+
+def stage_spec() -> P:
+    """PartitionSpec for stacked layer params (leading 'stage' axis)."""
+    return P("pp")
+
+
+def pipeline_forward(block_fn, stacked_params, x: jax.Array, *,
+                     mesh: Mesh, num_microbatches: int,
+                     axis_name: str = "pp") -> jax.Array:
+    """Run x [B, ...] through all L stacked layers, pipelined over the
+    ``axis_name`` mesh axis with ``num_microbatches`` GPipe microbatches."""
+    from jax import shard_map
+
+    n_stages = mesh.shape[axis_name]
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} must divide into {m} microbatches")
+    xs = x.reshape((m, b // m) + x.shape[1:])
+
+    def per_stage(local_params, xs_local):
+        p = jax.lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def run_local(h):
+            def one(h, layer):
+                return block_fn(layer, h), None
+
+            out, _ = jax.lax.scan(one, h, local_params)
+            return out
+
+        def tick(carry, t):
+            h_in, outputs = carry
+            # stage 0 ingests microbatch t (clamped once the feed is done);
+            # later stages consume what the previous tick handed them
+            feed = xs_local[jnp.clip(t, 0, m - 1)]
+            my_in = jnp.where(p == 0, feed, h_in)
+            h_out = run_local(my_in)
+            active = (t >= p) & (t < p + m)
+            h_out = jnp.where(active, h_out, jnp.zeros_like(h_out))
+            # the last stage banks its result for microbatch t-(P-1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            bank = (p == n_stages - 1) & (t >= n_stages - 1)
+            current = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                                   keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(bank, h_out, current), out_idx, 0)
+            h_next = jax.lax.ppermute(h_out, axis_name, perm)
+            return (h_next, outputs), None
+
+        zero = jnp.zeros_like(xs_local[0])
+        out_buf = jnp.zeros_like(xs_local)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zero, out_buf), jnp.arange(m + n_stages - 1))
+        # every stage holds a buffer but only the last stage's is real:
+        # psum with masking replicates the true outputs everywhere
+        outputs = jax.lax.psum(
+            jnp.where(p == n_stages - 1, outputs,
+                      jnp.zeros_like(outputs)), axis_name)
+        return outputs
+
+    mapped = shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(stage_spec(), P()),   # layers sharded, microbatches repl.
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = mapped(stacked_params, xs)
+    return out.reshape((b,) + out.shape[2:])

@@ -1,4 +1,4 @@
-"""Parameter/activation sharding rules for the 4-axis mesh.
+"""Parameter/activation sharding rules for the 6-axis mesh.
 
 Instead of hand-annotating every parameter, models tag each weight with
 *logical axis names* (flax ``nn.with_partitioning`` metadata) and this module
@@ -17,6 +17,8 @@ Default rules (transformer-oriented, scaling-book layouts):
   "kv"             None             per-head dim: replicated
   "mlp"            "tp"             FFN hidden dim: tensor parallel
   "vocab"          "tp"             embedding/LM-head vocab dim
+  "stage"          "pp"             stacked pipeline layers (parallel/pipeline)
+  "expert"         "ep"             MoE experts (models/moe)
 """
 
 from __future__ import annotations
@@ -40,8 +42,8 @@ class ShardingRules:
         ("kv", None),
         ("mlp", "tp"),
         ("vocab", "tp"),
-        ("stage", None),
-        ("expert", None),
+        ("stage", "pp"),
+        ("expert", "ep"),
     )
 
     def mesh_axes(self, logical_name: str | None):

@@ -1,0 +1,152 @@
+"""Pipeline (pp) and expert (ep) parallelism — the last two mesh axes.
+
+Correctness bar: pipelined execution must match plain sequential layer
+application exactly (fwd AND grad), and the MoE block must be a working
+top-2 router whose expert weights shard over ep.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.parallel import make_mesh
+from kubeflow_tpu.parallel.pipeline import (
+    pipeline_forward,
+    stack_layer_params,
+)
+
+
+def mlp_block(layer_params, h):
+    h = jnp.tanh(h @ layer_params["w"] + layer_params["b"])
+    return h
+
+
+def make_layers(n_layers, d, key):
+    per_layer = []
+    for i in range(n_layers):
+        k1, k2, key = jax.random.split(key, 3)
+        per_layer.append({
+            "w": jax.random.normal(k1, (d, d), jnp.float32) / d ** 0.5,
+            "b": jax.random.normal(k2, (d,), jnp.float32) * 0.01,
+        })
+    return stack_layer_params(per_layer)
+
+
+def sequential(stacked, x):
+    def one(h, layer):
+        return mlp_block(layer, h), None
+
+    out, _ = jax.lax.scan(one, x, stacked)
+    return out
+
+
+@pytest.mark.parametrize("pp,m", [(2, 4), (4, 2), (4, 8)])
+def test_pipeline_matches_sequential(pp, m):
+    mesh = make_mesh(8, dp=8 // pp, fsdp=1, tp=1, sp=1, pp=pp)
+    key = jax.random.PRNGKey(0)
+    stacked = make_layers(8, 16, key)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16), jnp.float32)
+    with mesh:
+        out = pipeline_forward(mlp_block, stacked, x, mesh=mesh,
+                               num_microbatches=m)
+    ref = sequential(stacked, x)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
+
+def test_pipeline_gradients_match():
+    pp, m = 4, 4
+    mesh = make_mesh(8, dp=2, fsdp=1, tp=1, sp=1, pp=pp)
+    stacked = make_layers(8, 8, jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 8), jnp.float32)
+
+    def loss_pipe(params):
+        with mesh:
+            return jnp.sum(pipeline_forward(
+                mlp_block, params, x, mesh=mesh, num_microbatches=m) ** 2)
+
+    def loss_seq(params):
+        return jnp.sum(sequential(params, x) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        err = float(jnp.max(jnp.abs(a - b)))
+        scale = float(jnp.max(jnp.abs(b))) + 1e-9
+        assert err / scale < 1e-5, err / scale
+
+
+def test_pipeline_rejects_bad_microbatching():
+    mesh = make_mesh(8, dp=4, fsdp=1, tp=1, sp=1, pp=2)
+    stacked = make_layers(2, 4, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline_forward(mlp_block, stacked,
+                         jnp.zeros((6, 4)), mesh=mesh, num_microbatches=4)
+
+
+# ---------------------------------------------------------------- MoE/ep ----
+
+def test_moe_routes_and_balances():
+    from kubeflow_tpu.models.moe import MoEBlock, MoEConfig
+
+    cfg = MoEConfig(hidden_size=16, ffn_size=32, num_experts=4,
+                    dtype="float32")
+    block = MoEBlock(cfg)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16), jnp.float32)
+    params = block.init(rng, x)["params"]
+    from kubeflow_tpu.parallel.sharding import unbox_params
+
+    (y, aux), _ = block.apply({"params": unbox_params(params)}, x), None
+    assert y.shape == x.shape
+    assert float(aux) > 0.0       # balance loss is live
+    # output actually depends on the experts (not a passthrough)
+    assert float(jnp.max(jnp.abs(y))) > 0.0
+
+    # gradients flow to router AND experts
+    def loss(p):
+        out, aux_ = block.apply({"params": p}, x)
+        return jnp.sum(out ** 2) + 0.01 * aux_
+
+    grads = jax.grad(loss)(unbox_params(params))
+    for path in ("router", "w_in", "w_out"):
+        leaf = grads[path] if path != "router" else grads["router"]["kernel"]
+        assert float(jnp.max(jnp.abs(jax.tree_util.tree_leaves(leaf)[0]
+                                     if isinstance(leaf, dict) else leaf))
+                     ) > 0.0, path
+
+
+def test_moe_expert_weights_shard_over_ep():
+    from kubeflow_tpu.models.moe import MoEBlock, MoEConfig
+    from kubeflow_tpu.parallel.sharding import (
+        DEFAULT_RULES,
+        shard_params_specs,
+    )
+
+    cfg = MoEConfig(hidden_size=16, ffn_size=32, num_experts=4,
+                    dtype="float32")
+    block = MoEBlock(cfg)
+    x = jnp.zeros((2, 8, 16), jnp.float32)
+    params = block.init(jax.random.PRNGKey(0), x)["params"]
+    specs = shard_params_specs(params, DEFAULT_RULES)
+    assert specs["w_in"][0] == "ep"      # expert axis -> ep mesh axis
+    assert specs["w_out"][0] == "ep"
+
+    # and the block actually executes under an ep>1 mesh with sharded
+    # expert weights (the dispatch/combine einsums become all-to-alls)
+    mesh = make_mesh(8, dp=2, fsdp=1, tp=1, sp=1, ep=4)
+    from jax.sharding import NamedSharding
+
+    from kubeflow_tpu.parallel.sharding import (
+        logical_to_sharding,
+        unbox_params,
+    )
+
+    shardings = logical_to_sharding(params, mesh, DEFAULT_RULES)
+    plain = unbox_params(params)
+    placed = jax.device_put(plain, unbox_params(shardings))
+    with mesh:
+        y, aux = jax.jit(
+            lambda p, x: block.apply({"params": p}, x))(placed, x)
+    y_ref, _ = block.apply({"params": plain}, x)
+    assert jnp.max(jnp.abs(y - y_ref)) < 1e-4

@@ -8,7 +8,7 @@ from kubeflow_tpu.parallel.mesh import TOPOLOGIES, factor_axes, make_mesh
 
 
 def test_factor_axes_inference():
-    assert factor_axes(8, dp=-1, fsdp=2, tp=2, sp=1) == (2, 2, 2, 1)
+    assert factor_axes(8, dp=-1, fsdp=2, tp=2, sp=1) == (2, 2, 2, 1, 1, 1)
     with pytest.raises(ValueError, match="not divisible"):
         factor_axes(8, dp=-1, fsdp=3)
     with pytest.raises(ValueError, match="multiply"):
@@ -25,7 +25,8 @@ def test_multislice_mesh_dp_blocks_align_with_slices():
     # 8 virtual devices as 2 "slices": dp=4 -> leading dp blocks of size 2
     # per slice; device order groups by slice under the gang launch
     mesh = make_mesh(8, dp=4, fsdp=2, tp=1, sp=1, num_slices=2)
-    assert mesh.shape == {"dp": 4, "fsdp": 2, "tp": 1, "sp": 1}
+    assert dict(mesh.shape) == {"dp": 4, "fsdp": 2, "tp": 1, "sp": 1,
+                                "pp": 1, "ep": 1}
     devs = mesh.devices
     flat = [d.id for d in devs.reshape(-1)]
     assert flat == sorted(flat)  # ordered blocking: slice 0 then slice 1
