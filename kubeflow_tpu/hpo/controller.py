@@ -62,6 +62,30 @@ class ExperimentController(Controller):
                    if t.get("status", {}).get("objective") is not None]
 
         # terminal checks
+        goal = spec["objective"].get("goal")
+        if goal is not None and history:
+            best = (max if maximize else min)(h[1] for h in history)
+            reached = best >= goal if maximize else best <= goal
+            if reached:
+                # Katib objective.goal semantics: stop as soon as any trial
+                # reaches the goal — and free the slices still-running
+                # trials hold (the whole point of stopping early on TPU)
+                for t in running:
+                    try:
+                        self.server.delete(api.TRIAL_KIND,
+                                           t["metadata"]["name"],
+                                           req.namespace)
+                    except NotFound:
+                        pass
+                status["phase"] = "Succeeded"
+                set_condition(exp, "Complete", "True", reason="GoalReached",
+                              message=f"objective {best} reached goal "
+                                      f"{goal}")
+                status.update(self._summary(trials, history, maximize,
+                                            exp=exp))
+                self.server.patch_status(api.KIND, req.name, req.namespace,
+                                         status)
+                return None
         if len(failed) > int(spec.get("maxFailedTrials", 3)):
             status["phase"] = "Failed"
             set_condition(exp, "Complete", "False", reason="TooManyFailures")

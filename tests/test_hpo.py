@@ -171,3 +171,40 @@ def test_invalid_experiment_rejected(stack):
     server, _ = stack
     with pytest.raises(ValueError, match="unknown algorithm"):
         server.create(api.new("bad", "ml", algorithm={"name": "magic"}))
+
+
+def test_experiment_goal_stops_early_and_frees_trials(stack):
+    """Katib objective.goal parity: the experiment completes as soon as a
+    trial reaches the goal; still-running trials are deleted so their
+    slices free up (maxTrials is never exhausted)."""
+    server, mgr = stack
+    exp = api.new("goal", "ml",
+                  objective={"type": "minimize", "metric": "final_loss",
+                             "goal": 0.5},   # FakeExecutor reports 0.1
+                  algorithm={"name": "random", "seed": 3},
+                  parameters=[{"name": "lr", "type": "double",
+                               "min": 1e-4, "max": 1e-1}],
+                  trial_template={
+                      "topology": "v5e-4",
+                      "trainer": {"model": "cifar_convnet", "steps": 5}},
+                  parallel_trials=2, max_trials=50)
+    server.create(exp)
+    done = wait_exp(server, "goal", "ml")
+    assert done["status"]["phase"] == "Succeeded"
+    cond = done["status"]["conditions"][0]
+    assert cond["reason"] == "GoalReached"
+    # far fewer than maxTrials ran
+    assert done["status"]["trials"] < 10
+    # no trial is left running/holding a slice
+    import time as _t
+
+    deadline = _t.monotonic() + 10
+    while _t.monotonic() < deadline:
+        live = [t for t in server.list(api.TRIAL_KIND, namespace="ml")
+                if t["spec"].get("experiment") == "goal"
+                and t.get("status", {}).get("phase") not in ("Succeeded",
+                                                             "Failed")]
+        if not live:
+            break
+        _t.sleep(0.05)
+    assert not live
