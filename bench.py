@@ -197,12 +197,34 @@ def measure_serving(max_new: int = 96, n_requests: int = 6) -> dict:
             "serving_requests": n_requests}
 
 
-def main() -> None:
-    import jax
+def _backend_or_die(timeout_s: float = 600.0):
+    """Initialize the JAX backend with a watchdog: a wedged TPU tunnel
+    hangs make_c_api_client forever, which must fail the bench loudly
+    instead of hanging the caller indefinitely."""
+    import threading
 
+    out: dict = {}
+
+    def init():
+        import jax
+
+        out["backend"] = jax.default_backend()
+        out["devices"] = jax.devices()
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise SystemExit(
+            f"backend init did not complete within {timeout_s:.0f}s — "
+            "TPU tunnel unreachable/wedged; aborting bench")
+    return out["backend"], out["devices"]
+
+
+def main() -> None:
     seq = 512
-    backend = jax.default_backend()
-    _log(f"backend={backend} devices={jax.devices()}")
+    backend, devices = _backend_or_die()
+    _log(f"backend={backend} devices={devices}")
 
     # optimized path: bf16 matmuls, NO remat (fits at seq 512), masked-
     # position MLM head, pipelined dispatch (batch 24 measured best: 91 vs

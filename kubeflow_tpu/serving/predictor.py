@@ -189,7 +189,11 @@ def main(argv=None) -> int:
     from kubeflow_tpu.core.httpapi import serve
 
     parser = argparse.ArgumentParser("kubeflow_tpu.serving")
-    parser.add_argument("--model", default="llama")
+    parser.add_argument("--model", action="append", dest="models",
+                        default=None,
+                        help="repeatable: serve several models from one "
+                             "process (default: llama; each generative "
+                             "model gets its own batching engine)")
     parser.add_argument("--size", default="tiny")
     parser.add_argument("--checkpoint-dir")
     parser.add_argument("--port", type=int, default=8602)
@@ -197,15 +201,19 @@ def main(argv=None) -> int:
     parser.add_argument("--max-seq", type=int, default=512)
     args = parser.parse_args(argv)
 
-    if args.model == "llama":
-        pred = GenerativePredictor(
-            args.model, size=args.size, checkpoint_dir=args.checkpoint_dir,
-            max_batch=args.max_batch, max_seq=args.max_seq)
-    else:
-        pred = ClassifierPredictor(args.model,
-                                   checkpoint_dir=args.checkpoint_dir)
-    httpd, thread = serve(PredictorApp({args.model: pred}), args.port)
-    print(f"predictor serving {args.model} on :{args.port}", flush=True)
+    names = [m for m in (args.models or []) if m] or ["llama"]
+    predictors = {}
+    for name in names:
+        if name == "llama":
+            predictors[name] = GenerativePredictor(
+                name, size=args.size, checkpoint_dir=args.checkpoint_dir,
+                max_batch=args.max_batch, max_seq=args.max_seq)
+        else:
+            predictors[name] = ClassifierPredictor(
+                name, checkpoint_dir=args.checkpoint_dir)
+    httpd, thread = serve(PredictorApp(predictors), args.port)
+    print(f"predictor serving {sorted(predictors)} on :{args.port}",
+          flush=True)
     thread.join()
     return 0
 
