@@ -206,10 +206,13 @@ def _backend_or_die(timeout_s: float = 600.0):
     out: dict = {}
 
     def init():
-        import jax
+        try:
+            import jax
 
-        out["backend"] = jax.default_backend()
-        out["devices"] = jax.devices()
+            out["backend"] = jax.default_backend()
+            out["devices"] = jax.devices()
+        except BaseException as e:  # surfaced in the caller, not swallowed
+            out["error"] = e
 
     t = threading.Thread(target=init, daemon=True)
     t.start()
@@ -218,6 +221,8 @@ def _backend_or_die(timeout_s: float = 600.0):
         raise SystemExit(
             f"backend init did not complete within {timeout_s:.0f}s — "
             "TPU tunnel unreachable/wedged; aborting bench")
+    if "error" in out:
+        raise SystemExit(f"backend init failed: {out['error']!r}")
     return out["backend"], out["devices"]
 
 

@@ -22,6 +22,8 @@ class ModelEntry:
     make_inputs: Callable[..., tuple]       # (batch, rng, module) -> example inputs
     make_batch: Callable[..., dict]         # (batch, rng, module) -> train batch
     forward_loss: Callable[..., Any]        # (module, params, batch) -> scalar
+    generative: bool = False                # decoder LM: serve via the
+    #                                         continuous-batching engine
 
 
 _REGISTRY: dict[str, ModelEntry] = {}
@@ -207,4 +209,4 @@ def _llama_loss(module, params, batch):
 register(ModelEntry(
     "llama", _make_llama,
     make_inputs=lambda b, rng, m: (jnp.zeros((b, 64), jnp.int32),),
-    make_batch=_llama_batch, forward_loss=_llama_loss))
+    make_batch=_llama_batch, forward_loss=_llama_loss, generative=True))

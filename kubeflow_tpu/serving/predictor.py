@@ -109,6 +109,14 @@ class ClassifierPredictor:
 
         self.params = unbox_params(
             self.module.init(rng, *inputs)["params"])
+        if checkpoint_dir:
+            import orbax.checkpoint as ocp
+
+            from kubeflow_tpu.training.checkpoint import abstract_like
+
+            ckptr = ocp.StandardCheckpointer()
+            self.params = ckptr.restore(checkpoint_dir,
+                                        abstract_like(self.params))
         self._fn = jax.jit(
             lambda p, x: self.module.apply({"params": p}, x))
 
@@ -188,12 +196,18 @@ def main(argv=None) -> int:
 
     from kubeflow_tpu.core.httpapi import serve
 
-    parser = argparse.ArgumentParser("kubeflow_tpu.serving")
+    parser = argparse.ArgumentParser(
+        "kubeflow_tpu.serving",
+        description="Serve one or more registry models from one process. "
+                    "--model is repeatable and accepts per-model options "
+                    "after a colon: --model "
+                    "'llama:size=7b,checkpoint_dir=/ckpts/llama'; bare "
+                    "--size/--checkpoint-dir/--max-* are the defaults.")
     parser.add_argument("--model", action="append", dest="models",
                         default=None,
-                        help="repeatable: serve several models from one "
-                             "process (default: llama; each generative "
-                             "model gets its own batching engine)")
+                        help="repeatable model spec: name[:k=v,...] "
+                             "(default: llama; generative models get their "
+                             "own continuous-batching engine)")
     parser.add_argument("--size", default="tiny")
     parser.add_argument("--checkpoint-dir")
     parser.add_argument("--port", type=int, default=8602)
@@ -201,16 +215,23 @@ def main(argv=None) -> int:
     parser.add_argument("--max-seq", type=int, default=512)
     args = parser.parse_args(argv)
 
-    names = [m for m in (args.models or []) if m] or ["llama"]
+    specs = [m for m in (args.models or []) if m] or ["llama"]
     predictors = {}
-    for name in names:
-        if name == "llama":
+    for spec in specs:
+        name, _, rest = spec.partition(":")
+        opts = dict(kv.split("=", 1) for kv in rest.split(",") if "=" in kv)
+        size = opts.get("size", args.size)
+        ckpt = opts.get("checkpoint_dir", args.checkpoint_dir)
+        from kubeflow_tpu.models import registry
+
+        if registry.get(name).generative:
             predictors[name] = GenerativePredictor(
-                name, size=args.size, checkpoint_dir=args.checkpoint_dir,
-                max_batch=args.max_batch, max_seq=args.max_seq)
+                name, size=size, checkpoint_dir=ckpt,
+                max_batch=int(opts.get("max_batch", args.max_batch)),
+                max_seq=int(opts.get("max_seq", args.max_seq)))
         else:
-            predictors[name] = ClassifierPredictor(
-                name, checkpoint_dir=args.checkpoint_dir)
+            predictors[name] = ClassifierPredictor(name,
+                                                   checkpoint_dir=ckpt)
     httpd, thread = serve(PredictorApp(predictors), args.port)
     print(f"predictor serving {sorted(predictors)} on :{args.port}",
           flush=True)
