@@ -34,14 +34,22 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                          "kubeflow_tpu/training/*"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
                      "tests/test_train_core.py", "tests/test_models.py",
-                     "tests/test_trainer.py", "tests/test_ring_attention.py"],
+                     "tests/test_trainer.py", "tests/test_ring_attention.py",
+                     "tests/test_flash_attention.py", "tests/test_pp_ep.py",
+                     "tests/test_sharding_mesh.py"],
     },
     "jaxjob": {
         "include_dirs": ["kubeflow_tpu/controllers/jaxjob.py",
                          "kubeflow_tpu/controllers/executor.py",
-                         "kubeflow_tpu/api/jaxjob.py"],
+                         "kubeflow_tpu/controllers/scheduler.py",
+                         "kubeflow_tpu/core/quota.py",
+                         "kubeflow_tpu/api/jaxjob.py",
+                         "kubeflow_tpu/api/versions.py",
+                         "kubeflow_tpu/parallel/distributed.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
-                     "tests/test_jaxjob.py"],
+                     "tests/test_jaxjob.py", "tests/test_quota.py",
+                     "tests/test_gang_scheduler.py", "tests/test_versions.py",
+                     "tests/test_distributed_rendezvous.py"],
         "image": "images/worker",
     },
     "notebooks": {
@@ -51,7 +59,8 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                          "kubeflow_tpu/api/notebook.py",
                          "kubeflow_tpu/webapps/*"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
-                     "tests/test_notebook.py", "tests/test_webapps.py"],
+                     "tests/test_notebook.py", "tests/test_webapps.py",
+                     "tests/test_notebook_events_culling.py"],
         "image": "images/jupyter-jax",
     },
     "profiles": {
@@ -74,9 +83,10 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                      "tests/test_tensorboard.py"],
     },
     "dashboard": {
-        "include_dirs": ["kubeflow_tpu/dashboard/*"],
+        "include_dirs": ["kubeflow_tpu/dashboard/*",
+                         "kubeflow_tpu/frontend/*"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
-                     "tests/test_dashboard.py"],
+                     "tests/test_dashboard.py", "tests/test_frontend.py"],
     },
     "hpo": {
         "include_dirs": ["kubeflow_tpu/hpo/*",
@@ -89,7 +99,7 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                          "kubeflow_tpu/api/inferenceservice.py",
                          "kubeflow_tpu/controllers/inferenceservice.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
-                     "tests/test_serving.py"],
+                     "tests/test_serving.py", "tests/test_serving_engine.py"],
         "image": "images/predictor",
     },
     "pipelines": {
