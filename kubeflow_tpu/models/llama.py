@@ -32,6 +32,10 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     remat: bool = True
     use_flash: bool = True
+    # Mixtral-style MoE: >0 replaces the FFN with a top-2 MoE block in
+    # every ``moe_every``-th layer; experts shard over the ep mesh axis
+    moe_experts: int = 0
+    moe_every: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -123,6 +127,7 @@ class LlamaAttention(nn.Module):
 
 class LlamaBlock(nn.Module):
     config: LlamaConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, positions, cache=None, attn_mask=None):
@@ -133,17 +138,27 @@ class LlamaBlock(nn.Module):
             positions, cache, attn_mask)
         x = x + h
         y = kl.RMSNorm(cfg.rms_eps, dtype, name="ffn_norm")(x)
-        gate = kl.DenseGeneral(cfg.intermediate_size, use_bias=False,
-                               axis_names=("embed", "mlp"), dtype=dtype,
-                               name="gate")(y)
-        up = kl.DenseGeneral(cfg.intermediate_size, use_bias=False,
-                             axis_names=("embed", "mlp"), dtype=dtype,
-                             name="up")(y)
-        y = nn.silu(gate) * up
-        y = kl.DenseGeneral(cfg.hidden_size, use_bias=False,
-                            axis_names=("mlp", "embed"), dtype=dtype,
-                            name="down")(y)
-        return x + y, cache
+        aux = jnp.zeros((), jnp.float32)
+        if self.use_moe:
+            from kubeflow_tpu.models.moe import MoEBlock, MoEConfig
+
+            y, aux = MoEBlock(MoEConfig(
+                hidden_size=cfg.hidden_size,
+                ffn_size=cfg.intermediate_size,
+                num_experts=cfg.moe_experts,
+                dtype=cfg.dtype), name="moe")(y)
+        else:
+            gate = kl.DenseGeneral(cfg.intermediate_size, use_bias=False,
+                                   axis_names=("embed", "mlp"), dtype=dtype,
+                                   name="gate")(y)
+            up = kl.DenseGeneral(cfg.intermediate_size, use_bias=False,
+                                 axis_names=("embed", "mlp"), dtype=dtype,
+                                 name="up")(y)
+            y = nn.silu(gate) * up
+            y = kl.DenseGeneral(cfg.hidden_size, use_bias=False,
+                                axis_names=("mlp", "embed"), dtype=dtype,
+                                name="down")(y)
+        return x + y, cache, aux
 
 
 class LlamaModel(nn.Module):
@@ -174,14 +189,21 @@ class LlamaModel(nn.Module):
         if cfg.remat and cache is None:
             block_cls = nn.remat(LlamaBlock, static_argnums=())
         new_cache = []
+        moe_aux = jnp.zeros((), jnp.float32)
         for i in range(cfg.num_layers):
             layer_cache = None if cache is None else cache["layers"][i]
-            x, layer_cache = block_cls(cfg, name=f"layer_{i}")(
+            use_moe = (cfg.moe_experts > 0
+                       and i % max(cfg.moe_every, 1) == 0)
+            x, layer_cache, aux = block_cls(
+                cfg, use_moe=use_moe, name=f"layer_{i}")(
                 x, positions, layer_cache, attn_mask)
             new_cache.append(layer_cache)
+            moe_aux = moe_aux + aux
         x = kl.RMSNorm(cfg.rms_eps, dtype, name="final_norm")(x)
         logits = embed.attend(x)
         out = {"logits": logits}
+        if cfg.moe_experts > 0:
+            out["moe_aux"] = moe_aux
         if cache is not None:
             out["cache"] = {"layers": new_cache}
         return out

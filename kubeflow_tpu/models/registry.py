@@ -203,7 +203,10 @@ def _llama_loss(module, params, batch):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, batch["labels"][..., None],
                                axis=-1)[..., 0]
-    return jnp.mean(nll)
+    loss = jnp.mean(nll)
+    if "moe_aux" in out:  # Switch-style load-balance regularizer
+        loss = loss + 0.01 * out["moe_aux"]
+    return loss
 
 
 register(ModelEntry(
