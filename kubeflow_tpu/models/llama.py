@@ -142,11 +142,15 @@ class LlamaBlock(nn.Module):
         if self.use_moe:
             from kubeflow_tpu.models.moe import MoEBlock, MoEConfig
 
+            # serving (cache present) uses DROPLESS routing so a request's
+            # logits never depend on bucket padding or co-batched traffic;
+            # training uses the static-capacity formulation
             y, aux = MoEBlock(MoEConfig(
                 hidden_size=cfg.hidden_size,
                 ffn_size=cfg.intermediate_size,
                 num_experts=cfg.moe_experts,
-                dtype=cfg.dtype), name="moe")(y)
+                dtype=cfg.dtype), dropless=cache is not None,
+                name="moe")(y)
         else:
             gate = kl.DenseGeneral(cfg.intermediate_size, use_bias=False,
                                    axis_names=("embed", "mlp"), dtype=dtype,
@@ -203,7 +207,11 @@ class LlamaModel(nn.Module):
         logits = embed.attend(x)
         out = {"logits": logits}
         if cfg.moe_experts > 0:
-            out["moe_aux"] = moe_aux
+            # depth-normalized so the loss coefficient is independent of
+            # how many layers are MoE
+            n_moe = sum(1 for i in range(cfg.num_layers)
+                        if i % max(cfg.moe_every, 1) == 0)
+            out["moe_aux"] = moe_aux / max(n_moe, 1)
         if cache is not None:
             out["cache"] = {"layers": new_cache}
         return out

@@ -41,9 +41,17 @@ class MoEConfig:
 
 
 class MoEBlock(nn.Module):
-    """Top-2 gated MoE FFN over [B, S, D] activations."""
+    """Top-2 gated MoE FFN over [B, S, D] activations.
+
+    ``dropless=True`` evaluates EVERY expert on every token and combines
+    with the top-2 gates — no capacity, no drops, and therefore exactly
+    batch/padding-invariant.  Serving uses it (a request's logits must not
+    depend on bucket padding or co-batched traffic); training uses the
+    capacity formulation (static shapes, drops as regularization).
+    """
 
     config: MoEConfig
+    dropless: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -71,6 +79,33 @@ class MoEBlock(nn.Module):
             jnp.sum(gates, -1, keepdims=True), 1e-9)
         expert_idx = jnp.concatenate([idx1, idx2], -1)   # [B,S,2]
 
+        w_in = self.param("w_in", nn.with_partitioning(
+            nn.initializers.lecun_normal(), ("expert", "embed", "mlp")),
+            (e, d, cfg.ffn_size), jnp.float32)
+        w_out = self.param("w_out", nn.with_partitioning(
+            nn.initializers.lecun_normal(), ("expert", "mlp", "embed")),
+            (e, cfg.ffn_size, d), jnp.float32)
+        # load-balancing aux loss (Switch eq. 4): fraction of tokens
+        # routed to each expert (first choice) x mean router prob
+        frac_tokens = jnp.mean(
+            jax.nn.one_hot(expert_idx[..., 0], e), axis=(0, 1))
+        mean_probs = jnp.mean(probs, axis=(0, 1))
+        aux = jnp.sum(frac_tokens * mean_probs) * e
+
+        if self.dropless:
+            xd = x.astype(jnp.float32)
+            h = jnp.einsum("bsd,edf->bsef", xd,
+                           jnp.asarray(w_in, dtype).astype(jnp.float32))
+            h = nn.gelu(h, approximate=True)
+            all_out = jnp.einsum("bsef,efd->bsed", h,
+                                 jnp.asarray(w_out, dtype).astype(
+                                     jnp.float32))
+            sel = jnp.take_along_axis(
+                all_out, expert_idx[..., None].astype(jnp.int32),
+                axis=2)                                  # [B,S,2,D]
+            y = jnp.sum(sel * gates[..., None], axis=2)
+            return y.astype(x.dtype), aux
+
         # position of each (token, choice) within its expert's capacity
         # buffer; overflowing tokens are dropped (their one-hot rows zero)
         choice_oh = jax.nn.one_hot(expert_idx, e,
@@ -93,13 +128,6 @@ class MoEBlock(nn.Module):
         xd = x.astype(jnp.float32)
         expert_in = jnp.einsum("bskec,bsd->ecd",
                                dispatch.astype(jnp.float32), xd)
-        # batched experts: weights carry the "expert" logical axis -> ep
-        w_in = self.param("w_in", nn.with_partitioning(
-            nn.initializers.lecun_normal(), ("expert", "embed", "mlp")),
-            (e, d, cfg.ffn_size), jnp.float32)
-        w_out = self.param("w_out", nn.with_partitioning(
-            nn.initializers.lecun_normal(), ("expert", "mlp", "embed")),
-            (e, cfg.ffn_size, d), jnp.float32)
         h = jnp.einsum("ecd,edf->ecf", expert_in,
                        jnp.asarray(w_in, dtype).astype(jnp.float32))
         h = nn.gelu(h, approximate=True)
@@ -108,11 +136,4 @@ class MoEBlock(nn.Module):
                                     jnp.float32))
         y = jnp.einsum("bskec,ecd->bsd", combine.astype(jnp.float32),
                        expert_out)
-
-        # load-balancing aux loss (Switch eq. 4): fraction of tokens
-        # routed to each expert (first choice) x mean router prob
-        frac_tokens = jnp.mean(
-            jax.nn.one_hot(expert_idx[..., 0], e), axis=(0, 1))
-        mean_probs = jnp.mean(probs, axis=(0, 1))
-        aux = jnp.sum(frac_tokens * mean_probs) * e
         return y.astype(x.dtype), aux

@@ -171,12 +171,12 @@ def test_llama_moe_trains_and_decodes():
     state, sh = ts.init_train_state(model, optax.adam(1e-3), rng, (ids,),
                                     mesh)
 
+    from kubeflow_tpu.models import registry
+
     def forward(params, batch):
-        out = model.apply({"params": params}, batch["input_ids"])
-        logp = jax.nn.log_softmax(out["logits"].astype(jnp.float32), -1)
-        nll = -jnp.take_along_axis(logp, batch["labels"][..., None],
-                                   -1)[..., 0]
-        return jnp.mean(nll) + 0.01 * out["moe_aux"]
+        # the registry loss (incl. the aux-loss coefficient) IS the
+        # contract under test — no hand copy that could drift
+        return registry._llama_loss(model, params, batch)
 
     batch = {"input_ids": jax.random.randint(rng, (4, 16), 0,
                                              cfg.vocab_size),
@@ -202,3 +202,11 @@ def test_llama_moe_trains_and_decodes():
     full = model.apply({"params": params}, prompt)["logits"]
     nxt_full = int(jnp.argmax(full[0, -1]))
     assert nxt_cached == nxt_full
+
+    # serving is DROPLESS: padding the prompt (bucket padding) must not
+    # change the logits at the real positions
+    padded = jnp.pad(prompt, ((0, 0), (0, 11)))
+    cache2 = lm.init_cache(cfg, 1, max_len=32)
+    out_p = model.apply({"params": params}, padded,
+                        cache=cache2)["logits"]
+    assert jnp.allclose(out_p[0, 4], out[0, 4], atol=1e-4)
