@@ -79,10 +79,15 @@ class JupyterApp(CrudApp):
     def get_events(self, req: Request):
         ns, name = req.params["ns"], req.params["name"]
         req.authorize("list", "Event", ns)
+
+        def involved(e) -> bool:
+            # the notebook itself, or its children (nb-0 pod, nb STS) —
+            # NOT another notebook that merely shares a name prefix
+            target = e["spec"].get("involvedObject", {}).get("name", "")
+            return target == name or target.startswith(name + "-")
+
         events = [e for e in self.server.list("Event", namespace=ns)
-                  if e["spec"].get("involvedObject", {}).get("name",
-                                                             "").startswith(
-                      name)]
+                  if involved(e)]
         return "200 OK", {"events": events}
 
     def list_poddefaults(self, req: Request):
