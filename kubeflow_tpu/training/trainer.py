@@ -10,6 +10,7 @@ thin and the payload self-describing.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable
 
@@ -41,6 +42,11 @@ class TrainerConfig:
     data_path: str | None = None                  # .npz on a PVC; else synthetic
     profile_dir: str | None = None                # XLA trace capture window
     profile_steps: int = 5                        # window length in steps
+    # fault injection (the reference has no fault-injection framework,
+    # SURVEY.md §5.3): a fresh (non-resumed) run hard-kills itself after
+    # completing this step — simulates a slice preemption mid-training so
+    # gang restart + checkpoint resume can be exercised deterministically
+    fault_kill_at_step: int = 0
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "TrainerConfig":
@@ -67,6 +73,16 @@ class Trainer:
         from kubeflow_tpu.training.optim import make_optimizer
 
         cfg = self.cfg
+        if cfg.fault_kill_at_step and not (
+                cfg.checkpoint_dir and cfg.checkpoint_every and cfg.resume
+                and cfg.checkpoint_every <= cfg.fault_kill_at_step
+                and cfg.fault_kill_at_step <= cfg.steps):
+            # without a committed checkpoint before the kill step every
+            # incarnation restarts from 0 and dies again — a crash loop,
+            # not a recovery test
+            raise ValueError(
+                "fault_kill_at_step requires resume plus checkpointing "
+                "with checkpoint_every <= fault_kill_at_step <= steps")
         entry = registry.get(cfg.model)
         module = entry.make_model(**cfg.model_config)
         mesh = make_mesh(dp=cfg.dp, fsdp=cfg.fsdp, tp=cfg.tp, sp=cfg.sp)
@@ -96,7 +112,8 @@ class Trainer:
                     self.log.info("already complete", step=start_step)
                     ckpt.close()
                     return {"final_loss": None, "steps": cfg.steps,
-                            "samples_per_sec": 0.0, "already_complete": True}
+                            "samples_per_sec": 0.0, "start_step": start_step,
+                            "already_complete": True}
 
         import contextlib
 
@@ -166,6 +183,16 @@ class Trainer:
                     if (ckpt and cfg.checkpoint_every
                             and (step + 1) % cfg.checkpoint_every == 0):
                         ckpt.save(step + 1, state)
+                    if (cfg.fault_kill_at_step and start_step == 0
+                            and step + 1 == cfg.fault_kill_at_step):
+                        # simulated preemption: commit pending checkpoints,
+                        # then die the way SIGKILL would (no cleanup, no
+                        # final save) — the gang restart must recover us
+                        if ckpt:
+                            ckpt.close()
+                        self.log.info("fault injection: killing process",
+                                      step=step + 1)
+                        os._exit(17)
         finally:
             # a failing step is exactly when the trace matters: always flush
             tracer.close()
@@ -176,6 +203,7 @@ class Trainer:
         return {
             "final_loss": final_loss,
             "steps": cfg.steps,
+            "start_step": start_step,
             "samples_per_sec": (self.history[-1]["samples_per_sec"]
                                 if self.history else 0.0),
         }

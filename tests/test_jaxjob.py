@@ -158,6 +158,50 @@ def test_local_executor_really_trains_mnist(harness):
     assert result["samples_per_sec"] > 0
 
 
+def test_preempted_trial_resumes_from_checkpoint(harness, tmp_path):
+    """SURVEY.md §7 hard-part #2: elastic recovery on preemptible slices.
+
+    A real training subprocess is hard-killed mid-run (fault injection
+    simulating slice preemption), the gang restarts, and the replacement
+    worker RESUMES from the last committed checkpoint instead of step 0.
+    """
+    server, mgr = harness
+    mgr.add(LocalExecutor(server, extra_env={
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "JAXJOB_COORDINATOR": "",
+    }))
+    mgr.start()
+    ckpt_dir = str(tmp_path / "ckpt")
+    job = api.new("preempt-e2e", "ml", topology="v5e-1",
+                  trainer={"model": "mnist_mlp", "steps": 6,
+                           "global_batch": 16, "log_every": 2,
+                           "checkpoint_dir": ckpt_dir,
+                           "checkpoint_every": 2,
+                           "fault_kill_at_step": 5,
+                           "optimizer": {"name": "adam",
+                                         "learning_rate": 1e-3}})
+    server.create(job)
+    done = wait_phase(server, "preempt-e2e", "ml", {"Succeeded", "Failed"},
+                      timeout=300)
+    assert done["status"]["phase"] == "Succeeded", done["status"]
+    # exactly one preemption happened and was absorbed by gang restart
+    assert done["status"]["restarts"] == 1
+    result = done["status"]["result"]
+    # the surviving incarnation resumed from the step-4 checkpoint — it did
+    # NOT retrain from scratch
+    assert result["start_step"] == 4, result
+    assert result["steps"] == 6
+    # the final checkpoint covers the full run
+    from kubeflow_tpu.training.checkpoint import CheckpointManager
+    ckpt = CheckpointManager(ckpt_dir)
+    try:
+        assert ckpt.latest_step() == 6
+    finally:
+        ckpt.close()
+
+
 def test_multislice_gang(harness):
     """numSlices > 1: one atomic gang of hosts x slices pods; dp crosses
     DCN, everything else stays within a slice."""
