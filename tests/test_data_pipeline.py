@@ -1,0 +1,107 @@
+"""Async input pipeline: DevicePrefetcher semantics + Trainer parity.
+
+The reference has no input-pipeline layer at all (it is a control plane);
+this platform's TPU-first training path overlaps host batch assembly and
+h2d transfer with device compute.  Correctness bar: prefetched training is
+bit-identical to the synchronous path.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.training.data import DevicePrefetcher
+from kubeflow_tpu.training.trainer import Trainer, TrainerConfig
+
+
+def test_prefetcher_preserves_order_and_terminates():
+    src = [{"x": np.full((2,), i)} for i in range(7)]
+    pf = DevicePrefetcher(iter(src), lambda b: b, depth=2)
+    got = list(pf)
+    assert [int(b["x"][0]) for b in got] == list(range(7))
+    # exhausted: stays exhausted instead of blocking on the dead queue
+    assert list(pf) == []
+    pf.close()
+
+
+def test_prefetcher_applies_put_fn():
+    pf = DevicePrefetcher(iter([1, 2, 3]), lambda b: b * 10, depth=1)
+    assert list(pf) == [10, 20, 30]
+    pf.close()
+
+
+def test_prefetcher_propagates_producer_error():
+    def gen():
+        yield 1
+        raise RuntimeError("bad shard")
+
+    pf = DevicePrefetcher(gen(), lambda b: b, depth=2)
+    assert next(pf) == 1
+    with pytest.raises(RuntimeError, match="bad shard"):
+        next(pf)
+    pf.close()
+
+
+def test_prefetcher_close_unblocks_infinite_producer():
+    def forever():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    pf = DevicePrefetcher(forever(), lambda b: b, depth=2)
+    assert next(pf) == 0
+    pf.close()
+    # the daemon thread must have exited (offer() observes the stop event)
+    deadline = time.monotonic() + 5
+    while pf._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not pf._thread.is_alive()
+    assert threading.active_count() < 50  # no thread pileup
+
+
+def test_prefetcher_rejects_bad_depth():
+    with pytest.raises(ValueError, match="depth"):
+        DevicePrefetcher(iter([]), lambda b: b, depth=0)
+
+
+def test_prefetcher_overlaps_host_work_with_consumer():
+    """The point of the pipeline: producer (host batch assembly) and
+    consumer (device step) run concurrently, so wall time approaches
+    max(gen, step) per item, not gen + step.  Timed with sleeps (no
+    device involved); margins are wide to tolerate scheduler jitter."""
+    gen_t, step_t, n = 0.03, 0.03, 10
+
+    def slow_batches():
+        for i in range(n):
+            time.sleep(gen_t)  # host-side assembly cost
+            yield i
+
+    t0 = time.monotonic()
+    pf = DevicePrefetcher(slow_batches(), lambda b: b, depth=2)
+    for _ in pf:
+        time.sleep(step_t)  # device step cost
+    overlapped = time.monotonic() - t0
+    pf.close()
+    serial = n * (gen_t + step_t)
+    # fully serial would be ~0.6s; overlapped should be ~0.33s — the 0.8
+    # threshold leaves ~150ms of slack for scheduler jitter
+    assert overlapped < serial * 0.8, (overlapped, serial)
+
+
+def _train(prefetch: int) -> dict:
+    cfg = TrainerConfig(model="mnist_mlp", steps=4, global_batch=16,
+                        log_every=4, seed=7, prefetch=prefetch,
+                        optimizer={"name": "adam", "learning_rate": 1e-3})
+    return Trainer(cfg).run()
+
+
+def test_trainer_prefetch_matches_sync_path():
+    """Same seed, same schedule: the async pipeline must not change a
+    single batch — final loss is bit-identical to the synchronous path."""
+    sync = _train(prefetch=0)
+    pre = _train(prefetch=2)
+    assert pre["final_loss"] == sync["final_loss"]
+    assert pre["steps"] == sync["steps"]
