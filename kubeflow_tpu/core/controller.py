@@ -17,6 +17,7 @@ reference's Go stack:
 
 from __future__ import annotations
 
+import ctypes
 import heapq
 import threading
 import time
@@ -119,6 +120,84 @@ class WorkQueue:
             self._lock.notify_all()
 
 
+class NativeWorkQueue:
+    """The same queue backed by the C++ engine (native/workqueue.cpp).
+
+    Same public surface and semantics as :class:`WorkQueue`; the blocking
+    ``get`` parks in native code with the GIL released, so N idle
+    controller workers cost no Python-level wakeups.  Keys round-trip
+    through a flat string: a leading '1' flags a cluster-scoped (None)
+    namespace, fields are joined by the unit separator.
+    """
+
+    _SEP = "\x1f"
+
+    def __init__(self) -> None:
+        from kubeflow_tpu.core.native import ENGINE
+
+        self._lib = ENGINE.lib
+        self._q = self._lib.kf_wq_new()
+        self._buf = ctypes.create_string_buffer(4096)
+
+    def _key(self, req: Request) -> bytes:
+        flag = "1" if req.namespace is None else "0"
+        return (flag + (req.namespace or "") + self._SEP
+                + req.name).encode()
+
+    @staticmethod
+    def _decode(raw: bytes) -> Request:
+        text = raw.decode()
+        ns, name = text[1:].split(NativeWorkQueue._SEP, 1)
+        return Request(None if text[0] == "1" else ns, name)
+
+    def add(self, req: Request, delay: float = 0.0) -> None:
+        self._lib.kf_wq_add(self._q, self._key(req), delay)
+
+    def add_rate_limited(self, req: Request) -> None:
+        self._lib.kf_wq_add_rate_limited(self._q, self._key(req))
+
+    def forget(self, req: Request) -> None:
+        self._lib.kf_wq_forget(self._q, self._key(req))
+
+    def get(self, timeout: float = 0.5) -> Request | None:
+        # buffer is per-queue and get() is called by one worker thread per
+        # controller; a second concurrent caller would need its own buffer
+        rc = self._lib.kf_wq_get(self._q, timeout, self._buf,
+                                 len(self._buf))
+        if rc <= 0:
+            if rc == -2:
+                raise RuntimeError("workqueue key exceeds buffer")
+            return None  # timeout or shutdown, like WorkQueue.get
+        return self._decode(self._buf.value)
+
+    def depth(self) -> int:
+        return self._lib.kf_wq_depth(self._q)
+
+    def due_now(self, horizon: float = 0.0) -> int:
+        return self._lib.kf_wq_due_now(self._q, horizon)
+
+    def shutdown(self) -> None:
+        self._lib.kf_wq_shutdown(self._q)
+
+    def __del__(self) -> None:
+        try:
+            self._lib.kf_wq_free(self._q)
+        except Exception:
+            pass
+
+
+def make_workqueue():
+    """Native C++ queue when the engine is buildable (the normal case);
+    pure-Python fallback otherwise or under KF_PURE_PYTHON_WORKQUEUE=1."""
+    import os
+
+    from kubeflow_tpu.core.native import ENGINE
+
+    if os.environ.get("KF_PURE_PYTHON_WORKQUEUE") != "1" and ENGINE.available:
+        return NativeWorkQueue()
+    return WorkQueue()
+
+
 class Controller:
     """Subclass contract:
 
@@ -176,7 +255,7 @@ class Manager:
 
     def add(self, controller: Controller) -> None:
         self.controllers.append(controller)
-        self._queues[controller.name] = WorkQueue()
+        self._queues[controller.name] = make_workqueue()
 
     def _watched_kinds(self) -> set[str]:
         kinds: set[str] = set()

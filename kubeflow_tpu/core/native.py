@@ -39,11 +39,24 @@ class _Engine:
         subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
                        capture_output=True, text=True)
 
+    @staticmethod
+    def _stale() -> bool:
+        """The .so must be rebuilt when missing or older than any source
+        (a prebuilt library from an older checkout lacks newer symbols)."""
+        if not os.path.exists(_SO_PATH):
+            return True
+        built = os.path.getmtime(_SO_PATH)
+        for name in ("engine.cpp", "workqueue.cpp", "json.hpp", "Makefile"):
+            src = os.path.join(_NATIVE_DIR, name)
+            if os.path.exists(src) and os.path.getmtime(src) > built:
+                return True
+        return False
+
     @property
     def lib(self) -> ctypes.CDLL:
         with self._lock:
             if self._lib is None:
-                if not os.path.exists(_SO_PATH):
+                if self._stale():
                     self._build()
                 lib = ctypes.CDLL(_SO_PATH)
                 for fn in ("kf_apply_poddefaults", "kf_filter_poddefaults",
@@ -53,6 +66,25 @@ class _Engine:
                                                  ctypes.c_char_p]
                 lib.kf_free.argtypes = [ctypes.c_void_p]
                 lib.kf_version.restype = ctypes.c_char_p
+                # workqueue ABI (blocking kf_wq_get releases the GIL —
+                # ctypes drops it for every foreign call)
+                lib.kf_wq_new.restype = ctypes.c_void_p
+                lib.kf_wq_free.argtypes = [ctypes.c_void_p]
+                lib.kf_wq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_double]
+                lib.kf_wq_add_rate_limited.argtypes = [ctypes.c_void_p,
+                                                       ctypes.c_char_p]
+                lib.kf_wq_forget.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p]
+                lib.kf_wq_get.restype = ctypes.c_int
+                lib.kf_wq_get.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                          ctypes.c_char_p, ctypes.c_int]
+                lib.kf_wq_depth.restype = ctypes.c_int
+                lib.kf_wq_depth.argtypes = [ctypes.c_void_p]
+                lib.kf_wq_due_now.restype = ctypes.c_int
+                lib.kf_wq_due_now.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_double]
+                lib.kf_wq_shutdown.argtypes = [ctypes.c_void_p]
                 self._lib = lib
             return self._lib
 
@@ -60,7 +92,8 @@ class _Engine:
     def available(self) -> bool:
         try:
             return self.lib is not None
-        except (OSError, subprocess.CalledProcessError):
+        except (OSError, subprocess.CalledProcessError, AttributeError):
+            # AttributeError = loaded library is missing expected symbols
             return False
 
     def version(self) -> str:

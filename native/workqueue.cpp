@@ -1,0 +1,173 @@
+// Native workqueue: the controller runtime's hot data structure.
+//
+// The reference's controller-runtime (Go) implements this as the
+// rate-limited delaying workqueue under every reconciler; here it is C++
+// behind a C ABI, driven from Python worker threads via ctypes (which
+// releases the GIL for the blocking get, so a parked worker costs nothing).
+//
+// Semantics (mirrors kubeflow_tpu/core/controller.py WorkQueue exactly):
+//  - add(key, delay): dedup — keep only the EARLIEST scheduled run per key;
+//    later duplicates are no-ops, earlier ones supersede (stale heap entries
+//    are skipped on pop).
+//  - add_rate_limited(key): per-key exponential failure backoff
+//    5ms * 2^n capped at 30s; forget(key) resets.
+//  - get(timeout): blocks until a key is due, the timeout lapses (returns
+//    0) or shutdown (returns -1).
+
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <tuple>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+double now_s() {
+    return std::chrono::duration<double>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+}
+
+constexpr double kBaseDelay = 0.005;
+constexpr double kMaxDelay = 30.0;
+
+struct Entry {
+    double when;
+    unsigned long long seq;
+    std::string key;
+    bool operator>(const Entry& o) const {
+        return std::tie(when, seq) > std::tie(o.when, o.seq);
+    }
+};
+
+class WorkQueue {
+  public:
+    void add(const std::string& key, double delay) {
+        const double when = now_s() + delay;
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = due_.find(key);
+        if (it != due_.end() && it->second <= when) return;
+        due_[key] = when;
+        heap_.push(Entry{when, ++seq_, key});
+        cv_.notify_all();
+    }
+
+    void add_rate_limited(const std::string& key) {
+        int n;
+        {
+            std::lock_guard<std::mutex> g(mu_);
+            n = failures_[key]++;
+        }
+        double delay = kBaseDelay;
+        for (int i = 0; i < n && delay < kMaxDelay; i++) delay *= 2;
+        if (delay > kMaxDelay) delay = kMaxDelay;
+        add(key, delay);
+    }
+
+    void forget(const std::string& key) {
+        std::lock_guard<std::mutex> g(mu_);
+        failures_.erase(key);
+    }
+
+    // 1 = key written to *out; 0 = timeout; -1 = shutdown
+    int get(double timeout, std::string* out) {
+        std::unique_lock<std::mutex> lk(mu_);
+        const double deadline = now_s() + timeout;
+        while (!shutdown_) {
+            const double now = now_s();
+            while (!heap_.empty() && heap_.top().when <= now) {
+                Entry e = heap_.top();
+                heap_.pop();
+                auto it = due_.find(e.key);
+                if (it == due_.end() || it->second != e.when)
+                    continue;  // superseded by an earlier reschedule
+                due_.erase(it);
+                *out = std::move(e.key);
+                return 1;
+            }
+            double wait = deadline - now;
+            if (!heap_.empty()) {
+                const double until_due = heap_.top().when - now;
+                if (until_due < wait) wait = until_due;
+            }
+            if (wait <= 0) return 0;
+            cv_.wait_for(lk, std::chrono::duration<double>(wait));
+        }
+        return -1;
+    }
+
+    int depth() {
+        std::lock_guard<std::mutex> g(mu_);
+        return static_cast<int>(due_.size());
+    }
+
+    int due_now(double horizon) {
+        const double cutoff = now_s() + horizon;
+        std::lock_guard<std::mutex> g(mu_);
+        int n = 0;
+        for (const auto& kv : due_)
+            if (kv.second <= cutoff) n++;
+        return n;
+    }
+
+    void shutdown() {
+        std::lock_guard<std::mutex> g(mu_);
+        shutdown_ = true;
+        cv_.notify_all();
+    }
+
+  private:
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap_;
+    std::unordered_map<std::string, double> due_;
+    std::unordered_map<std::string, int> failures_;
+    unsigned long long seq_ = 0;
+    bool shutdown_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kf_wq_new() { return new WorkQueue(); }
+
+void kf_wq_free(void* q) { delete static_cast<WorkQueue*>(q); }
+
+void kf_wq_add(void* q, const char* key, double delay) {
+    static_cast<WorkQueue*>(q)->add(key, delay);
+}
+
+void kf_wq_add_rate_limited(void* q, const char* key) {
+    static_cast<WorkQueue*>(q)->add_rate_limited(key);
+}
+
+void kf_wq_forget(void* q, const char* key) {
+    static_cast<WorkQueue*>(q)->forget(key);
+}
+
+// >0: length of key copied into out (NUL-terminated); 0: timeout;
+// -1: shutdown; -2: out buffer too small (key stays consumed — size the
+// buffer generously, keys are "<ns>/<name>")
+int kf_wq_get(void* q, double timeout, char* out, int cap) {
+    std::string key;
+    const int rc = static_cast<WorkQueue*>(q)->get(timeout, &key);
+    if (rc != 1) return rc;
+    if (static_cast<int>(key.size()) + 1 > cap) return -2;
+    std::memcpy(out, key.c_str(), key.size() + 1);
+    return static_cast<int>(key.size());
+}
+
+int kf_wq_depth(void* q) { return static_cast<WorkQueue*>(q)->depth(); }
+
+int kf_wq_due_now(void* q, double horizon) {
+    return static_cast<WorkQueue*>(q)->due_now(horizon);
+}
+
+void kf_wq_shutdown(void* q) { static_cast<WorkQueue*>(q)->shutdown(); }
+
+}  // extern "C"

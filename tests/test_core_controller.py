@@ -12,7 +12,12 @@ from kubeflow_tpu.core import (
     Result,
     api_object,
 )
-from kubeflow_tpu.core.controller import WorkQueue, acquire_lease
+from kubeflow_tpu.core.controller import (
+    NativeWorkQueue,
+    WorkQueue,
+    acquire_lease,
+    make_workqueue,
+)
 from kubeflow_tpu.core.objects import set_owner
 from kubeflow_tpu.core.store import NotFound
 
@@ -84,8 +89,23 @@ def test_preexisting_objects_reconciled_on_start():
         mgr.stop()
 
 
-def test_workqueue_dedup_and_backoff():
-    q = WorkQueue()
+@pytest.fixture(params=["python", "native"])
+def queue(request):
+    """Both workqueue implementations must satisfy identical semantics."""
+    if request.param == "python":
+        q = WorkQueue()
+    else:
+        from kubeflow_tpu.core.native import ENGINE
+
+        if not ENGINE.available:
+            pytest.skip("no native engine (compiler missing)")
+        q = NativeWorkQueue()
+    yield q
+    q.shutdown()
+
+
+def test_workqueue_dedup_and_backoff(queue):
+    q = queue
     r = Request("ns", "a")
     q.add(r)
     q.add(r)  # deduped while pending
@@ -96,8 +116,58 @@ def test_workqueue_dedup_and_backoff():
     t0 = time.monotonic()
     assert q.get(timeout=1.0) == r
     # second failure: delay doubled (>= BASE_DELAY * 2 from the first add)
-    assert time.monotonic() - t0 >= q.BASE_DELAY
-    q.shutdown()
+    assert time.monotonic() - t0 >= WorkQueue.BASE_DELAY
+
+
+def test_workqueue_earlier_add_supersedes(queue):
+    q = queue
+    r = Request("ns", "slow")
+    q.add(r, delay=5.0)
+    assert q.depth() == 1
+    q.add(r, delay=0.0)  # earlier schedule wins; later dupes are no-ops
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == r
+    assert time.monotonic() - t0 < 1.0
+    assert q.depth() == 0
+
+
+def test_workqueue_cluster_scoped_key_roundtrip(queue):
+    q = queue
+    r = Request(None, "cluster-profile")
+    q.add(r)
+    got = q.get(timeout=0.5)
+    assert got == r and got.namespace is None
+
+
+def test_workqueue_forget_resets_backoff(queue):
+    q = queue
+    r = Request("ns", "x")
+    for _ in range(8):
+        q.add_rate_limited(r)
+        assert q.get(timeout=5.0) == r
+    q.forget(r)
+    q.add_rate_limited(r)  # back to BASE_DELAY, not 2^8 * BASE_DELAY
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == r
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_workqueue_due_now_excludes_far_future(queue):
+    q = queue
+    q.add(Request("ns", "soon"), delay=0.0)
+    q.add(Request("ns", "later"), delay=60.0)
+    assert q.depth() == 2
+    assert q.due_now(horizon=1.0) == 1
+
+
+def test_make_workqueue_prefers_native(monkeypatch):
+    from kubeflow_tpu.core.native import ENGINE
+
+    monkeypatch.delenv("KF_PURE_PYTHON_WORKQUEUE", raising=False)
+    if ENGINE.available:
+        assert isinstance(make_workqueue(), NativeWorkQueue)
+    monkeypatch.setenv("KF_PURE_PYTHON_WORKQUEUE", "1")
+    assert isinstance(make_workqueue(), WorkQueue)
 
 
 def test_requeue_after():
