@@ -131,6 +131,9 @@ class NativeWorkQueue:
     """
 
     _SEP = "\x1f"
+    # mirrored from native/workqueue.cpp kBaseDelay/kMaxDelay
+    BASE_DELAY = WorkQueue.BASE_DELAY
+    MAX_DELAY = WorkQueue.MAX_DELAY
 
     def __init__(self) -> None:
         from kubeflow_tpu.core.native import ENGINE
@@ -138,6 +141,7 @@ class NativeWorkQueue:
         self._lib = ENGINE.lib
         self._q = self._lib.kf_wq_new()
         self._buf = ctypes.create_string_buffer(4096)
+        self._log = get_logger("native-workqueue")
 
     def _key(self, req: Request) -> bytes:
         flag = "1" if req.namespace is None else "0"
@@ -166,7 +170,10 @@ class NativeWorkQueue:
                                  len(self._buf))
         if rc <= 0:
             if rc == -2:
-                raise RuntimeError("workqueue key exceeds buffer")
+                # key longer than the buffer (no such names exist in a
+                # sane store) — drop it rather than kill the worker;
+                # get() never raises, matching WorkQueue's contract
+                self._log.error("dropped oversized workqueue key")
             return None  # timeout or shutdown, like WorkQueue.get
         return self._decode(self._buf.value)
 
@@ -246,7 +253,8 @@ class Manager:
                  identity: str = "manager-0"):
         self.server = server
         self.controllers: list[Controller] = []
-        self._queues: dict[str, WorkQueue] = {}
+        # WorkQueue or NativeWorkQueue — same surface (make_workqueue)
+        self._queues: dict[str, WorkQueue | NativeWorkQueue] = {}
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._leader_election = leader_election

@@ -44,7 +44,7 @@ TOKS_PER_SEC = REGISTRY.gauge("serving_tokens_per_sec",
                               "decode throughput, last window")
 
 PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
-DECODE_CHUNKS = (8, 32, 128)
+DECODE_CHUNKS = (8, 16, 32, 64, 128)
 
 
 @dataclass
@@ -152,6 +152,9 @@ class ContinuousBatcher:
 
     # -- compiled pieces -------------------------------------------------------
     def _prefill(self, bucket: int):
+        """One dispatch per admission: run the prompt, pick the logits at
+        the last REAL position, and sample the first token in the same
+        executable (separate index/sample dispatches cost tunnel RTTs)."""
         if bucket not in self._prefill_cache:
             from kubeflow_tpu.models import llama as llama_mod
 
@@ -159,10 +162,13 @@ class ContinuousBatcher:
                                           per_sequence=True)
 
             @jax.jit
-            def fn(params, ids):
+            def fn(params, ids, last_pos, temp, key):
                 out = self.module.apply({"params": params}, ids,
                                         cache=cache0)
-                return out["logits"], out["cache"]
+                logits = jax.lax.dynamic_index_in_dim(
+                    out["logits"][0], last_pos, axis=0, keepdims=False)
+                tok = _sample_rows(logits[None, :], temp[None], key[None, :])
+                return tok[0], out["cache"]
 
             self._prefill_cache[bucket] = fn
         return self._prefill_cache[bucket]
@@ -261,18 +267,15 @@ class ContinuousBatcher:
             bucket = min(bucket, self.max_seq)
             padded = req.ids + [0] * (bucket - prompt_len)
             arr = jnp.asarray([padded], jnp.int32)
-            logits, small_cache = self._prefill(bucket)(self.params, arr)
-            self.cache = self._insert()(self.cache, small_cache,
-                                        jnp.int32(free))
-            # first token comes from the last REAL prompt position; the
-            # request's own key chain starts at its seed
-            first_logits = logits[0, prompt_len - 1]
+            # the request's own key chain starts at its seed
             k_first, k_chain = jax.random.split(
                 jax.random.PRNGKey(req.seed))
-            tok = _sample_rows(first_logits[None, :],
-                               jnp.asarray([req.temperature], jnp.float32),
-                               k_first[None, :])
-            tok_host = int(tok[0])
+            tok, small_cache = self._prefill(bucket)(
+                self.params, arr, jnp.int32(prompt_len - 1),
+                jnp.float32(req.temperature), k_first)
+            self.cache = self._insert()(self.cache, small_cache,
+                                        jnp.int32(free))
+            tok_host = int(tok)
             req.first_token_at = time.perf_counter()
             TTFT_LAST.set(req.first_token_at - req.submitted_at)
             req.generated.append(tok_host)
@@ -292,11 +295,25 @@ class ContinuousBatcher:
                      for s in self.slots if s]
         if not remaining:
             return
-        if queue_empty:
-            chunk = next((c for c in reversed(DECODE_CHUNKS)
-                          if c <= min(remaining)), DECODE_CHUNKS[0])
+        # a waiting queue can only be admitted when a slot frees, and the
+        # earliest that happens is min(remaining) steps away — so decode
+        # right up to that point in one dispatch.  The exception is eos
+        # traffic: a request may finish mid-chunk, so keep chunks small to
+        # re-check while someone is waiting.
+        eos_active = any(s.eos_id is not None for s in self.slots if s)
+        if not queue_empty and eos_active:
+            chunk = DECODE_CHUNKS[0]
         else:
-            chunk = DECODE_CHUNKS[0]  # admit often while requests wait
+            # prefer ONE slightly-too-long dispatch over several short ones:
+            # overshoot rows are dropped and the cache index is restored
+            # from host truth, so <=25% wasted steps buys a saved sync
+            mn = min(remaining)
+            over = next((c for c in DECODE_CHUNKS if c >= mn), None)
+            if over is not None and over <= mn * 1.25:
+                chunk = over
+            else:
+                chunk = next((c for c in reversed(DECODE_CHUNKS)
+                              if c <= mn), DECODE_CHUNKS[0])
         t0 = time.perf_counter()
         toks, self.cache, self.keys = self._decode(chunk)(
             self.params, self.last_token, self.cache, self.index,
