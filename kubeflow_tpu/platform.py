@@ -104,6 +104,22 @@ def _register_optional(server, mgr, enable: set[str] | None) -> None:
         reg(server, mgr)
 
 
+def dev_identity_middleware(app, email: str):
+    """Plays the mesh/IAP for local development: injects the trusted
+    identity header (crud_backend.USERID_HEADER) into every request that
+    does not already carry one — the platform's auth layers then behave
+    exactly as they would behind Istio, CSRF included."""
+    # constants from the non-optional core module: --dev-identity must work
+    # even on a distribution without the webapps package
+    from kubeflow_tpu.core.httpapi import USERID_HEADER, USERID_PREFIX
+
+    def wrapped(environ, start_response):
+        environ.setdefault(USERID_HEADER, USERID_PREFIX + email)
+        return app(environ, start_response)
+
+    return wrapped
+
+
 def build_wsgi_app(server, *, secure_api: bool = True,
                    expose_webhook: bool = False):
     """One HTTP front door: /apis (REST), /kfam (access management), plus
@@ -164,6 +180,9 @@ def main(argv=None) -> int:
                         help="disable RBAC on raw /apis routes (dev only)")
     parser.add_argument("--bootstrap-admin", metavar="EMAIL",
                         help="grant cluster-admin to this user at startup")
+    parser.add_argument("--dev-identity", metavar="EMAIL",
+                        help="inject this identity header into every "
+                        "request (plays the mesh/IAP; local dev only)")
     args = parser.parse_args(argv)
 
     log = get_logger("platform")
@@ -180,9 +199,12 @@ def main(argv=None) -> int:
                 "roleRef": {"kind": "ClusterRole",
                             "name": "kubeflow-admin"}}))
     mgr.start()
-    httpd, _ = serve(build_wsgi_app(server,
-                                    secure_api=not args.insecure_api),
-                     args.port, args.host)
+    app = build_wsgi_app(server, secure_api=not args.insecure_api)
+    if args.dev_identity:
+        log.info("DEV MODE: injecting identity header for every request",
+                 identity=args.dev_identity)
+        app = dev_identity_middleware(app, args.dev_identity)
+    httpd, _ = serve(app, args.port, args.host)
     log.info("platform ready", port=args.port, executor=args.executor)
     print(f"kubeflow-tpu platform listening on "
           f"http://{args.host}:{args.port}", flush=True)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import http.cookies
 import json
+import os
 import re
 import secrets
 from typing import Any, Callable
@@ -54,7 +55,15 @@ class CrudApp:
     handlers(req) -> (status, body)."""
 
     prefix = ""  # mount prefix stripped before routing
-    app_disable_auth = False  # APP_DISABLE_AUTH escape hatch (dev mode)
+
+    @property
+    def app_disable_auth(self) -> bool:
+        """APP_DISABLE_AUTH escape hatch, env-wired like the reference's
+        crud_backend settings.py ("True"/"true"/"1" enables dev mode).
+        Read per-request so the security posture is never frozen at
+        import time."""
+        return os.environ.get("APP_DISABLE_AUTH", "").lower() in ("true",
+                                                                  "1")
 
     def __init__(self, server: APIServer):
         self.server = server

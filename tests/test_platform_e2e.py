@@ -137,3 +137,44 @@ def _phase_is(server, kind, name, ns, phase):
     except NotFound:
         return None
     return obj if obj.get("status", {}).get("phase") == phase else None
+
+
+def test_dev_identity_middleware(platform):
+    """--dev-identity plays the mesh: requests without the trusted header
+    get one injected; an explicit header wins (setdefault semantics)."""
+    import json
+    import urllib.request
+
+    from kubeflow_tpu.platform import dev_identity_middleware
+
+    server, mgr, base = platform
+    app = dev_identity_middleware(build_wsgi_app(server, secure_api=False),
+                                  "dev@local")
+    httpd, _ = serve(app, 0)
+    try:
+        b = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(
+                b + "/dashboard/api/workgroup/exists") as r:
+            assert json.load(r)["user"] == "dev@local"
+        req = urllib.request.Request(
+            b + "/dashboard/api/workgroup/exists",
+            headers={"X-Goog-Authenticated-User-Email":
+                     "accounts.google.com:real@corp.com"})
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["user"] == "real@corp.com"
+    finally:
+        httpd.shutdown()
+
+
+def test_app_disable_auth_env_wiring(monkeypatch):
+    """APP_DISABLE_AUTH env (reference crud_backend settings.py parity) is
+    read live per request, so the security posture is never frozen at
+    import time."""
+    from kubeflow_tpu.webapps.crud_backend import CrudApp
+
+    app = CrudApp(None)
+    assert app.app_disable_auth is False
+    monkeypatch.setenv("APP_DISABLE_AUTH", "True")
+    assert app.app_disable_auth is True
+    monkeypatch.setenv("APP_DISABLE_AUTH", "false")
+    assert app.app_disable_auth is False
