@@ -27,8 +27,9 @@ TRIAL_KIND = "Trial"
 def new(name: str, namespace: str, *, objective: dict | None = None,
         algorithm: dict | None = None, parameters: list[dict] | None = None,
         trial_template: dict | None = None, parallel_trials: int = 2,
-        max_trials: int = 8, max_failed_trials: int = 3) -> dict:
-    return api_object(KIND, name, namespace, spec={
+        max_trials: int = 8, max_failed_trials: int = 3,
+        early_stopping: dict | None = None) -> dict:
+    spec = {
         "objective": objective or {"type": "minimize",
                                    "metric": "final_loss"},
         "algorithm": algorithm or {"name": "bayesian"},
@@ -37,7 +38,14 @@ def new(name: str, namespace: str, *, objective: dict | None = None,
         "parallelTrials": parallel_trials,
         "maxTrials": max_trials,
         "maxFailedTrials": max_failed_trials,
-    })
+    }
+    if early_stopping is not None:
+        # {algorithm: medianstop, minTrials, startStep, type} — prunes
+        # trials whose intermediate metric trails the median (the Katib
+        # early-stopping service role; observations flow from the
+        # executor's log scraping)
+        spec["earlyStopping"] = early_stopping
+    return api_object(KIND, name, namespace, spec=spec)
 
 
 def validate(exp: dict) -> None:
@@ -50,6 +58,19 @@ def validate(exp: dict) -> None:
     algo = spec.get("algorithm", {}).get("name", "random")
     if algo not in ALGORITHMS:
         raise ValueError(f"unknown algorithm {algo!r}")
+    es = spec.get("earlyStopping")
+    if es is not None:
+        from kubeflow_tpu.hpo.early_stopping import (
+            ALGORITHMS as ES_ALGORITHMS)
+
+        if es.get("algorithm", "medianstop") not in ES_ALGORITHMS:
+            raise ValueError(
+                f"unknown earlyStopping algorithm "
+                f"{es.get('algorithm')!r}; known: {ES_ALGORITHMS}")
+        if int(es.get("minTrials", 3)) < 1:
+            raise ValueError("earlyStopping.minTrials must be >= 1")
+        if int(es.get("startStep", 1)) < 0:
+            raise ValueError("earlyStopping.startStep must be >= 0")
 
 
 def substitute(template: Any, assignment: dict[str, Any]) -> Any:

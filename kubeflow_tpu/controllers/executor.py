@@ -38,10 +38,16 @@ class FakeExecutor(Controller):
 
     def __init__(self, server, *, fail_once: set[str] | None = None,
                  always_fail: set[str] | None = None,
-                 complete: bool = True, run_for: float = 0.0):
+                 complete: bool = True, run_for: float = 0.0,
+                 metrics_script: dict[str, list[dict]] | None = None):
         super().__init__(server)
         self.fail_once = set(fail_once or ())
         self.always_fail = set(always_fail or ())
+        # pod name -> metrics dicts surfaced one per reconcile while
+        # Running (deterministic stand-in for the LocalExecutor's log
+        # scraping; exercises intermediate-metric consumers)
+        self.metrics_script = {k: list(v)
+                               for k, v in (metrics_script or {}).items()}
         # complete=False models long-running servers (notebooks,
         # tensorboards): pods stay Running instead of finishing
         self.complete = complete
@@ -66,6 +72,13 @@ class FakeExecutor(Controller):
             return Result(requeue_after=0.01)
         if phase == "Running":
             name = req.name
+            script = self.metrics_script.get(name)
+            if script:
+                self.server.patch_status(
+                    "Pod", req.name, req.namespace,
+                    {**pod.get("status", {}), "phase": "Running",
+                     "metrics": script.pop(0)})
+                return Result(requeue_after=0.01)
             if not self.complete and name not in self.always_fail and (
                     name not in self.fail_once):
                 return None
@@ -163,6 +176,31 @@ class LocalExecutor(Controller):
                 if self._procs.get(key, ("",))[0] == uid:
                     self._procs.pop(key, None)
 
+    # metric keys lifted from a worker's structured "train" log records
+    # into pod status.metrics (the Katib metrics-collector sidecar pattern,
+    # scraping logs — here the executor IS the sidecar)
+    METRIC_KEYS = ("step", "loss", "samples_per_sec")
+
+    def _scrape_metrics(self, md: dict, uid: str, line: str) -> None:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return
+        if not isinstance(rec, dict) or rec.get("msg") != "train":
+            return
+        metrics = {k: rec[k] for k in self.METRIC_KEYS if k in rec}
+        if "step" not in metrics:
+            return
+        try:
+            current = self.server.get("Pod", md["name"], md.get("namespace"))
+            if current["metadata"]["uid"] == uid:
+                self.server.patch_status(
+                    "Pod", md["name"], md.get("namespace"),
+                    {**current.get("status", {}), "phase": "Running",
+                     "metrics": metrics})
+        except (NotFound, Conflict):
+            pass
+
     def _run_inner(self, pod: dict, key: tuple, uid: str) -> None:
         md = pod["metadata"]
         container = pod["spec"]["containers"][0]
@@ -187,12 +225,34 @@ class LocalExecutor(Controller):
                 proc.kill()
                 proc.communicate()
                 return
+            # drain both pipes concurrently (no pipe-full deadlock); the
+            # stderr drain doubles as the live metrics collector
+            out_lines: list[str] = []
+            err_lines: list[str] = []
+
+            def drain_stdout() -> None:
+                for line in proc.stdout:
+                    out_lines.append(line)
+
+            def drain_stderr() -> None:
+                for line in proc.stderr:
+                    err_lines.append(line)
+                    self._scrape_metrics(md, uid, line)
+
+            drains = [threading.Thread(target=drain_stdout, daemon=True),
+                      threading.Thread(target=drain_stderr, daemon=True)]
+            for t in drains:
+                t.start()
             try:
-                stdout, stderr = proc.communicate(timeout=self.timeout)
+                proc.wait(timeout=self.timeout)
             except subprocess.TimeoutExpired:
                 proc.kill()
-                proc.communicate()
+                proc.wait()
                 raise
+            finally:
+                for t in drains:
+                    t.join(timeout=5.0)
+            stdout, stderr = "".join(out_lines), "".join(err_lines)
             for line in reversed(stdout.strip().splitlines()):
                 try:
                     result = json.loads(line)
@@ -211,6 +271,9 @@ class LocalExecutor(Controller):
         try:
             current = self.server.get("Pod", md["name"], md.get("namespace"))
             if current["metadata"]["uid"] == uid:
+                scraped = current.get("status", {}).get("metrics")
+                if scraped is not None:
+                    status.setdefault("metrics", scraped)
                 self.server.patch_status("Pod", md["name"],
                                          md.get("namespace"), status)
         except (NotFound, Conflict):

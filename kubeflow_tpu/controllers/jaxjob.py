@@ -72,6 +72,12 @@ class JAXJobController(Controller):
         phases = [p.get("status", {}).get("phase", "Pending") for p in pods]
         ready = sum(1 for ph in phases if ph in ("Running", "Succeeded"))
         status["workers"] = {"ready": ready, "total": gang_size}
+        if pods:
+            # live training metrics scraped from worker-0's logs by the
+            # executor (the metrics-collector path HPO early stopping reads)
+            scraped = pods[0].get("status", {}).get("metrics")
+            if scraped is not None:
+                status["metrics"] = scraped
 
         if any(ph == "Failed" for ph in phases):
             restarts = int(status.get("restarts", 0))

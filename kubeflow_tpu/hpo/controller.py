@@ -50,16 +50,30 @@ class ExperimentController(Controller):
 
         done = [t for t in trials
                 if t.get("status", {}).get("phase") in ("Succeeded",
-                                                        "Failed")]
+                                                        "Failed",
+                                                        "EarlyStopped")]
         succeeded = [t for t in done
                      if t["status"]["phase"] == "Succeeded"]
         failed = [t for t in done if t["status"]["phase"] == "Failed"]
+        stopped = [t for t in done
+                   if t["status"]["phase"] == "EarlyStopped"]
         running = [t for t in trials if t not in done]
 
         maximize = spec["objective"]["type"] == "maximize"
+        # early-stopped trials contribute their last observation to the
+        # suggester's history, as Katib's do — but ONLY when the
+        # intermediate metric's direction matches the objective's
+        # (a stopped trial's loss must never enter a maximize-accuracy
+        # comparison as if it were an accuracy)
+        es = spec.get("earlyStopping") or {}
+        es_max = es.get("type", "minimize") == "maximize"
+        observed = succeeded + (stopped if es and es_max == maximize
+                                else [])
         history = [(t["spec"]["assignment"], float(t["status"]["objective"]))
-                   for t in succeeded
+                   for t in observed
                    if t.get("status", {}).get("objective") is not None]
+
+        running = self._apply_early_stopping(exp, running, trials)
 
         # terminal checks
         goal = spec["objective"].get("goal")
@@ -94,7 +108,7 @@ class ExperimentController(Controller):
             self.server.patch_status(api.KIND, req.name, req.namespace,
                                      status)
             return None
-        if len(succeeded) >= int(spec.get("maxTrials", 8)):
+        if len(succeeded) + len(stopped) >= int(spec.get("maxTrials", 8)):
             status["phase"] = "Succeeded"
             set_condition(exp, "Complete", "True", reason="MaxTrialsReached")
             status.update(self._summary(trials, history, maximize, exp=exp))
@@ -126,6 +140,62 @@ class ExperimentController(Controller):
         self.server.patch_status(api.KIND, req.name, req.namespace, status)
         return None
 
+    def _apply_early_stopping(self, exp: dict, running: list[dict],
+                              trials: list[dict]) -> list[dict]:
+        """Median-stop pruning over the running trials' intermediate
+        observations; stopped trials free their slice (JAXJob deleted) and
+        become EarlyStopped with their last observation as the objective.
+        Returns the trials still running.
+
+        Ordering matters: the trial is marked EarlyStopped BEFORE its
+        JAXJob is deleted so a concurrently-reconciling TrialController
+        that finds the job missing re-reads the trial, sees the terminal
+        phase, and does not resurrect the gang."""
+        es = exp["spec"].get("earlyStopping")
+        if not es:
+            return running
+        from kubeflow_tpu.core.events import record_event
+        from kubeflow_tpu.hpo import early_stopping as es_mod
+
+        # the intermediate metric's direction may differ from the final
+        # objective's (es["type"] overrides; default: lower loss is better)
+        es_max = es.get("type", "minimize") == "maximize"
+        min_trials = int(es.get("minTrials", 3))
+        start_step = int(es.get("startStep", 1))
+        ns = exp["metadata"]["namespace"]
+        all_inter = {t["metadata"]["name"]:
+                     (t.get("status", {}).get("intermediate") or [])
+                     for t in trials}
+        survivors = []
+        for t in running:
+            name = t["metadata"]["name"]
+            mine = all_inter.get(name) or []
+            others = [v for k, v in all_inter.items() if k != name and v]
+            if es_mod.medianstop_should_stop(
+                    mine, others, maximize=es_max,
+                    min_trials=min_trials, start_step=start_step):
+                last = mine[-1]
+                status = dict(t.get("status") or {})
+                status.update(phase="EarlyStopped",
+                              objective=last["value"],
+                              stoppedAtStep=last["step"])
+                try:
+                    self.server.patch_status(api.TRIAL_KIND, name, ns,
+                                             status)
+                except NotFound:
+                    continue
+                try:
+                    self.server.delete(jaxjob_api.KIND, name, ns)
+                except NotFound:
+                    pass
+                TRIALS_TOTAL.labels("early_stopped").inc()
+                record_event(self.server, exp, "Normal", "TrialEarlyStopped",
+                             f"{name} stopped at step {last['step']}: "
+                             f"{last['value']} worse than median")
+            else:
+                survivors.append(t)
+        return survivors
+
     def _suggester(self, exp: dict, history):
         spec = exp["spec"]
         space = SearchSpace(spec.get("parameters", []))
@@ -143,6 +213,9 @@ class ExperimentController(Controller):
             "trialsFailed": sum(
                 1 for t in trials
                 if t.get("status", {}).get("phase") == "Failed"),
+            "trialsEarlyStopped": sum(
+                1 for t in trials
+                if t.get("status", {}).get("phase") == "EarlyStopped"),
             "conditions": (exp or {}).get("status", {}).get("conditions",
                                                             []),
         }
@@ -164,10 +237,12 @@ class TrialController(Controller):
         if trial["metadata"].get("deletionTimestamp"):
             return None
         status = dict(trial.get("status") or {})
-        if status.get("phase") in ("Succeeded", "Failed"):
+        if status.get("phase") in ("Succeeded", "Failed", "EarlyStopped"):
             return None
 
         job = self._ensure_job(trial)
+        if job is None:
+            return None  # trial went terminal while we looked (early stop)
         jphase = job.get("status", {}).get("phase", "Pending")
         if jphase == "Succeeded":
             result = job.get("status", {}).get("result") or {}
@@ -181,16 +256,48 @@ class TrialController(Controller):
             TRIALS_TOTAL.labels("failed").inc()
         else:
             status["phase"] = "Running"
+            # accumulate intermediate observations from the scraped
+            # training metrics (the early-stopping input)
+            metrics = job.get("status", {}).get("metrics")
+            metric = trial["spec"].get("intermediateMetric", "loss")
+            if metrics and metric in metrics and "step" in metrics:
+                inter = list(status.get("intermediate") or [])
+                step = int(metrics["step"])
+                if not inter or inter[-1]["step"] < step:
+                    inter.append({"step": step,
+                                  "value": float(metrics[metric])})
+                    status["intermediate"] = inter
+        # the experiment controller may have early-stopped this trial since
+        # we read it; a stale Running patch must not overwrite the terminal
+        # phase (level-triggered convergence: a lost race here is caught on
+        # the next event anyway, this check just closes the common window)
+        try:
+            fresh = self.server.get(api.TRIAL_KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if fresh.get("status", {}).get("phase") in ("Succeeded", "Failed",
+                                                    "EarlyStopped"):
+            return None
         self.server.patch_status(api.TRIAL_KIND, req.name, req.namespace,
                                  status)
         return None
 
-    def _ensure_job(self, trial: dict) -> dict:
+    def _ensure_job(self, trial: dict) -> dict | None:
+        """The trial's JAXJob, created if missing — unless the trial has
+        gone terminal in the meantime (EarlyStopped deletes the job; a
+        stale create here would re-occupy the slice it just freed)."""
         name = trial["metadata"]["name"]
         ns = trial["metadata"]["namespace"]
         try:
             return self.server.get(jaxjob_api.KIND, name, ns)
         except NotFound:
+            try:
+                fresh = self.server.get(api.TRIAL_KIND, name, ns)
+            except NotFound:
+                return None
+            if fresh.get("status", {}).get("phase") in (
+                    "Succeeded", "Failed", "EarlyStopped"):
+                return None
             job = jaxjob_api.new(
                 name, ns,
                 topology=trial["spec"].get("topology", "v5e-1"),

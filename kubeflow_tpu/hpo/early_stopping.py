@@ -1,0 +1,50 @@
+"""Early stopping for HPO trials (the Katib early-stopping service role).
+
+Median stopping rule: a trial is stopped when its best intermediate
+objective so far is worse than the median of the other trials'
+best-so-far values at a comparable step.  Observations arrive through
+the metrics-collector path (executor scrapes worker logs -> pod
+status.metrics -> JAXJob status.metrics -> Trial status.intermediate),
+mirroring how Katib's sidecar scrapes trial logs.
+
+A stopped trial frees its TPU slice immediately — on preemptible-slice
+economics that is the entire value of early stopping.
+"""
+
+from __future__ import annotations
+
+import statistics
+
+ALGORITHMS = ("medianstop",)
+
+
+def best_so_far(intermediate: list[dict], step: int, *,
+                maximize: bool) -> float | None:
+    """Best observed value at any step <= ``step`` (None if unobserved)."""
+    vals = [o["value"] for o in intermediate if o["step"] <= step]
+    if not vals:
+        return None
+    return max(vals) if maximize else min(vals)
+
+
+def medianstop_should_stop(trial_inter: list[dict],
+                           others_inter: list[list[dict]], *,
+                           maximize: bool, min_trials: int = 3,
+                           start_step: int = 1) -> bool:
+    """True when the trial's best-so-far is strictly worse than the median
+    of >= ``min_trials`` other trials' best-so-far at the same step."""
+    if not trial_inter:
+        return False
+    step = max(o["step"] for o in trial_inter)
+    if step < start_step:
+        return False
+    mine = best_so_far(trial_inter, step, maximize=maximize)
+    pool = []
+    for other in others_inter:
+        val = best_so_far(other, step, maximize=maximize)
+        if val is not None:
+            pool.append(val)
+    if len(pool) < min_trials:
+        return False
+    med = statistics.median(pool)
+    return mine < med if maximize else mine > med

@@ -1,0 +1,230 @@
+"""HPO early stopping: metrics-collector path + median stopping rule.
+
+Mirrors Katib's early-stopping architecture: trial logs are scraped into
+metrics (executor = the sidecar), mirrored up pod -> JAXJob -> Trial, and
+the experiment controller prunes trials trailing the median.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api import experiment as exp_api
+from kubeflow_tpu.api import jaxjob as jaxjob_api
+from kubeflow_tpu.controllers.executor import FakeExecutor, LocalExecutor
+from kubeflow_tpu.controllers.jaxjob import JAXJobController
+from kubeflow_tpu.core import APIServer, Manager
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.hpo.controller import (
+    ExperimentController,
+    TrialController,
+)
+from kubeflow_tpu.hpo.early_stopping import medianstop_should_stop
+from tests.conftest import poll_until
+
+
+def obs(*pairs):
+    return [{"step": s, "value": v} for s, v in pairs]
+
+
+# ------------------------------------------------------------ rule math ----
+def test_medianstop_prunes_trailing_trial():
+    mine = obs((1, 9.0), (2, 8.8))          # barely improving loss
+    others = [obs((1, 5.0), (2, 3.0)), obs((1, 6.0), (2, 4.0)),
+              obs((1, 5.5), (2, 3.5))]
+    assert medianstop_should_stop(mine, others, maximize=False,
+                                  min_trials=3, start_step=2)
+
+
+def test_medianstop_keeps_leader_and_respects_min_trials():
+    lead = obs((1, 2.0), (2, 1.0))
+    others = [obs((1, 5.0), (2, 3.0)), obs((1, 6.0), (2, 4.0)),
+              obs((1, 5.5), (2, 3.5))]
+    assert not medianstop_should_stop(lead, others, maximize=False,
+                                      min_trials=3, start_step=1)
+    # too few comparison trials: never stop
+    assert not medianstop_should_stop(obs((2, 99.0)), others[:2],
+                                      maximize=False, min_trials=3,
+                                      start_step=1)
+
+
+def test_medianstop_start_step_gate():
+    mine = obs((1, 99.0))
+    others = [obs((1, 1.0))] * 5
+    assert not medianstop_should_stop(mine, others, maximize=False,
+                                      min_trials=3, start_step=2)
+
+
+def test_medianstop_uses_best_so_far_not_last():
+    # latest reading regressed but best-so-far still leads the median
+    mine = obs((1, 1.0), (2, 6.0))
+    others = [obs((2, 3.0)), obs((2, 4.0)), obs((2, 5.0))]
+    assert not medianstop_should_stop(mine, others, maximize=False,
+                                      min_trials=3, start_step=1)
+
+
+# -------------------------------------------------- controller pipeline ----
+@pytest.fixture()
+def stack():
+    server = APIServer()
+    server.register_validating_hook(
+        lambda o: exp_api.validate(o)
+        if o.get("kind") == exp_api.KIND else None)
+    mgr = Manager(server)
+    mgr.add(ExperimentController(server))
+    mgr.add(TrialController(server))
+    mgr.add(JAXJobController(server))
+    yield server, mgr
+    mgr.stop()
+
+
+def test_trailing_trial_early_stopped_and_slice_freed(stack):
+    """4 parallel trials, one clearly bad mid-flight: it is EarlyStopped,
+    its JAXJob (slice) is deleted, the experiment still completes, and the
+    bad trial's observation lands in history/status."""
+    server, mgr = stack
+    # worker pods emit scripted metrics; trial-000 is the laggard
+    script = {}
+    for i in range(4):
+        pod = jaxjob_api.worker_pod_name(f"es-exp-trial-{i}", 0)
+        # healthy trials share one trajectory: the median equals their own
+        # value, so strict-worse-than-median isolates exactly the laggard
+        vals = [9.0, 8.9, 8.8] if i == 0 else [5.0, 3.0, 1.0]
+        script[pod] = [{"step": s + 1, "loss": v,
+                        "samples_per_sec": 100.0}
+                       for s, v in enumerate(vals)]
+    # run_for keeps pods Running after their script drains so the
+    # metrics chain (pod -> job -> trial -> experiment) has time to
+    # propagate and the pruning pass fires before natural completion
+    mgr.add(FakeExecutor(server, metrics_script=script, run_for=1.5))
+    mgr.start()
+
+    exp = exp_api.new(
+        "es-exp", "hpo",
+        objective={"type": "minimize", "metric": "final_loss"},
+        algorithm={"name": "random"},
+        parameters=[{"name": "lr", "type": "double",
+                     "min": 1e-4, "max": 1e-1}],
+        parallel_trials=4, max_trials=4,
+        early_stopping={"algorithm": "medianstop", "minTrials": 3,
+                        "startStep": 2})
+    server.create(exp)
+
+    done = poll_until(lambda: (
+        lambda e: e if e.get("status", {}).get("phase") in
+        ("Succeeded", "Failed") else None)(
+        server.get(exp_api.KIND, "es-exp", "hpo")), timeout=30)
+    assert done["status"]["phase"] == "Succeeded", done["status"]
+    assert done["status"]["trialsEarlyStopped"] == 1
+
+    t0 = server.get(exp_api.TRIAL_KIND, "es-exp-trial-0", "hpo")
+    assert t0["status"]["phase"] == "EarlyStopped"
+    assert t0["status"]["objective"] == pytest.approx(8.8)
+    assert t0["status"]["stoppedAtStep"] >= 2
+    # the laggard's JAXJob is gone: its slice was freed early
+    with pytest.raises(NotFound):
+        server.get(jaxjob_api.KIND, "es-exp-trial-0", "hpo")
+    # survivors finished normally and best comes from them
+    best = done["status"]["bestTrial"]
+    assert best["objective"] < 8.8
+
+
+def test_stopped_loss_never_pollutes_maximize_objective(stack):
+    """A stopped trial's objective is its intermediate LOSS; when the
+    experiment maximizes a different metric, that loss must stay out of
+    the goal check and bestTrial (else a large loss reads as a great
+    score and falsely completes the experiment)."""
+    server, mgr = stack
+    script = {}
+    for i in range(3):
+        pod = jaxjob_api.worker_pod_name(f"mix-trial-{i}", 0)
+        # laggard's losses are HUGE: if they leaked into the maximize
+        # history they would beat goal=200 instantly
+        vals = [9000.0, 9000.0] if i == 0 else [5.0, 3.0]
+        script[pod] = [{"step": s + 1, "loss": v, "samples_per_sec": 100.0}
+                       for s, v in enumerate(vals)]
+    mgr.add(FakeExecutor(server, metrics_script=script, run_for=1.5))
+    mgr.start()
+    exp = exp_api.new(
+        "mix", "hpo",
+        objective={"type": "maximize", "metric": "samples_per_sec",
+                   "goal": 200.0},
+        algorithm={"name": "random"},
+        parameters=[{"name": "lr", "type": "double",
+                     "min": 1e-4, "max": 1e-1}],
+        parallel_trials=3, max_trials=3,
+        early_stopping={"algorithm": "medianstop", "minTrials": 2,
+                        "startStep": 2})
+    server.create(exp)
+    done = poll_until(lambda: (
+        lambda e: e if e.get("status", {}).get("phase") in
+        ("Succeeded", "Failed") else None)(
+        server.get(exp_api.KIND, "mix", "hpo")), timeout=30)
+    # goal 200 was never truly reached: completion must come from
+    # maxTrials, and bestTrial must be a real samples_per_sec, not a loss
+    conds = {c["type"]: c for c in done["status"]["conditions"]}
+    assert conds["Complete"]["reason"] == "MaxTrialsReached", conds
+    assert done["status"]["bestTrial"]["objective"] == pytest.approx(100.0)
+
+
+def test_experiment_without_early_stopping_unaffected(stack):
+    server, mgr = stack
+    mgr.add(FakeExecutor(server))
+    mgr.start()
+    exp = exp_api.new("plain", "hpo",
+                      objective={"type": "minimize",
+                                 "metric": "final_loss"},
+                      algorithm={"name": "random"},
+                      parameters=[{"name": "lr", "type": "double",
+                                   "min": 1e-4, "max": 1e-1}],
+                      parallel_trials=2, max_trials=2)
+    server.create(exp)
+    done = poll_until(lambda: (
+        lambda e: e if e.get("status", {}).get("phase") in
+        ("Succeeded", "Failed") else None)(
+        server.get(exp_api.KIND, "plain", "hpo")), timeout=30)
+    assert done["status"]["phase"] == "Succeeded"
+    assert done["status"]["trialsEarlyStopped"] == 0
+
+
+def test_invalid_early_stopping_rejected(stack):
+    server, _ = stack
+    with pytest.raises(ValueError, match="earlyStopping algorithm"):
+        server.create(exp_api.new(
+            "bad", "hpo", parameters=[],
+            early_stopping={"algorithm": "psychic"}))
+
+
+# ------------------------------------------------------- real scraping ----
+def test_local_executor_scrapes_training_logs(tmp_path):
+    """The metrics-collector path end to end with a REAL subprocess: the
+    executor scrapes structured train records from worker stderr into pod
+    status.metrics, and the JAXJob mirrors worker-0's metrics."""
+    server = APIServer()
+    server.register_validating_hook(
+        lambda o: jaxjob_api.validate(o)
+        if o.get("kind") == jaxjob_api.KIND else None)
+    mgr = Manager(server)
+    mgr.add(JAXJobController(server))
+    mgr.add(LocalExecutor(server, extra_env={
+        "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "", "JAXJOB_COORDINATOR": ""}))
+    mgr.start()
+    try:
+        job = jaxjob_api.new(
+            "scrape", "ml", topology="v5e-1",
+            trainer={"model": "mnist_mlp", "steps": 6, "global_batch": 16,
+                     "log_every": 2,
+                     "optimizer": {"name": "adam", "learning_rate": 1e-3}})
+        server.create(job)
+        done = poll_until(lambda: (
+            lambda j: j if j.get("status", {}).get("phase") in
+            ("Succeeded", "Failed") else None)(
+            server.get(jaxjob_api.KIND, "scrape", "ml")), timeout=180)
+        assert done["status"]["phase"] == "Succeeded", done["status"]
+        metrics = done["status"].get("metrics")
+        assert metrics is not None, "no metrics were scraped"
+        assert metrics["step"] == 6  # the last train record (log_every=2)
+        assert metrics["loss"] == metrics["loss"]
+    finally:
+        mgr.stop()
