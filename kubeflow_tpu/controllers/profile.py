@@ -36,6 +36,28 @@ class ProfilePlugin:
         raise NotImplementedError
 
 
+WORKLOAD_SAS = ("default-editor", "default-viewer")
+
+
+def annotate_namespace_sas(server, ns: str, key: str,
+                           value: str | None) -> None:
+    """Set (or remove, when ``value`` is None) an annotation on the
+    namespace's workload service accounts — the shared move both cloud
+    identity plugins make."""
+    for sa_name in WORKLOAD_SAS:
+        try:
+            sa = server.get("ServiceAccount", sa_name, ns)
+        except NotFound:
+            continue
+        ann = sa["metadata"].setdefault("annotations", {})
+        if value is None:
+            if ann.pop(key, None) is not None:
+                server.update(sa)
+        elif ann.get(key) != value:
+            ann[key] = value
+            server.update(sa)
+
+
 class TpuWorkloadIdentity(ProfilePlugin):
     """GcpWorkloadIdentity analog: annotate the namespace service accounts so
     TPU-VM workloads impersonate the team's cloud identity."""
@@ -43,32 +65,162 @@ class TpuWorkloadIdentity(ProfilePlugin):
     kind = "TpuWorkloadIdentity"
 
     def apply(self, server, profile, spec):
-        gsa = spec.get("serviceAccount", "")
-        ns = profile["metadata"]["name"]
-        for sa_name in ("default-editor", "default-viewer"):
+        annotate_namespace_sas(server, profile["metadata"]["name"],
+                               "iam.gke.io/gcp-service-account",
+                               spec.get("serviceAccount", ""))
+
+    def revoke(self, server, profile, spec):
+        annotate_namespace_sas(server, profile["metadata"]["name"],
+                               "iam.gke.io/gcp-service-account", None)
+
+
+def irsa_subject(namespace: str, sa_name: str) -> str:
+    return f"system:serviceaccount:{namespace}:{sa_name}"
+
+
+def add_trust_statement(doc: dict, provider: str,
+                        sub: str) -> tuple[dict, bool]:
+    """Add an IRSA web-identity statement for ``sub`` to a trust-policy
+    document (idempotent) — the doc-rewriting plugin_iam.go:68-120 does
+    against live AWS IAM, here as a pure function."""
+    issuer = provider.split("oidc-provider/", 1)[-1]
+    stmts = list(doc.get("Statement", []))
+    want = {
+        "Effect": "Allow",
+        "Principal": {"Federated": provider},
+        "Action": "sts:AssumeRoleWithWebIdentity",
+        "Condition": {"StringEquals": {f"{issuer}:sub": sub}},
+    }
+    if want in stmts:
+        return doc, False
+    return {**doc, "Version": doc.get("Version", "2012-10-17"),
+            "Statement": stmts + [want]}, True
+
+
+def remove_trust_statement(doc: dict, provider: str,
+                           sub: str) -> tuple[dict, bool]:
+    """Drop the IRSA statement for ``sub``; unrelated statements survive."""
+    issuer = provider.split("oidc-provider/", 1)[-1]
+    stmts = doc.get("Statement", [])
+    kept = [s for s in stmts
+            if not (s.get("Principal", {}).get("Federated") == provider
+                    and s.get("Condition", {}).get("StringEquals", {})
+                    .get(f"{issuer}:sub") == sub)]
+    if len(kept) == len(stmts):
+        return doc, False
+    return {**doc, "Statement": kept}, True
+
+
+def iam_role_name(arn: str) -> str:
+    """Store-object name for an IAM role ARN: readable tail + a digest of
+    the FULL arn (distinct accounts/paths/cases must never collide)."""
+    import hashlib
+
+    tail = arn.rsplit("/", 1)[-1].lower()
+    return f"{tail}-{hashlib.sha256(arn.encode()).hexdigest()[:8]}"
+
+
+class AwsIamForServiceAccount(ProfilePlugin):
+    """AwsIAMForServiceAccount analog (plugin_iam.go:21-50): annotate the
+    namespace service accounts with the IAM role ARN (EKS IRSA) and add
+    web-identity statements to the role's trust policy so those SAs can
+    assume it.  The cloud IAM role materializes as a cluster-scoped
+    ``IamRole`` store object — the same external-state modeling the rest
+    of this platform uses, which keeps the doc-rewriting testable exactly
+    the way the reference tests it (no AWS calls).
+
+    The last-applied (arn, provider) pair is recorded in a profile
+    annotation so editing the spec revokes the OLD role's statements
+    before granting on the new one — without this, changing awsIamRole
+    would leave the namespace trusted on the previous role forever."""
+
+    kind = "AwsIamForServiceAccount"
+    ROLE_ANNOTATION = "eks.amazonaws.com/role-arn"
+    APPLIED_ANNOTATION = "aws-iam.kubeflow.org/applied"
+    DEFAULT_PROVIDER = ("arn:aws:iam::000000000000:oidc-provider/"
+                        "oidc.eks.example.com/id/KFTPU")
+
+    def _role_object(self, server, arn: str) -> dict:
+        name = iam_role_name(arn)
+        try:
+            return server.get("IamRole", name, None)
+        except NotFound:
+            return server.create(api_object(
+                "IamRole", name, None,
+                spec={"arn": arn, "trustPolicy":
+                      {"Version": "2012-10-17", "Statement": []}}))
+
+    def _edit_statements(self, server, ns: str, arn: str, provider: str,
+                         add: bool) -> None:
+        if add:
+            role = self._role_object(server, arn)
+        else:
             try:
-                sa = server.get("ServiceAccount", sa_name, ns)
+                role = server.get("IamRole", iam_role_name(arn), None)
             except NotFound:
-                continue
-            ann = sa["metadata"].setdefault("annotations", {})
-            if ann.get("iam.gke.io/gcp-service-account") != gsa:
-                ann["iam.gke.io/gcp-service-account"] = gsa
-                server.update(sa)
+                return
+        doc = role["spec"]["trustPolicy"]
+        edit = add_trust_statement if add else remove_trust_statement
+        changed_any = False
+        for sa_name in WORKLOAD_SAS:
+            doc, changed = edit(doc, provider, irsa_subject(ns, sa_name))
+            changed_any = changed_any or changed
+        if changed_any:
+            role["spec"]["trustPolicy"] = doc
+            server.update(role)
+
+    def _applied(self, profile: dict) -> dict | None:
+        import json
+
+        raw = profile["metadata"].get("annotations", {}).get(
+            self.APPLIED_ANNOTATION)
+        return json.loads(raw) if raw else None
+
+    def apply(self, server, profile, spec):
+        import json
+
+        arn = spec.get("awsIamRole", "")
+        if not arn:
+            raise ValueError("AwsIamForServiceAccount needs awsIamRole")
+        provider = spec.get("oidcProviderArn", self.DEFAULT_PROVIDER)
+        annotate_only = bool(spec.get("annotateOnly"))
+        ns = profile["metadata"]["name"]
+
+        prev = self._applied(profile)
+        cur = {"arn": arn, "provider": provider,
+               "annotateOnly": annotate_only}
+        if prev and not prev.get("annotateOnly") and (
+                prev["arn"] != arn or prev["provider"] != provider
+                or annotate_only):
+            # the grant moved (or statements are no longer wanted):
+            # revoke from the PREVIOUS role before granting anew
+            self._edit_statements(server, ns, prev["arn"],
+                                  prev["provider"], add=False)
+
+        annotate_namespace_sas(server, ns, self.ROLE_ANNOTATION, arn)
+        if not annotate_only:
+            self._edit_statements(server, ns, arn, provider, add=True)
+        if prev != cur:
+            profile["metadata"].setdefault(
+                "annotations", {})[self.APPLIED_ANNOTATION] = json.dumps(cur)
+            server.update(profile)
 
     def revoke(self, server, profile, spec):
         ns = profile["metadata"]["name"]
-        for sa_name in ("default-editor", "default-viewer"):
-            try:
-                sa = server.get("ServiceAccount", sa_name, ns)
-            except NotFound:
-                continue
-            ann = sa["metadata"].get("annotations", {})
-            if ann.pop("iam.gke.io/gcp-service-account", None) is not None:
-                server.update(sa)
+        annotate_namespace_sas(server, ns, self.ROLE_ANNOTATION, None)
+        # trust what was actually applied over what the spec says now
+        state = self._applied(profile) or {
+            "arn": spec.get("awsIamRole", ""),
+            "provider": spec.get("oidcProviderArn", self.DEFAULT_PROVIDER),
+            "annotateOnly": bool(spec.get("annotateOnly"))}
+        if state["arn"] and not state.get("annotateOnly"):
+            self._edit_statements(server, ns, state["arn"],
+                                  state["provider"], add=False)
 
 
 PLUGINS: dict[str, ProfilePlugin] = {
     TpuWorkloadIdentity.kind: TpuWorkloadIdentity(),
+    AwsIamForServiceAccount.kind: AwsIamForServiceAccount(),
 }
 
 
@@ -152,14 +304,26 @@ class ProfileController(Controller):
             self._ensure(profile, "ResourceQuota", "kf-resource-quota", name,
                          spec=quota_spec, update=True)
 
-        # 5. plugins
+        # 5. plugins — a broken plugin spec becomes a visible condition,
+        # not a silent rate-limited crash loop; other plugins still run
+        plugin_err = None
         for plug in profile["spec"].get("plugins", []):
             impl = PLUGINS.get(plug.get("kind", ""))
             if impl is None:
                 self.log.warning("unknown plugin", kind=plug.get("kind"))
                 continue
-            impl.apply(self.server, profile, plug.get("spec", {}))
+            try:
+                impl.apply(self.server, profile, plug.get("spec", {}))
+            except Exception as e:
+                self.log.error("plugin apply failed",
+                               kind=plug.get("kind"), exc_info=True)
+                plugin_err = f"{plug.get('kind')}: {e}"
 
+        if plugin_err:
+            set_condition(profile, "Ready", "False", reason="PluginFailed",
+                          message=plugin_err)
+            self.server.patch_status(api.KIND, name, None, profile["status"])
+            return Result(requeue_after=5.0)
         set_condition(profile, "Ready", "True", reason="Reconciled")
         self.server.patch_status(api.KIND, name, None, profile["status"])
         return None

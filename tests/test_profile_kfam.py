@@ -92,6 +92,136 @@ def test_workload_identity_plugin(harness):
             == "ml-sa@proj.iam.gserviceaccount.com")
 
 
+def test_aws_iam_plugin_trust_policy_lifecycle(harness):
+    """AwsIAMForServiceAccount parity (plugin_iam.go): SA annotation +
+    trust-policy statements on apply, clean removal on profile delete,
+    unrelated statements untouched — the reference's own test strategy
+    (doc rewriting without AWS calls)."""
+    server, mgr = harness
+    arn = "arn:aws:iam::123456789012:role/Team-Alpha"
+    p = profile_api.new("team-aws", "dana@corp.com", plugins=[
+        {"kind": "AwsIamForServiceAccount",
+         "spec": {"awsIamRole": arn}}])
+    server.create(p)
+    assert mgr.wait_idle()
+
+    from kubeflow_tpu.controllers.profile import iam_role_name
+
+    sa = server.get("ServiceAccount", "default-editor", "team-aws")
+    assert sa["metadata"]["annotations"]["eks.amazonaws.com/role-arn"] == arn
+    role = server.get("IamRole", iam_role_name(arn))
+    stmts = role["spec"]["trustPolicy"]["Statement"]
+    subs = [s["Condition"]["StringEquals"]
+            [next(iter(s["Condition"]["StringEquals"]))] for s in stmts]
+    assert sorted(subs) == [
+        "system:serviceaccount:team-aws:default-editor",
+        "system:serviceaccount:team-aws:default-viewer"]
+    assert all(s["Action"] == "sts:AssumeRoleWithWebIdentity"
+               for s in stmts)
+
+    # idempotent: re-reconcile does not duplicate statements
+    server.update(server.get(profile_api.KIND, "team-aws"))
+    assert mgr.wait_idle()
+    role = server.get("IamRole", iam_role_name(arn))
+    assert len(role["spec"]["trustPolicy"]["Statement"]) == 2
+
+    # an unrelated statement (another team) survives this profile's revoke
+    role["spec"]["trustPolicy"]["Statement"].append(
+        {"Effect": "Allow", "Principal": {"AWS": "arn:aws:iam::1:root"},
+         "Action": "sts:AssumeRole"})
+    server.update(role)
+    server.delete(profile_api.KIND, "team-aws")
+    assert mgr.wait_idle()
+    role = server.get("IamRole", iam_role_name(arn))
+    assert role["spec"]["trustPolicy"]["Statement"] == [
+        {"Effect": "Allow", "Principal": {"AWS": "arn:aws:iam::1:root"},
+         "Action": "sts:AssumeRole"}]
+
+
+def test_aws_iam_plugin_annotate_only(harness):
+    server, mgr = harness
+    arn = "arn:aws:iam::123456789012:role/AnnotateOnly"
+    server.create(profile_api.new("team-ao", "erin@corp.com", plugins=[
+        {"kind": "AwsIamForServiceAccount",
+         "spec": {"awsIamRole": arn, "annotateOnly": True}}]))
+    assert mgr.wait_idle()
+    sa = server.get("ServiceAccount", "default-editor", "team-ao")
+    assert sa["metadata"]["annotations"]["eks.amazonaws.com/role-arn"] == arn
+    from kubeflow_tpu.controllers.profile import iam_role_name
+
+    with pytest.raises(NotFound):
+        server.get("IamRole", iam_role_name(arn))
+
+
+def test_aws_iam_role_change_revokes_old_grant(harness):
+    """Editing awsIamRole must remove the namespace's statements from the
+    PREVIOUS role — otherwise the old grant stands forever."""
+    from kubeflow_tpu.controllers.profile import iam_role_name
+
+    server, mgr = harness
+    old_arn = "arn:aws:iam::111111111111:role/Old"
+    new_arn = "arn:aws:iam::222222222222:role/New"
+    server.create(profile_api.new("team-move", "fay@corp.com", plugins=[
+        {"kind": "AwsIamForServiceAccount",
+         "spec": {"awsIamRole": old_arn}}]))
+    assert mgr.wait_idle()
+    assert server.get("IamRole", iam_role_name(old_arn)
+                      )["spec"]["trustPolicy"]["Statement"]
+
+    prof = server.get(profile_api.KIND, "team-move")
+    prof["spec"]["plugins"][0]["spec"]["awsIamRole"] = new_arn
+    server.update(prof)
+    assert mgr.wait_idle()
+    old_role = server.get("IamRole", iam_role_name(old_arn))
+    assert old_role["spec"]["trustPolicy"]["Statement"] == []
+    new_role = server.get("IamRole", iam_role_name(new_arn))
+    assert len(new_role["spec"]["trustPolicy"]["Statement"]) == 2
+    sa = server.get("ServiceAccount", "default-editor", "team-move")
+    assert (sa["metadata"]["annotations"]["eks.amazonaws.com/role-arn"]
+            == new_arn)
+
+
+def test_aws_iam_plugin_missing_role_sets_condition(harness):
+    """A broken plugin spec surfaces as Ready=False/PluginFailed, not a
+    silent crash loop; the tenancy objects still materialize."""
+    server, mgr = harness
+    server.create(profile_api.new("team-broken", "gil@corp.com", plugins=[
+        {"kind": "AwsIamForServiceAccount", "spec": {}}]))
+    assert mgr.wait_idle()
+    prof = server.get(profile_api.KIND, "team-broken")
+    conds = {c["type"]: c for c in prof["status"]["conditions"]}
+    assert conds["Ready"]["status"] == "False"
+    assert conds["Ready"]["reason"] == "PluginFailed"
+    assert "awsIamRole" in conds["Ready"]["message"]
+    assert server.get("ServiceAccount", "default-editor", "team-broken")
+
+
+def test_trust_statement_rewriting_pure():
+    from kubeflow_tpu.controllers.profile import (
+        add_trust_statement,
+        irsa_subject,
+        remove_trust_statement,
+    )
+
+    provider = ("arn:aws:iam::1:oidc-provider/oidc.eks.example.com/id/X")
+    doc = {"Version": "2012-10-17", "Statement": []}
+    doc, changed = add_trust_statement(doc, provider,
+                                       irsa_subject("ns", "sa"))
+    assert changed and len(doc["Statement"]) == 1
+    # condition keys on the issuer path, not the full provider arn
+    cond = doc["Statement"][0]["Condition"]["StringEquals"]
+    assert list(cond) == ["oidc.eks.example.com/id/X:sub"]
+    doc, changed = add_trust_statement(doc, provider,
+                                       irsa_subject("ns", "sa"))
+    assert not changed  # idempotent
+    doc, changed = remove_trust_statement(doc, provider,
+                                          irsa_subject("other", "sa"))
+    assert not changed  # wrong subject: no-op
+    doc, changed = remove_trust_statement(doc, provider,
+                                          irsa_subject("ns", "sa"))
+    assert changed and doc["Statement"] == []
+
+
 # -- KFAM over HTTP ------------------------------------------------------------
 
 
