@@ -6,22 +6,77 @@ PipelineRun is a DAG of steps, each materialized as a pod when its
 dependencies succeed.  The CI workflow specs (ci/pipelines.generate_workflow)
 are directly runnable as PipelineRuns — same step shape {name, run, depends}.
 
+Data passing (the Kubeflow Pipelines core concept):
+- a step declares ``outputs: [keys]``; on success those keys are read from
+  its pod's ``status.result`` (the executor parses the last JSON stdout
+  line) into ``status.steps[name].outputs``;
+- any ``run`` argv element or ``env`` value may reference
+  ``{{steps.<name>.outputs.<key>}}``; references imply dependencies
+  (data flow orders the DAG, explicit ``depends`` is for control-only
+  edges) and are substituted at pod-creation time;
+- ``workspace: true`` provisions a shared PVC mounted into every step at
+  /workspace for file artifacts.
+
 spec:
-  steps: [{name, run: [argv], image?, env?, depends: [step names]}]
+  steps: [{name, run: [argv], image?, env?, depends: [step names],
+           outputs?: [keys]}]
+  workspace: bool | {size: "10Gi"}
 status:
   phase: Pending|Running|Succeeded|Failed
-  steps: {name: {phase, podName}}
+  steps: {name: {phase, podName, outputs?}}
 """
 
 from __future__ import annotations
+
+import re
+from typing import Any
 
 from kubeflow_tpu.core.objects import api_object
 
 KIND = "PipelineRun"
 
+PLACEHOLDER = re.compile(r"\{\{steps\.([A-Za-z0-9_-]+)"
+                         r"\.outputs\.([A-Za-z0-9_./-]+)\}\}")
 
-def new(name: str, namespace: str, steps: list[dict]) -> dict:
-    return api_object(KIND, name, namespace, spec={"steps": steps})
+
+def new(name: str, namespace: str, steps: list[dict], *,
+        workspace: bool | dict = False) -> dict:
+    spec: dict[str, Any] = {"steps": steps}
+    if workspace:
+        spec["workspace"] = workspace
+    return api_object(KIND, name, namespace, spec=spec)
+
+
+def referenced_outputs(step: dict) -> list[tuple[str, str]]:
+    """(producer step, output key) pairs referenced by this step's argv
+    and env values."""
+    texts = [str(a) for a in step.get("run", [])]
+    texts += [str(v) for v in (step.get("env") or {}).values()]
+    return [(m.group(1), m.group(2))
+            for t in texts for m in PLACEHOLDER.finditer(t)]
+
+
+def effective_depends(step: dict) -> list[str]:
+    """Control dependencies plus the data dependencies implied by output
+    references (KFP semantics: data flow orders the graph)."""
+    deps = set(step.get("depends", []))
+    deps.update(name for name, _ in referenced_outputs(step))
+    return sorted(deps)
+
+
+def substitute_outputs(step: dict, outputs: dict[str, dict]) -> dict:
+    """A copy of ``step`` with every output placeholder replaced from
+    ``outputs[producer][key]``."""
+    def sub(text: str) -> str:
+        return PLACEHOLDER.sub(
+            lambda m: str(outputs.get(m.group(1), {}).get(m.group(2), "")),
+            text)
+
+    out = dict(step)
+    out["run"] = [sub(str(a)) for a in step.get("run", [])]
+    if step.get("env"):
+        out["env"] = {k: sub(str(v)) for k, v in step["env"].items()}
+    return out
 
 
 def from_workflow(workflow: dict, namespace: str) -> dict:
@@ -37,14 +92,42 @@ def validate(run: dict) -> None:
     names = [s.get("name") for s in steps]
     if len(set(names)) != len(names) or not all(names):
         raise ValueError("step names must be unique and non-empty")
+    for n in names:
+        # names must stay referenceable from placeholders
+        if not re.fullmatch(r"[A-Za-z0-9_-]+", n):
+            raise ValueError(f"step name {n!r} must match [A-Za-z0-9_-]+")
+    for s in steps:
+        for text in ([str(a) for a in s.get("run", [])]
+                     + [str(v) for v in (s.get("env") or {}).values()]):
+            # a '{{steps.' that does not fully parse would otherwise be
+            # passed through literally with no dependency edge — reject
+            # the typo instead of silently launching out of order
+            if "{{steps." in PLACEHOLDER.sub("", text):
+                raise ValueError(
+                    f"step {s['name']}: malformed output reference in "
+                    f"{text!r} (expected "
+                    "{{steps.<name>.outputs.<key>}})")
     known = set(names)
+    declared = {s["name"]: set(s.get("outputs", [])) for s in steps}
     for s in steps:
         for dep in s.get("depends", []):
             if dep not in known:
                 raise ValueError(f"step {s['name']}: unknown dependency "
                                  f"{dep!r}")
-    # cycle check (Kahn)
-    remaining = {s["name"]: set(s.get("depends", [])) for s in steps}
+        for producer, key in referenced_outputs(s):
+            if producer == s.get("name"):
+                raise ValueError(
+                    f"step {s['name']} references its own output")
+            if producer not in known:
+                raise ValueError(f"step {s['name']}: output reference to "
+                                 f"unknown step {producer!r}")
+            if key not in declared[producer]:
+                raise ValueError(
+                    f"step {s['name']} references undeclared output "
+                    f"{producer}.{key} (declare it in that step's "
+                    f"'outputs')")
+    # cycle check (Kahn) over control AND data dependencies
+    remaining = {s["name"]: set(effective_depends(s)) for s in steps}
     while remaining:
         ready = [n for n, deps in remaining.items() if not deps]
         if not ready:

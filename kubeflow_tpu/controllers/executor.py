@@ -118,10 +118,18 @@ class LocalExecutor(Controller):
     kind = "Pod"
 
     def __init__(self, server, *, extra_env: dict[str, str] | None = None,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0, volumes_root: str | None = None):
         super().__init__(server)
         self.extra_env = extra_env or {}
         self.timeout = timeout
+        # PVC mounts materialize as host directories under this root; the
+        # mount path is exposed to the process as KF_MOUNT_<NAME> (a
+        # one-host kubelet has no mount namespaces — the env var is the
+        # documented convention pipeline steps use for file artifacts)
+        import tempfile
+
+        self.volumes_root = volumes_root or os.path.join(
+            tempfile.gettempdir(), "kubeflow-tpu-volumes")
         # (ns, name) -> (uid, Popen): deleting a pod must KILL its process
         # (kubelet semantics) — a dead gang's worker would otherwise hold
         # the rendezvous port hostage across the restart
@@ -207,6 +215,27 @@ class LocalExecutor(Controller):
         env = dict(os.environ)
         for item in container.get("env", []):
             env[item["name"]] = str(item.get("value", ""))
+        claims = {v["name"]: v["persistentVolumeClaim"]["claimName"]
+                  for v in pod["spec"].get("volumes", [])
+                  if "persistentVolumeClaim" in v}
+        for mount in container.get("volumeMounts", []):
+            claim = claims.get(mount["name"])
+            if claim is None:
+                continue
+            # key the host dir by the PVC's uid so a recreated claim with
+            # the same name starts empty (fresh-PVC semantics) instead of
+            # inheriting the previous volume's files
+            try:
+                pvc = self.server.get("PersistentVolumeClaim", claim,
+                                      md.get("namespace"))
+                claim_dir = f"{claim}-{pvc['metadata']['uid'][:8]}"
+            except NotFound:
+                claim_dir = claim
+            path = os.path.join(self.volumes_root,
+                                md.get("namespace") or "_", claim_dir)
+            os.makedirs(path, exist_ok=True)
+            env_key = "KF_MOUNT_" + mount["name"].upper().replace("-", "_")
+            env[env_key] = path
         env.update(self.extra_env)
         result = None
         try:

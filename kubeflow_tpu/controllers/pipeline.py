@@ -35,22 +35,37 @@ class PipelineRunController(Controller):
                 s["name"], {"phase": "Pending"}))
             for s in steps}
 
-        # read pod phases into step statuses
+        # read pod phases into step statuses; on success, lift declared
+        # outputs from the pod's result (a promised-but-missing output is
+        # a step failure — silently empty substitutions downstream would
+        # be worse)
         for s in steps:
             pod_name = api.step_pod_name(req.name, s["name"])
             try:
                 pod = self.server.get("Pod", pod_name, req.namespace)
-                step_status[s["name"]] = {
-                    "phase": pod.get("status", {}).get("phase", "Pending"),
-                    "podName": pod_name,
-                }
-                if pod.get("status", {}).get("message"):
-                    step_status[s["name"]]["message"] = (
-                        pod["status"]["message"][-500:])
             except NotFound:
-                pass
+                continue
+            st = {
+                "phase": pod.get("status", {}).get("phase", "Pending"),
+                "podName": pod_name,
+            }
+            if pod.get("status", {}).get("message"):
+                st["message"] = pod["status"]["message"][-500:]
+            if st["phase"] == "Succeeded" and s.get("outputs"):
+                result = pod.get("status", {}).get("result") or {}
+                missing = [k for k in s["outputs"] if k not in result]
+                if missing:
+                    st["phase"] = "Failed"
+                    st["message"] = (f"declared outputs missing from step "
+                                     f"result: {missing}")
+                else:
+                    st["outputs"] = {k: result[k] for k in s["outputs"]}
+            step_status[s["name"]] = st
 
         # propagate failure: dependents of a failed step are skipped
+        # (data dependencies count — a consumer of a failed producer's
+        # outputs can never run)
+        eff = {s["name"]: api.effective_depends(s) for s in steps}
         failed = {n for n, st in step_status.items()
                   if st["phase"] == "Failed"}
         changed = True
@@ -59,31 +74,43 @@ class PipelineRunController(Controller):
             for s in steps:
                 if s["name"] in failed:
                     continue
-                if any(d in failed for d in s.get("depends", [])):
+                if any(d in failed for d in eff[s["name"]]):
                     step_status[s["name"]] = {"phase": "Skipped"}
                     failed.add(s["name"])
                     changed = True
 
-        # launch ready steps
+        workspace = self._ensure_workspace(run)
+        outputs = {n: st.get("outputs", {})
+                   for n, st in step_status.items()}
+
+        # launch ready steps with upstream outputs substituted
         for s in steps:
             st = step_status[s["name"]]
             if st["phase"] != "Pending" or "podName" in st:
                 continue
             deps_done = all(
                 step_status[d]["phase"] == "Succeeded"
-                for d in s.get("depends", []))
+                for d in eff[s["name"]])
             if not deps_done:
                 continue
+            resolved = api.substitute_outputs(s, outputs)
+            spec = {"containers": [{
+                "name": "step",
+                "image": s.get("image", "kubeflow-tpu/ci:latest"),
+                "command": list(resolved.get("run", [])),
+                "env": [{"name": k, "value": str(v)}
+                        for k, v in (resolved.get("env") or {}).items()],
+            }], "restartPolicy": "Never"}
+            if workspace:
+                spec["volumes"] = [{"name": "workspace",
+                                    "persistentVolumeClaim":
+                                    {"claimName": workspace}}]
+                spec["containers"][0]["volumeMounts"] = [
+                    {"name": "workspace", "mountPath": "/workspace"}]
             pod = set_owner(api_object(
                 "Pod", api.step_pod_name(req.name, s["name"]), req.namespace,
                 labels={"pipelinerun": req.name, "step": s["name"]},
-                spec={"containers": [{
-                    "name": "step",
-                    "image": s.get("image", "kubeflow-tpu/ci:latest"),
-                    "command": list(s.get("run", [])),
-                    "env": [{"name": k, "value": str(v)}
-                            for k, v in (s.get("env") or {}).items()],
-                }], "restartPolicy": "Never"}), run)
+                spec=spec), run)
             try:
                 self.server.create(pod)
                 step_status[s["name"]] = {
@@ -112,6 +139,26 @@ class PipelineRunController(Controller):
         status["steps"] = step_status
         self.server.patch_status(api.KIND, req.name, req.namespace, status)
         return None
+
+
+    def _ensure_workspace(self, run: dict) -> str | None:
+        """The run's shared artifact PVC (created on first use); None when
+        the spec doesn't ask for one."""
+        ws = run["spec"].get("workspace")
+        if not ws:
+            return None
+        name = f"{run['metadata']['name']}-workspace"
+        ns = run["metadata"]["namespace"]
+        try:
+            self.server.get("PersistentVolumeClaim", name, ns)
+        except NotFound:
+            size = (ws.get("size", "10Gi") if isinstance(ws, dict)
+                    else "10Gi")
+            self.server.create(set_owner(api_object(
+                "PersistentVolumeClaim", name, ns,
+                spec={"accessModes": ["ReadWriteOnce"],
+                      "resources": {"requests": {"storage": size}}}), run))
+        return name
 
 
 def register(server, mgr) -> None:

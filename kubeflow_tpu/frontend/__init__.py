@@ -15,7 +15,8 @@ driving their JSON APIs:
                   image/TPU-slice pickers (jupyter frontend/src/app);
 - ``volumes.js``  PVC table + create dialog;
 - ``tensorboards.js``  tensorboard table + create dialog;
-- ``jobs.js``     JAXJob table over the raw /apis REST (TPU-native extra).
+- ``resources.js``  generic table over the raw /apis REST, mounted for
+                  JAXJobs/Experiments/Models (webapps/resource_uis.py).
 
 Assets live in ``static/`` and are served by ``StaticApp`` (mounted at
 ``/static`` by the platform front door).  ``page()`` renders the HTML shell

@@ -108,3 +108,115 @@ def test_ci_workflow_adapts_to_pipelinerun():
     api.validate(run)
     names = [s["name"] for s in run["spec"]["steps"]]
     assert names == ["checkout", "test"]
+
+
+def test_output_reference_validation():
+    # undeclared output
+    with pytest.raises(ValueError, match="undeclared output"):
+        api.validate(api.new("x", "ns", [
+            {"name": "a", "run": ["true"]},
+            {"name": "b", "run": ["echo", "{{steps.a.outputs.rate}}"]}]))
+    # unknown producer
+    with pytest.raises(ValueError, match="unknown step"):
+        api.validate(api.new("x", "ns", [
+            {"name": "b", "run": ["echo", "{{steps.z.outputs.k}}"]}]))
+    # self-reference
+    with pytest.raises(ValueError, match="its own output"):
+        api.validate(api.new("x", "ns", [
+            {"name": "a", "outputs": ["k"],
+             "run": ["echo", "{{steps.a.outputs.k}}"]}]))
+    # data references imply dependencies, including cycles
+    with pytest.raises(ValueError, match="cycle"):
+        api.validate(api.new("x", "ns", [
+            {"name": "a", "outputs": ["k"],
+             "run": ["echo", "{{steps.b.outputs.j}}"]},
+            {"name": "b", "outputs": ["j"],
+             "run": ["echo", "{{steps.a.outputs.k}}"]}]))
+    # a typo'd placeholder must be rejected, not passed through inert
+    with pytest.raises(ValueError, match="malformed output reference"):
+        api.validate(api.new("x", "ns", [
+            {"name": "a", "outputs": ["k"], "run": ["true"]},
+            {"name": "b", "run": ["echo", "{{steps.a.output.k}}"]}]))
+    with pytest.raises(ValueError, match="must match"):
+        api.validate(api.new("x", "ns", [{"name": "pre.process",
+                                          "run": ["true"]}]))
+
+
+def test_data_dependency_orders_and_substitutes():
+    """A consumer with NO explicit depends runs after its producer purely
+    via the data edge, and the placeholder resolves to the producer's
+    output value (FakeExecutor results carry samples_per_sec=100.0)."""
+    server, mgr = make_stack(FakeExecutor)
+    try:
+        server.create(api.new("data", "ci", [
+            {"name": "train", "run": ["train"],
+             "outputs": ["samples_per_sec"]},
+            {"name": "report", "run": [
+                "report", "--rate={{steps.train.outputs.samples_per_sec}}"],
+             "env": {"RATE": "{{steps.train.outputs.samples_per_sec}}"}},
+        ]))
+        done = wait_run(server, "data", "ci")
+        assert done["status"]["phase"] == "Succeeded"
+        assert (done["status"]["steps"]["train"]["outputs"]
+                ["samples_per_sec"] == 100.0)
+        pod = server.get("Pod", api.step_pod_name("data", "report"), "ci")
+        assert pod["spec"]["containers"][0]["command"] == [
+            "report", "--rate=100.0"]
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["RATE"] == "100.0"
+    finally:
+        mgr.stop()
+
+
+def test_missing_declared_output_fails_step_and_skips_consumers():
+    server, mgr = make_stack(FakeExecutor)
+    try:
+        server.create(api.new("miss", "ci", [
+            {"name": "a", "run": ["a"], "outputs": ["no_such_key"]},
+            {"name": "b", "run": ["b", "{{steps.a.outputs.no_such_key}}"]},
+        ]))
+        done = wait_run(server, "miss", "ci")
+        assert done["status"]["phase"] == "Failed"
+        assert done["status"]["steps"]["a"]["phase"] == "Failed"
+        assert "no_such_key" in done["status"]["steps"]["a"]["message"]
+        assert done["status"]["steps"]["b"]["phase"] == "Skipped"
+    finally:
+        mgr.stop()
+
+
+def test_artifacts_and_params_flow_through_real_steps(tmp_path):
+    """KFP-style data passing with REAL subprocesses: step A writes a file
+    artifact to the shared workspace and emits an output parameter; step B
+    receives the parameter by substitution and reads the artifact via the
+    executor's KF_MOUNT_WORKSPACE mapping."""
+    server = APIServer()
+    mgr = Manager(server)
+    register(server, mgr)
+    mgr.add(LocalExecutor(server, timeout=30,
+                          volumes_root=str(tmp_path / "vols")))
+    mgr.start()
+    try:
+        a_prog = ("import json, os; "
+                  "open(os.environ['KF_MOUNT_WORKSPACE']+'/a.txt','w')"
+                  ".write('42'); print(json.dumps({'rate': 7}))")
+        b_prog = ("import json, os, sys; "
+                  "art=open(os.environ['KF_MOUNT_WORKSPACE']+'/a.txt')"
+                  ".read(); "
+                  "print(json.dumps({'got': art, 'rate': sys.argv[1]}))")
+        server.create(api.new("art", "ci", [
+            {"name": "a", "outputs": ["rate"],
+             "run": [sys.executable, "-c", a_prog]},
+            {"name": "b", "outputs": ["got", "rate"],
+             "run": [sys.executable, "-c", b_prog,
+                     "{{steps.a.outputs.rate}}"]},
+        ], workspace=True))
+        done = wait_run(server, "art", "ci", timeout=60)
+        assert done["status"]["phase"] == "Succeeded", done["status"]
+        outs = done["status"]["steps"]["b"]["outputs"]
+        assert outs == {"got": "42", "rate": "7"}
+        # the workspace PVC materialized and is owned by the run
+        pvc = server.get("PersistentVolumeClaim", "art-workspace", "ci")
+        assert pvc["metadata"]["ownerReferences"][0]["name"] == "art"
+    finally:
+        mgr.stop()
