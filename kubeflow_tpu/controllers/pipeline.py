@@ -53,6 +53,10 @@ class PipelineRunController(Controller):
                 st["message"] = pod["status"]["message"][-500:]
             if st["phase"] == "Succeeded" and s.get("outputs"):
                 result = pod.get("status", {}).get("result") or {}
+                if not isinstance(result, dict):
+                    # executor accepts any JSON value as the result line; a
+                    # scalar can never satisfy named outputs
+                    result = {}
                 missing = [k for k in s["outputs"] if k not in result]
                 if missing:
                     st["phase"] = "Failed"

@@ -185,6 +185,30 @@ def test_missing_declared_output_fails_step_and_skips_consumers():
         mgr.stop()
 
 
+def test_scalar_result_fails_step_not_crashloop():
+    """A step whose last stdout line is a bare JSON scalar (not an object)
+    can never satisfy named outputs — the step must go Failed (and its
+    consumers Skipped), not wedge the reconciler in a TypeError loop."""
+    server = APIServer()
+    mgr = Manager(server)
+    register(server, mgr)
+    mgr.add(LocalExecutor(server, timeout=30))
+    mgr.start()
+    try:
+        server.create(api.new("scalar", "ci", [
+            {"name": "a", "outputs": ["rate"],
+             "run": [sys.executable, "-c", "print(42)"]},
+            {"name": "b", "run": ["echo", "{{steps.a.outputs.rate}}"]},
+        ]))
+        done = wait_run(server, "scalar", "ci", timeout=60)
+        assert done["status"]["phase"] == "Failed"
+        assert done["status"]["steps"]["a"]["phase"] == "Failed"
+        assert "rate" in done["status"]["steps"]["a"]["message"]
+        assert done["status"]["steps"]["b"]["phase"] == "Skipped"
+    finally:
+        mgr.stop()
+
+
 def test_artifacts_and_params_flow_through_real_steps(tmp_path):
     """KFP-style data passing with REAL subprocesses: step A writes a file
     artifact to the shared workspace and emits an output parameter; step B
