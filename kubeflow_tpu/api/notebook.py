@@ -20,23 +20,55 @@ NB_PREFIX_ENV = "NB_PREFIX"
 
 def new(name: str, namespace: str, *, image: str,
         cpu: str = "0.5", memory: str = "1Gi",
+        cpu_limit: str | None = None, memory_limit: str | None = None,
         tpu_resource: str | None = None, tpu_chips: int = 0,
         workspace_pvc: str | None = None, labels: dict | None = None,
-        env: list | None = None) -> dict:
+        env: list | None = None,
+        data_volumes: list | None = None,
+        affinity: dict | None = None,
+        tolerations: list | None = None,
+        shm: bool = False) -> dict:
+    """data_volumes: [{"pvc": claim-name, "mount": path}]; shm=True mounts
+    a memory-backed emptyDir at /dev/shm (reference form.py shm handling)."""
     resources: dict = {"requests": {"cpu": cpu, "memory": memory}}
+    if cpu_limit or memory_limit:
+        limits = resources.setdefault("limits", {})
+        if cpu_limit:
+            limits["cpu"] = cpu_limit
+        if memory_limit:
+            limits["memory"] = memory_limit
     if tpu_resource and tpu_chips:
         resources.setdefault("limits", {})[tpu_resource] = tpu_chips
     container = {"name": name, "image": image, "resources": resources,
                  "env": list(env or [])}
+    mounts = []
     volumes = []
     if workspace_pvc:
-        container["volumeMounts"] = [{"name": "workspace",
-                                      "mountPath": "/home/jovyan"}]
+        mounts.append({"name": "workspace", "mountPath": "/home/jovyan"})
         volumes.append({"name": "workspace",
                         "persistentVolumeClaim": {"claimName": workspace_pvc}})
+    for i, dv in enumerate(data_volumes or []):
+        vol_name = f"data-{i}" if len(data_volumes) > 1 else "data"
+        mounts.append({"name": vol_name,
+                       "mountPath": dv.get("mount") or f"/data/{dv['pvc']}"})
+        volumes.append({"name": vol_name,
+                        "persistentVolumeClaim": {"claimName": dv["pvc"]}})
+    if shm:
+        # sizeLimit bounds the tmpfs: without it /dev/shm defaults to half
+        # of NODE memory, letting one notebook evict co-tenants
+        shm_vol = {"medium": "Memory",
+                   "sizeLimit": memory_limit or memory}
+        mounts.append({"name": "dshm", "mountPath": "/dev/shm"})
+        volumes.append({"name": "dshm", "emptyDir": shm_vol})
+    if mounts:
+        container["volumeMounts"] = mounts
+    pod_spec: dict = {"containers": [container], "volumes": volumes}
+    if affinity:
+        pod_spec["affinity"] = affinity
+    if tolerations:
+        pod_spec["tolerations"] = list(tolerations)
     return api_object(KIND, name, namespace, labels=labels, spec={
-        "template": {"spec": {"containers": [container],
-                              "volumes": volumes}},
+        "template": {"spec": pod_spec},
     })
 
 

@@ -44,6 +44,28 @@ class JAXJobController(Controller):
     kind = api.KIND
     owns = ("Pod", "Service")
 
+    def requests_for(self, ev):
+        yield from super().requests_for(ev)
+        # event-driven unpark: a pod leaving the world (terminal phase or
+        # deletion) can free slice capacity or TPU quota, which is exactly
+        # what gangs parked on WaitingForSlices/QuotaExceeded are polling
+        # for — re-enqueue them immediately instead of waiting out the
+        # 0.25s park requeue (trial scheduling latency: ~500ms -> ~ms)
+        if ev.kind != "Pod":
+            return
+        phase = ev.object.get("status", {}).get("phase")
+        if ev.type != "DELETED" and phase not in ("Succeeded", "Failed"):
+            return
+        for job in self.server.list(api.KIND):
+            st = job.get("status") or {}
+            if st.get("phase") != "Pending":
+                continue
+            if any(c.get("status") == "True" and c.get("type") in
+                   ("WaitingForSlices", "QuotaExceeded")
+                   for c in st.get("conditions", [])):
+                md = job["metadata"]
+                yield Request(md.get("namespace"), md["name"])
+
     def reconcile(self, req: Request) -> Result | None:
         try:
             job = self.server.get(api.KIND, req.name, req.namespace)

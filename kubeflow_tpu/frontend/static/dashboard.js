@@ -175,6 +175,30 @@
           el("div", { class: "muted" }, "running / total"));
       }).catch(() => nbCard.append(errorBox("unavailable")));
 
+    // training + pipelines card (reference dashboard-view pipelines-card;
+    // here it also surfaces the in-tree JAXJob/HPO equivalents)
+    const jobsCard = el("div", { class: "card", id: "jobs-card" },
+      el("h2", null, "Training & Pipelines"),
+      el("div", { class: "muted" }, "…"));
+    cards.append(jobsCard);
+    Promise.all([
+      api.get(`/apis/JAXJob?namespace=${state.ns}`),
+      api.get(`/apis/Experiment?namespace=${state.ns}`),
+      api.get(`/apis/PipelineRun?namespace=${state.ns}`),
+    ]).then(([jobs, exps, runs]) => {
+      const phase = (o) => (o.status && o.status.phase) || "Pending";
+      const running = (xs) => xs.filter(
+        (o) => ["Running", "Pending", "Restarting"].includes(phase(o)))
+        .length;
+      const line = (label, xs) => el("li", null,
+        `${label}: ${running(xs)} active / ${xs.length} total`);
+      jobsCard.replaceChildren(el("h2", null, "Training & Pipelines"),
+        el("ul", null,
+          line("JAXJobs", jobs.items || []),
+          line("Experiments", exps.items || []),
+          line("Pipeline runs", runs.items || [])));
+    }).catch(() => jobsCard.append(errorBox("unavailable")));
+
     // metrics cards
     for (const [mtype, title] of [["tpuduty", "TPU duty cycle"],
                                   ["podcpu", "Pod CPU"]]) {

@@ -52,10 +52,67 @@
       `${v} × ${k.replace("cloud-tpu.google.com/", "")}`).join(", ");
   }
 
+  /* details drawer: overview + events + raw CR (reference: the jupyter
+   * app's notebook details page with OVERVIEW/EVENTS/YAML tabs) */
+  async function openDetails(name) {
+    const [detail, events] = await Promise.all([
+      api.get(`${base}/notebooks/${name}`),
+      api.get(`${base}/notebooks/${name}/events`),
+    ]);
+    const nb = detail.notebook;
+    const overview = el("dl", { class: "kf-overview" },
+      el("dt", null, "Status"), el("dd", null, statusIcon(nb.status),
+        " ", nb.status.message || ""),
+      el("dt", null, "Image"), el("dd", null, nb.image || ""),
+      el("dt", null, "CPU / Memory"),
+      el("dd", null, `${nb.cpu || "—"} / ${nb.memory || "—"}`),
+      el("dt", null, "TPUs"), el("dd", null,
+        Object.entries(nb.tpus || {}).map(([k, v]) => `${v} × ${k}`)
+          .join(", ") || "none"),
+      el("dt", null, "Volumes"), el("dd", null,
+        ((nb.notebook.spec.template.spec.volumes) || [])
+          .map((v) => v.name).join(", ") || "none"),
+      el("dt", null, "Created"), el("dd", null, age(nb.createdAt) +
+        " ago"));
+    const evRows = (events.events || []).map((e) => el("tr", null,
+      el("td", null, e.spec.type || ""),
+      el("td", null, e.spec.reason || ""),
+      el("td", null, e.spec.message || ""),
+      el("td", null, age(e.spec.lastTimestamp))));
+    const evTable = el("table", { class: "kf-table" },
+      el("thead", null, el("tr", null, ["Type", "Reason", "Message",
+        "Age"].map((h) => el("th", null, h)))),
+      el("tbody", null, evRows.length ? evRows
+        : el("tr", null, el("td", { colspan: "4", class: "empty" },
+          "No events."))));
+    const yaml = el("pre", { class: "kf-yaml" },
+      JSON.stringify(nb.notebook, null, 2));
+
+    const panes = { Overview: overview, Events: evTable, YAML: yaml };
+    const body = el("div", { class: "kf-details" });
+    const tabs = el("div", { class: "kf-tabs" },
+      Object.keys(panes).map((t, i) => el("a", {
+        href: "#", class: i === 0 ? "active" : null,
+        onclick: (ev) => {
+          ev.preventDefault();
+          tabs.querySelectorAll("a").forEach((a) =>
+            a.classList.remove("active"));
+          ev.target.classList.add("active");
+          body.replaceChildren(panes[t]);
+        } }, t)));
+    body.append(overview);
+    const dlg = KF.dialog(`Notebook ${name}`,
+      el("div", null, tabs, body),
+      [el("button", { onclick: () => dlg.close() }, "Close")]);
+  }
+
   const tbl = table({
     columns: [
       { title: "Status", render: (nb) => statusIcon(nb.status) },
-      { title: "Name", render: (nb) => nb.name },
+      { title: "Name", render: (nb) => el("a", { href: "#",
+          class: "name-link", onclick: (ev) => { ev.preventDefault();
+            openDetails(nb.name).catch((e) => snack(e.message)); } },
+          nb.name) },
       { title: "Image", render: (nb) => nb.shortImage || "" },
       { title: "CPU", render: (nb) => nb.cpu || "" },
       { title: "Memory", render: (nb) => nb.memory || "" },
@@ -99,11 +156,47 @@
     const memory = el("input", { type: "text", value: cfg.memory.value });
     const tpuSlice = select(cfg.tpu.options, cfg.tpu.value.slice || "none");
     const workspace = el("input", { type: "checkbox", checked: "" });
+    const shm = el("input", { type: "checkbox",
+      checked: cfg.shm && cfg.shm.value ? "" : null });
     const pdBoxes = pds.map((pd) => {
       const box = el("input", { type: "checkbox" });
       box.dataset.name = pd.name;
       return el("label", { class: "chip" }, box, pd.desc || pd.name);
     });
+
+    // affinity / toleration presets from the admin config
+    const affOpts = (cfg.affinityConfig && cfg.affinityConfig.options) || [];
+    const affinity = el("select", null,
+      el("option", { value: "" }, "none"),
+      affOpts.map((o) => el("option", { value: o.configKey },
+        o.displayName)));
+    affinity.value = (cfg.affinityConfig && cfg.affinityConfig.value) || "";
+    const tolOpts = (cfg.tolerationGroup && cfg.tolerationGroup.options)
+      || [];
+    const toleration = el("select", null, tolOpts.map((o) =>
+      el("option", { value: o.groupKey }, o.displayName)));
+    toleration.value = (cfg.tolerationGroup &&
+      cfg.tolerationGroup.value) || "none";
+
+    // data volumes: dynamic rows of {existing?, name, size, mount}
+    const dvRows = [];
+    const dvList = el("div");
+    function addDataVolume() {
+      const existing = el("input", { type: "checkbox" });
+      const vname = el("input", { type: "text",
+        placeholder: "{notebook-name}-data" });
+      const size = el("input", { type: "text", value: "10Gi" });
+      const mount = el("input", { type: "text", placeholder: "/data" });
+      const row = el("div", { class: "row datavol" },
+        el("label", { class: "chip" }, existing, "existing"),
+        vname, size, mount,
+        el("button", { class: "icon danger", title: "Remove",
+          onclick: () => { dvRows.splice(dvRows.indexOf(entry), 1);
+                           row.remove(); } }, "✕"));
+      const entry = { existing, vname, size, mount };
+      dvRows.push(entry);
+      dvList.append(row);
+    }
 
     const err = el("div");
     const form = el("div", { class: "kf-form" },
@@ -120,6 +213,20 @@
       field("Workspace volume",
         el("label", null, workspace, " create + mount a workspace PVC"),
         { readOnly: cfg.workspaceVolume.readOnly }),
+      field("Data volumes",
+        el("div", null, dvList,
+          el("button", { class: "icon", onclick: addDataVolume },
+            "+ add data volume")),
+        { readOnly: cfg.dataVolumes && cfg.dataVolumes.readOnly,
+          hint: "existing = attach a PVC you already have; otherwise " +
+                "one is created (name / size / mount path)" }),
+      affOpts.length ? field("Affinity", affinity,
+        { readOnly: cfg.affinityConfig.readOnly }) : null,
+      tolOpts.length ? field("Tolerations", toleration,
+        { readOnly: cfg.tolerationGroup.readOnly }) : null,
+      field("Shared memory",
+        el("label", null, shm, " mount memory-backed /dev/shm"),
+        { readOnly: cfg.shm && cfg.shm.readOnly }),
       pds.length ? field("Configurations", el("div", null, pdBoxes),
         { hint: "PodDefaults applied at admission" }) : null);
 
@@ -136,6 +243,22 @@
         body.tpu = { slice: tpuSlice.value };
       }
       if (!workspace.checked) body.noWorkspace = true;
+      if (dvRows.length && !(cfg.dataVolumes && cfg.dataVolumes.readOnly)) {
+        body.dataVolumes = dvRows.map((r, i) => ({
+          existing: r.existing.checked,
+          // blank name -> the server-side template (placeholder promise)
+          name: r.vname.value.trim() || `{notebook-name}-data-${i}`,
+          size: r.size.value.trim(),
+          mount: r.mount.value.trim() || undefined,
+        }));
+      }
+      if (affinity.value && !cfg.affinityConfig.readOnly) {
+        body.affinityConfig = affinity.value;
+      }
+      if (tolOpts.length && !cfg.tolerationGroup.readOnly) {
+        body.tolerationGroup = toleration.value;
+      }
+      if (!(cfg.shm && cfg.shm.readOnly)) body.shm = shm.checked;
       body.configurations = pdBoxes
         .map((chip) => chip.querySelector("input"))
         .filter((box) => box.checked)

@@ -138,6 +138,35 @@ class JupyterApp(CrudApp):
             tpu_resource = topo.resource_name
             tpu_chips = topo.chips
 
+        # affinity preset: selected configKey -> pod affinity stanza
+        affinity = None
+        aff_key = gfv("affinityConfig")
+        if aff_key:
+            opts = {o["configKey"]: o for o in
+                    self.config.get("affinityConfig", {}).get("options", [])}
+            if aff_key not in opts:
+                raise ValueError(f"unknown affinity config {aff_key!r}")
+            affinity = opts[aff_key]["affinity"]
+
+        # toleration group: selected groupKey -> toleration list
+        tolerations = None
+        tol_key = gfv("tolerationGroup")
+        if tol_key and tol_key != "none":
+            groups = {g["groupKey"]: g for g in
+                      self.config.get("tolerationGroup", {}).get(
+                          "options", [])}
+            if tol_key not in groups:
+                raise ValueError(f"unknown toleration group {tol_key!r}")
+            tolerations = groups[tol_key]["tolerations"]
+
+        def ensure_pvc(pvc_name: str, spec: dict) -> None:
+            req.authorize("create", "PersistentVolumeClaim", ns)
+            try:
+                self.server.get("PersistentVolumeClaim", pvc_name, ns)
+            except NotFound:
+                self.server.create(api_object(
+                    "PersistentVolumeClaim", pvc_name, ns, spec=spec))
+
         # volumes: create new PVCs, collect mounts (post.py:38-62)
         workspace_pvc = None
         ws = gfv("workspaceVolume")
@@ -146,14 +175,23 @@ class JupyterApp(CrudApp):
             pvc_name = (pvc_spec.get("metadata", {}).get("name",
                                                          "{notebook-name}")
                         .replace("{notebook-name}", name))
-            req.authorize("create", "PersistentVolumeClaim", ns)
-            try:
-                self.server.get("PersistentVolumeClaim", pvc_name, ns)
-            except NotFound:
-                self.server.create(api_object(
-                    "PersistentVolumeClaim", pvc_name, ns,
-                    spec=pvc_spec.get("spec", {})))
+            ensure_pvc(pvc_name, pvc_spec.get("spec", {}))
             workspace_pvc = pvc_name
+
+        # data volumes: attach existing PVCs or create new ones
+        # ({"name": pvc, "size": "10Gi", "mount": path, "existing": bool})
+        data_volumes = []
+        for i, dv in enumerate(gfv("dataVolumes") or []):
+            pvc_name = ((dv.get("name") or f"{{notebook-name}}-data-{i}")
+                        .replace("{notebook-name}", name))
+            if dv.get("existing"):
+                self.server.get("PersistentVolumeClaim", pvc_name, ns)
+            else:
+                ensure_pvc(pvc_name, {
+                    "resources": {"requests": {
+                        "storage": dv.get("size", "10Gi")}},
+                    "accessModes": ["ReadWriteOnce"]})
+            data_volumes.append({"pvc": pvc_name, "mount": dv.get("mount")})
 
         labels = {"notebook-name": name}
         for conf_name in (gfv("configurations") or []):
@@ -166,9 +204,18 @@ class JupyterApp(CrudApp):
                 raise ValueError(f"unknown configuration {conf_name!r}")
 
         nb = nb_api.new(name, ns, image=image, cpu=str(cpu),
-                        memory=str(memory), tpu_resource=tpu_resource,
+                        memory=str(memory),
+                        cpu_limit=spawner_config.limit_for(
+                            cpu, self.config.get("cpu", {}).get(
+                                "limitFactor")),
+                        memory_limit=spawner_config.limit_for(
+                            memory, self.config.get("memory", {}).get(
+                                "limitFactor")),
+                        tpu_resource=tpu_resource,
                         tpu_chips=tpu_chips, workspace_pvc=workspace_pvc,
-                        labels=labels)
+                        data_volumes=data_volumes, affinity=affinity,
+                        tolerations=tolerations,
+                        shm=bool(gfv("shm")), labels=labels)
         # propagate labels onto the pod template so admission matches
         tmeta = nb["spec"]["template"].setdefault("metadata", {})
         tmeta.setdefault("labels", {}).update(labels)

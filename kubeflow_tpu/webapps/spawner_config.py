@@ -48,7 +48,34 @@ DEFAULT_CONFIG: dict[str, Any] = {
         "readOnly": False,
     },
     "dataVolumes": {"value": [], "readOnly": False},
-    "affinityConfig": {"value": "", "options": [], "readOnly": False},
+    "affinityConfig": {
+        "value": "",
+        "options": [
+            # TPU-first presets filling the reference's commented-out
+            # affinityConfig examples (spawner_ui_config.yaml:155-180):
+            # dedicate a TPU-VM host to one notebook, or pin to hosts that
+            # actually carry chips.
+            {"configKey": "exclusive-tpu-host",
+             "displayName": "Exclusive: one notebook per TPU-VM host",
+             "affinity": {
+                 "podAntiAffinity": {
+                     "requiredDuringSchedulingIgnoredDuringExecution": [{
+                         "labelSelector": {"matchExpressions": [
+                             {"key": "notebook-name",
+                              "operator": "Exists"}]},
+                         "topologyKey": "kubernetes.io/hostname",
+                     }]}}},
+            {"configKey": "tpu-host-only",
+             "displayName": "Require: schedule on TPU-VM hosts",
+             "affinity": {
+                 "nodeAffinity": {
+                     "requiredDuringSchedulingIgnoredDuringExecution": {
+                         "nodeSelectorTerms": [{"matchExpressions": [
+                             {"key": "cloud.google.com/gke-tpu-topology",
+                              "operator": "Exists"}]}]}}}},
+        ],
+        "readOnly": False,
+    },
     "tolerationGroup": {
         "value": "none",
         "options": [
@@ -80,3 +107,28 @@ def get_form_value(body: dict, config: dict, field: str,
     if spec.get("readOnly"):
         return spec.get("value")
     return body.get(body_field or field, spec.get("value"))
+
+
+_QUANTITY_UNITS = ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki",
+                   "E", "P", "T", "G", "M", "K", "m")
+
+
+def limit_for(request: str, factor) -> str | None:
+    """request * limitFactor -> limit string (reference form.py cpu/memory
+    limit semantics); factor None/"none" means no limit.  An unparseable
+    quantity raises (a silent None would drop the admin's limit)."""
+    if factor in (None, "none", ""):
+        return None
+    s = str(request).strip()
+    unit = ""
+    num = s
+    for u in _QUANTITY_UNITS:
+        if s.endswith(u):
+            unit, num = u, s[:-len(u)]
+            break
+    try:
+        scaled = float(num) * float(factor)
+    except ValueError:
+        raise ValueError(f"cannot parse resource quantity {request!r}")
+    text = f"{scaled:.3f}".rstrip("0").rstrip(".")
+    return f"{text}{unit}"

@@ -1,0 +1,113 @@
+"""HPO trial-scheduling-latency load test — the driver-defined Katib-analog
+metric in BASELINE.json ("Katib trial scheduling latency").
+
+Runs one Experiment of N trials whose JAXJob gangs contend for a bounded
+slice pool (the preemptible-slice trial path: TrialController creates gang
+jobs with the preemptible toleration; the slice scheduler releases them
+FIFO).  Scheduling latency per trial = Trial CR creation -> its JAXJob
+leaving Pending (gang released + pods admitted).  Reports p50/p99 latency,
+experiment makespan, and trials/sec.
+
+Usage: python loadtest/load_hpo.py [N_TRIALS] [PARALLEL] [M_SLICES]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def pct(xs: list[float], p: float) -> float:
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p / 100 * len(xs)))]
+
+
+def main() -> int:
+    n_trials = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    parallel = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    m_slices = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+
+    from kubeflow_tpu.api import experiment as api
+    from kubeflow_tpu.controllers import scheduler
+    from kubeflow_tpu.controllers.executor import FakeExecutor
+    from kubeflow_tpu.controllers.jaxjob import JAXJobController
+    from kubeflow_tpu.core import APIServer, Manager
+    from kubeflow_tpu.hpo.controller import register
+
+    server = APIServer()
+    server.create(scheduler.new_pool({"v5e-4": m_slices}))
+    mgr = Manager(server)
+    register(server, mgr)
+    mgr.add(JAXJobController(server))
+    mgr.add(FakeExecutor(server, run_for=0.2))
+    mgr.start()
+
+    exp = api.new(
+        "latency", "loadtest",
+        objective={"type": "minimize", "metric": "final_loss"},
+        algorithm={"name": "random", "seed": 0},
+        parameters=[{"name": "lr", "type": "double",
+                     "min": 1e-4, "max": 1e-1, "logScale": True}],
+        trial_template={"topology": "v5e-4",
+                        "trainer": {"model": "cifar_convnet", "steps": 1}},
+        parallel_trials=parallel, max_trials=n_trials,
+        max_failed_trials=n_trials)
+    t0 = time.monotonic()
+    server.create(exp)
+
+    deadline = time.monotonic() + 120
+    done = None
+    while time.monotonic() < deadline:
+        done = server.get(api.KIND, "latency", "loadtest")
+        if done.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            break
+        time.sleep(0.05)
+    makespan = time.monotonic() - t0
+    phase = done.get("status", {}).get("phase")
+    mgr.stop()
+
+    if phase not in ("Succeeded",):
+        print(f"FAIL: experiment ended {phase!r}")
+        return 1
+
+    # scheduling latency: trial created -> its gang released onto a slice.
+    # Gangs that had to queue carry a WaitingForSlices condition whose
+    # False transition stamps the release; gangs scheduled instantly never
+    # get the condition — their latency is the first pod's creation.
+    from kubeflow_tpu.core.objects import get_condition
+
+    lats, waited = [], 0
+    trials = server.list(api.TRIAL_KIND, namespace="loadtest")
+    if len(trials) < n_trials:
+        print(f"FAIL: only {len(trials)} trials materialized")
+        return 1
+    for t in trials:
+        created = t["metadata"]["creationTimestamp"]
+        job = server.get("JAXJob", t["metadata"]["name"], "loadtest")
+        cond = get_condition(job, "WaitingForSlices")
+        if cond is not None and cond["status"] == "False":
+            released = cond["lastTransitionTime"]
+            waited += 1
+        else:
+            pods = [p for p in server.list("Pod", namespace="loadtest")
+                    if p["metadata"]["name"].startswith(
+                        t["metadata"]["name"] + "-")]
+            released = min((p["metadata"]["creationTimestamp"]
+                            for p in pods), default=created)
+        lats.append(max(0.0, released - created))
+
+    concurrent_peak = done["status"].get("trialsRunningPeak")
+    print(f"trials={n_trials} parallel={parallel} slices={m_slices}")
+    print(f"experiment makespan: {makespan:.2f}s "
+          f"({n_trials / makespan:.1f} trials/s)")
+    print(f"trial scheduling latency: p50={pct(lats, 50) * 1e3:.0f}ms "
+          f"p90={pct(lats, 90) * 1e3:.0f}ms p99={pct(lats, 99) * 1e3:.0f}ms "
+          f"max={max(lats) * 1e3:.0f}ms ({waited}/{n_trials} queued for "
+          "a slice)")
+    if concurrent_peak is not None:
+        print(f"peak concurrent trials: {concurrent_peak}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,11 +106,15 @@ def test_js_contracts(stack):
     assert "readOnly" in jup and "admin-pinned" in jup
     assert "/jupyter/api/config" in jup     # form generated from config
     assert "poddefaults" in jup             # configurations checkboxes
+    assert "dataVolumes" in jup             # data-volume rows submitted
+    assert "affinityConfig" in jup and "tolerationGroup" in jup
+    assert "/events" in jup                 # details drawer reads events
     _, dash, _ = b.req("/static/dashboard.js", raw=True)
     dash = dash.decode()
     assert "workgroup/create" in dash       # registration flow
     assert "add-contributor" in dash and "remove-contributor" in dash
     assert "?" in dash and "ns=" in dash    # namespace propagated to iframes
+    assert "/apis/PipelineRun" in dash      # training+pipelines card
 
 
 # -------------------------------------------------------------- journey ----

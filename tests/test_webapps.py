@@ -112,6 +112,75 @@ def test_spawner_full_flow(stack):
     assert listing["notebooks"] == []
 
 
+def test_spawner_datavols_affinity_tolerations_shm(stack):
+    """The full spawner form surface (reference form.py): data volumes
+    (new + existing), affinity preset, toleration group, /dev/shm mount,
+    and cpu/memory limits scaled by limitFactor."""
+    server, mgr, base = stack
+    alice = Client(base, "alice@corp.com")
+
+    # an existing PVC to attach as a data volume
+    code, _ = alice.req("/volumes/api/namespaces/team/pvcs", "POST",
+                        {"name": "datasets", "size": "5Gi"})
+    assert code == 201
+
+    code, created = alice.req(
+        "/jupyter/api/namespaces/team/notebooks", "POST",
+        {"name": "nb2", "cpu": "1", "memory": "2.0Gi",
+         "dataVolumes": [
+             {"existing": True, "name": "datasets", "mount": "/data/sets"},
+             {"name": "{notebook-name}-scratch", "size": "20Gi"},
+         ],
+         "affinityConfig": "exclusive-tpu-host",
+         "tolerationGroup": "tpu-preemptible",
+         "shm": True})
+    assert code == 201, created
+
+    nb = server.get("Notebook", "nb2", "team")
+    spec = nb["spec"]["template"]["spec"]
+    c0 = spec["containers"][0]
+
+    # limits = requests * limitFactor (1.2)
+    assert c0["resources"]["limits"]["cpu"] == "1.2"
+    assert c0["resources"]["limits"]["memory"] == "2.4Gi"
+
+    vols = {v["name"]: v for v in spec["volumes"]}
+    mounts = {m["name"]: m["mountPath"] for m in c0["volumeMounts"]}
+    assert vols["data-0"]["persistentVolumeClaim"]["claimName"] == \
+        "datasets"
+    assert mounts["data-0"] == "/data/sets"
+    # templated new data volume was created
+    scratch = server.get("PersistentVolumeClaim", "nb2-scratch", "team")
+    assert scratch["spec"]["resources"]["requests"]["storage"] == "20Gi"
+    assert vols["data-1"]["persistentVolumeClaim"]["claimName"] == \
+        "nb2-scratch"
+    # tmpfs bounded by the memory limit (not node RAM)
+    assert vols["dshm"]["emptyDir"] == {"medium": "Memory",
+                                        "sizeLimit": "2.4Gi"}
+    assert mounts["dshm"] == "/dev/shm"
+
+    # affinity preset + toleration group landed on the pod spec
+    anti = spec["affinity"]["podAntiAffinity"]
+    assert anti["requiredDuringSchedulingIgnoredDuringExecution"][0][
+        "topologyKey"] == "kubernetes.io/hostname"
+    assert spec["tolerations"][0]["key"] == \
+        "cloud.google.com/gke-preemptible"
+
+    # an unknown preset is a clean 4xx, not a crash
+    with pytest.raises(urllib.error.HTTPError) as e:
+        alice.req("/jupyter/api/namespaces/team/notebooks", "POST",
+                  {"name": "nb3", "affinityConfig": "no-such-preset"})
+    assert e.value.code == 422
+    assert "affinity" in json.loads(e.value.read())["error"]
+
+    # attaching a non-existent PVC as existing fails loudly
+    with pytest.raises(urllib.error.HTTPError) as e:
+        alice.req("/jupyter/api/namespaces/team/notebooks", "POST",
+                  {"name": "nb4",
+                   "dataVolumes": [{"existing": True, "name": "ghost"}]})
+    assert e.value.code in (404, 422)
+
+
 def test_authz_blocks_non_members(stack):
     server, mgr, base = stack
     mallory = Client(base, "mallory@corp.com")
