@@ -197,6 +197,42 @@ def measure_serving(max_new: int = 96, n_requests: int = 6) -> dict:
             "serving_requests": n_requests}
 
 
+def _measure_decode(size: str, quantize: bool, max_new: int = 128) -> float:
+    """Single-stream decode tok/s — the weight-bandwidth-bound regime."""
+    from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+    pred = GenerativePredictor("llama", size=size, max_batch=1,
+                               max_seq=256, quantize=quantize,
+                               fast_init=True)
+    try:
+        prompt = [[1, 2, 3, 4]]
+        pred.generate(prompt, max_new_tokens=max_new)   # warm / compile
+        out = pred.generate(prompt, max_new_tokens=max_new)
+        return out["tokens_per_sec"]
+    finally:
+        pred.engine.shutdown()
+
+
+def measure_quant() -> dict:
+    """int8 weight-only serving vs bf16 on a 3B llama (serving/quant.py):
+    decode streams weights every token, so int8 should approach 2x."""
+    rows = {}
+    for label, q in (("bf16", False), ("int8", True)):
+        tps = _measure_decode("3b", q)
+        rows[f"llama3b_decode_tok_s_{label}"] = round(tps, 1)
+        _log(f"llama-3b {label} single-stream decode: {tps:.1f} tok/s")
+    return rows
+
+
+def measure_quant7b() -> dict:
+    """Llama-2-7B int8 on ONE v5e chip — bf16 (13.5 GB + cache) does not
+    fit 16 GB HBM; weight-only int8 (~6.9 GB) makes the BASELINE.json
+    'Llama-2-7B text-gen predictor' config single-chip-servable."""
+    tps = _measure_decode("7b", True, max_new=64)
+    _log(f"llama-2-7b int8 single-stream decode: {tps:.1f} tok/s")
+    return {"llama7b_int8_decode_tok_s": round(tps, 1)}
+
+
 def _backend_or_die(timeout_s: float = 600.0):
     """Initialize the JAX backend with a watchdog: a wedged TPU tunnel
     hangs make_c_api_client forever, which must fail the bench loudly
@@ -257,7 +293,9 @@ def _run_extra_subprocess(name: str, timeout: float = 900.0) -> dict:
 def _extra_entry(name: str) -> None:
     _backend_or_die()
     out = {"flash": measure_flash_longseq,
-           "serving": measure_serving}[name]()
+           "serving": measure_serving,
+           "quant": measure_quant,
+           "quant7b": measure_quant7b}[name]()
     print(json.dumps(out))
 
 
@@ -316,6 +354,8 @@ def main() -> None:
     extra = {}
     extra.update(_run_extra_subprocess("flash"))
     extra.update(_run_extra_subprocess("serving"))
+    extra.update(_run_extra_subprocess("quant", timeout=1200))
+    extra.update(_run_extra_subprocess("quant7b", timeout=1200))
     print(json.dumps({
         "metric": "bert_large_pretrain_samples_per_sec_per_chip",
         "value": round(value, 3),
@@ -326,7 +366,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 2 and sys.argv[1] == "--extra":
+    if len(sys.argv) > 1 and sys.argv[1] == "--extra":
+        if len(sys.argv) < 3:
+            raise SystemExit("usage: bench.py --extra "
+                             "{flash|serving|quant|quant7b}")
         _extra_entry(sys.argv[2])
     else:
         main()

@@ -181,7 +181,7 @@
       el("h2", null, "Training & Pipelines"),
       el("div", { class: "muted" }, "…"));
     cards.append(jobsCard);
-    Promise.all([
+    Promise.allSettled([
       api.get(`/apis/JAXJob?namespace=${state.ns}`),
       api.get(`/apis/Experiment?namespace=${state.ns}`),
       api.get(`/apis/PipelineRun?namespace=${state.ns}`),
@@ -190,14 +190,19 @@
       const running = (xs) => xs.filter(
         (o) => ["Running", "Pending", "Restarting"].includes(phase(o)))
         .length;
-      const line = (label, xs) => el("li", null,
-        `${label}: ${running(xs)} active / ${xs.length} total`);
+      // one denied/failed list degrades to its own "unavailable" line,
+      // not a blank card
+      const line = (label, settled) => settled.status !== "fulfilled"
+        ? el("li", { class: "muted" }, `${label}: unavailable`)
+        : el("li", null, `${label}: ` +
+            `${running(settled.value.items || [])} active / ` +
+            `${(settled.value.items || []).length} total`);
       jobsCard.replaceChildren(el("h2", null, "Training & Pipelines"),
         el("ul", null,
-          line("JAXJobs", jobs.items || []),
-          line("Experiments", exps.items || []),
-          line("Pipeline runs", runs.items || [])));
-    }).catch(() => jobsCard.append(errorBox("unavailable")));
+          line("JAXJobs", jobs),
+          line("Experiments", exps),
+          line("Pipeline runs", runs)));
+    });
 
     // metrics cards
     for (const [mtype, title] of [["tpuduty", "TPU duty cycle"],

@@ -214,9 +214,14 @@
         el("label", null, workspace, " create + mount a workspace PVC"),
         { readOnly: cfg.workspaceVolume.readOnly }),
       field("Data volumes",
-        el("div", null, dvList,
-          el("button", { class: "icon", onclick: addDataVolume },
-            "+ add data volume")),
+        (cfg.dataVolumes && cfg.dataVolumes.readOnly)
+          // readOnly pins the admin's list: no interactive rows at all
+          ? el("div", { class: "muted" },
+              ((cfg.dataVolumes.value || []).map((d) => d.name).join(", "))
+              || "none")
+          : el("div", null, dvList,
+              el("button", { class: "icon", onclick: addDataVolume },
+                "+ add data volume")),
         { readOnly: cfg.dataVolumes && cfg.dataVolumes.readOnly,
           hint: "existing = attach a PVC you already have; otherwise " +
                 "one is created (name / size / mount path)" }),

@@ -55,6 +55,13 @@ def llama2_13b(**kw) -> LlamaConfig:
                        num_kv_heads=40, intermediate_size=13824, **kw)
 
 
+def llama_3b(**kw) -> LlamaConfig:
+    """OpenLLaMA-3B shape: the largest size that fits a 16 GB v5e chip in
+    bf16 WITH headroom — the bench pair for the int8 bandwidth win."""
+    return LlamaConfig(hidden_size=3200, num_layers=26, num_heads=32,
+                       num_kv_heads=32, intermediate_size=8640, **kw)
+
+
 def llama_tiny(**kw) -> LlamaConfig:
     kw.setdefault("use_flash", False)
     return LlamaConfig(vocab_size=512, hidden_size=64, num_layers=2,

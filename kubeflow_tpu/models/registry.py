@@ -184,8 +184,8 @@ register(ModelEntry(
 def _make_llama(size: str = "tiny", **cfg):
     from kubeflow_tpu.models import llama
 
-    factory = {"tiny": llama.llama_tiny, "7b": llama.llama2_7b,
-               "13b": llama.llama2_13b}[size]
+    factory = {"tiny": llama.llama_tiny, "3b": llama.llama_3b,
+               "7b": llama.llama2_7b, "13b": llama.llama2_13b}[size]
     return llama.LlamaModel(factory(**cfg))
 
 

@@ -34,7 +34,8 @@ class GenerativePredictor:
     def __init__(self, model_name: str = "llama", size: str = "tiny",
                  model_config: dict | None = None,
                  checkpoint_dir: str | None = None,
-                 max_batch: int = 4, max_seq: int = 512, seed: int = 0):
+                 max_batch: int = 4, max_seq: int = 512, seed: int = 0,
+                 quantize: bool = False, fast_init: bool = False):
         from kubeflow_tpu.models import registry
 
         self.log = get_logger("predictor", model=model_name, size=size)
@@ -45,12 +46,48 @@ class GenerativePredictor:
         self.max_seq = min(max_seq, self.cfg.max_seq_len)
         rng = jax.random.PRNGKey(seed)
         example = jnp.zeros((1, 8), jnp.int32)
-        params = self.module.init(rng, example)["params"]
         from kubeflow_tpu.parallel.sharding import unbox_params
 
-        self.params = unbox_params(params)
-        if checkpoint_dir:
-            self._restore(checkpoint_dir)
+        def init_params():
+            if not fast_init:
+                return unbox_params(self.module.init(rng, example)["params"])
+            # fast_init: zero-filled weights from eval_shape — for
+            # BENCHMARKS ONLY (decode timing is value-independent; a real
+            # deployment restores a checkpoint).  Skips minutes of
+            # single-core threefry for multi-billion-param random init.
+            shapes = jax.eval_shape(
+                lambda r: self.module.init(r, example)["params"], rng)
+            return jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                unbox_params(shapes))
+
+        if quantize:
+            # weight-only int8 (serving/quant.py): init + restore +
+            # quantize happen ON THE HOST so the accelerator never holds
+            # the full-precision tree — a 7B llama (27 GB f32) quantizes
+            # down to ~6.9 GB before the only device transfer, which is
+            # what lets it serve from one 16 GB v5e chip at all
+            from kubeflow_tpu.serving.quant import (
+                quantize_params,
+                quantized_bytes,
+            )
+
+            cpu = jax.local_devices(backend="cpu")[0]
+            with jax.default_device(cpu):
+                self.params = init_params()
+                if checkpoint_dir:
+                    self._restore(checkpoint_dir)
+                before = sum(x.size * x.dtype.itemsize for x in
+                             jax.tree_util.tree_leaves(self.params))
+                self.params = quantize_params(self.params)
+            self.params = jax.device_put(self.params, jax.devices()[0])
+            self.log.info("quantized weights int8",
+                          bytes_before=before,
+                          bytes_after=quantized_bytes(self.params))
+        else:
+            self.params = init_params()
+            if checkpoint_dir:
+                self._restore(checkpoint_dir)
         from kubeflow_tpu.serving.engine import ContinuousBatcher
 
         self.engine = ContinuousBatcher(self.module, self.params, self.cfg,
@@ -228,7 +265,9 @@ def main(argv=None) -> int:
             predictors[name] = GenerativePredictor(
                 name, size=size, checkpoint_dir=ckpt,
                 max_batch=int(opts.get("max_batch", args.max_batch)),
-                max_seq=int(opts.get("max_seq", args.max_seq)))
+                max_seq=int(opts.get("max_seq", args.max_seq)),
+                quantize=opts.get("quantize", "").lower()
+                in ("1", "true", "int8"))
         else:
             predictors[name] = ClassifierPredictor(name,
                                                    checkpoint_dir=ckpt)

@@ -1,0 +1,117 @@
+"""Weight-only int8 quantization for serving (TPU-first).
+
+Post-training, per-output-channel symmetric int8 on the matmul weights:
+``w ≈ q * scale`` with ``q`` int8 and ``scale = max|w| / 127`` taken over
+the contraction axis.  The quantized leaf is a :class:`QTensor` pytree
+whose ``__jax_array__`` dequantizes to bfloat16 inline — flax modules call
+``jnp.asarray(kernel, dtype)`` on their params, so NO model code changes:
+XLA fuses the int8→bf16 convert + scale into the matmul's weight read.
+
+Why this is the TPU-native shape of the feature:
+- decode is weight-bandwidth-bound: streaming int8 instead of bf16 halves
+  the HBM bytes per generated token;
+- a Llama-2-7B checkpoint drops from ~13.5 GB (bf16) to ~6.9 GB, fitting
+  a single 16 GB v5e chip with room for the KV cache — the KServe
+  "one-GPU-per-replica" sizing constraint the reference ecosystem
+  inherits simply disappears;
+- everything stays static-shaped and jit-compatible (QTensor is a pytree;
+  the dequant is traced like any other op).
+
+Only matmul kernels are quantized (paths ending in ``kernel`` and the MoE
+``w_in``/``w_out``).  Embedding tables (gathered, not contracted), norm
+gains, biases, and the MoE router (routing decisions are precision-
+sensitive and tiny) stay in full precision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+QUANT_LEAF_NAMES = ("kernel", "w_in", "w_out")
+SKIP_PATH_PARTS = ("router",)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """int8 weights + broadcastable scales; dequantizes on use."""
+
+    q: jax.Array      # int8, original shape
+    scale: jax.Array  # float32, keepdims over the contraction axis
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # array-protocol surface flax/jax touch on params
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def size(self):
+        return self.q.size
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16
+
+    def __jax_array__(self) -> jax.Array:
+        return self.q.astype(jnp.bfloat16) * self.scale.astype(jnp.bfloat16)
+
+
+def quantize_array(w: jax.Array, axis: int = 0) -> QTensor:
+    """Symmetric per-channel int8 over ``axis`` (the contraction axis)."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax, 1.0) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q, scale.astype(jnp.float32))
+
+
+def _wants_quant(path) -> bool:
+    keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+    if any(part in keys for part in SKIP_PATH_PARTS):
+        return False
+    return bool(keys) and keys[-1] in QUANT_LEAF_NAMES
+
+
+def quantize_params(params, *, min_size: int = 1 << 12):
+    """Quantize every eligible matmul kernel in a (plain) params pytree.
+
+    min_size skips tiny kernels where int8 saves nothing but costs
+    accuracy.  Returns a new pytree; non-kernel leaves pass through.
+    """
+    def one(path, leaf):
+        if (_wants_quant(path) and getattr(leaf, "ndim", 0) >= 2
+                and leaf.size >= min_size):
+            # DenseGeneral kernels contract on axis 0 (input features);
+            # MoE w_in/w_out are [expert, in, out]-style stacks whose
+            # contraction is the second-to-last axis
+            axis = leaf.ndim - 2 if keys_last(path) in ("w_in", "w_out") \
+                else 0
+            return quantize_array(leaf, axis=axis)
+        return leaf
+
+    def keys_last(path):
+        return getattr(path[-1], "key", getattr(path[-1], "name", ""))
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def quantized_bytes(params) -> int:
+    """Approximate in-memory parameter bytes after quantization."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total

@@ -109,8 +109,10 @@ def get_form_value(body: dict, config: dict, field: str,
     return body.get(body_field or field, spec.get("value"))
 
 
+# k8s resource.Quantity suffixes: binary (Ki..Ei), decimal (k..E — note
+# LOWERCASE k), and the sub-unit m/u/n used for cpu millicores
 _QUANTITY_UNITS = ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki",
-                   "E", "P", "T", "G", "M", "K", "m")
+                   "E", "P", "T", "G", "M", "k", "m", "u", "n")
 
 
 def limit_for(request: str, factor) -> str | None:

@@ -96,7 +96,6 @@ def main() -> int:
                             for p in pods), default=created)
         lats.append(max(0.0, released - created))
 
-    concurrent_peak = done["status"].get("trialsRunningPeak")
     print(f"trials={n_trials} parallel={parallel} slices={m_slices}")
     print(f"experiment makespan: {makespan:.2f}s "
           f"({n_trials / makespan:.1f} trials/s)")
@@ -104,8 +103,6 @@ def main() -> int:
           f"p90={pct(lats, 90) * 1e3:.0f}ms p99={pct(lats, 99) * 1e3:.0f}ms "
           f"max={max(lats) * 1e3:.0f}ms ({waited}/{n_trials} queued for "
           "a slice)")
-    if concurrent_peak is not None:
-        print(f"peak concurrent trials: {concurrent_peak}")
     return 0
 
 
