@@ -99,7 +99,8 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                          "kubeflow_tpu/api/inferenceservice.py",
                          "kubeflow_tpu/controllers/inferenceservice.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
-                     "tests/test_serving.py", "tests/test_serving_engine.py"],
+                     "tests/test_serving.py", "tests/test_serving_engine.py",
+                     "tests/test_quant.py"],
         "image": "images/predictor",
     },
     "pipelines": {

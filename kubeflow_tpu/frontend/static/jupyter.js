@@ -118,6 +118,9 @@
       { title: "Memory", render: (nb) => nb.memory || "" },
       { title: "TPUs", render: tpuCell },
       { title: "Age", render: (nb) => age(nb.createdAt) },
+      { title: "Last activity", render: (nb) => nb.lastActivity
+          ? age(nb.lastActivity) + " ago"
+          : el("span", { class: "muted" }, "—") },
       { title: "Connect", render: connectCell },
       { title: "", render: (nb) => actionsCell(nb, tbl) },
     ],

@@ -54,6 +54,8 @@ class GenRequest:
     temperature: float
     eos_id: int | None = None
     seed: int = 0
+    top_k: int = 0        # 0 = disabled
+    top_p: float = 0.0    # 0 or >= 1 = disabled
     submitted_at: float = field(default_factory=time.perf_counter)
     first_token_at: float | None = None
     generated: list[int] = field(default_factory=list)
@@ -92,6 +94,8 @@ class ContinuousBatcher:
         self.index = jnp.zeros((max_batch,), jnp.int32)
         self.last_token = jnp.zeros((max_batch,), jnp.int32)
         self.temps = jnp.zeros((max_batch,), jnp.float32)
+        self.top_ks = jnp.zeros((max_batch,), jnp.int32)
+        self.top_ps = jnp.zeros((max_batch,), jnp.float32)
         # one PRNG chain PER SLOT: a request's samples depend only on its
         # own (seed, step) — deterministic regardless of co-batched traffic
         self.keys = jnp.zeros((max_batch, 2), jnp.uint32)
@@ -109,19 +113,24 @@ class ContinuousBatcher:
     # -- public ----------------------------------------------------------------
     def submit(self, ids: list[int], max_new_tokens: int = 32,
                temperature: float = 0.0, eos_id: int | None = None,
-               seed: int | None = None) -> GenRequest:
+               seed: int | None = None, top_k: int = 0,
+               top_p: float = 0.0) -> GenRequest:
         if len(ids) + max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt+new ({len(ids) + max_new_tokens}) > max_seq "
                 f"{self.max_seq}")
         if not ids:
             raise ValueError("empty prompt")
+        if top_k < 0:
+            raise ValueError("top_k must be >= 0")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError("top_p must be in [0, 1]")
         with self._work:
             if seed is None:
                 self._auto_seed += 1
                 seed = self._auto_seed
         req = GenRequest(list(ids), max_new_tokens, temperature, eos_id,
-                         seed=seed)
+                         seed=seed, top_k=top_k, top_p=top_p)
         with self._work:
             self.queue.append(req)
             QUEUE_DEPTH.set(len(self.queue))
@@ -136,10 +145,12 @@ class ContinuousBatcher:
 
     def generate_sync(self, batch: list[list[int]], max_new_tokens: int = 32,
                       temperature: float = 0.0, eos_id: int | None = None,
-                      seed: int | None = None) -> list[list[int]]:
+                      seed: int | None = None, top_k: int = 0,
+                      top_p: float = 0.0) -> list[list[int]]:
         """Submit a whole (possibly ragged) batch and wait for all rows."""
         reqs = [self.submit(ids, max_new_tokens, temperature, eos_id,
-                            seed=None if seed is None else seed + i)
+                            seed=None if seed is None else seed + i,
+                            top_k=top_k, top_p=top_p)
                 for i, ids in enumerate(batch)]
         return [r.result() for r in reqs]
 
@@ -162,12 +173,13 @@ class ContinuousBatcher:
                                           per_sequence=True)
 
             @jax.jit
-            def fn(params, ids, last_pos, temp, key):
+            def fn(params, ids, last_pos, temp, key, top_k, top_p):
                 out = self.module.apply({"params": params}, ids,
                                         cache=cache0)
                 logits = jax.lax.dynamic_index_in_dim(
                     out["logits"][0], last_pos, axis=0, keepdims=False)
-                tok = _sample_rows(logits[None, :], temp[None], key[None, :])
+                tok = _sample_rows(logits[None, :], temp[None], key[None, :],
+                                   top_k[None], top_p[None])
                 return tok[0], out["cache"]
 
             self._prefill_cache[bucket] = fn
@@ -193,10 +205,16 @@ class ContinuousBatcher:
             self._insert_fn = fn
         return self._insert_fn
 
-    def _decode(self, chunk: int):
-        if chunk not in self._decode_cache:
+    def _decode(self, chunk: int, filtered: bool):
+        """filtered=False compiles the sort-free sampling variant: the
+        per-token [B, V] sort/softmax/cumsum of top-k/top-p filtering is
+        pure overhead when no active request asked for it, so the hot
+        default path must not pay it."""
+        key = (chunk, filtered)
+        if key not in self._decode_cache:
             @functools.partial(jax.jit, donate_argnums=(2,))
-            def fn(params, token, cache_kv, index, temps, keys):
+            def fn(params, token, cache_kv, index, temps, keys,
+                   top_ks, top_ps):
                 def body(carry, _):
                     token, cache_kv, index, keys = carry
                     full = {"layers": [dict(l, index=index)
@@ -207,8 +225,10 @@ class ContinuousBatcher:
                     # independent: sample g of a request always uses the
                     # g-th key of its chain)
                     split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
-                    nxt = _sample_rows(out["logits"][:, 0], temps,
-                                       split[:, 0])
+                    nxt = _sample_rows(
+                        out["logits"][:, 0], temps, split[:, 0],
+                        top_ks if filtered else None,
+                        top_ps if filtered else None)
                     return (nxt, _kv_only(out["cache"]), index + 1,
                             split[:, 1]), nxt
 
@@ -216,8 +236,8 @@ class ContinuousBatcher:
                     body, (token, cache_kv, index, keys), None, length=chunk)
                 return toks, cache_kv, keys  # toks: [chunk, B]
 
-            self._decode_cache[chunk] = fn
-        return self._decode_cache[chunk]
+            self._decode_cache[key] = fn
+        return self._decode_cache[key]
 
     # -- the scheduling loop ---------------------------------------------------
     def _loop(self) -> None:
@@ -272,7 +292,8 @@ class ContinuousBatcher:
                 jax.random.PRNGKey(req.seed))
             tok, small_cache = self._prefill(bucket)(
                 self.params, arr, jnp.int32(prompt_len - 1),
-                jnp.float32(req.temperature), k_first)
+                jnp.float32(req.temperature), k_first,
+                jnp.int32(req.top_k), jnp.float32(req.top_p))
             self.cache = self._insert()(self.cache, small_cache,
                                         jnp.int32(free))
             tok_host = int(tok)
@@ -283,6 +304,8 @@ class ContinuousBatcher:
             self.index = self.index.at[free].set(prompt_len)
             self.last_token = self.last_token.at[free].set(tok_host)
             self.temps = self.temps.at[free].set(req.temperature)
+            self.top_ks = self.top_ks.at[free].set(req.top_k)
+            self.top_ps = self.top_ps.at[free].set(req.top_p)
             self.keys = self.keys.at[free].set(k_chain)
             with self._work:
                 self.slots[free] = req
@@ -315,9 +338,11 @@ class ContinuousBatcher:
                 chunk = next((c for c in reversed(DECODE_CHUNKS)
                               if c <= mn), DECODE_CHUNKS[0])
         t0 = time.perf_counter()
-        toks, self.cache, self.keys = self._decode(chunk)(
+        filtered = any(s is not None and (s.top_k or s.top_p)
+                       for s in self.slots)
+        toks, self.cache, self.keys = self._decode(chunk, filtered)(
             self.params, self.last_token, self.cache, self.index,
-            self.temps, self.keys)
+            self.temps, self.keys, self.top_ks, self.top_ps)
         host_toks = jax.device_get(toks)  # [chunk, B] — the sync point
         dt = time.perf_counter() - t0
 
@@ -372,13 +397,54 @@ def _kv_only(cache: dict) -> dict:
                        for l in cache["layers"]]}
 
 
-def _sample_rows(logits: jax.Array, temps: jax.Array,
-                 keys: jax.Array) -> jax.Array:
+def _filter_logits(logits: jax.Array, top_ks: jax.Array,
+                   top_ps: jax.Array) -> jax.Array:
+    """Per-row top-k / top-p (nucleus) masking over [B, V] logits.
+
+    top_ks int32 (0 = off), top_ps float32 (0 or >=1 = off).  Static
+    shapes throughout: thresholds come from a descending sort, disabled
+    rows keep everything.  Top-1 always survives either filter.
+    """
+    v = logits.shape[-1]
+    sorted_lg = jnp.sort(logits, axis=-1)[:, ::-1]          # [B, V] desc
+
+    # top-k: keep logits >= the k-th largest value
+    k_idx = jnp.clip(top_ks, 1, v) - 1
+    kth = jnp.take_along_axis(sorted_lg, k_idx[:, None], axis=-1)
+    keep_k = jnp.where((top_ks > 0)[:, None], logits >= kth, True)
+
+    # top-p: keep the smallest prefix of the sorted distribution whose
+    # mass reaches p (exclusive cumsum keeps the top token always)
+    probs = jax.nn.softmax(sorted_lg, axis=-1)
+    cum_excl = jnp.cumsum(probs, axis=-1) - probs
+    kept_sorted = cum_excl < top_ps[:, None]                 # [B, V]
+    last_kept = jnp.maximum(jnp.sum(kept_sorted, axis=-1) - 1, 0)
+    pth = jnp.take_along_axis(sorted_lg, last_kept[:, None], axis=-1)
+    p_on = ((top_ps > 0.0) & (top_ps < 1.0))[:, None]
+    keep_p = jnp.where(p_on, logits >= pth, True)
+
+    return jnp.where(keep_k & keep_p, logits, -jnp.inf)
+
+
+def _sample_rows(logits: jax.Array, temps: jax.Array, keys: jax.Array,
+                 top_ks: jax.Array | None = None,
+                 top_ps: jax.Array | None = None) -> jax.Array:
     """Per-row temperature sampling over [B, V] logits with per-row PRNG
-    keys [B, 2] (temperature 0 = greedy)."""
+    keys [B, 2] (temperature 0 = greedy) and optional per-row top-k /
+    top-p restriction of the sampled support.
+
+    Ordering matches the HF/vLLM convention: temperature scales the
+    distribution FIRST, then the nucleus is taken on the scaled one.
+    """
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1)
-    sampled = jax.vmap(
-        lambda lg, t, k: jax.random.categorical(
-            k, lg / jnp.maximum(t, 1e-6)))(logits, temps, keys)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if top_ks is not None or top_ps is not None:
+        b = logits.shape[0]
+        top_ks = (jnp.zeros((b,), jnp.int32) if top_ks is None
+                  else top_ks)
+        top_ps = (jnp.zeros((b,), jnp.float32) if top_ps is None
+                  else top_ps)
+        scaled = _filter_logits(scaled, top_ks, top_ps)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temps > 0.0, sampled, greedy)

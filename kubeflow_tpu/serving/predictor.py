@@ -77,8 +77,7 @@ class GenerativePredictor:
                 self.params = init_params()
                 if checkpoint_dir:
                     self._restore(checkpoint_dir)
-                before = sum(x.size * x.dtype.itemsize for x in
-                             jax.tree_util.tree_leaves(self.params))
+                before = quantized_bytes(self.params)
                 self.params = quantize_params(self.params)
             self.params = jax.device_put(self.params, jax.devices()[0])
             self.log.info("quantized weights int8",
@@ -110,7 +109,8 @@ class GenerativePredictor:
     # -- API -------------------------------------------------------------------
     def generate(self, ids: list[list[int]], max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0,
-                 eos_id: int | None = None) -> dict:
+                 eos_id: int | None = None, top_k: int = 0,
+                 top_p: float = 0.0) -> dict:
         """Generate continuations for a (possibly RAGGED) batch of prompts.
 
         Routed through the continuous-batching engine: each prompt becomes a
@@ -120,7 +120,7 @@ class GenerativePredictor:
         t0 = time.perf_counter()
         out_ids = self.engine.generate_sync(
             ids, max_new_tokens=max_new_tokens, temperature=temperature,
-            eos_id=eos_id, seed=seed)
+            eos_id=eos_id, seed=seed, top_k=top_k, top_p=top_p)
         dt = time.perf_counter() - t0
         generated = sum(len(o) - len(i) for o, i in zip(out_ids, ids))
         return {
@@ -215,7 +215,9 @@ class PredictorApp:
                         body["ids"],
                         max_new_tokens=int(body.get("max_new_tokens", 32)),
                         temperature=float(body.get("temperature", 0.0)),
-                        eos_id=int(eos) if eos is not None else None)
+                        eos_id=int(eos) if eos is not None else None,
+                        top_k=int(body.get("top_k", 0)),
+                        top_p=float(body.get("top_p", 0.0)))
                 if verb == "predict":
                     return "200 OK", pred.predict(body["instances"])
             else:

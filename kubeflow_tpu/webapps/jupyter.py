@@ -245,6 +245,24 @@ class JupyterApp(CrudApp):
         return "200 OK", {"success": True}
 
     # -- helpers --------------------------------------------------------------
+    def _last_activity(self, nb: dict) -> float | None:
+        """Epoch seconds of last activity from the culler's CHEAP sources
+        (annotation + activity file; the HTTP probe would add a network
+        round-trip per row to every list request), or None."""
+        from kubeflow_tpu.controllers import culler
+
+        if not hasattr(self, "_culler_cfg"):
+            self._culler_cfg = culler.CullerConfig.load()
+        try:
+            stamps = [s for s in (
+                culler.annotation_activity_probe(nb),
+                culler.file_activity_probe(
+                    nb, self._culler_cfg.activity_dir),
+            ) if s is not None]
+        except Exception:
+            return None
+        return max(stamps).timestamp() if stamps else None
+
     def _nb_events(self, nb: dict) -> list[dict]:
         """Events the controller mirrored onto this Notebook CR, newest
         first (the WARNING-status source, common/status.py:9-99)."""
@@ -272,6 +290,7 @@ class JupyterApp(CrudApp):
             "status": notebook_status(nb, events=self._nb_events(nb)),
             "url": nb_api.url_prefix(nb),
             "createdAt": md.get("creationTimestamp"),
+            "lastActivity": self._last_activity(nb),
         }
         if detail:
             out["notebook"] = nb

@@ -96,3 +96,54 @@ def test_temperature_sampling_varies(predictor):
         [7, 7, 7], max_new_tokens=12, temperature=1.5).result(60))
         for _ in range(6)}
     assert len(outs) > 1
+
+
+def test_top_k_top_p_filtering_semantics():
+    """_filter_logits: top-k keeps exactly the k largest, top-p keeps the
+    smallest prefix reaching p mass, 0 disables, top-1 always survives."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import _filter_logits
+
+    logits = jnp.asarray([[1.0, 4.0, 2.0, 3.0],
+                          [1.0, 4.0, 2.0, 3.0],
+                          [1.0, 4.0, 2.0, 3.0]], jnp.float32)
+    ks = jnp.asarray([2, 0, 1], jnp.int32)
+    ps = jnp.asarray([0.0, 0.9, 0.0], jnp.float32)
+    out = np.asarray(_filter_logits(logits, ks, ps))
+    # row 0: top-2 -> keep logits 4 and 3 only
+    assert np.isfinite(out[0][[1, 3]]).all()
+    assert np.isneginf(out[0][[0, 2]]).all()
+    # row 1: p=0.9 over softmax([1,4,2,3]) keeps 4 and 3 (mass ~0.88 after
+    # the top token, prefix crossing 0.9 adds 3 then stops)
+    assert np.isfinite(out[1][1])
+    assert np.isneginf(out[1][0])
+    # row 2: top-1 -> only the max survives
+    assert np.isfinite(out[2][1])
+    assert np.isneginf(out[2][[0, 2, 3]]).all()
+
+    # extreme p never empties the support
+    out2 = np.asarray(_filter_logits(
+        logits[:1], jnp.asarray([0], jnp.int32),
+        jnp.asarray([1e-9], jnp.float32)))
+    assert np.isfinite(out2[0][1])
+
+
+def test_top_k_sampling_restricts_tokens(predictor):
+    """top_k=1 at high temperature is exactly greedy — the filter reaches
+    the sampled distribution end to end."""
+    greedy = predictor.engine.submit(
+        [5, 6, 7], max_new_tokens=10, temperature=0.0,
+        seed=3).result(60)
+    topk1 = predictor.engine.submit(
+        [5, 6, 7], max_new_tokens=10, temperature=2.0, top_k=1,
+        seed=11).result(60)
+    assert topk1 == greedy
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        predictor.engine.submit([1], top_p=1.5)
+    with pytest.raises(ValueError):
+        predictor.engine.submit([1], top_k=-2)
