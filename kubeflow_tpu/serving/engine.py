@@ -107,7 +107,7 @@ class ContinuousBatcher:
         self._stop = False
         self._thread: threading.Thread | None = None
         self._prefill_cache: dict[int, object] = {}
-        self._decode_cache: dict[int, object] = {}
+        self._decode_cache: dict[tuple[int, bool], object] = {}
         self._insert_fn = None
 
     # -- public ----------------------------------------------------------------
@@ -125,6 +125,9 @@ class ContinuousBatcher:
             raise ValueError("top_k must be >= 0")
         if not 0.0 <= top_p <= 1.0:
             raise ValueError("top_p must be in [0, 1]")
+        if top_p >= 1.0:
+            top_p = 0.0  # the full distribution: normalize to "disabled"
+                         # so it doesn't force the filtered decode variant
         with self._work:
             if seed is None:
                 self._auto_seed += 1

@@ -251,9 +251,9 @@ class JupyterApp(CrudApp):
         round-trip per row to every list request), or None."""
         from kubeflow_tpu.controllers import culler
 
-        if not hasattr(self, "_culler_cfg"):
-            self._culler_cfg = culler.CullerConfig.load()
         try:
+            if not hasattr(self, "_culler_cfg"):
+                self._culler_cfg = culler.CullerConfig.load()
             stamps = [s for s in (
                 culler.annotation_activity_probe(nb),
                 culler.file_activity_probe(

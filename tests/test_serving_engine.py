@@ -115,9 +115,10 @@ def test_top_k_top_p_filtering_semantics():
     # row 0: top-2 -> keep logits 4 and 3 only
     assert np.isfinite(out[0][[1, 3]]).all()
     assert np.isneginf(out[0][[0, 2]]).all()
-    # row 1: p=0.9 over softmax([1,4,2,3]) keeps 4 and 3 (mass ~0.88 after
-    # the top token, prefix crossing 0.9 adds 3 then stops)
-    assert np.isfinite(out[1][1])
+    # row 1: p=0.9 over softmax([1,4,2,3]) — sorted probs (.644, .237,
+    # .087, .032) have exclusive cumsums (0, .644, .881, .968), so the
+    # prefix {4, 3, 2} survives and only logit 1 is cut
+    assert np.isfinite(out[1][[1, 2, 3]]).all()
     assert np.isneginf(out[1][0])
     # row 2: top-1 -> only the max survives
     assert np.isfinite(out[2][1])
