@@ -32,12 +32,12 @@ COORDINATOR_PORT = 8476
 def new(name: str, namespace: str, *, topology: str = "v5e-4",
         trainer: dict | None = None, parallelism: dict | None = None,
         pod_template: dict | None = None, max_restarts: int = 3,
-        num_slices: int = 1,
+        num_slices: int = 1, max_run_seconds: float | None = None,
         image: str = "kubeflow-tpu/worker:latest") -> dict:
     if topology not in TOPOLOGIES:
         raise ValueError(
             f"unknown topology {topology!r}; known: {sorted(TOPOLOGIES)}")
-    return api_object(KIND, name, namespace, spec={
+    spec = {
         "topology": topology,
         # multi-slice (DCN) data parallelism: numSlices independent ICI
         # domains; the dp mesh axis spans slices so only gradient reduction
@@ -48,7 +48,12 @@ def new(name: str, namespace: str, *, topology: str = "v5e-4",
         "podTemplate": pod_template or {},
         "maxRestarts": max_restarts,
         "image": image,
-    })
+    }
+    if max_run_seconds is not None:
+        # declared runtime bound: enforced like activeDeadlineSeconds, and
+        # the admission ticket for scheduler backfill (scheduler.py)
+        spec["maxRunSeconds"] = float(max_run_seconds)
+    return api_object(KIND, name, namespace, spec=spec)
 
 
 def num_slices_of(job: dict) -> int:

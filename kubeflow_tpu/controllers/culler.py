@@ -77,12 +77,25 @@ def file_activity_probe(nb: dict, activity_dir: str) -> dt.datetime | None:
         return None
 
 
-def http_activity_probe(nb: dict) -> dt.datetime | None:
-    """GET the notebook's Jupyter status endpoint inside the mesh
-    (culler.go:138-169); None = unreachable."""
+def http_activity_probe(nb: dict, server=None) -> dt.datetime | None:
+    """GET the notebook's Jupyter status endpoint (culler.go:138-169);
+    None = unreachable.  With a ``server``, the URL resolves through the
+    platform gateway's VirtualService -> pod route (the in-process
+    equivalent of probing through the mesh); without one it falls back to
+    mesh DNS for real-cluster deployments."""
     md = nb["metadata"]
-    url = (f"http://{md['name']}.{md['namespace']}.svc"
-           f"/notebook/{md['namespace']}/{md['name']}/api/status")
+    path = f"/notebook/{md['namespace']}/{md['name']}/api/status"
+    url = f"http://{md['name']}.{md['namespace']}.svc{path}"
+    if server is not None:
+        from kubeflow_tpu import gateway
+
+        try:
+            backend = gateway.resolve_backend(server, path)
+        except gateway.NoBackend:
+            return None
+        if backend is None:
+            return None
+        url = f"http://{backend.host}:{backend.port}{backend.path}"
     try:
         with urllib.request.urlopen(url, timeout=2) as r:
             data = json.loads(r.read())
@@ -91,7 +104,8 @@ def http_activity_probe(nb: dict) -> dt.datetime | None:
         return None
 
 
-def default_probe(cfg: CullerConfig) -> Callable[[dict], dt.datetime | None]:
+def default_probe(cfg: CullerConfig,
+                  server=None) -> Callable[[dict], dt.datetime | None]:
     def probe(nb: dict) -> dt.datetime | None:
         # MOST RECENT activity across all sources: a stale annotation left
         # by one reporter must not shadow a fresh activity file (and vice
@@ -99,7 +113,7 @@ def default_probe(cfg: CullerConfig) -> Callable[[dict], dt.datetime | None]:
         stamps = [source(nb) for source in (
             annotation_activity_probe,
             lambda n: file_activity_probe(n, cfg.activity_dir),
-            http_activity_probe)]
+            lambda n: http_activity_probe(n, server))]
         stamps = [s for s in stamps if s is not None]
         return max(stamps) if stamps else None
 
@@ -109,9 +123,10 @@ def default_probe(cfg: CullerConfig) -> Callable[[dict], dt.datetime | None]:
 class Culler:
     def __init__(self, cfg: CullerConfig | None = None,
                  probe: Callable[[dict], dt.datetime | None] | None = None,
-                 now: Callable[[], dt.datetime] | None = None):
+                 now: Callable[[], dt.datetime] | None = None,
+                 server=None):
         self.cfg = cfg or CullerConfig.load()
-        self.probe = probe or default_probe(self.cfg)
+        self.probe = probe or default_probe(self.cfg, server)
         self.now = now or (lambda: dt.datetime.now(dt.timezone.utc))
 
     @property

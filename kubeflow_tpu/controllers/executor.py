@@ -134,6 +134,9 @@ class LocalExecutor(Controller):
         # (kubelet semantics) — a dead gang's worker would otherwise hold
         # the rendezvous port hostage across the restart
         self._procs: dict[tuple, tuple[str, subprocess.Popen]] = {}
+        # pod uid -> {containerPort: allocated host port}: the gateway
+        # routes Service targetPorts to these via status.portMap
+        self._portmaps: dict[str, dict[str, int]] = {}
         self._lock = threading.Lock()
 
     def reconcile(self, req: Request) -> Result | None:
@@ -148,6 +151,18 @@ class LocalExecutor(Controller):
         if pod["spec"].get("schedulingGates"):
             return None
         phase = pod.get("status", {}).get("phase", "Pending")
+        if phase == "Running":
+            with self._lock:
+                tracked = self._procs.get(key, (None,))[0] == uid
+            if not tracked:
+                # orphaned by a platform restart: the subprocess died with
+                # the old process and cannot be re-adopted — reset to
+                # Pending so the next reconcile relaunches it cleanly
+                # (kubelet restarts containers after a node reboot)
+                self.server.patch_status("Pod", req.name, req.namespace,
+                                         {"phase": "Pending"})
+                return Result(requeue_after=0.01)
+            return None
         if phase != "Pending":
             return None
         with self._lock:
@@ -156,11 +171,35 @@ class LocalExecutor(Controller):
             # claim the slot before spawning so a duplicate reconcile
             # cannot double-launch; the thread swaps in the real Popen
             self._procs[key] = (uid, None)
-        self.server.patch_status("Pod", req.name, req.namespace,
-                                 {"phase": "Running"})
+        # allocate one host port per declared containerPort: a one-host
+        # kubelet has no pod IPs, so serving pods get real local ports the
+        # gateway can reach; status.portMap is the Service targetPort ->
+        # host port bridge (gateway.resolve_backend)
+        portmap = self._allocate_ports(pod)
+        self._portmaps[uid] = portmap
+        status = {"phase": "Running"}
+        if portmap:
+            status["podIP"] = "127.0.0.1"
+            status["portMap"] = portmap
+        self.server.patch_status("Pod", req.name, req.namespace, status)
         t = threading.Thread(target=self._run, args=(pod,), daemon=True)
         t.start()
         return None
+
+    @staticmethod
+    def _allocate_ports(pod: dict) -> dict[str, int]:
+        import socket
+
+        portmap: dict[str, int] = {}
+        for container in pod["spec"].get("containers", []):
+            for p in container.get("ports", []):
+                cp = p.get("containerPort")
+                if cp is None or str(cp) in portmap:
+                    continue
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    portmap[str(cp)] = s.getsockname()[1]
+        return portmap
 
     def _kill(self, key: tuple, keep_uid: str | None = None) -> None:
         """Terminate the tracked process for ``key`` unless it belongs to
@@ -180,6 +219,7 @@ class LocalExecutor(Controller):
         try:
             self._run_inner(pod, key, uid)
         finally:
+            self._portmaps.pop(uid, None)
             with self._lock:
                 if self._procs.get(key, ("",))[0] == uid:
                     self._procs.pop(key, None)
@@ -209,12 +249,48 @@ class LocalExecutor(Controller):
         except (NotFound, Conflict):
             pass
 
+    def _wait_flushing_logs(self, proc, md: dict, uid: str,
+                            log_tail) -> None:
+        """proc.wait with a 1s heartbeat that mirrors the rolling log tail
+        into pod status (throttled: one status write per second at most)."""
+        import time as _time
+
+        deadline = _time.monotonic() + self.timeout
+        flushed = 0
+        while True:
+            try:
+                proc.wait(timeout=1.0)
+                return
+            except subprocess.TimeoutExpired:
+                if _time.monotonic() >= deadline:
+                    raise
+                if len(log_tail) == flushed:
+                    continue
+                flushed = len(log_tail)
+                try:
+                    current = self.server.get("Pod", md["name"],
+                                              md.get("namespace"))
+                    if current["metadata"]["uid"] == uid:
+                        self.server.patch_status(
+                            "Pod", md["name"], md.get("namespace"),
+                            {**current.get("status", {}),
+                             "logTail": list(log_tail)})
+                except (NotFound, Conflict):
+                    pass
+
     def _run_inner(self, pod: dict, key: tuple, uid: str) -> None:
         md = pod["metadata"]
         container = pod["spec"]["containers"][0]
         env = dict(os.environ)
         for item in container.get("env", []):
             env[item["name"]] = str(item.get("value", ""))
+        # allocated host ports: KF_POD_PORT = first declared containerPort's
+        # host port (what a serving process should bind), plus one
+        # KF_PORT_<containerPort> per mapping
+        portmap = self._portmaps.get(uid, {})
+        for cp, host_port in portmap.items():
+            env.setdefault("KF_POD_PORT", str(host_port))
+            env[f"KF_PORT_{cp}"] = str(host_port)
         claims = {v["name"]: v["persistentVolumeClaim"]["claimName"]
                   for v in pod["spec"].get("volumes", [])
                   if "persistentVolumeClaim" in v}
@@ -238,9 +314,24 @@ class LocalExecutor(Controller):
             env[env_key] = path
         env.update(self.extra_env)
         result = None
+        from collections import deque
+
+        # rolling stdout+stderr tail mirrored into pod status.logTail (the
+        # log-subresource stand-in the web apps' logs panes read)
+        log_tail: deque = deque(maxlen=200)
+        # k8s kubelet semantics: $(VAR) in command/args expands from the
+        # container's env (how images bind the allocated $(KF_POD_PORT))
+        import re
+
+        def expand(word: str) -> str:
+            return re.sub(r"\$\((\w+)\)",
+                          lambda m: env.get(m.group(1), m.group(0)), word)
+
+        argv = [expand(w) for w in
+                container["command"] + container.get("args", [])]
         try:
             proc = subprocess.Popen(
-                container["command"] + container.get("args", []),
+                argv,
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                 text=True)
             with self._lock:
@@ -255,17 +346,21 @@ class LocalExecutor(Controller):
                 proc.communicate()
                 return
             # drain both pipes concurrently (no pipe-full deadlock); the
-            # stderr drain doubles as the live metrics collector
+            # stderr drain doubles as the live metrics collector, and a
+            # shared rolling tail feeds pod status.logTail (the log
+            # subresource stand-in the web apps' logs panes read)
             out_lines: list[str] = []
             err_lines: list[str] = []
 
             def drain_stdout() -> None:
                 for line in proc.stdout:
                     out_lines.append(line)
+                    log_tail.append(line.rstrip("\n"))
 
             def drain_stderr() -> None:
                 for line in proc.stderr:
                     err_lines.append(line)
+                    log_tail.append(line.rstrip("\n"))
                     self._scrape_metrics(md, uid, line)
 
             drains = [threading.Thread(target=drain_stdout, daemon=True),
@@ -273,7 +368,7 @@ class LocalExecutor(Controller):
             for t in drains:
                 t.start()
             try:
-                proc.wait(timeout=self.timeout)
+                self._wait_flushing_logs(proc, md, uid, log_tail)
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait()
@@ -295,6 +390,8 @@ class LocalExecutor(Controller):
         except Exception as e:  # command not found etc.
             phase, message = "Failed", str(e)
         status = {"phase": phase, "result": result}
+        if log_tail:
+            status["logTail"] = list(log_tail)
         if message:
             status["message"] = message
         try:

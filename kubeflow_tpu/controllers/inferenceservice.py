@@ -91,7 +91,7 @@ class InferenceServiceController(Controller):
                     "hosts": ["*"],
                     "gateways": ["kubeflow/kubeflow-gateway"],
                     "http": [{"match": [{"uri": {"prefix":
-                                                 f"/models/{ns}/{name}/"}}],
+                                                 f"/serving/{ns}/{name}/"}}],
                               "rewrite": {"uri": "/"},
                               "route": [{"destination": {
                                   "host": f"{name}.{ns}.svc",
@@ -111,7 +111,7 @@ class InferenceServiceController(Controller):
         set_condition(isvc, "Ready", "True" if ready else "False")
         self.server.patch_status(api.KIND, name, ns, {
             "ready": bool(ready),
-            "url": f"/models/{ns}/{name}/",
+            "url": f"/serving/{ns}/{name}/",
             "conditions": isvc["status"]["conditions"]})
 
 

@@ -40,38 +40,70 @@ JOB_RESTARTS = REGISTRY.counter("jaxjob_gang_restarts_total",
                                 "gang restarts after worker failure")
 
 
+PARK_CONDITIONS = ("WaitingForSlices", "QuotaExceeded")
+
+
 class JAXJobController(Controller):
     kind = api.KIND
     owns = ("Pod", "Service")
 
+    # per-event unpark fan-out bound: freed capacity can admit at most a
+    # handful of gangs, so re-evaluating the FIFO-oldest few is enough —
+    # re-enqueueing every parked gang per pod event is the O(parked²)
+    # storm that froze the 500-gang loadtest
+    UNPARK_FANOUT = 8
+
+    def __init__(self, server):
+        super().__init__(server)
+        # parked-jobs index: (ns, name) -> (creationTimestamp, topology,
+        # condition) for gangs parked on a PARK_CONDITIONS condition.
+        # Kept by _park/_unpark so pod events re-enqueue exactly the
+        # relevant waiting gangs instead of listing every JAXJob
+        # cluster-wide per pod event; the park requeue (with backoff)
+        # remains the repopulating fallback after a controller restart.
+        # Dict ops are GIL-atomic; requests_for runs on the watch thread,
+        # mutation on the reconcile worker.
+        self._parked: dict[tuple[str | None, str],
+                           tuple[float, str, str]] = {}
+        # consecutive-park backoff per gang: deep queues must not burn the
+        # worker thread polling 4x/s each (0.25s -> 4s, reset on unpark)
+        self._park_delay: dict[tuple[str | None, str], float] = {}
+
     def requests_for(self, ev):
         yield from super().requests_for(ev)
         # event-driven unpark: a pod leaving the world (terminal phase or
-        # deletion) can free slice capacity or TPU quota, which is exactly
-        # what gangs parked on WaitingForSlices/QuotaExceeded are polling
-        # for — re-enqueue them immediately instead of waiting out the
-        # 0.25s park requeue (trial scheduling latency: ~500ms -> ~ms)
+        # deletion) frees slice capacity (its topology) or TPU quota (its
+        # namespace) — re-enqueue the FIFO-oldest parked gangs those could
+        # admit, immediately, instead of waiting out the park requeue
         if ev.kind != "Pod":
             return
         phase = ev.object.get("status", {}).get("phase")
         if ev.type != "DELETED" and phase not in ("Succeeded", "Failed"):
             return
-        for job in self.server.list(api.KIND):
-            st = job.get("status") or {}
-            if st.get("phase") != "Pending":
-                continue
-            if any(c.get("status") == "True" and c.get("type") in
-                   ("WaitingForSlices", "QuotaExceeded")
-                   for c in st.get("conditions", [])):
-                md = job["metadata"]
-                yield Request(md.get("namespace"), md["name"])
+        md = ev.object.get("metadata", {})
+        ev_ns = md.get("namespace")
+        ev_topo = md.get("labels", {}).get("jaxjob-topology")
+        slice_parked = []
+        quota_parked = []
+        for key, (ts, topo, cond) in list(self._parked.items()):
+            if cond == "WaitingForSlices" and (ev_topo is None
+                                               or topo == ev_topo):
+                slice_parked.append((ts, key))
+            elif cond == "QuotaExceeded" and key[0] == ev_ns:
+                quota_parked.append((ts, key))
+        for _, key in sorted(slice_parked)[:self.UNPARK_FANOUT]:
+            yield Request(*key)
+        for _, key in sorted(quota_parked)[:self.UNPARK_FANOUT]:
+            yield Request(*key)
 
     def reconcile(self, req: Request) -> Result | None:
         try:
             job = self.server.get(api.KIND, req.name, req.namespace)
         except NotFound:
+            self._parked.pop((req.namespace, req.name), None)
             return None
         if job["metadata"].get("deletionTimestamp"):
+            self._parked.pop((req.namespace, req.name), None)
             return None  # children GC'd via ownerReferences
 
         api.validate(job)
@@ -80,6 +112,7 @@ class JAXJobController(Controller):
         status = dict(job.get("status") or {})
         phase = status.get("phase", "Pending")
         if phase in ("Succeeded", "Failed"):
+            self._parked.pop((req.namespace, req.name), None)
             return None
 
         self._ensure_service(job)
@@ -130,6 +163,38 @@ class JAXJobController(Controller):
                                      status)
             return Result(requeue_after=0.05)
 
+        # maxRunSeconds is a CONTRACT (activeDeadlineSeconds semantics):
+        # scheduler backfill proofs rely on the bound, so an overrunning
+        # gang is terminated, not tolerated
+        deadline_requeue: float | None = None
+        max_run = spec.get("maxRunSeconds")
+        started = status.get("startedAt")
+        if max_run is not None and started is not None:
+            import time as _time
+
+            remaining = float(started) + float(max_run) - _time.time()
+            if remaining <= 0:
+                for p in pods:
+                    try:
+                        self.server.delete("Pod", p["metadata"]["name"],
+                                           req.namespace)
+                    except NotFound:
+                        pass
+                status["phase"] = "Failed"
+                set_condition(job, "Complete", "False",
+                              reason="DeadlineExceeded",
+                              message=f"exceeded maxRunSeconds={max_run}")
+                status["conditions"] = job["status"]["conditions"]
+                record_event(self.server, job, "Warning",
+                             "DeadlineExceeded",
+                             f"gang ran past its declared "
+                             f"{max_run}s bound; terminated")
+                self.server.patch_status(api.KIND, req.name,
+                                         req.namespace, status)
+                self._parked.pop((req.namespace, req.name), None)
+                return None
+            deadline_requeue = remaining
+
         # atomic gate release once the whole gang is admitted AND the slice
         # pool has room (strict FIFO per topology — scheduler.may_release)
         gated = [p for p in pods if p["spec"].get("schedulingGates")]
@@ -141,6 +206,11 @@ class JAXJobController(Controller):
                 return self._park(job, status, req, "WaitingForSlices",
                                   "NoCapacity", why)
             self._unpark(job, status, "WaitingForSlices", "Scheduled")
+            import time as _time
+
+            # release timestamp: the backfill ETA model and the
+            # maxRunSeconds deadline both count from here
+            status.setdefault("startedAt", _time.time())
             for p in gated:
                 p["spec"]["schedulingGates"] = []
                 self.server.update(p)
@@ -159,6 +229,9 @@ class JAXJobController(Controller):
                                if status.get("phase") == "Restarting"
                                else "Pending")
         self.server.patch_status(api.KIND, req.name, req.namespace, status)
+        if deadline_requeue is not None and status["phase"] not in (
+                "Succeeded", "Failed"):
+            return Result(requeue_after=deadline_requeue)
         return None
 
     # -- parking -------------------------------------------------------------
@@ -174,14 +247,29 @@ class JAXJobController(Controller):
             record_event(self.server, job, "Warning", cond_type, message)
         status["phase"] = "Pending"
         status["conditions"] = job["status"]["conditions"]
+        key = (req.namespace, req.name)
+        self._parked[key] = (
+            float(job["metadata"].get("creationTimestamp", 0.0)),
+            job["spec"].get("topology", ""), cond_type)
         self.server.patch_status(api.KIND, req.name, req.namespace, status)
-        return Result(requeue_after=0.25)
+        # polling fallback with backoff: event-driven unpark carries the
+        # latency story, so a deep queue may poll slowly
+        delay = self._park_delay.get(key, 0.125) * 2
+        self._park_delay[key] = min(delay, 4.0)
+        return Result(requeue_after=self._park_delay[key])
 
     def _unpark(self, job: dict, status: dict, cond_type: str,
                 reason: str) -> None:
         if get_condition(job, cond_type):
             set_condition(job, cond_type, "False", reason=reason)
             status["conditions"] = job["status"]["conditions"]
+        if not any(c.get("status") == "True"
+                   and c.get("type") in PARK_CONDITIONS
+                   for c in (job.get("status") or {}).get("conditions", [])):
+            md = job["metadata"]
+            key = (md.get("namespace"), md["name"])
+            self._parked.pop(key, None)
+            self._park_delay.pop(key, None)
 
     def _older_quota_blocker(self, job: dict) -> str | None:
         """FIFO for quota admission: the name of an older, still-active

@@ -39,7 +39,8 @@ class NotebookController(Controller):
                  culler: Culler | None = None):
         super().__init__(server)
         self.cfg = cfg or NotebookControllerConfig.load()
-        self.culler = culler or Culler()
+        # server-aware culler: its HTTP probe resolves through the gateway
+        self.culler = culler or Culler(server=server)
         self._seen: set[str] = set()
         # re-emission bookkeeping: (event uid) -> count already mirrored
         self._emitted: dict[str, int] = {}
@@ -174,6 +175,11 @@ class NotebookController(Controller):
         name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
         prefix = api.url_prefix(nb)
+        # identity rewrite by default (notebook_controller.go:413-417):
+        # jupyter serves under base_url=NB_PREFIX, so the proxied path must
+        # keep the prefix; the annotation overrides for root-serving images
+        rewrite = nb["metadata"].get("annotations", {}).get(
+            "notebooks.kubeflow.org/http-rewrite-uri") or prefix
         try:
             self.server.get("VirtualService", f"notebook-{name}", ns)
         except NotFound:
@@ -184,7 +190,7 @@ class NotebookController(Controller):
                     "gateways": [self.cfg.istio_gateway],
                     "http": [{
                         "match": [{"uri": {"prefix": prefix}}],
-                        "rewrite": {"uri": "/"},
+                        "rewrite": {"uri": rewrite},
                         "route": [{"destination": {
                             "host": host, "port": {"number": 80}}}],
                         "timeout": "300s",

@@ -57,6 +57,8 @@ class RestAPI:
 
     # -- WSGI ------------------------------------------------------------------
     def __call__(self, environ, start_response):
+        if environ.get("PATH_INFO", "").rstrip("/") == "/apis/watch":
+            return self._watch_stream(environ, start_response)
         try:
             status, body = self._route(environ)
         except NotFound as e:
@@ -156,6 +158,40 @@ class RestAPI:
                 self.server.delete(kind, name, ns)
                 return "200 OK", {"status": "deleted"}
         raise NotFound(f"no route {method} {path}")
+
+    def _watch_stream(self, environ, start_response):
+        """GET /apis/watch?kinds=A,B&namespace=ns — long-lived response
+        streaming one JSON line per WatchEvent (the k8s watch verb for
+        out-of-process controllers, SURVEY §1 L1).  Heartbeat lines ("{}")
+        every 0.5s keep the pipe alive and surface client disconnects."""
+        qs = parse_qs(environ.get("QUERY_STRING", ""))
+        user = self._user(environ)
+        raw_kinds = qs.get("kinds", [None])[0]
+        kinds = ([k for k in raw_kinds.split(",") if k]
+                 if raw_kinds else None)
+        namespace = qs.get("namespace", [None])[0]
+        self._authz(user, "watch", "*" if not kinds else kinds[0],
+                    namespace)
+        watch = self.server.watch(kinds=kinds, namespace=namespace)
+        start_response("200 OK",
+                       [("Content-Type", "application/jsonl"),
+                        ("Cache-Control", "no-cache")])
+
+        def stream():
+            try:
+                while True:
+                    ev = watch.next(timeout=0.5)
+                    if ev is None:
+                        yield b"{}\n"  # heartbeat; write fails on a dead
+                        # client and tears the watch down
+                        continue
+                    yield (json.dumps({"type": ev.type,
+                                       "object": ev.object})
+                           .encode() + b"\n")
+            finally:
+                watch.stop()
+
+        return stream()
 
     def _downconvert(self, obj: dict, version: str) -> dict:
         from kubeflow_tpu.api import versions

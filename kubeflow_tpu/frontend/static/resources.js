@@ -34,10 +34,107 @@
     return "";
   }
 
+  function simpleTable(headers, rows, emptyMsg) {
+    return el("table", { class: "kf-table" },
+      el("thead", null, el("tr", null,
+        headers.map((h) => el("th", null, h)))),
+      el("tbody", null, rows.length ? rows
+        : el("tr", null, el("td", { colspan: String(headers.length),
+            class: "empty" }, emptyMsg))));
+  }
+
+  function detailDialog(title, panes) {
+    const body = el("div", { class: "kf-details" });
+    const tabs = el("div", { class: "kf-tabs" },
+      Object.keys(panes).map((t, i) => el("a", {
+        href: "#", class: i === 0 ? "active" : null,
+        onclick: (ev) => {
+          ev.preventDefault();
+          tabs.querySelectorAll("a").forEach((a) =>
+            a.classList.remove("active"));
+          ev.target.classList.add("active");
+          body.replaceChildren(panes[t]);
+        } }, t)));
+    body.append(Object.values(panes)[0]);
+    const dlg = KF.dialog(title, el("div", null, tabs, body),
+      [el("button", { onclick: () => dlg.close() }, "Close")]);
+  }
+
+  /* JAXJob detail: per-worker pod status — the training operator's
+   * "replica statuses" view, from the gang's pods. */
+  async function openJAXJobDetails(o) {
+    const name = o.metadata.name;
+    const pods = (await api.get(
+      `/apis/Pod?namespace=${namespace}&labelSelector=jaxjob=${name}`))
+      .items;
+    pods.sort((a, b) =>
+      Number(a.metadata.labels["jaxjob-worker-index"] || 0) -
+      Number(b.metadata.labels["jaxjob-worker-index"] || 0));
+    const workerRows = pods.map((p) => el("tr", null,
+      el("td", null, p.metadata.labels["jaxjob-worker-index"] || "?"),
+      el("td", null, p.metadata.name),
+      el("td", null, (p.status && p.status.phase) || "Pending"),
+      el("td", null, (p.spec.schedulingGates || []).length
+        ? "gated" : "released"),
+      el("td", null, p.status && p.status.metrics
+        ? `step ${p.status.metrics.step ?? "—"}, loss ` +
+          `${p.status.metrics.loss ?? "—"}`
+        : el("span", { class: "muted" }, "—"))));
+    const workers = simpleTable(
+      ["#", "Pod", "Phase", "Gate", "Live metrics"], workerRows,
+      "No worker pods (gang not admitted yet).");
+    const result = el("pre", { class: "kf-yaml" },
+      JSON.stringify(o.status && o.status.result || null, null, 2));
+    const yaml = el("pre", { class: "kf-yaml" },
+      JSON.stringify(o, null, 2));
+    detailDialog(`JAXJob ${name}`,
+      { Workers: workers, Result: result, YAML: yaml });
+  }
+
+  /* Experiment detail: trial table + best trial — the Katib experiment
+   * page's trials view. */
+  async function openExperimentDetails(o) {
+    const name = o.metadata.name;
+    const trials = (await api.get(`/apis/Trial?namespace=${namespace}`))
+      .items.filter((t) => t.spec.experiment === name);
+    const best = o.status && o.status.bestTrial;
+    const trialRows = trials.map((t) => {
+      const isBest = best && JSON.stringify(best.assignment) ===
+        JSON.stringify(t.spec.assignment);
+      return el("tr", { class: isBest ? "best-trial" : null },
+        el("td", null, t.metadata.name + (isBest ? " ★" : "")),
+        el("td", null, (t.status && t.status.phase) || "Pending"),
+        el("td", null, JSON.stringify(t.spec.assignment || {})),
+        el("td", null, t.status && t.status.objective !== undefined
+          ? String(t.status.objective)
+          : el("span", { class: "muted" }, "—")));
+    });
+    const trialTable = simpleTable(
+      ["Trial", "Phase", "Assignment", "Objective"], trialRows,
+      "No trials yet.");
+    const bestPane = el("pre", { class: "kf-yaml" },
+      JSON.stringify(best || null, null, 2));
+    const yaml = el("pre", { class: "kf-yaml" },
+      JSON.stringify(o, null, 2));
+    detailDialog(`Experiment ${name}`,
+      { Trials: trialTable, "Best trial": bestPane, YAML: yaml });
+  }
+
+  const DETAILS = { JAXJob: openJAXJobDetails,
+    Experiment: openExperimentDetails };
+
+  function nameCell(o) {
+    const open = DETAILS[kind];
+    if (!open) return o.metadata.name;
+    return el("a", { href: "#", class: "name-link",
+      onclick: (ev) => { ev.preventDefault();
+        open(o).catch((e) => KF.snack(e.message)); } }, o.metadata.name);
+  }
+
   const COLUMNS = {
     JAXJob: [
       { title: "Status", render: phaseIcon },
-      { title: "Name", render: (o) => o.metadata.name },
+      { title: "Name", render: nameCell },
       { title: "Phase", render: (o) =>
           (o.status && o.status.phase) || "Pending" },
       { title: "Topology", render: (o) => o.spec.numSlices > 1
@@ -51,16 +148,20 @@
     ],
     Experiment: [
       { title: "Status", render: phaseIcon },
-      { title: "Name", render: (o) => o.metadata.name },
+      { title: "Name", render: nameCell },
       { title: "Phase", render: (o) =>
           (o.status && o.status.phase) || "Pending" },
       { title: "Trials", render: (o) => o.status
-          ? `${o.status.succeeded || 0}/${o.spec.maxTrials || "?"}` : "—" },
-      { title: "Best", render: (o) => (o.status && o.status.best
-          && o.status.best.value !== undefined)
-          ? String(o.status.best.value.toFixed
-              ? o.status.best.value.toFixed(4) : o.status.best.value)
-          : el("span", { class: "muted" }, "—") },
+          ? `${o.status.trialsSucceeded || 0}/${o.spec.maxTrials || "?"}`
+          : "—" },
+      { title: "Best", render: (o) => {
+          const best = o.status && o.status.bestTrial;
+          if (!best || best.objective === undefined) {
+            return el("span", { class: "muted" }, "—");
+          }
+          const v = best.objective;
+          return String(v.toFixed ? v.toFixed(4) : v);
+        } },
     ],
     InferenceService: [
       { title: "Status", render: (o) => KF.statusIcon({

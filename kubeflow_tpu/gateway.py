@@ -1,0 +1,272 @@
+"""The platform's front-door ingress gateway.
+
+The reference's runtime traffic path is user -> Istio ingress gateway ->
+VirtualService -> Service -> pod (SURVEY.md §1 "Traffic path at runtime";
+notebook_controller.go:401-496 writes the routes an Istio gateway serves).
+This module is that gateway for the single-binary platform: it consumes the
+VirtualService objects the controllers already write and reverse-proxies
+matching requests to the backing pod.
+
+Resolution pipeline (all against the in-process store, per request — routes
+are live the instant a controller writes them):
+
+1. longest-prefix match of the request path over every VirtualService's
+   ``http[].match[].uri.prefix``;
+2. apply the route's ``rewrite.uri`` (Istio semantics: the matched prefix is
+   replaced by the rewrite string) and ``headers.request.set``;
+3. route's destination host ``<svc>.<ns>.svc...`` -> Service -> port mapping
+   (``port.number`` -> ``targetPort``) -> selector;
+4. a Running pod matching the selector whose ``status.portMap`` maps the
+   targetPort to a real host port (LocalExecutor allocates one per
+   containerPort) -> proxy to ``http://<status.podIP>:<hostPort>``.
+
+Bodies stream both directions in chunks (long-poll/SSE work; WebSocket
+upgrade is NOT supported — WSGI offers no socket hijack; Jupyter falls back
+to long-polling).  A matched route with no live backend is 503, a refused
+connection 502 — only an unmatched path falls through to the caller.
+"""
+
+from __future__ import annotations
+
+import http.client
+import time
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.core.store import APIServer, NotFound
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+PROXIED = REGISTRY.counter("gateway_requests_total",
+                           "requests proxied through the gateway",
+                           labels=("code",))
+
+log = get_logger("gateway")
+
+# RFC 2616 §13.5.1 + connection-specific headers a proxy must not forward
+HOP_BY_HOP = {"connection", "keep-alive", "proxy-authenticate",
+              "proxy-authorization", "te", "trailers",
+              "transfer-encoding", "upgrade"}
+
+
+class NoBackend(RuntimeError):
+    """A VirtualService matched but no live pod backs its destination."""
+
+
+@dataclass
+class Route:
+    prefix: str
+    rewrite: str
+    dest_host: str          # <service>.<namespace>.svc[.domain]
+    dest_port: int
+    set_headers: dict = field(default_factory=dict)
+    timeout_s: float = 300.0
+
+    def rewritten(self, path: str) -> str:
+        return self.rewrite + path[len(self.prefix):]
+
+
+@dataclass
+class Backend:
+    host: str
+    port: int
+    path: str
+    set_headers: dict
+    timeout_s: float
+
+
+def match_route(server: APIServer, path: str) -> Route | None:
+    """Longest-prefix match over every VirtualService's http routes."""
+    best: Route | None = None
+    for vs in server.list("VirtualService"):
+        for http_route in vs.get("spec", {}).get("http", []):
+            prefix = None
+            for m in http_route.get("match", []):
+                p = m.get("uri", {}).get("prefix")
+                if p and path.startswith(p):
+                    prefix = p
+                    break
+            if prefix is None:
+                continue
+            if best is not None and len(prefix) <= len(best.prefix):
+                continue
+            routes = http_route.get("route") or []
+            if not routes:
+                continue
+            dest = routes[0].get("destination", {})
+            timeout = http_route.get("timeout", "300s")
+            try:
+                timeout_s = float(str(timeout).rstrip("s"))
+            except ValueError:
+                timeout_s = 300.0
+            best = Route(
+                prefix=prefix,
+                rewrite=http_route.get("rewrite", {}).get("uri", prefix),
+                dest_host=dest.get("host", ""),
+                dest_port=int(dest.get("port", {}).get("number", 80)),
+                set_headers=dict(http_route.get("headers", {})
+                                 .get("request", {}).get("set", {})),
+                timeout_s=timeout_s,
+            )
+    return best
+
+
+def resolve_backend(server: APIServer, path: str) -> Backend | None:
+    """Full resolution path -> Backend; None if no route matches,
+    NoBackend if a route matches but nothing serves it."""
+    route = match_route(server, path)
+    if route is None:
+        return None
+    parts = route.dest_host.split(".")
+    if len(parts) < 2:
+        raise NoBackend(f"unresolvable destination {route.dest_host!r}")
+    svc_name, svc_ns = parts[0], parts[1]
+    try:
+        svc = server.get("Service", svc_name, svc_ns)
+    except NotFound:
+        raise NoBackend(f"service {svc_ns}/{svc_name} not found")
+    target_port = None
+    for p in svc["spec"].get("ports", []):
+        if int(p.get("port", 80)) == route.dest_port:
+            target_port = p.get("targetPort", p.get("port"))
+            break
+    if target_port is None:
+        raise NoBackend(
+            f"service {svc_ns}/{svc_name} has no port {route.dest_port}")
+    selector = {"matchLabels": svc["spec"].get("selector", {})}
+    for pod in server.list("Pod", namespace=svc_ns,
+                           label_selector=selector):
+        status = pod.get("status", {})
+        if status.get("phase") != "Running":
+            continue
+        host_port = (status.get("portMap") or {}).get(str(target_port))
+        if host_port is None:
+            continue
+        return Backend(host=status.get("podIP", "127.0.0.1"),
+                       port=int(host_port),
+                       path=route.rewritten(path),
+                       set_headers=route.set_headers,
+                       timeout_s=route.timeout_s)
+    raise NoBackend(f"no running pod backs {svc_ns}/{svc_name}"
+                    f":{target_port}")
+
+
+def _request_headers(environ: dict, backend: Backend) -> dict:
+    headers: dict[str, str] = {}
+    for key, value in environ.items():
+        if not key.startswith("HTTP_"):
+            continue
+        name = key[5:].replace("_", "-").title()
+        if name.lower() in HOP_BY_HOP or name.lower() == "host":
+            continue
+        headers[name] = value
+    if environ.get("CONTENT_TYPE"):
+        headers["Content-Type"] = environ["CONTENT_TYPE"]
+    headers["Host"] = f"{backend.host}:{backend.port}"
+    # standard reverse-proxy forwarding headers
+    if environ.get("REMOTE_ADDR"):
+        headers["X-Forwarded-For"] = environ["REMOTE_ADDR"]
+    headers["X-Forwarded-Proto"] = environ.get("wsgi.url_scheme", "http")
+    headers.update(backend.set_headers)
+    return headers
+
+
+def _body_chunks(stream, length: int, chunk: int = 65536):
+    remaining = length
+    while remaining > 0:
+        data = stream.read(min(chunk, remaining))
+        if not data:
+            break
+        remaining -= len(data)
+        yield data
+
+
+class Gateway:
+    """WSGI reverse proxy over the store's VirtualService objects."""
+
+    def __init__(self, server: APIServer, *, connect_retries: int = 40,
+                 retry_delay: float = 0.25):
+        self.server = server
+        # a pod reports Running slightly before its process binds the
+        # port; a short connect-retry absorbs that startup race
+        self.connect_retries = connect_retries
+        self.retry_delay = retry_delay
+
+    def matches(self, path: str) -> bool:
+        return match_route(self.server, path) is not None
+
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        try:
+            backend = resolve_backend(self.server, path)
+        except NoBackend as e:
+            PROXIED.labels("503").inc()
+            start_response("503 Service Unavailable",
+                           [("Content-Type", "text/plain")])
+            return [f"no backend: {e}\n".encode()]
+        if backend is None:  # caller should have checked matches()
+            PROXIED.labels("404").inc()
+            start_response("404 Not Found",
+                           [("Content-Type", "text/plain")])
+            return [b"no route\n"]
+        return self._proxy(backend, environ, start_response)
+
+    def _proxy(self, backend: Backend, environ, start_response):
+        method = environ["REQUEST_METHOD"]
+        url = backend.path
+        qs = environ.get("QUERY_STRING")
+        if qs:
+            url += "?" + qs
+        headers = _request_headers(environ, backend)
+        try:
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            length = 0
+        headers["Content-Length"] = str(length)
+        body = (_body_chunks(environ["wsgi.input"], length)
+                if length else b"")
+
+        conn = None
+        for attempt in range(self.connect_retries):
+            conn = http.client.HTTPConnection(backend.host, backend.port,
+                                              timeout=backend.timeout_s)
+            try:
+                conn.request(method, url, body=body, headers=headers)
+                resp = conn.getresponse()
+                break
+            except ConnectionRefusedError:
+                conn.close()
+                if attempt + 1 == self.connect_retries:
+                    PROXIED.labels("502").inc()
+                    start_response("502 Bad Gateway",
+                                   [("Content-Type", "text/plain")])
+                    return [b"backend connection refused\n"]
+                # only retriable when the request body wasn't consumed
+                if length:
+                    PROXIED.labels("502").inc()
+                    start_response("502 Bad Gateway",
+                                   [("Content-Type", "text/plain")])
+                    return [b"backend connection refused\n"]
+                time.sleep(self.retry_delay)
+            except OSError as e:
+                conn.close()
+                PROXIED.labels("502").inc()
+                start_response("502 Bad Gateway",
+                               [("Content-Type", "text/plain")])
+                return [f"backend error: {e}\n".encode()]
+
+        out_headers = [(k, v) for k, v in resp.getheaders()
+                       if k.lower() not in HOP_BY_HOP]
+        PROXIED.labels(str(resp.status)).inc()
+        start_response(f"{resp.status} {resp.reason}", out_headers)
+
+        def stream():
+            try:
+                while True:
+                    chunk = resp.read(65536)
+                    if not chunk:
+                        break
+                    yield chunk
+            finally:
+                conn.close()
+
+        return stream()

@@ -140,7 +140,10 @@ def build_wsgi_app(server, *, secure_api: bool = True,
             raise PermissionError("identity header required for /apis")
         ensure_authorized(server, user, verb, kind, namespace)
 
+    from kubeflow_tpu.gateway import Gateway
+
     rest = RestAPI(server, authorize=rbac_authorize if secure_api else None)
+    gateway = Gateway(server)
     mounts = {"/kfam": KfamApp(server)}
     if expose_webhook:
         from kubeflow_tpu.admission.webhook import WebhookApp
@@ -164,6 +167,10 @@ def build_wsgi_app(server, *, secure_api: bool = True,
         for prefix, handler in mounts.items():
             if path == prefix or path.startswith(prefix + "/"):
                 return handler(environ, start_response)
+        # ingress: paths claimed by a VirtualService route proxy to the
+        # backing pod (the Istio-gateway role, SURVEY §1 traffic path)
+        if gateway.matches(path):
+            return gateway(environ, start_response)
         return rest(environ, start_response)
 
     return app
@@ -183,21 +190,33 @@ def main(argv=None) -> int:
     parser.add_argument("--dev-identity", metavar="EMAIL",
                         help="inject this identity header into every "
                         "request (plays the mesh/IAP; local dev only)")
+    parser.add_argument("--data-dir", metavar="DIR",
+                        help="durable state directory (snapshot + WAL); "
+                        "omit for memory-only (state dies with the process)")
     args = parser.parse_args(argv)
 
     log = get_logger("platform")
     server, mgr = build_platform(executor=args.executor,
                                  leader_election=args.leader_election)
+    if args.data_dir:
+        from kubeflow_tpu.core import persistence
+
+        persistence.attach(server, args.data_dir)
     if args.bootstrap_admin:
         from kubeflow_tpu.core import api_object
         from kubeflow_tpu.core.rbac import ensure_builtin_roles
+        from kubeflow_tpu.core.store import Conflict
 
         ensure_builtin_roles(server)
-        server.create(api_object(
-            "ClusterRoleBinding", "bootstrap-admin", spec={
-                "subjects": [{"kind": "User", "name": args.bootstrap_admin}],
-                "roleRef": {"kind": "ClusterRole",
-                            "name": "kubeflow-admin"}}))
+        try:
+            server.create(api_object(
+                "ClusterRoleBinding", "bootstrap-admin", spec={
+                    "subjects": [{"kind": "User",
+                                  "name": args.bootstrap_admin}],
+                    "roleRef": {"kind": "ClusterRole",
+                                "name": "kubeflow-admin"}}))
+        except Conflict:
+            pass  # recovered from the data dir on a previous boot
     mgr.start()
     app = build_wsgi_app(server, secure_api=not args.insecure_api)
     if args.dev_identity:

@@ -416,15 +416,19 @@ def _filter_logits(logits: jax.Array, top_ks: jax.Array,
     kth = jnp.take_along_axis(sorted_lg, k_idx[:, None], axis=-1)
     keep_k = jnp.where((top_ks > 0)[:, None], logits >= kth, True)
 
-    # top-p: keep the smallest prefix of the sorted distribution whose
-    # mass reaches p (exclusive cumsum keeps the top token always)
-    probs = jax.nn.softmax(sorted_lg, axis=-1)
+    # top-p AFTER top-k (HF/vLLM sequential-warper convention): nucleus
+    # mass is computed over the top-k-filtered distribution, renormalized —
+    # softmax over the k-masked logits zeroes the dropped entries, so the
+    # exclusive cumsum is automatically over the kept support only
+    k_masked = jnp.where(keep_k, logits, -jnp.inf)
+    sorted_km = jnp.sort(k_masked, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_km, axis=-1)
     cum_excl = jnp.cumsum(probs, axis=-1) - probs
     kept_sorted = cum_excl < top_ps[:, None]                 # [B, V]
     last_kept = jnp.maximum(jnp.sum(kept_sorted, axis=-1) - 1, 0)
-    pth = jnp.take_along_axis(sorted_lg, last_kept[:, None], axis=-1)
+    pth = jnp.take_along_axis(sorted_km, last_kept[:, None], axis=-1)
     p_on = ((top_ps > 0.0) & (top_ps < 1.0))[:, None]
-    keep_p = jnp.where(p_on, logits >= pth, True)
+    keep_p = jnp.where(p_on, k_masked >= pth, True)
 
     return jnp.where(keep_k & keep_p, logits, -jnp.inf)
 

@@ -273,8 +273,14 @@ def main(argv=None) -> int:
         else:
             predictors[name] = ClassifierPredictor(name,
                                                    checkpoint_dir=ckpt)
-    httpd, thread = serve(PredictorApp(predictors), args.port)
-    print(f"predictor serving {sorted(predictors)} on :{args.port}",
+    # under the LocalExecutor, KF_POD_PORT is the allocated host port the
+    # gateway routes to (a one-host kubelet has no pod IPs); on a real
+    # cluster the env is absent and --port binds inside the pod netns
+    import os
+
+    port = int(os.environ.get("KF_POD_PORT", args.port))
+    httpd, thread = serve(PredictorApp(predictors), port)
+    print(f"predictor serving {sorted(predictors)} on :{port}",
           flush=True)
     thread.join()
     return 0

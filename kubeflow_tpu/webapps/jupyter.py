@@ -41,6 +41,8 @@ class JupyterApp(CrudApp):
                        self.get)
         self.add_route("GET", "/api/namespaces/<ns>/notebooks/<name>/pod",
                        self.get_pod)
+        self.add_route("GET", "/api/namespaces/<ns>/notebooks/<name>/logs",
+                       self.get_logs)
         self.add_route("GET", "/api/namespaces/<ns>/notebooks/<name>/events",
                        self.get_events)
         self.add_route("PATCH", "/api/namespaces/<ns>/notebooks/<name>",
@@ -75,6 +77,18 @@ class JupyterApp(CrudApp):
         except NotFound:
             return "200 OK", {"pod": None}
         return "200 OK", {"pod": pod}
+
+    def get_logs(self, req: Request):
+        """Container log tail for the UI's logs pane (reference: the
+        jupyter app surfaces pod logs via the k8s log subresource; here
+        the executor mirrors a rolling tail into pod status.logTail)."""
+        ns, name = req.params["ns"], req.params["name"]
+        req.authorize("get", "Pod", ns)
+        try:
+            pod = self.server.get("Pod", f"{name}-0", ns)
+        except NotFound:
+            return "200 OK", {"logs": []}
+        return "200 OK", {"logs": pod.get("status", {}).get("logTail", [])}
 
     def get_events(self, req: Request):
         ns, name = req.params["ns"], req.params["name"]

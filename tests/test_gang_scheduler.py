@@ -197,3 +197,89 @@ def test_podtemplate_nodeselector_cannot_hide_gang(harness):
     server.create(api.new("waiter", "ml", topology="v5e-8"))
     wait_for(lambda: get_condition(server.get(api.KIND, "waiter", "ml"),
                                    "WaitingForSlices") or None)
+
+
+def test_backfill_disabled_by_default(harness):
+    """Without pool.spec.backfill, a bounded younger gang still queues
+    strictly behind the head (the documented default)."""
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 2}))
+    # hog holds 1 slice with a declared bound; head needs 2 (blocked)
+    server.create(api.new("hog", "ml", topology="v5e-8",
+                          max_run_seconds=300))
+    wait_for(lambda: job_phase(server, "hog") == "Running" or None)
+    server.create(api.new("head", "ml", topology="v5e-8", num_slices=2))
+    wait_for(lambda: (get_condition(server.get(api.KIND, "head", "ml"),
+                                    "WaitingForSlices") or {})
+             .get("status") == "True" or None)
+    server.create(api.new("small", "ml", topology="v5e-8",
+                          max_run_seconds=1))
+    parked = wait_for(lambda: (
+        lambda j: j if (get_condition(j, "WaitingForSlices") or {})
+        .get("status") == "True" else None)(
+        server.get(api.KIND, "small", "ml")))
+    assert "queued behind" in get_condition(
+        parked, "WaitingForSlices")["message"]
+
+
+def test_backfill_releases_provably_harmless_gang(harness):
+    """pool.spec.backfill + declared bounds: a younger 1-slice gang whose
+    maxRunSeconds ends before the head's ETA runs ahead of the queue."""
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 2}, backfill=True))
+    server.create(api.new("hog", "ml", topology="v5e-8",
+                          max_run_seconds=300))
+    wait_for(lambda: job_phase(server, "hog") == "Running" or None)
+    # head needs both slices -> blocked until hog ends (ETA ~ +300s)
+    server.create(api.new("head", "ml", topology="v5e-8", num_slices=2))
+    wait_for(lambda: (get_condition(server.get(api.KIND, "head", "ml"),
+                                    "WaitingForSlices") or {})
+             .get("status") == "True" or None)
+    # bounded to 5s << 300s: provably cannot delay the head
+    server.create(api.new("small", "ml", topology="v5e-8",
+                          max_run_seconds=5))
+    wait_for(lambda: job_phase(server, "small") == "Running" or None,
+             timeout=10)
+    # the head is still parked (backfill must not have released it)
+    assert (get_condition(server.get(api.KIND, "head", "ml"),
+                          "WaitingForSlices") or {}).get("status") == "True"
+
+
+def test_backfill_refused_without_bound_or_with_unbounded_runner(harness):
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 2}, backfill=True))
+    # hog has NO declared bound: head ETA unknowable -> no backfill ever
+    server.create(api.new("hog", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "hog") == "Running" or None)
+    server.create(api.new("head", "ml", topology="v5e-8", num_slices=2))
+    wait_for(lambda: (get_condition(server.get(api.KIND, "head", "ml"),
+                                    "WaitingForSlices") or {})
+             .get("status") == "True" or None)
+    server.create(api.new("small", "ml", topology="v5e-8",
+                          max_run_seconds=1))
+    parked = wait_for(lambda: (
+        lambda j: j if (get_condition(j, "WaitingForSlices") or {})
+        .get("status") == "True" else None)(
+        server.get(api.KIND, "small", "ml")))
+    assert "queued behind" in get_condition(
+        parked, "WaitingForSlices")["message"]
+
+
+def test_max_run_seconds_deadline_enforced(harness):
+    """The declared bound is a contract: an overrunning gang is terminated
+    (activeDeadlineSeconds semantics) so backfill proofs stay sound."""
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 1}))
+    server.create(api.new("overrun", "ml", topology="v5e-8",
+                          max_run_seconds=0.5))
+    wait_for(lambda: job_phase(server, "overrun") == "Running" or None)
+    done = wait_for(
+        lambda: (lambda j: j if j.get("status", {}).get("phase") == "Failed"
+                 else None)(server.get(api.KIND, "overrun", "ml")),
+        timeout=20)
+    cond = get_condition(done, "Complete")
+    assert cond["reason"] == "DeadlineExceeded"
+    # slices freed: a successor gang can run
+    server.create(api.new("next", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "next") == "Running" or None,
+             timeout=10)

@@ -1,0 +1,294 @@
+"""Front-door ingress gateway e2e (VERDICT r2 #2: "make Connect work").
+
+The reference's runtime promise is user -> Istio gateway -> VirtualService ->
+pod (notebook_controller.go:401-496 writes routes a real gateway serves).
+These tests prove the platform's own gateway delivers that promise: a
+LocalExecutor notebook serving real HTTP is reached through
+``/notebook/<ns>/<name>/`` via the front door, rewrite/headers semantics
+match Istio's, the culler's HTTP probe resolves through the same path, and a
+predictor ``:generate`` routes the same way.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+from conftest import poll_until as wait
+
+from kubeflow_tpu import gateway as gw
+from kubeflow_tpu.core.httpapi import serve
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.platform import build_platform, build_wsgi_app
+
+# a stand-in notebook server: binds the executor-allocated port, answers the
+# Jupyter activity API and echoes path/headers/body for proxy assertions
+SERVER_SCRIPT = """
+import json, os
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+class H(BaseHTTPRequestHandler):
+    def _reply(self, body):
+        raw = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def do_GET(self):
+        if self.path.endswith("/api/status"):
+            self._reply({"last_activity": "2026-01-02T03:04:05Z"})
+        else:
+            self._reply({"echo": self.path,
+                         "prefix": os.environ.get("NB_PREFIX", ""),
+                         "rsc": self.headers.get("X-RSC-Request", "")})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        self._reply({"echo": self.path,
+                     "body": self.rfile.read(n).decode()})
+
+    def log_message(self, *a):
+        pass
+
+HTTPServer(("127.0.0.1", int(os.environ["KF_POD_PORT"])),
+           H).serve_forever()
+"""
+
+
+@pytest.fixture()
+def platform():
+    server, mgr = build_platform(executor="local", extra_env={
+        "PALLAS_AXON_POOL_IPS": "",       # don't attach the TPU tunnel
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+    })
+    mgr.start()
+    httpd, _ = serve(build_wsgi_app(server, secure_api=False), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield server, mgr, base
+    httpd.shutdown()
+    mgr.stop()
+
+
+def _get(url, method="GET", body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read() or b"null")
+
+
+def _exists(server, kind, name, ns):
+    try:
+        server.get(kind, name, ns)
+        return True
+    except NotFound:
+        return False
+
+
+def _running_with_port(server, name, ns):
+    try:
+        pod = server.get("Pod", name, ns)
+    except NotFound:
+        return None
+    st = pod.get("status", {})
+    if st.get("phase") == "Running" and st.get("portMap"):
+        return pod
+    return None
+
+
+def _make_notebook(server, name="nb1", ns="default"):
+    server.create({
+        "kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"template": {"spec": {"containers": [{
+            "name": name, "image": "jax-nb:v1",
+            "command": ["python", "-c", SERVER_SCRIPT],
+        }]}}},
+    })
+
+
+def test_notebook_connect_through_front_door(platform):
+    """The UI's Connect link — /notebook/<ns>/<name>/ — reaches the live
+    notebook process, path preserved (identity rewrite, so jupyter's
+    base_url=NB_PREFIX serving works) and VS request headers applied."""
+    server, mgr, base = platform
+    _make_notebook(server)
+    wait(lambda: _running_with_port(server, "nb1-0", "default"),
+         timeout=30)
+
+    code, body = _get(base + "/notebook/default/nb1/lab/tree")
+    assert code == 200
+    # identity rewrite: backend sees the FULL prefixed path (jupyter serves
+    # under base_url=NB_PREFIX; stripping would 404 every asset)
+    assert body["echo"] == "/notebook/default/nb1/lab/tree"
+    assert body["prefix"] == "/notebook/default/nb1"
+    # the VirtualService's headers.request.set applied by the proxy
+    assert body["rsc"] == "/notebook/default/nb1/"
+
+    # query strings survive
+    code, body = _get(base + "/notebook/default/nb1/files?path=a.ipynb")
+    assert body["echo"] == "/notebook/default/nb1/files?path=a.ipynb"
+
+    # POST bodies stream through
+    code, body = _get(base + "/notebook/default/nb1/api/kernel", "POST",
+                      {"kernel": "python3"})
+    assert code == 200
+    assert json.loads(body["body"]) == {"kernel": "python3"}
+
+
+def test_notebook_logs_pane_reads_executor_log_tail(platform):
+    """The UI's Logs tab: LocalExecutor mirrors a rolling stdout/stderr
+    tail into pod status.logTail; the jupyter backend serves it at
+    /notebooks/<name>/logs (the k8s log-subresource stand-in)."""
+    from kubeflow_tpu.api import profile as profile_api
+
+    server, mgr, base = platform
+    server.create(profile_api.new("team", "alice@corp.com"))
+    server.create({
+        "kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+        "metadata": {"name": "nblog", "namespace": "team"},
+        "spec": {"template": {"spec": {"containers": [{
+            "name": "nblog", "image": "i",
+            "command": ["python", "-c",
+                        "import sys, time\n"
+                        "print('hello from the notebook', flush=True)\n"
+                        "print('second line', file=sys.stderr, flush=True)\n"
+                        "time.sleep(30)"],
+        }]}}},
+    })
+
+    def logs():
+        r = urllib.request.Request(
+            base + "/jupyter/api/namespaces/team/notebooks/nblog/logs",
+            headers={"X-Goog-Authenticated-User-Email":
+                     "accounts.google.com:alice@corp.com"})
+        try:
+            with urllib.request.urlopen(r, timeout=5) as resp:
+                got = json.loads(resp.read())["logs"]
+        except urllib.error.HTTPError:
+            return None
+        return got if got else None
+
+    lines = wait(logs, timeout=30)
+    assert "hello from the notebook" in lines
+    assert "second line" in lines
+
+
+def test_culler_http_probe_resolves_through_gateway(platform):
+    """Chain step 3 (the Jupyter activity API probe) fires through the
+    gateway's VirtualService resolution — culler.go:138-169's probe, made
+    to work without mesh DNS."""
+    from kubeflow_tpu.controllers.culler import http_activity_probe
+
+    server, mgr, base = platform
+    _make_notebook(server, name="nb2")
+    wait(lambda: _running_with_port(server, "nb2-0", "default"),
+         timeout=30)
+    nb = server.get("Notebook", "nb2", "default")
+    ts = wait(lambda: http_activity_probe(nb, server), timeout=10)
+    assert ts.isoformat().startswith("2026-01-02T03:04:05")
+
+
+def test_rewrite_strips_prefix_for_root_serving_backends(platform):
+    """Tensorboard/predictor-shaped routes (rewrite "/"): the backend sees
+    the path with the prefix replaced — Istio rewrite semantics."""
+    server, mgr, base = platform
+    server.create({"kind": "Pod", "apiVersion": "v1",
+                   "metadata": {"name": "tb1-0", "namespace": "default",
+                                "labels": {"app": "tb1"}},
+                   "spec": {"containers": [{
+                       "name": "tb", "image": "tb:v1",
+                       "command": ["python", "-c", SERVER_SCRIPT],
+                       "ports": [{"containerPort": 6006}]}]}})
+    server.create({"kind": "Service", "apiVersion": "v1",
+                   "metadata": {"name": "tb1", "namespace": "default"},
+                   "spec": {"selector": {"app": "tb1"},
+                            "ports": [{"port": 80, "targetPort": 6006}]}})
+    server.create({"kind": "VirtualService",
+                   "apiVersion": "networking.istio.io/v1alpha3",
+                   "metadata": {"name": "tensorboard-tb1",
+                                "namespace": "default"},
+                   "spec": {"hosts": ["*"],
+                            "gateways": ["kubeflow/kubeflow-gateway"],
+                            "http": [{
+                                "match": [{"uri": {"prefix":
+                                                   "/tensorboard/default/"
+                                                   "tb1/"}}],
+                                "rewrite": {"uri": "/"},
+                                "route": [{"destination": {
+                                    "host": "tb1.default.svc",
+                                    "port": {"number": 80}}}]}]}})
+    wait(lambda: _running_with_port(server, "tb1-0", "default"),
+         timeout=30)
+    code, body = _get(base + "/tensorboard/default/tb1/scalars?run=a")
+    assert code == 200
+    assert body["echo"] == "/scalars?run=a"
+
+
+def test_matched_route_without_backend_is_503(platform):
+    server, mgr, base = platform
+    server.create({"kind": "VirtualService",
+                   "apiVersion": "networking.istio.io/v1alpha3",
+                   "metadata": {"name": "ghost", "namespace": "default"},
+                   "spec": {"http": [{
+                       "match": [{"uri": {"prefix": "/ghost/"}}],
+                       "route": [{"destination": {
+                           "host": "ghost.default.svc",
+                           "port": {"number": 80}}}]}]}})
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(base + "/ghost/page")
+    assert exc.value.code == 503
+
+
+def test_longest_prefix_wins():
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    for name, prefix in (("a", "/nb/"), ("b", "/nb/deep/")):
+        server.create({"kind": "VirtualService", "apiVersion": "x",
+                       "metadata": {"name": name, "namespace": "default"},
+                       "spec": {"http": [{
+                           "match": [{"uri": {"prefix": prefix}}],
+                           "route": [{"destination": {
+                               "host": f"{name}.default.svc",
+                               "port": {"number": 80}}}]}]}})
+    route = gw.match_route(server, "/nb/deep/x")
+    assert route.dest_host == "b.default.svc"
+    route = gw.match_route(server, "/nb/shallow")
+    assert route.dest_host == "a.default.svc"
+    assert gw.match_route(server, "/other") is None
+
+
+@pytest.mark.slow
+def test_predictor_generate_routes_through_gateway(platform):
+    """InferenceService -> Deployment(LocalExecutor subprocess running the
+    real predictor on CPU) -> Service -> VS -> POST :generate through the
+    front door (BASELINE.json configs[4] shape, tiny model)."""
+    server, mgr, base = platform
+    server.create({"kind": "InferenceService",
+                   "apiVersion": "serving.kubeflow.org/v1",
+                   "metadata": {"name": "llm", "namespace": "default"},
+                   "spec": {"predictor": {"model": "llama", "size": "tiny",
+                                          "topology": "v5e-4"}}})
+    wait(lambda: _running_with_port(server, "llm-0", "default"),
+         timeout=30)
+    # the predictor subprocess imports jax + compiles on CPU: give it time
+    code, body = None, None
+    deadline = 120
+    import time
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        try:
+            code, body = _get(base + "/serving/default/llm/v1/models/llama"
+                              ":generate", "POST",
+                              {"ids": [[1, 2, 3]], "max_new_tokens": 4},
+                              timeout=60)
+            break
+        except urllib.error.HTTPError as e:
+            if e.code not in (502, 503):
+                raise
+            time.sleep(2)
+    assert code == 200, "predictor never became reachable"
+    assert len(body["ids"][0]) == 7

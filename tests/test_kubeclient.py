@@ -1,0 +1,187 @@
+"""Controllers over the wire: the KubeStore k8s-REST adapter (VERDICT r2 #4).
+
+The reference's controllers speak REST to a kube-apiserver
+(notebook_controller.go:119-198, tested against envtest's real apiserver).
+Here the SAME controller classes that normally hold an in-process store run
+against ``KubeStore`` — HTTP to a remote API server served by our own
+``core.httpapi`` facade (the envtest move: real API semantics, no cluster).
+This is the bridge that lets ``manifests/`` deploy a control plane whose
+executors live on other machines (TPU-VM node agents).
+"""
+
+import pytest
+from conftest import poll_until as wait
+
+from kubeflow_tpu.api import jaxjob as jaxjob_api
+from kubeflow_tpu.controllers.executor import FakeExecutor, LocalExecutor
+from kubeflow_tpu.controllers.jaxjob import JAXJobController
+from kubeflow_tpu.controllers.notebook import NotebookController
+from kubeflow_tpu.controllers import workloads
+from kubeflow_tpu.core import APIServer, Manager, quota
+from kubeflow_tpu.core.httpapi import RestAPI, serve
+from kubeflow_tpu.core.kubeclient import KubeStore
+from kubeflow_tpu.core.store import Conflict, Invalid, NotFound
+
+
+@pytest.fixture()
+def make_remote():
+    """The 'real cluster': APIServer + admission, served over HTTP, with a
+    FakeExecutor manager as its kubelet."""
+    cleanup = []
+
+    def build(**executor_kw):
+        server = APIServer()
+        quota.register(server)
+        mgr = Manager(server)
+        mgr.add(FakeExecutor(server, **executor_kw))
+        mgr.start()
+        httpd, _ = serve(RestAPI(server), 0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        cleanup.append((httpd, mgr))
+        return server, base
+
+    yield build
+    for httpd, mgr in cleanup:
+        httpd.shutdown()
+        mgr.stop()
+
+
+def test_store_surface_over_http(make_remote):
+    server, base = make_remote()
+    store = KubeStore(base)
+    created = store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                            "metadata": {"name": "c", "namespace": "d"},
+                            "spec": {"x": 1}})
+    assert created["metadata"]["resourceVersion"]
+    got = store.get("ConfigMap", "c", "d")
+    assert got["spec"] == {"x": 1}
+
+    # optimistic concurrency crosses the wire: stale rv -> Conflict
+    stale = dict(got)
+    store.update(got)  # no-op ok
+    got2 = store.get("ConfigMap", "c", "d")
+    got2["spec"] = {"x": 2}
+    store.update(got2)
+    stale["spec"] = {"x": 3}
+    with pytest.raises(Conflict):
+        store.update(stale)
+
+    # label-selector list
+    store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                  "metadata": {"name": "l", "namespace": "d",
+                               "labels": {"app": "a"}}, "spec": {}})
+    items = store.list("ConfigMap", namespace="d",
+                       label_selector={"matchLabels": {"app": "a"}})
+    assert [o["metadata"]["name"] for o in items] == ["l"]
+
+    store.delete("ConfigMap", "c", "d")
+    with pytest.raises(NotFound):
+        store.get("ConfigMap", "c", "d")
+
+    # server-side admission still guards the wire path
+    server.register_validating_hook(
+        lambda o: (_ for _ in ()).throw(Invalid("nope"))
+        if o.get("kind") == "Forbidden" else None)
+    with pytest.raises(Invalid):
+        store.create({"kind": "Forbidden", "apiVersion": "v1",
+                      "metadata": {"name": "f", "namespace": "d"},
+                      "spec": {}})
+
+
+def test_watch_streams_over_http(make_remote):
+    server, base = make_remote()
+    store = KubeStore(base)
+    w = store.watch(kinds=["ConfigMap"])
+    try:
+        store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                      "metadata": {"name": "w", "namespace": "d"},
+                      "spec": {}})
+        ev = w.next(timeout=5)
+        assert ev is not None and ev.type == "ADDED"
+        assert ev.object["metadata"]["name"] == "w"
+        store.delete("ConfigMap", "w", "d")
+        ev = w.next(timeout=5)
+        assert ev is not None and ev.type == "DELETED"
+    finally:
+        w.stop()
+
+
+def test_notebook_controller_against_http_facade(make_remote):
+    """The notebook controller subset (VERDICT #4 'Done' criterion): CR ->
+    StatefulSet -> pod -> status mirror -> stop annotation, all over HTTP."""
+    server, base = make_remote(complete=False)  # notebooks run forever
+    store = KubeStore(base)
+    mgr = Manager(store)
+    mgr.add(NotebookController(store))
+    workloads.register(store, mgr)
+    mgr.start()
+    try:
+        store.create({"kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+                      "metadata": {"name": "nb", "namespace": "team"},
+                      "spec": {"template": {"spec": {"containers": [
+                          {"name": "nb", "image": "jax-nb:v1"}]}}}})
+        nb = wait(lambda: (lambda o: o if o.get("status", {})
+                           .get("readyReplicas") else None)(
+            store.get("Notebook", "nb", "team")), timeout=20)
+        assert nb["status"]["containerState"] == {"running": {}}
+        # children materialized in the REMOTE store
+        server.get("StatefulSet", "nb", "team")
+        server.get("Service", "nb", "team")
+        server.get("VirtualService", "notebook-nb", "team")
+
+        # stop annotation -> replicas 0 across the wire
+        fresh = store.get("Notebook", "nb", "team")
+        fresh["metadata"]["annotations"][
+            "kubeflow-resource-stopped"] = "2026-07-29T00:00:00Z"
+        store.update(fresh)
+        wait(lambda: (server.get("StatefulSet", "nb", "team")["spec"]
+                      ["replicas"] == 0) or None, timeout=20)
+    finally:
+        mgr.stop()
+        store.close()
+
+
+def test_jaxjob_gang_against_http_facade(make_remote):
+    server, base = make_remote()
+    store = KubeStore(base)
+    mgr = Manager(store)
+    mgr.add(JAXJobController(store))
+    mgr.start()
+    try:
+        store.create(jaxjob_api.new("train", "team", topology="v5e-8"))
+        job = wait(lambda: (lambda o: o if o.get("status", {})
+                            .get("phase") == "Succeeded" else None)(
+            store.get("JAXJob", "train", "team")), timeout=30)
+        assert job["status"]["workers"]["total"] == 2  # v5e-8 = 2 hosts
+        assert job["status"]["result"]["samples_per_sec"] == 100.0
+    finally:
+        mgr.stop()
+        store.close()
+
+
+def test_split_process_kubelet():
+    """LocalExecutor(KubeStore) IS the KubeExecutor: pod state lives in the
+    remote apiserver, the process runs where the executor agent does — the
+    TPU-VM node-agent shape."""
+    server = APIServer()
+    httpd, _ = serve(RestAPI(server), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    store = KubeStore(base)
+    mgr = Manager(store)
+    mgr.add(LocalExecutor(store))
+    mgr.start()
+    try:
+        store.create({"kind": "Pod", "apiVersion": "v1",
+                      "metadata": {"name": "p", "namespace": "d"},
+                      "spec": {"containers": [{
+                          "name": "c", "image": "i",
+                          "command": ["python", "-c",
+                                      "print('{\"ok\": true}')"]}]}})
+        pod = wait(lambda: (lambda o: o if o.get("status", {})
+                            .get("phase") == "Succeeded" else None)(
+            server.get("Pod", "p", "d")), timeout=20)
+        assert pod["status"]["result"] == {"ok": True}
+    finally:
+        mgr.stop()
+        store.close()
+        httpd.shutdown()

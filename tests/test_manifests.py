@@ -66,7 +66,8 @@ def test_platform_args_are_real_flags():
             if d["metadata"]["name"] == "kubeflow-tpu-platform"]
     assert deps
     known = {"--host", "--port", "--executor", "--leader-election",
-             "--insecure-api", "--bootstrap-admin", "--dev-identity"}
+             "--insecure-api", "--bootstrap-admin", "--dev-identity",
+             "--data-dir"}
     # keep `known` honest against the real parser
     import contextlib
     import io

@@ -101,7 +101,7 @@ def test_full_user_journey(platform):
     isvc = wait(lambda: (server.get("InferenceService", "llm", "journey")
                          if server.get("InferenceService", "llm", "journey")
                          .get("status", {}).get("ready") else None))
-    assert isvc["status"]["url"] == "/models/journey/llm/"
+    assert isvc["status"]["url"] == "/serving/journey/llm/"
 
     req(base, "/apis/PipelineRun", "POST", {
         "metadata": {"name": "pl", "namespace": "journey"},

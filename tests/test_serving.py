@@ -114,10 +114,10 @@ def test_inferenceservice_controller():
         assert c["resources"]["limits"]["cloud-tpu.google.com/v5e"] == 4
         isvc = server.get(api.KIND, "llama-7b", "serving")
         assert isvc["status"]["ready"] is True
-        assert isvc["status"]["url"] == "/models/serving/llama-7b/"
+        assert isvc["status"]["url"] == "/serving/serving/llama-7b/"
         vs = server.get("VirtualService", "isvc-llama-7b", "serving")
         assert (vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
-                == "/models/serving/llama-7b/")
+                == "/serving/serving/llama-7b/")
     finally:
         mgr.stop()
 
