@@ -118,10 +118,22 @@ class LocalExecutor(Controller):
     kind = "Pod"
 
     def __init__(self, server, *, extra_env: dict[str, str] | None = None,
-                 timeout: float = 600.0, volumes_root: str | None = None):
+                 timeout: float = 600.0, volumes_root: str | None = None,
+                 node_name: str | None = None):
         super().__init__(server)
         self.extra_env = extra_env or {}
         self.timeout = timeout
+        # stable node identity, bound into spec.nodeName on launch:
+        # restart-stable (same name after a platform restart, so orphan
+        # relaunch works) but distinct between two concurrent executors
+        # sharing one apiserver, so they never reset or double-launch each
+        # other's pods (advisor r3).  Default = hostname: distinct across
+        # hosts with no config; two executors on ONE host must set
+        # KF_NODE_NAME/node_name apart.
+        import socket
+
+        self.node_name = (node_name or os.environ.get("KF_NODE_NAME")
+                          or socket.gethostname())
         # PVC mounts materialize as host directories under this root; the
         # mount path is exposed to the process as KF_MOUNT_<NAME> (a
         # one-host kubelet has no mount namespaces — the env var is the
@@ -155,6 +167,12 @@ class LocalExecutor(Controller):
             with self._lock:
                 tracked = self._procs.get(key, (None,))[0] == uid
             if not tracked:
+                owner = (pod["spec"].get("nodeName")
+                         or pod.get("status", {}).get("nodeName"))
+                if owner is not None and owner != self.node_name:
+                    # another executor's pod — resetting it here would
+                    # perpetually bounce and double-launch it
+                    return None
                 # orphaned by a platform restart: the subprocess died with
                 # the old process and cannot be re-adopted — reset to
                 # Pending so the next reconcile relaunches it cleanly
@@ -165,6 +183,26 @@ class LocalExecutor(Controller):
             return None
         if phase != "Pending":
             return None
+        # bind the pod to this node BEFORE launching (kubelet binding
+        # semantics, via spec.nodeName + optimistic concurrency): with two
+        # executors sharing one apiserver, exactly one claim survives the
+        # resourceVersion conflict check, so a Pending pod is never
+        # double-launched (the in-process _procs claim only dedupes
+        # reconciles within ONE executor)
+        bound = pod["spec"].get("nodeName")
+        if bound is None:
+            pod["spec"]["nodeName"] = self.node_name
+            try:
+                pod = self.server.update(pod)
+            except Conflict:
+                # raced (another executor's claim or any concurrent pod
+                # write): re-read and re-decide next reconcile
+                return Result(requeue_after=0.05)
+            except NotFound:
+                return None
+        elif bound != self.node_name:
+            return None  # bound to another executor
+        uid = pod["metadata"]["uid"]
         with self._lock:
             if key in self._procs and self._procs[key][0] == uid:
                 return None  # already launched for this incarnation
@@ -177,7 +215,7 @@ class LocalExecutor(Controller):
         # host port bridge (gateway.resolve_backend)
         portmap = self._allocate_ports(pod)
         self._portmaps[uid] = portmap
-        status = {"phase": "Running"}
+        status = {"phase": "Running", "nodeName": self.node_name}
         if portmap:
             status["podIP"] = "127.0.0.1"
             status["portMap"] = portmap

@@ -65,8 +65,11 @@ def _pod_topology(pod: dict) -> str | None:
 
 def _scan_gangs(server: APIServer,
                 topology: str) -> tuple[dict, dict]:
-    """(released, waiting): (ns, gang) -> slices held/needed, from the pod
-    view (level-triggered: recomputed every decision, no counters)."""
+    """(released, waiting): (ns, gang, job_uid) -> slices held/needed, from
+    the pod view (level-triggered: recomputed every decision, no counters).
+    Keys carry the owning JAXJob's uid so a job deleted and recreated under
+    the same name is a distinct gang (advisor r3: a (ns, name) key let the
+    recreation inherit the old creationTimestamp and jump the FIFO)."""
     released: dict[tuple, int] = {}
     waiting: dict[tuple, int] = {}
     for pod in server.list("Pod"):
@@ -74,11 +77,15 @@ def _scan_gangs(server: APIServer,
             continue
         if pod.get("status", {}).get("phase") in TERMINAL_PHASES:
             continue
-        gang = pod["metadata"].get("labels", {}).get("gang")
+        md = pod["metadata"]
+        gang = md.get("labels", {}).get("gang")
         if not gang:
             continue
-        key = (pod["metadata"].get("namespace"), gang)
-        slices = int(pod["metadata"]["labels"].get("jaxjob-num-slices", "1"))
+        owner_uid = next((r.get("uid")
+                          for r in md.get("ownerReferences", [])
+                          if r.get("kind") == "JAXJob"), None)
+        key = (md.get("namespace"), gang, owner_uid)
+        slices = int(md["labels"].get("jaxjob-num-slices", "1"))
         if pod["spec"].get("schedulingGates"):
             waiting[key] = slices
         else:
@@ -91,7 +98,8 @@ def _scan_gangs(server: APIServer,
 
 # creationTimestamp is server-set and immutable, so FIFO ordering lookups
 # are memoizable for a job's lifetime (kills the one-get-per-waiting-gang
-# scan cost VERDICT r2 weak #5 flagged; ~34% faster decisions at 500 gangs)
+# scan cost VERDICT r2 weak #5 flagged; ~34% faster decisions at 500 gangs).
+# Keyed by (ns, name, uid): a same-name recreation gets a fresh entry.
 _CREATED_CACHE: dict[tuple, float] = {}
 
 
@@ -107,7 +115,11 @@ def _job_created(server: APIServer, key: tuple) -> float:
     if ts is not None:
         return ts
     job = _job_get(server, key)
-    if job is None:
+    if job is None or (len(key) > 2 and key[2] is not None
+                       and job["metadata"].get("uid") != key[2]):
+        # job gone (or replaced by a same-name recreation): its pods are
+        # moments from cascade GC — never cache, sort conservatively first
+        _CREATED_CACHE.pop(key, None)
         return 0.0
     ts = float(job["metadata"].get("creationTimestamp", 0.0))
     if len(_CREATED_CACHE) > 10000:
@@ -167,7 +179,8 @@ def may_release(server: APIServer, job: dict,
                        f"pool only has {cap} (will never fit)")
 
     released, waiting = _scan_gangs(server, topology)
-    me = (job["metadata"]["namespace"], job["metadata"]["name"])
+    me = (job["metadata"]["namespace"], job["metadata"]["name"],
+          job["metadata"].get("uid"))
     if me in released:
         # this gang already holds its slices (backfilling a deleted worker):
         # re-release unconditionally or it deadlocks against its own hold

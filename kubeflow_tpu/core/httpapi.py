@@ -170,8 +170,18 @@ class RestAPI:
         kinds = ([k for k in raw_kinds.split(",") if k]
                  if raw_kinds else None)
         namespace = qs.get("namespace", [None])[0]
-        self._authz(user, "watch", "*" if not kinds else kinds[0],
-                    namespace)
+        # every requested kind must be authorized — a single-kind check
+        # would let ?kinds=Allowed,Secret stream Secrets (advisor r3)
+        try:
+            for kind in (kinds or ["*"]):
+                self._authz(user, "watch", kind, namespace)
+        except PermissionError as e:
+            payload = json.dumps({"error": str(e)}).encode()
+            HTTP_REQS.labels("GET", "403").inc()
+            start_response("403 Forbidden",
+                           [("Content-Type", "application/json"),
+                            ("Content-Length", str(len(payload)))])
+            return [payload]
         watch = self.server.watch(kinds=kinds, namespace=namespace)
         start_response("200 OK",
                        [("Content-Type", "application/jsonl"),
@@ -222,10 +232,23 @@ class RestAPI:
         return json.loads(raw or b"{}")
 
 
-def serve(app, port: int, host: str = "127.0.0.1"):
-    """Run a WSGI app on a threading HTTP server; returns (server, thread)."""
+def serve(app, port: int, host: str = "127.0.0.1", upgrade=None):
+    """Run a WSGI app on a threading HTTP server; returns (server, thread).
+
+    ``upgrade(handler) -> bool``: WSGI cannot hijack sockets, so requests
+    carrying ``Upgrade: websocket`` are offered to this hook BEFORE the
+    WSGI machinery sees them — the hook gets the raw
+    ``BaseHTTPRequestHandler`` (parsed request line + headers, live
+    socket) and returns True if it consumed the connection (the gateway's
+    WebSocket tunnel) or False to fall through to normal WSGI handling.
+    Defaults to the app's own ``websocket_upgrade`` attribute when set.
+    """
     from socketserver import ThreadingMixIn
-    from wsgiref.simple_server import WSGIServer, make_server, WSGIRequestHandler
+    from wsgiref.simple_server import (ServerHandler, WSGIRequestHandler,
+                                       WSGIServer, make_server)
+
+    if upgrade is None:
+        upgrade = getattr(app, "websocket_upgrade", None)
 
     class ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
         daemon_threads = True
@@ -233,6 +256,30 @@ def serve(app, port: int, host: str = "127.0.0.1"):
     class QuietHandler(WSGIRequestHandler):
         def log_message(self, *args):  # route access logs to our logger
             pass
+
+        def handle(self):
+            # WSGIRequestHandler.handle, with an upgrade-interception
+            # window between parse_request and the WSGI run
+            self.raw_requestline = self.rfile.readline(65537)
+            if len(self.raw_requestline) > 65536:
+                self.requestline = ""
+                self.request_version = ""
+                self.command = ""
+                self.send_error(414)
+                return
+            if not self.parse_request():
+                return
+            if (upgrade is not None
+                    and "websocket" in self.headers.get("Upgrade",
+                                                        "").lower()
+                    and upgrade(self)):
+                self.close_connection = True
+                return
+            handler = ServerHandler(self.rfile, self.wfile,
+                                    self.get_stderr(), self.get_environ(),
+                                    multithread=True)
+            handler.request_handler = self
+            handler.run(self.server.get_app())
 
     httpd = make_server(host, port, app, server_class=ThreadingWSGIServer,
                         handler_class=QuietHandler)

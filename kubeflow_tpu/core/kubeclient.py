@@ -15,6 +15,19 @@ IS the split-process kubelet — pod state lives in the remote apiserver, the
 processes run wherever the executor agent does (how a TPU-VM node agent
 would join the control plane).
 
+Watch resilience (the informer contract controller-runtime gets for free):
+a broken watch connection RECONNECTS with backoff, then re-LISTs the
+watched kinds and synthesizes MODIFIED events for every live object (so
+level-triggered controllers re-converge anything that changed during the
+gap) and DELETED events for objects that vanished (tracked against the
+keys this watch has seen).  The down/up state is visible: a gauge
+(``kubeclient_watches_connected``, the count of currently-connected
+streams), a reconnect counter, and warning logs.
+
+Auth/transport: ``token=`` sends ``Authorization: Bearer`` (the k8s
+ServiceAccount convention), ``cafile=`` pins the server CA for https URLs,
+``insecure_tls=True`` skips verification (dev only).
+
 Error mapping: 404 -> NotFound, 409 -> Conflict, 403 -> PermissionError,
 422 -> Invalid — the exceptions controllers already catch.
 """
@@ -23,6 +36,7 @@ from __future__ import annotations
 
 import json
 import queue
+import ssl
 import threading
 import urllib.error
 import urllib.request
@@ -35,6 +49,18 @@ from kubeflow_tpu.core.store import (
     WatchEvent,
     _match_fields,
 )
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+log = get_logger("kubeclient")
+
+WATCH_CONNECTED = REGISTRY.gauge(
+    "kubeclient_watches_connected",
+    "number of currently-connected watch streams in this process")
+WATCH_RECONNECTS = REGISTRY.counter(
+    "kubeclient_watch_reconnects_total", "watch stream reconnections")
+_GAUGE_LOCK = threading.Lock()
+_CONNECTED_COUNT = 0
 
 # facade convention for cluster-scoped kinds (httpapi routes)
 _NO_NS = "_"
@@ -42,24 +68,43 @@ _NO_NS = "_"
 
 class KubeStore:
     def __init__(self, base_url: str, *, user: str | None = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, token: str | None = None,
+                 cafile: str | None = None, insecure_tls: bool = False):
         self.base_url = base_url.rstrip("/")
         self.user = user
         self.timeout = timeout
+        self.token = token
         self._watches: list[_HttpWatch] = []
+        if base_url.startswith("https"):
+            ctx = ssl.create_default_context(cafile=cafile)
+            if insecure_tls:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            self._ssl_ctx: ssl.SSLContext | None = ctx
+        else:
+            self._ssl_ctx = None
 
     # -- plumbing -------------------------------------------------------------
+    def _headers(self, request: urllib.request.Request) -> None:
+        if self.user:
+            request.add_header("X-Goog-Authenticated-User-Email",
+                               "accounts.google.com:" + self.user)
+        if self.token:
+            request.add_header("Authorization", f"Bearer {self.token}")
+
+    def _open(self, request: urllib.request.Request, timeout=None):
+        return urllib.request.urlopen(request, timeout=timeout,
+                                      context=self._ssl_ctx)
+
     def _req(self, method: str, path: str, body: dict | None = None):
         data = json.dumps(body).encode() if body is not None else None
         r = urllib.request.Request(self.base_url + path, data=data,
                                    method=method)
-        if self.user:
-            r.add_header("X-Goog-Authenticated-User-Email",
-                         "accounts.google.com:" + self.user)
+        self._headers(r)
         if data is not None:
             r.add_header("Content-Type", "application/json")
         try:
-            with urllib.request.urlopen(r, timeout=self.timeout) as resp:
+            with self._open(r, timeout=self.timeout) as resp:
                 return json.loads(resp.read() or b"null")
         except urllib.error.HTTPError as e:
             detail = ""
@@ -145,38 +190,135 @@ class KubeStore:
 
 class _HttpWatch:
     """Client side of GET /apis/watch: a reader thread turns JSON lines
-    into WatchEvents on a queue — same surface as core.store.Watch."""
+    into WatchEvents on a queue — same surface as core.store.Watch.
+
+    Survives connection loss: reconnects with backoff and re-lists (module
+    docstring).  The initial connection is synchronous and raises, so
+    misconfiguration fails fast instead of silently retrying forever.
+    """
+
+    RECONNECT_DELAYS = (0.2, 0.5, 1.0, 2.0, 5.0)
 
     def __init__(self, store: KubeStore, kinds, namespace):
+        self._kinds = sorted(set(kinds)) if kinds else None
+        self._namespace = namespace
         query = []
-        if kinds:
-            query.append("kinds=" + ",".join(sorted(set(kinds))))
+        if self._kinds:
+            query.append("kinds=" + ",".join(self._kinds))
         if namespace:
             query.append(f"namespace={namespace}")
-        q = ("?" + "&".join(query)) if query else ""
+        self._query = ("?" + "&".join(query)) if query else ""
         self._store = store
         self._queue: queue.Queue = queue.Queue()
         self._stopped = threading.Event()
-        r = urllib.request.Request(store.base_url + "/apis/watch" + q)
-        if store.user:
-            r.add_header("X-Goog-Authenticated-User-Email",
-                         "accounts.google.com:" + store.user)
-        self._resp = urllib.request.urlopen(r)  # no timeout: long-lived
+        # keys this watch has observed alive — the baseline that lets a
+        # post-reconnect re-list synthesize DELETED for vanished objects
+        self._known: set[tuple] = set()
+        self._resp = self._connect()  # synchronous: config errors raise
+        self._connected = False
+        self._mark_connected(True)
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 
+    def _connect(self):
+        r = urllib.request.Request(
+            self._store.base_url + "/apis/watch" + self._query)
+        self._store._headers(r)
+        return self._store._open(r)  # no timeout: long-lived stream
+
+    @staticmethod
+    def _key(obj: dict) -> tuple:
+        md = obj.get("metadata", {})
+        return (obj.get("kind"), md.get("namespace"), md.get("name"))
+
+    def _emit(self, ev: WatchEvent) -> None:
+        key = self._key(ev.object)
+        if ev.type == "DELETED":
+            self._known.discard(key)
+        else:
+            self._known.add(key)
+        self._queue.put(ev)
+
     def _pump(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                for line in self._resp:
+                    if self._stopped.is_set():
+                        return
+                    line = line.strip()
+                    if not line or line == b"{}":  # heartbeat
+                        continue
+                    rec = json.loads(line)
+                    self._emit(WatchEvent(rec["type"], rec["object"]))
+            except (OSError, ValueError):
+                pass  # fall through to the reconnect decision below
+            if self._stopped.is_set():
+                return
+            self._mark_connected(False)
+            log.warning("watch stream lost; reconnecting",
+                        kinds=self._kinds, namespace=self._namespace)
+            if not self._reconnect():
+                return
+
+    def _mark_connected(self, up: bool) -> None:
+        global _CONNECTED_COUNT
+        with _GAUGE_LOCK:  # flag + count transition atomically (pump
+            # thread and stop() both call this)
+            if up == self._connected:
+                return
+            self._connected = up
+            _CONNECTED_COUNT += 1 if up else -1
+            WATCH_CONNECTED.set(_CONNECTED_COUNT)
+
+    def _reconnect(self) -> bool:
+        """Reopen the stream (backoff, forever until stop()), then re-list
+        and synthesize sync/delete events.  Ordering: the new watch opens
+        BEFORE the re-list so no event in between is lost — duplicates are
+        harmless under level-triggered reconcile."""
+        attempt = 0
+        while not self._stopped.is_set():
+            try:
+                self._resp = self._connect()
+                break
+            except (OSError, urllib.error.URLError):
+                delay = self.RECONNECT_DELAYS[
+                    min(attempt, len(self.RECONNECT_DELAYS) - 1)]
+                attempt += 1
+                if self._stopped.wait(delay):
+                    return False
+        if self._stopped.is_set():
+            return False
+        WATCH_RECONNECTS.inc()
+        self._mark_connected(True)
+        log.info("watch stream reconnected", attempts=attempt + 1)
+        if self._kinds is None:
+            # unbounded watch: cannot enumerate every kind to re-list
+            log.warning("watch reconnected without re-list "
+                        "(no kind filter); events during the gap are lost")
+            return True
+        alive: set[tuple] = set()
         try:
-            for line in self._resp:
-                if self._stopped.is_set():
-                    return
-                line = line.strip()
-                if not line or line == b"{}":  # heartbeat
-                    continue
-                rec = json.loads(line)
-                self._queue.put(WatchEvent(rec["type"], rec["object"]))
-        except (OSError, ValueError):
-            pass  # connection closed (stop() or server shutdown)
+            for kind in self._kinds:
+                for obj in self._store.list(kind,
+                                            namespace=self._namespace):
+                    alive.add(self._key(obj))
+                    self._emit(WatchEvent("MODIFIED", obj))
+        except (OSError, urllib.error.URLError, NotFound):
+            # server flapping again: the pump loop will land back here
+            return True
+        except PermissionError as e:
+            # list permission denied (rotated token, watch-but-not-list
+            # authorizer): the stream itself is up, so keep pumping — but
+            # the gap sync is lost and must be visible
+            log.error("watch re-list denied; events during the gap are "
+                      "lost", error=str(e))
+            return True
+        for key in self._known - alive:
+            kind, ns, name = key
+            self._emit(WatchEvent("DELETED", {
+                "kind": kind,
+                "metadata": {"namespace": ns, "name": name}}))
+        return True
 
     def next(self, timeout: float | None = None) -> WatchEvent | None:
         try:
@@ -186,6 +328,7 @@ class _HttpWatch:
 
     def stop(self) -> None:
         self._stopped.set()
+        self._mark_connected(False)
         try:
             self._resp.close()
         except OSError:

@@ -5,8 +5,16 @@ restart (its envtest harness boots a real etcd+apiserver,
 suite_test.go:46-105).  This module gives the in-process APIServer the same
 property (VERDICT r2 #3): every committed mutation appends one JSON line to
 ``wal.jsonl`` under a data dir, and ``attach()`` replays snapshot+WAL into a
-fresh store on boot, then compacts (full snapshot, empty WAL) so the log
-never grows unboundedly across restarts.
+fresh store on boot, then compacts (full snapshot, empty WAL).
+
+Compaction also runs *mid-process*: when the WAL exceeds
+``compact_bytes`` / ``compact_records`` (etcd's auto-compaction role), the
+journal hook re-snapshots and truncates while it already holds the store
+lock, so a long-lived platform under pod churn keeps the log bounded
+(advisor r3: a ~1/s status flush could otherwise fill the data PVC).
+High-churn ephemeral status (``status.logTail``) is elided from journaled
+records — log lines are re-derived from the live pod on demand and are not
+part of durable state.
 
 Layout under ``data_dir``:
     snapshot.json   {"rv": N, "objects": [...]} — full store at compaction
@@ -26,15 +34,25 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Any
 
 from kubeflow_tpu.core.store import APIServer
 from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
 
 log = get_logger("persistence")
 
 SNAPSHOT = "snapshot.json"
 WAL = "wal.jsonl"
+
+# runtime compaction thresholds (either trips it)
+COMPACT_BYTES = 32 * 1024 * 1024
+COMPACT_RECORDS = 50_000
+
+WAL_COMPACTIONS = REGISTRY.counter(
+    "persistence_wal_compactions_total", "mid-run WAL compactions")
+
+# ephemeral status fields never journaled: high-churn, re-derivable
+EPHEMERAL_STATUS = ("logTail",)
 
 
 class WriteAheadLog:
@@ -43,6 +61,8 @@ class WriteAheadLog:
         self.fsync = fsync
         self._lock = threading.Lock()
         self._f = open(path, "a", encoding="utf-8")
+        self.bytes = self._f.tell()
+        self.records = 0
 
     def append(self, record: dict) -> None:
         line = json.dumps(record, separators=(",", ":"))
@@ -51,6 +71,18 @@ class WriteAheadLog:
             self._f.flush()
             if self.fsync:
                 os.fsync(self._f.fileno())
+            self.bytes += len(line) + 1
+            self.records += 1
+
+    def truncate(self) -> None:
+        """Reset to an empty log (caller has just snapshotted)."""
+        with self._lock:
+            self._f.close()
+            self._f = open(self.path, "w", encoding="utf-8")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self.bytes = 0
+            self.records = 0
 
     def close(self) -> None:
         with self._lock:
@@ -84,8 +116,64 @@ def _load_records(data_dir: str):
                     yield "del", tuple(rec["key"])
 
 
-def attach(server: APIServer, data_dir: str, *,
-           fsync: bool = False) -> APIServer:
+def _journal_view(obj: dict) -> dict:
+    """The durable shape of an object: ephemeral status fields elided.
+    Shallow-copies only the layers it changes; json.dumps happens
+    immediately (under the store lock), so aliasing deeper layers is safe."""
+    status = obj.get("status")
+    if isinstance(status, dict) and any(k in status
+                                        for k in EPHEMERAL_STATUS):
+        obj = dict(obj)
+        obj["status"] = {k: v for k, v in status.items()
+                        if k not in EPHEMERAL_STATUS}
+    return obj
+
+
+class Persister:
+    """Owns the data dir for one APIServer: journals mutations, compacts
+    when the WAL crosses the thresholds.  The journal hook runs under the
+    store lock, so compaction reads ``server._objects`` race-free."""
+
+    def __init__(self, server: APIServer, data_dir: str, *,
+                 fsync: bool = False,
+                 compact_bytes: int = COMPACT_BYTES,
+                 compact_records: int = COMPACT_RECORDS):
+        self.server = server
+        self.data_dir = data_dir
+        self.compact_bytes = compact_bytes
+        self.compact_records = compact_records
+        self.wal = WriteAheadLog(os.path.join(data_dir, WAL), fsync=fsync)
+
+    def journal(self, op: str, payload) -> None:
+        if op == "put":
+            self.wal.append({"op": "put", "obj": _journal_view(payload)})
+        else:
+            self.wal.append({"op": "del", "key": list(payload)})
+        if (self.wal.bytes >= self.compact_bytes
+                or self.wal.records >= self.compact_records):
+            self.compact()
+            WAL_COMPACTIONS.inc()
+            log.info("WAL compacted mid-run",
+                     objects=len(self.server._objects))
+
+    def compact(self) -> None:
+        """Write a fresh snapshot atomically, then truncate the WAL.
+        Caller must hold the store lock (journal does; attach takes it)."""
+        snap_tmp = os.path.join(self.data_dir, SNAPSHOT + ".tmp")
+        snap = {"rv": self.server._rv,
+                "objects": [_journal_view(o)
+                            for o in self.server._objects.values()]}
+        with open(snap_tmp, "w", encoding="utf-8") as f:
+            json.dump(snap, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(snap_tmp, os.path.join(self.data_dir, SNAPSHOT))
+        self.wal.truncate()
+
+
+def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
+           compact_bytes: int = COMPACT_BYTES,
+           compact_records: int = COMPACT_RECORDS) -> APIServer:
     """Replay ``data_dir`` into ``server``, compact, and hook the journal so
     every further mutation is logged.  Idempotent per process; the server
     must not have a journal attached already."""
@@ -114,30 +202,12 @@ def attach(server: APIServer, data_dir: str, *,
         server._objects.update(objects)
         server._rv = max(server._rv, max_rv)
 
-    # -- compact: one fresh snapshot, empty WAL (atomic rename) --
-    snap_tmp = os.path.join(data_dir, SNAPSHOT + ".tmp")
+    persister = Persister(server, data_dir, fsync=fsync,
+                          compact_bytes=compact_bytes,
+                          compact_records=compact_records)
     with server._lock:
-        snap = {"rv": server._rv,
-                "objects": list(server._objects.values())}
-    with open(snap_tmp, "w", encoding="utf-8") as f:
-        json.dump(snap, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(snap_tmp, os.path.join(data_dir, SNAPSHOT))
-    wal_path = os.path.join(data_dir, WAL)
-    with open(wal_path, "w", encoding="utf-8") as f:
-        f.flush()
-        os.fsync(f.fileno())
-
-    wal = WriteAheadLog(wal_path, fsync=fsync)
-
-    def journal(op: str, payload: Any) -> None:
-        if op == "put":
-            wal.append({"op": "put", "obj": payload})
-        else:
-            wal.append({"op": "del", "key": list(payload)})
-
-    server._journal = journal
+        persister.compact()
+        server._journal = persister.journal
     if objects:
         log.info("state recovered", objects=len(objects),
                  records_replayed=count, rv=max_rv)

@@ -20,7 +20,6 @@ core.httpapi exposes the same store over REST for out-of-process clients.
 
 from __future__ import annotations
 
-import copy
 import fnmatch
 import queue
 import threading
@@ -114,7 +113,7 @@ class APIServer:
 
     # -- CRUD -----------------------------------------------------------------
     def create(self, obj: dict) -> dict:
-        obj = copy.deepcopy(obj)
+        obj = _jcopy(obj)
         kind = obj["kind"]
         md = ob.meta(obj)
         if "name" not in md:
@@ -144,8 +143,8 @@ class APIServer:
             md.setdefault("annotations", {})
             self._objects[key] = obj
             self._record("put", obj)
-            out = copy.deepcopy(obj)
-        self._emit(WatchEvent("ADDED", copy.deepcopy(obj)))
+            out = _jcopy(obj)
+        self._emit(WatchEvent("ADDED", _jcopy(obj)))
         return out
 
     def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
@@ -153,7 +152,7 @@ class APIServer:
             key = self._key(kind, namespace, name)
             if key not in self._objects:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(self._objects[key])
+            return _jcopy(self._objects[key])
 
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict | None = None,
@@ -171,12 +170,12 @@ class APIServer:
                     continue
                 if field_match and not _match_fields(obj, field_match):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(_jcopy(obj))
             return sorted(out, key=lambda o: (o["metadata"].get("namespace")
                                               or "", o["metadata"]["name"]))
 
     def update(self, obj: dict) -> dict:
-        obj = copy.deepcopy(obj)
+        obj = _jcopy(obj)
         kind = obj["kind"]
         md = obj["metadata"]
         with self._lock:
@@ -210,14 +209,14 @@ class APIServer:
             # (prevents status-mirroring reconcile hot-loops)
             md["resourceVersion"] = existing["metadata"]["resourceVersion"]
             if obj == existing:
-                return copy.deepcopy(existing)
+                return _jcopy(existing)
             md["resourceVersion"] = self._next_rv()
             self._objects[key] = obj
             self._record("put", obj)
             finalize = ("deletionTimestamp" in md
                         and not md.get("finalizers"))
-            out = copy.deepcopy(obj)
-        self._emit(WatchEvent("MODIFIED", copy.deepcopy(obj)))
+            out = _jcopy(obj)
+        self._emit(WatchEvent("MODIFIED", _jcopy(obj)))
         if finalize:
             self._remove(kind, md.get("namespace"), md["name"])
         return out
@@ -232,13 +231,13 @@ class APIServer:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             obj = self._objects[key]
             if obj.get("status") == status:
-                return copy.deepcopy(obj)
-            obj["status"] = copy.deepcopy(status)
+                return _jcopy(obj)
+            obj["status"] = _jcopy(status)
             obj["metadata"]["resourceVersion"] = self._next_rv()
             self._record("put", obj)
-            snapshot = copy.deepcopy(obj)
+            snapshot = _jcopy(obj)
         self._emit(WatchEvent("MODIFIED", snapshot))
-        return copy.deepcopy(snapshot)
+        return _jcopy(snapshot)
 
     def delete(self, kind: str, name: str, namespace: str | None = None,
                ) -> None:
@@ -255,7 +254,7 @@ class APIServer:
                     obj["metadata"]["deletionTimestamp"] = _t.time()
                     obj["metadata"]["resourceVersion"] = self._next_rv()
                     self._record("put", obj)
-                    snapshot = copy.deepcopy(obj)
+                    snapshot = _jcopy(obj)
                 else:
                     return
             else:
@@ -281,7 +280,7 @@ class APIServer:
                 if any(r.get("uid") == uid
                        for r in o["metadata"].get("ownerReferences", []))
             ]
-        self._emit(WatchEvent("DELETED", copy.deepcopy(obj)))
+        self._emit(WatchEvent("DELETED", _jcopy(obj)))
         for dkind, dns, dname in dependents:
             try:
                 self.delete(dkind, dname, dns)

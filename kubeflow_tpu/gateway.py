@@ -20,10 +20,35 @@ are live the instant a controller writes them):
    targetPort to a real host port (LocalExecutor allocates one per
    containerPort) -> proxy to ``http://<status.podIP>:<hostPort>``.
 
+Authorization: the reference never proxies a data-path byte without the
+mesh checking identity — profile-controller writes the
+``ns-owner-access-istio`` AuthorizationPolicy gating every in-namespace
+service (profile_controller.go:340-422) and each KFAM contributor binding
+adds a policy keyed on the identity header (kfam/bindings.go:79-94).  This
+gateway enforces those same objects before proxying: the DESTINATION
+workload's namespace (from the route's ``destination.host`` — where Istio's
+sidecar would enforce) is the policy scope; if any ALLOW policy exists
+there, the caller's identity header must satisfy one (403 otherwise); a
+namespace with no policies is default-allow (Istio semantics — only
+Profile-managed namespaces carry policies).  Scoping by the VirtualService's
+own namespace instead would let a tenant route a VS in THEIR namespace at
+another tenant's Service and walk past the victim's policies.
+
+Trust model note: the verified identity header IS forwarded to the backing
+pod — reference parity (the notebook VS sets the userid header so Jupyter
+knows its user, notebook_controller.go:50-51; Istio forwards it to every
+destination sidecar).  A pod can therefore observe the identity of users
+who visit it.  In the single-binary deployment every local process can
+already mint that header toward the platform port, so the boundary that
+matters is the front door (IAP/--dev-identity strips inbound identity);
+pod-to-control-plane mTLS is the real-cluster deployment's job, as it is
+in the reference.
+
 Bodies stream both directions in chunks (long-poll/SSE work; WebSocket
-upgrade is NOT supported — WSGI offers no socket hijack; Jupyter falls back
-to long-polling).  A matched route with no live backend is 503, a refused
-connection 502 — only an unmatched path falls through to the caller.
+upgrade happens one layer down — core.httpapi's raw-socket handler hands
+upgrade requests to ``Gateway.websocket_backend``).  A matched route with
+no live backend is 503, a refused connection 502 — only an unmatched path
+falls through to the caller.
 """
 
 from __future__ import annotations
@@ -39,8 +64,15 @@ from kubeflow_tpu.utils.metrics import REGISTRY
 PROXIED = REGISTRY.counter("gateway_requests_total",
                            "requests proxied through the gateway",
                            labels=("code",))
+DENIED = REGISTRY.counter("gateway_denied_total",
+                          "requests denied by AuthorizationPolicy")
 
 log = get_logger("gateway")
+
+# the mesh identity header, wire-format (profile.py/kfam write policies
+# keyed on exactly this name)
+IDENTITY_HEADER = "x-goog-authenticated-user-email"
+WSGI_IDENTITY = "HTTP_X_GOOG_AUTHENTICATED_USER_EMAIL"
 
 # RFC 2616 §13.5.1 + connection-specific headers a proxy must not forward
 HOP_BY_HOP = {"connection", "keep-alive", "proxy-authenticate",
@@ -60,6 +92,16 @@ class Route:
     dest_port: int
     set_headers: dict = field(default_factory=dict)
     timeout_s: float = 300.0
+    namespace: str | None = None   # the VirtualService's own namespace
+
+    @property
+    def dest_namespace(self) -> str | None:
+        """The DESTINATION workload's namespace — the AuthorizationPolicy
+        scope.  Istio enforces policies at the destination sidecar, so a
+        VS in an attacker's namespace routing into a victim's namespace
+        must face the victim's policies, not the attacker's."""
+        parts = self.dest_host.split(".")
+        return parts[1] if len(parts) >= 2 else self.namespace
 
     def rewritten(self, path: str) -> str:
         return self.rewrite + path[len(self.prefix):]
@@ -74,15 +116,29 @@ class Backend:
     timeout_s: float
 
 
+def _prefix_owned(prefix: str, vs_namespace: str | None) -> bool:
+    """Path-ownership constraint: a VirtualService may only claim prefixes
+    whose SECOND segment is its own namespace (``/<class>/<ns>/...`` — the
+    shape every controller-written route has).  Without this, any
+    namespace admin could claim ``/notebook/team/nbsec/lab/`` (longer
+    prefix wins) or ``/apis/`` and capture other tenants' traffic and
+    credentials into their own pod."""
+    parts = [p for p in prefix.split("/") if p]
+    return len(parts) >= 2 and parts[1] == (vs_namespace or "default")
+
+
 def match_route(server: APIServer, path: str) -> Route | None:
-    """Longest-prefix match over every VirtualService's http routes."""
+    """Longest-prefix match over every VirtualService's http routes.
+    Only namespace-owned prefixes participate (``_prefix_owned``)."""
     best: Route | None = None
     for vs in server.list("VirtualService"):
+        vs_ns = vs["metadata"].get("namespace")
         for http_route in vs.get("spec", {}).get("http", []):
             prefix = None
             for m in http_route.get("match", []):
                 p = m.get("uri", {}).get("prefix")
-                if p and path.startswith(p):
+                if (p and path.startswith(p)
+                        and _prefix_owned(p, vs_ns)):
                     prefix = p
                     break
             if prefix is None:
@@ -106,16 +162,74 @@ def match_route(server: APIServer, path: str) -> Route | None:
                 set_headers=dict(http_route.get("headers", {})
                                  .get("request", {}).get("set", {})),
                 timeout_s=timeout_s,
+                namespace=vs["metadata"].get("namespace"),
             )
     return best
 
 
+def authorize_ingress(server: APIServer, namespace: str | None,
+                      header_value: str | None) -> tuple[bool, str]:
+    """Evaluate the namespace's AuthorizationPolicy objects for an ingress
+    request carrying ``header_value`` as its identity header.
+
+    Istio semantics: no ALLOW policies in the namespace -> allow; any
+    present -> the request must satisfy at least one rule.  ``when`` rules
+    match on the identity header; ``from.source.namespaces`` rules describe
+    mesh-internal peers and never match ingress traffic; an empty rule
+    matches everything (an explicit allow-all policy)."""
+    if namespace is None:
+        return True, "cluster-scoped route"
+    all_policies = server.list("AuthorizationPolicy", namespace=namespace)
+
+    def rule_matches(rule: dict) -> bool:
+        if rule.get("from"):
+            # Istio ANDs a rule's clauses: any from/source clause means
+            # mesh-internal peers only, which ingress never satisfies —
+            # regardless of whether a when-clause would also match
+            return False
+        whens = rule.get("when", [])
+        if not whens:
+            return True  # match-all rule
+        header_key = f"request.headers[{IDENTITY_HEADER}]"
+        return all(w.get("key") == header_key
+                   and header_value is not None
+                   and header_value in w.get("values", [])
+                   for w in whens)
+
+    # Istio evaluates DENY before ALLOW: a matching DENY rejects
+    # regardless of what any ALLOW policy says
+    for pol in all_policies:
+        if pol.get("spec", {}).get("action") != "DENY":
+            continue
+        if any(rule_matches(r) for r in pol.get("spec", {}).get("rules",
+                                                                [])):
+            return False, (f"denied by AuthorizationPolicy "
+                           f"{pol['metadata']['name']}")
+    allows = [p for p in all_policies
+              if p.get("spec", {}).get("action", "ALLOW") == "ALLOW"]
+    if not allows:
+        return True, "no ALLOW policy (default allow)"
+    for pol in allows:
+        if any(rule_matches(r) for r in pol.get("spec", {}).get("rules",
+                                                                [])):
+            return True, pol["metadata"]["name"]
+    return False, (f"no AuthorizationPolicy in namespace {namespace!r} "
+                   f"admits this identity")
+
+
 def resolve_backend(server: APIServer, path: str) -> Backend | None:
     """Full resolution path -> Backend; None if no route matches,
-    NoBackend if a route matches but nothing serves it."""
+    NoBackend if a route matches but nothing serves it.  NO authorization —
+    in-process callers only (the culler's probe); user traffic goes through
+    ``Gateway.__call__`` which authorizes first."""
     route = match_route(server, path)
     if route is None:
         return None
+    return backend_for_route(server, route, path)
+
+
+def backend_for_route(server: APIServer, route: Route,
+                      path: str) -> Backend:
     parts = route.dest_host.split(".")
     if len(parts) < 2:
         raise NoBackend(f"unresolvable destination {route.dest_host!r}")
@@ -194,20 +308,139 @@ class Gateway:
     def matches(self, path: str) -> bool:
         return match_route(self.server, path) is not None
 
+    # -- WebSocket upgrade (raw socket; httpapi.serve's upgrade hook) --------
+    def websocket_upgrade(self, handler) -> bool:
+        """Handle an ``Upgrade: websocket`` request on the raw socket.
+
+        Jupyter kernel channels are WebSocket-only in current JupyterLab,
+        and the reference's Envoy data path upgrades them transparently
+        (SURVEY §1 traffic path); WSGI can't, so httpapi.serve hands the
+        parsed request + live socket here before the WSGI app runs.
+        Returns False when no VirtualService claims the path (the caller
+        falls through to WSGI); otherwise authorizes exactly like
+        ``__call__``, performs the HTTP/1.1 upgrade handshake against the
+        backing pod, and pumps bytes both ways until either side closes —
+        the WS framing stays end-to-end."""
+        path, _, query = handler.path.partition("?")
+        route = match_route(self.server, path)
+        if route is None:
+            return False
+        ok, why = authorize_ingress(self.server, route.dest_namespace,
+                                    handler.headers.get(IDENTITY_HEADER))
+        if not ok:
+            DENIED.inc()
+            PROXIED.labels("403").inc()
+            handler.send_error(403, explain=why)
+            return True
+        try:
+            backend = backend_for_route(self.server, route, path)
+        except NoBackend as e:
+            PROXIED.labels("503").inc()
+            handler.send_error(503, explain=str(e))
+            return True
+        self._tunnel(handler, backend, query)
+        return True
+
+    def _tunnel(self, handler, backend: Backend, query: str) -> None:
+        import socket as socketlib
+
+        target = backend.path + ("?" + query if query else "")
+        sock = None
+        # same bind-race absorption as the HTTP path: a pod reports
+        # Running slightly before its process binds the port, and nothing
+        # has been consumed from the client yet, so retries are safe
+        for attempt in range(self.connect_retries):
+            try:
+                sock = socketlib.create_connection(
+                    (backend.host, backend.port), timeout=10)
+                break
+            except OSError:
+                if attempt + 1 == self.connect_retries:
+                    PROXIED.labels("502").inc()
+                    handler.send_error(502,
+                                       explain="backend connection failed")
+                    return
+                time.sleep(self.retry_delay)
+        # replay the upgrade request verbatim (hop-by-hop headers INCLUDED:
+        # Connection/Upgrade are the handshake) plus the route's header set
+        lines = [f"{handler.command} {target} HTTP/1.1",
+                 f"Host: {backend.host}:{backend.port}"]
+        for name, value in handler.headers.items():
+            if name.lower() == "host":
+                continue
+            lines.append(f"{name}: {value}")
+        for name, value in backend.set_headers.items():
+            lines.append(f"{name}: {value}")
+        client = handler.connection
+        # kernel channels idle for long stretches: no read deadline; the
+        # pump ends on EOF/reset from either side
+        sock.settimeout(None)
+        client.settimeout(None)
+        try:
+            sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+        except OSError:
+            sock.close()
+            PROXIED.labels("502").inc()
+            handler.send_error(502, explain="backend reset during upgrade")
+            return
+        # counted once the handshake is in flight; the backend's actual
+        # status (which the pump relays verbatim) is not parsed here, so a
+        # backend-refused upgrade still counts under "101" — an accepted
+        # approximation for a blind byte tunnel
+        PROXIED.labels("101").inc()
+
+        def pump(read, peer):
+            try:
+                while True:
+                    data = read(65536)
+                    if not data:
+                        break
+                    peer.sendall(data)
+            except (OSError, ValueError):
+                pass
+            finally:
+                # wake the opposite pump's blocking read
+                for s in (sock, client):
+                    try:
+                        s.shutdown(socketlib.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        import threading
+
+        # client->backend reads via rfile (it may hold bytes buffered past
+        # the request headers); backend->client writes the raw socket
+        t_up = threading.Thread(target=pump,
+                                args=(handler.rfile.read1, sock),
+                                daemon=True)
+        t_up.start()
+        pump(sock.recv, client)
+        t_up.join(timeout=5.0)
+        sock.close()
+
     def __call__(self, environ, start_response):
         path = environ.get("PATH_INFO", "/")
+        route = match_route(self.server, path)
+        if route is None:  # caller should have checked matches()
+            PROXIED.labels("404").inc()
+            start_response("404 Not Found",
+                           [("Content-Type", "text/plain")])
+            return [b"no route\n"]
+        ok, why = authorize_ingress(self.server, route.dest_namespace,
+                                    environ.get(WSGI_IDENTITY))
+        if not ok:
+            DENIED.inc()
+            PROXIED.labels("403").inc()
+            start_response("403 Forbidden",
+                           [("Content-Type", "text/plain")])
+            return [f"{why}\n".encode()]
         try:
-            backend = resolve_backend(self.server, path)
+            backend = backend_for_route(self.server, route, path)
         except NoBackend as e:
             PROXIED.labels("503").inc()
             start_response("503 Service Unavailable",
                            [("Content-Type", "text/plain")])
             return [f"no backend: {e}\n".encode()]
-        if backend is None:  # caller should have checked matches()
-            PROXIED.labels("404").inc()
-            start_response("404 Not Found",
-                           [("Content-Type", "text/plain")])
-            return [b"no route\n"]
         return self._proxy(backend, environ, start_response)
 
     def _proxy(self, backend: Backend, environ, start_response):

@@ -105,18 +105,31 @@ def _register_optional(server, mgr, enable: set[str] | None) -> None:
 
 
 def dev_identity_middleware(app, email: str):
-    """Plays the mesh/IAP for local development: injects the trusted
-    identity header (crud_backend.USERID_HEADER) into every request that
-    does not already carry one — the platform's auth layers then behave
+    """Plays the mesh/IAP for local development: OVERWRITES the identity
+    header (crud_backend.USERID_HEADER) on every request — like IAP, any
+    inbound value is stripped first, so a client cannot impersonate another
+    user by sending its own header.  The platform's auth layers then behave
     exactly as they would behind Istio, CSRF included."""
     # constants from the non-optional core module: --dev-identity must work
     # even on a distribution without the webapps package
     from kubeflow_tpu.core.httpapi import USERID_HEADER, USERID_PREFIX
 
     def wrapped(environ, start_response):
-        environ.setdefault(USERID_HEADER, USERID_PREFIX + email)
+        environ[USERID_HEADER] = USERID_PREFIX + email
         return app(environ, start_response)
 
+    # the WebSocket upgrade path bypasses WSGI (raw handler): inject the
+    # identity there too, with the same strip-first semantics
+    inner_upgrade = getattr(app, "websocket_upgrade", None)
+    if inner_upgrade is not None:
+        from kubeflow_tpu.gateway import IDENTITY_HEADER
+
+        def wrapped_upgrade(handler):
+            del handler.headers[IDENTITY_HEADER]
+            handler.headers[IDENTITY_HEADER] = USERID_PREFIX + email
+            return inner_upgrade(handler)
+
+        wrapped.websocket_upgrade = wrapped_upgrade
     return wrapped
 
 
@@ -162,6 +175,16 @@ def build_wsgi_app(server, *, secure_api: bool = True,
     except ImportError:
         pass
 
+    # paths the platform itself owns: NEVER routable by a tenant
+    # VirtualService, on either the HTTP or the WebSocket-upgrade path
+    # (a profile named "apis"/"kfam" must not capture control-plane
+    # traffic; match_route's namespace-ownership rule handles the rest)
+    reserved = tuple(mounts) + ("/apis", "/healthz", "/readyz", "/metrics")
+
+    def _reserved(path: str) -> bool:
+        return any(path == p or path.startswith(p + "/")
+                   for p in reserved)
+
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
         for prefix, handler in mounts.items():
@@ -169,10 +192,20 @@ def build_wsgi_app(server, *, secure_api: bool = True,
                 return handler(environ, start_response)
         # ingress: paths claimed by a VirtualService route proxy to the
         # backing pod (the Istio-gateway role, SURVEY §1 traffic path)
-        if gateway.matches(path):
+        if not _reserved(path) and gateway.matches(path):
             return gateway(environ, start_response)
         return rest(environ, start_response)
 
+    # WebSocket upgrades can't ride WSGI — httpapi.serve hands them here
+    # (Jupyter kernel channels; the Envoy-upgrade role).  Reserved paths
+    # decline the upgrade so mounted apps/REST keep precedence even for
+    # requests flagged Upgrade: websocket.
+    def websocket_upgrade(handler):
+        if _reserved(handler.path.partition("?")[0]):
+            return False
+        return gateway.websocket_upgrade(handler)
+
+    app.websocket_upgrade = websocket_upgrade
     return app
 
 

@@ -74,7 +74,7 @@ class ContinuousBatcher:
     """Shares one device cache of ``max_batch`` slots across requests."""
 
     def __init__(self, module, params, cfg, *, max_batch: int = 4,
-                 max_seq: int = 512):
+                 max_seq: int = 512, mesh=None):
         from kubeflow_tpu.models import llama as llama_mod
 
         self.module = module
@@ -82,6 +82,9 @@ class ContinuousBatcher:
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_seq = min(max_seq, cfg.max_seq_len)
+        self.mesh = mesh  # tp>1: params arrive pre-sharded (serving/
+        # sharded.py); the KV cache shards heads over tp here and XLA
+        # propagates both through prefill/insert/decode
         self.log = get_logger("serving.batcher")
 
         # engine cache holds ONLY k/v buffers (all distinct, donate-safe);
@@ -91,6 +94,11 @@ class ContinuousBatcher:
         full = llama_mod.init_cache(cfg, max_batch, max_len=self.max_seq,
                                     per_sequence=True)
         self.cache = _kv_only(full)
+        if mesh is not None:
+            from kubeflow_tpu.serving import sharded
+
+            self.cache = sharded.shard_cache(self.cache, mesh,
+                                             cfg.num_kv_heads)
         self.index = jnp.zeros((max_batch,), jnp.int32)
         self.last_token = jnp.zeros((max_batch,), jnp.int32)
         self.temps = jnp.zeros((max_batch,), jnp.float32)

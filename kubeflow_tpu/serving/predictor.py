@@ -35,7 +35,8 @@ class GenerativePredictor:
                  model_config: dict | None = None,
                  checkpoint_dir: str | None = None,
                  max_batch: int = 4, max_seq: int = 512, seed: int = 0,
-                 quantize: bool = False, fast_init: bool = False):
+                 quantize: bool = False, fast_init: bool = False,
+                 tp: int = 1):
         from kubeflow_tpu.models import registry
 
         self.log = get_logger("predictor", model=model_name, size=size)
@@ -61,6 +62,16 @@ class GenerativePredictor:
                 lambda s: jnp.zeros(s.shape, s.dtype),
                 unbox_params(shapes))
 
+        # tp>1: Megatron tensor parallelism over a pure-tp mesh — each
+        # chip holds 1/tp of every matmul weight and of the KV cache heads
+        # (serving/sharded.py); tp=1 keeps the single-chip path untouched
+        self.mesh = None
+        specs = None
+        if tp > 1:
+            from kubeflow_tpu.serving import sharded
+
+            self.mesh = sharded.serving_mesh(tp)
+            specs = sharded.param_specs(self.module, rng, example)
         if quantize:
             # weight-only int8 (serving/quant.py): init + restore +
             # quantize happen ON THE HOST so the accelerator never holds
@@ -79,7 +90,10 @@ class GenerativePredictor:
                     self._restore(checkpoint_dir)
                 before = quantized_bytes(self.params)
                 self.params = quantize_params(self.params)
-            self.params = jax.device_put(self.params, jax.devices()[0])
+            if self.mesh is None:
+                # host-quantized tree must move to the accelerator; the
+                # tp>1 placement below handles the sharded case
+                self.params = jax.device_put(self.params, jax.devices()[0])
             self.log.info("quantized weights int8",
                           bytes_before=before,
                           bytes_after=quantized_bytes(self.params))
@@ -87,11 +101,17 @@ class GenerativePredictor:
             self.params = init_params()
             if checkpoint_dir:
                 self._restore(checkpoint_dir)
+        if self.mesh is not None:
+            from kubeflow_tpu.serving import sharded
+
+            self.params = sharded.shard_params(self.params, specs,
+                                               self.mesh)
         from kubeflow_tpu.serving.engine import ContinuousBatcher
 
         self.engine = ContinuousBatcher(self.module, self.params, self.cfg,
                                         max_batch=max_batch,
-                                        max_seq=self.max_seq)
+                                        max_seq=self.max_seq,
+                                        mesh=self.mesh)
         self.log.info("predictor ready",
                       params=sum(x.size for x in
                                  jax.tree_util.tree_leaves(self.params)))
@@ -269,7 +289,8 @@ def main(argv=None) -> int:
                 max_batch=int(opts.get("max_batch", args.max_batch)),
                 max_seq=int(opts.get("max_seq", args.max_seq)),
                 quantize=opts.get("quantize", "").lower()
-                in ("1", "true", "int8"))
+                in ("1", "true", "int8"),
+                tp=int(opts.get("tp", 1)))
         else:
             predictors[name] = ClassifierPredictor(name,
                                                    checkpoint_dir=ckpt)

@@ -1,0 +1,96 @@
+"""Tensor-parallel sharding for the serving path (tp>1 predictors).
+
+The north star is "JAX inference on slices" (SURVEY §2.12 KServe
+equivalent): one 16 GB v5e chip caps the servable model at ~7B int8, so
+anything bigger must shard weights AND KV cache over a device mesh.  The
+TPU-native recipe (scaling-book inference chapter): Megatron-style tensor
+parallelism over the attention-head / FFN-hidden / vocab dims — each chip
+holds 1/tp of every matmul weight and 1/tp of the KV cache heads, and XLA
+inserts the (two per layer) all-reduces from the weight shardings alone.
+
+This module adapts the training-side logical-axis rules
+(parallel/sharding.py) to serving:
+
+- serving meshes carry only the ``tp`` axis (batch is the engine's slot
+  dimension, never sharded; no fsdp — weights are read-only so ZeRO-3
+  gather-per-use would add latency for no memory win beyond what tp gives);
+- quantized weights (serving/quant.py QTensor) shard like their parent
+  kernel: the int8 payload takes the kernel's spec, the per-channel scale
+  takes the same spec with its broadcast (size-1) axes unsharded.
+
+Works with any registry model that tags weights with logical axis names
+(flax ``nn.with_partitioning``), exactly like the training path.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+from kubeflow_tpu.serving.quant import QTensor
+
+# batch/seq/embed stay local in a serving mesh: only head/mlp/vocab dims
+# split over tp (Megatron layout)
+SERVING_RULES = DEFAULT_RULES.replace(batch=None, seq=None, embed=None)
+
+# KV cache rows are [batch_slots, seq, kv_heads, head_dim]: heads over tp
+CACHE_SPEC = P(None, None, "tp", None)
+
+
+def serving_mesh(tp: int, devices=None) -> Mesh:
+    """A pure-tp mesh over the first ``tp`` local devices (one slice)."""
+    from kubeflow_tpu.parallel import make_mesh
+
+    devices = devices if devices is not None else jax.devices()[:tp]
+    if len(devices) < tp:
+        raise ValueError(f"tp={tp} needs {tp} devices, have {len(devices)}")
+    return make_mesh(tp, dp=1, fsdp=1, tp=tp, sp=1, devices=devices)
+
+
+def param_specs(module, rng, example):
+    """PartitionSpec tree for a module's params under SERVING_RULES,
+    derived from the flax partitioning metadata via eval_shape (no
+    memory is allocated)."""
+    from kubeflow_tpu.parallel.sharding import shard_params_specs
+
+    boxed = jax.eval_shape(lambda r: module.init(r, example)["params"], rng)
+    return shard_params_specs(boxed, SERVING_RULES)
+
+
+def _scale_spec(spec: P, scale_shape: tuple) -> P:
+    """A QTensor scale broadcasts over the quantization axis (size 1):
+    that axis must stay unsharded whatever the kernel spec says."""
+    return P(*(None if scale_shape[i] == 1 else ax
+               for i, ax in enumerate(spec)))
+
+
+def shard_params(params, specs, mesh: Mesh):
+    """device_put a (possibly quantized) params tree onto ``mesh`` per the
+    spec tree.  QTensor nodes shard q by the kernel's spec and scale by
+    the broadcast-aware variant."""
+    def place(spec, leaf):
+        if isinstance(leaf, QTensor):
+            return QTensor(
+                jax.device_put(leaf.q, NamedSharding(mesh, spec)),
+                jax.device_put(leaf.scale, NamedSharding(
+                    mesh, _scale_spec(spec, leaf.scale.shape))))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    # specs lead the map (their P leaves align with params' QTensor
+    # subtrees via flatten_up_to); P is a tuple, so mark it as a leaf
+    return jax.tree_util.tree_map(
+        place, specs, params,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_cache(cache, mesh: Mesh, num_kv_heads: int):
+    """Place the engine's KV cache with heads over tp (each chip holds the
+    cache for exactly its own heads — the memory win that makes long
+    contexts fit)."""
+    tp = mesh.shape["tp"]
+    if num_kv_heads % tp != 0:
+        raise ValueError(
+            f"num_kv_heads={num_kv_heads} not divisible by tp={tp}")
+    sh = NamedSharding(mesh, CACHE_SPEC)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), cache)

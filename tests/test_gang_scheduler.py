@@ -283,3 +283,37 @@ def test_max_run_seconds_deadline_enforced(harness):
     server.create(api.new("next", "ml", topology="v5e-8"))
     wait_for(lambda: job_phase(server, "next") == "Running" or None,
              timeout=10)
+
+
+def test_recreated_job_does_not_inherit_fifo_position(harness):
+    """advisor r3: a JAXJob deleted and recreated under the same name is a
+    NEW gang — it must queue behind gangs created in between, not jump the
+    FIFO via a stale (ns, name)-keyed creationTimestamp cache."""
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 1}))
+
+    server.create(api.new("running", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "running") == "Running" or None)
+
+    # "first" queues and gets its creationTimestamp cached by the FIFO
+    server.create(api.new("first", "ml", topology="v5e-8"))
+    wait_for(lambda: get_condition(server.get(api.KIND, "first", "ml"),
+                                   "WaitingForSlices") or None)
+    # delete it, then park a middle gang, then recreate "first"
+    server.delete(api.KIND, "first", "ml")
+    wait_for(lambda: not gang_pods(server, "first") or None)
+    server.create(api.new("middle", "ml", topology="v5e-8"))
+    wait_for(lambda: get_condition(server.get(api.KIND, "middle", "ml"),
+                                   "WaitingForSlices") or None)
+    server.create(api.new("first", "ml", topology="v5e-8"))
+    wait_for(lambda: get_condition(server.get(api.KIND, "first", "ml"),
+                                   "WaitingForSlices") or None)
+
+    # slice frees: the MIDDLE gang (older than first's recreation) runs
+    finish_gang(server, "running")
+    wait_for(lambda: job_phase(server, "middle") == "Running" or None)
+    assert job_phase(server, "first") == "Pending"
+    assert all(p["spec"].get("schedulingGates")
+               for p in gang_pods(server, "first"))
+    finish_gang(server, "middle")
+    wait_for(lambda: job_phase(server, "first") == "Running" or None)

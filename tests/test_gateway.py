@@ -233,20 +233,406 @@ def test_matched_route_without_backend_is_503(platform):
                    "apiVersion": "networking.istio.io/v1alpha3",
                    "metadata": {"name": "ghost", "namespace": "default"},
                    "spec": {"http": [{
-                       "match": [{"uri": {"prefix": "/ghost/"}}],
+                       "match": [{"uri": {"prefix": "/ghost/default/g/"}}],
                        "route": [{"destination": {
                            "host": "ghost.default.svc",
                            "port": {"number": 80}}}]}]}})
     with pytest.raises(urllib.error.HTTPError) as exc:
-        _get(base + "/ghost/page")
+        _get(base + "/ghost/default/g/page")
     assert exc.value.code == 503
+
+
+IDENTITY = "X-Goog-Authenticated-User-Email"
+
+# a stand-in for Jupyter's kernel-channel endpoint: accepts a WebSocket
+# handshake and echoes each (masked) client frame back prefixed with the
+# request path — proving both the upgrade AND the identity rewrite
+WS_SERVER_SCRIPT = """
+import base64, hashlib, os, socket
+
+GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+def recv_exact(c, n):
+    buf = b""
+    while len(buf) < n:
+        d = c.recv(n - len(buf))
+        if not d:
+            raise ConnectionError
+        buf += d
+    return buf
+
+srv = socket.socket()
+srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+srv.bind(("127.0.0.1", int(os.environ["KF_POD_PORT"])))
+srv.listen(5)
+while True:
+    conn, _ = srv.accept()
+    try:
+        raw = b""
+        while b"\\r\\n\\r\\n" not in raw:
+            d = conn.recv(4096)
+            if not d:
+                raise ConnectionError
+            raw += d
+        head = raw.split(b"\\r\\n\\r\\n", 1)[0].decode()
+        path = head.split(" ", 2)[1]
+        key = ""
+        for line in head.split("\\r\\n")[1:]:
+            k, _, v = line.partition(":")
+            if k.strip().lower() == "sec-websocket-key":
+                key = v.strip()
+        accept = base64.b64encode(
+            hashlib.sha1((key + GUID).encode()).digest()).decode()
+        conn.sendall(("HTTP/1.1 101 Switching Protocols\\r\\n"
+                      "Upgrade: websocket\\r\\nConnection: Upgrade\\r\\n"
+                      "Sec-WebSocket-Accept: " + accept
+                      + "\\r\\n\\r\\n").encode())
+        while True:
+            b1, b2 = recv_exact(conn, 2)
+            ln = b2 & 0x7F
+            mask = recv_exact(conn, 4)
+            payload = bytearray(recv_exact(conn, ln))
+            for i in range(ln):
+                payload[i] ^= mask[i % 4]
+            out = path.encode() + b"|" + bytes(payload)
+            conn.sendall(bytes([0x81, len(out)]) + out)
+    except Exception:
+        pass
+    finally:
+        conn.close()
+"""
+
+
+def _ws_roundtrip(host, port, path, payload, user=None, timeout=10):
+    """Minimal RFC6455 client: handshake, one masked text frame, read the
+    echo.  Returns (status, echoed_text_or_None)."""
+    import base64
+    import os
+    import socket
+
+    key = base64.b64encode(os.urandom(16)).decode()
+    headers = [f"GET {path} HTTP/1.1", f"Host: {host}:{port}",
+               "Upgrade: websocket", "Connection: Upgrade",
+               f"Sec-WebSocket-Key: {key}", "Sec-WebSocket-Version: 13"]
+    if user is not None:
+        headers.append(f"{IDENTITY}: accounts.google.com:{user}")
+    s = socket.create_connection((host, port), timeout=timeout)
+    try:
+        s.sendall(("\r\n".join(headers) + "\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            d = s.recv(4096)
+            if not d:
+                break
+            resp += d
+        status = int(resp.split(b" ", 2)[1])
+        if status != 101:
+            return status, None
+        buf = resp.split(b"\r\n\r\n", 1)[1]
+        mask = os.urandom(4)
+        data = payload.encode()
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        s.sendall(bytes([0x81, 0x80 | len(data)]) + mask + masked)
+        while len(buf) < 2 or len(buf) < 2 + (buf[1] & 0x7F):
+            d = s.recv(4096)
+            if not d:
+                break
+            buf += d
+        ln = buf[1] & 0x7F
+        return 101, buf[2:2 + ln].decode()
+    finally:
+        s.close()
+
+
+def test_websocket_upgrade_through_gateway(platform):
+    """VERDICT r3 #3: Jupyter kernel channels are WebSocket-only — the
+    front door must upgrade and tunnel them.  A WS echo pod behind
+    /notebook/<ns>/<name>/ answers a real RFC6455 handshake + frame
+    round-trip through the gateway, path identity-rewritten."""
+    server, mgr, base = platform
+    server.create({
+        "kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+        "metadata": {"name": "nbws", "namespace": "default"},
+        "spec": {"template": {"spec": {"containers": [{
+            "name": "nbws", "image": "i",
+            "command": ["python", "-c", WS_SERVER_SCRIPT],
+        }]}}},
+    })
+    wait(lambda: _running_with_port(server, "nbws-0", "default"),
+         timeout=30)
+    host, port = base.replace("http://", "").split(":")
+
+    def rt():
+        try:
+            return _ws_roundtrip(host, int(port),
+                                 "/notebook/default/nbws/api/kernels/ws",
+                                 "execute_request")
+        except OSError:
+            return None
+
+    status, echo = wait(rt, timeout=30)
+    assert status == 101
+    # frame round-tripped AND the pod saw the full prefixed path
+    assert echo == "/notebook/default/nbws/api/kernels/ws|execute_request"
+
+
+def test_websocket_upgrade_enforces_authorization(platform):
+    """The WS path enforces the same AuthorizationPolicy gate as HTTP:
+    anonymous/stranger handshakes are refused before reaching the pod."""
+    from kubeflow_tpu.api import profile as profile_api
+
+    server, mgr, base = platform
+    server.create(profile_api.new("wsteam", "alice@corp.com"))
+    wait(lambda: _exists(server, "AuthorizationPolicy",
+                         "ns-owner-access-istio", "wsteam"), timeout=10)
+    server.create({
+        "kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+        "metadata": {"name": "nbws2", "namespace": "wsteam"},
+        "spec": {"template": {"spec": {"containers": [{
+            "name": "nbws2", "image": "i",
+            "command": ["python", "-c", WS_SERVER_SCRIPT],
+        }]}}},
+    })
+    wait(lambda: _running_with_port(server, "nbws2-0", "wsteam"),
+         timeout=30)
+    host, port = base.replace("http://", "").split(":")
+    path = "/notebook/wsteam/nbws2/ws"
+    status, _ = _ws_roundtrip(host, int(port), path, "x")
+    assert status == 403
+    status, _ = _ws_roundtrip(host, int(port), path, "x",
+                              user="mallory@evil.com")
+    assert status == 403
+    status, echo = _ws_roundtrip(host, int(port), path, "hello",
+                                 user="alice@corp.com")
+    assert status == 101 and echo.endswith("|hello")
+
+
+def _get_as(url, user, method="GET", body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    headers = {}
+    if user is not None:
+        headers[IDENTITY] = "accounts.google.com:" + user
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers=headers)
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read() or b"null")
+
+
+def test_gateway_enforces_authorization_policies(platform):
+    """The round-3 hole (VERDICT r3 missing #1): the data path must enforce
+    the AuthorizationPolicy objects profile/KFAM write.  Owner passes,
+    anonymous and non-owner 403, a KFAM contributor binding admits the
+    contributor, and removing it locks them out again."""
+    from kubeflow_tpu.api import profile as profile_api
+
+    server, mgr, base = platform
+    server.create(profile_api.new("team", "alice@corp.com"))
+    wait(lambda: _exists(server, "AuthorizationPolicy",
+                         "ns-owner-access-istio", "team"), timeout=10)
+    _make_notebook(server, name="nbsec", ns="team")
+    wait(lambda: _running_with_port(server, "nbsec-0", "team"), timeout=30)
+    url = base + "/notebook/team/nbsec/lab"
+
+    # anonymous and non-owner: 403 before a byte reaches the pod
+    for user in (None, "mallory@evil.com"):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get_as(url, user)
+        assert exc.value.code == 403, f"user={user}"
+
+    code, body = _get_as(url, "alice@corp.com")
+    assert code == 200
+    assert body["echo"] == "/notebook/team/nbsec/lab"
+
+    # contributor add through KFAM (as the owner) admits bob on the data
+    # path — the kfam/bindings.go:79-94 contract
+    code, _ = _get_as(base + "/kfam/v1/bindings", "alice@corp.com", "POST",
+                      {"referredNamespace": "team",
+                       "user": {"kind": "User", "name": "bob@corp.com"},
+                       "roleRef": {"kind": "ClusterRole",
+                                   "name": "kubeflow-edit"}})
+    assert code == 201
+    code, _ = _get_as(url, "bob@corp.com")
+    assert code == 200
+
+    # binding removal revokes data-path access
+    code, _ = _get_as(base + "/kfam/v1/bindings", "alice@corp.com",
+                      "DELETE",
+                      {"referredNamespace": "team",
+                       "user": {"kind": "User", "name": "bob@corp.com"},
+                       "roleRef": {"kind": "ClusterRole",
+                                   "name": "kubeflow-edit"}})
+    assert code == 200
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get_as(url, "bob@corp.com")
+    assert exc.value.code == 403
+
+
+def test_authorize_ingress_semantics():
+    """Istio policy-evaluation corners: default allow with no policies,
+    from/source rules never admit ingress, empty rule = allow-all."""
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    hdr = "accounts.google.com:alice@corp.com"
+    ok, why = gw.authorize_ingress(server, "ns1", hdr)
+    assert ok and "default allow" in why
+
+    # a policy with ONLY a mesh-internal from-rule must not admit ingress
+    server.create({"kind": "AuthorizationPolicy", "apiVersion": "x",
+                   "metadata": {"name": "mesh-only", "namespace": "ns1"},
+                   "spec": {"action": "ALLOW", "rules": [
+                       {"from": [{"source": {"namespaces": ["ns1"]}}]}]}})
+    ok, _ = gw.authorize_ingress(server, "ns1", hdr)
+    assert not ok
+
+    # the owner when-rule admits exactly the owner
+    server.create({"kind": "AuthorizationPolicy", "apiVersion": "x",
+                   "metadata": {"name": "owner", "namespace": "ns1"},
+                   "spec": {"action": "ALLOW", "rules": [
+                       {"when": [{"key": "request.headers"
+                                         "[x-goog-authenticated-user-email]",
+                                  "values": [hdr]}]}]}})
+    assert gw.authorize_ingress(server, "ns1", hdr)[0]
+    assert not gw.authorize_ingress(
+        server, "ns1", "accounts.google.com:eve@x")[0]
+    assert not gw.authorize_ingress(server, "ns1", None)[0]
+
+    # an explicit allow-all rule (no when, no from) admits everyone
+    server.create({"kind": "AuthorizationPolicy", "apiVersion": "x",
+                   "metadata": {"name": "allow-all", "namespace": "ns2"},
+                   "spec": {"action": "ALLOW", "rules": [{}]}})
+    assert gw.authorize_ingress(server, "ns2", None)[0]
+
+
+def test_cross_namespace_vs_cannot_bypass_destination_policies():
+    """A tenant routing a VirtualService in THEIR namespace at another
+    tenant's Service must face the DESTINATION namespace's policies (Istio
+    enforces at the destination sidecar), not their own."""
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    # victim namespace: owner-only policy
+    server.create({"kind": "AuthorizationPolicy", "apiVersion": "x",
+                   "metadata": {"name": "ns-owner-access-istio",
+                                "namespace": "team"},
+                   "spec": {"action": "ALLOW", "rules": [
+                       {"when": [{"key": "request.headers"
+                                         "[x-goog-authenticated-user-email]",
+                                  "values": ["accounts.google.com:"
+                                             "alice@corp.com"]}]}]}})
+    # attacker's VS in their own (policy-free) namespace, destination in
+    # the victim's
+    server.create({"kind": "VirtualService", "apiVersion": "x",
+                   "metadata": {"name": "steal", "namespace": "mal"},
+                   "spec": {"http": [{
+                       "match": [{"uri": {"prefix": "/steal/mal/x/"}}],
+                       "route": [{"destination": {
+                           "host": "nbsec.team.svc",
+                           "port": {"number": 80}}}]}]}})
+    assert gw.match_route(server, "/notebook/team/nbsec/") is None
+    route = gw.match_route(server, "/steal/mal/x/y")
+    assert route.dest_namespace == "team"
+    ok, _ = gw.authorize_ingress(server, route.dest_namespace,
+                                 "accounts.google.com:mallory@evil.com")
+    assert not ok
+    ok, _ = gw.authorize_ingress(server, route.dest_namespace,
+                                 "accounts.google.com:alice@corp.com")
+    assert ok
+
+
+def test_tenant_cannot_shadow_another_tenants_route():
+    """Longest-prefix must not be hijackable: a VS in 'mal' claiming a
+    LONGER prefix under /notebook/team/... is ignored (namespace path
+    ownership), so the victim's own route still wins."""
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    server.create({"kind": "VirtualService", "apiVersion": "x",
+                   "metadata": {"name": "legit", "namespace": "team"},
+                   "spec": {"http": [{
+                       "match": [{"uri": {"prefix":
+                                          "/notebook/team/nbsec/"}}],
+                       "route": [{"destination": {
+                           "host": "nbsec.team.svc",
+                           "port": {"number": 80}}}]}]}})
+    server.create({"kind": "VirtualService", "apiVersion": "x",
+                   "metadata": {"name": "shadow", "namespace": "mal"},
+                   "spec": {"http": [{
+                       "match": [{"uri": {"prefix":
+                                          "/notebook/team/nbsec/lab/"}}],
+                       "route": [{"destination": {
+                           "host": "evil.mal.svc",
+                           "port": {"number": 80}}}]}]}})
+    route = gw.match_route(server, "/notebook/team/nbsec/lab/tree")
+    assert route.dest_host == "nbsec.team.svc"
+    # and a bare platform-path claim ("/apis/") never matches at all
+    server.create({"kind": "VirtualService", "apiVersion": "x",
+                   "metadata": {"name": "grab", "namespace": "mal"},
+                   "spec": {"http": [{
+                       "match": [{"uri": {"prefix": "/apis/"}}],
+                       "route": [{"destination": {
+                           "host": "evil.mal.svc",
+                           "port": {"number": 80}}}]}]}})
+    assert gw.match_route(server, "/apis/Notebook") is None
+
+
+def test_reserved_platform_paths_never_route_to_pods(platform):
+    """A profile literally named 'apis' (so its VS prefixes pass the
+    ownership rule) still cannot capture control-plane traffic: the front
+    door reserves its own mount points."""
+    server, mgr, base = platform
+    server.create({"kind": "VirtualService", "apiVersion": "x",
+                   "metadata": {"name": "grab", "namespace": "apis"},
+                   "spec": {"http": [{
+                       "match": [{"uri": {"prefix": "/apis/"}}],
+                       "route": [{"destination": {
+                           "host": "evil.apis.svc",
+                           "port": {"number": 80}}}]}]}})
+    # REST still answers /apis (a captured route would 503: no such pod)
+    code, out = _get(base + "/apis/Notebook")
+    assert code == 200 and "items" in out
+
+
+def test_deny_policy_overrides_allow():
+    """Istio evaluates DENY before ALLOW; a DENY-only namespace is locked,
+    not default-allowed."""
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    hdr = "accounts.google.com:alice@corp.com"
+    server.create({"kind": "AuthorizationPolicy", "apiVersion": "x",
+                   "metadata": {"name": "lockdown", "namespace": "ns1"},
+                   "spec": {"action": "DENY", "rules": [{}]}})
+    ok, why = gw.authorize_ingress(server, "ns1", hdr)
+    assert not ok and "lockdown" in why
+    # DENY wins even when an ALLOW would admit the same identity
+    server.create({"kind": "AuthorizationPolicy", "apiVersion": "x",
+                   "metadata": {"name": "owner", "namespace": "ns1"},
+                   "spec": {"action": "ALLOW", "rules": [
+                       {"when": [{"key": "request.headers"
+                                         "[x-goog-authenticated-user-email]",
+                                  "values": [hdr]}]}]}})
+    assert not gw.authorize_ingress(server, "ns1", hdr)[0]
+    # a targeted DENY blocks only its identity
+    server.delete("AuthorizationPolicy", "lockdown", "ns1")
+    server.create({"kind": "AuthorizationPolicy", "apiVersion": "x",
+                   "metadata": {"name": "ban-eve", "namespace": "ns1"},
+                   "spec": {"action": "DENY", "rules": [
+                       {"when": [{"key": "request.headers"
+                                         "[x-goog-authenticated-user-email]",
+                                  "values": ["accounts.google.com:eve@x"]
+                                  }]}]}})
+    assert gw.authorize_ingress(server, "ns1", hdr)[0]
+    assert not gw.authorize_ingress(server, "ns1",
+                                    "accounts.google.com:eve@x")[0]
 
 
 def test_longest_prefix_wins():
     from kubeflow_tpu.core.store import APIServer
 
     server = APIServer()
-    for name, prefix in (("a", "/nb/"), ("b", "/nb/deep/")):
+    for name, prefix in (("a", "/nb/default/"),
+                         ("b", "/nb/default/deep/")):
         server.create({"kind": "VirtualService", "apiVersion": "x",
                        "metadata": {"name": name, "namespace": "default"},
                        "spec": {"http": [{
@@ -254,9 +640,9 @@ def test_longest_prefix_wins():
                            "route": [{"destination": {
                                "host": f"{name}.default.svc",
                                "port": {"number": 80}}}]}]}})
-    route = gw.match_route(server, "/nb/deep/x")
+    route = gw.match_route(server, "/nb/default/deep/x")
     assert route.dest_host == "b.default.svc"
-    route = gw.match_route(server, "/nb/shallow")
+    route = gw.match_route(server, "/nb/default/shallow")
     assert route.dest_host == "a.default.svc"
     assert gw.match_route(server, "/other") is None
 

@@ -90,3 +90,29 @@ def test_identity_header_and_authz(endpoint):
             api_object("Notebook", "nb2", "ns"), headers=hdr)
     assert e.value.code == 403
     httpd.shutdown()
+
+
+def test_watch_authorizes_every_requested_kind(endpoint):
+    """advisor r3: ?kinds=Allowed,Secret must check EVERY kind — watch
+    permission on the first must not stream the rest."""
+    server, _ = endpoint
+
+    def deny_secret(user, verb, kind, namespace):
+        if kind == "Secret":
+            raise PermissionError("no secrets for you")
+
+    api = RestAPI(server, authorize=deny_secret)
+    httpd, _ = serve(api, 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{base}/apis/watch?kinds=ConfigMap,Secret", timeout=5)
+        assert e.value.code == 403
+        # the allowed kind alone still streams
+        with urllib.request.urlopen(f"{base}/apis/watch?kinds=ConfigMap",
+                                    timeout=5) as r:
+            assert r.status == 200
+            assert r.readline().strip() == b"{}"  # first heartbeat
+    finally:
+        httpd.shutdown()

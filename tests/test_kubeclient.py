@@ -185,3 +185,108 @@ def test_split_process_kubelet():
         mgr.stop()
         store.close()
         httpd.shutdown()
+
+
+def test_watch_survives_facade_restart():
+    """VERDICT r3 #5: the pump thread must not die silently on connection
+    loss.  Kill the facade mid-watch, bring it back on the same port:
+    the watch reconnects, re-lists (sync MODIFIED for survivors, DELETED
+    for objects that vanished during the gap), and live events flow."""
+    server = APIServer()
+    httpd, _ = serve(RestAPI(server), 0)
+    port = httpd.server_address[1]
+    store = KubeStore(f"http://127.0.0.1:{port}")
+    w = store.watch(kinds=["ConfigMap"])
+    try:
+        for name in ("keep", "gone"):
+            store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                          "metadata": {"name": name, "namespace": "d"},
+                          "spec": {}})
+        assert w.next(timeout=5).type == "ADDED"
+        assert w.next(timeout=5).type == "ADDED"
+
+        # facade dies: stop accepting AND sever the established stream
+        # (a process restart kills its sockets; shutdown() alone leaves
+        # the old connection thread serving)
+        httpd.shutdown()
+        httpd.server_close()
+        w._resp.close()
+        server.delete("ConfigMap", "gone", "d")
+        httpd, _ = serve(RestAPI(server), port)  # same port, same store
+
+        events = {}
+        deadline = 15
+        import time as _t
+        t0 = _t.monotonic()
+        while _t.monotonic() - t0 < deadline:
+            ev = w.next(timeout=1.0)
+            if ev is None:
+                continue
+            events[(ev.type, ev.object["metadata"]["name"])] = ev
+            if (("MODIFIED", "keep") in events
+                    and ("DELETED", "gone") in events):
+                break
+        assert ("MODIFIED", "keep") in events, events  # re-list sync
+        assert ("DELETED", "gone") in events, events   # gap deletion
+
+        # live events flow again on the reconnected stream
+        store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                      "metadata": {"name": "after", "namespace": "d"},
+                      "spec": {}})
+        got = wait(lambda: next(
+            (e for e in iter(lambda: w.next(timeout=0.5), None)
+             if e.object["metadata"]["name"] == "after"), None), timeout=10)
+        assert got.type == "ADDED"
+    finally:
+        w.stop()
+        httpd.shutdown()
+
+
+def test_controller_reconverges_after_facade_restart():
+    """A NotebookController on a KubeStore keeps reconciling after the
+    facade bounces: a Notebook created post-restart still materializes its
+    StatefulSet (the silent-deaf-watch failure mode, fixed)."""
+    server = APIServer()
+    quota.register(server)
+    remote_mgr = Manager(server)
+    remote_mgr.add(FakeExecutor(server, complete=False))
+    remote_mgr.start()
+    httpd, _ = serve(RestAPI(server), 0)
+    port = httpd.server_address[1]
+    store = KubeStore(f"http://127.0.0.1:{port}")
+    mgr = Manager(store)
+    mgr.add(NotebookController(store))
+    workloads.register(store, mgr)
+    mgr.start()
+    try:
+        store.create({"kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+                      "metadata": {"name": "nb1", "namespace": "t"},
+                      "spec": {"template": {"spec": {"containers": [
+                          {"name": "nb1", "image": "i"}]}}}})
+        wait(lambda: _exists(store, "StatefulSet", "nb1", "t"), timeout=10)
+
+        httpd.shutdown()
+        httpd.server_close()
+        for watch in list(store._watches):  # a restart severs live sockets
+            watch._resp.close()
+        httpd, _ = serve(RestAPI(server), port)
+
+        # created AFTER the bounce: only a reconnected watch sees it
+        store.create({"kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+                      "metadata": {"name": "nb2", "namespace": "t"},
+                      "spec": {"template": {"spec": {"containers": [
+                          {"name": "nb2", "image": "i"}]}}}})
+        wait(lambda: _exists(store, "StatefulSet", "nb2", "t"), timeout=20)
+    finally:
+        mgr.stop()
+        remote_mgr.stop()
+        httpd.shutdown()
+        store.close()
+
+
+def _exists(store, kind, name, ns):
+    try:
+        store.get(kind, name, ns)
+        return True
+    except NotFound:
+        return False

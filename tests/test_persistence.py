@@ -99,6 +99,45 @@ def test_compaction_bounds_wal(tmp_path):
     assert len(snap["objects"]) == 50
 
 
+def test_midrun_compaction_bounds_wal(tmp_path):
+    """A long-lived process under pod-status churn keeps the WAL bounded:
+    crossing the record threshold re-snapshots and truncates WITHOUT a
+    restart (etcd auto-compaction; advisor r3 found attach()-only
+    compaction could fill the data PVC)."""
+    server = APIServer()
+    persistence.attach(server, str(tmp_path), compact_records=40)
+    server.create({"kind": "Pod", "apiVersion": "v1",
+                   "metadata": {"name": "p", "namespace": "d"},
+                   "spec": {}})
+    for i in range(200):  # 5x the threshold of status churn
+        server.patch_status("Pod", "p", "d", {"phase": "Running",
+                                              "tick": i})
+    wal = os.path.join(tmp_path, persistence.WAL)
+    assert sum(1 for _ in open(wal)) < 40  # bounded, not 200
+    # and nothing was lost: a fresh attach sees the latest state
+    s2 = _attach(tmp_path)
+    assert s2.get("Pod", "p", "d")["status"]["tick"] == 199
+
+
+def test_ephemeral_log_tail_not_journaled(tmp_path):
+    """status.logTail (the ~1/s executor flush) is elided from durable
+    records: the WAL/snapshot never hold log lines, and recovery drops
+    them (they're re-derived from the live pod)."""
+    server = APIServer()
+    persistence.attach(server, str(tmp_path))
+    server.create({"kind": "Pod", "apiVersion": "v1",
+                   "metadata": {"name": "p", "namespace": "d"},
+                   "spec": {}})
+    server.patch_status("Pod", "p", "d",
+                        {"phase": "Running",
+                         "logTail": ["secret log line"] * 200})
+    raw = open(os.path.join(tmp_path, persistence.WAL)).read()
+    assert "secret log line" not in raw
+    s2 = _attach(tmp_path)
+    st = s2.get("Pod", "p", "d")["status"]
+    assert st["phase"] == "Running" and "logTail" not in st
+
+
 def test_torn_final_record_is_dropped(tmp_path):
     s1 = _attach(tmp_path)
     s1.create({"kind": "ConfigMap", "apiVersion": "v1",
@@ -187,3 +226,57 @@ def test_platform_restart_reconverges(tmp_path):
         # the contract
     finally:
         mgr2.stop()
+
+
+def test_orphan_reset_respects_executor_identity():
+    """advisor r3: with split-process executors sharing one apiserver, an
+    executor must only orphan-reset pods RECORDED as its own — resetting a
+    peer's Running pod would perpetually bounce and double-launch it.  The
+    same-named executor (a restart of the owner) still resets it."""
+    from kubeflow_tpu.controllers.executor import LocalExecutor
+    from kubeflow_tpu.core import Request
+
+    server = APIServer()
+    server.create({"kind": "Pod", "apiVersion": "v1",
+                   "metadata": {"name": "p", "namespace": "d"},
+                   "spec": {"containers": [{"name": "c", "image": "i",
+                                            "command": ["true"]}]}})
+    server.patch_status("Pod", "p", "d", {"phase": "Running",
+                                          "nodeName": "node-a"})
+
+    other = LocalExecutor(server, node_name="node-b")
+    other.reconcile(Request("d", "p"))
+    assert server.get("Pod", "p", "d")["status"]["phase"] == "Running"
+
+    owner_restarted = LocalExecutor(server, node_name="node-a")
+    owner_restarted.reconcile(Request("d", "p"))
+    assert server.get("Pod", "p", "d")["status"]["phase"] == "Pending"
+
+
+def test_pending_pod_launch_claims_node_binding():
+    """Two executors sharing one apiserver must not BOTH launch a Pending
+    pod: the launcher binds spec.nodeName first (optimistic concurrency),
+    and the loser leaves the pod alone entirely."""
+    import time as _time
+
+    from kubeflow_tpu.controllers.executor import LocalExecutor
+    from kubeflow_tpu.core import Request
+
+    server = APIServer()
+    server.create({"kind": "Pod", "apiVersion": "v1",
+                   "metadata": {"name": "p", "namespace": "d"},
+                   "spec": {"containers": [{"name": "c", "image": "i",
+                                            "command": ["sleep", "5"]}]}})
+    a = LocalExecutor(server, node_name="node-a")
+    b = LocalExecutor(server, node_name="node-b")
+    a.reconcile(Request("d", "p"))
+    pod = server.get("Pod", "p", "d")
+    assert pod["spec"]["nodeName"] == "node-a"
+    b.reconcile(Request("d", "p"))
+    assert ("d", "p") not in b._procs  # loser never spawned anything
+    assert server.get("Pod", "p", "d")["spec"]["nodeName"] == "node-a"
+    deadline = _time.monotonic() + 5
+    while ("d", "p") in a._procs and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    for proc in [e[1] for e in a._procs.values() if e[1] is not None]:
+        proc.kill()

@@ -140,8 +140,9 @@ def _phase_is(server, kind, name, ns, phase):
 
 
 def test_dev_identity_middleware(platform):
-    """--dev-identity plays the mesh: requests without the trusted header
-    get one injected; an explicit header wins (setdefault semantics)."""
+    """--dev-identity plays the mesh/IAP: every request gets the configured
+    identity, and a spoofed inbound header is STRIPPED (overwritten) — a
+    client cannot impersonate another user past the front door."""
     import json
     import urllib.request
 
@@ -159,9 +160,9 @@ def test_dev_identity_middleware(platform):
         req = urllib.request.Request(
             b + "/dashboard/api/workgroup/exists",
             headers={"X-Goog-Authenticated-User-Email":
-                     "accounts.google.com:real@corp.com"})
+                     "accounts.google.com:attacker@evil.com"})
         with urllib.request.urlopen(req) as r:
-            assert json.load(r)["user"] == "real@corp.com"
+            assert json.load(r)["user"] == "dev@local"
     finally:
         httpd.shutdown()
 

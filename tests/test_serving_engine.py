@@ -148,3 +148,77 @@ def test_top_k_sampling_restricts_tokens(predictor):
         predictor.engine.submit([1], top_p=1.5)
     with pytest.raises(ValueError):
         predictor.engine.submit([1], top_k=-2)
+
+
+class TestShardedServing:
+    """tp>1 predictors (VERDICT r3 #4): weights and KV cache shard over a
+    pure-tp mesh; decode output must match the single-chip engine
+    token-for-token, full precision and int8."""
+
+    def test_tp2_decode_matches_single_chip(self, predictor):
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        tp2 = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                  max_seq=64, tp=2)
+        try:
+            solo = predictor.generate([[5, 8, 13, 21]], max_new_tokens=12)
+            out = tp2.generate([[5, 8, 13, 21]], max_new_tokens=12)
+            assert out["ids"][0] == solo["ids"][0]
+            # ragged co-batching works sharded too
+            pair = tp2.generate([[5, 8, 13, 21], [2, 7]],
+                                max_new_tokens=8)
+            ref = predictor.generate([[5, 8, 13, 21], [2, 7]],
+                                     max_new_tokens=8)
+            assert pair["ids"] == ref["ids"]
+        finally:
+            tp2.engine.shutdown()
+
+    def test_tp2_weights_and_cache_actually_sharded(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        tp2 = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                  max_seq=64, tp=2)
+        try:
+            specs = {leaf.sharding.spec
+                     for leaf in jax.tree_util.tree_leaves(tp2.params)}
+            assert any("tp" in str(s) for s in specs), specs
+            cache_specs = {leaf.sharding.spec for leaf in
+                           jax.tree_util.tree_leaves(tp2.engine.cache)}
+            assert cache_specs == {P(None, None, "tp", None)}
+        finally:
+            tp2.engine.shutdown()
+
+    def test_tp2_quantized_matches(self, predictor):
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+        from kubeflow_tpu.serving.quant import QTensor
+        import jax
+
+        q2 = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                 max_seq=64, tp=2, quantize=True)
+        try:
+            out = q2.generate([[5, 8, 13, 21]], max_new_tokens=12)
+            solo = predictor.generate([[5, 8, 13, 21]], max_new_tokens=12)
+            assert out["ids"][0] == solo["ids"][0]
+            qleaves = [leaf for leaf in jax.tree_util.tree_leaves(
+                           q2.params, is_leaf=lambda x: isinstance(x, QTensor))
+                       if isinstance(x := leaf, QTensor)]
+            assert qleaves, "no quantized leaves survived sharding"
+            assert any("tp" in str(leaf.q.sharding.spec)
+                       for leaf in qleaves)
+        finally:
+            q2.engine.shutdown()
+
+    def test_kv_heads_must_divide_tp(self):
+        import pytest as _pytest
+
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        # tiny has num_kv_heads=2; tp=8 over 8 virtual devices can't split
+        # weight sharding (heads=4/kv=2 over tp=8) or the cache
+        # divisibility check raises either way
+        with _pytest.raises(ValueError, match="divisible"):
+            GenerativePredictor("llama", size="tiny", max_batch=2,
+                                max_seq=64, tp=8)
