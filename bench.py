@@ -288,11 +288,40 @@ STAGES = {
 }
 
 
-def _backend_or_die(timeout_s: float = 150.0):
+def _tunnel_diagnostics() -> None:
+    """Log what we can see of the TPU tunnel when init wedges, so a
+    BENCH_rNN failure distinguishes 'unreachable' from 'slow' (VERDICT r3
+    weak #1 asked for diagnostics on wedge)."""
+    import os
+    import socket
+
+    for var in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
+                "PALLAS_AXON_TPU_GEN", "PALLAS_AXON_REMOTE_COMPILE",
+                "AXON_LOOPBACK_RELAY"):
+        _log(f"diag env {var}={os.environ.get(var)!r}")
+    ips = (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")
+    for ip in [i.strip() for i in ips if i.strip()]:
+        # no documented port: a bare TCP reachability probe against the
+        # relay host still separates dead-host from slow-backend
+        for port in (443, 8471, 8476):
+            try:
+                with socket.create_connection((ip, port), timeout=3):
+                    _log(f"diag tcp {ip}:{port} connect OK")
+                    break
+            except OSError as e:
+                _log(f"diag tcp {ip}:{port} -> {e}")
+
+
+def _backend_or_die(timeout_s: float = 600.0):
     """Initialize the JAX backend with a watchdog.  A wedged TPU tunnel
-    hangs make_c_api_client forever; exiting RC_WEDGE quickly lets the
-    parent respawn a fresh child with backoff (a hung ``jax.devices()``
-    poisons this process — same-process retry cannot recover)."""
+    hangs make_c_api_client forever; exiting RC_WEDGE lets the parent
+    respawn a fresh child with backoff (a hung ``jax.devices()`` poisons
+    this process — same-process retry cannot recover).
+
+    The budget is 600s (back from r3's 150s): r1's successful COLD init
+    took minutes, and r3's three 150s attempts all "wedged" — a slow-not-
+    dead tunnel must be given the time it historically needed.
+    """
     import threading
 
     out: dict = {}
@@ -312,9 +341,11 @@ def _backend_or_die(timeout_s: float = 150.0):
     if t.is_alive():
         _log(f"backend init did not complete within {timeout_s:.0f}s — "
              "TPU tunnel unreachable/wedged")
+        _tunnel_diagnostics()
         raise SystemExit(RC_WEDGE)
     if "error" in out:
         _log(f"backend init failed: {out['error']!r}")
+        _tunnel_diagnostics()
         raise SystemExit(RC_WEDGE)
     return out["backend"], out["devices"]
 
@@ -363,7 +394,9 @@ def _run_stage(name: str, timeout: float, attempts: int = 2,
             return {}
         if p.returncode == RC_WEDGE and attempt + 1 < attempts:
             _log(f"stage '{name}' backend init wedged; retrying in "
-                 f"{backoff:.0f}s (attempt {attempt + 2}/{attempts})")
+                 f"{backoff:.0f}s (attempt {attempt + 2}/{attempts}); "
+                 f"child diagnostics:\n"
+                 f"{(p.stderr or '').strip()[-600:]}")
             time.sleep(backoff)
             continue
         if p.returncode != 0:
@@ -393,7 +426,8 @@ def main() -> None:
         "vs_baseline": None,
         "extra": {},
     }
-    head = _run_stage("headline", timeout=2100, attempts=3, backoff=30.0)
+    # per-attempt budget: up to 600s init + 1500s stage watchdog
+    head = _run_stage("headline", timeout=2400, attempts=3, backoff=30.0)
     if not head:
         raise SystemExit("headline measurement failed (see stderr)")
     result["value"] = head["value"]
@@ -402,8 +436,10 @@ def main() -> None:
     # in any later stage still leaves a complete, parseable result line
     print(json.dumps(result), flush=True)
 
-    for name, timeout in (("flash", 900.0), ("serving", 900.0),
-                          ("quant", 1200.0), ("quant7b", 1500.0)):
+    # +600s vs r3: each child may legitimately spend the full init budget
+    # on a slow tunnel before its measurement starts
+    for name, timeout in (("flash", 1500.0), ("serving", 1500.0),
+                          ("quant", 1800.0), ("quant7b", 2100.0)):
         rows = _run_stage(name, timeout=timeout)
         if rows:
             result["extra"].update(rows)

@@ -17,6 +17,13 @@ v1beta1 shapes (this platform's actual history, not the reference's):
     spec.template.spec (notebook_types.go:27-35 pattern).
   JAXJob v1beta1    — {tpuSlice, sliceCount, mesh{dp,fsdp,tp,sp}, train{...}}
     ; v1 renamed these to topology/numSlices/parallelism/trainer.
+  Tensorboard v1beta1 — {logsPath, tensorboardImage}; v1 renamed to
+    {logspath, image} (the reference kept the lowercase spelling,
+    tensorboard_types.go:54-61).
+  Experiment v1beta1 — Katib-v1beta1-shaped: parameters carry
+    {parameterType, feasibleSpace{min,max,step,list}} and the counts are
+    {parallelTrialCount, maxTrialCount, maxFailedTrialCount}; v1 flattened
+    parameters to {type,min,max,step,values} and shortened the counts.
 """
 
 from __future__ import annotations
@@ -171,7 +178,109 @@ def _jaxjob_v1_to_beta(obj: dict) -> dict:
     return obj
 
 
+# -- Tensorboard v1beta1 ------------------------------------------------------
+
+def _tensorboard_beta_to_v1(obj: dict) -> dict:
+    from kubeflow_tpu.api.tensorboard import DEFAULT_IMAGE
+
+    spec = obj.get("spec", {})
+    obj["spec"] = {
+        "logspath": spec.get("logsPath", ""),
+        "image": spec.get("tensorboardImage", DEFAULT_IMAGE),
+    }
+    return obj
+
+
+def _tensorboard_v1_to_beta(obj: dict) -> dict:
+    from kubeflow_tpu.api.tensorboard import DEFAULT_IMAGE
+
+    spec = obj.get("spec", {})
+    obj["spec"] = {
+        "logsPath": spec.get("logspath", ""),
+        "tensorboardImage": spec.get("image", DEFAULT_IMAGE),
+    }
+    return obj
+
+
+# -- Experiment v1beta1 -------------------------------------------------------
+# parameter shapes: v1beta1 {name, parameterType, feasibleSpace{min, max,
+# step, list}} <-> v1 {name, type, min, max, step, values, logScale}
+
+_NUMERIC = ("double", "int")
+
+
+def _param_beta_to_v1(p: dict) -> dict:
+    fs = p.get("feasibleSpace", {})
+    out: dict = {"name": p.get("name", ""),
+                 "type": p.get("parameterType", "double")}
+    if out["type"] in _NUMERIC:
+        for key in ("min", "max", "step"):
+            if key in fs:
+                out[key] = fs[key]
+    if "list" in fs:
+        out["values"] = list(fs["list"])
+        if out["type"] not in _NUMERIC:
+            out["type"] = "categorical"
+    if fs.get("logScale"):
+        out["logScale"] = True
+    return out
+
+
+def _param_v1_to_beta(p: dict) -> dict:
+    fs: dict = {}
+    for key in ("min", "max", "step"):
+        if key in p:
+            fs[key] = p[key]
+    if "values" in p:
+        fs["list"] = list(p["values"])
+    if p.get("logScale"):
+        fs["logScale"] = True
+    return {"name": p.get("name", ""),
+            "parameterType": p.get("type", "double"),
+            "feasibleSpace": fs}
+
+
+def _experiment_beta_to_v1(obj: dict) -> dict:
+    spec = obj.get("spec", {})
+    out = {
+        "objective": dict(spec.get("objective") or {}),
+        "algorithm": dict(spec.get("algorithm") or {}),
+        "parameters": [_param_beta_to_v1(p)
+                       for p in spec.get("parameters") or []],
+        "trialTemplate": dict(spec.get("trialTemplate") or {}),
+        "parallelTrials": spec.get("parallelTrialCount", 2),
+        "maxTrials": spec.get("maxTrialCount", 8),
+        "maxFailedTrials": spec.get("maxFailedTrialCount", 3),
+    }
+    if spec.get("earlyStopping"):
+        out["earlyStopping"] = dict(spec["earlyStopping"])
+    obj["spec"] = out
+    return obj
+
+
+def _experiment_v1_to_beta(obj: dict) -> dict:
+    spec = obj.get("spec", {})
+    out = {
+        "objective": dict(spec.get("objective") or {}),
+        "algorithm": dict(spec.get("algorithm") or {}),
+        "parameters": [_param_v1_to_beta(p)
+                       for p in spec.get("parameters") or []],
+        "trialTemplate": dict(spec.get("trialTemplate") or {}),
+        "parallelTrialCount": spec.get("parallelTrials", 2),
+        "maxTrialCount": spec.get("maxTrials", 8),
+        "maxFailedTrialCount": spec.get("maxFailedTrials", 3),
+    }
+    if spec.get("earlyStopping"):
+        out["earlyStopping"] = dict(spec["earlyStopping"])
+    obj["spec"] = out
+    return obj
+
+
 register_conversion("Notebook", "v1beta1",
                     _notebook_beta_to_v1, _notebook_v1_to_beta)
 register_conversion("JAXJob", "v1beta1",
                     _jaxjob_beta_to_v1, _jaxjob_v1_to_beta)
+register_conversion("Tensorboard", "v1beta1",
+                    _tensorboard_beta_to_v1, _tensorboard_v1_to_beta)
+register_conversion("Experiment", "v1beta1",
+                    _experiment_beta_to_v1, _experiment_v1_to_beta)

@@ -206,10 +206,13 @@ class NotebookController(Controller):
         status from these (crud-web-apps common/status.py:9-99)."""
         name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
-        for ev in self.server.list("Event", namespace=ns):
+        # field_match narrows server-side BEFORE the per-object copy: an
+        # unfiltered Event list was O(all events) deep-copied per reconcile
+        # — the 500-notebook quadratic (p50 70s -> see BASELINE.md)
+        for ev in self.server.list("Event", namespace=ns, field_match={
+                "spec.type": "Warning",
+                "spec.involvedObject.name": f"{name}*"}):
             spec = ev["spec"]
-            if spec.get("type") != "Warning":
-                continue
             involved = spec.get("involvedObject", {})
             mine = (involved.get("kind") == "StatefulSet"
                     and involved.get("name") == name) or (
@@ -253,9 +256,10 @@ class NotebookController(Controller):
         set_condition(nb, "Ready",
                       "True" if status["readyReplicas"] else "False")
         status["conditions"] = nb["status"]["conditions"]
-        RUNNING.set(sum(
-            1 for n in self.server.list(api.KIND)
-            if n.get("status", {}).get("readyReplicas")))
+        # count, don't list: the gauge recomputes every reconcile, and a
+        # copying list() here made reconciles O(total notebooks)
+        RUNNING.set(self.server.count(
+            api.KIND, field_match={"status.readyReplicas": 1}))
         self.server.patch_status(api.KIND, name, ns, status)
 
 

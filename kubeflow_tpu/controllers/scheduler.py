@@ -57,10 +57,9 @@ def pool_capacity(server: APIServer) -> dict[str, int] | None:
     return pool.get("spec", {}).get("capacity") or None
 
 
-def _pod_topology(pod: dict) -> str | None:
-    # controller-owned label, NOT spec.nodeSelector: a user podTemplate can
-    # replace the nodeSelector, which must not hide the gang from accounting
-    return pod["metadata"].get("labels", {}).get(TOPOLOGY_LABEL)
+# gang accounting selects on the controller-owned TOPOLOGY_LABEL, NOT
+# spec.nodeSelector: a user podTemplate can replace the nodeSelector,
+# which must not hide the gang from accounting
 
 
 def _scan_gangs(server: APIServer,
@@ -69,15 +68,35 @@ def _scan_gangs(server: APIServer,
     the pod view (level-triggered: recomputed every decision, no counters).
     Keys carry the owning JAXJob's uid so a job deleted and recreated under
     the same name is a distinct gang (advisor r3: a (ns, name) key let the
-    recreation inherit the old creationTimestamp and jump the FIFO)."""
+    recreation inherit the old creationTimestamp and jump the FIFO).
+
+    Memoized per topology on the store's Pod generation counter: parked
+    gangs re-poll with no pod changes between polls, so most scans are
+    recomputations of identical state (profiled: ~10 scans per gang at
+    150-gang contention).  The cache lives ON the server instance — a
+    module-global cache served one server's gangs to another whose fresh
+    generation counter collided (restart / multi-store processes)."""
+    gen_fn = getattr(server, "generation", None)
+    gen = gen_fn("Pod") if gen_fn is not None else -1
+    cache: dict | None = None
+    if gen >= 0:
+        cache = server.__dict__.setdefault("_gang_scan_cache", {})
+        cached = cache.get(topology)
+        if cached is not None and cached[0] == gen:
+            # shallow copies: _scan_gangs' tail and callers mutate them
+            return dict(cached[1]), dict(cached[2])
     released: dict[tuple, int] = {}
     waiting: dict[tuple, int] = {}
-    for pod in server.list("Pod"):
-        if _pod_topology(pod) != topology:
-            continue
+    # projection, not list: this scan runs per scheduling decision over
+    # every pod — full-object copies here were the 500-gang quadratic
+    for pod in server.project(
+            "Pod", ("metadata.namespace", "metadata.labels",
+                    "metadata.ownerReferences", "status.phase",
+                    "spec.schedulingGates"),
+            label_selector={"matchLabels": {TOPOLOGY_LABEL: topology}}):
         if pod.get("status", {}).get("phase") in TERMINAL_PHASES:
             continue
-        md = pod["metadata"]
+        md = pod.get("metadata", {})
         gang = md.get("labels", {}).get("gang")
         if not gang:
             continue
@@ -85,14 +104,18 @@ def _scan_gangs(server: APIServer,
                           for r in md.get("ownerReferences", [])
                           if r.get("kind") == "JAXJob"), None)
         key = (md.get("namespace"), gang, owner_uid)
-        slices = int(md["labels"].get("jaxjob-num-slices", "1"))
-        if pod["spec"].get("schedulingGates"):
+        slices = int(md.get("labels", {}).get("jaxjob-num-slices", "1"))
+        if pod.get("spec", {}).get("schedulingGates"):
             waiting[key] = slices
         else:
             released[key] = slices
     # a gang mid-release (some gates lifted) holds capacity already
     for key in released:
         waiting.pop(key, None)
+    if cache is not None:
+        if len(cache) > 64:
+            cache.clear()
+        cache[topology] = (gen, dict(released), dict(waiting))
     return released, waiting
 
 

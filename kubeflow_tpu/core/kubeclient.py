@@ -151,6 +151,27 @@ class KubeStore:
             items = [o for o in items if _match_fields(o, field_match)]
         return items
 
+    def count(self, kind: str, namespace: str | None = None,
+              field_match: dict | None = None) -> int:
+        """Store-surface parity with APIServer.count (here it costs a
+        list over the wire either way)."""
+        return len(self.list(kind, namespace=namespace,
+                             field_match=field_match))
+
+    def project(self, kind: str, paths: tuple,
+                namespace: str | None = None,
+                label_selector: dict | None = None,
+                field_match: dict | None = None) -> list[dict]:
+        """Store-surface parity with APIServer.project — client-side
+        projection over a full list (the wire cost dominates anyway)."""
+        from kubeflow_tpu.core.store import project_object
+
+        split_paths = [p.split(".") for p in paths]
+        return [project_object(obj, split_paths, copy=False)
+                for obj in self.list(kind, namespace=namespace,
+                                     label_selector=label_selector,
+                                     field_match=field_match)]
+
     def update(self, obj: dict) -> dict:
         md = obj["metadata"]
         return self._req(

@@ -200,6 +200,7 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
             objects.pop(payload, None)
     with server._lock:
         server._objects.update(objects)
+        server._rebuild_index()
         server._rv = max(server._rv, max_rv)
 
     persister = Persister(server, data_dir, fsync=fsync,

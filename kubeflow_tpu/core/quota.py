@@ -56,9 +56,12 @@ def quota_hard(server: APIServer, namespace: str) -> dict[str, int] | None:
 
 
 def namespace_usage(server: APIServer, namespace: str) -> dict[str, int]:
-    """Charged usage: every non-terminal pod in the namespace."""
+    """Charged usage: every non-terminal pod in the namespace.  Projected
+    read: this runs inside every pod-create admission, so copying whole
+    pods here was quadratic under gang churn."""
     usage: dict[str, int] = {}
-    for pod in server.list("Pod", namespace=namespace):
+    for pod in server.project("Pod", ("status.phase", "spec.containers"),
+                              namespace=namespace):
         if pod.get("status", {}).get("phase") in TERMINAL_PHASES:
             continue
         for key, val in pod_tpu_requests(pod).items():

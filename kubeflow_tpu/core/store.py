@@ -67,12 +67,43 @@ class WatchEvent:
 # kinds that live outside any namespace (mirrors k8s built-ins + our CRDs)
 CLUSTER_SCOPED = {"Namespace", "Profile", "ClusterRole", "PersistentVolume"}
 
+_MISSING = object()  # sentinel: dotted path absent in a projected object
+
+
+def project_object(obj: dict, split_paths: list[list[str]],
+                   copy: bool = True) -> dict:
+    """Extract the given (pre-split) dotted paths from ``obj`` into a new
+    nested dict; absent paths are omitted.  Shared by APIServer.project
+    and KubeStore.project so the two store surfaces cannot drift."""
+    row: dict = {}
+    for parts in split_paths:
+        cur: Any = obj
+        for part in parts:
+            if not isinstance(cur, dict) or part not in cur:
+                cur = _MISSING
+                break
+            cur = cur[part]
+        if cur is _MISSING:
+            continue
+        dst = row
+        for part in parts[:-1]:
+            dst = dst.setdefault(part, {})
+        dst[parts[-1]] = _jcopy(cur) if copy else cur
+    return row
+
 
 class APIServer:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         # (kind, namespace or "", name) -> object
         self._objects: dict[tuple[str, str, str], dict] = {}
+        # kind -> {key -> object}: LIST scans only its own kind instead of
+        # the whole store (the flat scan was O(total objects) per list and
+        # quadratic under controller load — 500-notebook loadtest)
+        self._kinds: dict[str, dict[tuple, dict]] = {}
+        # kind -> mutation generation: lets hot read paths (the gang
+        # scheduler's pod scan) memoize "nothing of this kind changed"
+        self._gens: dict[str, int] = {}
         self._rv = 0
         self._watchers: list[tuple[Callable[[WatchEvent], bool], queue.Queue]] = []
         self._mutating_hooks: list[Callable[[dict], dict | None]] = []
@@ -85,6 +116,24 @@ class APIServer:
     def _record(self, op: str, payload) -> None:
         if self._journal is not None:
             self._journal(op, payload)
+
+    def _index_put(self, key: tuple, obj: dict) -> None:
+        self._kinds.setdefault(key[0], {})[key] = obj
+        self._gens[key[0]] = self._gens.get(key[0], 0) + 1
+
+    def generation(self, kind: str) -> int:
+        """Monotonic per-kind mutation counter (bumps on create/update/
+        status-patch/delete of that kind).  Read paths may cache derived
+        state keyed on it."""
+        with self._lock:
+            return self._gens.get(kind, 0)
+
+    def _rebuild_index(self) -> None:
+        """Recompute the per-kind index from _objects (persistence.attach
+        bulk-loads _objects directly)."""
+        self._kinds = {}
+        for key, obj in self._objects.items():
+            self._index_put(key, obj)
 
     # -- helpers --------------------------------------------------------------
     def _key(self, kind: str, namespace: str | None, name: str):
@@ -142,6 +191,7 @@ class APIServer:
             md.setdefault("labels", {})
             md.setdefault("annotations", {})
             self._objects[key] = obj
+            self._index_put(key, obj)
             self._record("put", obj)
             out = _jcopy(obj)
         self._emit(WatchEvent("ADDED", _jcopy(obj)))
@@ -159,9 +209,7 @@ class APIServer:
              field_match: dict | None = None) -> list[dict]:
         with self._lock:
             out = []
-            for (k, ns, _), obj in self._objects.items():
-                if k != kind:
-                    continue
+            for (_, ns, _n), obj in self._kinds.get(kind, {}).items():
                 if (namespace is not None and kind not in CLUSTER_SCOPED
                         and ns != namespace):
                     continue
@@ -173,6 +221,46 @@ class APIServer:
                 out.append(_jcopy(obj))
             return sorted(out, key=lambda o: (o["metadata"].get("namespace")
                                               or "", o["metadata"]["name"]))
+
+    def project(self, kind: str, paths: tuple,
+                namespace: str | None = None,
+                label_selector: dict | None = None,
+                field_match: dict | None = None) -> list[dict]:
+        """LIST that copies ONLY the dotted ``paths`` out of each matching
+        object (k8s PartialObjectMetadata's role) — per-item cost is the
+        selected fields, not the whole object.  Hot-path scans (gang
+        scheduler, quota usage) run every scheduling decision over every
+        pod; full-object copies there were quadratic at 500-gang scale."""
+        split_paths = [p.split(".") for p in paths]
+        with self._lock:
+            out = []
+            for (_, ns, _n), obj in self._kinds.get(kind, {}).items():
+                if (namespace is not None and kind not in CLUSTER_SCOPED
+                        and ns != namespace):
+                    continue
+                if not ob.match_labels(label_selector,
+                                       obj["metadata"].get("labels")):
+                    continue
+                if field_match and not _match_fields(obj, field_match):
+                    continue
+                out.append(project_object(obj, split_paths))
+            return out
+
+    def count(self, kind: str, namespace: str | None = None,
+              field_match: dict | None = None) -> int:
+        """Count matching objects WITHOUT copying them — for metrics and
+        other read-only tallies (a copying list() per reconcile was the
+        500-notebook quadratic)."""
+        with self._lock:
+            n = 0
+            for (_, ns, _n), obj in self._kinds.get(kind, {}).items():
+                if (namespace is not None and kind not in CLUSTER_SCOPED
+                        and ns != namespace):
+                    continue
+                if field_match and not _match_fields(obj, field_match):
+                    continue
+                n += 1
+            return n
 
     def update(self, obj: dict) -> dict:
         obj = _jcopy(obj)
@@ -212,6 +300,7 @@ class APIServer:
                 return _jcopy(existing)
             md["resourceVersion"] = self._next_rv()
             self._objects[key] = obj
+            self._index_put(key, obj)
             self._record("put", obj)
             finalize = ("deletionTimestamp" in md
                         and not md.get("finalizers"))
@@ -234,6 +323,7 @@ class APIServer:
                 return _jcopy(obj)
             obj["status"] = _jcopy(status)
             obj["metadata"]["resourceVersion"] = self._next_rv()
+            self._gens[kind] = self._gens.get(kind, 0) + 1
             self._record("put", obj)
             snapshot = _jcopy(obj)
         self._emit(WatchEvent("MODIFIED", snapshot))
@@ -253,6 +343,7 @@ class APIServer:
 
                     obj["metadata"]["deletionTimestamp"] = _t.time()
                     obj["metadata"]["resourceVersion"] = self._next_rv()
+                    self._gens[kind] = self._gens.get(kind, 0) + 1
                     self._record("put", obj)
                     snapshot = _jcopy(obj)
                 else:
@@ -268,6 +359,8 @@ class APIServer:
         with self._lock:
             key = self._key(kind, namespace, name)
             obj = self._objects.pop(key, None)
+            self._kinds.get(key[0], {}).pop(key, None)
+            self._gens[key[0]] = self._gens.get(key[0], 0) + 1
             if obj is None:
                 return
             self._record("del", key)

@@ -167,9 +167,16 @@
       { title: "Status", render: (o) => KF.statusIcon({
           phase: o.status && o.status.ready ? "ready" : "waiting" }) },
       { title: "Name", render: (o) => o.metadata.name },
-      { title: "Model", render: (o) =>
-          `${o.spec.model || ""} ${o.spec.size || ""}` },
-      { title: "Topology", render: (o) => o.spec.topology || "" },
+      /* the predictor payload lives under spec.predictor
+       * (api/inferenceservice.py) — reading spec.model rendered a blank
+       * Model column for every service (caught by the field-contract
+       * test, tests/test_frontend_contract.py) */
+      { title: "Model", render: (o) => {
+          const p = o.spec.predictor || {};
+          return `${p.model || ""} ${p.size || ""}`;
+        } },
+      { title: "Topology", render: (o) =>
+          (o.spec.predictor || {}).topology || "" },
       { title: "URL", render: (o) => o.status && o.status.url
           ? el("code", null, o.status.url)
           : el("span", { class: "muted" }, "—") },

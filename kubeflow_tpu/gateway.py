@@ -46,7 +46,7 @@ in the reference.
 
 Bodies stream both directions in chunks (long-poll/SSE work; WebSocket
 upgrade happens one layer down — core.httpapi's raw-socket handler hands
-upgrade requests to ``Gateway.websocket_backend``).  A matched route with
+upgrade requests to ``Gateway.websocket_upgrade``).  A matched route with
 no live backend is 503, a refused connection 502 — only an unmatched path
 falls through to the caller.
 """
@@ -297,6 +297,9 @@ def _body_chunks(stream, length: int, chunk: int = 65536):
 class Gateway:
     """WSGI reverse proxy over the store's VirtualService objects."""
 
+    # bodies at or below this buffer whole for safe connect retries
+    BUFFER_BODY_MAX = 1 << 20
+
     def __init__(self, server: APIServer, *, connect_retries: int = 40,
                  retry_delay: float = 0.25):
         self.server = server
@@ -455,8 +458,17 @@ class Gateway:
         except ValueError:
             length = 0
         headers["Content-Length"] = str(length)
-        body = (_body_chunks(environ["wsgi.input"], length)
-                if length else b"")
+        # small bodies buffer whole so they survive connect retries (the
+        # first click after "ready" is usually a POST hitting the pod's
+        # bind-race window); only large uploads stream unbuffered and
+        # forfeit the retry
+        if 0 < length <= self.BUFFER_BODY_MAX:
+            body: object = environ["wsgi.input"].read(length)
+            retriable = True
+        else:
+            body = (_body_chunks(environ["wsgi.input"], length)
+                    if length else b"")
+            retriable = length == 0
 
         conn = None
         for attempt in range(self.connect_retries):
@@ -468,13 +480,9 @@ class Gateway:
                 break
             except ConnectionRefusedError:
                 conn.close()
-                if attempt + 1 == self.connect_retries:
-                    PROXIED.labels("502").inc()
-                    start_response("502 Bad Gateway",
-                                   [("Content-Type", "text/plain")])
-                    return [b"backend connection refused\n"]
-                # only retriable when the request body wasn't consumed
-                if length:
+                # a streamed (unbuffered) body may be partially consumed
+                # and cannot be replayed
+                if attempt + 1 == self.connect_retries or not retriable:
                     PROXIED.labels("502").inc()
                     start_response("502 Bad Gateway",
                                    [("Content-Type", "text/plain")])

@@ -1,10 +1,15 @@
-"""Flash attention for TPU, written in Pallas.
+"""Flash attention for TPU, written in Pallas — forward AND backward.
 
-Forward pass is a Pallas kernel: grid over (batch*heads, query blocks), online
-softmax over key blocks held in VMEM, accumulation in float32, output cast back
-to the input dtype.  Backward is a blockwise lax.scan (XLA) using the saved
-log-sum-exp, so peak memory stays O(S * block) instead of O(S^2) — on TPU the
-backward matmuls are MXU-bound either way and XLA fuses the elementwise chain.
+Forward: a Pallas kernel gridded over (batch*heads, query blocks), online
+softmax over key blocks held in VMEM, accumulation in float32, output cast
+back to the input dtype; the log-sum-exp per query row is saved as the
+residual.
+
+Backward: two Pallas kernels using that saved log-sum-exp — ``_bwd_dq``
+grids over query blocks (recomputes p = exp(qk - lse) per key block and
+accumulates dq), ``_bwd_dkv`` grids over key blocks (accumulates dk/dv
+across query blocks).  Recompute-from-lse keeps peak memory O(S * block)
+instead of O(S^2), and the block matmuls stay MXU-shaped.
 
 Kernel playbook follows /opt/skills/guides/pallas_guide.md (online-softmax +
 VMEM blocking + MXU-aligned tiles).

@@ -122,3 +122,109 @@ def test_rest_layer_serves_both_versions(server):
     assert st.startswith("200")
     st, v1 = call("GET", "/apis/JAXJob/team/job")
     assert v1["spec"]["trainer"]["steps"] == 200
+
+
+def beta_tensorboard(name="tb", ns="team"):
+    return {
+        "apiVersion": "kubeflow-tpu.org/v1beta1",
+        "kind": "Tensorboard",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"logsPath": "pvc://logs/run1",
+                 "tensorboardImage": "tf:2.9"},
+    }
+
+
+def beta_experiment(name="exp", ns="team"):
+    return {
+        "apiVersion": "kubeflow-tpu.org/v1beta1",
+        "kind": "Experiment",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "objective": {"type": "minimize", "metric": "final_loss"},
+            "algorithm": {"name": "random", "seed": 7},
+            "parameters": [
+                {"name": "lr", "parameterType": "double",
+                 "feasibleSpace": {"min": 1e-4, "max": 1e-1,
+                                   "logScale": True}},
+                {"name": "layers", "parameterType": "int",
+                 "feasibleSpace": {"min": 1, "max": 4, "step": 1}},
+                {"name": "opt", "parameterType": "categorical",
+                 "feasibleSpace": {"list": ["adam", "sgd"]}},
+            ],
+            "trialTemplate": {"topology": "v5e-4",
+                              "trainer": {"model": "mlp"}},
+            "parallelTrialCount": 3, "maxTrialCount": 9,
+            "maxFailedTrialCount": 2,
+            "earlyStopping": {"algorithm": "medianstop", "minTrials": 3},
+        },
+    }
+
+
+def test_tensorboard_v1beta1_stored_as_v1(server):
+    server.create(beta_tensorboard())
+    stored = server.get("Tensorboard", "tb", "team")
+    assert stored["apiVersion"] == "kubeflow-tpu.org/v1"
+    assert stored["spec"] == {"logspath": "pvc://logs/run1",
+                              "image": "tf:2.9"}
+    back = versions.from_storage(stored, "v1beta1")
+    assert back["spec"] == {"logsPath": "pvc://logs/run1",
+                            "tensorboardImage": "tf:2.9"}
+    assert back["apiVersion"] == "kubeflow-tpu.org/v1beta1"
+
+
+def test_experiment_v1beta1_stored_as_v1_and_valid(server):
+    """The up-converted Experiment must satisfy the v1 validator (the
+    real consumer), and the round trip back must be lossless."""
+    from kubeflow_tpu.api import experiment as exp_api
+
+    server.create(beta_experiment())
+    stored = server.get("Experiment", "exp", "team")
+    assert stored["apiVersion"] == "kubeflow-tpu.org/v1"
+    spec = stored["spec"]
+    assert spec["parallelTrials"] == 3 and spec["maxTrials"] == 9
+    by_name = {p["name"]: p for p in spec["parameters"]}
+    assert by_name["lr"] == {"name": "lr", "type": "double",
+                             "min": 1e-4, "max": 1e-1, "logScale": True}
+    assert by_name["layers"]["step"] == 1
+    assert by_name["opt"] == {"name": "opt", "type": "categorical",
+                              "values": ["adam", "sgd"]}
+    exp_api.validate(stored)  # the controller's admission check passes
+
+    back = versions.from_storage(stored, "v1beta1")
+    assert back["spec"]["parameters"] == beta_experiment()["spec"][
+        "parameters"]
+    assert back["spec"]["maxFailedTrialCount"] == 2
+    assert back["spec"]["earlyStopping"]["algorithm"] == "medianstop"
+
+
+def test_experiment_v1beta1_runs_through_v1_controller(server):
+    """A v1beta1 Experiment drives the real HPO controller end-to-end:
+    trials spawn from the converted spec (the conversion is admission-
+    deep, not serialization-deep)."""
+    from kubeflow_tpu.core import Manager
+    from kubeflow_tpu.hpo import controller as hpo
+
+    mgr = Manager(server)
+    hpo.register(server, mgr)
+    from kubeflow_tpu.controllers.executor import FakeExecutor
+    from kubeflow_tpu.controllers.jaxjob import JAXJobController
+
+    mgr.add(JAXJobController(server))
+    mgr.add(FakeExecutor(server))
+    mgr.start()
+    try:
+        exp = beta_experiment(name="e2")
+        exp["spec"]["maxTrialCount"] = 2
+        exp["spec"]["parallelTrialCount"] = 2
+        del exp["spec"]["earlyStopping"]
+        server.create(exp)
+        from conftest import poll_until
+
+        done = poll_until(
+            lambda: (lambda e: e if (e.get("status", {}).get("phase")
+                                     == "Succeeded") else None)(
+                server.get("Experiment", "e2", "team")), timeout=60)
+        assert done["status"]["trials"] == 2
+        assert "bestTrial" in done["status"]
+    finally:
+        mgr.stop()
