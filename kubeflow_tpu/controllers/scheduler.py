@@ -70,21 +70,22 @@ def _scan_gangs(server: APIServer,
     the same name is a distinct gang (advisor r3: a (ns, name) key let the
     recreation inherit the old creationTimestamp and jump the FIFO).
 
-    Memoized per topology on the store's Pod generation counter: parked
-    gangs re-poll with no pod changes between polls, so most scans are
-    recomputations of identical state (profiled: ~10 scans per gang at
-    150-gang contention).  The cache lives ON the server instance — a
-    module-global cache served one server's gangs to another whose fresh
-    generation counter collided (restart / multi-store processes)."""
-    gen_fn = getattr(server, "generation", None)
-    gen = gen_fn("Pod") if gen_fn is not None else -1
-    cache: dict | None = None
-    if gen >= 0:
-        cache = server.__dict__.setdefault("_gang_scan_cache", {})
-        cached = cache.get(topology)
-        if cached is not None and cached[0] == gen:
-            # shallow copies: _scan_gangs' tail and callers mutate them
-            return dict(cached[1]), dict(cached[2])
+    Memoized per topology via the store's generation-keyed ``memo()``
+    (parked gangs re-poll with no pod changes between polls, so most
+    scans are recomputations of identical state — profiled: ~10 scans
+    per gang at 150-gang contention)."""
+    memo = getattr(server, "memo", None)
+    if memo is not None:
+        released, waiting = memo("Pod", ("gang-scan", topology),
+                                 lambda: _scan_gangs_uncached(server,
+                                                              topology))
+        # shallow copies: callers mutate (memo values are shared)
+        return dict(released), dict(waiting)
+    return _scan_gangs_uncached(server, topology)
+
+
+def _scan_gangs_uncached(server: APIServer,
+                         topology: str) -> tuple[dict, dict]:
     released: dict[tuple, int] = {}
     waiting: dict[tuple, int] = {}
     # projection, not list: this scan runs per scheduling decision over
@@ -112,10 +113,6 @@ def _scan_gangs(server: APIServer,
     # a gang mid-release (some gates lifted) holds capacity already
     for key in released:
         waiting.pop(key, None)
-    if cache is not None:
-        if len(cache) > 64:
-            cache.clear()
-        cache[topology] = (gen, dict(released), dict(waiting))
     return released, waiting
 
 

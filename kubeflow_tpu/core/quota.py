@@ -10,8 +10,10 @@ admit or park whole gangs atomically (a TPU slice is useless partially
 admitted — all-or-nothing, unlike per-pod k8s quota).
 
 Accounting follows k8s semantics: terminal pods (Succeeded/Failed) do not
-count; usage is recomputed from live objects on every check (level-triggered,
-no cached counters to drift).
+count; usage derives from live objects, never incremental counters that can
+drift — memoized via the store's generation-keyed ``memo()`` (a cached
+value is provably identical to a recomputation: it is invalidated by ANY
+pod mutation, so it cannot go stale).
 """
 
 from __future__ import annotations
@@ -57,16 +59,24 @@ def quota_hard(server: APIServer, namespace: str) -> dict[str, int] | None:
 
 def namespace_usage(server: APIServer, namespace: str) -> dict[str, int]:
     """Charged usage: every non-terminal pod in the namespace.  Projected
-    read: this runs inside every pod-create admission, so copying whole
-    pods here was quadratic under gang churn."""
-    usage: dict[str, int] = {}
-    for pod in server.project("Pod", ("status.phase", "spec.containers"),
-                              namespace=namespace):
-        if pod.get("status", {}).get("phase") in TERMINAL_PHASES:
-            continue
-        for key, val in pod_tpu_requests(pod).items():
-            usage[key] = usage.get(key, 0) + val
-    return usage
+    read (copying whole pods here was quadratic under gang churn) and
+    memoized on the store's Pod generation — admission runs this per pod
+    create, but usage only changes when pods change."""
+    def compute() -> dict[str, int]:
+        usage: dict[str, int] = {}
+        for pod in server.project("Pod",
+                                  ("status.phase", "spec.containers"),
+                                  namespace=namespace):
+            if pod.get("status", {}).get("phase") in TERMINAL_PHASES:
+                continue
+            for key, val in pod_tpu_requests(pod).items():
+                usage[key] = usage.get(key, 0) + val
+        return usage
+
+    memo = getattr(server, "memo", None)
+    if memo is None:  # KubeStore: no server-side generations over REST
+        return compute()
+    return dict(memo("Pod", ("quota-usage", namespace), compute))
 
 
 def check_fit(server: APIServer, namespace: str,

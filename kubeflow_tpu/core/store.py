@@ -104,6 +104,8 @@ class APIServer:
         # kind -> mutation generation: lets hot read paths (the gang
         # scheduler's pod scan) memoize "nothing of this kind changed"
         self._gens: dict[str, int] = {}
+        # kind -> {key -> (generation, value)}: the memo() helper's store
+        self._memo: dict[str, dict] = {}
         self._rv = 0
         self._watchers: list[tuple[Callable[[WatchEvent], bool], queue.Queue]] = []
         self._mutating_hooks: list[Callable[[dict], dict | None]] = []
@@ -124,14 +126,36 @@ class APIServer:
     def generation(self, kind: str) -> int:
         """Monotonic per-kind mutation counter (bumps on create/update/
         status-patch/delete of that kind).  Read paths may cache derived
-        state keyed on it."""
+        state keyed on it — use ``memo()``."""
         with self._lock:
             return self._gens.get(kind, 0)
+
+    def memo(self, kind: str, key, compute):
+        """Cache ``compute()``'s value until any object of ``kind``
+        mutates (the centralized attachment point for generation-keyed
+        derived state: quota usage, the gang scheduler's pod scan).
+        Callers must treat the returned value as IMMUTABLE — it is shared
+        across calls; copy before mutating.
+
+        Safe without holding the lock across compute(): the generation is
+        read BEFORE computing and only ever advances, so a hit at the
+        stored generation implies no intervening mutation."""
+        gen = self.generation(kind)
+        cache = self._memo.setdefault(kind, {})
+        hit = cache.get(key)
+        if hit is not None and hit[0] == gen:
+            return hit[1]
+        value = compute()
+        if len(cache) > 256:
+            cache.clear()
+        cache[key] = (gen, value)
+        return value
 
     def _rebuild_index(self) -> None:
         """Recompute the per-kind index from _objects (persistence.attach
         bulk-loads _objects directly)."""
         self._kinds = {}
+        self._memo = {}
         for key, obj in self._objects.items():
             self._index_put(key, obj)
 

@@ -36,7 +36,7 @@ class GenerativePredictor:
                  checkpoint_dir: str | None = None,
                  max_batch: int = 4, max_seq: int = 512, seed: int = 0,
                  quantize: bool = False, fast_init: bool = False,
-                 tp: int = 1):
+                 tp: int = 1, ep: int = 1):
         from kubeflow_tpu.models import registry
 
         self.log = get_logger("predictor", model=model_name, size=size)
@@ -62,15 +62,26 @@ class GenerativePredictor:
                 lambda s: jnp.zeros(s.shape, s.dtype),
                 unbox_params(shapes))
 
-        # tp>1: Megatron tensor parallelism over a pure-tp mesh — each
-        # chip holds 1/tp of every matmul weight and of the KV cache heads
-        # (serving/sharded.py); tp=1 keeps the single-chip path untouched
+        # tp>1 / ep>1: Megatron tensor parallelism and/or expert
+        # parallelism over a serving mesh — each chip holds 1/tp of every
+        # matmul weight and of the KV cache heads, and 1/ep of the MoE
+        # experts (serving/sharded.py); tp=ep=1 keeps the single-chip
+        # path untouched
         self.mesh = None
         specs = None
-        if tp > 1:
+        if tp > 1 or ep > 1:
             from kubeflow_tpu.serving import sharded
 
-            self.mesh = sharded.serving_mesh(tp)
+            if ep > 1:
+                experts = getattr(self.cfg, "moe_experts", 0)
+                if not experts or experts % ep != 0:
+                    # config-level error beats a GSPMD partition failure
+                    # deep inside device_put (and ep>1 on a dense model
+                    # would silently waste every ep-replicated chip)
+                    raise ValueError(
+                        f"ep={ep} needs a MoE model whose moe_experts "
+                        f"divides by it (got moe_experts={experts})")
+            self.mesh = sharded.serving_mesh(tp, ep)
             specs = sharded.param_specs(self.module, rng, example)
         if quantize:
             # weight-only int8 (serving/quant.py): init + restore +
@@ -283,14 +294,20 @@ def main(argv=None) -> int:
         ckpt = opts.get("checkpoint_dir", args.checkpoint_dir)
         from kubeflow_tpu.models import registry
 
+        # model-config passthrough: moe_* keys configure a Mixtral-style
+        # MoE variant from the CLI (pairs with ep= for expert parallelism)
+        model_config = {k: int(v) for k, v in opts.items()
+                        if k in ("moe_experts", "moe_every")}
         if registry.get(name).generative:
             predictors[name] = GenerativePredictor(
                 name, size=size, checkpoint_dir=ckpt,
+                model_config=model_config or None,
                 max_batch=int(opts.get("max_batch", args.max_batch)),
                 max_seq=int(opts.get("max_seq", args.max_seq)),
                 quantize=opts.get("quantize", "").lower()
                 in ("1", "true", "int8"),
-                tp=int(opts.get("tp", 1)))
+                tp=int(opts.get("tp", 1)),
+                ep=int(opts.get("ep", 1)))
         else:
             predictors[name] = ClassifierPredictor(name,
                                                    checkpoint_dir=ckpt)

@@ -38,14 +38,19 @@ SERVING_RULES = DEFAULT_RULES.replace(batch=None, seq=None, embed=None)
 CACHE_SPEC = P(None, None, "tp", None)
 
 
-def serving_mesh(tp: int, devices=None) -> Mesh:
-    """A pure-tp mesh over the first ``tp`` local devices (one slice)."""
+def serving_mesh(tp: int, ep: int = 1, devices=None) -> Mesh:
+    """A tp (x ep) mesh over the first ``tp*ep`` local devices (one
+    slice).  ``ep>1`` serves Mixtral-style MoE models with experts
+    distributed one-per-chip-group (the dispatch/combine einsums become
+    all-to-alls over 'ep', exactly as in training — models/moe.py)."""
     from kubeflow_tpu.parallel import make_mesh
 
-    devices = devices if devices is not None else jax.devices()[:tp]
-    if len(devices) < tp:
-        raise ValueError(f"tp={tp} needs {tp} devices, have {len(devices)}")
-    return make_mesh(tp, dp=1, fsdp=1, tp=tp, sp=1, devices=devices)
+    n = tp * ep
+    devices = devices if devices is not None else jax.devices()[:n]
+    if len(devices) < n:
+        raise ValueError(
+            f"tp={tp} x ep={ep} needs {n} devices, have {len(devices)}")
+    return make_mesh(n, dp=1, fsdp=1, tp=tp, sp=1, ep=ep, devices=devices)
 
 
 def param_specs(module, rng, example):

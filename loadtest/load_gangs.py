@@ -61,7 +61,11 @@ def main() -> int:
     deadline = time.perf_counter() + max(120, n_gangs * 3)
     while len(t_done) < n_gangs and time.perf_counter() < deadline:
         running = 0
-        for job in server.list(api.KIND, namespace="loadtest"):
+        # projected observer: the measurement loop must not itself be the
+        # load (full-copy listing N jobs per 20ms tick was)
+        for job in server.project(api.KIND,
+                                  ("metadata.name", "status.phase"),
+                                  namespace="loadtest"):
             name = job["metadata"]["name"]
             phase = job.get("status", {}).get("phase")
             if phase in ("Running", "Restarting"):

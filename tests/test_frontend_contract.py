@@ -146,6 +146,40 @@ def test_resources_js_field_paths_exist_on_real_objects(sample_objects):
         f"(renamed backend field or dead JS): {missing}")
 
 
+def test_webapp_js_field_paths_exist_on_real_objects():
+    """Same contract for the jupyter/volumes/tensorboards/dashboard apps:
+    the CR-shaped chains they read (Events for activity feeds, the
+    Notebook podTemplate for the volumes pane, normalized statuses) must
+    exist on objects the platform really produces."""
+    from kubeflow_tpu.core.events import record_event
+
+    server = APIServer()
+    nb = server.create({
+        "kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+        "metadata": {"name": "wnb", "namespace": "w"},
+        "spec": {"template": {"spec": {
+            "containers": [{"name": "wnb", "image": "i"}],
+            "volumes": [{"name": "ws", "persistentVolumeClaim": {
+                "claimName": "ws"}}]}}}})
+    record_event(server, nb, "Warning", "FailedScheduling", "no capacity")
+    event = server.list("Event", namespace="w")[0]
+    # normalized web-app status shape (crud_backend status contract)
+    normalized = {"status": {"phase": "ready", "message": "Running"}}
+    samples = [nb, event, normalized,
+               {"status": {"phase": "Running"}}]
+
+    union_src = "".join(
+        open(os.path.join(STATIC, f)).read()
+        for f in ("jupyter.js", "volumes.js", "tensorboards.js",
+                  "dashboard.js"))
+    paths = extract_paths(union_src)
+    assert paths, "extraction regressed"
+    missing = sorted(p for p in paths
+                     if not any(reachable(o, p) for o in samples))
+    assert not missing, (
+        f"web-app JS dereferences fields nothing produces: {missing}")
+
+
 def test_contract_catches_a_renamed_field(sample_objects):
     """The guard actually guards: a field nothing emits must be flagged."""
     fake = extract_paths("o.status.workersRenamed.ready")
