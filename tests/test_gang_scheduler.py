@@ -317,3 +317,56 @@ def test_recreated_job_does_not_inherit_fifo_position(harness):
                for p in gang_pods(server, "first"))
     finish_gang(server, "middle")
     wait_for(lambda: job_phase(server, "first") == "Running" or None)
+
+
+def test_pool_resize_unparks_waiting_gang_promptly(harness):
+    """Raising TpuSlicePool capacity fires NO pod event — the controller
+    must watch the pool itself so parked gangs start promptly instead of
+    waiting out the (slow) park poll."""
+    import time as _time
+
+    server, mgr, executor = harness
+    server.create(scheduler.new_pool({"v5e-8": 1}))
+    server.create(api.new("holder", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "holder") == "Running" or None)
+    server.create(api.new("waiter", "ml", topology="v5e-8"))
+    wait_for(lambda: get_condition(server.get(api.KIND, "waiter", "ml"),
+                                   "WaitingForSlices") or None)
+    # let the park backoff climb so the poll alone would be slow
+    _time.sleep(1.5)
+
+    pool = server.get(scheduler.POOL_KIND, scheduler.POOL_NAME)
+    pool["spec"]["capacity"]["v5e-8"] = 2
+    t0 = _time.monotonic()
+    server.update(pool)
+    wait_for(lambda: job_phase(server, "waiter") == "Running" or None,
+             timeout=10)
+    # prompt = event-driven (well under the backoff the poll had reached)
+    assert _time.monotonic() - t0 < 1.5
+
+
+def test_quota_raise_unparks_gang_promptly(harness):
+    """Same for ResourceQuota: a quota bump re-enqueues that namespace's
+    QuotaExceeded gangs immediately."""
+    import time as _time
+
+    from kubeflow_tpu.core import api_object, quota as quota_mod
+
+    server, mgr, executor = harness
+    server.create(api_object(
+        "ResourceQuota", quota_mod.QUOTA_NAME, "ml",
+        spec={"hard": {"cloud-tpu.google.com/v5e": 8}}))
+    server.create(api.new("fits", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "fits") == "Running" or None)
+    server.create(api.new("blocked", "ml", topology="v5e-8"))
+    wait_for(lambda: get_condition(server.get(api.KIND, "blocked", "ml"),
+                                   "QuotaExceeded") or None)
+    _time.sleep(1.5)  # let the backoff climb
+
+    rq = server.get("ResourceQuota", quota_mod.QUOTA_NAME, "ml")
+    rq["spec"]["hard"]["cloud-tpu.google.com/v5e"] = 16
+    t0 = _time.monotonic()
+    server.update(rq)
+    wait_for(lambda: job_phase(server, "blocked") == "Running" or None,
+             timeout=10)
+    assert _time.monotonic() - t0 < 1.5
