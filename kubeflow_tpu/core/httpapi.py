@@ -51,9 +51,16 @@ class RestAPI:
 
     def __init__(self, server: APIServer,
                  authorize: Callable[[str | None, str, str, str | None],
-                                     None] | None = None):
+                                     None] | None = None,
+                 tokens: dict[str, str] | None = None):
         self.server = server
         self.authorize = authorize
+        # static bearer tokens (kube-apiserver --token-auth-file model):
+        # token -> user.  A VALID bearer token authenticates the mapped
+        # user and takes precedence over the mesh identity header (the
+        # header is plaintext-forgeable by any local process; the token
+        # is a secret).  An invalid token authenticates nobody.
+        self.tokens = tokens or {}
 
     # -- WSGI ------------------------------------------------------------------
     def __call__(self, environ, start_response):
@@ -100,6 +107,15 @@ class RestAPI:
         if not parts or parts[0] != "apis":
             raise NotFound(f"no route {path}")
         parts = parts[1:]
+
+        if not parts and method == "GET":
+            # kind discovery (k8s API-group discovery's role): a
+            # kind-filterless watch client re-lists every kind after a
+            # reconnect instead of losing the gap.  Same authorization as
+            # a filterless watch ("*"): discovery reveals which kinds
+            # exist, nothing more.
+            self._authz(user, "list", "*", None)
+            return "200 OK", {"kinds": self.server.kinds()}
 
         version = qs.get("version", [None])[0]
         if len(parts) == 1:
@@ -165,7 +181,6 @@ class RestAPI:
         out-of-process controllers, SURVEY §1 L1).  Heartbeat lines ("{}")
         every 0.5s keep the pipe alive and surface client disconnects."""
         qs = parse_qs(environ.get("QUERY_STRING", ""))
-        user = self._user(environ)
         raw_kinds = qs.get("kinds", [None])[0]
         kinds = ([k for k in raw_kinds.split(",") if k]
                  if raw_kinds else None)
@@ -173,6 +188,7 @@ class RestAPI:
         # every requested kind must be authorized — a single-kind check
         # would let ?kinds=Allowed,Secret stream Secrets (advisor r3)
         try:
+            user = self._user(environ)  # may raise: invalid bearer token
             for kind in (kinds or ["*"]):
                 self._authz(user, "watch", kind, namespace)
         except PermissionError as e:
@@ -214,6 +230,16 @@ class RestAPI:
         return versions.to_storage(obj)
 
     def _user(self, environ) -> str | None:
+        auth = environ.get("HTTP_AUTHORIZATION", "")
+        if self.tokens and auth.startswith("Bearer "):
+            user = self.tokens.get(auth[len("Bearer "):])
+            if user is None:
+                # kube-apiserver semantics: presenting an INVALID bearer
+                # token hard-fails the request — falling through to the
+                # (plaintext-forgeable) identity header would make token
+                # auth bypassable wherever no mesh strips headers
+                raise PermissionError("invalid bearer token")
+            return user
         raw = environ.get(USERID_HEADER)
         if raw and raw.startswith(USERID_PREFIX):
             return raw[len(USERID_PREFIX):]
@@ -232,7 +258,8 @@ class RestAPI:
         return json.loads(raw or b"{}")
 
 
-def serve(app, port: int, host: str = "127.0.0.1", upgrade=None):
+def serve(app, port: int, host: str = "127.0.0.1", upgrade=None,
+          certfile: str | None = None, keyfile: str | None = None):
     """Run a WSGI app on a threading HTTP server; returns (server, thread).
 
     ``upgrade(handler) -> bool``: WSGI cannot hijack sockets, so requests
@@ -242,6 +269,11 @@ def serve(app, port: int, host: str = "127.0.0.1", upgrade=None):
     socket) and returns True if it consumed the connection (the gateway's
     WebSocket tunnel) or False to fall through to normal WSGI handling.
     Defaults to the app's own ``websocket_upgrade`` attribute when set.
+
+    ``certfile``/``keyfile`` switch the listener to TLS (the reference
+    never serves its webhook plaintext — admission-webhook
+    main.go:593-608; ``utils.tlsutil.self_signed_cert`` mints dev
+    material).  The WebSocket-upgrade path rides the same wrapped socket.
     """
     from socketserver import ThreadingMixIn
     from wsgiref.simple_server import (ServerHandler, WSGIRequestHandler,
@@ -258,6 +290,19 @@ def serve(app, port: int, host: str = "127.0.0.1", upgrade=None):
             pass
 
         def handle(self):
+            # TLS handshake happens HERE, in the per-connection worker
+            # thread — wrapping eagerly in accept() would let one idle
+            # TCP connection (a health probe, a slowloris) block the
+            # single dispatch thread and freeze the whole listener
+            conn = self.connection
+            if hasattr(conn, "do_handshake"):
+                try:
+                    conn.settimeout(10)
+                    conn.do_handshake()
+                    conn.settimeout(None)
+                except (OSError, ValueError):
+                    self.close_connection = True
+                    return
             # WSGIRequestHandler.handle, with an upgrade-interception
             # window between parse_request and the WSGI run
             self.raw_requestline = self.rfile.readline(65537)
@@ -283,6 +328,18 @@ def serve(app, port: int, host: str = "127.0.0.1", upgrade=None):
 
     httpd = make_server(host, port, app, server_class=ThreadingWSGIServer,
                         handler_class=QuietHandler)
+    if certfile:
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile, keyfile)
+        # handshake is DEFERRED to the worker thread (QuietHandler.handle)
+        # so a stalled client can't block the accept loop
+        httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True,
+                                       do_handshake_on_connect=False)
+        # wsgiref derives url_scheme from this attribute chain; setting it
+        # keeps environ['wsgi.url_scheme'] honest behind TLS
+        httpd.base_environ["HTTPS"] = "on"
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     return httpd, thread

@@ -17,10 +17,13 @@ would join the control plane).
 
 Watch resilience (the informer contract controller-runtime gets for free):
 a broken watch connection RECONNECTS with backoff, then re-LISTs the
-watched kinds and synthesizes MODIFIED events for every live object (so
-level-triggered controllers re-converge anything that changed during the
-gap) and DELETED events for objects that vanished (tracked against the
-keys this watch has seen).  The down/up state is visible: a gauge
+watched kinds (a kind-filterless watch enumerates the server's kinds via
+GET /apis discovery, so the resync never silently skips the gap) and
+synthesizes MODIFIED events for every live object (so level-triggered
+controllers re-converge anything that changed during the gap) and DELETED
+events for objects that vanished — carrying the last-seen metadata
+(labels, ownerReferences, uid) so owner/label watch-mappers can still
+derive reconcile Requests from them.  The down/up state is visible: a gauge
 (``kubeclient_watches_connected``, the count of currently-connected
 streams), a reconnect counter, and warning logs.
 
@@ -191,6 +194,11 @@ class KubeStore:
         self._req("DELETE",
                   f"/apis/{kind}/{self._ns_seg(namespace)}/{name}")
 
+    def kinds(self) -> list[str]:
+        """Kind discovery (GET /apis) — the reconnecting watch uses it to
+        re-list everything when it has no kind filter."""
+        return self._req("GET", "/apis")["kinds"]
+
     def watch(self, kinds: Iterable[str] | None = None,
               namespace: str | None = None) -> "_HttpWatch":
         w = _HttpWatch(self, kinds, namespace)
@@ -232,9 +240,13 @@ class _HttpWatch:
         self._store = store
         self._queue: queue.Queue = queue.Queue()
         self._stopped = threading.Event()
-        # keys this watch has observed alive — the baseline that lets a
-        # post-reconnect re-list synthesize DELETED for vanished objects
-        self._known: set[tuple] = set()
+        # key -> last-seen metadata for every object this watch observed
+        # alive: the baseline that lets a post-reconnect re-list
+        # synthesize DELETED for vanished objects.  Metadata (labels,
+        # ownerReferences, uid) is cached so the synthesized event carries
+        # enough for Controller.requests_for's owner mapping and
+        # label-based watch_mappers to derive a Request (ADVICE r4).
+        self._known: dict[tuple, dict] = {}
         self._resp = self._connect()  # synchronous: config errors raise
         self._connected = False
         self._mark_connected(True)
@@ -255,9 +267,12 @@ class _HttpWatch:
     def _emit(self, ev: WatchEvent) -> None:
         key = self._key(ev.object)
         if ev.type == "DELETED":
-            self._known.discard(key)
+            self._known.pop(key, None)
         else:
-            self._known.add(key)
+            md = ev.object.get("metadata", {})
+            self._known[key] = {
+                k: md[k] for k in ("namespace", "name", "uid", "labels",
+                                   "ownerReferences") if k in md}
         self._queue.put(ev)
 
     def _pump(self) -> None:
@@ -312,16 +327,24 @@ class _HttpWatch:
         WATCH_RECONNECTS.inc()
         self._mark_connected(True)
         log.info("watch stream reconnected", attempts=attempt + 1)
-        if self._kinds is None:
-            # unbounded watch: cannot enumerate every kind to re-list
-            log.warning("watch reconnected without re-list "
-                        "(no kind filter); events during the gap are lost")
-            return True
         alive: set[tuple] = set()
         try:
-            for kind in self._kinds:
-                for obj in self._store.list(kind,
-                                            namespace=self._namespace):
+            if self._kinds is None:
+                # kind-filterless watch: enumerate the server's kinds so
+                # the resync covers everything — plus any kind this watch
+                # has seen whose objects may ALL have vanished during the
+                # gap (absent from discovery, but _known needs the DELETEs)
+                relist = set(self._store.kinds())
+                relist.update(k for (k, _, _) in self._known)
+            else:
+                relist = set(self._kinds)
+            for kind in sorted(relist):
+                try:
+                    objs = self._store.list(kind,
+                                            namespace=self._namespace)
+                except NotFound:
+                    continue  # kind emptied between discovery and list
+                for obj in objs:
                     alive.add(self._key(obj))
                     self._emit(WatchEvent("MODIFIED", obj))
         except (OSError, urllib.error.URLError, NotFound):
@@ -334,11 +357,13 @@ class _HttpWatch:
             log.error("watch re-list denied; events during the gap are "
                       "lost", error=str(e))
             return True
-        for key in self._known - alive:
+        for key in set(self._known) - alive:
             kind, ns, name = key
-            self._emit(WatchEvent("DELETED", {
-                "kind": kind,
-                "metadata": {"namespace": ns, "name": name}}))
+            md = dict(self._known.get(key) or {})
+            md.setdefault("namespace", ns)
+            md.setdefault("name", name)
+            self._emit(WatchEvent("DELETED", {"kind": kind,
+                                              "metadata": md}))
         return True
 
     def next(self, timeout: float | None = None) -> WatchEvent | None:

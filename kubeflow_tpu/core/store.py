@@ -45,11 +45,16 @@ class Invalid(ValueError):
 def _jcopy(o):
     """Fast deep copy for the JSON-shaped trees the store holds (dict /
     list / immutable scalars) — ~6x cheaper than copy.deepcopy, which was
-    the store's dominant cost at 500-gang scale (profiled)."""
+    the store's dominant cost at 500-gang scale (profiled).  Tuples are
+    normalized to lists: a tuple is legal Python input to create/update
+    but returning it by reference would alias store internals (a nested
+    dict inside it escapes copy-on-read), and the WAL's JSON round-trip
+    turns tuples into lists anyway — normalizing at admission keeps the
+    in-memory shape identical to the replayed shape."""
     t = o.__class__
     if t is dict:
         return {k: _jcopy(v) for k, v in o.items()}
-    if t is list:
+    if t is list or t is tuple:
         return [_jcopy(v) for v in o]
     return o
 
@@ -122,6 +127,14 @@ class APIServer:
     def _index_put(self, key: tuple, obj: dict) -> None:
         self._kinds.setdefault(key[0], {})[key] = obj
         self._gens[key[0]] = self._gens.get(key[0], 0) + 1
+
+    def kinds(self) -> list[str]:
+        """Kinds with at least one live object — lets a kind-filterless
+        watch client re-list EVERYTHING after a reconnect instead of
+        silently losing the gap (controller-runtime informers never skip
+        resync)."""
+        with self._lock:
+            return sorted(k for k, v in self._kinds.items() if v)
 
     def generation(self, kind: str) -> int:
         """Monotonic per-kind mutation counter (bumps on create/update/

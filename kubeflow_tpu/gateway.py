@@ -7,8 +7,10 @@ This module is that gateway for the single-binary platform: it consumes the
 VirtualService objects the controllers already write and reverse-proxies
 matching requests to the backing pod.
 
-Resolution pipeline (all against the in-process store, per request — routes
-are live the instant a controller writes them):
+Resolution pipeline (all against the in-process store; the route table and
+policy index are memoized on the store's per-kind generation counters, so
+routes are live the instant a controller writes them yet cost no per-request
+scan — Envoy's compile-on-config-change route-table model):
 
 1. longest-prefix match of the request path over every VirtualService's
    ``http[].match[].uri.prefix``;
@@ -127,24 +129,18 @@ def _prefix_owned(prefix: str, vs_namespace: str | None) -> bool:
     return len(parts) >= 2 and parts[1] == (vs_namespace or "default")
 
 
-def match_route(server: APIServer, path: str) -> Route | None:
-    """Longest-prefix match over every VirtualService's http routes.
-    Only namespace-owned prefixes participate (``_prefix_owned``)."""
-    best: Route | None = None
+def _build_route_table(server: APIServer) -> dict[str, Route]:
+    """prefix -> Route over every VirtualService's http routes.  Built once
+    per VirtualService generation (``match_route`` memoizes it): Envoy
+    compiles its route table when config changes, never per request, and at
+    500 notebooks the per-request scan cost 500 object copies per proxied
+    byte-stream.  Only namespace-owned prefixes participate
+    (``_prefix_owned``); on a prefix claimed twice the first VS in (ns,
+    name) order wins, matching the old scan's tie-break."""
+    table: dict[str, Route] = {}
     for vs in server.list("VirtualService"):
         vs_ns = vs["metadata"].get("namespace")
         for http_route in vs.get("spec", {}).get("http", []):
-            prefix = None
-            for m in http_route.get("match", []):
-                p = m.get("uri", {}).get("prefix")
-                if (p and path.startswith(p)
-                        and _prefix_owned(p, vs_ns)):
-                    prefix = p
-                    break
-            if prefix is None:
-                continue
-            if best is not None and len(prefix) <= len(best.prefix):
-                continue
             routes = http_route.get("route") or []
             if not routes:
                 continue
@@ -154,17 +150,57 @@ def match_route(server: APIServer, path: str) -> Route | None:
                 timeout_s = float(str(timeout).rstrip("s"))
             except ValueError:
                 timeout_s = 300.0
-            best = Route(
-                prefix=prefix,
-                rewrite=http_route.get("rewrite", {}).get("uri", prefix),
-                dest_host=dest.get("host", ""),
-                dest_port=int(dest.get("port", {}).get("number", 80)),
-                set_headers=dict(http_route.get("headers", {})
-                                 .get("request", {}).get("set", {})),
-                timeout_s=timeout_s,
-                namespace=vs["metadata"].get("namespace"),
-            )
-    return best
+            # EVERY owned match prefix routes (a multi-match http entry
+            # serves the same destination under each of its prefixes)
+            for m in http_route.get("match", []):
+                prefix = m.get("uri", {}).get("prefix")
+                if not prefix or not _prefix_owned(prefix, vs_ns):
+                    continue
+                table.setdefault(prefix, Route(
+                    prefix=prefix,
+                    rewrite=http_route.get("rewrite", {})
+                    .get("uri", prefix),
+                    dest_host=dest.get("host", ""),
+                    dest_port=int(dest.get("port", {}).get("number", 80)),
+                    set_headers=dict(http_route.get("headers", {})
+                                     .get("request", {}).get("set", {})),
+                    timeout_s=timeout_s,
+                    namespace=vs["metadata"].get("namespace"),
+                ))
+    return table
+
+
+def match_route(server: APIServer, path: str) -> Route | None:
+    """Longest-prefix match against the memoized route table: probe every
+    truncation of ``path`` longest-first, so lookup cost is O(len(path))
+    dict hits — independent of how many VirtualServices exist.  Routes are
+    shared memo state: callers must not mutate them."""
+    table = server.memo("VirtualService", "gateway-route-table",
+                        lambda: _build_route_table(server))
+    if not table:
+        return None
+    for end in range(len(path), 0, -1):
+        route = table.get(path[:end])
+        if route is not None:
+            return route
+    return None
+
+
+def _build_policy_index(server: APIServer) -> dict:
+    """namespace -> (deny_policies, allow_policies), rebuilt once per
+    AuthorizationPolicy generation.  Actions other than DENY/ALLOW (e.g.
+    AUDIT) land in neither bucket, matching the per-request scan this
+    replaces."""
+    index: dict[str, tuple[list, list]] = {}
+    for pol in server.list("AuthorizationPolicy"):
+        ns = pol["metadata"].get("namespace")
+        action = pol.get("spec", {}).get("action", "ALLOW")
+        entry = index.setdefault(ns, ([], []))
+        if action == "DENY":
+            entry[0].append(pol)
+        elif action == "ALLOW":
+            entry[1].append(pol)
+    return index
 
 
 def authorize_ingress(server: APIServer, namespace: str | None,
@@ -179,7 +215,13 @@ def authorize_ingress(server: APIServer, namespace: str | None,
     matches everything (an explicit allow-all policy)."""
     if namespace is None:
         return True, "cluster-scoped route"
-    all_policies = server.list("AuthorizationPolicy", namespace=namespace)
+    # per-namespace (deny, allow) index, rebuilt once per
+    # AuthorizationPolicy generation instead of a full LIST-and-copy per
+    # request (memo state — treated as read-only below)
+    index = server.memo(
+        "AuthorizationPolicy", "gateway-policy-index",
+        lambda: _build_policy_index(server))
+    denies, allows = index.get(namespace, ((), ()))
 
     def rule_matches(rule: dict) -> bool:
         if rule.get("from"):
@@ -198,15 +240,11 @@ def authorize_ingress(server: APIServer, namespace: str | None,
 
     # Istio evaluates DENY before ALLOW: a matching DENY rejects
     # regardless of what any ALLOW policy says
-    for pol in all_policies:
-        if pol.get("spec", {}).get("action") != "DENY":
-            continue
+    for pol in denies:
         if any(rule_matches(r) for r in pol.get("spec", {}).get("rules",
                                                                 [])):
             return False, (f"denied by AuthorizationPolicy "
                            f"{pol['metadata']['name']}")
-    allows = [p for p in all_policies
-              if p.get("spec", {}).get("action", "ALLOW") == "ALLOW"]
     if not allows:
         return True, "no ALLOW policy (default allow)"
     for pol in allows:
@@ -365,19 +403,21 @@ class Gateway:
                     return
                 time.sleep(self.retry_delay)
         # replay the upgrade request verbatim (hop-by-hop headers INCLUDED:
-        # Connection/Upgrade are the handshake) plus the route's header set
+        # Connection/Upgrade are the handshake) plus the route's header
+        # set.  Istio 'set' semantics REPLACE a client-sent header of the
+        # same name, so client copies are dropped first — otherwise a
+        # backend that takes the first occurrence sees the client's value
+        # (unlike the HTTP path, where headers.update overwrites).
+        overridden = {n.lower() for n in backend.set_headers}
         lines = [f"{handler.command} {target} HTTP/1.1",
                  f"Host: {backend.host}:{backend.port}"]
         for name, value in handler.headers.items():
-            if name.lower() == "host":
+            if name.lower() == "host" or name.lower() in overridden:
                 continue
             lines.append(f"{name}: {value}")
         for name, value in backend.set_headers.items():
             lines.append(f"{name}: {value}")
         client = handler.connection
-        # kernel channels idle for long stretches: no read deadline; the
-        # pump ends on EOF/reset from either side
-        sock.settimeout(None)
         client.settimeout(None)
         try:
             sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
@@ -386,11 +426,42 @@ class Gateway:
             PROXIED.labels("502").inc()
             handler.send_error(502, explain="backend reset during upgrade")
             return
-        # counted once the handshake is in flight; the backend's actual
-        # status (which the pump relays verbatim) is not parsed here, so a
-        # backend-refused upgrade still counts under "101" — an accepted
-        # approximation for a blind byte tunnel
-        PROXIED.labels("101").inc()
+        # peek the backend's status line before relaying so the metric
+        # records the REAL upgrade outcome — a backend that refuses the
+        # upgrade (403/404) must not count as 101.  The handshake response
+        # is immediate, so a short deadline applies only here; the pump
+        # below runs deadline-free (kernel channels idle for long
+        # stretches).  Buffered bytes are relayed verbatim before pumping.
+        sock.settimeout(10)
+        buf = b""
+        try:
+            while b"\r\n" not in buf and len(buf) < 4096:
+                data = sock.recv(4096)
+                if not data:
+                    break
+                buf += data
+        except OSError:
+            pass
+        if not buf:
+            sock.close()
+            PROXIED.labels("502").inc()
+            handler.send_error(502, explain="backend closed during upgrade")
+            return
+        status = buf.split(b"\r\n", 1)[0].split()
+        # clamp to valid HTTP codes: the status line is tenant-pod-
+        # controlled, and an unclamped label would let a pod mint
+        # unbounded metric series (Envoy buckets protocol garbage as 502)
+        code = "502"
+        if len(status) >= 2 and status[1].isdigit() \
+                and len(status[1]) == 3 and status[1][:1] in b"12345":
+            code = status[1].decode("ascii")
+        PROXIED.labels(code).inc()
+        sock.settimeout(None)
+        try:
+            client.sendall(buf)
+        except OSError:
+            sock.close()
+            return
 
         def pump(read, peer):
             try:
@@ -497,7 +568,10 @@ class Gateway:
 
         out_headers = [(k, v) for k, v in resp.getheaders()
                        if k.lower() not in HOP_BY_HOP]
-        PROXIED.labels(str(resp.status)).inc()
+        # same label clamp as the tunnel: backend-controlled status codes
+        # outside HTTP's range must not mint unbounded metric series
+        PROXIED.labels(str(resp.status) if 100 <= resp.status <= 599
+                       else "502").inc()
         start_response(f"{resp.status} {resp.reason}", out_headers)
 
         def stream():

@@ -134,7 +134,8 @@ def dev_identity_middleware(app, email: str):
 
 
 def build_wsgi_app(server, *, secure_api: bool = True,
-                   expose_webhook: bool = False):
+                   expose_webhook: bool = False,
+                   tokens: dict[str, str] | None = None):
     """One HTTP front door: /apis (REST), /kfam (access management), plus
     whatever web apps have landed.
 
@@ -155,7 +156,8 @@ def build_wsgi_app(server, *, secure_api: bool = True,
 
     from kubeflow_tpu.gateway import Gateway
 
-    rest = RestAPI(server, authorize=rbac_authorize if secure_api else None)
+    rest = RestAPI(server, authorize=rbac_authorize if secure_api else None,
+                   tokens=tokens)
     gateway = Gateway(server)
     mounts = {"/kfam": KfamApp(server)}
     if expose_webhook:
@@ -226,6 +228,18 @@ def main(argv=None) -> int:
     parser.add_argument("--data-dir", metavar="DIR",
                         help="durable state directory (snapshot + WAL); "
                         "omit for memory-only (state dies with the process)")
+    parser.add_argument("--tls-cert", metavar="PEM",
+                        help="serve TLS with this certificate chain")
+    parser.add_argument("--tls-key", metavar="PEM",
+                        help="private key for --tls-cert")
+    parser.add_argument("--tls-self-signed", metavar="DIR",
+                        help="mint (or reuse) a self-signed cert/key under "
+                        "DIR and serve TLS with it (dev); clients pin "
+                        "DIR/tls.crt")
+    parser.add_argument("--token-file", metavar="CSV",
+                        help="static bearer tokens, 'token,user' per line "
+                        "(kube-apiserver --token-auth-file); lets agents "
+                        "authenticate without the mesh identity header")
     args = parser.parse_args(argv)
 
     log = get_logger("platform")
@@ -251,15 +265,34 @@ def main(argv=None) -> int:
         except Conflict:
             pass  # recovered from the data dir on a previous boot
     mgr.start()
-    app = build_wsgi_app(server, secure_api=not args.insecure_api)
+    tokens = None
+    if args.token_file:
+        from kubeflow_tpu.utils.tlsutil import load_token_file
+
+        tokens = load_token_file(args.token_file)
+        log.info("static bearer tokens loaded", users=len(tokens))
+    app = build_wsgi_app(server, secure_api=not args.insecure_api,
+                         tokens=tokens)
     if args.dev_identity:
         log.info("DEV MODE: injecting identity header for every request",
                  identity=args.dev_identity)
         app = dev_identity_middleware(app, args.dev_identity)
-    httpd, _ = serve(app, args.port, args.host)
-    log.info("platform ready", port=args.port, executor=args.executor)
+    certfile, keyfile = args.tls_cert, args.tls_key
+    if args.tls_self_signed:
+        if certfile or keyfile:
+            parser.error("--tls-self-signed conflicts with "
+                         "--tls-cert/--tls-key: pass one or the other")
+        from kubeflow_tpu.utils.tlsutil import self_signed_cert
+
+        certfile, keyfile = self_signed_cert(args.tls_self_signed,
+                                             hosts=(args.host, "localhost"))
+    httpd, _ = serve(app, args.port, args.host,
+                     certfile=certfile, keyfile=keyfile)
+    scheme = "https" if certfile else "http"
+    log.info("platform ready", port=args.port, executor=args.executor,
+             tls=bool(certfile))
     print(f"kubeflow-tpu platform listening on "
-          f"http://{args.host}:{args.port}", flush=True)
+          f"{scheme}://{args.host}:{args.port}", flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())

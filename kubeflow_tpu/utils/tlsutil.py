@@ -1,0 +1,91 @@
+"""Self-signed TLS material for the platform's serving surfaces.
+
+The reference never serves plaintext: the admission webhook listens on
+:4443 with TLS (admission-webhook/main.go:593-608) and the mesh wraps every
+other hop in mTLS.  This helper mints a self-signed server certificate so
+the single-binary platform can do the same out of the box — real
+deployments pass an issued cert/key pair instead.
+
+Uses the ``cryptography`` package (baked into the image); the material is
+written once and reused across restarts so clients pinning the CA file
+(``KubeStore(cafile=...)``) survive a platform bounce.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+
+DEFAULT_HOSTS = ("127.0.0.1", "localhost")
+
+
+def self_signed_cert(directory: str,
+                     hosts: tuple[str, ...] = DEFAULT_HOSTS,
+                     ) -> tuple[str, str]:
+    """Create (or reuse) ``tls.crt`` / ``tls.key`` under ``directory``.
+
+    Returns (certfile, keyfile).  The certificate is its own CA — clients
+    pin it directly (the kubeconfig ``certificate-authority`` pattern for
+    a cluster with a self-signed apiserver cert).
+    """
+    os.makedirs(directory, exist_ok=True)
+    certfile = os.path.join(directory, "tls.crt")
+    keyfile = os.path.join(directory, "tls.key")
+    if os.path.exists(certfile) and os.path.exists(keyfile):
+        return certfile, keyfile
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME,
+                                         "kubeflow-tpu-platform")])
+    alt_names: list[x509.GeneralName] = []
+    for host in hosts:
+        try:
+            alt_names.append(x509.IPAddress(ipaddress.ip_address(host)))
+        except ValueError:
+            alt_names.append(x509.DNSName(host))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.SubjectAlternativeName(alt_names),
+                       critical=False)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    # key first with owner-only mode: it must never be world-readable
+    fd = os.open(keyfile, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()))
+    with open(certfile, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    return certfile, keyfile
+
+
+def load_token_file(path: str) -> dict[str, str]:
+    """Parse a k8s-style static token file: ``token,user[,...]`` per line
+    (kube-apiserver --token-auth-file).  Returns {token: user}."""
+    tokens: dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = [p.strip() for p in line.split(",")]
+            if len(parts) >= 2 and parts[0]:
+                tokens[parts[0]] = parts[1]
+    return tokens

