@@ -10,8 +10,15 @@ and serves any requested version on read.  Same contract here:
 - a mutating hook up-converts v1beta1 writes to v1 at admission;
 - the REST layer down-converts on read when ``?version=v1beta1`` is asked.
 
-v1beta1 shapes (this platform's actual history, not the reference's):
+Historic shapes (this platform's actual history, not the reference's):
 
+  Notebook v1alpha1 — the original prototype spawner: primitive scalars
+    {image, cpuCores (float), memoryGi (int), env (["K=V"] strings),
+    workspace (bool)}.  Converts through a CHAIN: alpha -> beta -> v1 on
+    write, v1 -> beta -> alpha on read — the reference keeps three
+    Notebook versions the same way (notebook-controller/api/{v1alpha1,
+    v1beta1,v1}/notebook_types.go with conversion stubs in
+    api/v1/notebook_conversion.go).
   Notebook v1beta1  — flat spawner fields {image, cpu, memory, tpuResource,
     tpuChips, workspacePvc, env}; v1 wraps a full PodSpec in
     spec.template.spec (notebook_types.go:27-35 pattern).
@@ -276,6 +283,70 @@ def _experiment_v1_to_beta(obj: dict) -> dict:
     return obj
 
 
+# -- Notebook v1alpha1 (chained through v1beta1) ------------------------------
+
+def _notebook_alpha_to_beta(obj: dict) -> dict:
+    spec = obj.get("spec", {})
+    env = []
+    for kv in spec.get("env") or []:
+        key, _, val = str(kv).partition("=")
+        env.append({"name": key, "value": val})
+    beta: dict = {
+        "image": spec.get("image", ""),
+        "cpu": str(spec.get("cpuCores", 0.5)),
+        "memory": f"{spec.get('memoryGi', 1)}Gi",
+        "env": env,
+    }
+    if spec.get("workspace"):
+        beta["workspacePvc"] = f"workspace-{obj['metadata']['name']}"
+    obj["spec"] = beta
+    return obj
+
+
+def _notebook_beta_to_alpha(obj: dict) -> dict:
+    spec = obj.get("spec", {})
+    cpu = str(spec.get("cpu", "0.5"))
+    try:
+        cores = (float(cpu[:-1]) / 1000.0 if cpu.endswith("m")
+                 else float(cpu))
+    except ValueError:
+        cores = 0.5
+    mem = str(spec.get("memory", "1Gi"))
+    try:
+        # alpha's memoryGi is numeric, so every binary-suffix quantity is
+        # expressible — treating '512Mi' as 1Gi would silently double the
+        # request on an alpha read-modify-write round trip
+        if mem.endswith("Gi"):
+            gi = float(mem[:-2])
+        elif mem.endswith("Mi"):
+            gi = float(mem[:-2]) / 1024.0
+        elif mem.endswith("Ki"):
+            gi = float(mem[:-2]) / (1024.0 ** 2)
+        else:
+            gi = float(mem) / (1024.0 ** 3)  # plain bytes
+    except ValueError:
+        gi = 1.0
+    obj["spec"] = {
+        "image": spec.get("image", ""),
+        "cpuCores": cores,
+        "memoryGi": int(gi) if float(gi).is_integer() else gi,
+        "env": [f"{e.get('name', '')}={e.get('value', '')}"
+                for e in spec.get("env") or []],
+        "workspace": bool(spec.get("workspacePvc")),
+    }
+    return obj
+
+
+def _notebook_alpha_to_v1(obj: dict) -> dict:
+    return _notebook_beta_to_v1(_notebook_alpha_to_beta(obj))
+
+
+def _notebook_v1_to_alpha(obj: dict) -> dict:
+    return _notebook_beta_to_alpha(_notebook_v1_to_beta(obj))
+
+
+register_conversion("Notebook", "v1alpha1",
+                    _notebook_alpha_to_v1, _notebook_v1_to_alpha)
 register_conversion("Notebook", "v1beta1",
                     _notebook_beta_to_v1, _notebook_v1_to_beta)
 register_conversion("JAXJob", "v1beta1",

@@ -1,0 +1,228 @@
+"""Gateway hot-path loadtest (VERDICT r4 weak #2 / next #3).
+
+Envoy never pays a per-request route scan — its route table compiles when
+config changes.  Before round 5, this repo's gateway deep-copied every
+VirtualService on every request (~N copies per request at N notebooks) and
+LISTed every AuthorizationPolicy per request.  Round 5 memoizes both on the
+store's per-kind generation counters; this loadtest records what the front
+door actually costs at scale:
+
+- populate N VirtualServices (+ Service + Running Pod each, one shared
+  backend process) and one AuthorizationPolicy per namespace;
+- measure proxied-request latency p50/p99 under concurrency through the
+  REAL front door (httpapi.serve -> gateway -> backend socket);
+- measure WebSocket upgrade (handshake-to-101) latency the same way;
+- print one JSON line for BASELINE.md.
+
+Usage: python loadtest/load_gateway.py [N_ROUTES] [REQUESTS] [CONCURRENCY]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+
+def _start_backend() -> int:
+    """One shared echo backend standing in for every pod (the loadtest
+    measures the GATEWAY's cost, not N python processes)."""
+    import base64
+    import hashlib
+    from http.server import BaseHTTPRequestHandler
+    from socketserver import ThreadingMixIn
+    from http.server import HTTPServer
+
+    GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            if "websocket" in (self.headers.get("Upgrade") or "").lower():
+                key = self.headers.get("Sec-WebSocket-Key", "")
+                accept = base64.b64encode(hashlib.sha1(
+                    (key + GUID).encode()).digest()).decode()
+                self.wfile.write(
+                    ("HTTP/1.1 101 Switching Protocols\r\n"
+                     "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                     f"Sec-WebSocket-Accept: {accept}\r\n\r\n").encode())
+                self.close_connection = True
+                return
+            body = self.path.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    class Srv(ThreadingMixIn, HTTPServer):
+        daemon_threads = True
+
+    srv = Srv(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv.server_address[1]
+
+
+def main() -> int:
+    n_routes = int(sys.argv[1]) if len(sys.argv) > 1 else 500
+    n_requests = int(sys.argv[2]) if len(sys.argv) > 2 else 2000
+    concurrency = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+
+    from kubeflow_tpu.core import APIServer
+    from kubeflow_tpu.core.httpapi import serve
+    from kubeflow_tpu.platform import build_wsgi_app
+
+    server = APIServer()
+    backend_port = _start_backend()
+
+    t_pop = time.perf_counter()
+    for i in range(n_routes):
+        ns = f"team{i % 50}"
+        name = f"nb{i:04d}"
+        server.create({"kind": "Pod", "apiVersion": "v1",
+                       "metadata": {"name": f"{name}-0", "namespace": ns,
+                                    "labels": {"app": name}},
+                       "spec": {"containers": [{"name": name, "image": "i",
+                                                "ports": [{"containerPort":
+                                                           8888}]}]},
+                       "status": {"phase": "Running",
+                                  "podIP": "127.0.0.1",
+                                  "portMap": {"8888": backend_port}}})
+        server.create({"kind": "Service", "apiVersion": "v1",
+                       "metadata": {"name": name, "namespace": ns},
+                       "spec": {"selector": {"app": name},
+                                "ports": [{"port": 80,
+                                           "targetPort": 8888}]}})
+        server.create({"kind": "VirtualService", "apiVersion": "x",
+                       "metadata": {"name": name, "namespace": ns},
+                       "spec": {"http": [{
+                           "match": [{"uri": {"prefix":
+                                              f"/notebook/{ns}/{name}/"}}],
+                           "route": [{"destination": {
+                               "host": f"{name}.{ns}.svc",
+                               "port": {"number": 80}}}]}]}})
+    for i in range(50):
+        server.create({"kind": "AuthorizationPolicy", "apiVersion": "x",
+                       "metadata": {"name": "ns-owner-access-istio",
+                                    "namespace": f"team{i}"},
+                       "spec": {"action": "ALLOW", "rules": [
+                           {"when": [{"key": "request.headers"
+                                      "[x-goog-authenticated-user-email]",
+                                      "values": ["accounts.google.com:"
+                                                 "alice@corp.com"]}]}]}})
+    pop_s = time.perf_counter() - t_pop
+
+    app = build_wsgi_app(server, secure_api=False)
+    httpd, _ = serve(app, 0)
+    port = httpd.server_address[1]
+
+    # -- proxied HTTP latency under concurrency ------------------------------
+    latencies: list[float] = []
+    lat_lock = threading.Lock()
+    idx = iter(range(n_requests))
+    idx_lock = threading.Lock()
+
+    def worker():
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        local: list[float] = []
+        while True:
+            with idx_lock:
+                i = next(idx, None)
+            if i is None:
+                break
+            r = i % n_routes
+            ns, name = f"team{r % 50}", f"nb{r:04d}"
+            t0 = time.perf_counter()
+            try:
+                conn.request(
+                    "GET", f"/notebook/{ns}/{name}/lab/tree",
+                    headers={"X-Goog-Authenticated-User-Email":
+                             "accounts.google.com:alice@corp.com"})
+                resp = conn.getresponse()
+                body = resp.read()
+                assert resp.status == 200, resp.status
+                assert body.decode().startswith(f"/notebook/{ns}/{name}/")
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+                continue
+            local.append(time.perf_counter() - t0)
+        conn.close()
+        with lat_lock:
+            latencies.extend(local)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    http_wall = time.perf_counter() - t0
+
+    # -- WebSocket upgrade latency -------------------------------------------
+    import base64
+
+    ws_lat: list[float] = []
+    for i in range(min(200, n_routes)):
+        r = i % n_routes
+        ns, name = f"team{r % 50}", f"nb{r:04d}"
+        key = base64.b64encode(os.urandom(16)).decode()
+        t0 = time.perf_counter()
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            s.sendall((f"GET /notebook/{ns}/{name}/ws HTTP/1.1\r\n"
+                       f"Host: 127.0.0.1:{port}\r\n"
+                       "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                       f"Sec-WebSocket-Key: {key}\r\n"
+                       "Sec-WebSocket-Version: 13\r\n"
+                       "X-Goog-Authenticated-User-Email: "
+                       "accounts.google.com:alice@corp.com\r\n\r\n")
+                      .encode())
+            resp = b""
+            while b"\r\n\r\n" not in resp:
+                d = s.recv(4096)
+                if not d:
+                    break
+                resp += d
+            assert resp.startswith(b"HTTP/1.1 101"), resp[:80]
+            ws_lat.append(time.perf_counter() - t0)
+        finally:
+            s.close()
+
+    httpd.shutdown()
+
+    if not latencies or not ws_lat:
+        print("FAIL: no successful requests")
+        return 1
+
+    def pct(vals, p):
+        vals = sorted(vals)
+        return vals[min(int(len(vals) * p / 100), len(vals) - 1)]
+
+    result = {
+        "routes": n_routes,
+        "requests": len(latencies),
+        "concurrency": concurrency,
+        "populate_s": round(pop_s, 3),
+        "http_p50_ms": round(pct(latencies, 50) * 1e3, 2),
+        "http_p99_ms": round(pct(latencies, 99) * 1e3, 2),
+        "http_rps": round(len(latencies) / http_wall, 1),
+        "ws_upgrades": len(ws_lat),
+        "ws_p50_ms": round(pct(ws_lat, 50) * 1e3, 2),
+        "ws_p99_ms": round(pct(ws_lat, 99) * 1e3, 2),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

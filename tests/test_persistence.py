@@ -19,7 +19,11 @@ from kubeflow_tpu.core.store import APIServer, NotFound
 from kubeflow_tpu.platform import build_platform
 
 
-def _attach(tmp_path):
+def _attach(tmp_path, prev=None):
+    """Attach a fresh store; ``prev`` releases the old writer first (a
+    real restart's dying process drops its flock the same way)."""
+    if prev is not None:
+        persistence.detach(prev)
     server = APIServer()
     persistence.attach(server, str(tmp_path))
     return server
@@ -36,7 +40,7 @@ def test_state_survives_restart(tmp_path):
     s1.patch_status("Notebook", "nb", "team", {"readyReplicas": 1})
     nb_before = s1.get("Notebook", "nb", "team")
 
-    s2 = _attach(tmp_path)  # the restarted process
+    s2 = _attach(tmp_path, prev=s1)  # the restarted process
     assert s2.get("Profile", "alice")["spec"]["owner"]["name"] == "a@b.c"
     nb = s2.get("Notebook", "nb", "team")
     assert nb["status"] == {"readyReplicas": 1}
@@ -59,7 +63,7 @@ def test_deletes_survive_restart(tmp_path):
                "spec": {}})
     s1.delete("Notebook", "gone", "team")
 
-    s2 = _attach(tmp_path)
+    s2 = _attach(tmp_path, prev=s1)
     with pytest.raises(NotFound):
         s2.get("Notebook", "gone", "team")
     s2.get("Notebook", "kept", "team")
@@ -78,7 +82,7 @@ def test_owner_gc_state_survives(tmp_path):
                          "metadata": {"name": "own-svc", "namespace": "t"},
                          "spec": {}}, owner))
 
-    s2 = _attach(tmp_path)
+    s2 = _attach(tmp_path, prev=s1)
     s2.delete("Notebook", "own", "t")
     with pytest.raises(NotFound):
         s2.get("Service", "own-svc", "t")
@@ -93,7 +97,7 @@ def test_compaction_bounds_wal(tmp_path):
     wal = os.path.join(tmp_path, persistence.WAL)
     assert sum(1 for _ in open(wal)) == 50
 
-    _attach(tmp_path)  # restart compacts: snapshot holds all, WAL empties
+    _attach(tmp_path, prev=s1)  # restart compacts: snapshot fills, WAL empties
     assert os.path.getsize(wal) == 0
     snap = json.load(open(os.path.join(tmp_path, persistence.SNAPSHOT)))
     assert len(snap["objects"]) == 50
@@ -101,9 +105,9 @@ def test_compaction_bounds_wal(tmp_path):
 
 def test_midrun_compaction_bounds_wal(tmp_path):
     """A long-lived process under pod-status churn keeps the WAL bounded:
-    crossing the record threshold re-snapshots and truncates WITHOUT a
-    restart (etcd auto-compaction; advisor r3 found attach()-only
-    compaction could fill the data PVC)."""
+    crossing the record threshold rotates the live log and snapshots in
+    the background WITHOUT a restart (etcd auto-compaction; advisor r3
+    found attach()-only compaction could fill the data PVC)."""
     server = APIServer()
     persistence.attach(server, str(tmp_path), compact_records=40)
     server.create({"kind": "Pod", "apiVersion": "v1",
@@ -114,9 +118,43 @@ def test_midrun_compaction_bounds_wal(tmp_path):
                                               "tick": i})
     wal = os.path.join(tmp_path, persistence.WAL)
     assert sum(1 for _ in open(wal)) < 40  # bounded, not 200
-    # and nothing was lost: a fresh attach sees the latest state
-    s2 = _attach(tmp_path)
+    # and nothing was lost: a fresh attach (releasing the old writer,
+    # which waits out its background snapshot) sees the latest state
+    s2 = _attach(tmp_path, prev=server)
     assert s2.get("Pod", "p", "d")["status"]["tick"] == 199
+
+
+def test_crash_mid_compaction_recovers_from_segments(tmp_path):
+    """Every crash window of the async compaction recovers: a process
+    dying AFTER WAL rotation but BEFORE the background snapshot lands
+    leaves numbered segments + live WAL; replay order snapshot ->
+    segments (oldest first) -> live WAL reconstructs the exact state."""
+    server = APIServer()
+    persistence.attach(server, str(tmp_path), compact_records=1 << 30)
+    persister = server._journal.__self__
+    for i in range(30):
+        server.create({"kind": "ConfigMap", "apiVersion": "v1",
+                       "metadata": {"name": f"cm-{i}", "namespace": "d"},
+                       "spec": {"gen": 0}})
+    # simulate the crash: rotate twice with updates in between, write NO
+    # snapshot (the thread "died"), keep mutating the live WAL
+    persister.wal.rotate()
+    obj = server.get("ConfigMap", "cm-0", "d")
+    obj["spec"]["gen"] = 1
+    server.update(obj)
+    server.delete("ConfigMap", "cm-29", "d")
+    persister.wal.rotate()
+    obj = server.get("ConfigMap", "cm-0", "d")
+    obj["spec"]["gen"] = 2
+    server.update(obj)
+
+    s2 = _attach(tmp_path, prev=server)
+    assert s2.get("ConfigMap", "cm-0", "d")["spec"]["gen"] == 2
+    with pytest.raises(NotFound):
+        s2.get("ConfigMap", "cm-29", "d")
+    assert len(s2.list("ConfigMap", namespace="d")) == 29
+    # recovery compacted: segments gone, WAL empty, snapshot complete
+    assert persistence._wal_segments(str(tmp_path)) == []
 
 
 def test_ephemeral_log_tail_not_journaled(tmp_path):
@@ -133,7 +171,7 @@ def test_ephemeral_log_tail_not_journaled(tmp_path):
                          "logTail": ["secret log line"] * 200})
     raw = open(os.path.join(tmp_path, persistence.WAL)).read()
     assert "secret log line" not in raw
-    s2 = _attach(tmp_path)
+    s2 = _attach(tmp_path, prev=server)
     st = s2.get("Pod", "p", "d")["status"]
     assert st["phase"] == "Running" and "logTail" not in st
 
@@ -145,7 +183,7 @@ def test_torn_final_record_is_dropped(tmp_path):
     with open(os.path.join(tmp_path, persistence.WAL), "a") as f:
         f.write('{"op": "put", "obj": {"kind": "Config')  # crash mid-append
 
-    s2 = _attach(tmp_path)
+    s2 = _attach(tmp_path, prev=s1)
     s2.get("ConfigMap", "ok", "d")  # intact record recovered
 
 
@@ -197,6 +235,7 @@ def test_platform_restart_reconverges(tmp_path):
                     proc.kill()
 
     # ---- second incarnation, same data dir ----
+    persistence.detach(server)  # the dying process releases its flock
     server2, mgr2 = build_platform(executor="local")
     persistence.attach(server2, data)
     mgr2.start()
@@ -280,3 +319,43 @@ def test_pending_pod_launch_claims_node_binding():
         _time.sleep(0.05)
     for proc in [e[1] for e in a._procs.values() if e[1] is not None]:
         proc.kill()
+
+
+def test_replay_upconverts_stale_storage_versions(tmp_path):
+    """ARCHITECTURE.md storage-version policy: after a hub-version
+    upgrade, journaled records in the old version up-convert during
+    replay and the post-replay compaction rewrites the disk in the new
+    hub — simulated by hand-writing a v1beta1 record into the WAL."""
+    import json as _json
+
+    rec = {"op": "put", "obj": {
+        "apiVersion": "kubeflow-tpu.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "old", "namespace": "d",
+                     "resourceVersion": "7", "uid": "u1"},
+        "spec": {"image": "jax:v1", "cpu": "2", "memory": "4Gi"}}}
+    with open(os.path.join(tmp_path, persistence.WAL), "w") as f:
+        f.write(_json.dumps(rec) + "\n")
+
+    s = _attach(tmp_path)
+    stored = s.get("Notebook", "old", "d")
+    assert stored["apiVersion"] == "kubeflow-tpu.org/v1"
+    assert stored["spec"]["template"]["spec"]["containers"][0][
+        "image"] == "jax:v1"
+    # the compacted snapshot on disk is pure hub-version
+    snap = json.load(open(os.path.join(tmp_path, persistence.SNAPSHOT)))
+    assert snap["objects"][0]["apiVersion"] == "kubeflow-tpu.org/v1"
+
+
+def test_second_live_writer_is_refused(tmp_path):
+    """One live writer per data dir, ENFORCED (etcd's flock): an
+    abandoned writer's background snapshot thread must never clobber a
+    successor's state, so attach refuses while the flock is held and
+    succeeds after detach."""
+    s1 = _attach(tmp_path)
+    with pytest.raises(RuntimeError, match="live writer"):
+        persistence.attach(APIServer(), str(tmp_path))
+    persistence.detach(s1)
+    s2 = APIServer()
+    persistence.attach(s2, str(tmp_path))  # now admitted
+    persistence.detach(s2)
+    persistence.detach(s2)  # idempotent no-op
