@@ -11,24 +11,45 @@ for the torch-eager baseline the reference ecosystem would run.
 Robustness (VERDICT r2 #1 + ADVICE r2): the parent process NEVER initializes
 JAX — every measurement (headline included) runs in its own child process, so
 a wedged TPU tunnel can only kill one stage, a child can always acquire the
-(single-process-exclusive) TPU device, and a hung backend init is retried with
-backoff by respawning the child (same-process retry cannot work: a hung
+(single-process-exclusive) TPU device, and a hung backend init is retried by
+respawning the child (same-process retry cannot work: a hung
 ``jax.devices()`` poisons the process).  A cumulative result line is printed
 after every completed stage, headline first — a mid-run wedge still leaves the
 most recent complete JSON line on stdout for the driver:
     {"metric": ..., "value": N, "unit": "samples/sec/chip",
      "vs_baseline": N, "extra": {...}}
+
+Opportunistic design (VERDICT r4 next #2 — the relay was dead for entire
+builder sessions in r2/r3/r4 and the 3x600s init attempts burned the whole
+driver budget):
+
+- a ~10s TCP probe runs FIRST; a refusing relay costs one short (120s)
+  confirmation attempt instead of three 600s ones, so a driver retry later
+  in the round still has budget when a window opens;
+- every completed stage persists to ``bench_partial.json`` (12h TTL):
+  re-invocations skip already-measured stages and emit a cumulative result
+  immediately, so a window that closes after `headline` still yields
+  `headline` — and the NEXT window continues from `flash`;
+- ``python bench.py --stage NAME`` re-measures exactly one stage and
+  merges it into the partials.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
 
 # child exit code for "backend init hung/failed — tunnel wedge, retryable"
 RC_WEDGE = 17
+# parent exit code for "relay down per probe + confirmation attempt"
+RC_DOWN = 18
+
+PARTIALS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_partial.json")
+PARTIAL_TTL = 12 * 3600.0  # one round; stale results never leak forward
 
 
 def _log(msg: str) -> None:
@@ -312,17 +333,67 @@ def _tunnel_diagnostics() -> None:
                 _log(f"diag tcp {ip}:{port} -> {e}")
 
 
-def _backend_or_die(timeout_s: float = 600.0):
+def _tunnel_probe(timeout: float = 3.0) -> bool | None:
+    """~10s TCP reachability check against the relay pool.  True = some
+    port accepted; False = every attempt refused/timed out; None = no
+    pool IPs configured (nothing to probe — assume reachable)."""
+    import socket
+
+    ips = [i.strip() for i in
+           (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")
+           if i.strip()]
+    if not ips:
+        return None
+    for ip in ips:
+        for port in (443, 8471, 8476):
+            try:
+                with socket.create_connection((ip, port), timeout=timeout):
+                    _log(f"probe: {ip}:{port} accepts TCP")
+                    return True
+            except OSError:
+                continue
+    _log(f"probe: relay {ips} refused TCP on 443/8471/8476")
+    return False
+
+
+def _load_partials() -> dict:
+    try:
+        if time.time() - os.path.getmtime(PARTIALS) > PARTIAL_TTL:
+            _log(f"{PARTIALS} older than {PARTIAL_TTL / 3600:.0f}h; "
+                 "ignoring")
+            return {}
+        with open(PARTIALS) as f:
+            got = json.load(f)
+        if isinstance(got, dict):
+            _log(f"partials loaded: stages {sorted(got)}")
+            return got
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
+def _save_partials(partials: dict) -> None:
+    tmp = PARTIALS + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(partials, f)
+    os.replace(tmp, PARTIALS)
+
+
+def _backend_or_die(timeout_s: float | None = None):
     """Initialize the JAX backend with a watchdog.  A wedged TPU tunnel
     hangs make_c_api_client forever; exiting RC_WEDGE lets the parent
     respawn a fresh child with backoff (a hung ``jax.devices()`` poisons
     this process — same-process retry cannot recover).
 
-    The budget is 600s (back from r3's 150s): r1's successful COLD init
-    took minutes, and r3's three 150s attempts all "wedged" — a slow-not-
-    dead tunnel must be given the time it historically needed.
+    The default budget is 600s (r1's successful COLD init took minutes; a
+    slow-not-dead tunnel must get the time it historically needed), but
+    the parent shrinks it via KF_BENCH_INIT_TIMEOUT when the TCP probe
+    says the relay is refusing — confirming "down" must be cheap.
     """
     import threading
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("KF_BENCH_INIT_TIMEOUT", "600"))
 
     out: dict = {}
 
@@ -385,7 +456,8 @@ def _run_stage(name: str, timeout: float, attempts: int = 2,
     (RC_WEDGE) — the r2 failure mode where the tunnel needed a retry."""
     for attempt in range(attempts):
         try:
-            p = subprocess.run([sys.executable, __file__, "--stage", name],
+            p = subprocess.run([sys.executable, __file__,
+                                "--child-stage", name],
                                capture_output=True, text=True,
                                timeout=timeout)
         except subprocess.TimeoutExpired:
@@ -415,39 +487,116 @@ def _run_stage(name: str, timeout: float, attempts: int = 2,
     return {}
 
 
-def main() -> None:
+def _emit(partials: dict) -> bool:
+    """Print the cumulative result line from whatever stages exist.
+    Returns False when the headline is still missing (nothing emittable —
+    the driver's contract is the headline metric)."""
+    head = partials.get("headline")
+    if not head:
+        return False
+    result = {
+        "metric": "bert_large_pretrain_samples_per_sec_per_chip",
+        "value": head["value"],
+        "unit": "samples/sec/chip",
+        "vs_baseline": head["vs_baseline"],
+        "extra": {},
+    }
+    for name, rows in partials.items():
+        if name != "headline" and isinstance(rows, dict):
+            result["extra"].update(rows)
+    print(json.dumps(result), flush=True)
+    return True
+
+
+# (stage, child timeout, attempts, backoff); per-attempt budget is up to
+# the init budget + the in-child 1500s stage watchdog
+STAGE_PLAN = (("headline", 2400.0, 2, 30.0),
+              ("flash", 1500.0, 1, 0.0),
+              ("serving", 1500.0, 1, 0.0),
+              ("quant", 1800.0, 1, 0.0),
+              ("quant7b", 2100.0, 1, 0.0))
+
+
+def _confirm_init() -> bool:
+    """When the TCP probe says 'refusing', prove or refute it with ONE
+    short init-only child (the probe's port list could be wrong).  Only
+    backend init runs — no measurement — so a healthy-but-oddly-ported
+    relay is confirmed within ~150s and the full-budget loop proceeds."""
+    prior = os.environ.get("KF_BENCH_INIT_TIMEOUT")
+    os.environ["KF_BENCH_INIT_TIMEOUT"] = "120"
+    try:
+        p = subprocess.run([sys.executable, __file__, "--child-init"],
+                           capture_output=True, text=True, timeout=150)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+    finally:
+        if prior is None:
+            os.environ.pop("KF_BENCH_INIT_TIMEOUT", None)
+        else:
+            os.environ["KF_BENCH_INIT_TIMEOUT"] = prior
+
+
+def main(only_stage: str | None = None) -> None:
     # The parent deliberately never touches JAX: the TPU stays free for
     # whichever child is measuring, and a tunnel wedge can never hang the
     # orchestrator itself.
-    result = {
-        "metric": "bert_large_pretrain_samples_per_sec_per_chip",
-        "value": None,
-        "unit": "samples/sec/chip",
-        "vs_baseline": None,
-        "extra": {},
-    }
-    # per-attempt budget: up to 600s init + 1500s stage watchdog
-    head = _run_stage("headline", timeout=2400, attempts=3, backoff=30.0)
-    if not head:
-        raise SystemExit("headline measurement failed (see stderr)")
-    result["value"] = head["value"]
-    result["vs_baseline"] = head["vs_baseline"]
-    # cumulative partial emission: the headline is on stdout NOW; a wedge
-    # in any later stage still leaves a complete, parseable result line
-    print(json.dumps(result), flush=True)
+    if only_stage is not None and only_stage not in STAGES:
+        raise SystemExit(f"unknown stage {only_stage!r}; "
+                         f"stages: {sorted(STAGES)}")
+    partials = _load_partials()
+    plan = [s for s in STAGE_PLAN
+            if only_stage is None or s[0] == only_stage]
+    if only_stage and only_stage in partials:
+        # --stage forces a re-measure: drop the stale value ON DISK too,
+        # so a failed re-measure cannot silently resurrect it later
+        partials.pop(only_stage)
+        _save_partials(partials)
+    todo = [s for s in plan if s[0] not in partials]
 
-    # +600s vs r3: each child may legitimately spend the full init budget
-    # on a slow tunnel before its measurement starts
-    for name, timeout in (("flash", 1500.0), ("serving", 1500.0),
-                          ("quant", 1800.0), ("quant7b", 2100.0)):
-        rows = _run_stage(name, timeout=timeout)
+    if todo and _tunnel_probe() is False:
+        # refusing relay: one ~150s init-only confirmation instead of
+        # 3x600s, so a driver retry later in the round still has budget
+        _log("relay refusing TCP; init-only confirmation attempt")
+        if not _confirm_init():
+            _log("tunnel down; partial results "
+                 f"{sorted(partials) or 'none'} stand")
+            if _emit(partials):
+                return  # headline delivered from an earlier window
+            raise SystemExit(RC_DOWN)
+        _log("init succeeded despite refusing probe; full budget")
+
+    for name, timeout, attempts, backoff in todo:
+        rows = _run_stage(name, timeout=timeout, attempts=attempts,
+                          backoff=backoff)
         if rows:
-            result["extra"].update(rows)
-            print(json.dumps(result), flush=True)
+            partials[name] = rows
+            _save_partials(partials)
+        elif name == "headline" and only_stage is None:
+            break  # no headline, nothing emittable: stop burning budget
+        # cumulative emission: a wedge in any later stage still leaves a
+        # complete, parseable result line on stdout
+        _emit(partials)
+
+    for s in plan:
+        if s[0] in partials and s[0] not in [t[0] for t in todo]:
+            _log(f"stage '{s[0]}' reused from partials")
+    emitted = _emit(partials)
+    if only_stage is not None:
+        # single-stage contract: the requested stage, not the headline
+        if only_stage not in partials:
+            raise SystemExit(f"stage {only_stage!r} failed (see stderr)")
+    elif not emitted:
+        raise SystemExit("headline measurement failed (see stderr)")
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 2 and sys.argv[1] in ("--stage", "--extra"):
+    if len(sys.argv) > 2 and sys.argv[1] == "--child-stage":
         _stage_entry(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child-init":
+        _backend_or_die()
+        print("{}", flush=True)
+    elif len(sys.argv) > 2 and sys.argv[1] == "--stage":
+        main(only_stage=sys.argv[2])
     else:
         main()
