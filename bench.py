@@ -561,7 +561,13 @@ def main(only_stage: str | None = None) -> None:
         if not _confirm_init():
             _log("tunnel down; partial results "
                  f"{sorted(partials) or 'none'} stand")
-            if _emit(partials):
+            emitted = _emit(partials)
+            if only_stage is not None:
+                # the caller asked for THIS stage; a cached headline is
+                # not success (and its stale value is already dropped)
+                raise SystemExit(
+                    f"stage {only_stage!r} not measured: tunnel down")
+            if emitted:
                 return  # headline delivered from an earlier window
             raise SystemExit(RC_DOWN)
         _log("init succeeded despite refusing probe; full budget")

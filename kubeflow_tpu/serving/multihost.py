@@ -1,0 +1,238 @@
+"""Multi-host sharded serving: SPMD decode across processes.
+
+The north star is "JAX inference on slices" (BASELINE.json; the KServe hook
+at profile_controller.go:70): a v5e-32 slice spans 8 hosts, so a predictor
+for a model bigger than one host's HBM must shard weights and KV cache over
+a GLOBAL mesh — tp within a host (contiguous local devices, all-reduces on
+ICI), dp across hosts (weight replicas, independent request rows).
+
+Process model (jax SPMD): every process in the serving gang joins the same
+``jax.distributed`` rendezvous as a training gang would
+(``parallel/distributed.py`` — the JAXJob controller injects the identical
+env), builds the same global mesh, and executes the same compiled decode
+program in lockstep.  The engine's continuous batcher cannot drive that
+lockstep (its admissions happen on a background thread whose timing differs
+per process), so the multi-host path is the SYNCHRONOUS batch API: all
+processes must present identical prompts to each ``generate`` call — a
+rank-0 HTTP front door gets them there with ``broadcast_prompts`` (one
+all-ranks collective per batch).  Per-host continuous batching remains the
+single-process engine's job; slice-wide serving batches at the request tier.
+
+Everything here is deterministic across ranks by construction: params
+init from one seed (or one checkpoint), greedy or fixed-seed sampling,
+no data-dependent control flow outside the compiled program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger("serving.multihost")
+
+# decode-batch rows ride dp (one replica per host group); KV heads ride tp
+CACHE_SPEC = P("dp", None, "tp", None)
+
+
+def global_serving_mesh(tp: int, dp: int = 1, ep: int = 1) -> Mesh:
+    """A dp x tp (x ep) mesh over the GLOBAL device list.  Axis order
+    puts tp minor, so tp groups land on contiguous (same-host) devices
+    and its per-layer all-reduces stay on ICI; dp splits across hosts
+    where only independent rows travel."""
+    from kubeflow_tpu.parallel import make_mesh
+
+    n = tp * dp * ep
+    devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(f"dp={dp} x tp={tp} x ep={ep} needs {n} devices,"
+                         f" have {len(devices)} globally")
+    return make_mesh(n, dp=dp, fsdp=1, tp=tp, sp=1, ep=ep,
+                     devices=devices[:n])
+
+
+def place_global(tree, specs, mesh: Mesh):
+    """Place a HOST-replicated tree onto a global mesh: every process
+    holds the same host values (same seed / same checkpoint) and
+    contributes exactly its addressable shards.  ``jax.device_put`` can't
+    span processes; ``make_array_from_callback`` is the multi-host way.
+    QTensor q/scale placement is ``sharded.place_params``'s one rule."""
+    from kubeflow_tpu.serving.sharded import place_params
+
+    def put(x, sharding):
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+
+    return place_params(tree, specs, mesh, put)
+
+
+def constrain_cache(cache, mesh: Mesh):
+    """Pin a KV cache's 4-d leaves to ``CACHE_SPEC`` (rows over dp, KV
+    heads over tp — the memory win that makes slice-wide contexts fit);
+    index vectors and scalars stay replicated.  Used inside the compiled
+    decode; works eagerly too, which is how the test asserts the layout."""
+    return jax.tree_util.tree_map(
+        lambda x: (jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, CACHE_SPEC))
+            if getattr(x, "ndim", 0) == 4 else x), cache)
+
+
+def broadcast_prompts(prompts: list[list[int]] | None,
+                      max_items: int = 64,
+                      max_len: int = 4096) -> list[list[int]]:
+    """Get rank 0's prompts to every rank (the front-door fan-out): ranks
+    other than 0 pass None.  Encodes to a fixed-size int32 buffer and
+    rides ``broadcast_one_to_all`` so the collective shape is identical
+    on every rank."""
+    from jax.experimental import multihost_utils
+
+    buf = np.full((max_items, max_len + 1), -1, np.int32)
+    if jax.process_index() == 0:
+        if prompts is None:
+            raise ValueError("rank 0 must supply prompts")
+        if len(prompts) > max_items:
+            raise ValueError(f"{len(prompts)} prompts > {max_items}")
+        for i, p in enumerate(prompts):
+            if len(p) > max_len:
+                raise ValueError(f"prompt {i} longer than {max_len}")
+            buf[i, 0] = len(p)
+            buf[i, 1:1 + len(p)] = p
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    got: list[list[int]] = []
+    for row in out:
+        n = int(row[0])
+        if n < 0:
+            break
+        got.append([int(t) for t in row[1:1 + n]])
+    return got
+
+
+class MultiHostPredictor:
+    """Synchronous sharded text generation over a global dp x tp mesh.
+
+    Single-process with a local mesh this degenerates to plain sharded
+    decode (the CI-reference path); in a gang every rank constructs it
+    with the same arguments and calls ``generate`` with the same prompts
+    (see ``broadcast_prompts``)."""
+
+    def __init__(self, model_name: str = "llama", size: str = "tiny",
+                 tp: int = 1, dp: int = 1, ep: int = 1,
+                 max_seq: int = 128, seed: int = 0,
+                 quantize: bool = False,
+                 model_config: dict | None = None):
+        from kubeflow_tpu.models import registry
+        from kubeflow_tpu.parallel.sharding import unbox_params
+        from kubeflow_tpu.serving import sharded
+
+        entry = registry.get(model_name)
+        self.module = entry.make_model(size=size, **(model_config or {}))
+        self.cfg = self.module.config
+        self.max_seq = min(max_seq, self.cfg.max_seq_len)
+        self.mesh = global_serving_mesh(tp, dp=dp, ep=ep)
+        self.dp, self.tp = dp, tp
+        if self.cfg.num_kv_heads % tp != 0:
+            raise ValueError(f"num_kv_heads={self.cfg.num_kv_heads} "
+                             f"not divisible by tp={tp}")
+        rng = jax.random.PRNGKey(seed)
+        example = jnp.zeros((1, 8), jnp.int32)
+        # identical on every rank: same seed -> same threefry stream
+        with jax.default_device(jax.local_devices()[0]):
+            params = unbox_params(
+                self.module.init(rng, example)["params"])
+            params = jax.tree_util.tree_map(np.asarray, params)
+        specs = sharded.param_specs(self.module, rng, example)
+        if quantize:
+            from kubeflow_tpu.serving.quant import quantize_params
+
+            params = quantize_params(params)
+        self.params = place_global(params, specs, self.mesh)
+        self._gen_cache: dict = {}
+        log.info("multi-host predictor ready",
+                 processes=jax.process_count(),
+                 devices=len(self.mesh.devices.ravel()),
+                 dp=dp, tp=tp, ep=ep)
+
+    # -- compiled decode ------------------------------------------------------
+    def _gen_fn(self, batch: int, pad_len: int, max_new: int):
+        key = (batch, pad_len, max_new)
+        if key in self._gen_cache:
+            return self._gen_cache[key]
+        from kubeflow_tpu.models import llama as llama_mod
+
+        mesh, cfg = self.mesh, self.cfg
+        max_len = min(self.max_seq, pad_len + max_new)
+        rep = NamedSharding(mesh, P())
+        row = NamedSharding(mesh, P("dp"))
+
+        def fn(params, ids, last_pos):
+            # prefill the whole padded batch; per-row index = prompt len
+            cache0 = llama_mod.init_cache(cfg, batch, max_len=max_len,
+                                          per_sequence=True)
+            cache0 = constrain_cache(cache0, mesh)
+            out = self.module.apply({"params": params}, ids, cache=cache0)
+            first = jnp.argmax(
+                out["logits"][jnp.arange(batch), last_pos], axis=-1)
+            kv = {"layers": [{"k": l["k"], "v": l["v"]}
+                             for l in out["cache"]["layers"]]}
+
+            def body(carry, _):
+                tok, kv, index = carry
+                full = {"layers": [dict(l, index=index)
+                                   for l in kv["layers"]]}
+                step = self.module.apply({"params": params}, tok[:, None],
+                                         cache=full)
+                nxt = jnp.argmax(step["logits"][:, 0], axis=-1)
+                kv = {"layers": [{"k": l["k"], "v": l["v"]}
+                                 for l in step["cache"]["layers"]]}
+                return (nxt, kv, index + 1), nxt
+
+            (_, _, _), toks = jax.lax.scan(
+                body, (first, kv, last_pos + 1), None, length=max_new - 1)
+            # [B, max_new], fully replicated so every rank reads them
+            return jnp.concatenate([first[:, None], toks.T], axis=1)
+
+        jitted = jax.jit(
+            fn,
+            in_shardings=(None, row, row),   # params keep their shardings
+            out_shardings=rep)
+        self._gen_cache[key] = jitted
+        return jitted
+
+    def generate(self, prompts: list[list[int]],
+                 max_new_tokens: int = 16) -> list[list[int]]:
+        """Greedy decode; every rank must pass identical prompts.  Rows
+        pad up to a dp multiple (XLA requires whole arrays; pad rows are
+        dropped from the result)."""
+        if not prompts:
+            return []
+        if any(not p for p in prompts):
+            raise ValueError("empty prompt")
+        batch = len(prompts)
+        padded_b = -(-batch // self.dp) * self.dp
+        pad_len = max(len(p) for p in prompts)
+        if pad_len + max_new_tokens > self.max_seq:
+            # same contract as ContinuousBatcher.submit: refusing beats
+            # clamped cache writes silently corrupting the decode
+            raise ValueError(
+                f"prompt+new ({pad_len + max_new_tokens}) > max_seq "
+                f"{self.max_seq}")
+        ids = np.zeros((padded_b, pad_len), np.int32)
+        last = np.zeros((padded_b,), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = p
+            last[i] = len(p) - 1
+        row = NamedSharding(self.mesh, P("dp"))
+        gids = jax.make_array_from_callback(
+            ids.shape, row, lambda idx: ids[idx])
+        glast = jax.make_array_from_callback(
+            last.shape, row, lambda idx: last[idx])
+        toks = self._gen_fn(padded_b, pad_len, max_new_tokens)(
+            self.params, gids, glast)
+        toks = np.asarray(toks)
+        return [list(prompts[i]) + [int(t) for t in toks[i]]
+                for i in range(batch)]

@@ -70,23 +70,32 @@ def _scale_spec(spec: P, scale_shape: tuple) -> P:
                for i, ax in enumerate(spec)))
 
 
-def shard_params(params, specs, mesh: Mesh):
-    """device_put a (possibly quantized) params tree onto ``mesh`` per the
-    spec tree.  QTensor nodes shard q by the kernel's spec and scale by
-    the broadcast-aware variant."""
+def place_params(params, specs, mesh: Mesh, put):
+    """Map a (possibly quantized) params tree onto ``mesh`` per the spec
+    tree with a pluggable placement primitive ``put(leaf, sharding)``.
+    QTensor nodes shard q by the kernel's spec and scale by the
+    broadcast-aware variant — the ONE place that rule lives (the
+    single-process path device_puts; the multi-host path provides its
+    addressable shards via make_array_from_callback)."""
     def place(spec, leaf):
         if isinstance(leaf, QTensor):
             return QTensor(
-                jax.device_put(leaf.q, NamedSharding(mesh, spec)),
-                jax.device_put(leaf.scale, NamedSharding(
+                put(leaf.q, NamedSharding(mesh, spec)),
+                put(leaf.scale, NamedSharding(
                     mesh, _scale_spec(spec, leaf.scale.shape))))
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return put(leaf, NamedSharding(mesh, spec))
 
     # specs lead the map (their P leaves align with params' QTensor
     # subtrees via flatten_up_to); P is a tuple, so mark it as a leaf
     return jax.tree_util.tree_map(
         place, specs, params,
         is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params, specs, mesh: Mesh):
+    """device_put a (possibly quantized) params tree onto ``mesh`` per
+    the spec tree (single-process placement)."""
+    return place_params(params, specs, mesh, jax.device_put)
 
 
 def shard_cache(cache, mesh: Mesh, num_kv_heads: int):
