@@ -39,15 +39,20 @@ class FakeExecutor(Controller):
     def __init__(self, server, *, fail_once: set[str] | None = None,
                  always_fail: set[str] | None = None,
                  complete: bool = True, run_for: float = 0.0,
-                 metrics_script: dict[str, list[dict]] | None = None):
+                 metrics_script: dict[str, list[dict]] | None = None,
+                 metrics_all: list[dict] | None = None):
         super().__init__(server)
         self.fail_once = set(fail_once or ())
         self.always_fail = set(always_fail or ())
         # pod name -> metrics dicts surfaced one per reconcile while
         # Running (deterministic stand-in for the LocalExecutor's log
-        # scraping; exercises intermediate-metric consumers)
+        # scraping; exercises intermediate-metric consumers).
+        # metrics_all: the same script applied to EVERY pod without an
+        # explicit entry (generated pod names — HPO trials — can't be
+        # pre-keyed)
         self.metrics_script = {k: list(v)
                                for k, v in (metrics_script or {}).items()}
+        self.metrics_all = list(metrics_all or [])
         # complete=False models long-running servers (notebooks,
         # tensorboards): pods stay Running instead of finishing
         self.complete = complete
@@ -66,13 +71,21 @@ class FakeExecutor(Controller):
             return None  # not released yet
         phase = pod.get("status", {}).get("phase", "Pending")
         if phase == "Pending":
+            # mirror the LocalExecutor's pod-status surface: a rolling
+            # logTail rides status so log consumers (the UI's per-worker
+            # Logs pane, the contract test) see the same shape either way
             self.server.patch_status("Pod", req.name, req.namespace,
                                      {**pod.get("status", {}),
-                                      "phase": "Running"})
+                                      "phase": "Running",
+                                      "nodeName": "fake-node",
+                                      "logTail": [f"{req.name}: started "
+                                                  "(fake executor)"]})
             return Result(requeue_after=0.01)
         if phase == "Running":
             name = req.name
             script = self.metrics_script.get(name)
+            if script is None and self.metrics_all:
+                script = self.metrics_script[name] = list(self.metrics_all)
             if script:
                 self.server.patch_status(
                     "Pod", req.name, req.namespace,

@@ -43,6 +43,8 @@ DEFAULT_LINKS = {
          "icon": "timeline"},
         {"type": "item", "link": "/models/", "text": "Models (Serving)",
          "icon": "extension"},
+        {"type": "item", "link": "/pipelines/", "text": "Pipelines",
+         "icon": "device-hub"},
     ],
     "externalLinks": [],
     "quickLinks": [

@@ -88,6 +88,7 @@
     const items = [
       { text: "Home", view: "home" },
       ...state.links.menuLinks.map((l) => ({ text: l.text, link: l.link })),
+      { text: "Resource Usage", view: "metrics" },
       { text: "Manage Contributors", view: "contributors" },
     ];
     return items.map((item) => el("a", {
@@ -123,6 +124,7 @@
   function render() {
     const viewNode = state.view === "home" ? homeView()
       : state.view === "contributors" ? contributorsView()
+      : state.view === "metrics" ? metricsView()
       : el("iframe", { src: state.iframeSrc +
           (state.iframeSrc.includes("?") ? "&" : "?") + "ns=" + state.ns });
     root.replaceChildren(el("div", { class: "shell" },
@@ -239,6 +241,87 @@
       el("p", { class: "muted" },
         nsRole ? `You are ${nsRole.role} of namespace ${state.ns}.` : ""),
       cards);
+  }
+
+  /* -------------- resource usage (resource-chart view) -------------- */
+
+  const svgEl = KF.svgEl;
+
+  /* axis chart: the resource-chart component — min/max/last labels,
+   * gridlines, time span footer.  The plot area delegates to the shared
+   * polyline normalizer; a <g> transform offsets it past the axis. */
+  function axisChart(points, w, h) {
+    if (!points.length) {
+      return el("div", { class: "muted" }, "no samples in this interval");
+    }
+    const vals = points.map((p) => p.value);
+    const min = Math.min(...vals);
+    const max = Math.max(...vals);
+    const span = (max - min) || 1;
+    const PAD = { l: 44, r: 8, t: 8, b: 18 };
+    const iw = w - PAD.l - PAD.r;
+    const ih = h - PAD.t - PAD.b;
+    const svg = svgEl("svg", { width: w, height: h,
+      class: "axis-chart" });
+    for (const frac of [0, 0.5, 1]) {
+      const y = PAD.t + ih * (1 - frac);
+      svg.append(svgEl("line", { x1: PAD.l, y1: y, x2: w - PAD.r, y2: y,
+        class: "grid" }));
+      const label = svgEl("text", { x: PAD.l - 4, y: y + 4,
+        "text-anchor": "end", class: "axis-label" });
+      label.textContent = (min + span * frac).toFixed(2);
+      svg.append(label);
+    }
+    const g = svgEl("g", {
+      transform: `translate(${PAD.l}, ${PAD.t})` });
+    g.append(svgEl("polyline", {
+      points: KF.polylinePoints(vals, iw, ih, 0), fill: "none",
+      class: "series" }));
+    svg.append(g);
+    const t0 = points[0].timestamp;
+    const t1 = points[points.length - 1].timestamp;
+    const foot = svgEl("text", { x: PAD.l, y: h - 4,
+      class: "axis-label" });
+    foot.textContent = `${age(t0)} ago → ${age(t1)} ago · last ` +
+      `${vals[vals.length - 1].toFixed(3)}`;
+    svg.append(foot);
+    return svg;
+  }
+
+  const METRIC_TYPES = [["tpuduty", "TPU duty cycle (%)"],
+                        ["podcpu", "Pod CPU (cores)"],
+                        ["podmem", "Pod memory (bytes)"],
+                        ["node", "Node CPU (%)"]];
+
+  function metricsView() {
+    const interval = el("select", null,
+      ["Last5m", "Last15m", "Last30m", "Last60m", "Last180m"].map((i) =>
+        el("option", { value: i, selected: i === "Last15m" ? "" : null },
+          i)));
+    const grid = el("div", { class: "cards", id: "metrics-grid" });
+
+    async function draw() {
+      grid.replaceChildren();
+      for (const [mtype, title] of METRIC_TYPES) {
+        const card = el("div", { class: "card wide",
+          dataset: { metric: mtype } },
+          el("h2", null, title), el("div", { class: "muted" }, "…"));
+        grid.append(card);
+        api.get(`/dashboard/api/metrics/${mtype}` +
+                `?interval=${interval.value}`)
+          .then((series) => {
+            card.replaceChildren(el("h2", null, title),
+              axisChart(series, 440, 160));
+          }).catch((e) => card.append(errorBox(e.message)));
+      }
+    }
+    interval.addEventListener("change", draw);
+    draw();
+    return el("div", { class: "kf-content", id: "resource-usage" },
+      el("h1", null, "Resource usage"),
+      el("div", { class: "row", style: "display:flex;gap:8px;" },
+        el("label", null, "Interval:"), interval),
+      grid);
   }
 
   /* -------------- contributors (manage-users-view) -------------- */

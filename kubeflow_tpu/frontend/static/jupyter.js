@@ -94,23 +94,8 @@
         : "No logs yet (container starting, or a runtime without " +
           "log capture).");
 
-    const panes = { Overview: overview, Events: evTable, Logs: logPane,
-      YAML: yaml };
-    const body = el("div", { class: "kf-details" });
-    const tabs = el("div", { class: "kf-tabs" },
-      Object.keys(panes).map((t, i) => el("a", {
-        href: "#", class: i === 0 ? "active" : null,
-        onclick: (ev) => {
-          ev.preventDefault();
-          tabs.querySelectorAll("a").forEach((a) =>
-            a.classList.remove("active"));
-          ev.target.classList.add("active");
-          body.replaceChildren(panes[t]);
-        } }, t)));
-    body.append(overview);
-    const dlg = KF.dialog(`Notebook ${name}`,
-      el("div", null, tabs, body),
-      [el("button", { onclick: () => dlg.close() }, "Close")]);
+    KF.detailDialog(`Notebook ${name}`,
+      { Overview: overview, Events: evTable, Logs: logPane, YAML: yaml });
   }
 
   const tbl = table({

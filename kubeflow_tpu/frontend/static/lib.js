@@ -165,6 +165,50 @@
     return el("div", { class: "kf-error" }, message);
   }
 
+  /* ---- shared detail-dialog + SVG plumbing (one copy; every app's
+   * detail views and charts build on these) ---- */
+
+  function detailDialog(title, panes) {
+    const body = el("div", { class: "kf-details" });
+    const tabs = el("div", { class: "kf-tabs" },
+      Object.keys(panes).map((t, i) => el("a", {
+        href: "#", class: i === 0 ? "active" : null,
+        onclick: (ev) => {
+          ev.preventDefault();
+          tabs.querySelectorAll("a").forEach((a) =>
+            a.classList.remove("active"));
+          ev.target.classList.add("active");
+          body.replaceChildren(panes[t]);
+        } }, t)));
+    body.append(Object.values(panes)[0]);
+    const dlg = dialog(title, el("div", null, tabs, body),
+      [el("button", { onclick: () => dlg.close() }, "Close")]);
+    return dlg;
+  }
+
+  const SVG_NS = "http://www.w3.org/2000/svg";
+  function svgEl(tag, attrs) {
+    const node = document.createElementNS(SVG_NS, tag);
+    for (const [k, v] of Object.entries(attrs || {})) {
+      node.setAttribute(k, v);
+    }
+    return node;
+  }
+
+  /* values -> "x,y x,y ..." polyline points normalized into the box
+   * (pad keeps the stroke inside); span==0 draws a centered flat line */
+  function polylinePoints(values, w, h, pad) {
+    pad = pad === undefined ? 2 : pad;
+    const min = Math.min(...values);
+    const max = Math.max(...values);
+    const span = (max - min) || 1;
+    const n = Math.max(1, values.length - 1);
+    return values.map((v, i) =>
+      `${(i / n) * (w - 2 * pad) + pad},` +
+      `${h - pad - ((v - min) / span) * (h - 2 * pad)}`).join(" ");
+  }
+
   window.KF = { el, api, statusIcon, poll, table, dialog, confirmDialog,
-                snack, ns, age, errorBox };
+                snack, ns, age, errorBox, detailDialog, svgEl,
+                polylinePoints };
 })();

@@ -1,7 +1,17 @@
-/* Generic resource tables over the raw /apis REST facade — serves the
- * JAXJobs / Experiments / Models menu entries (TPU-native additions with
- * no reference counterpart; kind + columns configured by the page's
- * data-kind attribute). */
+/* Generic resource tables + detail views over the raw /apis REST facade —
+ * serves the JAXJobs / Experiments / Models / Pipelines menu entries
+ * (TPU-native additions; the reference's analogs live in the
+ * training-operator / Katib / Pipelines UIs).  Kind + columns configured
+ * by the page's data-kind attribute.
+ *
+ * Detail views (VERDICT r4 #1):
+ *   JAXJob      Workers | Logs (per-worker status.logTail) | Config
+ *               (topology / mesh / rendezvous env) | Events | Result | YAML
+ *   Experiment  Trials (per-trial metric curve, drill-down) | History |
+ *               Best trial | Events | YAML
+ *   PipelineRun DAG (step graph, phase-colored) | Steps (status/outputs/
+ *               logs) | Events | YAML
+ */
 (function () {
   "use strict";
   const { el, api, table, confirmDialog, ns, age, errorBox } = KF;
@@ -16,10 +26,15 @@
     return;
   }
 
+  /* ---------------- shared helpers ---------------- */
+
+  const muted = (t) => el("span", { class: "muted" }, t);
+
   function phaseIcon(obj) {
     const phase = (obj.status && obj.status.phase) || "Pending";
     const map = { Succeeded: "ready", Running: "ready", Pending: "waiting",
-      Restarting: "warning", Failed: "error", Completed: "ready" };
+      Restarting: "warning", Failed: "error", Completed: "ready",
+      EarlyStopped: "stopped", Skipped: "warning" };
     return KF.statusIcon({ phase: map[phase] || "waiting",
       message: blockingCondition(obj) || phase });
   }
@@ -43,30 +58,99 @@
             class: "empty" }, emptyMsg))));
   }
 
-  function detailDialog(title, panes) {
-    const body = el("div", { class: "kf-details" });
-    const tabs = el("div", { class: "kf-tabs" },
-      Object.keys(panes).map((t, i) => el("a", {
-        href: "#", class: i === 0 ? "active" : null,
-        onclick: (ev) => {
-          ev.preventDefault();
-          tabs.querySelectorAll("a").forEach((a) =>
-            a.classList.remove("active"));
-          ev.target.classList.add("active");
-          body.replaceChildren(panes[t]);
-        } }, t)));
-    body.append(Object.values(panes)[0]);
-    const dlg = KF.dialog(title, el("div", null, tabs, body),
-      [el("button", { onclick: () => dlg.close() }, "Close")]);
+  const detailDialog = KF.detailDialog;
+
+  function yamlPane(obj) {
+    return el("pre", { class: "kf-yaml" }, JSON.stringify(obj, null, 2));
   }
 
-  /* JAXJob detail: per-worker pod status — the training operator's
-   * "replica statuses" view, from the gang's pods. */
+  function kvList(pairs) {
+    const dl = el("dl", { class: "kf-overview" });
+    for (const [k, v] of pairs) {
+      dl.append(el("dt", null, k), el("dd", null, v));
+    }
+    return dl;
+  }
+
+  const svgEl = KF.svgEl;
+
+  /* tiny line chart (resource-chart equivalent): values -> polyline */
+  function sparkSVG(values, w, h, cls) {
+    if (!values || !values.length) return muted("—");
+    const min = Math.min(...values);
+    const max = Math.max(...values);
+    const svg = svgEl("svg", { width: w, height: h,
+      class: "spark-svg " + (cls || "") });
+    // an SVG <title> CHILD is the hover tooltip (an attribute is not)
+    const tip = svgEl("title", {});
+    tip.textContent = `${values.length} samples, min ${min.toFixed(3)},` +
+      ` max ${max.toFixed(3)}`;
+    svg.append(tip, svgEl("polyline", {
+      points: KF.polylinePoints(values, w, h), fill: "none" }));
+    return svg;
+  }
+
+  /* Events recorded against one object (the per-resource activity feed;
+   * the jupyter app has the same tab via its backend route) */
+  async function eventsPane(forKind, name) {
+    const all = (await api.get(`/apis/Event?namespace=${namespace}`)).items;
+    const mine = all.filter((e) => {
+      const io = e.spec.involvedObject || {};
+      return io.name === name && io.kind === forKind;
+    });
+    mine.sort((a, b) =>
+      (b.spec.lastTimestamp || 0) - (a.spec.lastTimestamp || 0));
+    return simpleTable(["Type", "Reason", "Count", "Message", "Age"],
+      mine.map((e) => el("tr", null,
+        el("td", null, e.spec.type || ""),
+        el("td", null, e.spec.reason || ""),
+        el("td", null, String(e.spec.count || 1)),
+        el("td", null, e.spec.message || ""),
+        el("td", null, age(e.spec.lastTimestamp)))),
+      "No events recorded for this object.");
+  }
+
+  /* per-pod log viewer over status.logTail (the executor's rolling
+   * stdout/stderr mirror — LocalExecutor flushes it ~1/s) */
+  function podLogsPane(podNames) {
+    if (!podNames.length) {
+      return muted("No pods (gang not admitted, or already cleaned up).");
+    }
+    const sel = el("select", null, podNames.map((p) =>
+      el("option", { value: p }, p)));
+    const pre = el("pre", { class: "kf-yaml kf-logs" }, "…");
+    async function refresh() {
+      try {
+        const p = await api.get(`/apis/Pod/${namespace}/${sel.value}`);
+        const lines = (p.status && p.status.logTail) || [];
+        pre.textContent = lines.length ? lines.join("\n")
+          : "No log lines yet (container starting, or a runtime " +
+            "without log capture).";
+      } catch (e) {
+        pre.textContent = `Pod ${sel.value} is gone (${e.message}) — ` +
+          "logs are not retained after pod deletion.";
+      }
+    }
+    sel.addEventListener("change", refresh);
+    refresh();
+    return el("div", null,
+      el("div", { class: "row", style: "display:flex;gap:8px;" },
+        sel, el("button", { class: "icon", title: "Refresh",
+          onclick: refresh }, "⟳")),
+      pre);
+  }
+
+  /* ---------------- JAXJob detail ---------------- */
+
   async function openJAXJobDetails(o) {
     const name = o.metadata.name;
-    const pods = (await api.get(
-      `/apis/Pod?namespace=${namespace}&labelSelector=jaxjob=${name}`))
-      .items;
+    // independent fetches in parallel: dialog opens in one RTT, not two
+    const [podsOut, events] = await Promise.all([
+      api.get(`/apis/Pod?namespace=${namespace}` +
+              `&labelSelector=jaxjob=${name}`),
+      eventsPane("JAXJob", name),
+    ]);
+    const pods = podsOut.items;
     pods.sort((a, b) =>
       Number(a.metadata.labels["jaxjob-worker-index"] || 0) -
       Number(b.metadata.labels["jaxjob-worker-index"] || 0));
@@ -76,52 +160,296 @@
       el("td", null, (p.status && p.status.phase) || "Pending"),
       el("td", null, (p.spec.schedulingGates || []).length
         ? "gated" : "released"),
+      el("td", null, (p.status && p.status.nodeName) || muted("—")),
       el("td", null, p.status && p.status.metrics
         ? `step ${p.status.metrics.step ?? "—"}, loss ` +
           `${p.status.metrics.loss ?? "—"}`
-        : el("span", { class: "muted" }, "—"))));
+        : muted("—"))));
     const workers = simpleTable(
-      ["#", "Pod", "Phase", "Gate", "Live metrics"], workerRows,
+      ["#", "Pod", "Phase", "Gate", "Node", "Live metrics"], workerRows,
       "No worker pods (gang not admitted yet).");
-    const result = el("pre", { class: "kf-yaml" },
-      JSON.stringify(o.status && o.status.result || null, null, 2));
-    const yaml = el("pre", { class: "kf-yaml" },
-      JSON.stringify(o, null, 2));
-    detailDialog(`JAXJob ${name}`,
-      { Workers: workers, Result: result, YAML: yaml });
+
+    /* Config: the sharded-training shape of this job — topology, mesh
+     * axes, and the rendezvous contract actually injected into pod 0
+     * (JAXJOB_COORDINATOR / NUM_PROCESSES / PROCESS_ID env) */
+    const mesh = o.spec.parallelism || {};
+    const rdvRows = [];
+    if (pods.length) {
+      const env = ((pods[0].spec.containers || [])[0] || {}).env || [];
+      for (const e of env) {
+        if ((e.name || "").startsWith("JAXJOB_")) {
+          rdvRows.push([e.name, el("code", null, e.value)]);
+        }
+      }
+    }
+    const config = kvList([
+      ["Topology", (o.spec.numSlices > 1
+        ? `${o.spec.numSlices} × ` : "") + o.spec.topology],
+      ["Mesh axes", el("code", null, Object.keys(mesh).length
+        ? Object.entries(mesh).map(([k, v]) => `${k}=${v}`).join(" ")
+        : "dp over all chips (default)")],
+      ["Trainer", el("code", null,
+        JSON.stringify(o.spec.trainer || {}))],
+      ["Image", o.spec.image || ""],
+      ["Max restarts", String(o.spec.maxRestarts ?? 3)],
+      ["Restarts so far", String((o.status && o.status.restarts) || 0)],
+      ...(rdvRows.length ? rdvRows
+        : [["Rendezvous", muted("no pods to read the injected env from")]]),
+    ]);
+
+    detailDialog(`JAXJob ${name}`, {
+      Workers: workers,
+      Logs: podLogsPane(pods.map((p) => p.metadata.name)),
+      Config: config,
+      Events: events,
+      Result: yamlPane((o.status && o.status.result) || null),
+      YAML: yamlPane(o),
+    });
   }
 
-  /* Experiment detail: trial table + best trial — the Katib experiment
-   * page's trials view. */
+  /* ---------------- Experiment detail ---------------- */
+
+  function trialCurve(t) {
+    const inter = (t.status && t.status.intermediate) || [];
+    return sparkSVG(inter.map((p) => p.value), 120, 26, "trial-curve");
+  }
+
+  function openTrialDetails(t) {
+    const inter = (t.status && t.status.intermediate) || [];
+    const interRows = inter.map((p) => el("tr", null,
+      el("td", null, String(p.step)),
+      el("td", null, String(p.value))));
+    detailDialog(`Trial ${t.metadata.name}`, {
+      Overview: kvList([
+        ["Phase", (t.status && t.status.phase) || "Pending"],
+        ["Assignment", el("code", null,
+          JSON.stringify(t.spec.assignment || {}))],
+        ["Objective", t.status && t.status.objective !== undefined &&
+            t.status.objective !== null
+          ? String(t.status.objective) : muted("—")],
+        ["Stopped at step", t.status && t.status.stoppedAtStep
+          ? String(t.status.stoppedAtStep)
+          : muted("— (ran to completion)")],
+        ["Metric curve", sparkSVG(inter.map((p) => p.value), 240, 48,
+          "trial-curve")],
+      ]),
+      Observations: simpleTable(["Step", "Value"], interRows,
+        "No intermediate observations (trial never reported metrics)."),
+      YAML: yamlPane(t),
+    });
+  }
+
   async function openExperimentDetails(o) {
     const name = o.metadata.name;
-    const trials = (await api.get(`/apis/Trial?namespace=${namespace}`))
-      .items.filter((t) => t.spec.experiment === name);
+    const [trialsOut, events] = await Promise.all([
+      api.get(`/apis/Trial?namespace=${namespace}`),
+      eventsPane("Experiment", name),
+    ]);
+    const trials = trialsOut.items
+      .filter((t) => t.spec.experiment === name);
     const best = o.status && o.status.bestTrial;
     const trialRows = trials.map((t) => {
       const isBest = best && JSON.stringify(best.assignment) ===
         JSON.stringify(t.spec.assignment);
       return el("tr", { class: isBest ? "best-trial" : null },
-        el("td", null, t.metadata.name + (isBest ? " ★" : "")),
+        el("td", null, el("a", { href: "#", class: "name-link",
+          onclick: (ev) => { ev.preventDefault(); openTrialDetails(t); } },
+          t.metadata.name + (isBest ? " ★" : ""))),
         el("td", null, (t.status && t.status.phase) || "Pending"),
         el("td", null, JSON.stringify(t.spec.assignment || {})),
-        el("td", null, t.status && t.status.objective !== undefined
-          ? String(t.status.objective)
-          : el("span", { class: "muted" }, "—")));
+        el("td", null, t.status && t.status.objective !== undefined &&
+            t.status.objective !== null
+          ? String(t.status.objective) : muted("—")),
+        el("td", null, trialCurve(t)));
     });
     const trialTable = simpleTable(
-      ["Trial", "Phase", "Assignment", "Objective"], trialRows,
+      ["Trial", "Phase", "Assignment", "Objective", "Curve"], trialRows,
       "No trials yet.");
-    const bestPane = el("pre", { class: "kf-yaml" },
-      JSON.stringify(best || null, null, 2));
-    const yaml = el("pre", { class: "kf-yaml" },
-      JSON.stringify(o, null, 2));
-    detailDialog(`Experiment ${name}`,
-      { Trials: trialTable, "Best trial": bestPane, YAML: yaml });
+
+    /* optimization history: objective per finished trial, in creation
+     * order (the Katib experiment-page chart) */
+    const finished = trials
+      .filter((t) => t.status && t.status.objective !== undefined &&
+        t.status.objective !== null)
+      .sort((a, b) => (a.metadata.creationTimestamp || 0) -
+                      (b.metadata.creationTimestamp || 0));
+    const history = el("div", null,
+      el("p", { class: "muted" },
+        `${finished.length} trials with a final objective ` +
+        `(${o.spec.objective ? o.spec.objective.type : "?"} ` +
+        `${o.spec.objective ? o.spec.objective.metric : ""})`),
+      sparkSVG(finished.map((t) => t.status.objective), 420, 120,
+        "history-chart"));
+
+    detailDialog(`Experiment ${name}`, {
+      Trials: trialTable,
+      History: history,
+      "Best trial": yamlPane(best || null),
+      Events: events,
+      YAML: yamlPane(o),
+    });
   }
 
+  /* ---------------- PipelineRun detail ---------------- */
+
+  const STEP_REF = /\{\{steps\.([A-Za-z0-9_-]+)\.outputs\./g;
+
+  function stepEdges(steps) {
+    /* control edges (depends) + data edges ({{steps.X.outputs.K}} refs
+     * in run argv / env values) — the same two sources the controller
+     * orders the DAG by (api/pipeline.py) */
+    const edges = [];
+    for (const s of steps) {
+      const from = new Set(s.depends || []);
+      const text = JSON.stringify([s.run || [], s.env || {}]);
+      let m;
+      while ((m = STEP_REF.exec(text)) !== null) from.add(m[1]);
+      for (const f of from) edges.push([f, s.name]);
+    }
+    return edges;
+  }
+
+  function dagPane(run) {
+    const steps = run.spec.steps || [];
+    const statuses = (run.status && run.status.steps) || {};
+    const edges = stepEdges(steps);
+    const depthOf = {};
+    function depth(name, seen) {
+      if (name in depthOf) return depthOf[name];
+      if (seen.has(name)) return 0; // cycle guard: render flat
+      seen.add(name);
+      const parents = edges.filter(([, to]) => to === name)
+        .map(([from]) => from);
+      const d = parents.length
+        ? 1 + Math.max(...parents.map((p) => depth(p, seen))) : 0;
+      depthOf[name] = d;
+      return d;
+    }
+    steps.forEach((s) => depth(s.name, new Set()));
+    const layers = [];
+    for (const s of steps) {
+      (layers[depthOf[s.name]] = layers[depthOf[s.name]] || []).push(s);
+    }
+    const BW = 150, BH = 38, GX = 60, GY = 18;
+    const pos = {};
+    layers.forEach((layer, li) => layer.forEach((s, si) => {
+      pos[s.name] = { x: 10 + li * (BW + GX), y: 10 + si * (BH + GY) };
+    }));
+    const w = 20 + layers.length * (BW + GX) - GX;
+    const h = 20 + Math.max(...layers.map((l) => l.length), 1) *
+      (BH + GY) - GY;
+    const svg = svgEl("svg", { width: w, height: h, class: "kf-dag" });
+    for (const [from, to] of edges) {
+      const a = pos[from];
+      const b = pos[to];
+      if (!a || !b) continue;
+      const x1 = a.x + BW;
+      const y1 = a.y + BH / 2;
+      const x2 = b.x;
+      const y2 = b.y + BH / 2;
+      svg.append(svgEl("path", { class: "dag-edge",
+        d: `M ${x1} ${y1} C ${x1 + GX / 2} ${y1} ` +
+           `${x2 - GX / 2} ${y2} ${x2} ${y2}`, fill: "none" }));
+    }
+    for (const s of steps) {
+      const p = pos[s.name];
+      const st = statuses[s.name] || { phase: "Pending" };
+      const g = svgEl("g", { class: "dag-node dag-" + st.phase });
+      g.append(svgEl("rect", { x: p.x, y: p.y, width: BW, height: BH,
+        rx: 6 }));
+      const label = svgEl("text", { x: p.x + BW / 2, y: p.y + 16,
+        "text-anchor": "middle" });
+      label.textContent = s.name;
+      const phase = svgEl("text", { x: p.x + BW / 2, y: p.y + 31,
+        "text-anchor": "middle", class: "dag-phase" });
+      phase.textContent = st.phase;
+      g.append(label, phase);
+      svg.append(g);
+    }
+    return el("div", { class: "kf-dag-wrap" }, svg);
+  }
+
+  function stepsPane(run) {
+    const steps = run.spec.steps || [];
+    const statuses = (run.status && run.status.steps) || {};
+    const rows = steps.map((s) => {
+      const st = statuses[s.name] || { phase: "Pending" };
+      const logsBtn = st.podName
+        ? el("button", { class: "icon", title: "Logs",
+            onclick: () => {
+              const dlg = KF.dialog(`Logs — step ${s.name}`,
+                podLogsPane([st.podName]),
+                [el("button", { onclick: () => dlg.close() }, "Close")]);
+            } }, "📜")
+        : muted("—");
+      return el("tr", null,
+        el("td", null, s.name),
+        el("td", null, st.phase || "Pending"),
+        el("td", null, st.podName || muted("—")),
+        el("td", null, st.outputs
+          ? el("code", null, JSON.stringify(st.outputs)) : muted("—")),
+        el("td", null, (s.depends || []).join(", ") || muted("—")),
+        el("td", null, logsBtn));
+    });
+    return simpleTable(
+      ["Step", "Phase", "Pod", "Outputs", "Depends", "Logs"], rows,
+      "Pipeline has no steps.");
+  }
+
+  async function openPipelineRunDetails(o) {
+    detailDialog(`PipelineRun ${o.metadata.name}`, {
+      DAG: dagPane(o),
+      Steps: stepsPane(o),
+      Events: await eventsPane("PipelineRun", o.metadata.name),
+      YAML: yamlPane(o),
+    });
+  }
+
+  /* ---------------- InferenceService detail ---------------- */
+
+  async function openInferenceServiceDetails(o) {
+    const name = o.metadata.name;
+    const p = o.spec.predictor || {};
+    const [podsOut, events] = await Promise.all([
+      api.get(`/apis/Pod?namespace=${namespace}` +
+              `&labelSelector=isvc=${name}`),
+      eventsPane("InferenceService", name),
+    ]);
+    const pods = podsOut.items;
+    const podRows = pods.map((pod) => el("tr", null,
+      el("td", null, pod.metadata.name),
+      el("td", null, (pod.status && pod.status.phase) || "Pending"),
+      el("td", null, (pod.status && pod.status.nodeName) || muted("—"))));
+    const ready = o.status && o.status.ready;
+    const url = (o.status && o.status.url) || `/serving/${namespace}/` +
+      `${name}/`;
+    const overview = kvList([
+      ["Ready", KF.statusIcon({ phase: ready ? "ready" : "waiting" })],
+      ["Model", `${p.model || ""} (${p.size || "?"})`],
+      ["Topology", p.topology || "v5e-4"],
+      ["Min replicas", String(p.minReplicas || 1)],
+      ["Quantization", p.quantize ? "int8 weight-only" : "bf16"],
+      ["URL", el("code", null, url)],
+      ["Sample request", el("pre", { class: "kf-yaml" },
+        `curl -X POST '${url}v1/models/${p.model || "llama"}:generate'` +
+        ` \\\n  -H 'Content-Type: application/json' \\\n` +
+        `  -d '{"ids": [[1, 2, 3]], "max_new_tokens": 16}'`)],
+    ]);
+    detailDialog(`InferenceService ${name}`, {
+      Overview: overview,
+      Predictors: simpleTable(["Pod", "Phase", "Node"], podRows,
+        "No predictor pods yet."),
+      Events: events,
+      YAML: yamlPane(o),
+    });
+  }
+
+  /* ---------------- tables ---------------- */
+
   const DETAILS = { JAXJob: openJAXJobDetails,
-    Experiment: openExperimentDetails };
+    Experiment: openExperimentDetails,
+    PipelineRun: openPipelineRunDetails,
+    InferenceService: openInferenceServiceDetails };
 
   function nameCell(o) {
     const open = DETAILS[kind];
@@ -129,6 +457,14 @@
     return el("a", { href: "#", class: "name-link",
       onclick: (ev) => { ev.preventDefault();
         open(o).catch((e) => KF.snack(e.message)); } }, o.metadata.name);
+  }
+
+  function stepProgress(o) {
+    const statuses = (o.status && o.status.steps) || {};
+    const phases = Object.values(statuses).map((s) => s.phase);
+    if (!phases.length) return muted("—");
+    const done = phases.filter((p) => p === "Succeeded").length;
+    return `${done}/${phases.length}`;
   }
 
   const COLUMNS = {
@@ -144,7 +480,7 @@
       { title: "Restarts", render: (o) =>
           String((o.status && o.status.restarts) || 0) },
       { title: "Why waiting", render: (o) => blockingCondition(o) ||
-          el("span", { class: "muted" }, "—") },
+          muted("—") },
     ],
     Experiment: [
       { title: "Status", render: phaseIcon },
@@ -157,16 +493,25 @@
       { title: "Best", render: (o) => {
           const best = o.status && o.status.bestTrial;
           if (!best || best.objective === undefined) {
-            return el("span", { class: "muted" }, "—");
+            return muted("—");
           }
           const v = best.objective;
           return String(v.toFixed ? v.toFixed(4) : v);
         } },
     ],
+    PipelineRun: [
+      { title: "Status", render: phaseIcon },
+      { title: "Name", render: nameCell },
+      { title: "Phase", render: (o) =>
+          (o.status && o.status.phase) || "Pending" },
+      { title: "Steps", render: stepProgress },
+      { title: "Workspace", render: (o) =>
+          o.spec.workspace ? "shared PVC" : muted("—") },
+    ],
     InferenceService: [
       { title: "Status", render: (o) => KF.statusIcon({
           phase: o.status && o.status.ready ? "ready" : "waiting" }) },
-      { title: "Name", render: (o) => o.metadata.name },
+      { title: "Name", render: nameCell },
       /* the predictor payload lives under spec.predictor
        * (api/inferenceservice.py) — reading spec.model rendered a blank
        * Model column for every service (caught by the field-contract
@@ -179,9 +524,225 @@
           (o.spec.predictor || {}).topology || "" },
       { title: "URL", render: (o) => o.status && o.status.url
           ? el("code", null, o.status.url)
-          : el("span", { class: "muted" }, "—") },
+          : muted("—") },
     ],
   };
+
+  /* ---------------- submission forms ---------------- */
+
+  const appBase = "/" + location.pathname.split("/")[1];
+
+  function formField(label, input, hint) {
+    const f = el("div", { class: "field" },
+      el("label", null, label), input);
+    if (hint) f.append(el("div", { class: "hint" }, hint));
+    return f;
+  }
+
+  function optionSelect(options, value) {
+    const s = el("select", null, options.map((o) =>
+      el("option", { value: o, selected: o === value ? "" : null }, o)));
+    if (value !== undefined) s.value = value;
+    return s;
+  }
+
+  function submitDialog(title, form, build, refresh) {
+    const err = form.querySelector(".form-err");
+    const create = el("button", { class: "primary", onclick: async () => {
+      create.disabled = true;
+      err.replaceChildren();
+      try {
+        await api.post(`/apis/${kind}`, build());
+        dlg.close();
+        refresh();
+        KF.snack(`${kind} created`);
+      } catch (e) {
+        err.replaceChildren(errorBox(e.message));
+        create.disabled = false;
+      }
+    } }, "Create");
+    const dlg = KF.dialog(title, form, [
+      el("button", { onclick: () => dlg.close() }, "Cancel"), create]);
+  }
+
+  async function openJAXJobForm(refresh) {
+    const cfg = (await api.get(`${appBase}/api/config`)).config;
+    const name = el("input", { type: "text", placeholder: "my-train" });
+    const topology = optionSelect(cfg.topologies, "v5e-8");
+    const numSlices = el("input", { type: "number", value: "1",
+      min: "1" });
+    const model = optionSelect(cfg.models, "bert");
+    const steps = el("input", { type: "number", value: "100", min: "1" });
+    const axes = {};
+    const axisRow = el("div", { class: "row" },
+      ["dp", "fsdp", "tp", "sp"].map((ax) => {
+        axes[ax] = el("input", { type: "number", min: "1",
+          placeholder: "auto", style: "width:70px" });
+        return formField(ax, axes[ax]);
+      }));
+    const maxRestarts = el("input", { type: "number", value: "3",
+      min: "0" });
+    const form = el("div", { class: "kf-form" },
+      el("div", { class: "form-err" }),
+      formField("Name", name),
+      el("div", { class: "row" },
+        formField("Topology", topology,
+          "TPU slice type; one pod per slice host"),
+        formField("Slices", numSlices, "multislice: dp across DCN")),
+      el("div", { class: "row" },
+        formField("Model", model), formField("Steps", steps)),
+      formField("Mesh axes", axisRow,
+        "blank = platform default (dp over all chips); the product " +
+        "must equal total chips"),
+      formField("Max restarts", maxRestarts,
+        "gang restarts on worker failure before Failed"));
+    submitDialog("New JAXJob", form, () => {
+      const spec = {
+        topology: topology.value,
+        numSlices: Number(numSlices.value) || 1,
+        trainer: { model: model.value,
+                   steps: Number(steps.value) || 100 },
+        maxRestarts: Number(maxRestarts.value) || 0,
+      };
+      const parallelism = {};
+      for (const [ax, input] of Object.entries(axes)) {
+        if (input.value) parallelism[ax] = Number(input.value);
+      }
+      if (Object.keys(parallelism).length) {
+        spec.parallelism = parallelism;
+      }
+      return { apiVersion: "kubeflow.org/v1", kind: "JAXJob",
+        metadata: { name: name.value.trim(), namespace }, spec };
+    }, refresh);
+  }
+
+  async function openExperimentForm(refresh) {
+    const cfg = (await api.get(`${appBase}/api/config`)).config;
+    const name = el("input", { type: "text", placeholder: "my-sweep" });
+    const metric = el("input", { type: "text", value: "final_loss" });
+    const goal = optionSelect(["minimize", "maximize"], "minimize");
+    const algorithm = optionSelect(cfg.algorithms, "random");
+    const parallel = el("input", { type: "number", value: "2", min: "1" });
+    const maxTrials = el("input", { type: "number", value: "8",
+      min: "1" });
+    const topology = optionSelect(cfg.topologies, "v5e-8");
+    const model = optionSelect(cfg.models, "mlp");
+
+    /* search-space rows: {name, type, min/max or values} */
+    const paramRows = [];
+    const paramList = el("div");
+    function addParam() {
+      const pname = el("input", { type: "text", placeholder: "lr",
+        style: "width:90px" });
+      const ptype = optionSelect(["double", "int", "categorical"],
+        "double");
+      const pmin = el("input", { type: "text", placeholder: "min",
+        style: "width:70px" });
+      const pmax = el("input", { type: "text", placeholder: "max",
+        style: "width:70px" });
+      const pvals = el("input", { type: "text",
+        placeholder: "a,b,c (categorical)", style: "width:130px" });
+      const row = el("div", { class: "row param" },
+        pname, ptype, pmin, pmax, pvals,
+        el("button", { class: "icon danger", title: "Remove",
+          onclick: () => { paramRows.splice(paramRows.indexOf(entry), 1);
+                           row.remove(); } }, "✕"));
+      const entry = { pname, ptype, pmin, pmax, pvals };
+      paramRows.push(entry);
+      paramList.append(row);
+    }
+    addParam();
+    const form = el("div", { class: "kf-form" },
+      el("div", { class: "form-err" }),
+      formField("Name", name),
+      el("div", { class: "row" },
+        formField("Objective metric", metric), formField("Goal", goal),
+        formField("Algorithm", algorithm)),
+      formField("Search space", el("div", null, paramList,
+        el("button", { class: "icon", onclick: addParam },
+          "+ add parameter")),
+        "double/int use min+max; categorical uses the value list"),
+      el("div", { class: "row" },
+        formField("Parallel trials", parallel),
+        formField("Max trials", maxTrials)),
+      el("div", { class: "row" },
+        formField("Trial topology", topology),
+        formField("Trial model", model)));
+    submitDialog("New Experiment", form, () => {
+      const parameters = paramRows.map((r) => {
+        const p = { name: r.pname.value.trim(), type: r.ptype.value };
+        if (p.type === "categorical") {
+          p.values = r.pvals.value.split(",").map((v) => v.trim())
+            .filter(Boolean);
+          if (!p.values.length) {
+            throw new Error(`parameter "${p.name}": categorical needs ` +
+              "a value list");
+          }
+        } else {
+          // blank would Number() to 0 and pass server validation as a
+          // degenerate one-point space — reject it here instead
+          if (r.pmin.value.trim() === "" || r.pmax.value.trim() === "" ||
+              Number.isNaN(Number(r.pmin.value)) ||
+              Number.isNaN(Number(r.pmax.value))) {
+            throw new Error(`parameter "${p.name}": numeric min and ` +
+              "max are required");
+          }
+          p.min = Number(r.pmin.value);
+          p.max = Number(r.pmax.value);
+        }
+        return p;
+      });
+      return { apiVersion: "kubeflow.org/v1", kind: "Experiment",
+        metadata: { name: name.value.trim(), namespace },
+        spec: {
+          objective: { type: goal.value, metric: metric.value.trim() },
+          algorithm: { name: algorithm.value },
+          parameters,
+          trialTemplate: { topology: topology.value,
+                           trainer: { model: model.value } },
+          parallelTrials: Number(parallel.value) || 1,
+          maxTrials: Number(maxTrials.value) || 1,
+        } };
+    }, refresh);
+  }
+
+  async function openPipelineRunForm(refresh) {
+    const name = el("input", { type: "text", placeholder: "my-run" });
+    const workspace = el("input", { type: "checkbox" });
+    const stepsJson = el("textarea", { rows: "10",
+      style: "width:100%;font-family:monospace" });
+    // the example must really run: a declared output has to appear in
+    // the step's last JSON stdout line or the controller fails the step
+    stepsJson.value = JSON.stringify([
+      { name: "train",
+        run: ["python", "-c",
+              "print('{\"final_loss\": 0.1}')"],
+        outputs: ["final_loss"] },
+      { name: "eval",
+        run: ["python", "-c",
+              "print('{{steps.train.outputs.final_loss}}')"],
+        depends: ["train"] },
+    ], null, 2);
+    const form = el("div", { class: "kf-form" },
+      el("div", { class: "form-err" }),
+      formField("Name", name),
+      formField("Steps", stepsJson,
+        "JSON list of {name, run, depends?, outputs?, env?}; " +
+        "{{steps.X.outputs.K}} references pass data and imply order"),
+      formField("Workspace",
+        el("label", null, workspace,
+          " shared PVC mounted at /workspace in every step")));
+    submitDialog("New PipelineRun", form, () => {
+      const spec = { steps: JSON.parse(stepsJson.value) };
+      if (workspace.checked) spec.workspace = true;
+      return { apiVersion: "kubeflow.org/v1", kind: "PipelineRun",
+        metadata: { name: name.value.trim(), namespace }, spec };
+    }, refresh);
+  }
+
+  const CREATE = { JAXJob: openJAXJobForm,
+    Experiment: openExperimentForm,
+    PipelineRun: openPipelineRunForm };
 
   const columns = [...(COLUMNS[kind] || [
     { title: "Name", render: (o) => o.metadata.name },
@@ -203,10 +764,15 @@
     empty: `No ${title.toLowerCase()} in this namespace.`,
   });
 
-  root.append(
-    el("div", { class: "kf-toolbar" },
-      el("h1", null, title),
-      el("span", { class: "muted" }, `namespace: ${namespace}`),
-      el("span", { class: "spacer" })),
-    el("div", { class: "kf-content" }, tbl));
+  const toolbar = el("div", { class: "kf-toolbar" },
+    el("h1", null, title),
+    el("span", { class: "muted" }, `namespace: ${namespace}`),
+    el("span", { class: "spacer" }));
+  const openForm = CREATE[kind];
+  if (openForm) {
+    toolbar.append(el("button", { class: "primary", id: "new-resource",
+      onclick: () => openForm(() => tbl.refresh())
+        .catch((e) => KF.snack(e.message)) }, `+ New ${kind}`));
+  }
+  root.append(toolbar, el("div", { class: "kf-content" }, tbl));
 })();

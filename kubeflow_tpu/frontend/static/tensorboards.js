@@ -1,7 +1,8 @@
 /* Tensorboards web app (reference: crud-web-apps/tensorboards/frontend). */
 (function () {
   "use strict";
-  const { el, api, statusIcon, table, confirmDialog, ns, errorBox } = KF;
+  const { el, api, statusIcon, table, confirmDialog, ns, age,
+          errorBox } = KF;
   const root = document.getElementById("app");
   const namespace = ns();
   const base = `/tensorboards/api/namespaces/${namespace}`;
@@ -12,10 +13,65 @@
     return;
   }
 
+  /* client-side mirror of api/tensorboard.parse_logspath — the grammar
+   * the detail view explains to the user */
+  function describeLogspath(p) {
+    if (!p) return "—";
+    if (p.startsWith("pvc://")) {
+      const rest = p.slice("pvc://".length);
+      const claim = rest.split("/")[0];
+      const sub = rest.slice(claim.length + 1);
+      return `volume "${claim}"` + (sub ? ` at subpath "${sub}"` : "") +
+        " mounted read-only into the tensorboard pod";
+    }
+    if (p.startsWith("gs://") || p.startsWith("s3://") ||
+        p.startsWith("/cns/")) {
+      return "cloud object storage, read with the namespace's " +
+        "storage credentials";
+    }
+    return "local path inside the tensorboard container";
+  }
+
+  /* detail view: Overview | Conditions | YAML (the tensorboard app's
+   * details page) */
+  async function openDetails(name) {
+    const out = await api.get(`${base}/tensorboards/${name}`);
+    const t = out.tensorboard;
+    const raw = t.raw;
+    const overview = el("dl", { class: "kf-overview" },
+      el("dt", null, "Status"), el("dd", null, statusIcon(t.status), " ",
+        t.status.message || ""),
+      el("dt", null, "Logspath"),
+      el("dd", null, el("code", null, t.logspath)),
+      el("dt", null, "Meaning"), el("dd", null,
+        describeLogspath(t.logspath)),
+      el("dt", null, "URL"), el("dd", null, el("code", null, t.url)),
+      el("dt", null, "Created"), el("dd", null,
+        age(raw.metadata.creationTimestamp) + " ago"));
+    const conds = (raw.status && raw.status.conditions) || [];
+    const condTable = el("table", { class: "kf-table" },
+      el("thead", null, el("tr", null, ["Type", "Status", "Message"]
+        .map((h) => el("th", null, h)))),
+      el("tbody", null, conds.length
+        ? conds.map((c) => el("tr", null,
+            el("td", null, c.type || ""),
+            el("td", null, c.status || ""),
+            el("td", null, c.message || "")))
+        : el("tr", null, el("td", { colspan: "3", class: "empty" },
+            "No conditions reported yet."))));
+    const yaml = el("pre", { class: "kf-yaml" },
+      JSON.stringify(raw, null, 2));
+    KF.detailDialog(`Tensorboard ${name}`,
+      { Overview: overview, Conditions: condTable, YAML: yaml });
+  }
+
   const tbl = table({
     columns: [
       { title: "Status", render: (t) => statusIcon(t.status) },
-      { title: "Name", render: (t) => t.name },
+      { title: "Name", render: (t) => el("a", { href: "#",
+          class: "name-link", onclick: (ev) => { ev.preventDefault();
+            openDetails(t.name).catch((e) => KF.snack(e.message)); } },
+          t.name) },
       { title: "Logspath", render: (t) => el("code", null, t.logspath) },
       { title: "Connect", render: (t) => t.status.phase === "ready"
           ? el("a", { class: "connect", href: t.url, target: "_blank" },

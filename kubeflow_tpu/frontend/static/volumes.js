@@ -13,10 +13,38 @@
     return;
   }
 
+  /* detail view: Overview | YAML (the volumes app's details page) */
+  async function openDetails(name) {
+    const out = await api.get(`${base}/pvcs/${name}`);
+    const p = out.pvc;
+    const raw = p.raw;
+    const overview = el("dl", { class: "kf-overview" },
+      el("dt", null, "Status"), el("dd", null, statusIcon(p.status), " ",
+        p.status.message || ""),
+      el("dt", null, "Size"), el("dd", null, p.size || "—"),
+      el("dt", null, "Access modes"),
+      el("dd", null, (p.modes || []).join(", ") || "—"),
+      el("dt", null, "Storage class"),
+      el("dd", null, p.class || "default"),
+      el("dt", null, "Used by"), el("dd", null,
+        (p.usedBy || []).length ? p.usedBy.join(", ")
+          : el("span", { class: "muted" },
+              "no pod mounts this volume (safe to delete)")),
+      el("dt", null, "Created"), el("dd", null,
+        KF.age(raw.metadata.creationTimestamp) + " ago"));
+    const yaml = el("pre", { class: "kf-yaml" },
+      JSON.stringify(raw, null, 2));
+    KF.detailDialog(`Volume ${name}`,
+      { Overview: overview, YAML: yaml });
+  }
+
   const tbl = table({
     columns: [
       { title: "Status", render: (p) => statusIcon(p.status) },
-      { title: "Name", render: (p) => p.name },
+      { title: "Name", render: (p) => el("a", { href: "#",
+          class: "name-link", onclick: (ev) => { ev.preventDefault();
+            openDetails(p.name).catch((e) => KF.snack(e.message)); } },
+          p.name) },
       { title: "Size", render: (p) => p.size || "" },
       { title: "Access modes", render: (p) => (p.modes || []).join(", ") },
       { title: "Storage class", render: (p) =>

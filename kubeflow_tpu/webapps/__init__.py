@@ -16,6 +16,7 @@ def mount_all(server) -> dict:
         make_experiments_ui,
         make_jaxjobs_ui,
         make_models_ui,
+        make_pipelines_ui,
     )
     from kubeflow_tpu.webapps.tensorboards import TensorboardsApp
     from kubeflow_tpu.webapps.volumes import VolumesApp
@@ -27,5 +28,6 @@ def mount_all(server) -> dict:
         "/jaxjobs": make_jaxjobs_ui(server),
         "/experiments": make_experiments_ui(server),
         "/models": make_models_ui(server),
+        "/pipelines": make_pipelines_ui(server),
         "/static": StaticApp(),
     }

@@ -1,12 +1,26 @@
 """Thin UI mounts for resources served by the raw /apis REST facade:
-JAXJobs, Experiments (HPO), Models (InferenceServices).  Each serves only
-the HTML shell; the generic resources.js table drives /apis directly
-(authz enforced there per request)."""
+JAXJobs, Experiments (HPO), Models (InferenceServices), Pipeline Runs.
+Each serves the HTML shell plus one ``/api/config`` route (the submission
+forms' option lists — valid topologies, HPO algorithms, registry models);
+the generic resources.js table drives /apis directly (authz enforced
+there per request)."""
 
 from __future__ import annotations
 
 from kubeflow_tpu.frontend import attach_index
 from kubeflow_tpu.webapps.crud_backend import CrudApp
+
+
+def _form_config() -> dict:
+    from kubeflow_tpu.hpo.suggestion import ALGORITHMS
+    from kubeflow_tpu.models import registry
+    from kubeflow_tpu.parallel.mesh import TOPOLOGIES
+
+    return {
+        "topologies": sorted(TOPOLOGIES),
+        "algorithms": sorted(ALGORITHMS),
+        "models": registry.names(),
+    }
 
 
 def _ui_app(prefix: str, title: str, kind: str):
@@ -18,6 +32,8 @@ def _ui_app(prefix: str, title: str, kind: str):
 
     def init(server):
         app = ResourceUI(server)
+        app.add_route("GET", "/api/config",
+                      lambda req: ("200 OK", {"config": _form_config()}))
         attach_index(app, title, "resources.js",
                      data={"kind": kind, "title": title})
         return app
@@ -28,3 +44,4 @@ def _ui_app(prefix: str, title: str, kind: str):
 make_jaxjobs_ui = _ui_app("/jaxjobs", "JAXJobs", "JAXJob")
 make_experiments_ui = _ui_app("/experiments", "Experiments", "Experiment")
 make_models_ui = _ui_app("/models", "Models", "InferenceService")
+make_pipelines_ui = _ui_app("/pipelines", "Pipeline Runs", "PipelineRun")

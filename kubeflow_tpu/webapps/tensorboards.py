@@ -31,8 +31,9 @@ class TensorboardsApp(CrudApp):
     def get(self, req: Request):
         ns, name = req.params["ns"], req.params["name"]
         req.authorize("get", tb_api.KIND, ns)
-        return "200 OK", {"tensorboard":
-                          self._view(self.server.get(tb_api.KIND, name, ns))}
+        tb = self.server.get(tb_api.KIND, name, ns)
+        # raw CR rides along for the detail view's Conditions/YAML tabs
+        return "200 OK", {"tensorboard": {**self._view(tb), "raw": tb}}
 
     def post(self, req: Request):
         ns = req.params["ns"]

@@ -48,7 +48,9 @@ class VolumesApp(CrudApp):
         req.authorize("get", KIND, ns)
         pvc = self.server.get(KIND, name, ns)
         pods = self.server.list("Pod", namespace=ns)
-        return "200 OK", {"pvc": self._view(pvc, pods)}
+        # raw CR rides along for the detail view's YAML tab (the jupyter
+        # backend's nb.notebook pattern)
+        return "200 OK", {"pvc": {**self._view(pvc, pods), "raw": pvc}}
 
     def post(self, req: Request):
         ns = req.params["ns"]

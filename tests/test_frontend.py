@@ -71,7 +71,8 @@ def test_pages_and_assets_served(stack):
                          ("/tensorboards/", "tensorboards.js"),
                          ("/jaxjobs/", "resources.js"),
                          ("/experiments/", "resources.js"),
-                         ("/models/", "resources.js")]:
+                         ("/models/", "resources.js"),
+                         ("/pipelines/", "resources.js")]:
         st, html, headers = b.req(path, raw=True)
         assert st == 200, path
         assert "text/html" in headers["Content-Type"]
@@ -80,6 +81,8 @@ def test_pages_and_assets_served(stack):
     # resource UIs carry their kind for the generic table
     _, html, _ = b.req("/jaxjobs/", raw=True)
     assert 'data-kind="JAXJob"' in html.decode()
+    _, html, _ = b.req("/pipelines/", raw=True)
+    assert 'data-kind="PipelineRun"' in html.decode()
 
     for asset, ctype in [("lib.js", "javascript"), ("app.css", "css"),
                          ("dashboard.js", "javascript"),
@@ -115,6 +118,16 @@ def test_js_contracts(stack):
     assert "add-contributor" in dash and "remove-contributor" in dash
     assert "?" in dash and "ns=" in dash    # namespace propagated to iframes
     assert "/apis/PipelineRun" in dash      # training+pipelines card
+    # round-5 detail views: the components exist in the shipped JS
+    _, res, _ = b.req("/static/resources.js", raw=True)
+    res = res.decode()
+    assert "logTail" in res                 # per-worker Logs pane
+    assert "JAXJOB_" in res                 # rendezvous Config pane
+    assert "intermediate" in res            # trial metric curves
+    assert "stoppedAtStep" in res           # trial drill-down
+    assert "dagPane" in res and "dag-edge" in res  # PipelineRun DAG
+    assert "involvedObject" in res          # per-object Events pane
+    assert "openTrialDetails" in res        # trial drill-down dialog
 
 
 # -------------------------------------------------------------- journey ----

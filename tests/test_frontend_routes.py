@@ -38,8 +38,12 @@ SUBS = {
     "name": "parityobj", "nb.name": "parityobj", "t.name": "parityobj",
     "p.name": "parityobj", "s.name": "parityobj",
     "o.metadata.name": "parityobj",
+    "sel.value": "parityobj",     # the log viewer's pod selector
+    "st.podName": "parityobj",    # pipeline step pod
     "mtype": "podcpu",
     "kind": "JAXJob",
+    "appBase": "/jaxjobs",        # resource-UI mount (form config route)
+    "interval.value": "Last15m",  # resource-usage interval selector
 }
 
 
