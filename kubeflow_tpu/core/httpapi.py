@@ -282,10 +282,34 @@ def serve(app, port: int, host: str = "127.0.0.1", upgrade=None,
     if upgrade is None:
         upgrade = getattr(app, "websocket_upgrade", None)
 
+    class KeepAliveServerHandler(ServerHandler):
+        http_version = "1.1"
+        # whether the response was length-framed, recorded at header-send
+        # time (BaseHandler.close() nulls self.headers afterwards)
+        framed = False
+        # set by the request handler when IT already decided to close
+        # (body-carrying request): the client must be told, not surprised
+        announce_close = False
+
+        def cleanup_headers(self):
+            super().cleanup_headers()
+            self.framed = self.headers.get("Content-Length") is not None
+            if self.announce_close or not self.framed:
+                self.headers["Connection"] = "close"
+
     class ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
         daemon_threads = True
 
     class QuietHandler(WSGIRequestHandler):
+        # HTTP/1.1: connections persist across requests (Envoy/nginx
+        # behavior); the 500-route loadtest's p99 was pure per-request
+        # TCP+thread churn before this
+        protocol_version = "HTTP/1.1"
+        # keepalive makes Nagle bite: headers+body go out as separate
+        # writes, and Nagle holding the second write for the client's
+        # delayed ACK added ~40ms to EVERY persistent-connection request
+        disable_nagle_algorithm = True
+
         def log_message(self, *args):  # route access logs to our logger
             pass
 
@@ -303,28 +327,69 @@ def serve(app, port: int, host: str = "127.0.0.1", upgrade=None,
                 except (OSError, ValueError):
                     self.close_connection = True
                     return
+            self.close_connection = True
+            self._handle_one()
+            while not self.close_connection:
+                self._handle_one()
+
+        # an idle persistent connection must not pin its worker thread
+        # forever (Envoy/nginx idle_timeout); a client that sends nothing
+        # for this long is disconnected
+        IDLE_TIMEOUT = 75.0
+
+        def _handle_one(self):
             # WSGIRequestHandler.handle, with an upgrade-interception
             # window between parse_request and the WSGI run
-            self.raw_requestline = self.rfile.readline(65537)
-            if len(self.raw_requestline) > 65536:
-                self.requestline = ""
-                self.request_version = ""
-                self.command = ""
-                self.send_error(414)
-                return
-            if not self.parse_request():
-                return
+            self.close_connection = True
+            try:
+                self.connection.settimeout(self.IDLE_TIMEOUT)
+                self.raw_requestline = self.rfile.readline(65537)
+                if len(self.raw_requestline) > 65536:
+                    self.requestline = ""
+                    self.request_version = ""
+                    self.command = ""
+                    self.send_error(414)
+                    return
+                if not self.raw_requestline:
+                    return  # client closed between requests
+                # parse_request re-opens the connection for HTTP/1.1
+                # unless the client sent Connection: close
+                if not self.parse_request():
+                    return
+            except (TimeoutError, OSError):
+                return  # idle/slowloris past the deadline, or reset
+            # headers parsed: lift the idle deadline — the app may
+            # legitimately stream for a long time (watch long-polls)
+            self.connection.settimeout(None)
             if (upgrade is not None
                     and "websocket" in self.headers.get("Upgrade",
                                                         "").lower()
                     and upgrade(self)):
                 self.close_connection = True
                 return
-            handler = ServerHandler(self.rfile, self.wfile,
-                                    self.get_stderr(), self.get_environ(),
-                                    multithread=True)
+            # a request BODY the app may not have fully consumed would
+            # corrupt the framing of the next request on this socket —
+            # keepalive applies to bodyless requests only (the hot read
+            # paths: gateway GETs, watch-less API reads).  Chunked
+            # transfer encoding is a body too, with no Content-Length.
+            try:
+                has_body = (int(self.headers.get("Content-Length")
+                                or 0) > 0
+                            or bool(self.headers.get(
+                                "Transfer-Encoding")))
+            except ValueError:
+                has_body = True
+            handler = KeepAliveServerHandler(
+                self.rfile, self.wfile, self.get_stderr(),
+                self.get_environ(), multithread=True)
             handler.request_handler = self
+            handler.announce_close = has_body
             handler.run(self.server.get_app())
+            # keep the connection only when the response was length-
+            # framed (a streamed/unframed body ends by close, HTTP/1.0
+            # style)
+            if has_body or not handler.framed:
+                self.close_connection = True
 
     httpd = make_server(host, port, app, server_class=ThreadingWSGIServer,
                         handler_class=QuietHandler)

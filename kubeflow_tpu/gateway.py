@@ -332,6 +332,80 @@ def _body_chunks(stream, length: int, chunk: int = 65536):
         yield data
 
 
+class _NodelayConnection(http.client.HTTPConnection):
+    """Nagle off: on a keep-alive upstream connection, Nagle holding the
+    request's second write for the backend's delayed ACK costs ~40ms per
+    proxied request."""
+
+    def connect(self):
+        import socket as socketlib
+
+        super().connect()
+        self.sock.setsockopt(socketlib.IPPROTO_TCP,
+                             socketlib.TCP_NODELAY, 1)
+
+
+class _BackendPool:
+    """Keep-alive connections to backing pods (Envoy's upstream pool):
+    with the front door itself serving HTTP/1.1 keepalive, a fresh TCP
+    connect per proxied request became the dominant per-request cost.
+    Idle entries expire after ``idle_ttl`` and expired/extinct backends
+    are swept periodically — pods churn, and sockets to deleted pods
+    must not accumulate for the gateway's lifetime."""
+
+    def __init__(self, max_idle_per_backend: int = 8,
+                 idle_ttl: float = 60.0):
+        import threading
+
+        self._idle: dict[tuple, list] = {}  # key -> [(conn, stored_at)]
+        self._lock = threading.Lock()
+        self.max_idle = max_idle_per_backend
+        self.idle_ttl = idle_ttl
+        self._last_sweep = time.monotonic()
+
+    def _sweep_locked(self, now: float) -> None:
+        if now - self._last_sweep < self.idle_ttl / 2:
+            return
+        self._last_sweep = now
+        dead = []
+        for key, idle in self._idle.items():
+            keep = []
+            for conn, stored in idle:
+                (keep.append((conn, stored))
+                 if now - stored < self.idle_ttl else dead.append(conn))
+            if keep:
+                self._idle[key] = keep
+            else:
+                del self._idle[key]
+        for conn in dead:
+            conn.close()
+
+    def get(self, host: str, port: int, timeout: float):
+        """-> (conn, reused): a pooled connection may be stale (pod
+        closed it); callers retry a failed REUSED conn on a fresh one."""
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            idle = self._idle.get((host, port))
+            while idle:
+                conn, stored = idle.pop()
+                if now - stored >= self.idle_ttl:
+                    conn.close()
+                    continue
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+                return conn, True
+        return (_NodelayConnection(host, port, timeout=timeout), False)
+
+    def put(self, host: str, port: int, conn) -> None:
+        with self._lock:
+            idle = self._idle.setdefault((host, port), [])
+            if len(idle) < self.max_idle:
+                idle.append((conn, time.monotonic()))
+                return
+        conn.close()
+
+
 class Gateway:
     """WSGI reverse proxy over the store's VirtualService objects."""
 
@@ -345,6 +419,7 @@ class Gateway:
         # port; a short connect-retry absorbs that startup race
         self.connect_retries = connect_retries
         self.retry_delay = retry_delay
+        self.pool = _BackendPool()
 
     def matches(self, path: str) -> bool:
         return match_route(self.server, path) is not None
@@ -542,9 +617,18 @@ class Gateway:
             retriable = length == 0
 
         conn = None
+        resp = None
+        force_fresh = False
         for attempt in range(self.connect_retries):
-            conn = http.client.HTTPConnection(backend.host, backend.port,
-                                              timeout=backend.timeout_s)
+            if force_fresh:
+                # a stale pooled connection just failed; its poolmates
+                # are likely stale too — bypass the pool entirely
+                conn, reused = (_NodelayConnection(
+                    backend.host, backend.port,
+                    timeout=backend.timeout_s), False)
+            else:
+                conn, reused = self.pool.get(backend.host, backend.port,
+                                             backend.timeout_s)
             try:
                 conn.request(method, url, body=body, headers=headers)
                 resp = conn.getresponse()
@@ -559,12 +643,23 @@ class Gateway:
                                    [("Content-Type", "text/plain")])
                     return [b"backend connection refused\n"]
                 time.sleep(self.retry_delay)
-            except OSError as e:
+            except (OSError, http.client.HTTPException) as e:
                 conn.close()
+                if (reused and retriable
+                        and attempt + 1 < self.connect_retries):
+                    # stale keep-alive connection (pod closed it while
+                    # idle): retry on a fresh connect, no backoff
+                    force_fresh = True
+                    continue
                 PROXIED.labels("502").inc()
                 start_response("502 Bad Gateway",
                                [("Content-Type", "text/plain")])
                 return [f"backend error: {e}\n".encode()]
+        if resp is None:  # loop exhausted without a response
+            PROXIED.labels("502").inc()
+            start_response("502 Bad Gateway",
+                           [("Content-Type", "text/plain")])
+            return [b"backend unavailable\n"]
 
         out_headers = [(k, v) for k, v in resp.getheaders()
                        if k.lower() not in HOP_BY_HOP]
@@ -574,6 +669,8 @@ class Gateway:
                        else "502").inc()
         start_response(f"{resp.status} {resp.reason}", out_headers)
 
+        pool = self.pool
+
         def stream():
             try:
                 while True:
@@ -582,6 +679,11 @@ class Gateway:
                         break
                     yield chunk
             finally:
-                conn.close()
+                # a fully-drained keep-alive response returns its
+                # connection to the pool; anything else closes
+                if resp.isclosed() and not resp.will_close:
+                    pool.put(backend.host, backend.port, conn)
+                else:
+                    conn.close()
 
         return stream()

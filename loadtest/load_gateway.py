@@ -40,6 +40,9 @@ def _start_backend() -> int:
 
     class H(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # the loadtest measures the GATEWAY: the stand-in backend must
+        # not add its own Nagle/delayed-ACK stalls to every response
+        disable_nagle_algorithm = True
 
         def do_GET(self):
             if "websocket" in (self.headers.get("Upgrade") or "").lower():

@@ -116,3 +116,58 @@ def test_watch_authorizes_every_requested_kind(endpoint):
             assert r.readline().strip() == b"{}"  # first heartbeat
     finally:
         httpd.shutdown()
+
+
+def test_http11_keepalive_reuses_connection_for_bodyless_requests():
+    """PERF r5: the front door serves HTTP/1.1 keepalive — N bodyless
+    GETs ride ONE connection (the 500-route loadtest's p99 was pure
+    per-request TCP/thread churn before this)."""
+    import http.client
+
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    server.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": "ka", "namespace": "d"},
+                   "spec": {}})
+    httpd, _ = serve(RestAPI(server), 0)
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", httpd.server_address[1], timeout=5)
+        sock_ids = set()
+        for _ in range(20):
+            conn.request("GET", "/apis/ConfigMap/d/ka")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+            sock_ids.add(id(conn.sock))
+        # http.client would have replaced .sock had the server closed
+        assert len(sock_ids) == 1, "connection was not reused"
+        conn.close()
+    finally:
+        httpd.shutdown()
+
+
+def test_request_with_body_closes_connection_for_framing_safety():
+    """A request BODY the app may not fully consume would corrupt the
+    next request's framing on a persistent socket — body-carrying
+    exchanges are one-per-connection by design."""
+    import http.client
+
+    from kubeflow_tpu.core.store import APIServer
+
+    httpd, _ = serve(RestAPI(APIServer()), 0)
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", httpd.server_address[1], timeout=5)
+        conn.request("POST", "/apis/ConfigMap", body=json.dumps(
+            {"metadata": {"name": "b1", "namespace": "d"}, "spec": {}}),
+            headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 201
+        resp.read()
+        # server signalled close (Connection: close or will_close)
+        assert resp.will_close
+        conn.close()
+    finally:
+        httpd.shutdown()
