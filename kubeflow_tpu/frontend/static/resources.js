@@ -110,32 +110,52 @@
       "No events recorded for this object.");
   }
 
-  /* per-pod log viewer over status.logTail (the executor's rolling
-   * stdout/stderr mirror — LocalExecutor flushes it ~1/s) */
+  /* per-pod LIVE log viewer over status.logTail (the executor's rolling
+   * stdout/stderr mirror — LocalExecutor flushes it ~1/s; this pane
+   * follows it ~2/s while the dialog is open and stops itself once the
+   * pane leaves the document) */
   function podLogsPane(podNames) {
     if (!podNames.length) {
       return muted("No pods (gang not admitted, or already cleaned up).");
     }
     const sel = el("select", null, podNames.map((p) =>
       el("option", { value: p }, p)));
+    const follow = el("input", { type: "checkbox", checked: "" });
     const pre = el("pre", { class: "kf-yaml kf-logs" }, "…");
     async function refresh() {
       try {
         const p = await api.get(`/apis/Pod/${namespace}/${sel.value}`);
         const lines = (p.status && p.status.logTail) || [];
+        const atBottom = pre.scrollTop + pre.clientHeight >=
+          pre.scrollHeight - 4;
         pre.textContent = lines.length ? lines.join("\n")
           : "No log lines yet (container starting, or a runtime " +
             "without log capture).";
+        if (atBottom) pre.scrollTop = pre.scrollHeight;  // tail -f feel
       } catch (e) {
         pre.textContent = `Pod ${sel.value} is gone (${e.message}) — ` +
           "logs are not retained after pod deletion.";
       }
     }
+    refresh();  // immediate first load; the poll only FOLLOWS
+    let wasConnected = false;
+    const handle = KF.poll(async () => {
+      // poll's first tick fires synchronously, before the dialog has
+      // attached this pane (and before `handle` exists) — only stop
+      // once the pane has been in the document and left it
+      if (!pre.isConnected) {
+        if (wasConnected) handle.stop();
+        return;
+      }
+      wasConnected = true;
+      if (follow.checked) await refresh();
+    }, 2000);
     sel.addEventListener("change", refresh);
-    refresh();
     return el("div", null,
       el("div", { class: "row", style: "display:flex;gap:8px;" },
-        sel, el("button", { class: "icon", title: "Refresh",
+        sel,
+        el("label", { class: "chip" }, follow, "follow"),
+        el("button", { class: "icon", title: "Refresh",
           onclick: refresh }, "⟳")),
       pre);
   }

@@ -368,7 +368,8 @@ class _BackendPool:
             return
         self._last_sweep = now
         dead = []
-        for key, idle in self._idle.items():
+        for key, idle in list(self._idle.items()):  # snapshot: keys are
+            # deleted during the walk
             keep = []
             for conn, stored in idle:
                 (keep.append((conn, stored))
@@ -620,9 +621,11 @@ class Gateway:
         resp = None
         force_fresh = False
         for attempt in range(self.connect_retries):
-            if force_fresh:
-                # a stale pooled connection just failed; its poolmates
-                # are likely stale too — bypass the pool entirely
+            if force_fresh or not retriable:
+                # bypass the pool when a stale pooled connection just
+                # failed (its poolmates are likely stale too) — and for
+                # UNREPLAYABLE streamed bodies, which must never gamble
+                # on a half-dead keep-alive socket in the first place
                 conn, reused = (_NodelayConnection(
                     backend.host, backend.port,
                     timeout=backend.timeout_s), False)
