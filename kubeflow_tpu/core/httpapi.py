@@ -111,11 +111,16 @@ class RestAPI:
         if not parts and method == "GET":
             # kind discovery (k8s API-group discovery's role): a
             # kind-filterless watch client re-lists every kind after a
-            # reconnect instead of losing the gap.  Same authorization as
-            # a filterless watch ("*"): discovery reveals which kinds
-            # exist, nothing more.
-            self._authz(user, "list", "*", None)
-            return "200 OK", {"kinds": self.server.kinds()}
+            # reconnect instead of losing the gap.  Authorized EXACTLY
+            # like the filterless watch it serves — including its
+            # namespace scope, so a contributor-bound (namespaced)
+            # client's reconnect resync works too.
+            ns = qs.get("namespace", [None])[0]
+            self._authz(user, "list", "*", ns)
+            # the ANSWER is scoped like the authz: a namespaced caller
+            # sees only kinds with objects in its namespace (+ cluster-
+            # scoped kinds), not cluster-wide kind existence
+            return "200 OK", {"kinds": self.server.kinds(namespace=ns)}
 
         version = qs.get("version", [None])[0]
         if len(parts) == 1:
@@ -287,15 +292,28 @@ def serve(app, port: int, host: str = "127.0.0.1", upgrade=None,
         # whether the response was length-framed, recorded at header-send
         # time (BaseHandler.close() nulls self.headers afterwards)
         framed = False
+        declared = None   # the Content-Length the client was promised
+        body_sent = 0     # body bytes actually written
         # set by the request handler when IT already decided to close
         # (body-carrying request): the client must be told, not surprised
         announce_close = False
 
         def cleanup_headers(self):
             super().cleanup_headers()
-            self.framed = self.headers.get("Content-Length") is not None
+            cl = self.headers.get("Content-Length")
+            try:
+                self.declared = None if cl is None else int(cl)
+            except ValueError:
+                self.declared = None
+            self.framed = self.declared is not None
             if self.announce_close or not self.framed:
                 self.headers["Connection"] = "close"
+
+        def close(self):
+            # BaseHandler.close() zeroes bytes_sent; snapshot it so the
+            # request handler can compare promised vs delivered
+            self.body_sent = self.bytes_sent
+            super().close()
 
     class ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
         daemon_threads = True
@@ -386,9 +404,14 @@ def serve(app, port: int, host: str = "127.0.0.1", upgrade=None,
             handler.announce_close = has_body
             handler.run(self.server.get_app())
             # keep the connection only when the response was length-
-            # framed (a streamed/unframed body ends by close, HTTP/1.0
-            # style)
-            if has_body or not handler.framed:
+            # framed AND fully delivered — a truncated body (backend died
+            # mid-stream; wsgiref swallows app errors once headers are
+            # out) on a persistent socket would desync every later
+            # response into the tail of the short one.  HEAD responses
+            # carry Content-Length with no body by spec: not truncation.
+            truncated = (handler.body_sent != handler.declared
+                         and self.command != "HEAD")
+            if has_body or not handler.framed or truncated:
                 self.close_connection = True
 
     httpd = make_server(host, port, app, server_class=ThreadingWSGIServer,

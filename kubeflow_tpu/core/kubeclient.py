@@ -194,10 +194,13 @@ class KubeStore:
         self._req("DELETE",
                   f"/apis/{kind}/{self._ns_seg(namespace)}/{name}")
 
-    def kinds(self) -> list[str]:
+    def kinds(self, namespace: str | None = None) -> list[str]:
         """Kind discovery (GET /apis) — the reconnecting watch uses it to
-        re-list everything when it has no kind filter."""
-        return self._req("GET", "/apis")["kinds"]
+        re-list everything when it has no kind filter.  ``namespace``
+        scopes the authorization check the same way the watch itself is
+        scoped (a namespaced contributor can resync its own watch)."""
+        q = f"?namespace={namespace}" if namespace else ""
+        return self._req("GET", f"/apis{q}")["kinds"]
 
     def watch(self, kinds: Iterable[str] | None = None,
               namespace: str | None = None) -> "_HttpWatch":
@@ -334,7 +337,7 @@ class _HttpWatch:
                 # the resync covers everything — plus any kind this watch
                 # has seen whose objects may ALL have vanished during the
                 # gap (absent from discovery, but _known needs the DELETEs)
-                relist = set(self._store.kinds())
+                relist = set(self._store.kinds(namespace=self._namespace))
                 relist.update(k for (k, _, _) in self._known)
             else:
                 relist = set(self._kinds)

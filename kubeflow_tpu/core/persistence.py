@@ -378,17 +378,39 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
     return server
 
 
-def detach(server: APIServer) -> None:
-    """Release a data dir: unhook the journal, wait out any background
-    compaction, close the WAL, and drop the flock — after this another
-    writer may attach.  No-op on a journal-less server."""
+def detach(server: APIServer, timeout: float = 30.0) -> None:
+    """Release a data dir: wait out any background compaction, unhook
+    the journal, close the WAL, and drop the flock — after this another
+    writer may attach.  No-op on a journal-less server.
+
+    Refuses (keeping the flock AND the journal attached — every mutation
+    stays durable) if the in-flight snapshot does not finish within
+    ``timeout``: releasing while the old thread can still ``os.replace``
+    the snapshot would hand a successor exactly the stale-clobber the
+    flock exists to prevent.  The journal is only unhooked under the
+    store lock once no snapshot is in flight, so no mutation ever lands
+    in an unjournaled gap."""
+    import time as _t
+
     j = server._journal
     if j is None:
         return
     persister = j.__self__
-    with server._lock:
-        server._journal = None
-    persister.quiesce()
+    deadline = _t.monotonic() + timeout
+    while True:
+        persister.quiesce(max(0.0, deadline - _t.monotonic()))
+        with server._lock:
+            t = persister._inflight
+            if t is None or not t.is_alive():
+                # holding the lock: no mutation (hence no new journal
+                # append or compaction) can race the unhook
+                server._journal = None
+                break
+            if _t.monotonic() >= deadline:
+                raise RuntimeError(
+                    "background compaction still running after "
+                    f"{timeout:.0f}s; data dir not released")
+        # inflight appeared between quiesce and the lock: wait again
     persister.wal.close()
     if persister._lock_fd is not None:
         os.close(persister._lock_fd)

@@ -128,13 +128,20 @@ class APIServer:
         self._kinds.setdefault(key[0], {})[key] = obj
         self._gens[key[0]] = self._gens.get(key[0], 0) + 1
 
-    def kinds(self) -> list[str]:
+    def kinds(self, namespace: str | None = None) -> list[str]:
         """Kinds with at least one live object — lets a kind-filterless
         watch client re-list EVERYTHING after a reconnect instead of
         silently losing the gap (controller-runtime informers never skip
-        resync)."""
+        resync).  ``namespace`` scopes the answer to kinds with objects
+        IN that namespace (plus cluster-scoped kinds): a namespaced
+        contributor must not learn which kinds exist elsewhere."""
         with self._lock:
-            return sorted(k for k, v in self._kinds.items() if v)
+            if namespace is None:
+                return sorted(k for k, v in self._kinds.items() if v)
+            return sorted(
+                kind for kind, objs in self._kinds.items()
+                if any(kind in CLUSTER_SCOPED or key[1] == namespace
+                       for key in objs))
 
     def generation(self, kind: str) -> int:
         """Monotonic per-kind mutation counter (bumps on create/update/

@@ -183,6 +183,14 @@
     body.append(Object.values(panes)[0]);
     const dlg = dialog(title, el("div", null, tabs, body),
       [el("button", { onclick: () => dlg.close() }, "Close")]);
+    // panes with background work (log-follow polls) expose kfStop;
+    // tear them down when the DIALOG closes — tab switches detach a
+    // pane without ending its lifetime
+    dlg.addEventListener("close", () => {
+      for (const pane of Object.values(panes)) {
+        if (pane && typeof pane.kfStop === "function") pane.kfStop();
+      }
+    });
     return dlg;
   }
 

@@ -91,9 +91,17 @@
   }
 
   /* Events recorded against one object (the per-resource activity feed;
-   * the jupyter app has the same tab via its backend route) */
+   * the jupyter app has the same tab via its backend route).  A denied
+   * or failed Events list degrades to its own message — it must never
+   * take Workers/Logs/YAML down with it (the dashboard cards set the
+   * same precedent). */
   async function eventsPane(forKind, name) {
-    const all = (await api.get(`/apis/Event?namespace=${namespace}`)).items;
+    let all;
+    try {
+      all = (await api.get(`/apis/Event?namespace=${namespace}`)).items;
+    } catch (e) {
+      return errorBox(`events unavailable: ${e.message}`);
+    }
     const mine = all.filter((e) => {
       const io = e.spec.involvedObject || {};
       return io.name === name && io.kind === forKind;
@@ -138,26 +146,21 @@
       }
     }
     refresh();  // immediate first load; the poll only FOLLOWS
-    let wasConnected = false;
     const handle = KF.poll(async () => {
-      // poll's first tick fires synchronously, before the dialog has
-      // attached this pane (and before `handle` exists) — only stop
-      // once the pane has been in the document and left it
-      if (!pre.isConnected) {
-        if (wasConnected) handle.stop();
-        return;
-      }
-      wasConnected = true;
-      if (follow.checked) await refresh();
+      // skip while the pane is on a background tab; the dialog's close
+      // event (via kfStop below) ends the poll for good
+      if (pre.isConnected && follow.checked) await refresh();
     }, 2000);
     sel.addEventListener("change", refresh);
-    return el("div", null,
+    const node = el("div", null,
       el("div", { class: "row", style: "display:flex;gap:8px;" },
         sel,
         el("label", { class: "chip" }, follow, "follow"),
         el("button", { class: "icon", title: "Refresh",
           onclick: refresh }, "⟳")),
       pre);
+    node.kfStop = () => handle.stop();
+    return node;
   }
 
   /* ---------------- JAXJob detail ---------------- */
@@ -397,9 +400,10 @@
       const logsBtn = st.podName
         ? el("button", { class: "icon", title: "Logs",
             onclick: () => {
-              const dlg = KF.dialog(`Logs — step ${s.name}`,
-                podLogsPane([st.podName]),
+              const pane = podLogsPane([st.podName]);
+              const dlg = KF.dialog(`Logs — step ${s.name}`, pane,
                 [el("button", { onclick: () => dlg.close() }, "Close")]);
+              dlg.addEventListener("close", () => pane.kfStop());
             } }, "📜")
         : muted("—");
       return el("tr", null,

@@ -135,8 +135,9 @@ def _build_route_table(server: APIServer) -> dict[str, Route]:
     compiles its route table when config changes, never per request, and at
     500 notebooks the per-request scan cost 500 object copies per proxied
     byte-stream.  Only namespace-owned prefixes participate
-    (``_prefix_owned``); on a prefix claimed twice the first VS in (ns,
-    name) order wins, matching the old scan's tie-break."""
+    (``_prefix_owned``).  EVERY owned match prefix of an http entry is a
+    route (Istio ORs a route's match clauses); when two entries claim the
+    same prefix, the first in (ns, name, match order) wins."""
     table: dict[str, Route] = {}
     for vs in server.list("VirtualService"):
         vs_ns = vs["metadata"].get("namespace")
@@ -620,12 +621,19 @@ class Gateway:
         conn = None
         resp = None
         force_fresh = False
+        # pooled keep-alive connections carry a replay hazard: a pod that
+        # dies after committing but before responding makes the send look
+        # stale-connection-shaped, and re-sending would execute the
+        # operation twice.  Envoy/urllib3 draw the same line: only
+        # idempotent methods ride (and retry on) reused connections.
+        idempotent = method in ("GET", "HEAD", "OPTIONS")
         for attempt in range(self.connect_retries):
-            if force_fresh or not retriable:
-                # bypass the pool when a stale pooled connection just
-                # failed (its poolmates are likely stale too) — and for
-                # UNREPLAYABLE streamed bodies, which must never gamble
-                # on a half-dead keep-alive socket in the first place
+            # fresh connection when: a pooled one just went stale
+            # (force_fresh), the method could replay a side effect
+            # (not idempotent), or the body is an unreplayable stream
+            # that must never gamble on a half-dead keep-alive socket
+            # (not retriable)
+            if force_fresh or not idempotent or not retriable:
                 conn, reused = (_NodelayConnection(
                     backend.host, backend.port,
                     timeout=backend.timeout_s), False)

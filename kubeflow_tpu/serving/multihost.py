@@ -213,7 +213,6 @@ class MultiHostPredictor:
         if any(not p for p in prompts):
             raise ValueError("empty prompt")
         batch = len(prompts)
-        padded_b = -(-batch // self.dp) * self.dp
         pad_len = max(len(p) for p in prompts)
         if pad_len + max_new_tokens > self.max_seq:
             # same contract as ContinuousBatcher.submit: refusing beats
@@ -221,6 +220,21 @@ class MultiHostPredictor:
             raise ValueError(
                 f"prompt+new ({pad_len + max_new_tokens}) > max_seq "
                 f"{self.max_seq}")
+        # compiled-shape bucketing: arbitrary request shapes must not
+        # each pay a multi-second XLA compile (and pin an executable
+        # forever) — pow2 buckets cap the cache at a handful of programs
+        def _pow2(n: int) -> int:
+            return 1 << max(0, (n - 1).bit_length())
+
+        requested_new = max_new_tokens
+        # bucket the PER-REPLICA row count, then multiply by dp — the
+        # batch dim must stay dp-divisible for P("dp") sharding (dp need
+        # not be a power of two)
+        padded_b = _pow2(-(-batch // self.dp)) * self.dp
+        pad_len = min(_pow2(max(8, pad_len)),
+                      self.max_seq - max_new_tokens)
+        max_new_tokens = min(_pow2(max(8, max_new_tokens)),
+                             self.max_seq - pad_len)
         ids = np.zeros((padded_b, pad_len), np.int32)
         last = np.zeros((padded_b,), np.int32)
         for i, p in enumerate(prompts):
@@ -233,6 +247,7 @@ class MultiHostPredictor:
             last.shape, row, lambda idx: last[idx])
         toks = self._gen_fn(padded_b, pad_len, max_new_tokens)(
             self.params, gids, glast)
-        toks = np.asarray(toks)
+        # bucketed decode may overshoot; return exactly what was asked
+        toks = np.asarray(toks)[:, :requested_new]
         return [list(prompts[i]) + [int(t) for t in toks[i]]
                 for i in range(batch)]

@@ -20,6 +20,23 @@ import os
 DEFAULT_HOSTS = ("127.0.0.1", "localhost")
 
 
+def _expiring(certfile: str, margin_days: float = 7.0) -> bool:
+    """True when the existing cert is expired or within ``margin_days``
+    of it — reusing it would strand every client pinning the file until
+    someone deletes it by hand; re-minting is self-healing (clients pin
+    the file path, and the platform reloads it at boot)."""
+    try:
+        from cryptography import x509
+
+        with open(certfile, "rb") as f:
+            cert = x509.load_pem_x509_certificate(f.read())
+        remaining = (cert.not_valid_after_utc
+                     - datetime.datetime.now(datetime.timezone.utc))
+        return remaining < datetime.timedelta(days=margin_days)
+    except Exception:
+        return True  # unreadable/corrupt material: re-mint
+
+
 def self_signed_cert(directory: str,
                      hosts: tuple[str, ...] = DEFAULT_HOSTS,
                      ) -> tuple[str, str]:
@@ -32,7 +49,8 @@ def self_signed_cert(directory: str,
     os.makedirs(directory, exist_ok=True)
     certfile = os.path.join(directory, "tls.crt")
     keyfile = os.path.join(directory, "tls.key")
-    if os.path.exists(certfile) and os.path.exists(keyfile):
+    if os.path.exists(certfile) and os.path.exists(keyfile) \
+            and not _expiring(certfile):
         return certfile, keyfile
 
     from cryptography import x509

@@ -184,3 +184,24 @@ def test_tuple_values_are_normalized_and_do_not_alias_store_internals():
     got["spec"]["tupled"][1]["deep"] = "also-mutated"
     assert server.get("Notebook", "t", "d")["spec"]["tupled"][1]["deep"] \
         == "original"
+
+
+def test_kind_discovery_scopes_to_namespace():
+    """A namespaced caller's kind discovery must not reveal kinds that
+    exist only in OTHER namespaces (cluster-scoped kinds always show)."""
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    server.create({"kind": "Notebook", "apiVersion": "v1",
+                   "metadata": {"name": "a", "namespace": "team-a"},
+                   "spec": {}})
+    server.create({"kind": "Experiment", "apiVersion": "v1",
+                   "metadata": {"name": "b", "namespace": "team-b"},
+                   "spec": {}})
+    server.create({"kind": "Profile", "apiVersion": "v1",
+                   "metadata": {"name": "p"},
+                   "spec": {}})  # cluster-scoped
+    assert server.kinds() == ["Experiment", "Notebook", "Profile"]
+    assert server.kinds(namespace="team-a") == ["Notebook", "Profile"]
+    assert server.kinds(namespace="team-b") == ["Experiment", "Profile"]
+    assert server.kinds(namespace="empty") == ["Profile"]

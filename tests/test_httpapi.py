@@ -171,3 +171,43 @@ def test_request_with_body_closes_connection_for_framing_safety():
         conn.close()
     finally:
         httpd.shutdown()
+
+
+def test_truncated_framed_response_closes_connection():
+    """A response that promises Content-Length N but delivers fewer
+    bytes (backend died mid-stream) must NOT keep the connection alive —
+    the next response's bytes would be consumed as the truncated body's
+    tail (silent desync)."""
+    import http.client
+
+    def app(environ, start_response):
+        if environ["PATH_INFO"] == "/short":
+            start_response("200 OK", [("Content-Type", "text/plain"),
+                                      ("Content-Length", "100")])
+            return [b"only-this"]  # 9 of the promised 100 bytes
+        body = b"ok"
+        start_response("200 OK", [("Content-Type", "text/plain"),
+                                  ("Content-Length", "2")])
+        return [body]
+
+    httpd, _ = serve(app, 0)
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", httpd.server_address[1], timeout=5)
+        conn.request("GET", "/short")
+        resp = conn.getresponse()
+        # client sees the truncation as an explicit error/EOF, not as the
+        # next response bleeding in
+        with pytest.raises((http.client.IncompleteRead, OSError)):
+            resp.read()
+        conn.close()
+        # healthy framed responses still keep the connection
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", httpd.server_address[1], timeout=5)
+        for _ in range(3):
+            conn.request("GET", "/ok")
+            r = conn.getresponse()
+            assert r.read() == b"ok"
+        conn.close()
+    finally:
+        httpd.shutdown()
