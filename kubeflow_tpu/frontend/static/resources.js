@@ -651,6 +651,13 @@
       min: "1" });
     const topology = optionSelect(cfg.topologies, "v5e-8");
     const model = optionSelect(cfg.models, "mlp");
+    // early stopping (medianstop): prune trials whose intermediate
+    // metric trails the median — frees their slices early
+    const esOn = el("input", { type: "checkbox" });
+    const esMinTrials = el("input", { type: "number", value: "3",
+      min: "1", style: "width:70px" });
+    const esStartStep = el("input", { type: "number", value: "2",
+      min: "1", style: "width:70px" });
 
     /* search-space rows: {name, type, min/max or values} */
     const paramRows = [];
@@ -691,7 +698,14 @@
         formField("Max trials", maxTrials)),
       el("div", { class: "row" },
         formField("Trial topology", topology),
-        formField("Trial model", model)));
+        formField("Trial model", model)),
+      formField("Early stopping",
+        el("div", { class: "row" },
+          el("label", { class: "chip" }, esOn, "medianstop"),
+          formField("min trials", esMinTrials),
+          formField("start step", esStartStep)),
+        "prunes trials whose intermediate metric trails the median of " +
+        "the others' bests — their slices free early"));
     submitDialog("New Experiment", form, () => {
       const parameters = paramRows.map((r) => {
         const p = { name: r.pname.value.trim(), type: r.ptype.value };
@@ -716,17 +730,24 @@
         }
         return p;
       });
+      const spec = {
+        objective: { type: goal.value, metric: metric.value.trim() },
+        algorithm: { name: algorithm.value },
+        parameters,
+        trialTemplate: { topology: topology.value,
+                         trainer: { model: model.value } },
+        parallelTrials: Number(parallel.value) || 1,
+        maxTrials: Number(maxTrials.value) || 1,
+      };
+      if (esOn.checked) {
+        spec.earlyStopping = {
+          algorithm: "medianstop",
+          minTrials: Number(esMinTrials.value) || 3,
+          startStep: Number(esStartStep.value) || 2,
+        };
+      }
       return { apiVersion: "kubeflow.org/v1", kind: "Experiment",
-        metadata: { name: name.value.trim(), namespace },
-        spec: {
-          objective: { type: goal.value, metric: metric.value.trim() },
-          algorithm: { name: algorithm.value },
-          parameters,
-          trialTemplate: { topology: topology.value,
-                           trainer: { model: model.value } },
-          parallelTrials: Number(parallel.value) || 1,
-          maxTrials: Number(maxTrials.value) || 1,
-        } };
+        metadata: { name: name.value.trim(), namespace }, spec };
     }, refresh);
   }
 

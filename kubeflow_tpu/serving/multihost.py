@@ -231,10 +231,20 @@ class MultiHostPredictor:
         # batch dim must stay dp-divisible for P("dp") sharding (dp need
         # not be a power of two)
         padded_b = _pow2(-(-batch // self.dp)) * self.dp
+        # bucket max_new FIRST: deriving the pad cap from the raw
+        # requested value would make the cache key vary per distinct
+        # max_new for long prompts.  Shrink the max_new bucket (never
+        # below the request) until it fits beside the real prompt, then
+        # bucket pad_len into whatever room remains.
+        new_b = _pow2(max(8, requested_new))
+        while new_b // 2 >= requested_new and \
+                pad_len + new_b > self.max_seq:
+            new_b //= 2
+        if pad_len + new_b > self.max_seq:
+            new_b = requested_new  # no pow2 bucket fits: exact tail
+        max_new_tokens = new_b
         pad_len = min(_pow2(max(8, pad_len)),
                       self.max_seq - max_new_tokens)
-        max_new_tokens = min(_pow2(max(8, max_new_tokens)),
-                             self.max_seq - pad_len)
         ids = np.zeros((padded_b, pad_len), np.int32)
         last = np.zeros((padded_b,), np.int32)
         for i, p in enumerate(prompts):
