@@ -66,6 +66,7 @@ class DashboardApp(CrudApp):
         self.metrics = metrics or make_metrics_service(server, project)
         self.add_route("GET", "/api/namespaces", self.namespaces)
         self.add_route("GET", "/api/activities/<ns>", self.activities)
+        self.add_route("GET", "/api/quota/<ns>", self.quota_route)
         self.add_route("GET", "/api/metrics/<mtype>", self.metrics_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
@@ -101,6 +102,18 @@ class DashboardApp(CrudApp):
         events.sort(key=lambda e: e["spec"].get("lastTimestamp", 0),
                     reverse=True)
         return "200 OK", events[:100]
+
+    def quota_route(self, req: Request):
+        """TPU quota standing for the namespace (the home-view quota
+        card): enforced limits from the Profile's ResourceQuota plus the
+        live charged usage the admission hook computes."""
+        from kubeflow_tpu.core import quota as quota_mod
+
+        ns = req.params["ns"]
+        req.authorize("get", "ResourceQuota", ns)
+        hard = quota_mod.quota_hard(self.server, ns)
+        used = quota_mod.namespace_usage(self.server, ns)
+        return "200 OK", {"hard": hard or {}, "used": used}
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

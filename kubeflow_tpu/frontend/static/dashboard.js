@@ -206,6 +206,36 @@
           line("Pipeline runs", runs)));
     });
 
+    // TPU quota card: used/hard meter per TPU resource key
+    const quotaCard = el("div", { class: "card", id: "quota-card" },
+      el("h2", null, "TPU quota"), el("div", { class: "muted" }, "…"));
+    cards.append(quotaCard);
+    api.get(`/dashboard/api/quota/${state.ns}`).then((q) => {
+      const keys = Object.keys(q.hard);
+      if (!keys.length) {
+        quotaCard.replaceChildren(el("h2", null, "TPU quota"),
+          el("div", { class: "muted" },
+            "no quota set for this namespace"));
+        return;
+      }
+      // native replaceChildren takes Nodes, not Arrays — spread the rows
+      quotaCard.replaceChildren(el("h2", null, "TPU quota"),
+        ...keys.map((k) => {
+          const used = q.used[k] || 0;
+          const hard = q.hard[k];
+          const pct = Math.min(100, 100 * used / Math.max(1, hard));
+          const label = k.startsWith("cloud-tpu.google.com/")
+            ? `${k.replace("cloud-tpu.google.com/", "")}: ` +
+              `${used} / ${hard} chips`
+            : `${k}: ${used} / ${hard}`;
+          return el("div", { class: "quota-row" },
+            el("div", { class: "hint" }, label),
+            el("div", { class: "meter" },
+              el("i", { style: `width:${pct}%`,
+                class: pct >= 90 ? "hot" : null })));
+        }));
+    }).catch(() => quotaCard.append(errorBox("unavailable")));
+
     // metrics cards
     for (const [mtype, title] of [["tpuduty", "TPU duty cycle"],
                                   ["podcpu", "Pod CPU"]]) {

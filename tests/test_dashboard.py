@@ -144,3 +144,29 @@ def test_all_namespaces_admin_only(stack):
     _, out = req(base, "/dashboard/api/workgroup/get-all-namespaces",
                  user="root@corp.com")
     assert {"namespace": "team-a", "owner": "alice@corp.com"} in out
+
+
+def test_quota_route_reports_hard_and_used(stack):
+    """The home view's TPU-quota card: enforced limits + live charged
+    usage for the namespace."""
+    server, mgr, base = stack
+    server.create({"kind": "ResourceQuota", "apiVersion": "v1",
+                   "metadata": {"name": "kf-resource-quota",
+                                "namespace": "team-a"},
+                   "spec": {"hard": {"cloud-tpu.google.com/v5e": 8}}})
+    server.create({"kind": "Pod", "apiVersion": "v1",
+                   "metadata": {"name": "tpupod", "namespace": "team-a"},
+                   "spec": {"containers": [{
+                       "name": "w", "image": "i",
+                       "resources": {"limits": {
+                           "cloud-tpu.google.com/v5e": 4}}}]},
+                   "status": {"phase": "Running"}})
+    code, out = req(base, "/dashboard/api/quota/team-a",
+                    user="alice@corp.com")
+    assert code == 200
+    assert out["hard"] == {"cloud-tpu.google.com/v5e": 8}
+    assert out["used"]["cloud-tpu.google.com/v5e"] == 4
+    # a namespace with no quota degrades cleanly
+    code, out = req(base, "/dashboard/api/quota/team-b",
+                    user="bob@corp.com")
+    assert code == 200 and out["hard"] == {}
