@@ -53,11 +53,12 @@ def validate(exp: dict) -> None:
     if spec.get("objective", {}).get("type") not in ("maximize", "minimize"):
         raise ValueError("objective.type must be maximize|minimize")
     SearchSpace(spec.get("parameters", []))  # validates each parameter
-    from kubeflow_tpu.hpo.suggestion import ALGORITHMS
+    from kubeflow_tpu.hpo.suggestion import validate_algorithm
 
-    algo = spec.get("algorithm", {}).get("name", "random")
-    if algo not in ALGORITHMS:
-        raise ValueError(f"unknown algorithm {algo!r}")
+    # validates name AND settings (keys + types) at ADMISSION — a typo'd
+    # setting must fail the create, not loop a reconcile forever
+    validate_algorithm(spec.get("algorithm", {}).get("name", "random"),
+                       spec.get("algorithm", {}).get("settings"))
     es = spec.get("earlyStopping")
     if es is not None:
         from kubeflow_tpu.hpo.early_stopping import (

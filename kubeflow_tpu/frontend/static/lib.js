@@ -18,7 +18,9 @@
     const node = document.createElement(tag);
     if (attrs) {
       for (const [k, v] of Object.entries(attrs)) {
-        if (k === "class") node.className = v;
+        // null/undefined mean "no attribute" for class too — className
+        // = null would coerce to the literal string "null"
+        if (k === "class") { if (v != null) node.className = v; }
         else if (k === "dataset") Object.assign(node.dataset, v);
         else if (k.startsWith("on") && typeof v === "function") {
           node.addEventListener(k.slice(2), v);

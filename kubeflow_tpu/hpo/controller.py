@@ -122,9 +122,18 @@ class ExperimentController(Controller):
         slots = int(spec.get("parallelTrials", 2)) - len(running)
         next_index = (max((t["spec"]["index"] for t in trials), default=-1)
                       + 1)
+        # in-flight trials from PRIOR reconciles join as placeholders:
+        # GridSearch must not re-suggest a grid point another gang is
+        # already evaluating (model-based suggesters filter the NaNs)
+        for t in running:
+            history.append((t["spec"]["assignment"], float("nan")))
         suggester = self._suggester(exp, history)
         for i in range(min(slots, max(budget, 0))):
-            assignment = suggester.suggest(history)
+            # index ties the rng stream to the TRIAL, not the suggester
+            # object: the level-triggered reconcile rebuilds the
+            # suggester with the same seed every pass, and without the
+            # index every pass would replay identical suggestions
+            assignment = suggester.suggest(history, index=next_index + i)
             trial = set_owner(api.new_trial(exp, next_index + i, assignment),
                               exp)
             try:
@@ -198,11 +207,13 @@ class ExperimentController(Controller):
 
     def _suggester(self, exp: dict, history):
         spec = exp["spec"]
+        algo = spec.get("algorithm", {})
         space = SearchSpace(spec.get("parameters", []))
         return make_suggester(
-            spec.get("algorithm", {}).get("name", "random"), space,
-            seed=int(spec.get("algorithm", {}).get("seed", 0)),
-            maximize=spec["objective"]["type"] == "maximize")
+            algo.get("name", "random"), space,
+            seed=int(algo.get("seed", 0)),
+            maximize=spec["objective"]["type"] == "maximize",
+            settings=algo.get("settings"))
 
     def _summary(self, trials, history, maximize, exp=None):
         out = {

@@ -1,9 +1,13 @@
-"""Suggestion services: random, grid, and Gaussian-process Bayesian
-optimization (the reference ecosystem's Katib suggestion algorithms).
+"""Suggestion services: random, grid, Gaussian-process Bayesian
+optimization, and TPE (the reference ecosystem's Katib suggestion
+algorithms — katib's suggestion services list random/grid/bayesian/tpe/
+hyperband as the core set).
 
 The Bayesian suggester is a dependency-light GP with an RBF kernel and
-expected-improvement acquisition maximized over random candidates — adequate
-for the low-dimensional HPO spaces trials sweep (BASELINE.json configs[3]).
+expected-improvement acquisition maximized over random candidates; TPE is
+a per-dimension Parzen estimator over the encoded unit cube (Bergstra et
+al., NeurIPS 2011) — both adequate for the low-dimensional HPO spaces
+trials sweep (BASELINE.json configs[3]).
 """
 
 from __future__ import annotations
@@ -18,19 +22,41 @@ from kubeflow_tpu.hpo.search_space import SearchSpace
 
 
 class Suggester:
+    """``suggest(history, index=None)``: ``index`` is the trial's global
+    index.  The experiment controller is level-triggered and REBUILDS the
+    suggester every reconcile with the same seed, so any rng state that
+    only advances within one object lifetime would replay the same
+    stream and re-suggest identical points across reconciles — deriving
+    the stream from (seed, trial index) makes suggestions deterministic
+    per trial yet distinct across trials."""
+
     def __init__(self, space: SearchSpace, *, seed: int = 0,
                  maximize: bool = True):
         self.space = space
+        self.seed = seed
         self.rng = random.Random(seed)
         self.maximize = maximize
 
-    def suggest(self, history: list[tuple[dict, float]]) -> dict[str, Any]:
+    def _rng_for(self, index: int | None) -> random.Random:
+        if index is None:
+            return self.rng
+        return random.Random(f"{self.seed}:{index}")
+
+    def suggest(self, history: list[tuple[dict, float]],
+                index: int | None = None) -> dict[str, Any]:
         raise NotImplementedError
 
 
+def _finished(history):
+    """Drop in-flight entries (the controller appends (assignment, nan)
+    placeholders to stop duplicate suggestions within a reconcile) —
+    model-based suggesters must not fit on NaNs."""
+    return [h for h in history if h[1] == h[1]]
+
+
 class RandomSearch(Suggester):
-    def suggest(self, history):
-        return self.space.sample(self.rng)
+    def suggest(self, history, index=None):
+        return self.space.sample(self._rng_for(index))
 
 
 class GridSearch(Suggester):
@@ -40,14 +66,14 @@ class GridSearch(Suggester):
         self._grid = space.grid(points_per_axis)
         self._next = 0
 
-    def suggest(self, history):
+    def suggest(self, history, index=None):
         tried = [h[0] for h in history]
         while self._next < len(self._grid):
             cand = self._grid[self._next]
             self._next += 1
             if cand not in tried:
                 return cand
-        return self.space.sample(self.rng)  # grid exhausted
+        return self.space.sample(self._rng_for(index))  # grid exhausted
 
 
 class _GP:
@@ -87,9 +113,11 @@ class BayesianOptimization(Suggester):
         self.n_initial = n_initial
         self.n_candidates = n_candidates
 
-    def suggest(self, history):
+    def suggest(self, history, index=None):
+        rng = self._rng_for(index)
+        history = _finished(history)
         if len(history) < self.n_initial:
-            return self.space.sample(self.rng)
+            return self.space.sample(rng)
         x = np.array([self.space.encode(h[0]) for h in history])
         y = np.array([h[1] for h in history], dtype=float)
         if not self.maximize:
@@ -98,8 +126,8 @@ class BayesianOptimization(Suggester):
         try:
             gp.fit(x, y)
         except np.linalg.LinAlgError:
-            return self.space.sample(self.rng)
-        cands = np.array([[self.rng.random() for _ in self.space.params]
+            return self.space.sample(rng)
+        cands = np.array([[rng.random() for _ in self.space.params]
                           for _ in range(self.n_candidates)])
         mu, sigma = gp.predict(cands)
         best = y.max()
@@ -117,16 +145,167 @@ def _npdf(z: np.ndarray) -> np.ndarray:
     return np.exp(-0.5 * z**2) / math.sqrt(2 * math.pi)
 
 
+class TPE(Suggester):
+    """Tree-structured Parzen Estimator: split observed trials at the
+    gamma quantile into good/bad sets, model each with per-dimension
+    Parzen (Gaussian-kernel) densities over the ENCODED unit cube —
+    the encoding makes doubles/ints/log-scales/categoricals uniform —
+    sample candidates from the GOOD density and keep the one maximizing
+    g(x)/b(x).  Working in encoded space sidesteps per-type kernels the
+    same way the GP suggester does."""
+
+    def __init__(self, space, *, seed: int = 0, maximize: bool = True,
+                 n_initial: int = 5, gamma: float = 0.25,
+                 n_candidates: int = 64):
+        # a too-small random phase leaves the Parzen split hostage to
+        # its first lucky/unlucky corner (hyperopt defaults to ~20);
+        # 5 keeps the model path reachable under the controller's
+        # default maxTrials=8 — raise via algorithm.settings.n_initial
+        # for bigger sweeps
+        super().__init__(space, seed=seed, maximize=maximize)
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+
+    # weight of the uniform prior mixed into both densities (Bergstra's
+    # TPE anchors its Parzen estimators with a prior over the domain) —
+    # without it the good density collapses onto the single best point
+    # and the suggester repeats it forever
+    PRIOR = 0.25
+
+    @classmethod
+    def _log_density(cls, x: np.ndarray, centers: np.ndarray,
+                     bw: np.ndarray) -> np.ndarray:
+        """Sum over dims of log((1-PRIOR)*mean-of-Gaussians + PRIOR*1);
+        x [C, D], centers [N, D], bw [N, D] (per-CENTER bandwidths) ->
+        [C].  The uniform component has density 1 on the unit cube."""
+        d = (x[:, None, :] - centers[None, :, :]) / bw[None, :, :]
+        comp = (-0.5 * d**2
+                - np.log(bw * math.sqrt(2 * math.pi))[None, :, :])
+        mean = np.exp(comp).mean(axis=1)  # [C, D]
+        return np.log((1 - cls.PRIOR) * mean + cls.PRIOR).sum(axis=1)
+
+    @staticmethod
+    def _bandwidths(pts: np.ndarray) -> np.ndarray:
+        """Per-point, per-dim bandwidth = distance to the nearest other
+        point in that dim (hyperopt's adaptive-Parzen recipe): sparse
+        regions sample broadly, a tightening cluster zooms in with its
+        own spacing instead of a fixed floor."""
+        n, d = pts.shape
+        if n == 1:
+            return np.full((1, d), 0.5)
+        diff = np.abs(pts[:, None, :] - pts[None, :, :])  # [N, N, D]
+        diff[np.arange(n), np.arange(n), :] = np.inf
+        nearest = diff.min(axis=1)  # [N, D]
+        return np.clip(nearest, 0.01, 0.5)
+
+    # epsilon-greedy escape hatch: pure argmax-of-ratio can freeze on a
+    # tight early cluster (a prior-drawn candidate near the true optimum
+    # scores low until something is OBSERVED there, which argmax alone
+    # never does); a thin stream of random evaluations reshapes the
+    # good/bad split out of such traps
+    EPSILON = 0.1
+
+    def suggest(self, history, index=None):
+        rng = self._rng_for(index)
+        history = _finished(history)
+        if len(history) < max(self.n_initial, 2):
+            return self.space.sample(rng)
+        if rng.random() < self.EPSILON:
+            return self.space.sample(rng)
+        x = np.array([self.space.encode(h[0]) for h in history])
+        # stateless trap-breaker: when the last few evaluations collapsed
+        # onto one point (argmax-of-ratio fixating on a tight cluster,
+        # its nearest-neighbor bandwidths at the floor) WITHOUT improving
+        # the objective, force a random draw.  The improvement condition
+        # spares healthy convergence — clustering AT the optimum keeps
+        # refining.  History-derived, so it works even though the
+        # controller rebuilds this object every reconcile.
+        if len(x) >= max(self.n_initial, 2) + 3:
+            tail = x[-3:]
+            if np.abs(tail - tail[0]).max() < 0.03:
+                ys = [h[1] for h in history]
+                best_before = (max(ys[:-3]) if self.maximize
+                               else min(ys[:-3]))
+                tail_best = (max(ys[-3:]) if self.maximize
+                             else min(ys[-3:]))
+                improving = (tail_best > best_before if self.maximize
+                             else tail_best < best_before)
+                if not improving:
+                    return self.space.sample(rng)
+        y = np.array([h[1] for h in history], dtype=float)
+        order = np.argsort(-y if self.maximize else y)
+        # hyperopt's sqrt-gamma: the good set grows like sqrt(n), so the
+        # Parzen model tracks the few incumbents instead of a quarter of
+        # all history
+        n_good = max(2, min(int(math.ceil(
+            self.gamma * math.sqrt(len(history)))) + 1, 25))
+        good = x[order[:n_good]]
+        bad = x[order[n_good:]]
+        if not len(bad):
+            return self.space.sample(rng)
+
+        bw_g, bw_b = self._bandwidths(good), self._bandwidths(bad)
+        cands = np.empty((self.n_candidates, x.shape[1]))
+        for i in range(self.n_candidates):
+            if rng.random() < self.PRIOR:
+                # draw from the prior: exploration never dies out
+                cands[i] = [rng.random() for _ in range(x.shape[1])]
+                continue
+            ci = rng.randrange(len(good))
+            cands[i] = [min(1.0, max(0.0, rng.gauss(c, bw_g[ci, j])))
+                        for j, c in enumerate(good[ci])]
+        score = (self._log_density(cands, good, bw_g)
+                 - self._log_density(cands, bad, bw_b))
+        return self.space.decode(list(cands[int(np.argmax(score))]))
+
+
 ALGORITHMS = {
     "random": RandomSearch,
     "grid": GridSearch,
     "bayesian": BayesianOptimization,
+    "tpe": TPE,
 }
 
 
-def make_suggester(name: str, space: SearchSpace, *, seed: int = 0,
-                   maximize: bool = True) -> Suggester:
+def validate_algorithm(name: str, settings: dict | None = None) -> None:
+    """Admission-time validation of ``algorithm.name`` + ``.settings``
+    (Katib's algorithmSettings): unknown names, unknown setting keys,
+    non-numeric or non-positive values are rejected at CREATE, where the
+    user sees the error — a reconcile-time raise would be swallowed by
+    the controller's retry loop."""
     if name not in ALGORITHMS:
         raise ValueError(f"unknown algorithm {name!r}; "
                          f"known: {sorted(ALGORITHMS)}")
-    return ALGORITHMS[name](space, seed=seed, maximize=maximize)
+    if not settings:
+        return
+    import inspect
+
+    sig = inspect.signature(ALGORITHMS[name].__init__)
+    allowed = set(sig.parameters) - {"self", "space", "seed", "maximize"}
+    unknown = set(settings) - allowed
+    if unknown:
+        raise ValueError(
+            f"algorithm {name!r} has no settings {sorted(unknown)}; "
+            f"known: {sorted(allowed)}")
+    for key, val in settings.items():
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            raise ValueError(
+                f"algorithm setting {key} must be a number, "
+                f"got {val!r}")
+        if key in ("n_initial", "n_candidates", "points_per_axis") \
+                and int(val) < 1:
+            raise ValueError(f"algorithm setting {key} must be >= 1")
+        if key == "gamma" and not 0.0 < float(val) < 1.0:
+            raise ValueError("algorithm setting gamma must be in (0,1)")
+
+
+def make_suggester(name: str, space: SearchSpace, *, seed: int = 0,
+                   maximize: bool = True,
+                   settings: dict | None = None) -> Suggester:
+    """``settings`` is the Experiment's ``algorithm.settings`` mapping;
+    see ``validate_algorithm`` (run at admission) for the rules."""
+    validate_algorithm(name, settings)
+    kwargs = {k: (int(v) if k != "gamma" else float(v))
+              for k, v in (settings or {}).items()}
+    return ALGORITHMS[name](space, seed=seed, maximize=maximize, **kwargs)
