@@ -360,6 +360,25 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
                 pass
         else:
             objects.pop(payload, None)
+    # -- orphan GC (k8s background garbage collection's role): a crash
+    # between an owner's journaled delete and its children's leaves
+    # children referencing a dead uid; replaying them would resurrect
+    # workloads k8s would collect.  Iterate to a fixpoint — removing an
+    # orphan can orphan ITS children. --
+    uids = {o["metadata"].get("uid") for o in objects.values()}
+    while True:
+        orphans = [
+            key for key, o in objects.items()
+            if (refs := o["metadata"].get("ownerReferences"))
+            and not any(r.get("uid") in uids for r in refs)]
+        if not orphans:
+            break
+        for key in orphans:
+            uids.discard(objects.pop(key)["metadata"].get("uid"))
+        log.info("dropped orphaned children during recovery",
+                 count=len(orphans),
+                 sample=[f"{k[0]}/{k[2]}" for k in orphans[:5]])
+
     with server._lock:
         server._objects.update(objects)
         server._rebuild_index()

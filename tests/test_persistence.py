@@ -359,3 +359,45 @@ def test_second_live_writer_is_refused(tmp_path):
     persistence.attach(s2, str(tmp_path))  # now admitted
     persistence.detach(s2)
     persistence.detach(s2)  # idempotent no-op
+
+
+def test_recovery_collects_orphans_of_interrupted_cascade(tmp_path):
+    """A crash between an owner's journaled delete and its children's
+    leaves children referencing a dead uid; replay must garbage-collect
+    them (k8s background GC's role) — recursively, since dropping an
+    orphan can orphan ITS children."""
+    from kubeflow_tpu.core.objects import set_owner
+
+    s1 = _attach(tmp_path)
+    owner = s1.create({"kind": "Notebook", "apiVersion": "v1",
+                       "metadata": {"name": "own", "namespace": "t"},
+                       "spec": {}})
+    sts = s1.create(set_owner({"kind": "StatefulSet", "apiVersion": "v1",
+                               "metadata": {"name": "own",
+                                            "namespace": "t"},
+                               "spec": {}}, owner))
+    s1.create(set_owner({"kind": "Pod", "apiVersion": "v1",
+                         "metadata": {"name": "own-0", "namespace": "t"},
+                         "spec": {}}, sts))
+    keeper = s1.create({"kind": "Notebook", "apiVersion": "v1",
+                        "metadata": {"name": "keep", "namespace": "t"},
+                        "spec": {}})
+    s1.create(set_owner({"kind": "StatefulSet", "apiVersion": "v1",
+                         "metadata": {"name": "keep", "namespace": "t"},
+                         "spec": {}}, keeper))
+    # simulate the crash window: journal ONLY the owner's removal (the
+    # cascade's child deletes never hit the WAL)
+    persistence.detach(s1)
+    with open(os.path.join(tmp_path, persistence.WAL), "a") as f:
+        f.write(json.dumps({"op": "del",
+                            "key": ["Notebook", "t", "own"]}) + "\n")
+
+    s2 = _attach(tmp_path)
+    # the whole orphaned chain is gone...
+    with pytest.raises(NotFound):
+        s2.get("StatefulSet", "own", "t")
+    with pytest.raises(NotFound):
+        s2.get("Pod", "own-0", "t")
+    # ...and owned objects with LIVE owners survive
+    s2.get("Notebook", "keep", "t")
+    s2.get("StatefulSet", "keep", "t")
