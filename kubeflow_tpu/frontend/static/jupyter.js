@@ -56,11 +56,10 @@
    * jupyter app's notebook details page with OVERVIEW/EVENTS/LOGS/YAML
    * tabs) */
   async function openDetails(name) {
-    const [detail, events, logs] = await Promise.all([
+    const [detail, events] = await Promise.all([
       api.get(`${base}/notebooks/${name}`),
       api.get(`${base}/notebooks/${name}/events`),
-      api.get(`${base}/notebooks/${name}/logs`),
-    ]);
+    ]);  // logs load via the live-follow pane below
     const nb = detail.notebook;
     const overview = el("dl", { class: "kf-overview" },
       el("dt", null, "Status"), el("dd", null, statusIcon(nb.status),
@@ -89,10 +88,12 @@
           "No events."))));
     const yaml = el("pre", { class: "kf-yaml" },
       JSON.stringify(nb.notebook, null, 2));
-    const logPane = el("pre", { class: "kf-yaml" },
-      (logs.logs || []).length ? logs.logs.join("\n")
-        : "No logs yet (container starting, or a runtime without " +
-          "log capture).");
+    // shared live-follow pane (detailDialog tears the poll down on
+    // close via the kfStop protocol)
+    const logPane = KF.logsPane(
+      async () => (await api.get(`${base}/notebooks/${name}/logs`)).logs,
+      { empty: "No logs yet (container starting, or a runtime without " +
+               "log capture)." });
 
     KF.detailDialog(`Notebook ${name}`,
       { Overview: overview, Events: evTable, Logs: logPane, YAML: yaml });

@@ -196,6 +196,46 @@
     return dlg;
   }
 
+  /* live log-follow pane (ONE copy; the jupyter details dialog and the
+   * resource log viewers wrap it): fetchLines() -> Promise<string[]>;
+   * polls ~2s while attached, pins to the bottom tail -f style — the
+   * first render AFTER attach always bottoms out (the pane attaches at
+   * scrollTop 0, which must not read as "user scrolled up").
+   * opts: empty (placeholder text), onError(e) -> replacement text
+   * (default: keep last lines), follows() -> bool gate, interval. */
+  function logsPane(fetchLines, opts) {
+    opts = opts || {};
+    const pre = el("pre", { class: "kf-yaml kf-logs" }, "…");
+    let shown = false;
+    function render(lines) {
+      const firstShow = !shown && pre.isConnected;
+      const atBottom = firstShow ||
+        pre.scrollTop + pre.clientHeight >= pre.scrollHeight - 4;
+      if (pre.isConnected) shown = true;
+      pre.textContent = lines && lines.length ? lines.join("\n")
+        : (opts.empty || "No log lines yet.");
+      if (atBottom) pre.scrollTop = pre.scrollHeight;
+    }
+    async function refresh() {
+      try {
+        render(await fetchLines());
+      } catch (e) {
+        if (opts.onError) pre.textContent = opts.onError(e);
+        // else: keep the last lines we had
+      }
+    }
+    refresh();
+    const handle = poll(async () => {
+      if (pre.isConnected && (!opts.follows || opts.follows())) {
+        await refresh();
+      }
+    }, opts.interval || 2000);
+    const node = el("div", null, pre);
+    node.kfStop = () => handle.stop();
+    node.refresh = refresh;
+    return node;
+  }
+
   const SVG_NS = "http://www.w3.org/2000/svg";
   function svgEl(tag, attrs) {
     const node = document.createElementNS(SVG_NS, tag);
@@ -220,5 +260,5 @@
 
   window.KF = { el, api, statusIcon, poll, table, dialog, confirmDialog,
                 snack, ns, age, errorBox, detailDialog, svgEl,
-                polylinePoints };
+                polylinePoints, logsPane };
 })();

@@ -119,9 +119,8 @@
   }
 
   /* per-pod LIVE log viewer over status.logTail (the executor's rolling
-   * stdout/stderr mirror — LocalExecutor flushes it ~1/s; this pane
-   * follows it ~2/s while the dialog is open and stops itself once the
-   * pane leaves the document) */
+   * stdout/stderr mirror — LocalExecutor flushes it ~1/s): a pod
+   * selector + follow toggle around the shared KF.logsPane */
   function podLogsPane(podNames) {
     if (!podNames.length) {
       return muted("No pods (gang not admitted, or already cleaned up).");
@@ -129,37 +128,25 @@
     const sel = el("select", null, podNames.map((p) =>
       el("option", { value: p }, p)));
     const follow = el("input", { type: "checkbox", checked: "" });
-    const pre = el("pre", { class: "kf-yaml kf-logs" }, "…");
-    async function refresh() {
-      try {
+    const pane = KF.logsPane(
+      async () => {
         const p = await api.get(`/apis/Pod/${namespace}/${sel.value}`);
-        const lines = (p.status && p.status.logTail) || [];
-        const atBottom = pre.scrollTop + pre.clientHeight >=
-          pre.scrollHeight - 4;
-        pre.textContent = lines.length ? lines.join("\n")
-          : "No log lines yet (container starting, or a runtime " +
-            "without log capture).";
-        if (atBottom) pre.scrollTop = pre.scrollHeight;  // tail -f feel
-      } catch (e) {
-        pre.textContent = `Pod ${sel.value} is gone (${e.message}) — ` +
-          "logs are not retained after pod deletion.";
-      }
-    }
-    refresh();  // immediate first load; the poll only FOLLOWS
-    const handle = KF.poll(async () => {
-      // skip while the pane is on a background tab; the dialog's close
-      // event (via kfStop below) ends the poll for good
-      if (pre.isConnected && follow.checked) await refresh();
-    }, 2000);
-    sel.addEventListener("change", refresh);
+        return (p.status && p.status.logTail) || [];
+      },
+      { empty: "No log lines yet (container starting, or a runtime " +
+               "without log capture).",
+        onError: (e) => `Pod ${sel.value} is gone (${e.message}) — ` +
+          "logs are not retained after pod deletion.",
+        follows: () => follow.checked });
+    sel.addEventListener("change", pane.refresh);
     const node = el("div", null,
       el("div", { class: "row", style: "display:flex;gap:8px;" },
         sel,
         el("label", { class: "chip" }, follow, "follow"),
         el("button", { class: "icon", title: "Refresh",
-          onclick: refresh }, "⟳")),
-      pre);
-    node.kfStop = () => handle.stop();
+          onclick: pane.refresh }, "⟳")),
+      pane);
+    node.kfStop = () => pane.kfStop();
     return node;
   }
 

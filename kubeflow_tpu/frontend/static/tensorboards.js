@@ -89,17 +89,47 @@
     empty: "No tensorboards in this namespace.",
   });
 
-  function openCreate() {
+  async function openCreate() {
     const name = el("input", { type: "text", placeholder: "my-tboard" });
-    const logspath = el("input", { type: "text",
-      placeholder: "pvc://my-volume/logs or gs://bucket/logs" });
+    // source selector: pick an existing volume (the reference form's
+    // PVC dropdown) or type a cloud/object-store path
+    let pvcs = [];
+    try {
+      pvcs = (await api.get(`/volumes/api/namespaces/${namespace}/pvcs`))
+        .pvcs;
+    } catch (e) { /* volumes app denied/down: fall back to paths */ }
+    const source = el("select", null,
+      el("option", { value: "path" }, "cloud / custom path"),
+      pvcs.map((p) => el("option", { value: `pvc:${p.name}` },
+        `volume: ${p.name} (${p.size || "?"})`)));
+    const subpath = el("input", { type: "text",
+      placeholder: "logs/run1 (subpath inside the volume)" });
+    const path = el("input", { type: "text",
+      placeholder: "gs://bucket/logs or pvc://my-volume/logs" });
+    const pathField = el("div", { class: "field" },
+      el("label", null, "Logspath"), path,
+      el("div", { class: "hint" },
+        "pvc://<volume>/<subpath> mounts a volume; gs:// reads from " +
+        "cloud storage"));
+    const subField = el("div", { class: "field" },
+      el("label", null, "Subpath"), subpath);
+    subField.style.display = "none";
+    source.addEventListener("change", () => {
+      const isPvc = source.value.startsWith("pvc:");
+      pathField.style.display = isPvc ? "none" : "";
+      subField.style.display = isPvc ? "" : "none";
+    });
     const err = el("div");
     const create = el("button", { class: "primary", onclick: async () => {
       create.disabled = true;
       err.replaceChildren();
+      const logspath = source.value.startsWith("pvc:")
+        ? `pvc://${source.value.slice(4)}/` +
+          subpath.value.trim().replace(/^\/+/, "")
+        : path.value.trim();
       try {
         await api.post(`${base}/tensorboards`,
-          { name: name.value.trim(), logspath: logspath.value.trim() });
+          { name: name.value.trim(), logspath });
         dlg.close();
         tbl.refresh();
       } catch (e) {
@@ -110,11 +140,9 @@
     const dlg = KF.dialog("New tensorboard",
       el("div", { class: "kf-form" }, err,
         el("div", { class: "field" }, el("label", null, "Name"), name),
-        el("div", { class: "field" }, el("label", null, "Logspath"),
-          logspath,
-          el("div", { class: "hint" },
-            "pvc://<volume>/<subpath> mounts a volume; gs:// reads from " +
-            "cloud storage"))),
+        el("div", { class: "field" },
+          el("label", null, "Log source"), source),
+        pathField, subField),
       [el("button", { onclick: () => dlg.close() }, "Cancel"), create]);
   }
 
@@ -124,6 +152,8 @@
       el("span", { class: "muted" }, `namespace: ${namespace}`),
       el("span", { class: "spacer" }),
       el("button", { class: "primary", id: "new-tensorboard",
-                     onclick: openCreate }, "+ New Tensorboard")),
+                     onclick: () => openCreate()
+                       .catch((e) => KF.snack(e.message)) },
+        "+ New Tensorboard")),
     el("div", { class: "kf-content" }, tbl));
 })();
