@@ -89,9 +89,16 @@ assert rdv["initialized"], rdv
 import jax
 assert jax.process_count() == 2
 assert jax.device_count() == 4  # 2 local CPU devices per process
-from kubeflow_tpu.serving.multihost import MultiHostPredictor
+from kubeflow_tpu.serving.multihost import (MultiHostPredictor,
+                                            broadcast_prompts)
 mh = MultiHostPredictor("llama", size="tiny", tp=2, dp=2, max_seq=64)
-got = mh.generate([[1, 2, 3], [7, 8, 9, 10]], max_new_tokens=8)
+# the front-door fan-out: only rank 0 KNOWS the request; every rank
+# must decode the same prompts
+prompts = broadcast_prompts(
+    [[1, 2, 3], [7, 8, 9, 10]] if jax.process_index() == 0 else None,
+    max_items=4, max_len=16)
+assert prompts == [[1, 2, 3], [7, 8, 9, 10]], prompts
+got = mh.generate(prompts, max_new_tokens=8)
 print(json.dumps({"rank": jax.process_index(), "ids": got}))
 """
 
