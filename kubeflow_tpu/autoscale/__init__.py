@@ -1,0 +1,47 @@
+"""Metrics-driven autoscaling for InferenceServices (the KPA's role).
+
+The reference platform delegates serving elasticity to KServe/Knative; this
+subsystem closes the same loop in-tree: observed request load (gateway
+in-flight counts + serving-engine queue depth) -> per-revision concurrency
+samples -> a deterministic stable/panic-window decider -> a level-triggered
+reconciler that patches the InferenceService's Deployment ``spec.replicas``
+-> the existing workloads controller / quota admission materialize or park
+the pods.  At zero replicas the gateway's activator path holds requests in
+a bounded queue, scales 0->1, and replays them once a backend is Ready.
+
+Components:
+    metrics.MetricsCollector   live in-flight / queue-depth gauges per
+                               (namespace, service) revision key
+    decider.Decider            stable+panic window math over a sample ring
+                               buffer — pure, clock-injected, no sleeps
+    reconciler.Autoscaler      the controller: samples, decides, clamps to
+                               quota, patches spec.replicas, mirrors state
+                               into InferenceService status.autoscaler
+    activator.Activator        scale-from-zero request holding + replay
+
+Opt-in per InferenceService via ``autoscaling.kubeflow.org/*`` annotations
+(see reconciler.ANNOTATIONS); without the ``target`` annotation an
+InferenceService keeps its fixed ``minReplicas`` behavior.
+"""
+
+from kubeflow_tpu.autoscale.activator import Activator
+from kubeflow_tpu.autoscale.decider import Decider, DeciderSpec
+from kubeflow_tpu.autoscale.metrics import MetricsCollector, get_collector
+from kubeflow_tpu.autoscale.reconciler import (
+    ANNO_PREFIX,
+    Autoscaler,
+    autoscaling_enabled,
+    register,
+)
+
+__all__ = [
+    "ANNO_PREFIX",
+    "Activator",
+    "Autoscaler",
+    "Decider",
+    "DeciderSpec",
+    "MetricsCollector",
+    "autoscaling_enabled",
+    "get_collector",
+    "register",
+]

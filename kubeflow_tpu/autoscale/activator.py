@@ -1,0 +1,139 @@
+"""Scale-from-zero: the activator path behind the gateway.
+
+Knative's activator sits in the data path while a revision is at zero: it
+buffers the request, pokes the autoscaler, and replays once a pod is Ready.
+Here the gateway calls :meth:`Activator.wait` when a matched route has no
+live backend AND the destination Service is owned by an autoscaled
+InferenceService:
+
+1. the request joins a BOUNDED per-revision hold queue (counted as
+   concurrency by the metrics collector, so the decider sees the demand
+   and keeps the pod once it exists; overflow -> HeldOverflow -> 503);
+2. the Deployment's ``spec.replicas`` is raised to at least 1 directly —
+   the minimal, idempotent scale-up; the decider takes over from the next
+   tick (its samples include the held requests).  Level-triggered safety:
+   if this write races the reconciler, whoever loses the Conflict simply
+   re-reads — both converge on replicas >= 1;
+3. the caller blocks until ``backend_for_route`` resolves (pod Running
+   with a port mapping) or the deadline passes, then the gateway proxies
+   the ORIGINAL request normally.
+
+Replay safety: the hold happens BEFORE any request body is consumed and
+the eventual proxy uses the gateway's normal path — a brand-new backend
+means a fresh connection, and the existing rule that only idempotent
+replayable requests ride reused sockets is untouched.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubeflow_tpu.autoscale.metrics import (
+    HeldOverflow,
+    MetricsCollector,
+    get_collector,
+)
+from kubeflow_tpu.autoscale.reconciler import (
+    ISVC_KIND,
+    autoscaling_enabled,
+)
+from kubeflow_tpu.core.store import Conflict, NotFound
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+HELD_TOTAL = REGISTRY.counter("activator_held_requests_total",
+                              "requests held for scale-from-zero",
+                              labels=("outcome",))
+
+log = get_logger("activator")
+
+
+class Activator:
+    def __init__(self, server, collector: MetricsCollector | None = None, *,
+                 max_held: int = 100, poll: float = 0.05,
+                 timeout: float = 60.0):
+        self.server = server
+        self.collector = collector or get_collector(server)
+        self.max_held = max_held     # the bounded queue, per revision
+        self.poll = poll
+        self.timeout = timeout
+
+    def covers(self, route) -> tuple | None:
+        """(namespace, service) when the route's destination is an
+        autoscaled InferenceService, else None (the gateway 503s as
+        before).  The Service and its InferenceService share a name."""
+        svc, ns = route.dest_service, route.dest_namespace
+        if svc is None or ns is None:
+            return None
+        try:
+            isvc = self.server.get(ISVC_KIND, svc, ns)
+        except NotFound:
+            return None
+        return (ns, svc) if autoscaling_enabled(isvc) else None
+
+    def wait(self, route, path, key: tuple):
+        """Hold until a backend is READY; returns a Backend or raises
+        NoBackend/HeldOverflow for the gateway to turn into 503.
+
+        Ready means accepting connections, not merely resolvable: a pod
+        reports Running (with its port mapping) before its process binds
+        the port — for a scale-from-zero predictor that gap is the whole
+        model init, far longer than the gateway's bind-race retries — so
+        the held request is only replayed once a TCP connect succeeds
+        (Knative's activator probes readiness the same way)."""
+        from kubeflow_tpu.gateway import NoBackend, backend_for_route
+
+        ns, svc = key
+        with self.collector.hold(key, self.max_held):
+            self._ensure_scale(ns, svc)
+            deadline = time.monotonic() + self.timeout
+            while True:
+                backend = None
+                try:
+                    backend = backend_for_route(self.server, route, path)
+                except NoBackend:
+                    pass
+                if backend is not None and _reachable(backend):
+                    HELD_TOTAL.labels("served").inc()
+                    return backend
+                if time.monotonic() >= deadline:
+                    HELD_TOTAL.labels("timeout").inc()
+                    raise NoBackend(
+                        f"{ns}/{svc}: no backend became ready within "
+                        f"{self.timeout:.0f}s of scale-from-zero")
+                time.sleep(self.poll)
+
+    def _ensure_scale(self, ns: str, svc: str) -> None:
+        """Idempotently raise the Deployment to >= 1 replica (the poke).
+        A missing Deployment is fine — the InferenceService controller is
+        mid-materialization and creates it with initialScale."""
+        for _ in range(5):
+            try:
+                dep = self.server.get("Deployment", svc, ns)
+            except NotFound:
+                return
+            if int(dep.get("spec", {}).get("replicas", 0)) >= 1:
+                return
+            dep["spec"]["replicas"] = 1
+            try:
+                self.server.update(dep)
+                log.info("activator scaled from zero", namespace=ns,
+                         service=svc)
+                return
+            except (Conflict, NotFound):
+                continue  # raced the reconciler; re-read and retry
+
+
+def _reachable(backend) -> bool:
+    """One cheap TCP connect: is the resolved backend actually ready?"""
+    import socket
+
+    try:
+        with socket.create_connection((backend.host, backend.port),
+                                      timeout=1.0):
+            return True
+    except OSError:
+        return False
+
+
+__all__ = ["Activator", "HeldOverflow"]

@@ -1,0 +1,161 @@
+"""KPA-style scaling decisions: stable window + panic window over a ring
+buffer of concurrency samples.
+
+All math is deterministic and clock-injected — callers pass ``now`` into
+``record``/``desired`` explicitly, so every path is testable without sleeps
+(the decider tests drive a fake clock).  Per design decision 9
+(ARCHITECTURE.md) the decider owns NO state that cannot be rebuilt from its
+sample buffer plus the stored objects: a restarted autoscaler starts with an
+empty buffer, observes for one window, and converges to the same answer.
+
+Semantics (Knative KPA, simplified to what the math needs):
+
+- ``desired_raw = ceil(avg_concurrency / target)`` where the average is
+  taken over the STABLE window (default 60s);
+- a much shorter PANIC window (default stable/10) reacts to bursts: when
+  the panic-window desired reaches ``panic_threshold`` x the currently
+  ready pods, the decider enters panic mode and scales to the MAX of the
+  stable and panic answers — and never scales down while panicking (the
+  high-water mark is held until a full stable window passes with no
+  re-trigger);
+- scale-DOWN decisions are delayed: the applied desired is the max of the
+  raw desired over ``scale_down_delay`` trailing seconds, so a transient
+  dip (or the gap between two bursts) does not tear pods down only to
+  recreate them;
+- the result is clamped to [min_scale, max_scale]; min_scale=0 enables
+  scale-to-zero (an empty window averages to 0 -> desired 0).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class DeciderSpec:
+    """Per-revision tuning, parsed from the InferenceService's
+    ``autoscaling.kubeflow.org/*`` annotations (reconciler.spec_from)."""
+
+    target: float = 2.0            # concurrency each pod should carry
+    stable_window: float = 60.0    # seconds of samples behind scale-down
+    panic_window: float = 6.0      # seconds of samples behind burst scale-up
+    panic_threshold: float = 2.0   # panic when desired >= ready * this
+    scale_down_delay: float = 0.0  # extra trailing max over raw desired
+    min_scale: int = 0
+    max_scale: int = 100
+    initial_scale: int = 1         # replicas at Deployment creation
+    tick: float = 1.0              # reconciler sampling period (seconds)
+
+
+@dataclass
+class Decision:
+    desired: int          # clamped, delay-applied answer
+    panic: bool
+    stable_concurrency: float
+    panic_concurrency: float
+
+
+class _WindowBuffer:
+    """Ring buffer of (t, value) retaining ``horizon`` seconds of samples."""
+
+    def __init__(self, horizon: float):
+        self.horizon = horizon
+        self._buf: deque[tuple[float, float]] = deque()
+
+    def record(self, now: float, value: float) -> None:
+        self._buf.append((now, value))
+        self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.horizon
+        while self._buf and self._buf[0][0] < cutoff:
+            self._buf.popleft()
+
+    def average(self, now: float, window: float) -> float:
+        """Arithmetic mean of samples in [now - window, now]; 0 if empty."""
+        cutoff = now - window
+        total = 0.0
+        n = 0
+        for t, v in reversed(self._buf):
+            if t < cutoff:
+                break
+            total += v
+            n += 1
+        return total / n if n else 0.0
+
+    def max(self, now: float, window: float) -> float:
+        cutoff = now - window
+        best = 0.0
+        for t, v in reversed(self._buf):
+            if t < cutoff:
+                break
+            best = v if v > best else best
+        return best
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+
+class Decider:
+    """One revision's scaling brain.  ``record`` feeds a concurrency sample,
+    ``desired`` answers "how many pods right now" — both take ``now``."""
+
+    def __init__(self, spec: DeciderSpec):
+        self.spec = spec
+        self._samples = _WindowBuffer(spec.stable_window)
+        # raw desired history: the trailing max implements scale-down delay
+        self._desired = _WindowBuffer(max(spec.scale_down_delay, 0.0))
+        self._panic_since: float | None = None
+        self._panic_high = 0
+
+    def update_spec(self, spec: DeciderSpec) -> None:
+        """Annotations changed mid-flight: retune without losing samples."""
+        if spec == self.spec:
+            return
+        self.spec = spec
+        self._samples.horizon = spec.stable_window
+        self._desired.horizon = max(spec.scale_down_delay, 0.0)
+
+    def record(self, now: float, concurrency: float) -> None:
+        self._samples.record(now, concurrency)
+
+    def desired(self, now: float, ready: int) -> Decision:
+        spec = self.spec
+        stable_avg = self._samples.average(now, spec.stable_window)
+        panic_avg = self._samples.average(now, spec.panic_window)
+        want_stable = math.ceil(stable_avg / spec.target)
+        want_panic = math.ceil(panic_avg / spec.target)
+
+        # enter (or re-trigger) panic when the burst-window answer dwarfs
+        # what is actually ready; ready=0 panics on ANY demand — the
+        # activator's held requests must win a pod immediately and keep
+        # it (panic's never-scale-down hold) through the cold start
+        over = (want_panic >= ready * spec.panic_threshold if ready > 0
+                else want_panic > 0)
+        if over and want_panic > 0:
+            self._panic_since = now
+            self._panic_high = max(self._panic_high, want_panic)
+        elif (self._panic_since is not None
+              and now - self._panic_since >= spec.stable_window):
+            # a full stable window with no re-trigger: stand down
+            self._panic_since = None
+            self._panic_high = 0
+
+        panic = self._panic_since is not None
+        if panic:
+            # never scale down during panic: hold the high-water mark
+            raw = max(want_stable, want_panic, self._panic_high)
+            self._panic_high = raw
+        else:
+            raw = want_stable
+
+        self._desired.record(now, raw)
+        delayed = (max(raw, int(self._desired.max(
+            now, spec.scale_down_delay)))
+            if spec.scale_down_delay > 0 else raw)
+        clamped = min(max(delayed, spec.min_scale), spec.max_scale)
+        return Decision(desired=clamped, panic=panic,
+                        stable_concurrency=stable_avg,
+                        panic_concurrency=panic_avg)

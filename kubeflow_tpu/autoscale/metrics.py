@@ -1,0 +1,139 @@
+"""Live concurrency observation: the autoscaler's metrics collector.
+
+One ``MetricsCollector`` per APIServer aggregates, per revision key
+``(namespace, service)``:
+
+- in-flight proxied requests — the gateway increments on proxy start and
+  decrements when the response stream finishes (Envoy's upstream_rq_active
+  per cluster);
+- activator-held requests — demand arriving at zero replicas counts as
+  concurrency too (Knative counts queued-at-activator), or the decider
+  would see silence exactly when it must scale 0->1;
+- optional pull sources — e.g. an in-process serving engine's
+  ``stats()`` snapshot (serving/engine.py), registered with
+  ``add_source``; their active+queued counts fold into the snapshot.
+
+The collector is a GAUGE layer only: windowing/averaging lives in the
+decider's ring buffer, fed by the reconciler sampling ``concurrency()``
+every tick.  Everything here is thread-safe (gateway worker threads,
+activator holds, and the reconciler all touch it concurrently).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable
+
+Key = tuple  # (namespace, service-name)
+
+
+class HeldOverflow(RuntimeError):
+    """The activator's bounded hold queue for a revision is full."""
+
+
+class MetricsCollector:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._inflight: dict[Key, int] = {}
+        self._held: dict[Key, int] = {}
+        # key -> stats fn returning a dict with "active"/"queued" counts
+        self._sources: dict[Key, Callable[[], dict]] = {}
+
+    # -- gateway in-flight -----------------------------------------------------
+    def inc(self, key: Key) -> None:
+        with self._lock:
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+
+    def dec(self, key: Key) -> None:
+        with self._lock:
+            n = self._inflight.get(key, 0) - 1
+            if n > 0:
+                self._inflight[key] = n
+            else:
+                self._inflight.pop(key, None)
+
+    # -- activator holds -------------------------------------------------------
+    def hold(self, key: Key, limit: int) -> "_Hold":
+        """Context manager counting one held request; raises
+        :class:`HeldOverflow` when ``limit`` requests already wait."""
+        with self._lock:
+            if self._held.get(key, 0) >= limit:
+                raise HeldOverflow(
+                    f"{key[0]}/{key[1]}: {limit} requests already held "
+                    "waiting for scale-from-zero")
+            self._held[key] = self._held.get(key, 0) + 1
+        return _Hold(self, key)
+
+    def _release(self, key: Key) -> None:
+        with self._lock:
+            n = self._held.get(key, 0) - 1
+            if n > 0:
+                self._held[key] = n
+            else:
+                self._held.pop(key, None)
+
+    # -- pull sources (serving engine stats) -----------------------------------
+    def add_source(self, key: Key, stats_fn: Callable[[], dict]) -> None:
+        """Register an in-process stats snapshot (e.g.
+        ``ContinuousBatcher.stats``) folded into ``concurrency(key)``."""
+        with self._lock:
+            self._sources[key] = stats_fn
+
+    def remove_source(self, key: Key) -> None:
+        with self._lock:
+            self._sources.pop(key, None)
+
+    # -- the reconciler's read -------------------------------------------------
+    def concurrency(self, key: Key) -> float:
+        """Current demand on the revision: in-flight + held + source
+        active/queued.  Sampled by the autoscaler every tick."""
+        with self._lock:
+            total = float(self._inflight.get(key, 0)
+                          + self._held.get(key, 0))
+            source = self._sources.get(key)
+        if source is not None:
+            try:
+                stats = source()
+                total += float(stats.get("active", 0)
+                               + stats.get("queued", 0))
+            except Exception:
+                pass  # a dying engine must not take the autoscaler down
+        return total
+
+    def queue_depth(self, key: Key) -> int:
+        with self._lock:
+            return self._held.get(key, 0)
+
+    def snapshot(self) -> dict[Key, float]:
+        """All keys with live demand (dashboard/debugging)."""
+        with self._lock:
+            keys = set(self._inflight) | set(self._held) | set(self._sources)
+        return {k: self.concurrency(k) for k in keys}
+
+
+class _Hold:
+    def __init__(self, collector: MetricsCollector, key: Key):
+        self._collector = collector
+        self._key = key
+
+    def __enter__(self) -> "_Hold":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._collector._release(self._key)
+
+
+# one collector per APIServer, discoverable by every layer that feeds or
+# reads it (the gateway and the reconciler are constructed at different
+# times — build_platform vs build_wsgi_app — so neither can own it)
+_COLLECTORS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_COLLECTORS_LOCK = threading.Lock()
+
+
+def get_collector(server) -> MetricsCollector:
+    with _COLLECTORS_LOCK:
+        collector = _COLLECTORS.get(server)
+        if collector is None:
+            collector = _COLLECTORS[server] = MetricsCollector()
+        return collector

@@ -1,0 +1,254 @@
+"""The autoscaler reconciler: samples -> decision -> patched replicas.
+
+Level-triggered (ARCHITECTURE.md design decision 9): every tick recomputes
+the whole answer from stored objects (InferenceService annotations, the
+Deployment's replicas/readyReplicas, the namespace ResourceQuota) plus the
+live sample gauge — no hidden counters that can drift.  The decider's ring
+buffer is the only in-memory state, and it rebuilds from observation after
+a restart (one stable window of samples converges to the same answer).
+
+Scale-ups are clamped to what the namespace TPU quota can actually admit
+BEFORE touching ``spec.replicas``: raising replicas past quota would make
+the workloads controller create pods that admission rejects every 2s
+forever (thrash).  Instead the shortfall PARKS — surfaced as
+``status.autoscaler.parked`` — and the next tick retries, so capacity
+freed elsewhere is picked up within one tick (the same park-don't-thrash
+contract the JAXJob gang controller honors).
+
+Opt-in + tuning via annotations on the InferenceService:
+
+    autoscaling.kubeflow.org/target            REQUIRED; concurrency per pod
+    autoscaling.kubeflow.org/minReplicas       default 0 (scale-to-zero)
+    autoscaling.kubeflow.org/maxReplicas       default 100
+    autoscaling.kubeflow.org/window            stable window s, default 60
+    autoscaling.kubeflow.org/panicWindow       default window/10
+    autoscaling.kubeflow.org/panicThreshold    default 2.0
+    autoscaling.kubeflow.org/scaleDownDelay    default 0 s
+    autoscaling.kubeflow.org/initialScale      default 1
+    autoscaling.kubeflow.org/tick              sample period s, default 1
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubeflow_tpu.autoscale.decider import Decider, DeciderSpec, Decision
+from kubeflow_tpu.autoscale.metrics import MetricsCollector, get_collector
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core import quota as quota_mod
+from kubeflow_tpu.core.store import Conflict, NotFound
+from kubeflow_tpu.parallel.mesh import TOPOLOGIES
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+ANNO_PREFIX = "autoscaling.kubeflow.org/"
+ISVC_KIND = "InferenceService"
+
+DESIRED = REGISTRY.gauge("autoscaler_desired_replicas",
+                         "decider output before quota clamp",
+                         labels=("namespace", "name"))
+PARKED = REGISTRY.gauge("autoscaler_parked_replicas",
+                        "replicas wanted but parked on TPU quota",
+                        labels=("namespace", "name"))
+PANIC = REGISTRY.gauge("autoscaler_panic_mode",
+                       "1 while the revision is in panic scaling",
+                       labels=("namespace", "name"))
+
+
+def autoscaling_enabled(isvc: dict) -> bool:
+    annos = isvc.get("metadata", {}).get("annotations") or {}
+    return (ANNO_PREFIX + "target") in annos
+
+
+def spec_from(isvc: dict) -> DeciderSpec:
+    """Parse the annotations into a DeciderSpec (defaults above); invalid
+    values fall back to the default rather than wedging the reconcile."""
+    annos = isvc.get("metadata", {}).get("annotations") or {}
+
+    def num(key: str, default: float, cast=float):
+        raw = annos.get(ANNO_PREFIX + key)
+        if raw is None:
+            return default
+        try:
+            return cast(raw)
+        except (TypeError, ValueError):
+            return default
+
+    window = max(num("window", 60.0), 0.1)
+    return DeciderSpec(
+        target=max(num("target", 2.0), 0.01),
+        stable_window=window,
+        panic_window=max(num("panicWindow", window / 10.0), 0.01),
+        panic_threshold=max(num("panicThreshold", 2.0), 1.0),
+        scale_down_delay=max(num("scaleDownDelay", 0.0), 0.0),
+        min_scale=max(num("minReplicas", 0, int), 0),
+        max_scale=max(num("maxReplicas", 100, int), 1),
+        initial_scale=max(num("initialScale", 1, int), 0),
+        tick=max(num("tick", 1.0), 0.01),
+    )
+
+
+def initial_replicas(isvc: dict) -> int:
+    """What the InferenceService controller should create the Deployment
+    with when autoscaling owns replicas (clamped into [min, max])."""
+    spec = spec_from(isvc)
+    return min(max(spec.initial_scale, spec.min_scale), spec.max_scale)
+
+
+def pod_tpu_need(isvc: dict) -> dict[str, int]:
+    """Per-pod quota charge for this predictor (mirrors the container the
+    InferenceService controller writes)."""
+    pred = isvc.get("spec", {}).get("predictor", {})
+    topo = TOPOLOGIES[pred.get("topology", "v5e-4")]
+    return {quota_mod.POD_COUNT_KEY: 1, topo.resource_name: topo.chips}
+
+
+class Autoscaler(Controller):
+    """Ticks every ``spec.tick`` seconds per autoscaled InferenceService:
+    sample the collector, run the decider, clamp to quota, patch the
+    Deployment's ``spec.replicas``, and mirror the decision into
+    ``status.autoscaler`` (the dashboard reads it from the store)."""
+
+    kind = ISVC_KIND
+    owns = ("Deployment",)
+
+    def __init__(self, server, collector: MetricsCollector | None = None,
+                 clock=time.monotonic):
+        super().__init__(server)
+        self.collector = collector or get_collector(server)
+        self.clock = clock
+        # (ns, name, uid) -> Decider: uid-keyed so a same-name recreation
+        # starts with a fresh buffer (scheduler learned this the hard way)
+        self._deciders: dict[tuple, Decider] = {}
+        # last sample time per decider: watch events (our own status
+        # patches, Deployment readyReplicas flips) re-trigger reconcile
+        # off-cadence, and the window average is a mean over sample
+        # COUNT — unthrottled event samples would skew it toward bursts
+        self._last_sample: dict[tuple, float] = {}
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            isvc = self.server.get(ISVC_KIND, req.name, req.namespace)
+        except NotFound:
+            self._drop(req.namespace, req.name)
+            return None
+        if (not autoscaling_enabled(isvc)
+                or isvc["metadata"].get("deletionTimestamp")):
+            self._drop(req.namespace, req.name)
+            return None
+        spec = spec_from(isvc)
+        dkey = (req.namespace, req.name, isvc["metadata"].get("uid"))
+        decider = self._deciders.get(dkey)
+        if decider is None:
+            self._drop(req.namespace, req.name)  # stale uid, if any
+            decider = self._deciders[dkey] = Decider(spec)
+        else:
+            decider.update_spec(spec)
+
+        now = self.clock()
+        concurrency = self.collector.concurrency((req.namespace, req.name))
+        if now - self._last_sample.get(dkey, -1e18) >= spec.tick / 2:
+            decider.record(now, concurrency)
+            self._last_sample[dkey] = now
+
+        try:
+            dep = self.server.get("Deployment", req.name, req.namespace)
+        except NotFound:
+            # the InferenceService controller hasn't materialized it yet
+            return Result(requeue_after=spec.tick)
+        current = int(dep.get("spec", {}).get("replicas", 0))
+        ready = int(dep.get("status", {}).get("readyReplicas", 0))
+
+        decision = decider.desired(now, ready)
+        applied, parked = self._quota_clamp(isvc, req.namespace,
+                                            current, decision.desired)
+        if applied != current:
+            self._patch_replicas(dep, applied)
+        self._mirror(isvc, decision, applied, parked, concurrency)
+        return Result(requeue_after=spec.tick)
+
+    # -- pieces ----------------------------------------------------------------
+    def _quota_clamp(self, isvc: dict, ns: str | None, current: int,
+                     desired: int) -> tuple[int, int]:
+        """(applied, parked): largest replica count <= desired that fits
+        the namespace TPU quota.  The candidate count is charged as
+        DECLARED replicas against the namespace usage minus this
+        revision's own live pods — so a tick landing between a replicas
+        patch and its pods materializing sees the same answer (no
+        over-admit, no flap).  Scale-downs never consult quota."""
+        if desired <= current:
+            return desired, 0
+        hard = quota_mod.quota_hard(self.server, ns)
+        if hard is None:
+            return desired, 0
+        per_pod = pod_tpu_need(isvc)
+        usage = dict(quota_mod.namespace_usage(self.server, ns))
+        name = isvc["metadata"]["name"]
+        for pod in self.server.project(
+                "Pod", ("status.phase", "spec.containers"), namespace=ns,
+                label_selector={"matchLabels": {"isvc": name}}):
+            if pod.get("status", {}).get("phase") \
+                    in quota_mod.TERMINAL_PHASES:
+                continue
+            for key, val in quota_mod.pod_tpu_requests(pod).items():
+                usage[key] = usage.get(key, 0) - val
+        for n in range(desired, current, -1):
+            if all(usage.get(key, 0) + val * n <= hard[key]
+                   for key, val in per_pod.items() if key in hard):
+                return n, desired - n
+        return current, desired - current
+
+    def _patch_replicas(self, dep: dict, replicas: int) -> None:
+        dep["spec"]["replicas"] = replicas
+        try:
+            self.server.update(dep)
+        except (Conflict, NotFound):
+            pass  # level-triggered: next tick re-reads and re-decides
+
+    # concurrency readings jitter every tick; they ride along when a
+    # DECISION changes but never trigger a write by themselves (a
+    # per-tick status bump would journal a WAL record and spin every
+    # InferenceService watcher for as long as load lasts)
+    _EPHEMERAL_STATE = ("stableConcurrency", "panicConcurrency")
+
+    def _mirror(self, isvc: dict, decision: Decision, applied: int,
+                parked: int, concurrency: float) -> None:
+        ns = isvc["metadata"]["namespace"]
+        name = isvc["metadata"]["name"]
+        state = {
+            "desiredReplicas": decision.desired,
+            "appliedReplicas": applied,
+            "parked": parked,
+            "panic": decision.panic,
+            "stableConcurrency": round(decision.stable_concurrency, 2),
+            "panicConcurrency": round(decision.panic_concurrency, 2),
+        }
+        DESIRED.labels(ns, name).set(decision.desired)
+        PARKED.labels(ns, name).set(parked)
+        PANIC.labels(ns, name).set(1 if decision.panic else 0)
+
+        def material(s: dict) -> dict:
+            return {k: v for k, v in s.items()
+                    if k not in self._EPHEMERAL_STATE}
+
+        prior = isvc.get("status", {}).get("autoscaler") or {}
+        if material(prior) == material(state):
+            return
+        # re-read right before writing: patch_status replaces the WHOLE
+        # status, and the InferenceService controller mirrors ready/url
+        # into the same object — patching over the tick-start read would
+        # widen the clobber window to the entire tick
+        try:
+            fresh = self.server.get(ISVC_KIND, name, ns)
+        except NotFound:
+            return
+        self.server.patch_status(ISVC_KIND, name, ns, {
+            **fresh.get("status", {}), "autoscaler": state})
+
+    def _drop(self, ns: str | None, name: str) -> None:
+        for key in [k for k in self._deciders
+                    if k[0] == ns and k[1] == name]:
+            del self._deciders[key]
+
+
+def register(server, mgr) -> None:
+    mgr.add(Autoscaler(server))

@@ -103,6 +103,12 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                      "tests/test_quant.py"],
         "image": "images/predictor",
     },
+    "autoscale": {
+        "include_dirs": ["kubeflow_tpu/autoscale/*",
+                         "kubeflow_tpu/gateway.py"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q",
+                     "tests/test_autoscale.py", "tests/test_gateway.py"],
+    },
     "pipelines": {
         "include_dirs": ["kubeflow_tpu/controllers/pipeline.py",
                          "kubeflow_tpu/api/pipeline.py",

@@ -40,10 +40,15 @@ class FakeExecutor(Controller):
                  always_fail: set[str] | None = None,
                  complete: bool = True, run_for: float = 0.0,
                  metrics_script: dict[str, list[dict]] | None = None,
-                 metrics_all: list[dict] | None = None):
+                 metrics_all: list[dict] | None = None,
+                 portmap: dict[str, int] | None = None):
         super().__init__(server)
         self.fail_once = set(fail_once or ())
         self.always_fail = set(always_fail or ())
+        # containerPort -> host port stamped into every Running pod's
+        # status (the LocalExecutor allocates these for real; tests that
+        # route gateway traffic at fake pods point this at a stub server)
+        self.portmap = dict(portmap or {})
         # pod name -> metrics dicts surfaced one per reconcile while
         # Running (deterministic stand-in for the LocalExecutor's log
         # scraping; exercises intermediate-metric consumers).
@@ -74,12 +79,14 @@ class FakeExecutor(Controller):
             # mirror the LocalExecutor's pod-status surface: a rolling
             # logTail rides status so log consumers (the UI's per-worker
             # Logs pane, the contract test) see the same shape either way
-            self.server.patch_status("Pod", req.name, req.namespace,
-                                     {**pod.get("status", {}),
-                                      "phase": "Running",
-                                      "nodeName": "fake-node",
-                                      "logTail": [f"{req.name}: started "
-                                                  "(fake executor)"]})
+            status = {**pod.get("status", {}),
+                      "phase": "Running",
+                      "nodeName": "fake-node",
+                      "logTail": [f"{req.name}: started (fake executor)"]}
+            if self.portmap:
+                status["podIP"] = "127.0.0.1"
+                status["portMap"] = dict(self.portmap)
+            self.server.patch_status("Pod", req.name, req.namespace, status)
             return Result(requeue_after=0.01)
         if phase == "Running":
             name = req.name

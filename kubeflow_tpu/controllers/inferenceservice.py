@@ -33,6 +33,24 @@ class InferenceServiceController(Controller):
         self._mirror_status(isvc)
         return None
 
+    def _replicas(self, isvc: dict, live: dict | None) -> int:
+        """Fixed ``minReplicas`` normally; when the autoscale subsystem
+        owns the InferenceService (autoscaling.kubeflow.org/target
+        annotation), the live Deployment's replica count is authoritative
+        — reasserting minReplicas here would tug-of-war with every
+        autoscaler patch — and a fresh Deployment starts at initialScale."""
+        pred = isvc["spec"]["predictor"]
+        try:
+            from kubeflow_tpu.autoscale import reconciler as autoscale_rec
+        except ImportError:
+            autoscale_rec = None
+        if autoscale_rec is not None and \
+                autoscale_rec.autoscaling_enabled(isvc):
+            if live is not None:
+                return int(live.get("spec", {}).get("replicas", 0))
+            return autoscale_rec.initial_replicas(isvc)
+        return int(pred.get("minReplicas", 1))
+
     def _ensure_deployment(self, isvc: dict) -> None:
         name = isvc["metadata"]["name"]
         ns = isvc["metadata"]["namespace"]
@@ -51,8 +69,12 @@ class InferenceServiceController(Controller):
             "ports": [{"containerPort": api.PORT}],
             "resources": {"limits": {topo.resource_name: topo.chips}},
         }
+        try:
+            live = self.server.get("Deployment", name, ns)
+        except NotFound:
+            live = None
         desired = set_owner(api_object("Deployment", name, ns, spec={
-            "replicas": int(pred.get("minReplicas", 1)),
+            "replicas": self._replicas(isvc, live),
             "selector": {"matchLabels": {"isvc": name}},
             "template": {"metadata": {"labels": {"isvc": name}},
                          "spec": {"containers": [container],
@@ -60,13 +82,12 @@ class InferenceServiceController(Controller):
                                       "cloud-tpu.google.com/slice":
                                       topo.name}}},
         }), isvc)
-        try:
-            live = self.server.get("Deployment", name, ns)
+        if live is None:
+            self.server.create(desired)
+        else:
             merged, changed = ENGINE.reconcile_merge(live, desired)
             if changed:
                 self.server.update(merged)
-        except NotFound:
-            self.server.create(desired)
 
     def _ensure_service(self, isvc: dict) -> None:
         name = isvc["metadata"]["name"]
@@ -109,7 +130,16 @@ class InferenceServiceController(Controller):
         except NotFound:
             pass
         set_condition(isvc, "Ready", "True" if ready else "False")
+        # merge over a FRESH read: patch_status replaces the whole status,
+        # and the autoscaler mirrors status.autoscaler into the same
+        # object — merging over the reconcile-start copy would clobber
+        # any block it wrote since
+        try:
+            fresh = self.server.get(api.KIND, name, ns)
+        except NotFound:
+            return
         self.server.patch_status(api.KIND, name, ns, {
+            **fresh.get("status", {}),
             "ready": bool(ready),
             "url": f"/serving/{ns}/{name}/",
             "conditions": isvc["status"]["conditions"]})

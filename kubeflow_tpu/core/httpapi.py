@@ -237,7 +237,17 @@ class RestAPI:
     def _user(self, environ) -> str | None:
         auth = environ.get("HTTP_AUTHORIZATION", "")
         if self.tokens and auth.startswith("Bearer "):
-            user = self.tokens.get(auth[len("Bearer "):])
+            presented = auth[len("Bearer "):].encode()
+            # constant-time comparison against EVERY stored token, no
+            # early exit (ADVICE r5): a dict lookup short-circuits on the
+            # first differing byte, letting a caller probe token prefixes
+            # via response timing
+            import hmac
+
+            user = None
+            for token, mapped in self.tokens.items():
+                if hmac.compare_digest(token.encode(), presented):
+                    user = mapped
             if user is None:
                 # kube-apiserver semantics: presenting an INVALID bearer
                 # token hard-fails the request — falling through to the
@@ -261,6 +271,30 @@ class RestAPI:
             length = 0
         raw = environ["wsgi.input"].read(length) if length else b"{}"
         return json.loads(raw or b"{}")
+
+
+class _CountingReader:
+    """wsgi.input wrapper counting consumed body bytes, so the handler
+    knows how much of a declared request body the app left unread."""
+
+    def __init__(self, f):
+        self._f = f
+        self.consumed = 0
+
+    def read(self, *args):
+        data = self._f.read(*args)
+        self.consumed += len(data)
+        return data
+
+    def readline(self, *args):
+        data = self._f.readline(*args)
+        self.consumed += len(data)
+        return data
+
+    def __iter__(self):
+        for line in self._f:
+            self.consumed += len(line)
+            yield line
 
 
 def serve(app, port: int, host: str = "127.0.0.1", upgrade=None,
@@ -354,6 +388,28 @@ def serve(app, port: int, host: str = "127.0.0.1", upgrade=None,
         # forever (Envoy/nginx idle_timeout); a client that sends nothing
         # for this long is disconnected
         IDLE_TIMEOUT = 75.0
+        # at most this much unread request body is drained before close
+        DRAIN_BODY_MAX = 1 << 20
+
+        def _drain_body(self, reader, declared: int) -> None:
+            """Read-and-discard the unread remainder of a declared request
+            body before the socket closes (ADVICE r5): answering early
+            (403 before the app touches the body) and closing while the
+            client is still writing triggers an RST that can discard the
+            client's buffered copy of our response — the error message is
+            lost.  Bounded: an oversized remainder still closes hard."""
+            remaining = declared - reader.consumed
+            if not 0 < remaining <= self.DRAIN_BODY_MAX:
+                return
+            try:
+                self.connection.settimeout(2.0)
+                while remaining > 0:
+                    chunk = reader.read(min(65536, remaining))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
+            except (TimeoutError, OSError, ValueError):
+                pass
 
         def _handle_one(self):
             # WSGIRequestHandler.handle, with an upgrade-interception
@@ -391,18 +447,26 @@ def serve(app, port: int, host: str = "127.0.0.1", upgrade=None,
             # paths: gateway GETs, watch-less API reads).  Chunked
             # transfer encoding is a body too, with no Content-Length.
             try:
-                has_body = (int(self.headers.get("Content-Length")
-                                or 0) > 0
+                declared_body = int(self.headers.get("Content-Length")
+                                    or 0)
+                has_body = (declared_body > 0
                             or bool(self.headers.get(
                                 "Transfer-Encoding")))
             except ValueError:
+                declared_body = 0
                 has_body = True
+            # count the app's body consumption so the unread remainder
+            # can be drained before close (no RST-discarded responses)
+            stdin = (_CountingReader(self.rfile) if declared_body > 0
+                     else self.rfile)
             handler = KeepAliveServerHandler(
-                self.rfile, self.wfile, self.get_stderr(),
+                stdin, self.wfile, self.get_stderr(),
                 self.get_environ(), multithread=True)
             handler.request_handler = self
             handler.announce_close = has_body
             handler.run(self.server.get_app())
+            if declared_body > 0:
+                self._drain_body(stdin, declared_body)
             # keep the connection only when the response was length-
             # framed AND fully delivered — a truncated body (backend died
             # mid-stream; wsgiref swallows app errors once headers are

@@ -72,6 +72,15 @@ WAL_COMPACTIONS = REGISTRY.counter(
 COMPACTION_PAUSE = REGISTRY.gauge(
     "persistence_last_compaction_pause_seconds",
     "store-lock hold of the most recent mid-run WAL compaction")
+COMPACTION_FAILURES = REGISTRY.counter(
+    "persistence_compaction_failures_total",
+    "background compactions that failed (WAL segments retained)")
+# consecutive failures is the ALARM signal: one failed pass is disk
+# hiccup noise, a climbing streak means every threshold crossing is
+# rotating a segment that will never be reclaimed (unbounded disk growth)
+COMPACTION_FAILURE_STREAK = REGISTRY.gauge(
+    "persistence_compaction_failure_streak",
+    "consecutive failed background compactions (0 = healthy)")
 
 # ephemeral status fields never journaled: high-churn, re-derivable
 EPHEMERAL_STATUS = ("logTail",)
@@ -224,6 +233,7 @@ class Persister:
         self.wal = WriteAheadLog(os.path.join(data_dir, WAL), fsync=fsync)
         self._inflight: threading.Thread | None = None
         self._lock_fd: int | None = None  # flock on data_dir/LOCK
+        self.consecutive_failures = 0  # background compactions in a row
 
     def journal(self, op: str, payload) -> None:
         if op == "put":
@@ -279,11 +289,24 @@ class Persister:
             for seg in segs:
                 os.remove(seg)
             WAL_COMPACTIONS.inc()
+            self.consecutive_failures = 0
+            COMPACTION_FAILURE_STREAK.set(0)
             log.info("WAL compacted mid-run", objects=len(objs),
                      lock_pause_ms=round(pause * 1e3, 1))
-        except OSError as e:  # disk trouble: segments stay; next
-            # threshold crossing retries with a fresh rotation
-            log.error("background compaction failed", error=str(e))
+        except Exception as e:  # NOT just OSError (ADVICE r5): a
+            # non-JSON-serializable value in the store raises TypeError
+            # from json.dump, and swallowing it with a bare traceback
+            # would silently kill compaction while every later threshold
+            # crossing rotates another never-reclaimed segment.  Segments
+            # stay on disk; the next crossing retries with a fresh
+            # rotation, and the failure streak is the operator's alarm.
+            self.consecutive_failures += 1
+            COMPACTION_FAILURES.inc()
+            COMPACTION_FAILURE_STREAK.set(self.consecutive_failures)
+            log.error("background compaction failed",
+                      error=str(e), error_type=type(e).__name__,
+                      consecutive_failures=self.consecutive_failures,
+                      retained_segments=len(segs))
 
     def quiesce(self, timeout: float = 30.0) -> None:
         """Wait for an in-flight background compaction (tests; shutdown)."""
@@ -329,72 +352,91 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
             f"data dir {data_dir!r} already has a live writer "
             "(LOCK held); detach() it first")
 
-    # -- replay (no admission, no events: records were already admitted;
-    # EXCEPT version conversion — after a storage-version upgrade, old-hub
-    # records must up-convert exactly as admission would, so the post-
-    # replay compaction rewrites the disk in the new hub version
-    # (ARCHITECTURE.md "Storage-version policy")) --
-    from kubeflow_tpu.api import versions as _versions
+    # everything past the flock must release it on failure (ADVICE r5):
+    # a raise during replay, orphan GC, or the post-replay compact would
+    # otherwise leak the held LOCK fd, making every in-process retry of
+    # attach() fail "already has a live writer" with no writer alive
+    persister: Persister | None = None
+    try:
+        # -- replay (no admission, no events: records were already
+        # admitted; EXCEPT version conversion — after a storage-version
+        # upgrade, old-hub records must up-convert exactly as admission
+        # would, so the post-replay compaction rewrites the disk in the
+        # new hub version (ARCHITECTURE.md "Storage-version policy")) --
+        from kubeflow_tpu.api import versions as _versions
 
-    objects: dict[tuple, dict] = {}
-    max_rv = 0
-    count = 0
-    for op, payload in _load_records(data_dir):
-        count += 1
-        if op == "put":
+        objects: dict[tuple, dict] = {}
+        max_rv = 0
+        count = 0
+        for op, payload in _load_records(data_dir):
+            count += 1
+            if op == "put":
+                try:
+                    payload = _versions.to_storage(payload)
+                except ValueError as e:
+                    # a conversion was dropped before a compacted boot
+                    # (operator error the policy forbids): keep the record
+                    # visible rather than silently losing it
+                    log.error("journaled record in unservable version",
+                              kind=payload.get("kind"), error=str(e))
+                md = payload["metadata"]
+                key = server._key(payload["kind"], md.get("namespace"),
+                                  md["name"])
+                objects[key] = payload
+                try:
+                    max_rv = max(max_rv, int(md.get("resourceVersion", 0)))
+                except (TypeError, ValueError):
+                    pass
+            else:
+                objects.pop(payload, None)
+        # -- orphan GC (k8s background garbage collection's role): a crash
+        # between an owner's journaled delete and its children's leaves
+        # children referencing a dead uid; replaying them would resurrect
+        # workloads k8s would collect.  Iterate to a fixpoint — removing
+        # an orphan can orphan ITS children. --
+        uids = {o["metadata"].get("uid") for o in objects.values()}
+        while True:
+            orphans = [
+                key for key, o in objects.items()
+                if (refs := o["metadata"].get("ownerReferences"))
+                and not any(r.get("uid") in uids for r in refs)]
+            if not orphans:
+                break
+            for key in orphans:
+                uids.discard(objects.pop(key)["metadata"].get("uid"))
+            log.info("dropped orphaned children during recovery",
+                     count=len(orphans),
+                     sample=[f"{k[0]}/{k[2]}" for k in orphans[:5]])
+
+        with server._lock:
+            server._objects.update(objects)
+            server._rebuild_index()
+            server._rv = max(server._rv, max_rv)
+
+        persister = Persister(server, data_dir, fsync=fsync,
+                              compact_bytes=compact_bytes,
+                              compact_records=compact_records)
+        persister._lock_fd = lock_fd
+        with server._lock:
+            persister.compact()
+            server._journal = persister.journal
+        if objects:
+            log.info("state recovered", objects=len(objects),
+                     records_replayed=count, rv=max_rv)
+        return server
+    except BaseException:
+        with server._lock:
+            j = server._journal
+            if (j is not None and persister is not None
+                    and getattr(j, "__self__", None) is persister):
+                server._journal = None
+        if persister is not None:
             try:
-                payload = _versions.to_storage(payload)
-            except ValueError as e:
-                # a conversion was dropped before a compacted boot
-                # (operator error the policy forbids): keep the record
-                # visible rather than silently losing it
-                log.error("journaled record in unservable version",
-                          kind=payload.get("kind"), error=str(e))
-            md = payload["metadata"]
-            key = server._key(payload["kind"], md.get("namespace"),
-                              md["name"])
-            objects[key] = payload
-            try:
-                max_rv = max(max_rv, int(md.get("resourceVersion", 0)))
-            except (TypeError, ValueError):
+                persister.wal.close()
+            except OSError:
                 pass
-        else:
-            objects.pop(payload, None)
-    # -- orphan GC (k8s background garbage collection's role): a crash
-    # between an owner's journaled delete and its children's leaves
-    # children referencing a dead uid; replaying them would resurrect
-    # workloads k8s would collect.  Iterate to a fixpoint — removing an
-    # orphan can orphan ITS children. --
-    uids = {o["metadata"].get("uid") for o in objects.values()}
-    while True:
-        orphans = [
-            key for key, o in objects.items()
-            if (refs := o["metadata"].get("ownerReferences"))
-            and not any(r.get("uid") in uids for r in refs)]
-        if not orphans:
-            break
-        for key in orphans:
-            uids.discard(objects.pop(key)["metadata"].get("uid"))
-        log.info("dropped orphaned children during recovery",
-                 count=len(orphans),
-                 sample=[f"{k[0]}/{k[2]}" for k in orphans[:5]])
-
-    with server._lock:
-        server._objects.update(objects)
-        server._rebuild_index()
-        server._rv = max(server._rv, max_rv)
-
-    persister = Persister(server, data_dir, fsync=fsync,
-                          compact_bytes=compact_bytes,
-                          compact_records=compact_records)
-    persister._lock_fd = lock_fd
-    with server._lock:
-        persister.compact()
-        server._journal = persister.journal
-    if objects:
-        log.info("state recovered", objects=len(objects),
-                 records_replayed=count, rv=max_rv)
-    return server
+        os.close(lock_fd)  # releases the flock: attach() is retryable
+        raise
 
 
 def detach(server: APIServer, timeout: float = 30.0) -> None:

@@ -68,6 +68,7 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/activities/<ns>", self.activities)
         self.add_route("GET", "/api/quota/<ns>", self.quota_route)
         self.add_route("GET", "/api/metrics/<mtype>", self.metrics_route)
+        self.add_route("GET", "/api/autoscale/<ns>", self.autoscale_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -114,6 +115,18 @@ class DashboardApp(CrudApp):
         hard = quota_mod.quota_hard(self.server, ns)
         used = quota_mod.namespace_usage(self.server, ns)
         return "200 OK", {"hard": hard or {}, "used": used}
+
+    def autoscale_route(self, req: Request):
+        """Autoscaler standing for the namespace's InferenceServices
+        (current/desired replicas, panic, parked-on-quota, concurrency).
+        Store-derived like quota_route — correct under any metrics
+        backend."""
+        from kubeflow_tpu.dashboard.metrics_service import autoscaler_state
+
+        ns = req.params["ns"]
+        req.authorize("list", "InferenceService", ns)
+        return "200 OK", [s for s in autoscaler_state(self.server)
+                          if s["namespace"] == ns]
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

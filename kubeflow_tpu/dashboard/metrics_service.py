@@ -18,6 +18,28 @@ Interval = {"Last5m": 300, "Last15m": 900, "Last30m": 1800,
             "Last60m": 3600, "Last180m": 10800}
 
 
+def autoscaler_state(server) -> list[dict]:
+    """Per-revision autoscaler standing (current/desired replicas, panic
+    mode, observed concurrency), read straight from the store: the
+    autoscale reconciler mirrors each decision into the
+    InferenceService's ``status.autoscaler``, so no dashboard backend
+    needs a channel to the decider itself (level-triggered discipline —
+    the stored object IS the interface).  Store-derived on purpose:
+    correct under BOTH metrics backends, cloud or local."""
+    out = []
+    for isvc in server.list("InferenceService"):
+        state = isvc.get("status", {}).get("autoscaler")
+        if state is None:
+            continue
+        out.append({
+            "namespace": isvc["metadata"]["namespace"],
+            "name": isvc["metadata"]["name"],
+            "ready": bool(isvc.get("status", {}).get("ready")),
+            **state,
+        })
+    return out
+
+
 class MetricsService(Protocol):
     def get_node_cpu_utilization(self, span_s: int) -> list[dict]: ...
 
@@ -26,6 +48,8 @@ class MetricsService(Protocol):
     def get_pod_memory_usage(self, span_s: int) -> list[dict]: ...
 
     def get_tpu_duty_cycle(self, span_s: int) -> list[dict]: ...
+
+    def get_autoscaler_state(self) -> list[dict]: ...
 
 
 class LocalMetricsService:
@@ -70,6 +94,9 @@ class LocalMetricsService:
                         chips += int(v)
         return self._series(float(chips), span_s)
 
+    def get_autoscaler_state(self) -> list[dict]:
+        return autoscaler_state(self.server)
+
 
 class CloudMonitoringMetricsService:
     """Google Cloud Monitoring implementation (Stackdriver successor).
@@ -84,8 +111,9 @@ class CloudMonitoringMetricsService:
     POD_MEM = "kubernetes.io/container/memory/used_bytes"
     TPU_DUTY = "tpu.googleapis.com/tpu/mxu/utilization"
 
-    def __init__(self, project: str):
+    def __init__(self, project: str, server=None):
         self.project = project
+        self.server = server  # autoscaler state is store-local, not cloud
 
     def _query(self, metric: str, span_s: int) -> list[dict]:
         from google.cloud import monitoring_v3  # type: ignore
@@ -118,13 +146,19 @@ class CloudMonitoringMetricsService:
     def get_tpu_duty_cycle(self, span_s):
         return self._query(self.TPU_DUTY, span_s)
 
+    def get_autoscaler_state(self):
+        # the autoscaler's standing lives in the platform's own store,
+        # not Cloud Monitoring — a cloud-metrics deployment still runs
+        # the in-tree autoscaler, so read the store here too
+        return autoscaler_state(self.server) if self.server else []
+
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:
     """Factory (metrics_service_factory.ts pattern): Cloud Monitoring when a
     project is configured and importable, local otherwise."""
     if project:
         try:
-            return CloudMonitoringMetricsService(project)
+            return CloudMonitoringMetricsService(project, server)
         except ImportError:
             pass
     return LocalMetricsService(server)

@@ -105,6 +105,13 @@ class Route:
         parts = self.dest_host.split(".")
         return parts[1] if len(parts) >= 2 else self.namespace
 
+    @property
+    def dest_service(self) -> str | None:
+        """The destination Service's name (``<svc>.<ns>.svc...``) — with
+        dest_namespace, the autoscaler's revision key."""
+        parts = self.dest_host.split(".")
+        return parts[0] if len(parts) >= 2 else None
+
     def rewritten(self, path: str) -> str:
         return self.rewrite + path[len(self.prefix):]
 
@@ -116,6 +123,22 @@ class Backend:
     path: str
     set_headers: dict
     timeout_s: float
+
+
+def _scale_key(route: Route) -> tuple | None:
+    """(namespace, service) the autoscaler keys concurrency on — the
+    destination workload, matching the authorization scope."""
+    svc = route.dest_service
+    return (route.dest_namespace, svc) if svc else None
+
+
+def _counted(result, collector, key):
+    """Wrap a WSGI response iterable so the in-flight count drops only
+    when the body is fully streamed (or the client goes away)."""
+    try:
+        yield from result
+    finally:
+        collector.dec(key)
 
 
 def _prefix_owned(prefix: str, vs_namespace: str | None) -> bool:
@@ -400,10 +423,15 @@ class _BackendPool:
         return (_NodelayConnection(host, port, timeout=timeout), False)
 
     def put(self, host: str, port: int, conn) -> None:
+        now = time.monotonic()
         with self._lock:
+            # sweep on put too (ADVICE r5): a gateway that goes quiet after
+            # a burst would otherwise keep sockets to deleted pods open
+            # until the NEXT request — get() may never come
+            self._sweep_locked(now)
             idle = self._idle.setdefault((host, port), [])
             if len(idle) < self.max_idle:
-                idle.append((conn, time.monotonic()))
+                idle.append((conn, now))
                 return
         conn.close()
 
@@ -415,13 +443,26 @@ class Gateway:
     BUFFER_BODY_MAX = 1 << 20
 
     def __init__(self, server: APIServer, *, connect_retries: int = 40,
-                 retry_delay: float = 0.25):
+                 retry_delay: float = 0.25, collector=None, activator=None):
         self.server = server
         # a pod reports Running slightly before its process binds the
         # port; a short connect-retry absorbs that startup race
         self.connect_retries = connect_retries
         self.retry_delay = retry_delay
         self.pool = _BackendPool()
+        # autoscale integration: per-destination in-flight counts feed the
+        # concurrency autoscaler, and the activator holds requests hitting
+        # an autoscaled InferenceService at zero replicas (scale-from-zero)
+        if collector is None and activator is None:
+            try:
+                from kubeflow_tpu import autoscale
+
+                collector = autoscale.get_collector(server)
+                activator = autoscale.Activator(server, collector)
+            except ImportError:
+                pass  # distribution without the autoscale package
+        self.collector = collector
+        self.activator = activator
 
     def matches(self, path: str) -> bool:
         return match_route(self.server, path) is not None
@@ -588,11 +629,41 @@ class Gateway:
         try:
             backend = backend_for_route(self.server, route, path)
         except NoBackend as e:
-            PROXIED.labels("503").inc()
-            start_response("503 Service Unavailable",
-                           [("Content-Type", "text/plain")])
-            return [f"no backend: {e}\n".encode()]
-        return self._proxy(backend, environ, start_response)
+            backend = self._activate(route, path)
+            if backend is None:
+                PROXIED.labels("503").inc()
+                start_response("503 Service Unavailable",
+                               [("Content-Type", "text/plain")])
+                return [f"no backend: {e}\n".encode()]
+        key = _scale_key(route) if self.collector is not None else None
+        if key is None:
+            return self._proxy(backend, environ, start_response)
+        # count the request in-flight for the autoscaler's concurrency
+        # view: incremented before the upstream connect, released when the
+        # response stream is fully delivered (or the proxy errors out)
+        self.collector.inc(key)
+        try:
+            result = self._proxy(backend, environ, start_response)
+        except BaseException:
+            self.collector.dec(key)
+            raise
+        return _counted(result, self.collector, key)
+
+    def _activate(self, route: Route, path: str):
+        """Scale-from-zero: hold the request while the activator brings up
+        a backend; None when the route is not autoscaled (plain 503) or
+        activation fails (timeout / hold queue full)."""
+        if self.activator is None:
+            return None
+        key = self.activator.covers(route)
+        if key is None:
+            return None
+        try:
+            return self.activator.wait(route, path, key)
+        except Exception as e:
+            log.warning("scale-from-zero failed", route=route.prefix,
+                        error=str(e))
+            return None
 
     def _proxy(self, backend: Backend, environ, start_response):
         method = environ["REQUEST_METHOD"]

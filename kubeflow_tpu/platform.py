@@ -100,6 +100,12 @@ def _register_optional(server, mgr, enable: set[str] | None) -> None:
         registry.append(_pl.register)
     except ImportError:
         pass
+    try:
+        from kubeflow_tpu import autoscale as _as
+
+        registry.append(_as.register)
+    except ImportError:
+        pass
     for reg in registry:
         reg(server, mgr)
 

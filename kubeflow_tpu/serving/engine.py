@@ -165,6 +165,18 @@ class ContinuousBatcher:
                 for i, ids in enumerate(batch)]
         return [r.result() for r in reqs]
 
+    def stats(self) -> dict:
+        """Point-in-time load snapshot for the autoscaler's metrics
+        collector (autoscale/metrics.py): requests actively decoding,
+        requests queued for a slot, and the slot capacity.  Lock-held so
+        the two counts are mutually consistent."""
+        with self._work:
+            return {
+                "active": sum(1 for s in self.slots if s is not None),
+                "queued": len(self.queue),
+                "max_batch": self.max_batch,
+            }
+
     def shutdown(self) -> None:
         with self._work:
             self._stop = True

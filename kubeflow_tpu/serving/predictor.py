@@ -253,7 +253,15 @@ class PredictorApp:
                     return "200 OK", pred.predict(body["instances"])
             else:
                 pred = self.predictors[rest]
-                return "200 OK", {"name": rest, "ready": True}
+                meta = {"name": rest, "ready": True}
+                engine = getattr(pred, "engine", None)
+                if engine is not None:
+                    # live load snapshot (engine.stats()): for operators
+                    # and scrapers; an IN-process engine feeds the same
+                    # snapshot to the autoscaler via
+                    # autoscale.MetricsCollector.add_source
+                    meta["stats"] = engine.stats()
+                return "200 OK", meta
         raise KeyError(path)
 
     def _body(self, environ) -> dict:

@@ -1,0 +1,151 @@
+"""Autoscaler loadtest: synthetic traffic against an autoscaled
+InferenceService, replica trajectory out.
+
+Exercises the whole loop on one machine with no accelerator work (the
+backend is a stub pod, FakeExecutor-driven): gateway in-flight counts feed
+the collector, the KPA decider scales the Deployment, the workloads
+controller materializes pods, and the activator answers the first request
+arriving at zero replicas.  Phases:
+
+1. COLD:  one request at zero replicas — measures activator hold time
+          (scale-from-zero latency with instant pods);
+2. SURGE: CONCURRENCY closed-loop clients for DURATION seconds — replicas
+          should climb toward ceil(concurrency / target);
+3. IDLE:  traffic stops — replicas should return to zero within
+          stable window + scale-down delay.
+
+Prints one JSON line: replica trajectory (t, replicas) plus activator
+latency and request counts.
+
+Usage: python loadtest/load_autoscale.py [CONCURRENCY] [DURATION_S]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+
+def main() -> int:
+    concurrency = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    duration = float(sys.argv[2]) if len(sys.argv) > 2 else 8.0
+
+    from kubeflow_tpu import autoscale
+    from kubeflow_tpu.api import inferenceservice as api
+    from kubeflow_tpu.autoscale.reconciler import ANNO_PREFIX
+    from kubeflow_tpu.controllers import workloads
+    from kubeflow_tpu.controllers.executor import FakeExecutor
+    from kubeflow_tpu.controllers.inferenceservice import register
+    from kubeflow_tpu.core import APIServer, Manager
+    from kubeflow_tpu.core.httpapi import serve
+    from kubeflow_tpu.gateway import Gateway
+
+    def backend(environ, start_response):
+        time.sleep(0.05)  # a "decode" worth of per-request latency
+        start_response("200 OK", [("Content-Type", "application/json"),
+                                  ("Content-Length", "2")])
+        return [b"{}"]
+
+    stub, _ = serve(backend, 0)
+    server = APIServer()
+    mgr = Manager(server)
+    register(server, mgr)
+    workloads.register(server, mgr)
+    autoscale.register(server, mgr)
+    mgr.add(FakeExecutor(server, complete=False,
+                         portmap={str(api.PORT): stub.server_address[1]}))
+    gateway = Gateway(server, connect_retries=8, retry_delay=0.05)
+    front, _ = serve(gateway, 0)
+    base = f"http://127.0.0.1:{front.server_address[1]}"
+    mgr.start()
+
+    isvc = api.new("lt", "serving")
+    isvc["metadata"]["annotations"] = {
+        ANNO_PREFIX + "target": "2", ANNO_PREFIX + "minReplicas": "0",
+        ANNO_PREFIX + "maxReplicas": "16", ANNO_PREFIX + "initialScale": "0",
+        ANNO_PREFIX + "window": "2", ANNO_PREFIX + "panicWindow": "0.5",
+        ANNO_PREFIX + "scaleDownDelay": "0.5", ANNO_PREFIX + "tick": "0.1"}
+    server.create(isvc)
+
+    import urllib.request
+
+    def hit() -> bool:
+        try:
+            with urllib.request.urlopen(base + "/serving/serving/lt/x",
+                                        timeout=30) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    while True:  # the route must exist before the cold request
+        from kubeflow_tpu.core.store import NotFound
+
+        try:
+            server.get("VirtualService", "isvc-lt", "serving")
+            break
+        except NotFound:
+            time.sleep(0.05)
+
+    t0 = time.perf_counter()
+    cold_ok = hit()
+    cold_s = time.perf_counter() - t0
+
+    trajectory: list[tuple[float, int]] = []
+    stop = threading.Event()          # stops the closed-loop clients
+    stop_watch = threading.Event()    # stops the replica watcher
+    served = [0]
+
+    def watch_replicas() -> None:
+        while not stop_watch.is_set():
+            dep = server.get("Deployment", "lt", "serving")
+            point = (round(time.perf_counter() - t0, 2),
+                     dep["spec"]["replicas"])
+            if not trajectory or trajectory[-1][1] != point[1]:
+                trajectory.append(point)
+            time.sleep(0.1)
+
+    def client() -> None:
+        while not stop.is_set():
+            if hit():
+                served[0] += 1
+
+    watcher = threading.Thread(target=watch_replicas, daemon=True)
+    watcher.start()
+    clients = [threading.Thread(target=client, daemon=True)
+               for _ in range(concurrency)]
+    for c in clients:
+        c.start()
+    time.sleep(duration)
+    peak = max(r for _, r in trajectory)
+    stop_clients = time.perf_counter()
+    stop.set()
+    for c in clients:
+        c.join(timeout=10)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        dep = server.get("Deployment", "lt", "serving")
+        if dep["spec"]["replicas"] == 0:
+            break
+        time.sleep(0.1)
+    zero_after = time.perf_counter() - stop_clients
+    stop_watch.set()
+    watcher.join(timeout=5)
+    mgr.stop()
+    front.shutdown()
+    stub.shutdown()
+
+    print(json.dumps({
+        "bench": "autoscale", "concurrency": concurrency,
+        "duration_s": duration, "cold_request_ok": cold_ok,
+        "cold_start_s": round(cold_s, 3), "peak_replicas": peak,
+        "requests_served": served[0],
+        "scale_to_zero_s": round(zero_after, 2),
+        "trajectory": trajectory[:50],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

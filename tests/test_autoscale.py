@@ -1,0 +1,370 @@
+"""Autoscaling subsystem: decider math (fake clock, zero sleeps), the
+collector's gauges, quota-parked scale-ups, and the full
+0 -> N -> 0 / scale-from-zero activator loop on a live control plane."""
+
+import json
+import threading
+import time
+
+import pytest
+from conftest import poll_until as wait
+
+from kubeflow_tpu import autoscale
+from kubeflow_tpu.api import inferenceservice as api
+from kubeflow_tpu.autoscale.decider import Decider, DeciderSpec
+from kubeflow_tpu.autoscale.metrics import HeldOverflow, MetricsCollector
+from kubeflow_tpu.autoscale.reconciler import ANNO_PREFIX, Autoscaler
+from kubeflow_tpu.controllers import workloads
+from kubeflow_tpu.controllers.executor import FakeExecutor
+from kubeflow_tpu.controllers.inferenceservice import (
+    register as register_isvc,
+)
+from kubeflow_tpu.core import APIServer, Manager, Request
+from kubeflow_tpu.core.httpapi import serve
+from kubeflow_tpu.core.objects import api_object
+from kubeflow_tpu.gateway import Gateway
+
+
+# -- decider: pure clock-injected math, NO sleeps anywhere -------------------
+
+def test_decider_stable_scale_up_and_clamp():
+    spec = DeciderSpec(target=2.0, stable_window=10.0, panic_window=1.0,
+                       panic_threshold=100.0,  # panic out of the way
+                       min_scale=1, max_scale=4)
+    d = Decider(spec)
+    for t in range(10):
+        d.record(float(t), 6.0)
+    out = d.desired(9.0, ready=3)
+    assert out.desired == 3          # ceil(6 / 2)
+    assert not out.panic
+    # clamping: a flood beyond max_scale pins at max
+    for t in range(10, 20):
+        d.record(float(t), 40.0)
+    assert d.desired(19.0, ready=3).desired == 4
+    # and silence never drops below min_scale
+    d2 = Decider(spec)
+    d2.record(0.0, 0.0)
+    assert d2.desired(0.0, ready=1).desired == 1
+
+
+def test_decider_panic_window_reacts_to_burst():
+    """A burst inside the short panic window must scale up immediately
+    even though the stable-window average barely moved — and panic must
+    hold its high-water mark (no scale-down mid-panic)."""
+    spec = DeciderSpec(target=1.0, stable_window=60.0, panic_window=6.0,
+                       panic_threshold=2.0, min_scale=0, max_scale=100)
+    d = Decider(spec)
+    for t in range(54):              # nearly a stable window of quiet
+        d.record(float(t), 0.0)
+    for t in range(54, 60):          # 6s burst of 8 concurrent
+        d.record(float(t), 8.0)
+    out = d.desired(60.0, ready=1)   # panic window covers just the burst
+    assert out.panic
+    assert out.desired == 8          # panic window average, not stable
+    # burst gone: panic holds the high-water mark until a stable window
+    # passes with no re-trigger
+    for t in range(60, 90):
+        d.record(float(t), 0.0)
+    held = d.desired(89.0, ready=8)
+    assert held.panic and held.desired == 8
+    for t in range(90, 125):
+        d.record(float(t), 0.0)
+    calm = d.desired(124.0, ready=8)
+    assert not calm.panic
+    assert calm.desired == 0         # stable window is quiet -> to zero
+
+
+def test_decider_scale_down_delay():
+    """Raw desired falls as load stops, but the applied desired is the
+    trailing max over scale_down_delay — then drops to zero."""
+    spec = DeciderSpec(target=1.0, stable_window=2.0, panic_window=0.5,
+                       panic_threshold=100.0, scale_down_delay=5.0,
+                       min_scale=0, max_scale=10)
+    d = Decider(spec)
+    desired_at = {}
+    for t in range(11):
+        d.record(float(t), 4.0 if t <= 2 else 0.0)
+        desired_at[t] = d.desired(float(t), ready=4).desired
+    assert desired_at[2] == 4
+    assert desired_at[7] == 4        # raw is 0 by t=5; delay holds 4
+    assert desired_at[10] == 0       # delay window drained -> scale down
+
+
+def test_decider_scale_to_zero_and_back():
+    spec = DeciderSpec(target=2.0, stable_window=4.0, panic_window=1.0,
+                       min_scale=0, max_scale=5)
+    d = Decider(spec)
+    for t in range(8):
+        d.record(float(t), 0.0)
+    assert d.desired(7.0, ready=1).desired == 0
+    # demand arriving at zero replicas (the activator's held request)
+    d.record(8.0, 1.0)
+    out = d.desired(8.0, ready=0)
+    assert out.desired >= 1
+
+
+# -- collector ---------------------------------------------------------------
+
+def test_collector_gauges_and_bounded_hold():
+    c = MetricsCollector()
+    key = ("ns", "svc")
+    c.inc(key)
+    c.inc(key)
+    c.dec(key)
+    assert c.concurrency(key) == 1.0
+    with c.hold(key, limit=2):
+        assert c.concurrency(key) == 2.0
+        assert c.queue_depth(key) == 1
+        with c.hold(key, limit=2):
+            with pytest.raises(HeldOverflow):
+                c.hold(key, limit=2)
+    assert c.queue_depth(key) == 0
+    # engine stats fold into the same gauge (serving/engine.py stats())
+    c.add_source(key, lambda: {"active": 3, "queued": 2})
+    assert c.concurrency(key) == 6.0
+    c.remove_source(key)
+    c.dec(key)
+    assert c.concurrency(key) == 0.0
+
+
+def test_engine_stats_snapshot():
+    from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+    p = GenerativePredictor("llama", size="tiny", max_batch=2, max_seq=32)
+    stats = p.engine.stats()
+    assert stats == {"active": 0, "queued": 0, "max_batch": 2}
+    p.generate([[1, 2]], max_new_tokens=2)
+    assert p.engine.stats()["active"] == 0  # drained after sync generate
+
+
+# -- reconciler: deterministic, driven by direct reconcile calls -------------
+
+def _annotated_isvc(name="m", ns="serving", **annos):
+    isvc = api.new(name, ns, topology="v5e-4")
+    defaults = {"target": "2", "minReplicas": "0", "maxReplicas": "5",
+                "window": "10", "panicWindow": "1", "tick": "0.05"}
+    defaults.update({k: str(v) for k, v in annos.items()})
+    isvc["metadata"]["annotations"] = {
+        ANNO_PREFIX + k: v for k, v in defaults.items()}
+    return isvc
+
+
+def test_reconciler_patches_replicas_from_samples():
+    """No manager, no sleeps: feed the collector, step a fake clock, and
+    watch spec.replicas change through the store."""
+    server = APIServer()
+    collector = autoscale.get_collector(server)
+    now = [0.0]
+    scaler = Autoscaler(server, collector, clock=lambda: now[0])
+    server.create(_annotated_isvc())
+    server.create(api_object("Deployment", "m", "serving",
+                             spec={"replicas": 0, "template": {}}))
+    req = Request("serving", "m")
+
+    for _ in range(6):               # sustained concurrency of 6
+        collector.inc(("serving", "m"))
+    for _ in range(20):
+        now[0] += 0.5
+        scaler.reconcile(req)
+    dep = server.get("Deployment", "m", "serving")
+    assert dep["spec"]["replicas"] == 3   # ceil(6 / target 2)
+    isvc = server.get(api.KIND, "m", "serving")
+    state = isvc["status"]["autoscaler"]
+    assert state["appliedReplicas"] == 3
+    assert state["parked"] == 0
+    assert state["stableConcurrency"] > 0
+
+    for _ in range(6):
+        collector.dec(("serving", "m"))
+    for _ in range(30):              # drain a full stable window
+        now[0] += 0.5
+        scaler.reconcile(req)
+    assert server.get("Deployment", "m", "serving")["spec"]["replicas"] == 0
+
+
+def test_reconciler_ignores_unannotated_isvc():
+    server = APIServer()
+    scaler = Autoscaler(server, autoscale.get_collector(server),
+                        clock=lambda: 0.0)
+    server.create(api.new("plain", "serving"))
+    server.create(api_object("Deployment", "plain", "serving",
+                             spec={"replicas": 1, "template": {}}))
+    assert scaler.reconcile(Request("serving", "plain")) is None
+    assert server.get("Deployment", "plain",
+                      "serving")["spec"]["replicas"] == 1
+
+
+# -- quota parking: a scale-up past TPU quota parks, never flaps -------------
+
+def test_scale_up_beyond_quota_parks_without_flapping():
+    from kubeflow_tpu.core import quota as quota_mod
+
+    server = APIServer()
+    quota_mod.register(server)
+    mgr = Manager(server)
+    register_isvc(server, mgr)
+    workloads.register(server, mgr)
+    mgr.add(FakeExecutor(server, complete=False))
+    collector = autoscale.get_collector(server)
+    now = [0.0]
+    scaler = Autoscaler(server, collector, clock=lambda: now[0])
+    mgr.start()
+    try:
+        # room for exactly 2 predictor pods (4 chips each)
+        server.create(api_object("ResourceQuota", quota_mod.QUOTA_NAME,
+                                 "serving", spec={"hard": {
+                                     "cloud-tpu.google.com/v5e": 8}}))
+        server.create(_annotated_isvc(target="1", initialScale="1"))
+        wait(lambda: _pods_running(server, "serving", 1))
+
+        for _ in range(3):           # demand wants 3 pods; quota fits 2
+            collector.inc(("serving", "m"))
+        req = Request("serving", "m")
+        for _ in range(6):
+            now[0] += 0.5
+            scaler.reconcile(req)
+        wait(lambda: _pods_running(server, "serving", 2))
+        history = []
+        for _ in range(10):          # stability: no flapping at the cap
+            now[0] += 0.5
+            scaler.reconcile(req)
+            history.append(server.get("Deployment", "m",
+                                      "serving")["spec"]["replicas"])
+        assert history == [2] * 10
+        state = server.get(api.KIND, "m", "serving")["status"]["autoscaler"]
+        assert state["desiredReplicas"] == 3
+        assert state["appliedReplicas"] == 2
+        assert state["parked"] == 1
+    finally:
+        mgr.stop()
+
+
+def _pods_running(server, ns, n):
+    pods = [p for p in server.list("Pod", namespace=ns)
+            if p.get("status", {}).get("phase") == "Running"]
+    return True if len(pods) >= n else None
+
+
+# -- e2e: 0 -> N -> 0 through the gateway, activator answers at zero ---------
+
+def _backend_app(environ, start_response):
+    time.sleep(0.15)                 # hold concurrency open under load
+    payload = json.dumps({"ok": True,
+                          "path": environ.get("PATH_INFO")}).encode()
+    start_response("200 OK", [("Content-Type", "application/json"),
+                              ("Content-Length", str(len(payload)))])
+    return [payload]
+
+
+def _wsgi_get(app, path):
+    """Drive a WSGI callable directly (no sockets on the front side)."""
+    from io import BytesIO
+
+    status_box = {}
+
+    def start_response(status, headers):
+        status_box["status"] = status
+
+    environ = {"REQUEST_METHOD": "GET", "PATH_INFO": path,
+               "QUERY_STRING": "", "wsgi.input": BytesIO(b""),
+               "wsgi.url_scheme": "http"}
+    body = b"".join(app(environ, start_response))
+    return int(status_box["status"].split()[0]), body
+
+
+@pytest.fixture()
+def serving_stack():
+    stub, _ = serve(_backend_app, 0)          # the "predictor" pod process
+    stub_port = stub.server_address[1]
+    server = APIServer()
+    mgr = Manager(server)
+    register_isvc(server, mgr)
+    workloads.register(server, mgr)
+    autoscale.register(server, mgr)
+    mgr.add(FakeExecutor(server, complete=False,
+                         portmap={str(api.PORT): stub_port}))
+    gateway = Gateway(server, connect_retries=8, retry_delay=0.05)
+    assert gateway.activator is not None      # auto-wired from autoscale
+    mgr.start()
+    yield server, mgr, gateway
+    mgr.stop()
+    stub.shutdown()
+
+
+def test_scale_from_zero_to_n_to_zero(serving_stack):
+    """The acceptance loop: a request at zero replicas is held and
+    answered 200 after activator-driven scale-up; sustained load scales
+    to N; the idle window scales back to zero — all observed through the
+    store as patches to the Deployment's spec.replicas."""
+    server, mgr, gateway = serving_stack
+    server.create(_annotated_isvc(
+        target="2", minReplicas="0", maxReplicas="4", initialScale="0",
+        window="1.2", panicWindow="0.3", scaleDownDelay="0.2",
+        tick="0.05"))
+    wait(lambda: _exists(server, "VirtualService", "isvc-m", "serving"))
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] == 0
+
+    # a request arriving at ZERO replicas: held, scaled 0->1, answered
+    code, body = _wsgi_get(gateway, "/serving/serving/m/v1/models")
+    assert code == 200
+    assert json.loads(body)["ok"] is True
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] >= 1
+
+    # sustained concurrency ~6 against target 2 -> replicas climb past 1
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            _wsgi_get(gateway, "/serving/serving/m/v1/models")
+
+    threads = [threading.Thread(target=pound, daemon=True)
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        wait(lambda: (server.get("Deployment", "m", "serving")
+                      ["spec"]["replicas"] >= 2) or None, timeout=15)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    replicas = server.get("Deployment", "m", "serving")["spec"]["replicas"]
+    assert 2 <= replicas <= 4
+
+    # idle: stable window drains -> back to zero, pods deleted
+    wait(lambda: (server.get("Deployment", "m", "serving")
+                  ["spec"]["replicas"] == 0) or None, timeout=20)
+    wait(lambda: None if server.list("Pod", namespace="serving") else True,
+         timeout=10)
+    state = server.get(api.KIND, "m", "serving")["status"]["autoscaler"]
+    assert state["desiredReplicas"] == 0
+
+    # and the dashboard metrics service surfaces the same state
+    from kubeflow_tpu.dashboard.metrics_service import LocalMetricsService
+
+    rows = LocalMetricsService(server).get_autoscaler_state()
+    assert any(r["name"] == "m" and r["namespace"] == "serving"
+               for r in rows)
+
+
+def test_activator_not_engaged_for_plain_isvc(serving_stack):
+    """Without autoscaling annotations a dead backend stays a plain 503 —
+    the activator must not hold requests it cannot un-zero."""
+    server, mgr, gateway = serving_stack
+    isvc = api.new("fixed", "serving", min_replicas=0)
+    server.create(isvc)
+    wait(lambda: _exists(server, "VirtualService", "isvc-fixed", "serving"))
+    code, _ = _wsgi_get(gateway, "/serving/serving/fixed/v1/models")
+    assert code == 503
+
+
+def _exists(server, kind, name, ns):
+    from kubeflow_tpu.core.store import NotFound
+
+    try:
+        server.get(kind, name, ns)
+        return True
+    except NotFound:
+        return None
