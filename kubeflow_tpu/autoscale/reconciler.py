@@ -248,6 +248,7 @@ class Autoscaler(Controller):
         for key in [k for k in self._deciders
                     if k[0] == ns and k[1] == name]:
             del self._deciders[key]
+            self._last_sample.pop(key, None)  # else it leaks per dkey
 
 
 def register(server, mgr) -> None:

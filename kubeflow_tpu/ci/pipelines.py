@@ -24,9 +24,15 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                          "native/*"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
                      "tests/test_core_store.py", "tests/test_core_controller.py",
+                     "tests/test_concurrent_reconcile.py",
                      "tests/test_native_engine.py", "tests/test_utils.py",
                      "tests/test_httpapi.py"],
         "build_cmd": ["make", "-C", "native", "-s"],
+        # ThreadSanitizer gate for the worker-pool hot path (the native
+        # queue's processing/dirty protocol).  KF_SKIP_TSAN=1 opts out on
+        # hosts whose libtsan interceptors are unreliable (pre-4.8
+        # kernels report spurious double-locks).
+        "tsan_cmd": ["make", "-C", "native", "-s", "wq-tsan-run"],
     },
     "training": {
         "include_dirs": ["kubeflow_tpu/models/*", "kubeflow_tpu/ops/*",
@@ -144,6 +150,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "build_cmd" in spec:
         steps.append({"name": "build", "run": spec["build_cmd"],
                       "depends": ["checkout"]})
+    if "tsan_cmd" in spec:
+        steps.append({"name": "tsan", "run": spec["tsan_cmd"],
+                      "depends": [steps[-1]["name"]]})
     steps.append({"name": "test", "run": spec["test_cmd"],
                   "depends": [steps[-1]["name"]]})
     if spec.get("image"):
@@ -162,12 +171,17 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
 
 def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
     """Execute the selected pipelines on this machine; {component: passed}."""
+    import os
+
     results = {}
     for name in components:
         spec = COMPONENTS[name]
         ok = True
         if build and "build_cmd" in spec:
             ok = subprocess.run(spec["build_cmd"]).returncode == 0
+        if (ok and "tsan_cmd" in spec
+                and os.environ.get("KF_SKIP_TSAN") != "1"):
+            ok = subprocess.run(spec["tsan_cmd"]).returncode == 0
         if ok:
             ok = subprocess.run(spec["test_cmd"]).returncode == 0
         results[name] = ok

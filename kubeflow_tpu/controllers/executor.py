@@ -39,6 +39,7 @@ class FakeExecutor(Controller):
     def __init__(self, server, *, fail_once: set[str] | None = None,
                  always_fail: set[str] | None = None,
                  complete: bool = True, run_for: float = 0.0,
+                 spawn_cost: float = 0.0,
                  metrics_script: dict[str, list[dict]] | None = None,
                  metrics_all: list[dict] | None = None,
                  portmap: dict[str, int] | None = None):
@@ -64,6 +65,12 @@ class FakeExecutor(Controller):
         # run_for>0 holds each pod Running for that long before finishing
         # (loadtests need gangs to actually occupy their slice for a while)
         self.run_for = run_for
+        # spawn_cost>0 BLOCKS the reconciling worker for that long on the
+        # Pending->Running transition — models the container runtime's
+        # image-pull/create latency (a real kubelet's CRI calls block its
+        # sync loop the same way).  This is the regime worker pools exist
+        # for: with one worker, N pending pods start serially
+        self.spawn_cost = spawn_cost
         self._started: dict[str, float] = {}
         self._failed_already: set[str] = set()
 
@@ -76,6 +83,10 @@ class FakeExecutor(Controller):
             return None  # not released yet
         phase = pod.get("status", {}).get("phase", "Pending")
         if phase == "Pending":
+            if self.spawn_cost > 0:
+                import time as _time
+
+                _time.sleep(self.spawn_cost)  # container create/pull
             # mirror the LocalExecutor's pod-status surface: a rolling
             # logTail rides status so log consumers (the UI's per-worker
             # Logs pane, the contract test) see the same shape either way

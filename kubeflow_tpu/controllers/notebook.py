@@ -266,6 +266,8 @@ class NotebookController(Controller):
 def register(server, mgr) -> None:
     from kubeflow_tpu.controllers import workloads
 
-    mgr.add(NotebookController(server))
+    # notebooks are independent keys (each owns its own StatefulSet /
+    # Service); shared controller state is limited to GIL-atomic set adds
+    mgr.add(NotebookController(server), workers=4)
     if not any(c.kind == "StatefulSet" for c in mgr.controllers):
         workloads.register(server, mgr)

@@ -34,6 +34,12 @@ RECONCILE_TOTAL = REGISTRY.counter(
     labels=("controller", "outcome"))
 QUEUE_DEPTH = REGISTRY.gauge(
     "controller_workqueue_depth", "pending keys", labels=("controller",))
+RECONCILE_DURATION = REGISTRY.histogram(
+    "controller_reconcile_duration_seconds", "reconcile latency",
+    labels=("controller",))
+ACTIVE_WORKERS = REGISTRY.gauge(
+    "controller_active_workers", "workers currently inside reconcile",
+    labels=("controller",))
 
 
 @dataclass(frozen=True)
@@ -48,31 +54,57 @@ class Result:
 
 
 class WorkQueue:
-    """Deduplicating delay queue with per-key exponential failure backoff."""
+    """Deduplicating delay queue with per-key exponential failure backoff.
+
+    Safe for N concurrent ``get`` callers (client-go workqueue.Type
+    semantics): a key handed out by ``get`` sits in a *processing* set and
+    is never handed to a second worker; ``add`` of a processing key parks
+    it *dirty* (earliest requested run time wins) and ``done`` republishes
+    it — a key re-added mid-reconcile runs exactly once more.
+    """
 
     BASE_DELAY = 0.005
     MAX_DELAY = 30.0
 
-    def __init__(self) -> None:
+    def __init__(self, metrics_label: str | None = None) -> None:
         self._lock = threading.Condition()
         self._heap: list[tuple[float, int, Request]] = []
         # earliest scheduled run per key; duplicate heap entries later than
         # this are stale and skipped on pop
         self._due: dict[Request, float] = {}
+        self._processing: set[Request] = set()
+        self._dirty: dict[Request, float] = {}
         self._failures: dict[Request, int] = {}
         self._seq = 0
         self._shutdown = False
+        # depth gauge updated at add/pop/done (sampling it from a worker
+        # loop raced across pool workers and under-reported)
+        self._metrics_label = metrics_label
+
+    def _publish_depth(self) -> None:
+        if self._metrics_label is not None:
+            QUEUE_DEPTH.labels(self._metrics_label).set(
+                len(self._due) + len(self._dirty))
 
     def add(self, req: Request, delay: float = 0.0) -> None:
         when = time.monotonic() + delay
         with self._lock:
+            if req in self._processing:
+                dirty = self._dirty.get(req)
+                if dirty is None or when < dirty:
+                    self._dirty[req] = when
+                self._publish_depth()
+                return
             existing = self._due.get(req)
             if existing is not None and existing <= when:
                 return  # already scheduled at least as early
             self._due[req] = when
             self._seq += 1
             heapq.heappush(self._heap, (when, self._seq, req))
-            self._lock.notify_all()
+            self._publish_depth()
+            # one key became runnable: wake ONE worker, not the whole
+            # parked pool (get() re-arms the cascade)
+            self._lock.notify()
 
     def add_rate_limited(self, req: Request) -> None:
         with self._lock:
@@ -95,6 +127,10 @@ class WorkQueue:
                     if self._due.get(req) != when:
                         continue  # superseded by an earlier reschedule
                     del self._due[req]
+                    self._processing.add(req)
+                    self._publish_depth()
+                    if self._heap and self._heap[0][0] <= now:
+                        self._lock.notify()  # cascade: more work due now
                     return req
                 wait = min(self._heap[0][0] - now if self._heap else timeout,
                            deadline - now)
@@ -103,16 +139,43 @@ class WorkQueue:
                 self._lock.wait(wait)
             return None
 
+    def done(self, req: Request) -> None:
+        """Worker finished ``req``: republish a dirty re-add (at its
+        earliest requested run time) so a mid-reconcile event is not
+        lost."""
+        with self._lock:
+            if req not in self._processing:
+                return
+            self._processing.discard(req)
+            when = self._dirty.pop(req, None)
+            if when is None:
+                return
+            self._due[req] = when
+            self._seq += 1
+            heapq.heappush(self._heap, (when, self._seq, req))
+            self._publish_depth()
+            # one key became runnable: wake ONE worker, not the whole
+            # parked pool (get() re-arms the cascade)
+            self._lock.notify()
+
     def depth(self) -> int:
         with self._lock:
-            return len(self._due)
+            return len(self._due) + len(self._dirty)
+
+    def in_flight(self) -> int:
+        """Keys currently held by a worker (get'd, not yet done'd)."""
+        with self._lock:
+            return len(self._processing)
 
     def due_now(self, horizon: float = 0.0) -> int:
         """Keys due to run within ``horizon`` seconds (excludes far-future
-        periodic requeues, e.g. hourly culling checks)."""
+        periodic requeues, e.g. hourly culling checks).  Dirty keys count:
+        they rerun as soon as their holder calls done()."""
         cutoff = time.monotonic() + horizon
         with self._lock:
-            return sum(1 for when in self._due.values() if when <= cutoff)
+            return (sum(1 for when in self._due.values() if when <= cutoff)
+                    + sum(1 for when in self._dirty.values()
+                          if when <= cutoff))
 
     def shutdown(self) -> None:
         with self._lock:
@@ -135,13 +198,27 @@ class NativeWorkQueue:
     BASE_DELAY = WorkQueue.BASE_DELAY
     MAX_DELAY = WorkQueue.MAX_DELAY
 
-    def __init__(self) -> None:
+    def __init__(self, metrics_label: str | None = None) -> None:
         from kubeflow_tpu.core.native import ENGINE
 
         self._lib = ENGINE.lib
         self._q = self._lib.kf_wq_new()
-        self._buf = ctypes.create_string_buffer(4096)
+        # per-thread receive buffers: N pool workers call get()
+        # concurrently, so a single shared buffer would tear keys
+        self._tls = threading.local()
         self._log = get_logger("native-workqueue")
+        self._metrics_label = metrics_label
+
+    def _buf(self) -> ctypes.Array:
+        buf = getattr(self._tls, "buf", None)
+        if buf is None:
+            buf = self._tls.buf = ctypes.create_string_buffer(4096)
+        return buf
+
+    def _publish_depth(self) -> None:
+        if self._metrics_label is not None:
+            QUEUE_DEPTH.labels(self._metrics_label).set(
+                self._lib.kf_wq_depth(self._q))
 
     def _key(self, req: Request) -> bytes:
         flag = "1" if req.namespace is None else "0"
@@ -156,18 +233,18 @@ class NativeWorkQueue:
 
     def add(self, req: Request, delay: float = 0.0) -> None:
         self._lib.kf_wq_add(self._q, self._key(req), delay)
+        self._publish_depth()
 
     def add_rate_limited(self, req: Request) -> None:
         self._lib.kf_wq_add_rate_limited(self._q, self._key(req))
+        self._publish_depth()
 
     def forget(self, req: Request) -> None:
         self._lib.kf_wq_forget(self._q, self._key(req))
 
     def get(self, timeout: float = 0.5) -> Request | None:
-        # buffer is per-queue and get() is called by one worker thread per
-        # controller; a second concurrent caller would need its own buffer
-        rc = self._lib.kf_wq_get(self._q, timeout, self._buf,
-                                 len(self._buf))
+        buf = self._buf()
+        rc = self._lib.kf_wq_get(self._q, timeout, buf, len(buf))
         if rc <= 0:
             if rc == -2:
                 # key longer than the buffer (no such names exist in a
@@ -175,10 +252,18 @@ class NativeWorkQueue:
                 # get() never raises, matching WorkQueue's contract
                 self._log.error("dropped oversized workqueue key")
             return None  # timeout or shutdown, like WorkQueue.get
-        return self._decode(self._buf.value)
+        self._publish_depth()
+        return self._decode(buf.value)
+
+    def done(self, req: Request) -> None:
+        self._lib.kf_wq_done(self._q, self._key(req))
+        self._publish_depth()
 
     def depth(self) -> int:
         return self._lib.kf_wq_depth(self._q)
+
+    def in_flight(self) -> int:
+        return self._lib.kf_wq_in_flight(self._q)
 
     def due_now(self, horizon: float = 0.0) -> int:
         return self._lib.kf_wq_due_now(self._q, horizon)
@@ -193,7 +278,7 @@ class NativeWorkQueue:
             pass
 
 
-def make_workqueue():
+def make_workqueue(metrics_label: str | None = None):
     """Native C++ queue when the engine is buildable (the normal case);
     pure-Python fallback otherwise or under KF_PURE_PYTHON_WORKQUEUE=1."""
     import os
@@ -201,8 +286,8 @@ def make_workqueue():
     from kubeflow_tpu.core.native import ENGINE
 
     if os.environ.get("KF_PURE_PYTHON_WORKQUEUE") != "1" and ENGINE.available:
-        return NativeWorkQueue()
-    return WorkQueue()
+        return NativeWorkQueue(metrics_label)
+    return WorkQueue(metrics_label)
 
 
 class Controller:
@@ -246,24 +331,40 @@ class Controller:
 
 
 class Manager:
-    """Runs controllers against one APIServer; one worker thread per
-    controller plus a shared watch-dispatch thread."""
+    """Runs controllers against one APIServer; a worker *pool* per
+    controller (controller-runtime's MaxConcurrentReconciles) plus a
+    shared watch-dispatch thread.  The workqueue's processing/dirty
+    protocol guarantees no key is ever reconciled by two workers at
+    once, so reconcilers only need to be safe across *different* keys."""
 
     def __init__(self, server: APIServer, *, leader_election: bool = False,
-                 identity: str = "manager-0"):
+                 identity: str = "manager-0", default_workers: int = 1,
+                 force_workers: int | None = None):
         self.server = server
         self.controllers: list[Controller] = []
         # WorkQueue or NativeWorkQueue — same surface (make_workqueue)
         self._queues: dict[str, WorkQueue | NativeWorkQueue] = {}
+        self._workers: dict[str, int] = {}
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._leader_election = leader_election
         self._identity = identity
+        self._default_workers = max(1, default_workers)
+        # loadtest/bench knob: pin EVERY pool to exactly N, overriding
+        # per-controller counts.  Only for harnesses that know their whole
+        # controller set — it also overrides controllers that must stay
+        # single-worker (e.g. gang release decisions).
+        self._force_workers = force_workers
         self.log = get_logger("manager", identity=identity)
 
-    def add(self, controller: Controller) -> None:
+    def add(self, controller: Controller, *, workers: int | None = None,
+            ) -> None:
         self.controllers.append(controller)
-        self._queues[controller.name] = make_workqueue()
+        self._queues[controller.name] = make_workqueue(controller.name)
+        if self._force_workers is not None:
+            workers = self._force_workers
+        self._workers[controller.name] = max(
+            1, workers if workers is not None else self._default_workers)
 
     def _watched_kinds(self) -> set[str]:
         kinds: set[str] = set()
@@ -312,12 +413,14 @@ class Manager:
         self._watch = watch
 
         for c in self.controllers:
-            t = threading.Thread(target=self._worker, args=(c,), daemon=True,
-                                 name=c.name)
-            t.start()
-            self._threads.append(t)
+            for i in range(self._workers[c.name]):
+                t = threading.Thread(target=self._worker, args=(c,),
+                                     daemon=True, name=f"{c.name}-{i}")
+                t.start()
+                self._threads.append(t)
         self.log.info("manager started",
-                      controllers=[c.name for c in self.controllers])
+                      controllers=[c.name for c in self.controllers],
+                      workers=dict(self._workers))
 
     def _lease_renewer(self) -> None:
         """Renew the leadership lease; losing it stops this manager so two
@@ -342,24 +445,34 @@ class Manager:
 
     def _worker(self, controller: Controller) -> None:
         q = self._queues[controller.name]
+        name = controller.name
         while not self._stop.is_set():
             req = q.get(timeout=0.3)
-            QUEUE_DEPTH.labels(controller.name).set(q.depth())
             if req is None:
                 continue
+            ACTIVE_WORKERS.labels(name).inc()
+            t0 = time.perf_counter()
             try:
-                result = controller.reconcile(req)
-            except Exception:
-                RECONCILE_TOTAL.labels(controller.name, "error").inc()
-                controller.log.error(
-                    "reconcile failed", key=f"{req.namespace}/{req.name}",
-                    exc_info=True)
-                q.add_rate_limited(req)
-                continue
-            q.forget(req)
-            RECONCILE_TOTAL.labels(controller.name, "success").inc()
-            if result and result.requeue_after:
-                q.add(req, result.requeue_after)
+                try:
+                    result = controller.reconcile(req)
+                except Exception:
+                    RECONCILE_TOTAL.labels(name, "error").inc()
+                    controller.log.error(
+                        "reconcile failed",
+                        key=f"{req.namespace}/{req.name}", exc_info=True)
+                    q.add_rate_limited(req)
+                else:
+                    q.forget(req)
+                    RECONCILE_TOTAL.labels(name, "success").inc()
+                    if result and result.requeue_after:
+                        q.add(req, result.requeue_after)
+            finally:
+                # done AFTER the requeue adds: they land in the dirty set
+                # and are republished here with their delay intact
+                q.done(req)
+                RECONCILE_DURATION.labels(name).observe(
+                    time.perf_counter() - t0)
+                ACTIVE_WORKERS.labels(name).inc(-1)
 
     def stop(self) -> None:
         self._stop.set()
@@ -371,11 +484,14 @@ class Manager:
             release_lease(self.server, "manager-leader", self._identity)
 
     def wait_idle(self, timeout: float = 10.0, settle: float = 0.15) -> bool:
-        """Test helper: wait until all queues drain and stay drained."""
+        """Test helper: wait until all queues drain AND all in-flight
+        reconciles finish, and both stay that way.  Queue depth alone is
+        not idleness with worker pools: a drained queue can still have N
+        reconciles running that will mutate the store (or requeue)."""
         deadline = time.monotonic() + timeout
         quiet_since = None
         while time.monotonic() < deadline:
-            if all(q.due_now(horizon=settle) == 0
+            if all(q.due_now(horizon=settle) == 0 and q.in_flight() == 0
                    for q in self._queues.values()):
                 if quiet_since is None:
                     quiet_since = time.monotonic()

@@ -76,6 +76,10 @@ class _Engine:
                                                        ctypes.c_char_p]
                 lib.kf_wq_forget.argtypes = [ctypes.c_void_p,
                                              ctypes.c_char_p]
+                lib.kf_wq_done.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p]
+                lib.kf_wq_in_flight.restype = ctypes.c_int
+                lib.kf_wq_in_flight.argtypes = [ctypes.c_void_p]
                 lib.kf_wq_get.restype = ctypes.c_int
                 lib.kf_wq_get.argtypes = [ctypes.c_void_p, ctypes.c_double,
                                           ctypes.c_char_p, ctypes.c_int]

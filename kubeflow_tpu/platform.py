@@ -43,11 +43,18 @@ def build_platform(executor: str = "fake", *, extra_env: dict | None = None,
 
     identity = identity or f"{socket.gethostname()}-{os.getpid()}"
     mgr = Manager(server, leader_election=leader_election, identity=identity)
-    mgr.add(JAXJobController(server))
+    # JAXJob stays single-worker: gang release reads the free-slice count
+    # and then acts on it — two concurrent reconciles could both see the
+    # last slice free and overcommit the pool (decisions must serialize)
+    mgr.add(JAXJobController(server), workers=1)
+    # pods are independent keys and the executor reconcile blocks on real
+    # work (subprocess spawn, port binds): the hottest pool in the system
+    pod_workers = int(os.environ.get("KF_POD_WORKERS", "8"))
     if executor == "local":
-        mgr.add(LocalExecutor(server, extra_env=extra_env or {}))
+        mgr.add(LocalExecutor(server, extra_env=extra_env or {}),
+                workers=pod_workers)
     elif executor == "fake":
-        mgr.add(FakeExecutor(server))
+        mgr.add(FakeExecutor(server), workers=pod_workers)
     # executor == "none": an external kubelet owns pod lifecycle
 
     _register_optional(server, mgr, enable)

@@ -69,6 +69,82 @@ class Counter(_Metric):
         self._add((), delta)
 
 
+class Histogram(_Metric):
+    """Prometheus histogram: cumulative le buckets + _sum/_count series."""
+
+    DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, name: str, help_text: str,
+                 label_names: Iterable[str] = (),
+                 buckets: Iterable[float] | None = None):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        # label key -> [per-bucket counts..., +Inf count, sum]
+        self._data: dict[tuple, list[float]] = {}
+
+    def labels(self, *label_values: str) -> "_HistogramHandle":
+        if len(label_values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} labels, "
+                f"got {len(label_values)}")
+        return _HistogramHandle(self, tuple(str(v) for v in label_values))
+
+    def observe(self, value: float) -> None:
+        self._observe((), value)
+
+    def _observe(self, key: tuple, value: float) -> None:
+        with self._lock:
+            row = self._data.get(key)
+            if row is None:
+                row = self._data[key] = [0.0] * (len(self.buckets) + 2)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    row[i] += 1
+                    break
+            else:
+                row[len(self.buckets)] += 1  # +Inf only
+            row[-1] += value
+
+    def count(self, *label_values: str) -> float:
+        row = self._data.get(tuple(str(v) for v in label_values))
+        return sum(row[:-1]) if row else 0.0
+
+    def sum(self, *label_values: str) -> float:
+        row = self._data.get(tuple(str(v) for v in label_values))
+        return row[-1] if row else 0.0
+
+    def expose(self, kind: str) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {kind}"]
+        with self._lock:
+            items = sorted((k, list(v)) for k, v in self._data.items())
+        for key, row in items:
+            base = ",".join(f'{n}="{v}"'
+                            for n, v in zip(self.label_names, key))
+            sep = "," if base else ""
+            cum = 0.0
+            for bound, n in zip(self.buckets, row):
+                cum += n
+                lines.append(f'{self.name}_bucket{{{base}{sep}le="{bound}"}}'
+                             f" {cum}")
+            cum += row[len(self.buckets)]
+            lines.append(f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {cum}')
+            suffix = f"{{{base}}}" if base else ""
+            lines.append(f"{self.name}_sum{suffix} {row[-1]}")
+            lines.append(f"{self.name}_count{suffix} {cum}")
+        return "\n".join(lines)
+
+
+class _HistogramHandle:
+    def __init__(self, metric: Histogram, key: tuple):
+        self._metric = metric
+        self._key = key
+
+    def observe(self, value: float) -> None:
+        self._metric._observe(self._key, value)
+
+
 class Gauge(_Metric):
     def set(self, value: float) -> None:
         self._set((), value)
@@ -92,6 +168,12 @@ class Registry:
     def gauge(self, name: str, help_text: str = "",
               labels: Iterable[str] = ()) -> Gauge:
         return self._register(name, "gauge", Gauge(name, help_text, labels))
+
+    def histogram(self, name: str, help_text: str = "",
+                  labels: Iterable[str] = (),
+                  buckets: Iterable[float] | None = None) -> Histogram:
+        return self._register(
+            name, "histogram", Histogram(name, help_text, labels, buckets))
 
     def _register(self, name: str, kind: str, metric: _Metric):
         with self._lock:

@@ -7,11 +7,18 @@ scheduler, runs on the FakeExecutor, and frees its slice on completion.
 Reports makespan, per-gang queue latency percentiles, and invariant checks
 (never more than M gangs released at once; zero partial releases).
 
+``--workers N`` sizes the pod-executor pool (the JAXJob controller always
+stays single-worker: gang release reads free-slice capacity and then acts
+on it, so release decisions must serialize); ``--sweep 1,8`` runs once per
+pool size and checks the final JAXJob states digest identical.
+
 Usage: python loadtest/load_gangs.py [N_GANGS] [M_SLICES]
+       [--workers W | --sweep 1,8] [--spawn-cost S]
 """
 
 from __future__ import annotations
 
+import argparse
 import sys
 import time
 
@@ -21,15 +28,14 @@ def pct(xs: list[float], p: float) -> float:
     return xs[min(len(xs) - 1, int(p / 100 * len(xs)))]
 
 
-def main() -> int:
-    n_gangs = int(sys.argv[1]) if len(sys.argv) > 1 else 20
-    m_slices = int(sys.argv[2]) if len(sys.argv) > 2 else 4
-
+def run_once(n_gangs: int, m_slices: int, workers: int | None,
+             spawn_cost: float) -> dict:
     from kubeflow_tpu.api import jaxjob as api
     from kubeflow_tpu.controllers import scheduler
     from kubeflow_tpu.controllers.executor import FakeExecutor
     from kubeflow_tpu.controllers.jaxjob import JAXJobController
     from kubeflow_tpu.core import APIServer, Manager, api_object, quota
+    from kubeflow_tpu.core.store import state_digest
 
     server = APIServer()
     quota.register(server)
@@ -43,9 +49,10 @@ def main() -> int:
         spec={"hard": {"cloud-tpu.google.com/v5e":
                        8 * max(m_slices, n_gangs // 2)}}))
     mgr = Manager(server)
-    mgr.add(JAXJobController(server))
+    mgr.add(JAXJobController(server), workers=1)  # decisions serialize
     # each gang holds its slice for a bit so contention is real
-    mgr.add(FakeExecutor(server, run_for=0.3))
+    mgr.add(FakeExecutor(server, run_for=0.3, spawn_cost=spawn_cost),
+            workers=workers)
     mgr.start()
 
     t0 = time.perf_counter()
@@ -77,6 +84,8 @@ def main() -> int:
         max_concurrent = max(max_concurrent, running)
         time.sleep(0.02)
     makespan = time.perf_counter() - t0
+    mgr.wait_idle(timeout=30)
+    digest = state_digest(server)
     mgr.stop()
 
     assert len(t_done) == n_gangs, (
@@ -96,14 +105,47 @@ def main() -> int:
     queue_lat = [t_running[k] - t_created[k] for k in t_created]
     import json
 
-    print(json.dumps({
+    result = {
         "gangs": n_gangs, "slices": m_slices,
+        "workers": workers or "default",
         "makespan_s": round(makespan, 3),
         "max_concurrent": max_concurrent,
         "peak_overlap": peak_overlap,
         "queue_latency_p50_s": round(pct(queue_lat, 50), 3),
         "queue_latency_p99_s": round(pct(queue_lat, 99), 3),
-    }))
+        "digest": digest,
+    }
+    print(json.dumps(result))
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser("load_gangs")
+    ap.add_argument("n_gangs", nargs="?", type=int, default=20)
+    ap.add_argument("m_slices", nargs="?", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="pod-executor pool size")
+    ap.add_argument("--sweep", metavar="W1,W2,..",
+                    help="run once per pool size; final JAXJob state must "
+                    "digest identical")
+    ap.add_argument("--spawn-cost", type=float, default=0.02,
+                    help="blocking container-start latency per pod (s)")
+    args = ap.parse_args()
+
+    if not args.sweep:
+        run_once(args.n_gangs, args.m_slices, args.workers,
+                 args.spawn_cost)
+        return 0
+
+    results = [run_once(args.n_gangs, args.m_slices, w, args.spawn_cost)
+               for w in (int(x) for x in args.sweep.split(","))]
+    if len({r["digest"] for r in results}) != 1:
+        print("FAIL: final store state differs across worker counts")
+        return 1
+    base, best = results[0]["makespan_s"], min(r["makespan_s"]
+                                               for r in results)
+    print(f"state bit-identical across sweep; speedup vs "
+          f"workers={results[0]['workers']}: {base / best:.2f}x")
     return 0
 
 

@@ -4,30 +4,39 @@ observation to the operator).  This one measures: spawn N notebooks, record
 time-to-ready for each, print percentiles — the reconcile-latency baseline
 BASELINE.md says this repo must establish.
 
-Usage: python loadtest/load_notebooks.py [N] [--stop-start]
+``--workers N`` pins every controller pool to N (Manager force_workers);
+``--sweep 1,8`` runs the same scenario once per worker count and checks the
+final store state digests BIT-IDENTICAL (modulo resourceVersion/uid/
+timestamp ordering artifacts): worker pools must change throughput, never
+outcomes.
+
+Usage: python loadtest/load_notebooks.py [N] [--workers W | --sweep 1,8]
+       [--stop-start]
 """
 
 from __future__ import annotations
 
+import argparse
 import sys
 import time
 
 
-def main() -> int:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 50
-    do_stop_start = "--stop-start" in sys.argv
-
+def run_once(n: int, workers: int | None, do_stop_start: bool,
+             spawn_cost: float = 0.05) -> dict:
     from kubeflow_tpu.admission.webhook import register as register_adm
     from kubeflow_tpu.api import notebook as nb_api
     from kubeflow_tpu.controllers.executor import FakeExecutor
     from kubeflow_tpu.controllers.notebook import register as register_nb
     from kubeflow_tpu.core import APIServer, Manager
+    from kubeflow_tpu.core.store import state_digest
 
     server = APIServer()
     register_adm(server)
-    mgr = Manager(server)
+    mgr = Manager(server, force_workers=workers)
     register_nb(server, mgr)
-    mgr.add(FakeExecutor(server, complete=False))
+    # spawn_cost models the container runtime's blocking create/pull
+    # latency — the serial floor worker pools are built to hide
+    mgr.add(FakeExecutor(server, complete=False, spawn_cost=spawn_cost))
     mgr.start()
 
     t_created = {}
@@ -40,27 +49,39 @@ def main() -> int:
 
     deadline = time.perf_counter() + max(60, n * 0.5)
     while len(t_ready) < n and time.perf_counter() < deadline:
-        for nb in server.list(nb_api.KIND, namespace="loadtest"):
+        # projected observer: the measurement loop must not itself be the
+        # load (a full-copy list of N notebooks per 50ms tick was)
+        for nb in server.project(nb_api.KIND,
+                                 ("metadata.name", "status.readyReplicas"),
+                                 namespace="loadtest"):
             name = nb["metadata"]["name"]
             if name not in t_ready and nb.get("status", {}).get(
                     "readyReplicas"):
                 t_ready[name] = time.perf_counter()
         time.sleep(0.05)
     total = time.perf_counter() - t0
+    mgr.wait_idle(timeout=30)
 
     lat = sorted(t_ready[k] - t_created[k] for k in t_ready)
+    out = {"n": n, "workers": workers or "default", "ready": len(t_ready),
+           "makespan_s": round(total, 3)}
     if not lat:
         print("FAIL: no notebook became ready")
-        return 1
+        out["ok"] = False
+        mgr.stop()
+        return out
 
     def pct(p):
         return lat[min(int(len(lat) * p / 100), len(lat) - 1)]
 
-    print(f"notebooks: {n}  ready: {len(t_ready)}  wall: {total:.2f}s  "
-          f"throughput: {len(t_ready) / total:.1f} ready/s")
-    print(f"time-to-ready  p50={pct(50) * 1000:.0f}ms  "
-          f"p90={pct(90) * 1000:.0f}ms  p99={pct(99) * 1000:.0f}ms  "
-          f"max={lat[-1] * 1000:.0f}ms")
+    out.update(p50_ms=round(pct(50) * 1000), p90_ms=round(pct(90) * 1000),
+               p99_ms=round(pct(99) * 1000), max_ms=round(lat[-1] * 1000),
+               throughput=round(len(t_ready) / total, 1))
+    print(f"workers={out['workers']}  notebooks: {n}  ready: "
+          f"{len(t_ready)}  wall: {total:.2f}s  "
+          f"throughput: {out['throughput']} ready/s")
+    print(f"time-to-ready  p50={out['p50_ms']}ms  p90={out['p90_ms']}ms  "
+          f"p99={out['p99_ms']}ms  max={out['max_ms']}ms")
 
     if do_stop_start:
         t1 = time.perf_counter()
@@ -72,15 +93,64 @@ def main() -> int:
         stopped = 0
         deadline = time.perf_counter() + 60
         while stopped < n and time.perf_counter() < deadline:
-            stopped = sum(
-                1 for s in server.list("StatefulSet", namespace="loadtest")
-                if s["spec"].get("replicas") == 0)
+            stopped = server.count(
+                "StatefulSet", namespace="loadtest",
+                field_match={"spec.replicas": 0})
             time.sleep(0.05)
         print(f"stop-all: {stopped}/{n} scaled to zero in "
               f"{time.perf_counter() - t1:.2f}s")
+        mgr.wait_idle(timeout=30)
 
+    # digest AFTER idle: the state the controllers converged to
+    out["digest"] = state_digest(server)
+    out["ok"] = len(t_ready) == n
     mgr.stop()
-    return 0 if len(t_ready) == n else 1
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser("load_notebooks")
+    ap.add_argument("n", nargs="?", type=int, default=50)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="pin every controller pool to this many workers")
+    ap.add_argument("--sweep", metavar="W1,W2,..",
+                    help="run once per worker count; final store state "
+                    "must digest identical across the sweep")
+    ap.add_argument("--stop-start", action="store_true")
+    ap.add_argument("--spawn-cost", type=float, default=0.05,
+                    help="blocking container-start latency per pod, "
+                    "seconds — models the CRI pull/create a kubelet "
+                    "blocks on (0 = pure in-memory CPU-bound regime)")
+    args = ap.parse_args()
+
+    if not args.sweep:
+        res = run_once(args.n, args.workers, args.stop_start,
+                       args.spawn_cost)
+        print(f"state digest: {res.get('digest', 'n/a')[:16]}")
+        return 0 if res["ok"] else 1
+
+    results = []
+    for w in (int(x) for x in args.sweep.split(",")):
+        results.append(run_once(args.n, w, args.stop_start,
+                                args.spawn_cost))
+    print()
+    print("workers  makespan_s  p50_ms  p99_ms  ready/s  digest")
+    for r in results:
+        print(f"{r['workers']:>7}  {r['makespan_s']:>10}  "
+              f"{r.get('p50_ms', '-'):>6}  {r.get('p99_ms', '-'):>6}  "
+              f"{r.get('throughput', '-'):>7}  {r.get('digest', '')[:12]}")
+    if not all(r["ok"] for r in results):
+        print("FAIL: a sweep leg did not converge")
+        return 1
+    digests = {r["digest"] for r in results}
+    if len(digests) != 1:
+        print("FAIL: final store state differs across worker counts")
+        return 1
+    base = results[0]["makespan_s"]
+    best = min(r["makespan_s"] for r in results)
+    print(f"state bit-identical across sweep; speedup vs "
+          f"workers={results[0]['workers']}: {base / best:.2f}x")
+    return 0
 
 
 if __name__ == "__main__":

@@ -13,6 +13,12 @@
 //    5ms * 2^n capped at 30s; forget(key) resets.
 //  - get(timeout): blocks until a key is due, the timeout lapses (returns
 //    0) or shutdown (returns -1).
+//  - client-go processing/dirty protocol (workqueue.Type): a key handed
+//    out by get() moves to the PROCESSING set and is never handed to a
+//    second caller; add() of a processing key parks it in the DIRTY map
+//    (earliest requested run time wins) and done(key) republishes it, so
+//    a key re-added mid-reconcile runs exactly once more — never lost,
+//    never run concurrently with itself.
 
 #include <chrono>
 #include <condition_variable>
@@ -22,6 +28,7 @@
 #include <string>
 #include <tuple>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -49,11 +56,18 @@ class WorkQueue {
     void add(const std::string& key, double delay) {
         const double when = now_s() + delay;
         std::lock_guard<std::mutex> g(mu_);
+        if (processing_.count(key)) {
+            auto it = dirty_.find(key);
+            if (it == dirty_.end() || when < it->second) dirty_[key] = when;
+            return;
+        }
         auto it = due_.find(key);
         if (it != due_.end() && it->second <= when) return;
         due_[key] = when;
         heap_.push(Entry{when, ++seq_, key});
-        cv_.notify_all();
+        // one key became runnable: wake ONE worker (notify_all stampeded
+        // every parked pool worker per add; get() re-arms the chain)
+        cv_.notify_one();
     }
 
     void add_rate_limited(const std::string& key) {
@@ -86,6 +100,11 @@ class WorkQueue {
                 if (it == due_.end() || it->second != e.when)
                     continue;  // superseded by an earlier reschedule
                 due_.erase(it);
+                processing_.insert(e.key);
+                // cascade: more work due now -> wake the next worker
+                // (each add only notified one)
+                if (!heap_.empty() && heap_.top().when <= now)
+                    cv_.notify_one();
                 *out = std::move(e.key);
                 return 1;
             }
@@ -100,9 +119,40 @@ class WorkQueue {
         return -1;
     }
 
+    // drop a key the caller could not receive (kf_wq_get's too-small
+    // buffer): clear processing AND any dirty re-add, restoring the
+    // pre-pool semantics "dropped once, recoverable by a future add" —
+    // running done() instead would republish the same oversized key in
+    // a hot -2 loop, and doing nothing would wedge it in processing_
+    // (in_flight never drains, every re-add parks dirty forever)
+    void abandon(const std::string& key) {
+        std::lock_guard<std::mutex> g(mu_);
+        processing_.erase(key);
+        dirty_.erase(key);
+    }
+
+    // worker finished the key: republish a dirty re-add (at its earliest
+    // requested run time) so the mid-reconcile event is not lost
+    void done(const std::string& key) {
+        std::lock_guard<std::mutex> g(mu_);
+        if (!processing_.erase(key)) return;
+        auto it = dirty_.find(key);
+        if (it == dirty_.end()) return;
+        const double when = it->second;
+        dirty_.erase(it);
+        due_[key] = when;
+        heap_.push(Entry{when, ++seq_, key});
+        cv_.notify_one();
+    }
+
     int depth() {
         std::lock_guard<std::mutex> g(mu_);
-        return static_cast<int>(due_.size());
+        return static_cast<int>(due_.size() + dirty_.size());
+    }
+
+    int in_flight() {
+        std::lock_guard<std::mutex> g(mu_);
+        return static_cast<int>(processing_.size());
     }
 
     int due_now(double horizon) {
@@ -110,6 +160,8 @@ class WorkQueue {
         std::lock_guard<std::mutex> g(mu_);
         int n = 0;
         for (const auto& kv : due_)
+            if (kv.second <= cutoff) n++;
+        for (const auto& kv : dirty_)  // reruns as soon as done() lands
             if (kv.second <= cutoff) n++;
         return n;
     }
@@ -125,6 +177,8 @@ class WorkQueue {
     std::condition_variable cv_;
     std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap_;
     std::unordered_map<std::string, double> due_;
+    std::unordered_set<std::string> processing_;
+    std::unordered_map<std::string, double> dirty_;
     std::unordered_map<std::string, int> failures_;
     unsigned long long seq_ = 0;
     bool shutdown_ = false;
@@ -157,12 +211,24 @@ int kf_wq_get(void* q, double timeout, char* out, int cap) {
     std::string key;
     const int rc = static_cast<WorkQueue*>(q)->get(timeout, &key);
     if (rc != 1) return rc;
-    if (static_cast<int>(key.size()) + 1 > cap) return -2;
+    if (static_cast<int>(key.size()) + 1 > cap) {
+        // undeliverable: release it or it wedges in the processing set
+        static_cast<WorkQueue*>(q)->abandon(key);
+        return -2;
+    }
     std::memcpy(out, key.c_str(), key.size() + 1);
     return static_cast<int>(key.size());
 }
 
+void kf_wq_done(void* q, const char* key) {
+    static_cast<WorkQueue*>(q)->done(key);
+}
+
 int kf_wq_depth(void* q) { return static_cast<WorkQueue*>(q)->depth(); }
+
+int kf_wq_in_flight(void* q) {
+    return static_cast<WorkQueue*>(q)->in_flight();
+}
 
 int kf_wq_due_now(void* q, double horizon) {
     return static_cast<WorkQueue*>(q)->due_now(horizon);

@@ -1,10 +1,15 @@
 // ThreadSanitizer harness for the native workqueue: producers add/backoff
-// keys while consumers drain and a meddler polls depth/forgets — the
-// access pattern the Manager's watch-dispatch + worker threads generate.
+// keys while a consumer POOL drains (get/process/done — the worker-pool
+// protocol) and a meddler polls depth/in_flight — the access pattern the
+// Manager's watch-dispatch + N pool workers generate.  Each consumer
+// checks the client-go invariant: a key handed out by get() is never
+// held by two workers at once (per-key in-flight flags), and a key
+// re-added mid-processing reruns after done() instead of being lost.
 // Build & run: make tsan-run (CI gate; any data race fails the binary).
 
 #include <atomic>
 #include <cstdio>
+#include <cstring>
 #include <string>
 #include <thread>
 #include <vector>
@@ -16,16 +21,32 @@ void kf_wq_add(void* q, const char* key, double delay);
 void kf_wq_add_rate_limited(void* q, const char* key);
 void kf_wq_forget(void* q, const char* key);
 int kf_wq_get(void* q, double timeout, char* out, int cap);
+void kf_wq_done(void* q, const char* key);
 int kf_wq_depth(void* q);
+int kf_wq_in_flight(void* q);
 int kf_wq_due_now(void* q, double horizon);
 void kf_wq_shutdown(void* q);
 }
 
+namespace {
+constexpr int kProducers = 4, kConsumers = 4, kPerProducer = 250;
+constexpr int kKeySpace = 50;  // keys are ns/<p>-<i%50>
+
+// per-key single-flight flags; index = p * kKeySpace + (i % kKeySpace)
+std::atomic<int> in_flight_flag[kProducers * kKeySpace];
+
+int key_index(const char* key) {
+    int p = 0, i = 0;
+    if (std::sscanf(key, "ns/%d-%d", &p, &i) != 2) return -1;
+    return p * kKeySpace + i;
+}
+}  // namespace
+
 int main() {
     void* q = kf_wq_new();
     std::atomic<int> got{0};
+    std::atomic<bool> overlap{false};
     std::atomic<int> producers_live{0};
-    const int kProducers = 4, kConsumers = 4, kPerProducer = 250;
 
     std::vector<std::thread> threads;
     for (int p = 0; p < kProducers; p++) {
@@ -33,7 +54,7 @@ int main() {
         threads.emplace_back([q, p, &producers_live] {
             char key[64];
             for (int i = 0; i < kPerProducer; i++) {
-                snprintf(key, sizeof key, "ns/%d-%d", p, i % 50);
+                snprintf(key, sizeof key, "ns/%d-%d", p, i % kKeySpace);
                 if (i % 3 == 0)
                     kf_wq_add_rate_limited(q, key);
                 else
@@ -43,17 +64,26 @@ int main() {
         });
     }
     for (int c = 0; c < kConsumers; c++) {
-        threads.emplace_back([q, &got, &producers_live] {
+        threads.emplace_back([q, &got, &overlap, &producers_live] {
             char out[256];
             for (;;) {
                 const int rc = kf_wq_get(q, 0.05, out, sizeof out);
                 if (rc == -1) return;  // shutdown
                 if (rc > 0) {
+                    const int idx = key_index(out);
+                    if (idx >= 0 &&
+                        in_flight_flag[idx].exchange(1) != 0)
+                        overlap.store(true);  // handed out twice!
                     got.fetch_add(1);
+                    // re-add mid-processing: must park dirty, not dup
+                    if (got.load() % 7 == 0) kf_wq_add(q, out, 0.0);
                     kf_wq_forget(q, out);
+                    if (idx >= 0) in_flight_flag[idx].store(0);
+                    kf_wq_done(q, out);
                 } else if (producers_live.load() == 0 &&
-                           kf_wq_depth(q) == 0) {
-                    return;  // producers finished and queue drained
+                           kf_wq_depth(q) == 0 &&
+                           kf_wq_in_flight(q) == 0) {
+                    return;  // producers finished, drained, nothing held
                 }
             }
         });
@@ -61,6 +91,7 @@ int main() {
     threads.emplace_back([q] {  // meddler
         for (int i = 0; i < 200; i++) {
             kf_wq_depth(q);
+            kf_wq_in_flight(q);
             kf_wq_due_now(q, 0.01);
         }
     });
@@ -71,12 +102,62 @@ int main() {
         std::fprintf(stderr, "FAIL: get after shutdown != -1\n");
         return 1;
     }
+    if (overlap.load()) {
+        std::fprintf(stderr, "FAIL: a key was handed to two workers\n");
+        return 1;
+    }
+    if (kf_wq_in_flight(q) != 0) {
+        std::fprintf(stderr, "FAIL: in_flight != 0 after drain\n");
+        return 1;
+    }
     kf_wq_free(q);
     // dedup means got <= adds; it must still have drained a healthy number
     if (got.load() < 50) {
         std::fprintf(stderr, "FAIL: only %d keys drained\n", got.load());
         return 1;
     }
-    std::printf("wq tsan ok: drained %d keys\n", got.load());
+
+    // single-threaded semantics check for the dirty path: a key re-added
+    // while processing runs exactly once more after done()
+    void* q2 = kf_wq_new();
+    kf_wq_add(q2, "ns/again", 0.0);
+    char buf[64];
+    if (kf_wq_get(q2, 0.5, buf, sizeof buf) <= 0 ||
+        std::strcmp(buf, "ns/again") != 0) {
+        std::fprintf(stderr, "FAIL: dirty-path get #1\n");
+        return 1;
+    }
+    kf_wq_add(q2, "ns/again", 0.0);  // while processing -> dirty
+    if (kf_wq_get(q2, 0.02, buf, sizeof buf) != 0) {
+        std::fprintf(stderr, "FAIL: processing key handed out again\n");
+        return 1;
+    }
+    kf_wq_done(q2, "ns/again");
+    if (kf_wq_get(q2, 0.5, buf, sizeof buf) <= 0) {
+        std::fprintf(stderr, "FAIL: dirty re-add lost after done\n");
+        return 1;
+    }
+    kf_wq_done(q2, "ns/again");
+    if (kf_wq_get(q2, 0.02, buf, sizeof buf) != 0) {
+        std::fprintf(stderr, "FAIL: dirty re-add ran more than once\n");
+        return 1;
+    }
+    // oversized-key path: a key the caller's buffer can't hold must be
+    // ABANDONED (processing cleared, dirty dropped), not wedged in flight
+    kf_wq_add(q2, "ns/a-name-far-longer-than-the-tiny-buffer", 0.0);
+    char tiny[4];
+    if (kf_wq_get(q2, 0.5, tiny, sizeof tiny) != -2) {
+        std::fprintf(stderr, "FAIL: oversized key should return -2\n");
+        return 1;
+    }
+    if (kf_wq_in_flight(q2) != 0 || kf_wq_depth(q2) != 0) {
+        std::fprintf(stderr, "FAIL: oversized key wedged in flight\n");
+        return 1;
+    }
+    kf_wq_shutdown(q2);
+    kf_wq_free(q2);
+
+    std::printf("wq tsan ok: drained %d keys, no double-dispatch\n",
+                got.load());
     return 0;
 }

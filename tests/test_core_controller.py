@@ -110,11 +110,13 @@ def test_workqueue_dedup_and_backoff(queue):
     q.add(r)
     q.add(r)  # deduped while pending
     assert q.get(timeout=0.1) == r
+    q.done(r)
     assert q.get(timeout=0.05) is None
     q.add_rate_limited(r)
     q.add_rate_limited(r)
     t0 = time.monotonic()
     assert q.get(timeout=1.0) == r
+    q.done(r)
     # second failure: delay doubled (>= BASE_DELAY * 2 from the first add)
     assert time.monotonic() - t0 >= WorkQueue.BASE_DELAY
 
@@ -145,6 +147,7 @@ def test_workqueue_forget_resets_backoff(queue):
     for _ in range(8):
         q.add_rate_limited(r)
         assert q.get(timeout=5.0) == r
+        q.done(r)
     q.forget(r)
     q.add_rate_limited(r)  # back to BASE_DELAY, not 2^8 * BASE_DELAY
     t0 = time.monotonic()

@@ -205,3 +205,86 @@ def test_kind_discovery_scopes_to_namespace():
     assert server.kinds(namespace="team-a") == ["Notebook", "Profile"]
     assert server.kinds(namespace="team-b") == ["Experiment", "Profile"]
     assert server.kinds(namespace="empty") == ["Profile"]
+
+
+def test_watch_fanout_no_aliasing(server):
+    """Each watcher must receive its OWN copy of an event: one consumer
+    mutating the event object must not corrupt it for other watchers
+    (or for the store)."""
+    w1 = server.watch(["Notebook"])
+    w2 = server.watch(["Notebook"])
+    server.create(api_object("Notebook", "nb", "ns",
+                             spec={"image": "jax:v1"}))
+    ev1 = w1.next(timeout=1.0)
+    ev1.object["spec"]["image"] = "hacked"
+    ev1.object["metadata"]["labels"]["evil"] = "yes"
+    ev2 = w2.next(timeout=1.0)
+    assert ev2.object["spec"]["image"] == "jax:v1"
+    assert "evil" not in ev2.object["metadata"]["labels"]
+    assert server.get("Notebook", "nb", "ns")["spec"]["image"] == "jax:v1"
+    w1.stop()
+    w2.stop()
+
+
+def test_patch_status_does_not_mutate_prior_reads(server):
+    """COW contract: an object handed out before a status patch keeps its
+    pre-patch contents (writers replace, never mutate in place)."""
+    server.create(api_object("Notebook", "nb", "ns", spec={}))
+    before = server.get("Notebook", "nb", "ns")
+    server.patch_status("Notebook", "nb", "ns", {"phase": "Ready"})
+    assert "status" not in before or before.get("status") != {
+        "phase": "Ready"}
+    assert server.get("Notebook", "nb", "ns")["status"] == {
+        "phase": "Ready"}
+
+
+def test_lockfree_reads_under_write_storm(server):
+    """Readers iterating COW snapshots must never see torn state or raise
+    while a writer churns the same kind (the lock-free read path)."""
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            name = f"w-{i % 40}"
+            try:
+                server.create(api_object("Widget", name, "ns",
+                                         spec={"gen": i}))
+            except Conflict:
+                server.delete("Widget", name, "ns")
+            if i % 3 == 0:
+                try:
+                    server.patch_status("Widget", name, "ns", {"seen": i})
+                except NotFound:
+                    pass  # raced the delete above
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                for obj in server.list("Widget", namespace="ns"):
+                    # every returned object is internally consistent
+                    assert obj["kind"] == "Widget"
+                    assert "resourceVersion" in obj["metadata"]
+                server.count("Widget", namespace="ns")
+                server.project("Widget", ("metadata.name", "status.seen"),
+                               namespace="ns")
+                try:
+                    server.get("Widget", "w-3", "ns")
+                except NotFound:
+                    pass
+        except BaseException as e:  # noqa: BLE001 - surfaced to the test
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.6)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
