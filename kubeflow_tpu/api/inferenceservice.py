@@ -13,12 +13,17 @@ from kubeflow_tpu.parallel.mesh import TOPOLOGIES
 KIND = "InferenceService"
 PORT = 8602
 
+# opt-in radix-tree KV prefix reuse on the predictor: the value is the HBM
+# byte budget in MB for cached prefix blocks (0/absent = disabled)
+PREFIX_CACHE_ANNOTATION = "serving.kubeflow.org/prefix-cache-mb"
+
 
 def new(name: str, namespace: str, *, model: str = "llama",
         size: str = "tiny", topology: str = "v5e-4",
         model_config: dict | None = None,
-        checkpoint_dir: str | None = None, min_replicas: int = 1) -> dict:
-    return api_object(KIND, name, namespace, spec={
+        checkpoint_dir: str | None = None, min_replicas: int = 1,
+        prefix_cache_mb: float | None = None) -> dict:
+    isvc = api_object(KIND, name, namespace, spec={
         "predictor": {
             "model": model,
             "size": size,
@@ -27,6 +32,19 @@ def new(name: str, namespace: str, *, model: str = "llama",
             "topology": topology,
             "minReplicas": min_replicas,
         }})
+    if prefix_cache_mb:
+        isvc["metadata"].setdefault("annotations", {})[
+            PREFIX_CACHE_ANNOTATION] = str(prefix_cache_mb)
+    return isvc
+
+
+def prefix_cache_mb(isvc: dict) -> float:
+    """The annotated prefix-cache HBM budget in MB (0 = disabled)."""
+    raw = isvc.get("metadata", {}).get("annotations", {}).get(
+        PREFIX_CACHE_ANNOTATION)
+    if raw is None:
+        return 0.0
+    return float(raw)
 
 
 def validate(isvc: dict) -> None:
@@ -37,3 +55,17 @@ def validate(isvc: dict) -> None:
     if TOPOLOGIES[topo].hosts != 1:
         raise ValueError("predictors run on single-host slices; shard "
                          "bigger models with tp over in-host chips")
+    try:
+        mb = prefix_cache_mb(isvc)
+    except ValueError:
+        raise ValueError(
+            f"{PREFIX_CACHE_ANNOTATION} must be a number (MB)")
+    import math
+
+    if not math.isfinite(mb):
+        # inf would pass the sign check and CrashLoop the predictor at
+        # startup; nan would silently disable the cache
+        raise ValueError(
+            f"{PREFIX_CACHE_ANNOTATION} must be a finite number (MB)")
+    if mb < 0:
+        raise ValueError(f"{PREFIX_CACHE_ANNOTATION} must be >= 0")

@@ -106,7 +106,12 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                          "kubeflow_tpu/controllers/inferenceservice.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
                      "tests/test_serving.py", "tests/test_serving_engine.py",
-                     "tests/test_quant.py"],
+                     "tests/test_prefix_cache.py", "tests/test_quant.py"],
+        # small-N shared-prefix loadtest: asserts the prefix cache still
+        # cuts prefill dispatches and that warm output == cold output on
+        # real engine traffic (KF_SKIP_SMOKE=1 opts out)
+        "smoke_cmd": [sys.executable, "loadtest/load_serving.py",
+                      "--smoke"],
         "image": "images/predictor",
     },
     "autoscale": {
@@ -155,6 +160,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
                       "depends": [steps[-1]["name"]]})
     steps.append({"name": "test", "run": spec["test_cmd"],
                   "depends": [steps[-1]["name"]]})
+    if "smoke_cmd" in spec:
+        steps.append({"name": "smoke", "run": spec["smoke_cmd"],
+                      "depends": ["test"]})
     if spec.get("image"):
         # kaniko executor (the reference's builder): --no-push is the
         # presubmit mode (ci/notebook_servers pattern)
@@ -184,6 +192,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
             ok = subprocess.run(spec["tsan_cmd"]).returncode == 0
         if ok:
             ok = subprocess.run(spec["test_cmd"]).returncode == 0
+        if (ok and "smoke_cmd" in spec
+                and os.environ.get("KF_SKIP_SMOKE") != "1"):
+            ok = subprocess.run(spec["smoke_cmd"]).returncode == 0
         results[name] = ok
     return results
 

@@ -61,6 +61,9 @@ class InferenceServiceController(Controller):
                 "--port", str(api.PORT)]
         if pred.get("checkpointDir"):
             args += ["--checkpoint-dir", pred["checkpointDir"]]
+        cache_mb = api.prefix_cache_mb(isvc)
+        if cache_mb > 0:
+            args += ["--prefix-cache-mb", str(cache_mb)]
         container = {
             "name": "predictor",
             "image": pred.get("image", "kubeflow-tpu/predictor:latest"),

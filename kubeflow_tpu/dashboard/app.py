@@ -69,6 +69,7 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/quota/<ns>", self.quota_route)
         self.add_route("GET", "/api/metrics/<mtype>", self.metrics_route)
         self.add_route("GET", "/api/autoscale/<ns>", self.autoscale_route)
+        self.add_route("GET", "/api/serving-cache", self.serving_cache_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -127,6 +128,11 @@ class DashboardApp(CrudApp):
         req.authorize("list", "InferenceService", ns)
         return "200 OK", [s for s in autoscaler_state(self.server)
                           if s["namespace"] == ns]
+
+    def serving_cache_route(self, req: Request):
+        """Serving-engine prefix-cache standing (hit rate, cached bytes,
+        evictions) + TTFT p50/p99 from the promoted histogram."""
+        return "200 OK", self.metrics.get_serving_cache_state()
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

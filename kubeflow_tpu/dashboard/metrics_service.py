@@ -40,6 +40,38 @@ def autoscaler_state(server) -> list[dict]:
     return out
 
 
+def serving_cache_state() -> dict:
+    """Prefix-cache + TTFT standing of the serving engines sharing this
+    process's metrics registry (tests and the single-binary dev platform;
+    a scraped deployment reads the same series off each predictor's
+    ``/metrics``): hit rate, cached bytes/blocks, evictions, prefill
+    dispatch count, and TTFT p50/p99 from the histogram the engine
+    promoted (the last-value gauge stays for old panels)."""
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    def val(name: str) -> float:
+        m = REGISTRY.get_metric(name)
+        return m.get() if m is not None else 0.0
+
+    hits = val("serving_prefix_cache_hits_total")
+    misses = val("serving_prefix_cache_misses_total")
+    ttft = REGISTRY.get_metric("serving_time_to_first_token_seconds")
+    return {
+        "prefix_cache": {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+            "evictions": val("serving_prefix_cache_evictions_total"),
+            "bytes": val("serving_prefix_cache_bytes"),
+            "blocks": val("serving_prefix_cache_nodes"),
+        },
+        "prefill_dispatches": val("serving_prefill_dispatches_total"),
+        "prefill_tokens": val("serving_prefill_tokens_total"),
+        "ttft_p50_s": ttft.percentile(50) if ttft is not None else 0.0,
+        "ttft_p99_s": ttft.percentile(99) if ttft is not None else 0.0,
+    }
+
+
 class MetricsService(Protocol):
     def get_node_cpu_utilization(self, span_s: int) -> list[dict]: ...
 
@@ -50,6 +82,8 @@ class MetricsService(Protocol):
     def get_tpu_duty_cycle(self, span_s: int) -> list[dict]: ...
 
     def get_autoscaler_state(self) -> list[dict]: ...
+
+    def get_serving_cache_state(self) -> dict: ...
 
 
 class LocalMetricsService:
@@ -96,6 +130,9 @@ class LocalMetricsService:
 
     def get_autoscaler_state(self) -> list[dict]:
         return autoscaler_state(self.server)
+
+    def get_serving_cache_state(self) -> dict:
+        return serving_cache_state()
 
 
 class CloudMonitoringMetricsService:
@@ -151,6 +188,10 @@ class CloudMonitoringMetricsService:
         # not Cloud Monitoring — a cloud-metrics deployment still runs
         # the in-tree autoscaler, so read the store here too
         return autoscaler_state(self.server) if self.server else []
+
+    def get_serving_cache_state(self):
+        # serving counters live in the process-local registry either way
+        return serving_cache_state()
 
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:

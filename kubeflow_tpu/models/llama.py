@@ -90,8 +90,11 @@ class LlamaAttention(nn.Module):
 
         if cache is not None:
             # cache is dict(k=[B,S,Hkv,D], v=..., index) where index is a
-            # scalar (legacy equal-length batches) or [B] (ragged batches /
-            # continuous batching: every sequence sits at its own position)
+            # scalar (equal-length batches, and the serving engine's
+            # batch-1 prefill-from-index: a multi-token block continues
+            # from a non-zero position — prefix-cache suffix extension and
+            # chunked prefill) or [B] (ragged batches / continuous
+            # batching: every sequence sits at its own position)
             idx = cache["index"]
             if idx.ndim == 0:
                 ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, idx,
@@ -107,13 +110,16 @@ class LlamaAttention(nn.Module):
                 ck = cache["k"].at[b_idx, write].set(k[:, 0])
                 cv = cache["v"].at[b_idx, write].set(v[:, 0])
             else:
-                # ragged prefill into fresh rows: the padded block writes at
-                # slot 0; junk beyond each row's true length stays masked
-                # until overwritten by decode
-                ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, 0,
-                                                         axis=1)
-                cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v, 0,
-                                                         axis=1)
+                # ragged multi-token prefill: each row's padded block
+                # writes at its OWN index[b] (0 for fresh rows — the
+                # classic path; non-zero rows continue from an existing
+                # prefix). Junk beyond a row's true length stays masked
+                # until overwritten by decode.
+                write = jax.vmap(
+                    lambda c, u, i: jax.lax.dynamic_update_slice(
+                        c, u, (i, jnp.int32(0), jnp.int32(0))))
+                ck = write(cache["k"], k, idx)
+                cv = write(cache["v"], v, idx)
             cache = {"k": ck, "v": cv, "index": idx + x.shape[1]}
             s_total = ck.shape[1]
             # causal per query: key slot j visible to the query at absolute

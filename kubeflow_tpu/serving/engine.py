@@ -40,8 +40,26 @@ ACTIVE_SLOTS = REGISTRY.gauge("serving_active_requests",
                               "requests currently decoding")
 TTFT_LAST = REGISTRY.gauge("serving_ttft_seconds",
                            "time to first token, last request")
+# the gauge above stays for dashboard compatibility; the histogram is what
+# p50/p99 panels and the loadtest aggregate from
+TTFT_HIST = REGISTRY.histogram(
+    "serving_time_to_first_token_seconds", "time to first token",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
 TOKS_PER_SEC = REGISTRY.gauge("serving_tokens_per_sec",
                               "decode throughput, last window")
+PREFILL_DISPATCHES = REGISTRY.counter(
+    "serving_prefill_dispatches_total",
+    "prefill forward dispatches (full-prompt or chunked extend)")
+PREFILL_TOKENS = REGISTRY.counter(
+    "serving_prefill_tokens_total",
+    "real prompt tokens run through prefill compute")
+PREFIX_HITS = REGISTRY.counter(
+    "serving_prefix_cache_hits_total",
+    "admissions that reused a cached KV prefix")
+PREFIX_MISSES = REGISTRY.counter(
+    "serving_prefix_cache_misses_total",
+    "admissions that found no usable cached prefix")
 
 PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
 DECODE_CHUNKS = (8, 16, 32, 64, 128)
@@ -74,7 +92,8 @@ class ContinuousBatcher:
     """Shares one device cache of ``max_batch`` slots across requests."""
 
     def __init__(self, module, params, cfg, *, max_batch: int = 4,
-                 max_seq: int = 512, mesh=None):
+                 max_seq: int = 512, mesh=None,
+                 prefix_cache_bytes: int = 0, prefill_chunk: int = 512):
         from kubeflow_tpu.models import llama as llama_mod
 
         self.module = module
@@ -82,6 +101,15 @@ class ContinuousBatcher:
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_seq = min(max_seq, cfg.max_seq_len)
+        # longest suffix a single prefill dispatch may run: longer prompts
+        # prefill in chunks so one large admission cannot block in-flight
+        # decode for the whole prompt
+        self.prefill_chunk = max(1, min(prefill_chunk, self.max_seq))
+        self.prefix_cache = None
+        if prefix_cache_bytes > 0:
+            from kubeflow_tpu.serving.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(prefix_cache_bytes)
         self.mesh = mesh  # tp>1: params arrive pre-sharded (serving/
         # sharded.py); the KV cache shards heads over tp here and XLA
         # propagates both through prefill/insert/decode
@@ -113,10 +141,15 @@ class ContinuousBatcher:
         self._work = threading.Condition(self._lock)
         self._auto_seed = 0
         self._stop = False
+        self._closed = False  # terminal: submit() rejects until restart()
         self._thread: threading.Thread | None = None
         self._prefill_cache: dict[int, object] = {}
         self._decode_cache: dict[tuple[int, bool], object] = {}
         self._insert_fn = None
+        self._seed_cache: dict[int, object] = {}
+        self._extend_cache: dict[tuple[int, bool], object] = {}
+        self._snap_cache: dict[int, object] = {}
+        self._zeros_fn = None
 
     # -- public ----------------------------------------------------------------
     def submit(self, ids: list[int], max_new_tokens: int = 32,
@@ -137,12 +170,18 @@ class ContinuousBatcher:
             top_p = 0.0  # the full distribution: normalize to "disabled"
                          # so it doesn't force the filtered decode variant
         with self._work:
+            # one critical section for the closed check, seed assignment,
+            # enqueue, and thread (re)spawn: a concurrent shutdown() can
+            # never interleave and get resurrected by a late enqueue
+            if self._closed:
+                raise RuntimeError(
+                    "serving engine is shut down (call restart() to serve "
+                    "again)")
             if seed is None:
                 self._auto_seed += 1
                 seed = self._auto_seed
-        req = GenRequest(list(ids), max_new_tokens, temperature, eos_id,
-                         seed=seed, top_k=top_k, top_p=top_p)
-        with self._work:
+            req = GenRequest(list(ids), max_new_tokens, temperature, eos_id,
+                             seed=seed, top_k=top_k, top_p=top_p)
             self.queue.append(req)
             QUEUE_DEPTH.set(len(self.queue))
             if self._thread is None or not self._thread.is_alive():
@@ -171,18 +210,32 @@ class ContinuousBatcher:
         requests queued for a slot, and the slot capacity.  Lock-held so
         the two counts are mutually consistent."""
         with self._work:
-            return {
+            out = {
                 "active": sum(1 for s in self.slots if s is not None),
                 "queued": len(self.queue),
                 "max_batch": self.max_batch,
             }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out
 
     def shutdown(self) -> None:
+        """Terminal: pending and in-flight requests fail, and any
+        concurrent or later ``submit()`` raises instead of silently
+        flipping ``_stop`` back and resurrecting the batcher thread
+        mid-shutdown. ``restart()`` reopens the engine explicitly."""
         with self._work:
+            self._closed = True
             self._stop = True
             self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
+
+    def restart(self) -> None:
+        """Reopen a shut-down engine; the batcher thread respawns on the
+        next submit()."""
+        with self._work:
+            self._closed = False
 
     # -- compiled pieces -------------------------------------------------------
     def _prefill(self, bucket: int):
@@ -203,10 +256,97 @@ class ContinuousBatcher:
                     out["logits"][0], last_pos, axis=0, keepdims=False)
                 tok = _sample_rows(logits[None, :], temp[None], key[None, :],
                                    top_k[None], top_p[None])
-                return tok[0], out["cache"]
+                return tok[0], _kv_only(out["cache"])
 
             self._prefill_cache[bucket] = fn
         return self._prefill_cache[bucket]
+
+    def _bucket_for(self, n: int) -> int:
+        bucket = next((b for b in PREFILL_BUCKETS if b >= n), self.max_seq)
+        return min(bucket, self.max_seq)
+
+    def _zeros(self):
+        """Jitted: a fresh batch-1 kv tree (chunked cold prefill seeds from
+        nothing)."""
+        if self._zeros_fn is None:
+            shape = (1, self.max_seq, self.cfg.num_kv_heads,
+                     self.cfg.head_dim)
+            dtype = self.cfg.jnp_dtype
+            n_layers = self.cfg.num_layers
+
+            @jax.jit
+            def fn():
+                return {"layers": [{"k": jnp.zeros(shape, dtype),
+                                    "v": jnp.zeros(shape, dtype)}
+                                   for _ in range(n_layers)]}
+
+            self._zeros_fn = fn
+        return self._zeros_fn
+
+    def _seed(self, block_len: int):
+        """Jitted: materialize a batch-1 working cache with a cached prefix
+        block (snapped to ``block_len``) copied in at position 0 — ONE
+        dispatch regardless of how long the reused prefix is."""
+        if block_len not in self._seed_cache:
+            shape = (1, self.max_seq, self.cfg.num_kv_heads,
+                     self.cfg.head_dim)
+            dtype = self.cfg.jnp_dtype
+
+            @jax.jit
+            def fn(block):
+                out = {"layers": []}
+                for l in block["layers"]:
+                    out["layers"].append({
+                        "k": jax.lax.dynamic_update_slice(
+                            jnp.zeros(shape, dtype), l["k"], (0, 0, 0, 0)),
+                        "v": jax.lax.dynamic_update_slice(
+                            jnp.zeros(shape, dtype), l["v"], (0, 0, 0, 0)),
+                    })
+                return out
+
+            self._seed_cache[block_len] = fn
+        return self._seed_cache[block_len]
+
+    def _snap(self, bucket: int):
+        """Jitted: slice a batch-1 kv tree down to ``bucket`` positions —
+        the device-resident block a radix node owns."""
+        if bucket not in self._snap_cache:
+            @jax.jit
+            def fn(small):
+                return {"layers": [
+                    {"k": jax.lax.slice_in_dim(l["k"], 0, bucket, axis=1),
+                     "v": jax.lax.slice_in_dim(l["v"], 0, bucket, axis=1)}
+                    for l in small["layers"]]}
+
+            self._snap_cache[bucket] = fn
+        return self._snap_cache[bucket]
+
+    def _extend(self, chunk_len: int, sample: bool):
+        """Prefill CONTINUED from a non-zero cache index: run ``chunk_len``
+        prompt tokens against a batch-1 cache whose first ``start``
+        positions already hold valid KV (cached prefix and/or earlier
+        chunks). ``sample=True`` (the final chunk) also picks the logits
+        at the last real position and samples the first token in the same
+        executable — a full-prefix hit is exactly one such dispatch."""
+        key = (chunk_len, sample)
+        if key not in self._extend_cache:
+            @functools.partial(jax.jit, donate_argnums=(3,))
+            def fn(params, ids, start, small, last_pos, temp, key, top_k,
+                   top_p):
+                full = {"layers": [dict(l, index=start)
+                                   for l in small["layers"]]}
+                out = self.module.apply({"params": params}, ids, cache=full)
+                new_kv = _kv_only(out["cache"])
+                if not sample:
+                    return new_kv
+                logits = jax.lax.dynamic_index_in_dim(
+                    out["logits"][0], last_pos, axis=0, keepdims=False)
+                tok = _sample_rows(logits[None, :], temp[None], key[None, :],
+                                   top_k[None], top_p[None])
+                return tok[0], new_kv
+
+            self._extend_cache[key] = fn
+        return self._extend_cache[key]
 
     def _insert(self):
         """Jitted: copy a batch-1 prefill cache into slot row ``b``.
@@ -275,12 +415,18 @@ class ContinuousBatcher:
                         for req in list(self.queue) + [s for s in self.slots
                                                        if s]:
                             req.error = "serving engine shut down"
+                            REQS_TOTAL.labels("shutdown").inc()
                             req._done.set()
                         self.queue.clear()
                         self.slots = [None] * self.max_batch
                         return
-                    queue_empty = not self.queue
                 self._admit()
+                # queue state is re-read AFTER admission: requests that
+                # arrived or stayed queued while _admit ran must keep
+                # decode chunks small — the stale pre-admit snapshot gave
+                # them the large alone-in-the-batch chunk
+                with self._work:
+                    queue_empty = not self.queue
                 if any(self.slots):
                     self._decode_chunk(queue_empty)
         except Exception:
@@ -288,6 +434,7 @@ class ContinuousBatcher:
             with self._work:
                 for req in list(self.queue) + [s for s in self.slots if s]:
                     req.error = "serving engine crashed"
+                    REQS_TOTAL.labels("error").inc()
                     req._done.set()
                 self.queue.clear()
                 self.slots = [None] * self.max_batch
@@ -305,23 +452,25 @@ class ContinuousBatcher:
                 req = self.queue.pop(0)
                 QUEUE_DEPTH.set(len(self.queue))
             prompt_len = len(req.ids)
-            bucket = next((b for b in PREFILL_BUCKETS if b >= prompt_len),
-                          self.max_seq)
-            bucket = min(bucket, self.max_seq)
-            padded = req.ids + [0] * (bucket - prompt_len)
-            arr = jnp.asarray([padded], jnp.int32)
             # the request's own key chain starts at its seed
             k_first, k_chain = jax.random.split(
                 jax.random.PRNGKey(req.seed))
-            tok, small_cache = self._prefill(bucket)(
-                self.params, arr, jnp.int32(prompt_len - 1),
-                jnp.float32(req.temperature), k_first,
-                jnp.int32(req.top_k), jnp.float32(req.top_p))
+            tok, small_cache, fully_cached = self._run_prefill(req, k_first)
+            if self.prefix_cache is not None and not fully_cached:
+                # cache the WHOLE prompt's KV (RadixAttention discipline:
+                # insert everything, let LRU sort out what traffic shares),
+                # snapped to a bucket so seeding compiles once per bucket.
+                # A full-prefix hit skips this: insert() would just drop
+                # the freshly snapped copy, so don't pay its dispatch.
+                snap = self._bucket_for(prompt_len)
+                self.prefix_cache.insert(
+                    req.ids, self._snap(snap)(small_cache))
             self.cache = self._insert()(self.cache, small_cache,
                                         jnp.int32(free))
             tok_host = int(tok)
             req.first_token_at = time.perf_counter()
             TTFT_LAST.set(req.first_token_at - req.submitted_at)
+            TTFT_HIST.observe(req.first_token_at - req.submitted_at)
             req.generated.append(tok_host)
             TOKENS_TOTAL.inc()
             self.index = self.index.at[free].set(prompt_len)
@@ -335,6 +484,79 @@ class ContinuousBatcher:
                 ACTIVE_SLOTS.set(sum(1 for s in self.slots if s))
             if self._finish_if_done(free):
                 continue
+
+    def _run_prefill(self, req: GenRequest, k_first) -> tuple:
+        """Run the prompt and sample the first token; returns
+        ``(token, batch-1 kv tree, fully_cached)`` ready for slot
+        insertion (``fully_cached``: the radix tree already holds the
+        whole prompt, so re-inserting it would be a wasted dispatch).
+
+        Three shapes, all token-identical (the per-position KV and the
+        last-position logits are bitwise independent of how the prompt is
+        split — asserted by tests/test_prefix_cache.py):
+        - longest-prefix HIT: copy the cached block in (one dispatch) and
+          prefill only the suffix, so TTFT no longer depends on how long
+          the shared prefix is;
+        - short cold prompt: the classic single full-prefill dispatch;
+        - long cold prompt (> prefill_chunk): chunked extend from zero, so
+          admission interleaves with in-flight decode instead of blocking
+          it for the whole prompt.
+        """
+        prompt_len = len(req.ids)
+        node, usable, fully_cached = None, 0, False
+        if self.prefix_cache is not None:
+            node, matched = self.prefix_cache.match(req.ids, pin=True)
+            fully_cached = matched >= prompt_len
+            # always leave >= 1 suffix token: the extend dispatch is where
+            # the first-token logits come from (blocks hold KV, not logits)
+            usable = min(matched, prompt_len - 1)
+            if node is not None and usable <= 0:
+                self.prefix_cache.release(node)
+                node, usable = None, 0
+            (PREFIX_HITS if node is not None else PREFIX_MISSES).inc()
+        try:
+            if node is None and prompt_len <= self.prefill_chunk:
+                bucket = self._bucket_for(prompt_len)
+                padded = req.ids + [0] * (bucket - prompt_len)
+                arr = jnp.asarray([padded], jnp.int32)
+                tok, small = self._prefill(bucket)(
+                    self.params, arr, jnp.int32(prompt_len - 1),
+                    jnp.float32(req.temperature), k_first,
+                    jnp.int32(req.top_k), jnp.float32(req.top_p))
+                PREFILL_DISPATCHES.inc()
+                PREFILL_TOKENS.inc(prompt_len)
+                return tok, small, fully_cached
+            if node is not None:
+                small = self._seed(node.block_len)(node.block)
+            else:
+                small = self._zeros()()
+            pos = usable
+            while True:
+                take = min(prompt_len - pos, self.prefill_chunk)
+                # pad the chunk up to a bucket, but never past max_seq:
+                # dynamic_update_slice CLAMPS an out-of-range start index,
+                # which would slide the write over real earlier positions
+                room = self.max_seq - pos
+                cb = next((b for b in PREFILL_BUCKETS
+                           if take <= b <= room), take)
+                chunk = req.ids[pos:pos + take] + [0] * (cb - take)
+                arr = jnp.asarray([chunk], jnp.int32)
+                last = pos + take >= prompt_len
+                out = self._extend(cb, last)(
+                    self.params, arr, jnp.int32(pos), small,
+                    jnp.int32(take - 1), jnp.float32(req.temperature),
+                    k_first, jnp.int32(req.top_k),
+                    jnp.float32(req.top_p))
+                PREFILL_DISPATCHES.inc()
+                PREFILL_TOKENS.inc(take)
+                pos += take
+                if last:
+                    tok, small = out
+                    return tok, small, fully_cached
+                small = out
+        finally:
+            if node is not None:
+                self.prefix_cache.release(node)
 
     def _decode_chunk(self, queue_empty: bool) -> None:
         remaining = [s.max_new_tokens - len(s.generated)

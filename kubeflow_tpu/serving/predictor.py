@@ -36,7 +36,8 @@ class GenerativePredictor:
                  checkpoint_dir: str | None = None,
                  max_batch: int = 4, max_seq: int = 512, seed: int = 0,
                  quantize: bool = False, fast_init: bool = False,
-                 tp: int = 1, ep: int = 1):
+                 tp: int = 1, ep: int = 1,
+                 prefix_cache_mb: float = 0.0, prefill_chunk: int = 512):
         from kubeflow_tpu.models import registry
 
         self.log = get_logger("predictor", model=model_name, size=size)
@@ -119,10 +120,17 @@ class GenerativePredictor:
                                                self.mesh)
         from kubeflow_tpu.serving.engine import ContinuousBatcher
 
+        # prefix_cache_mb > 0 opts into radix-tree KV prefix reuse: shared
+        # system prompts prefill once, later admissions copy the cached
+        # block and prefill only their suffix (HBM budget in MB because
+        # annotations/CLI carry human-sized numbers)
         self.engine = ContinuousBatcher(self.module, self.params, self.cfg,
                                         max_batch=max_batch,
                                         max_seq=self.max_seq,
-                                        mesh=self.mesh)
+                                        mesh=self.mesh,
+                                        prefix_cache_bytes=int(
+                                            prefix_cache_mb * (1 << 20)),
+                                        prefill_chunk=prefill_chunk)
         self.log.info("predictor ready",
                       params=sum(x.size for x in
                                  jax.tree_util.tree_leaves(self.params)))
@@ -291,6 +299,12 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=8602)
     parser.add_argument("--max-batch", type=int, default=4)
     parser.add_argument("--max-seq", type=int, default=512)
+    parser.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                        help="HBM byte budget (MB) for radix-tree KV "
+                             "prefix reuse; 0 disables")
+    parser.add_argument("--prefill-chunk", type=int, default=512,
+                        help="max prompt tokens per prefill dispatch "
+                             "(longer prompts prefill in chunks)")
     args = parser.parse_args(argv)
 
     specs = [m for m in (args.models or []) if m] or ["llama"]
@@ -315,7 +329,11 @@ def main(argv=None) -> int:
                 quantize=opts.get("quantize", "").lower()
                 in ("1", "true", "int8"),
                 tp=int(opts.get("tp", 1)),
-                ep=int(opts.get("ep", 1)))
+                ep=int(opts.get("ep", 1)),
+                prefix_cache_mb=float(opts.get("prefix_cache_mb",
+                                               args.prefix_cache_mb)),
+                prefill_chunk=int(opts.get("prefill_chunk",
+                                           args.prefill_chunk)))
         else:
             predictors[name] = ClassifierPredictor(name,
                                                    checkpoint_dir=ckpt)

@@ -1,0 +1,211 @@
+"""Host-managed radix tree over token prefixes with device-resident KV blocks.
+
+RadixAttention-style prefix reuse (SGLang, Zheng et al. 2024; block-level
+KV management after vLLM's PagedAttention, Kwon et al. SOSP'23) adapted to
+this engine's network-attached-TPU constraints:
+
+- the TREE lives on the host (pure Python, no dispatch to walk it); only
+  the KV blocks are device arrays, so a longest-prefix match costs zero
+  tunnel RTTs;
+- every node's block covers the FULL prefix from the root (positions
+  ``[0, length)``), snapped up to a ``PREFILL_BUCKETS`` length so the
+  engine's seed/extend executables compile once per bucket, never per
+  prompt. Any matched prefix of a block is valid — k/v at position p
+  depends only on tokens ``<= p`` — so a partial match into an edge still
+  reuses the covered positions;
+- eviction is LRU under an explicit HBM byte budget, and a node PINNED by
+  an in-flight admission (``match(pin=True)`` .. ``release()``) is never
+  evicted: the engine holds the pin across its seed/extend dispatches so
+  the budget sweep cannot free a block a queued computation reads.
+
+The engine (serving/engine.py) owns all device work; this module only
+decides WHAT to reuse and WHEN to free.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+EVICTIONS_TOTAL = REGISTRY.counter(
+    "serving_prefix_cache_evictions_total",
+    "prefix-cache KV blocks evicted under the HBM budget")
+CACHED_BYTES = REGISTRY.gauge(
+    "serving_prefix_cache_bytes",
+    "device bytes held by cached prefix KV blocks")
+CACHED_NODES = REGISTRY.gauge(
+    "serving_prefix_cache_nodes",
+    "radix-tree nodes currently holding a KV block")
+
+
+def block_nbytes(block) -> int:
+    return sum(x.nbytes for x in jax.tree_util.tree_leaves(block))
+
+
+class _Node:
+    __slots__ = ("edge", "length", "parent", "children", "block",
+                 "block_len", "refs", "last_used")
+
+    def __init__(self, edge: tuple, parent: "_Node | None"):
+        self.edge = edge                      # tokens on the edge from parent
+        self.parent = parent
+        self.length = (parent.length if parent else 0) + len(edge)
+        self.children: dict[int, _Node] = {}  # first edge token -> child
+        self.block = None                     # per-layer {k, v} device arrays
+        self.block_len = 0                    # snapped array length (bytes src)
+        self.refs = 0                         # in-flight admissions pinning us
+        self.last_used = 0.0
+
+
+class PrefixCache:
+    """Radix tree of token prefixes; nodes own snapped KV blocks, LRU-evicted
+    under ``max_bytes``. Thread-safe (the batcher thread mutates, scrapers
+    read stats)."""
+
+    def __init__(self, max_bytes: int):
+        if max_bytes <= 0:
+            raise ValueError("prefix cache needs a positive byte budget")
+        self.max_bytes = int(max_bytes)
+        self.root = _Node((), None)
+        self.bytes = 0
+        self._blocked: set[_Node] = set()   # nodes currently holding a block
+        self._lock = threading.Lock()
+
+    # -- matching --------------------------------------------------------------
+    def match(self, tokens, *, pin: bool = False):
+        """Longest-prefix match: returns ``(node, usable)`` where
+        ``node.block[:, :usable]`` holds valid KV for ``tokens[:usable]``,
+        or ``(None, 0)``. With ``pin=True`` the node is refcounted before
+        the lock drops — callers MUST ``release()`` it."""
+        with self._lock:
+            node, matched = self._walk(tuple(tokens))
+            if matched == 0:
+                return None, 0
+            # the stop node (or any descendant: their paths extend ours)
+            # covers the whole match; an ancestor covers a shorter prefix
+            holder = self._find_block_at_or_below(node)
+            usable = matched
+            if holder is None:
+                holder = node.parent if node is not self.root else None
+                while holder is not None and holder.block is None:
+                    holder = holder.parent
+                if holder is None:
+                    return None, 0
+                usable = min(matched, holder.length)
+            if usable <= 0:
+                return None, 0
+            holder.last_used = time.monotonic()
+            if pin:
+                holder.refs += 1
+            return holder, usable
+
+    def _walk(self, tokens: tuple):
+        """Descend as far as tokens agree; returns (stop_node, matched)."""
+        node, depth = self.root, 0
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                return node, depth
+            edge = child.edge
+            m = 0
+            limit = min(len(edge), len(tokens) - depth)
+            while m < limit and edge[m] == tokens[depth + m]:
+                m += 1
+            depth += m
+            if m < len(edge):           # diverged (or prompt ended) mid-edge
+                return child, depth
+            node = child
+        return node, depth
+
+    def _find_block_at_or_below(self, node: _Node):
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.block is not None:
+                return n
+            stack.extend(n.children.values())
+        return None
+
+    def release(self, node: _Node) -> None:
+        with self._lock:
+            node.refs = max(0, node.refs - 1)
+
+    # -- insertion / eviction --------------------------------------------------
+    def insert(self, tokens, block) -> bool:
+        """Attach ``block`` (snapped per-layer k/v arrays covering
+        ``tokens``) at the node for ``tokens``, splitting edges as needed;
+        evicts LRU unpinned blocks until the budget holds. Returns False
+        when the block alone exceeds the budget (not stored)."""
+        tokens = tuple(tokens)
+        if not tokens:
+            return False
+        nbytes = block_nbytes(block)
+        if nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            node, matched = self._walk(tokens)
+            if matched < node.length:       # diverged mid-edge: split it
+                node = self._split(node, matched)
+            if matched < len(tokens):       # new leaf for the remainder
+                leaf = _Node(tokens[matched:], node)
+                node.children[tokens[matched]] = leaf
+                node = leaf
+            if node.block is not None:      # already cached: refresh LRU
+                node.last_used = time.monotonic()
+                return True
+            node.block = block
+            node.block_len = max(x.shape[1] for x in
+                                 jax.tree_util.tree_leaves(block))
+            node.last_used = time.monotonic()
+            self._blocked.add(node)
+            self.bytes += nbytes
+            self._evict_to_budget(keep=node)
+            self._publish()
+            return True
+
+    def _split(self, node: _Node, at_length: int) -> _Node:
+        """Split ``node``'s edge so a node boundary lands at path length
+        ``at_length``; the new middle node holds no block."""
+        cut = at_length - node.parent.length
+        mid = _Node(node.edge[:cut], node.parent)
+        node.parent.children[node.edge[0]] = mid
+        node.edge = node.edge[cut:]
+        node.parent = mid
+        mid.children[node.edge[0]] = node
+        return mid
+
+    def _evict_to_budget(self, keep: _Node | None = None) -> None:
+        while self.bytes > self.max_bytes:
+            victims = [n for n in self._blocked
+                       if n.refs == 0 and n is not keep]
+            if not victims:
+                return  # everything live is pinned; budget temporarily over
+            victim = min(victims, key=lambda n: n.last_used)
+            self._drop(victim)
+            EVICTIONS_TOTAL.inc()
+
+    def _drop(self, node: _Node) -> None:
+        self.bytes -= block_nbytes(node.block)
+        node.block = None
+        node.block_len = 0
+        self._blocked.discard(node)
+        # prune blockless leaves so the tree doesn't accumulate dead paths
+        while (node is not self.root and node.block is None
+               and not node.children and node.refs == 0):
+            parent = node.parent
+            del parent.children[node.edge[0]]
+            node = parent
+
+    # -- introspection ---------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {"bytes": self.bytes, "max_bytes": self.max_bytes,
+                    "blocks": len(self._blocked)}
+
+    def _publish(self) -> None:
+        CACHED_BYTES.set(float(self.bytes))
+        CACHED_NODES.set(float(len(self._blocked)))

@@ -114,6 +114,27 @@ class Histogram(_Metric):
         row = self._data.get(tuple(str(v) for v in label_values))
         return row[-1] if row else 0.0
 
+    def percentile(self, q: float, *label_values: str) -> float:
+        """Prometheus ``histogram_quantile``-style estimate: linear
+        interpolation inside the bucket the q-th observation falls in
+        (the +Inf bucket clamps to the largest finite bound)."""
+        with self._lock:
+            row = self._data.get(tuple(str(v) for v in label_values))
+            if row is None:
+                return 0.0
+            row = list(row)
+        total = sum(row[:-1])
+        if total == 0:
+            return 0.0
+        rank = q / 100.0 * total
+        cum, lo = 0.0, 0.0
+        for bound, n in zip(self.buckets, row):
+            if cum + n >= rank and n > 0:
+                return lo + (bound - lo) * (rank - cum) / n
+            cum += n
+            lo = bound
+        return self.buckets[-1] if self.buckets else 0.0
+
     def expose(self, kind: str) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {kind}"]
@@ -185,6 +206,14 @@ class Registry:
                 return existing
             self._metrics[name] = (kind, metric)
             return metric
+
+    def get_metric(self, name: str) -> _Metric | None:
+        """Look up a registered metric by name (dashboards and loadtests
+        read series programmatically instead of parsing the exposition
+        text)."""
+        with self._lock:
+            entry = self._metrics.get(name)
+        return entry[1] if entry else None
 
     def expose(self) -> str:
         with self._lock:

@@ -1,0 +1,210 @@
+"""Shared-prefix serving loadtest (ISSUE 3 acceptance: prefix cache).
+
+Traffic model after production LLM serving: N concurrent requests drawn
+from K distinct prompts that share long system prefixes — the "millions of
+users, few system prompts" shape.  Runs the SAME traffic twice through the
+real continuous-batching engine:
+
+- COLD: prefix cache disabled — every admission prefills its whole prompt
+  (in chunks of ``--prefill-chunk``, the round-7 chunked-prefill path);
+- WARM: prefix cache enabled — the first occurrence of each prompt
+  prefills and populates the radix tree, every later occurrence is a
+  full-prefix hit whose admission is one seed copy + one sample dispatch.
+
+Reports TTFT p50/p99 (hit-eligible requests, i.e. index >= K, in both
+runs), prefill dispatch/token counts, and the cache hit rate; asserts the
+warm token streams are identical to cold.  ``--smoke`` is the CI gate
+(small N, hard asserts); the full run prints one JSON line for PERF.md.
+
+Usage: python loadtest/load_serving.py [N_REQUESTS] [K_PROMPTS] [--smoke]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# a CPU loadtest: never try to grab the (possibly absent) TPU tunnel
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as `python loadtest/load_serving.py` (the CI smoke step) without
+# needing PYTHONPATH to be set
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _prompts(k: int, sys_len: int, vocab: int) -> list[list[int]]:
+    """K deterministic prompts: distinct ``sys_len``-token system prefixes
+    + a short question suffix (LCG so runs are reproducible)."""
+    out = []
+    state = 0x2545F491
+    for i in range(k):
+        toks = []
+        for _ in range(sys_len + 4 + i % 3):
+            state = (state * 1103515245 + 12345) & 0x7FFFFFFF
+            toks.append(1 + state % (vocab - 1))
+        out.append(toks)
+    return out
+
+
+def _pct(vals: list[float], p: float) -> float:
+    vals = sorted(vals)
+    return vals[min(int(len(vals) * p / 100), len(vals) - 1)]
+
+
+def _counters() -> dict:
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    def val(name):
+        m = REGISTRY.get_metric(name)
+        return m.get() if m is not None else 0.0
+
+    return {
+        "dispatches": val("serving_prefill_dispatches_total"),
+        "tokens": val("serving_prefill_tokens_total"),
+        "hits": val("serving_prefix_cache_hits_total"),
+        "misses": val("serving_prefix_cache_misses_total"),
+        "evictions": val("serving_prefix_cache_evictions_total"),
+        "bytes": val("serving_prefix_cache_bytes"),
+    }
+
+
+def _run(engine, prompts: list[list[int]], n: int,
+         max_new: int) -> tuple[list, list[float], dict]:
+    """Submit N concurrent requests round-robin over the prompts; returns
+    (token streams, per-request TTFT seconds)."""
+    before = _counters()
+    reqs = [engine.submit(prompts[i % len(prompts)], max_new_tokens=max_new)
+            for i in range(n)]
+    outs = [r.result(timeout=600) for r in reqs]
+    ttfts = [r.first_token_at - r.submitted_at for r in reqs]
+    after = _counters()
+    delta = {k: after[k] - before[k] for k in after}
+    delta["bytes"] = after["bytes"]  # gauge, not a counter
+    return outs, ttfts, delta
+
+
+def _probe_ttft(engine, prompts: list[list[int]], repeats: int,
+                max_new: int) -> list[float]:
+    """Sequential one-at-a-time TTFT: admission latency on an unloaded
+    engine (the concurrent phase's TTFT is dominated by shared decode
+    waves, which the prefix cache deliberately does not change)."""
+    out = []
+    for _ in range(repeats):
+        for p in prompts:
+            r = engine.submit(p, max_new_tokens=max_new)
+            r.result(timeout=600)
+            out.append(r.first_token_at - r.submitted_at)
+    return out
+
+
+def main() -> int:
+    smoke = "--smoke" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    if smoke:
+        n, k, sys_len, max_seq, chunk, max_new = 8, 2, 40, 128, 32, 4
+        shape = dict(hidden_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, intermediate_size=128)
+    else:
+        n = int(args[0]) if args else 32
+        k = int(args[1]) if len(args) > 1 else 4
+        sys_len, max_seq, chunk, max_new = 384, 512, 128, 8
+        # big enough that prefill COMPUTE (not dispatch overhead) is what
+        # TTFT measures — the shape a real deployment lives in
+        shape = dict(hidden_size=128, num_layers=4, num_heads=4,
+                     num_kv_heads=2, intermediate_size=256)
+    cache_mb = 64
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import llama as lm
+    from kubeflow_tpu.parallel.sharding import unbox_params
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    cfg = lm.LlamaConfig(vocab_size=512, max_seq_len=1024,
+                         use_flash=False, **shape)
+    module = lm.LlamaModel(cfg)
+    params = unbox_params(module.init(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, 8), jnp.int32))["params"])
+    cold_eng = ContinuousBatcher(module, params, cfg, max_batch=4,
+                                 max_seq=max_seq, prefill_chunk=chunk)
+    warm_eng = ContinuousBatcher(module, params, cfg, max_batch=4,
+                                 max_seq=max_seq, prefill_chunk=chunk,
+                                 prefix_cache_bytes=cache_mb << 20)
+    prompts = _prompts(k, sys_len, cfg.vocab_size)
+
+    # compile warm-up on BOTH engines with throwaway same-shape traffic so
+    # measured TTFT is dispatch cost, not one-off XLA compiles
+    warmup = _prompts(2, sys_len, cfg.vocab_size)
+    warmup = [[(t + 7) % (cfg.vocab_size - 1) + 1 for t in p]
+              for p in warmup]
+    for eng in (cold_eng, warm_eng):
+        for p in warmup:
+            eng.generate_sync([p, p], max_new_tokens=max_new)
+
+    t0 = time.perf_counter()
+    cold_out, cold_ttft, cold_d = _run(cold_eng, prompts, n, max_new)
+    warm_out, warm_ttft, warm_d = _run(warm_eng, prompts, n, max_new)
+    # after the burst the warm tree holds every prompt: the probe measures
+    # full-prefix-hit admission latency vs cold full-prompt prefill
+    repeats = 2 if smoke else 3
+    probe_cold = _probe_ttft(cold_eng, prompts, repeats, max_new)
+    probe_warm = _probe_ttft(warm_eng, prompts, repeats, max_new)
+    wall = time.perf_counter() - t0
+
+    cold_eng.shutdown()
+    warm_eng.shutdown()
+
+    identical = warm_out == cold_out
+    result = {
+        "requests": n,
+        "shared_prompts": k,
+        "sys_prompt_len": sys_len,
+        "prefill_chunk": chunk,
+        "wall_s": round(wall, 2),
+        "warm_identical_to_cold": identical,
+        "cold": {
+            "ttft_p50_ms": round(_pct(probe_cold, 50) * 1e3, 2),
+            "ttft_p99_ms": round(_pct(probe_cold, 99) * 1e3, 2),
+            "concurrent_ttft_p50_ms": round(_pct(cold_ttft, 50) * 1e3, 2),
+            "prefill_dispatches": cold_d["dispatches"],
+            "prefill_tokens": cold_d["tokens"],
+        },
+        "warm": {
+            "ttft_p50_ms": round(_pct(probe_warm, 50) * 1e3, 2),
+            "ttft_p99_ms": round(_pct(probe_warm, 99) * 1e3, 2),
+            "concurrent_ttft_p50_ms": round(_pct(warm_ttft, 50) * 1e3, 2),
+            "prefill_dispatches": warm_d["dispatches"],
+            "prefill_tokens": warm_d["tokens"],
+            "hits": warm_d["hits"],
+            "misses": warm_d["misses"],
+            "hit_rate": round(
+                warm_d["hits"] / max(warm_d["hits"] + warm_d["misses"], 1),
+                3),
+            "evictions": warm_d["evictions"],
+            "cached_mb": round(warm_d["bytes"] / (1 << 20), 2),
+        },
+    }
+    result["dispatch_ratio"] = round(
+        cold_d["dispatches"] / max(warm_d["dispatches"], 1), 2)
+    result["ttft_p50_speedup"] = round(
+        _pct(probe_cold, 50) / max(_pct(probe_warm, 50), 1e-9), 2)
+    print(json.dumps(result))
+
+    if not identical:
+        print("FAIL: warm token streams diverged from cold", file=sys.stderr)
+        return 1
+    if smoke:
+        ok = (warm_d["hits"] >= n - k
+              and warm_d["dispatches"] < cold_d["dispatches"])
+        if not ok:
+            print(f"FAIL: hits={warm_d['hits']} (want >= {n - k}), "
+                  f"dispatches warm={warm_d['dispatches']} vs "
+                  f"cold={cold_d['dispatches']}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

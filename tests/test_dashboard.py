@@ -170,3 +170,16 @@ def test_quota_route_reports_hard_and_used(stack):
     code, out = req(base, "/dashboard/api/quota/team-b",
                     user="bob@corp.com")
     assert code == 200 and out["hard"] == {}
+
+
+def test_serving_cache_route(stack):
+    """Prefix-cache + TTFT standing for the serving engines sharing this
+    process's registry (PR 3): hit rate, cached bytes, TTFT percentiles."""
+    server, mgr, base = stack
+    code, state = req(base, "/dashboard/api/serving-cache",
+                      user="alice@corp.com")
+    assert code == 200
+    assert set(state["prefix_cache"]) >= {"hits", "misses", "hit_rate",
+                                          "bytes", "evictions"}
+    assert "ttft_p50_s" in state and "ttft_p99_s" in state
+    assert "prefill_dispatches" in state

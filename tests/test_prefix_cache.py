@@ -1,0 +1,274 @@
+"""Prefix-aware KV cache reuse (ISSUE 3): radix-tree longest-prefix match,
+LRU eviction under an HBM budget with refcounted in-flight holds, and the
+engine's warm admission path — whose outputs must be TOKEN-IDENTICAL to
+the cold path for the same (prompt, seed, sampling params)."""
+
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.serving.prefix_cache import PrefixCache, block_nbytes
+
+
+def blk(snap: int = 16):
+    """A stand-in KV block shaped like the engine's ([1, snap, H, D])."""
+    return {"layers": [{"k": jnp.zeros((1, snap, 1, 2), jnp.float32),
+                        "v": jnp.zeros((1, snap, 1, 2), jnp.float32)}]}
+
+
+BLK_BYTES = block_nbytes(blk())
+
+
+# -- radix tree unit tests -----------------------------------------------------
+def test_longest_prefix_match_with_edge_splits():
+    pc = PrefixCache(1 << 30)
+    assert pc.insert((1, 2, 3, 4), blk())
+    assert pc.insert((1, 2, 5, 6), blk())   # splits the (1,2,3,4) edge
+
+    node, usable = pc.match((1, 2, 3, 4))
+    assert usable == 4 and node.block is not None
+    _, usable = pc.match((1, 2, 3, 9, 9))   # diverges inside an edge
+    assert usable == 3
+    _, usable = pc.match((1, 2, 5, 6, 7, 8))
+    assert usable == 4
+    # the split point itself holds no block, but any descendant's
+    # full-prefix block covers the shorter match
+    node, usable = pc.match((1, 2))
+    assert usable == 2 and node.block is not None
+    assert node.length >= 2
+    node, usable = pc.match((9, 9))
+    assert node is None and usable == 0
+
+
+def test_match_prefers_covering_block_and_falls_back_to_ancestor():
+    pc = PrefixCache(1 << 30)
+    pc.insert((7, 8), blk())
+    pc.insert((7, 8, 9, 10), blk())
+    node, usable = pc.match((7, 8, 9, 10, 11))
+    assert usable == 4
+    # drop the deep block: the (7,8) ancestor still serves 2 positions
+    pc._drop(node)
+    node, usable = pc.match((7, 8, 9, 10, 11))
+    assert usable == 2 and node.length == 2
+
+
+def test_eviction_is_lru_under_byte_budget():
+    from kubeflow_tpu.serving.prefix_cache import EVICTIONS_TOTAL
+
+    pc = PrefixCache(2 * BLK_BYTES)
+    pc.insert((1, 1, 1), blk())
+    pc.insert((2, 2, 2), blk())
+    assert pc.bytes == 2 * BLK_BYTES
+    pc.match((1, 1, 1))                      # (1,1,1) is now most recent
+    ev0 = EVICTIONS_TOTAL.get()
+    pc.insert((3, 3, 3), blk())              # evicts LRU (2,2,2)
+    assert pc.bytes == 2 * BLK_BYTES
+    assert EVICTIONS_TOTAL.get() == ev0 + 1
+    assert pc.match((2, 2, 2)) == (None, 0)
+    _, usable = pc.match((1, 1, 1))
+    assert usable == 3
+    _, usable = pc.match((3, 3, 3))
+    assert usable == 3
+
+
+def test_pinned_block_survives_eviction_until_released():
+    """The ISSUE invariant: eviction must never free a block an in-flight
+    admission holds."""
+    pc = PrefixCache(BLK_BYTES)              # budget: exactly one block
+    pc.insert((1, 1, 1), blk())
+    node, usable = pc.match((1, 1, 1), pin=True)
+    assert usable == 3 and node.refs == 1
+    # over-budget insert cannot evict the pinned node (nor itself)
+    pc.insert((2, 2, 2), blk())
+    assert node.block is not None
+    assert pc.bytes == 2 * BLK_BYTES         # temporarily over budget
+    pc.release(node)
+    assert node.refs == 0
+    pc.insert((3, 3, 3), blk())              # now LRU sweeps back to budget
+    assert pc.bytes <= BLK_BYTES
+    assert pc.match((1, 1, 1)) == (None, 0)
+
+
+def test_block_larger_than_budget_not_stored():
+    pc = PrefixCache(BLK_BYTES)
+    assert not pc.insert((1, 2, 3), blk(snap=64))
+    assert pc.bytes == 0
+
+
+def test_duplicate_insert_keeps_one_block():
+    pc = PrefixCache(1 << 30)
+    pc.insert((4, 5, 6), blk())
+    pc.insert((4, 5, 6), blk())
+    assert pc.bytes == BLK_BYTES
+    assert pc.stats()["blocks"] == 1
+
+
+# -- engine warm path: token identity ------------------------------------------
+SYS = [5, 8, 13, 21, 3, 9, 2, 17, 11, 4, 6, 12]
+
+
+@pytest.fixture(scope="module")
+def cold():
+    from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+    p = GenerativePredictor("llama", size="tiny", max_batch=2, max_seq=64)
+    yield p
+    p.engine.shutdown()
+
+
+@pytest.fixture(scope="module")
+def warm():
+    from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+    p = GenerativePredictor("llama", size="tiny", max_batch=2, max_seq=64,
+                            prefix_cache_mb=8)
+    assert p.engine.prefix_cache is not None
+    yield p
+    p.engine.shutdown()
+
+
+def test_warm_greedy_identical_to_cold(cold, warm):
+    a, b = SYS + [7, 1], SYS + [19, 6, 2]
+    ca = cold.generate([a], max_new_tokens=10)["ids"][0]
+    cb = cold.generate([b], max_new_tokens=10)["ids"][0]
+    wa = warm.generate([a], max_new_tokens=10)["ids"][0]   # miss, populates
+    wb = warm.generate([b], max_new_tokens=10)["ids"][0]   # partial hit
+    wa2 = warm.generate([a], max_new_tokens=10)["ids"][0]  # full-prefix hit
+    assert wa == ca
+    assert wb == cb
+    assert wa2 == ca
+
+
+def test_warm_sampled_identical_to_cold(cold, warm):
+    prompt = SYS + [30, 31]
+    kw = dict(max_new_tokens=12, temperature=1.3, seed=5, top_k=4,
+              top_p=0.9)
+    want = cold.engine.submit(prompt, **kw).result(60)
+    warm.engine.submit(prompt, max_new_tokens=4).result(60)  # prime cache
+    got = warm.engine.submit(prompt, **kw).result(60)        # full hit
+    assert got == want
+
+
+def test_ragged_cobatched_hits_identical_to_solo(cold, warm):
+    """Two prefix-sharing requests decoding TOGETHER on the warm engine
+    must still emit exactly their solo cold-path streams."""
+    import time
+
+    a, b = SYS + [40, 41, 42], SYS + [50]
+    solo = [cold.generate([p], max_new_tokens=8)["ids"][0] for p in (a, b)]
+    warm.generate([SYS + [60]], max_new_tokens=2)            # prime prefix
+    ra = warm.engine.submit(a, max_new_tokens=8)
+    time.sleep(0.02)
+    rb = warm.engine.submit(b, max_new_tokens=8)
+    assert [ra.result(60), rb.result(60)] == solo
+
+
+def test_full_prefix_hit_is_one_prefill_dispatch(warm):
+    from kubeflow_tpu.serving.engine import (
+        PREFILL_DISPATCHES,
+        PREFILL_TOKENS,
+        PREFIX_HITS,
+    )
+
+    prompt = SYS + [33, 34, 35]
+    warm.generate([prompt], max_new_tokens=2)                # populate
+    d0, t0, h0 = (PREFILL_DISPATCHES.get(), PREFILL_TOKENS.get(),
+                  PREFIX_HITS.get())
+    warm.generate([prompt], max_new_tokens=2)                # full hit
+    assert PREFILL_DISPATCHES.get() - d0 == 1
+    assert PREFIX_HITS.get() - h0 == 1
+    # only the 1-token suffix ran through prefill compute
+    assert PREFILL_TOKENS.get() - t0 == 1
+
+
+def test_chunked_prefill_identical_to_single_dispatch(cold):
+    """Long cold prompts prefill in chunks (admission no longer blocks
+    decode for the whole prompt) — and chunking must not change a single
+    token."""
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    eng = ContinuousBatcher(cold.module, cold.params, cold.cfg,
+                            max_batch=2, max_seq=64, prefill_chunk=16)
+    try:
+        long_prompt = list(range(1, 41))
+        want = cold.generate([long_prompt], max_new_tokens=8)["ids"][0]
+        assert eng.generate_sync([long_prompt], max_new_tokens=8)[0] == want
+        # seeded sampling too
+        kw = dict(max_new_tokens=6, temperature=0.9, seed=3)
+        assert (eng.submit(long_prompt, **kw).result(60)
+                == cold.engine.submit(long_prompt, **kw).result(60))
+    finally:
+        eng.shutdown()
+
+
+def test_warm_chunked_suffix_identical(cold):
+    """Prefix hit + a long suffix that itself prefills in chunks."""
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    eng = ContinuousBatcher(cold.module, cold.params, cold.cfg,
+                            max_batch=2, max_seq=64, prefill_chunk=16,
+                            prefix_cache_bytes=8 << 20)
+    try:
+        shared = list(range(3, 15))                       # 12 tokens
+        long_a = shared + list(range(20, 45))             # 37 tokens
+        want = cold.generate([long_a], max_new_tokens=6)["ids"][0]
+        eng.generate_sync([shared + [99]], max_new_tokens=2)  # cache prefix
+        assert eng.generate_sync([long_a], max_new_tokens=6)[0] == want
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_metrics_exported(warm):
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    warm.generate([SYS + [70]], max_new_tokens=2)
+    text = REGISTRY.expose()
+    for series in ("serving_prefix_cache_hits_total",
+                   "serving_prefix_cache_misses_total",
+                   "serving_prefix_cache_evictions_total",
+                   "serving_prefix_cache_bytes",
+                   "serving_prefill_dispatches_total"):
+        assert series in text, series
+    stats = warm.engine.stats()
+    assert stats["prefix_cache"]["bytes"] > 0
+
+
+# -- InferenceService plumb-through --------------------------------------------
+def test_annotation_flows_to_predictor_args():
+    from kubeflow_tpu.api import inferenceservice as api
+
+    isvc = api.new("chat", "serving", prefix_cache_mb=64)
+    assert api.prefix_cache_mb(isvc) == 64.0
+    api.validate(isvc)
+
+    from kubeflow_tpu.controllers.inferenceservice import (
+        InferenceServiceController,
+    )
+    from kubeflow_tpu.core import APIServer
+
+    server = APIServer()
+    server.create(isvc)
+    isvc = server.get(api.KIND, "chat", "serving")   # stored copy (uid)
+    InferenceServiceController(server)._ensure_deployment(isvc)
+    cmd = server.get("Deployment", "chat", "serving")[
+        "spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--prefix-cache-mb" in cmd
+    assert cmd[cmd.index("--prefix-cache-mb") + 1] == "64.0"
+
+
+def test_annotation_validation_rejects_garbage():
+    from kubeflow_tpu.api import inferenceservice as api
+
+    isvc = api.new("chat", "serving")
+    isvc["metadata"]["annotations"] = {
+        api.PREFIX_CACHE_ANNOTATION: "lots"}
+    with pytest.raises(ValueError, match="number"):
+        api.validate(isvc)
+    isvc["metadata"]["annotations"] = {
+        api.PREFIX_CACHE_ANNOTATION: "-4"}
+    with pytest.raises(ValueError, match=">= 0"):
+        api.validate(isvc)
+    for bad in ("inf", "nan"):   # inf CrashLoops the predictor at start,
+        isvc["metadata"]["annotations"] = {  # nan silently disables
+            api.PREFIX_CACHE_ANNOTATION: bad}
+        with pytest.raises(ValueError, match="finite"):
+            api.validate(isvc)

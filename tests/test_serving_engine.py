@@ -90,6 +90,55 @@ def test_serving_metrics_present(predictor):
     assert float(line.split()[-1]) > 0
 
 
+def test_ttft_histogram_promoted(predictor):
+    """TTFT is a histogram now (p50/p99 aggregable); the last-value gauge
+    stays for dashboard compatibility."""
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    predictor.generate([[2, 4, 6]], max_new_tokens=2)
+    hist = REGISTRY.get_metric("serving_time_to_first_token_seconds")
+    assert hist is not None and hist.count() > 0
+    assert hist.percentile(50) > 0
+    text = REGISTRY.expose()
+    assert "serving_time_to_first_token_seconds_bucket" in text
+    assert "serving_ttft_seconds" in text
+
+
+def test_shutdown_is_terminal_until_restart():
+    """A concurrent submit() must not resurrect the batcher mid-shutdown;
+    pending requests are failed AND counted by outcome."""
+    from kubeflow_tpu.serving.engine import REQS_TOTAL
+    from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+    p = GenerativePredictor("llama", size="tiny", max_batch=1, max_seq=64)
+    eng = p.engine
+    ok0 = REQS_TOTAL.get("ok")
+    down0 = REQS_TOTAL.get("shutdown")
+    reqs = [eng.submit([3, 5, 7], max_new_tokens=40) for _ in range(3)]
+    eng.shutdown()
+    outcomes = []
+    for r in reqs:
+        try:
+            r.result(timeout=30)
+            outcomes.append("ok")
+        except ValueError as e:
+            assert "shut down" in str(e)
+            outcomes.append("shutdown")
+    # whatever finished before the shutdown flag landed is 'ok'; all the
+    # rest must be failed AND accounted — nothing hangs or goes missing
+    assert outcomes.count("shutdown") >= 1
+    assert REQS_TOTAL.get("shutdown") - down0 == outcomes.count("shutdown")
+    assert REQS_TOTAL.get("ok") - ok0 == outcomes.count("ok")
+
+    with pytest.raises(RuntimeError, match="shut down"):
+        eng.submit([1, 2], max_new_tokens=2)
+
+    eng.restart()
+    out = eng.submit([3, 5, 7], max_new_tokens=4).result(timeout=60)
+    assert out[:3] == [3, 5, 7] and len(out) == 7
+    eng.shutdown()
+
+
 def test_temperature_sampling_varies(predictor):
     """temperature > 0 actually samples (not a frozen argmax path)."""
     outs = {tuple(predictor.engine.submit(
