@@ -1,0 +1,29 @@
+"""Seeded, deterministic fault injection for the control plane.
+
+Chaos engineering's core claim (Basiri et al., IEEE Software 2016) is that
+resilience properties only stay true if faults are injected continuously —
+an invariant nobody exercises is an invariant that has silently rotted.
+This package is the platform's fault-injection layer:
+
+- :class:`ChaoticAPIServer` — the store with seeded transient write faults
+  (optimistic-concurrency ``Conflict``\\ s and write latency), proving every
+  controller converges through the retry/backoff path instead of relying
+  on writes never failing;
+- :class:`ChaosInjector` — host/slice faults against a running platform:
+  silent pod kills (no status transition — the host died, nobody reports),
+  node heartbeat stops, and slice preemptions injected into the
+  ``TpuSlicePool``.
+
+Everything is driven by one ``random.Random(seed)``: the same seed
+produces the same fault schedule, so ``loadtest/load_chaos.py`` can assert
+that two runs under identical faults converge to the same
+``state_digest``.
+"""
+
+from kubeflow_tpu.chaos.injector import (
+    CHAOS_FAULTS,
+    ChaosInjector,
+    ChaoticAPIServer,
+)
+
+__all__ = ["CHAOS_FAULTS", "ChaosInjector", "ChaoticAPIServer"]

@@ -1,0 +1,208 @@
+"""Fault injectors: a chaotic store and a host/slice chaos driver.
+
+Two layers, matching where real faults live:
+
+- **Write-path faults** (:class:`ChaoticAPIServer`): optimistic-
+  concurrency ``Conflict`` s and write latency, injected at the store
+  boundary before any mutation happens.  Every controller is built on
+  level-triggered reconcile + retry-with-backoff; these faults prove it.
+- **Host/slice faults** (:class:`ChaosInjector`): silent pod death, node
+  heartbeat stops, and slice preemptions — the failures only the node
+  lifecycle layer (controllers.nodelifecycle) and the slice preemption
+  path (controllers.scheduler) can see and recover from.
+
+Both draw from one ``random.Random(seed)`` so a fault schedule is
+reproducible: the chaos loadtest's determinism invariant (same seed ⇒
+same final ``state_digest``) depends on it.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from kubeflow_tpu.core.store import APIServer, Conflict, NotFound
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+CHAOS_FAULTS = REGISTRY.counter(
+    "chaos_faults_injected_total", "faults injected by the chaos layer",
+    labels=("fault",))
+
+log = get_logger("chaos")
+
+
+class ChaoticAPIServer(APIServer):
+    """The in-memory API server with seeded transient write faults.
+
+    ``conflict_rate`` of write operations (create/update/patch_status/
+    delete) raise :class:`Conflict` BEFORE mutating anything — exactly the
+    shape a lost resourceVersion race or a flaky etcd leader produces, and
+    exactly what controllers must absorb via error backoff + level-
+    triggered re-reconcile.  ``latency_rate`` of writes additionally sleep
+    ``latency_s`` first, shaking out ordering assumptions that only held
+    because writes were instant.
+
+    Faults are injected on the WRITE path only: reads are lock-free
+    snapshot resolutions with no real-world transient failure mode worth
+    modelling here.
+    """
+
+    def __init__(self, *, seed: int = 0, conflict_rate: float = 0.0,
+                 latency_rate: float = 0.0, latency_s: float = 0.002):
+        super().__init__()
+        self.conflict_rate = conflict_rate
+        self.latency_rate = latency_rate
+        self.latency_s = latency_s
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        # chaos off-switch: the harness disarms injection while it seeds
+        # initial objects (a Conflict on the pool create would fail setup,
+        # not exercise recovery)
+        self._armed = False
+
+    def arm(self, on: bool = True) -> None:
+        self._armed = on
+
+    def _maybe_fault(self, op: str, kind: str) -> None:
+        if not self._armed:
+            return
+        with self._rng_lock:
+            conflict = self._rng.random() < self.conflict_rate
+            slow = self._rng.random() < self.latency_rate
+        if slow:
+            CHAOS_FAULTS.labels("latency").inc()
+            time.sleep(self.latency_s)
+        if conflict:
+            CHAOS_FAULTS.labels("conflict").inc()
+            raise Conflict(
+                f"chaos: injected transient conflict on {op} {kind}")
+
+    def create(self, obj: dict) -> dict:
+        self._maybe_fault("create", obj.get("kind", "?"))
+        return super().create(obj)
+
+    def update(self, obj: dict) -> dict:
+        self._maybe_fault("update", obj.get("kind", "?"))
+        return super().update(obj)
+
+    def patch_status(self, kind, name, namespace, status) -> dict:
+        self._maybe_fault("patch_status", kind)
+        return super().patch_status(kind, name, namespace, status)
+
+    def delete(self, kind, name, namespace=None) -> None:
+        self._maybe_fault("delete", kind)
+        return super().delete(kind, name, namespace)
+
+
+class ChaosInjector:
+    """Host/slice fault driver against a running fake-executor platform.
+
+    Primitives (each counted in ``chaos_faults_injected_total``):
+
+    - :meth:`kill_pod_silently` — the pod's process vanishes with NO
+      status transition (simulated host loss for one pod);
+    - :meth:`node_outage` / :meth:`node_recovery` — the whole host dies:
+      every Running pod on the executor's node is silenced AND the node's
+      heartbeat stops, so ONLY heartbeat staleness can reveal the loss;
+    - :meth:`preempt_slices` / :meth:`restore_slices` — the cloud takes
+      slices away: bumps ``TpuSlicePool.spec.unavailable`` so the
+      SlicePreemptionController evicts the youngest released gang(s).
+
+    Targets the :class:`~kubeflow_tpu.controllers.executor.FakeExecutor`
+    surface (``silence(name, uid)`` + ``heartbeat``); schedules live in
+    the harness (loadtest/load_chaos.py) where they can be state-triggered
+    for determinism.
+    """
+
+    def __init__(self, server: APIServer, executor, *, seed: int = 0):
+        self.server = server
+        self.executor = executor
+        self.rng = random.Random(seed)
+
+    # -- host faults -----------------------------------------------------------
+    def kill_pod_silently(self, name: str,
+                          namespace: str | None = None) -> str | None:
+        """Silence one pod's current incarnation; returns its uid (or None
+        when the pod does not exist)."""
+        try:
+            pod = self.server.get("Pod", name, namespace)
+        except NotFound:
+            return None
+        md = pod["metadata"]
+        uid = md["uid"]
+        self.executor.silence(name, uid, md.get("namespace"))
+        CHAOS_FAULTS.labels("pod_kill").inc()
+        log.info("chaos: silently killed pod", pod=f"{namespace}/{name}")
+        return uid
+
+    def stop_heartbeat(self) -> None:
+        self.executor.heartbeat.pause()
+        CHAOS_FAULTS.labels("heartbeat_stop").inc()
+        log.info("chaos: stopped node heartbeat",
+                 node=self.executor.node_name)
+
+    def resume_heartbeat(self) -> None:
+        self.executor.heartbeat.resume()
+
+    def node_outage(self) -> list[tuple]:
+        """The host dies whole: silence every Running pod bound to the
+        executor's node, then stop its heartbeat.  Returns the
+        ``(namespace, name, uid)`` of every pod taken down, so a harness
+        can wait for each to be detected (Failed/NodeLost or deleted)
+        before declaring the node recovered."""
+        killed: list[tuple] = []
+        for pod in self.server.project(
+                "Pod", ("metadata.name", "metadata.namespace",
+                        "metadata.uid", "status.phase", "status.nodeName")):
+            status = pod.get("status", {})
+            if status.get("phase") != "Running":
+                continue
+            if status.get("nodeName") != self.executor.node_name:
+                continue
+            md = pod["metadata"]
+            self.executor.silence(md["name"], md["uid"],
+                                  md.get("namespace"))
+            killed.append((md.get("namespace"), md["name"], md["uid"]))
+        self.stop_heartbeat()
+        CHAOS_FAULTS.labels("pod_kill").inc(len(killed))
+        log.info("chaos: node outage", node=self.executor.node_name,
+                 pods_killed=len(killed))
+        return killed
+
+    def node_recovery(self) -> None:
+        """The host comes back (fresh boot): heartbeats resume; the old
+        incarnations stay dead — their processes died with the machine."""
+        self.resume_heartbeat()
+        log.info("chaos: node recovered", node=self.executor.node_name)
+
+    # -- slice faults ----------------------------------------------------------
+    def preempt_slices(self, topology: str, count: int = 1) -> None:
+        """The cloud preempts ``count`` slices of ``topology``: marks them
+        unavailable in the pool, which triggers youngest-gang eviction."""
+        self._bump_unavailable(topology, count)
+        CHAOS_FAULTS.labels("preemption").inc(count)
+        log.info("chaos: preempted slices", topology=topology, count=count)
+
+    def restore_slices(self, topology: str, count: int = 1) -> None:
+        self._bump_unavailable(topology, -count)
+        log.info("chaos: restored slices", topology=topology, count=count)
+
+    def _bump_unavailable(self, topology: str, delta: int) -> None:
+        from kubeflow_tpu.controllers.scheduler import POOL_KIND, POOL_NAME
+
+        # the injector's own writes go through the (possibly chaotic)
+        # store: retry the read-modify-write like any well-behaved client
+        for _ in range(50):
+            try:
+                pool = self.server.get(POOL_KIND, POOL_NAME)
+                unavailable = pool["spec"].setdefault("unavailable", {})
+                now = int(unavailable.get(topology, 0)) + delta
+                unavailable[topology] = max(0, now)
+                self.server.update(pool)
+                return
+            except Conflict:
+                time.sleep(0.002)
+        raise RuntimeError(
+            f"chaos: could not adjust pool unavailability for {topology}")

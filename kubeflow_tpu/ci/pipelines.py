@@ -58,6 +58,21 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                      "tests/test_distributed_rendezvous.py"],
         "image": "images/worker",
     },
+    "chaos": {
+        "include_dirs": ["kubeflow_tpu/chaos/*",
+                         "kubeflow_tpu/controllers/nodelifecycle.py",
+                         "kubeflow_tpu/controllers/executor.py",
+                         "kubeflow_tpu/controllers/scheduler.py",
+                         "loadtest/load_chaos.py"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+                     "tests/test_node_lifecycle.py", "tests/test_chaos.py"],
+        # seeded convergence smoke: gangs + notebooks + an InferenceService
+        # under silent node outages, slice preemptions, and injected write
+        # conflicts; asserts terminal convergence, zero overcommit, quota
+        # drain, and same-seed state-digest determinism.  KF_SKIP_CHAOS=1
+        # opts out on constrained hosts.
+        "chaos_cmd": [sys.executable, "loadtest/load_chaos.py", "--smoke"],
+    },
     "notebooks": {
         "include_dirs": ["kubeflow_tpu/controllers/notebook.py",
                          "kubeflow_tpu/controllers/culler.py",
@@ -163,6 +178,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "smoke_cmd" in spec:
         steps.append({"name": "smoke", "run": spec["smoke_cmd"],
                       "depends": ["test"]})
+    if "chaos_cmd" in spec:
+        steps.append({"name": "chaos", "run": spec["chaos_cmd"],
+                      "depends": ["test"]})
     if spec.get("image"):
         # kaniko executor (the reference's builder): --no-push is the
         # presubmit mode (ci/notebook_servers pattern)
@@ -195,6 +213,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "smoke_cmd" in spec
                 and os.environ.get("KF_SKIP_SMOKE") != "1"):
             ok = subprocess.run(spec["smoke_cmd"]).returncode == 0
+        if (ok and "chaos_cmd" in spec
+                and os.environ.get("KF_SKIP_CHAOS") != "1"):
+            ok = subprocess.run(spec["chaos_cmd"]).returncode == 0
         results[name] = ok
     return results
 

@@ -21,9 +21,73 @@ import json
 import os
 import subprocess
 import threading
+import time
 
-from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core import Controller, Request, Result, api_object
 from kubeflow_tpu.core.store import Conflict, NotFound
+
+
+class NodeHeartbeat:
+    """Kubelet node-lease semantics for an in-tree executor.
+
+    Registers a cluster-scoped ``Node`` object and renews
+    ``status.heartbeatTime`` every ``interval`` seconds from a background
+    thread.  The NodeLifecycleController treats a heartbeat older than its
+    TTL as host loss — the ONLY signal the control plane gets when a node
+    vanishes (preemption, crash, executor death), since a dead kubelet
+    posts no pod status.  ``pause()``/``resume()`` exist for the chaos
+    layer: a paused heartbeat IS a silent node death."""
+
+    def __init__(self, server, node_name: str, *, interval: float = 0.5,
+                 executor: str = "fake"):
+        self.server = server
+        self.node_name = node_name
+        self.interval = interval
+        self.executor = executor
+        self._stopped = threading.Event()
+        self._paused = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        try:
+            self.server.create(api_object(
+                "Node", self.node_name, spec={"executor": self.executor}))
+        except Conflict:
+            pass  # re-registration after a restart adopts the object
+        self.beat()  # fresh before the first pod binds
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"heartbeat-{self.node_name}")
+        self._thread.start()
+
+    def beat(self) -> None:
+        try:
+            node = self.server.get("Node", self.node_name)
+            self.server.patch_status("Node", self.node_name, None, {
+                **node.get("status", {}),
+                "heartbeatTime": time.time(), "ready": True,
+                "message": ""})
+        except Exception:
+            # transient write faults (injected Conflict, store teardown)
+            # must not kill the renewal loop — staleness, not an exception,
+            # is how node death is signalled
+            pass
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self.interval):
+            if not self._paused.is_set():
+                self.beat()
+
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+        self.beat()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
 
 
 class FakeExecutor(Controller):
@@ -42,7 +106,9 @@ class FakeExecutor(Controller):
                  spawn_cost: float = 0.0,
                  metrics_script: dict[str, list[dict]] | None = None,
                  metrics_all: list[dict] | None = None,
-                 portmap: dict[str, int] | None = None):
+                 portmap: dict[str, int] | None = None,
+                 server_pods=None, node_name: str = "fake-node",
+                 heartbeat_interval: float = 0.5):
         super().__init__(server)
         self.fail_once = set(fail_once or ())
         self.always_fail = set(always_fail or ())
@@ -60,8 +126,13 @@ class FakeExecutor(Controller):
                                for k, v in (metrics_script or {}).items()}
         self.metrics_all = list(metrics_all or [])
         # complete=False models long-running servers (notebooks,
-        # tensorboards): pods stay Running instead of finishing
+        # tensorboards): pods stay Running instead of finishing.
+        # server_pods (a pod -> bool predicate) refines this PER POD for
+        # mixed workloads: predicate-true pods are servers (stay Running),
+        # the rest complete — the chaos loadtest runs gangs and notebooks
+        # against one executor
         self.complete = complete
+        self.server_pods = server_pods
         # run_for>0 holds each pod Running for that long before finishing
         # (loadtests need gangs to actually occupy their slice for a while)
         self.run_for = run_for
@@ -71,14 +142,59 @@ class FakeExecutor(Controller):
         # sync loop the same way).  This is the regime worker pools exist
         # for: with one worker, N pending pods start serially
         self.spawn_cost = spawn_cost
-        self._started: dict[str, float] = {}
+        # (namespace, name) -> (uid, started_at): keyed so the NotFound
+        # path can clear it (a uid key survived pod deletion mid-run_for
+        # and grew without bound over long chaos runs) and so same-name
+        # pods in different namespaces never share state
+        self._started: dict[tuple, tuple[str, float]] = {}
         self._failed_already: set[str] = set()
+        # chaos hooks: (namespace, name) -> silenced incarnation uid (the
+        # executor never touches that incarnation again — the host died
+        # under it, so no status transition is ever posted), plus the node
+        # identity whose heartbeat the chaos layer can pause
+        self._silenced: dict[tuple, str] = {}
+        self._auto_scripts: set[str] = set()
+        self.heartbeat = NodeHeartbeat(server, node_name,
+                                       interval=heartbeat_interval)
+        self.node_name = node_name
+
+    def start(self) -> None:
+        self.heartbeat.start()
+
+    def stop(self) -> None:
+        self.heartbeat.stop()
+
+    def silence(self, name: str, uid: str,
+                namespace: str | None = "default") -> None:
+        """Chaos: pod ``name``'s incarnation ``uid`` dies WITHOUT any
+        status transition (node loss) — only heartbeat staleness can
+        reveal it."""
+        self._silenced[(namespace, name)] = uid
+
+    def _is_server(self, pod: dict) -> bool:
+        if self.server_pods is not None:
+            return bool(self.server_pods(pod))
+        return not self.complete
+
+    def _forget(self, key: tuple) -> None:
+        """Drop per-pod state for a deleted pod (long chaos runs recycle
+        thousands of incarnations; leaked entries grew without bound)."""
+        self._started.pop(key, None)
+        self._silenced.pop(key, None)
+        name = key[1]
+        if name in self._auto_scripts:
+            self._auto_scripts.discard(name)
+            self.metrics_script.pop(name, None)
 
     def reconcile(self, req: Request) -> Result | None:
+        key = (req.namespace or "default", req.name)
         try:
             pod = self.server.get("Pod", req.name, req.namespace)
         except NotFound:
+            self._forget(key)
             return None
+        if self._silenced.get(key) == pod["metadata"]["uid"]:
+            return None  # this incarnation's host is dead (chaos)
         if pod["spec"].get("schedulingGates"):
             return None  # not released yet
         phase = pod.get("status", {}).get("phase", "Pending")
@@ -92,7 +208,7 @@ class FakeExecutor(Controller):
             # Logs pane, the contract test) see the same shape either way
             status = {**pod.get("status", {}),
                       "phase": "Running",
-                      "nodeName": "fake-node",
+                      "nodeName": self.node_name,
                       "logTail": [f"{req.name}: started (fake executor)"]}
             if self.portmap:
                 status["podIP"] = "127.0.0.1"
@@ -104,24 +220,27 @@ class FakeExecutor(Controller):
             script = self.metrics_script.get(name)
             if script is None and self.metrics_all:
                 script = self.metrics_script[name] = list(self.metrics_all)
+                self._auto_scripts.add(name)
             if script:
                 self.server.patch_status(
                     "Pod", req.name, req.namespace,
                     {**pod.get("status", {}), "phase": "Running",
                      "metrics": script.pop(0)})
                 return Result(requeue_after=0.01)
-            if not self.complete and name not in self.always_fail and (
+            if self._is_server(pod) and name not in self.always_fail and (
                     name not in self.fail_once):
                 return None
             if self.run_for > 0:
                 import time as _time
 
                 uid = pod["metadata"]["uid"]
-                started = self._started.setdefault(uid, _time.monotonic())
-                remaining = started + self.run_for - _time.monotonic()
+                entry = self._started.get(key)
+                if entry is None or entry[0] != uid:
+                    entry = self._started[key] = (uid, _time.monotonic())
+                remaining = entry[1] + self.run_for - _time.monotonic()
                 if remaining > 0:
                     return Result(requeue_after=remaining)
-                self._started.pop(uid, None)
+                self._started.pop(key, None)
             if name in self.always_fail or (
                     name in self.fail_once
                     and name not in self._failed_already):
@@ -150,7 +269,8 @@ class LocalExecutor(Controller):
 
     def __init__(self, server, *, extra_env: dict[str, str] | None = None,
                  timeout: float = 600.0, volumes_root: str | None = None,
-                 node_name: str | None = None):
+                 node_name: str | None = None,
+                 heartbeat_interval: float = 0.5):
         super().__init__(server)
         self.extra_env = extra_env or {}
         self.timeout = timeout
@@ -180,7 +300,36 @@ class LocalExecutor(Controller):
         # pod uid -> {containerPort: allocated host port}: the gateway
         # routes Service targetPorts to these via status.portMap
         self._portmaps: dict[str, dict[str, int]] = {}
+        # (ns, name) -> uid silenced by chaos: the incarnation's process is
+        # killed and NO terminal status is ever posted (the kubelet died
+        # with the node) — and the orphan-relaunch path must not resurrect
+        # it either
+        self._silenced: dict[tuple, str] = {}
         self._lock = threading.Lock()
+        self.heartbeat = NodeHeartbeat(server, self.node_name,
+                                       interval=heartbeat_interval,
+                                       executor="local")
+
+    def start(self) -> None:
+        self.heartbeat.start()
+
+    def stop(self) -> None:
+        self.heartbeat.stop()
+
+    def silence(self, name: str, namespace: str | None = None) -> str | None:
+        """Chaos: hard-kill the pod's process WITHOUT posting any status —
+        the host (executor + workload together) dying.  Returns the
+        silenced uid, or None when nothing was running."""
+        key = (namespace, name)
+        with self._lock:
+            entry = self._procs.get(key)
+            if entry is None:
+                return None
+            uid, proc = entry
+            self._silenced[key] = uid
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        return uid
 
     def reconcile(self, req: Request) -> Result | None:
         key = (req.namespace, req.name)
@@ -188,8 +337,13 @@ class LocalExecutor(Controller):
             pod = self.server.get("Pod", req.name, req.namespace)
         except NotFound:
             self._kill(key, None)
+            with self._lock:
+                self._silenced.pop(key, None)
             return None
         uid = pod["metadata"]["uid"]
+        with self._lock:
+            if self._silenced.get(key) == uid:
+                return None  # incarnation died with its node (chaos)
         self._kill(key, keep_uid=uid)  # reap a stale incarnation
         if pod["spec"].get("schedulingGates"):
             return None
@@ -458,6 +612,9 @@ class LocalExecutor(Controller):
             phase, message = "Failed", "timeout"
         except Exception as e:  # command not found etc.
             phase, message = "Failed", str(e)
+        with self._lock:
+            if self._silenced.get(key) == uid:
+                return  # host died silently (chaos): nobody reports status
         status = {"phase": phase, "result": result}
         if log_tail:
             status["logTail"] = list(log_tail)

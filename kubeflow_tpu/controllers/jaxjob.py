@@ -167,8 +167,19 @@ class JAXJobController(Controller):
                 status["metrics"] = scraped
 
         if any(ph == "Failed" for ph in phases):
+            # infrastructure loss (the host died under the pod, or the
+            # scheduler preempted the slice) is the NORMAL case on
+            # preemptible capacity — Borg semantics: it restarts the gang
+            # but never burns the user's maxRestarts failure budget, which
+            # exists for workload bugs
+            failed = [p for p in pods
+                      if p.get("status", {}).get("phase") == "Failed"]
+            infra = bool(failed) and all(
+                p.get("status", {}).get("reason") == "NodeLost"
+                for p in failed)
             restarts = int(status.get("restarts", 0))
-            terminal = restarts >= int(spec.get("maxRestarts", 3))
+            terminal = (not infra
+                        and restarts >= int(spec.get("maxRestarts", 3)))
             # tear down every worker either way: surviving workers of a
             # failed gang only hold the slice hostage (rendezvous is dead)
             for p in pods:
@@ -177,6 +188,13 @@ class JAXJobController(Controller):
                                        req.namespace)
                 except NotFound:
                     pass
+            if infra:
+                record_event(self.server, job, "Warning", "GangNodeLost",
+                             "worker lost with its host; restarting gang")
+                status["phase"] = "Restarting"
+                self.server.patch_status(api.KIND, req.name, req.namespace,
+                                         status)
+                return Result(requeue_after=0.05)
             if terminal:
                 status["phase"] = "Failed"
                 set_condition(job, "Complete", "False", reason="MaxRestarts",
@@ -238,15 +256,20 @@ class JAXJobController(Controller):
             if not ok:
                 return self._park(job, status, req, "WaitingForSlices",
                                   "NoCapacity", why)
-            self._unpark(job, status, "WaitingForSlices", "Scheduled")
-            import time as _time
-
-            # release timestamp: the backfill ETA model and the
-            # maxRunSeconds deadline both count from here
-            status.setdefault("startedAt", _time.time())
             for p in gated:
                 p["spec"]["schedulingGates"] = []
                 self.server.update(p)
+            gated = []
+        if pods and not gated:
+            # level-triggered unpark: the RELEASED STATE clears the parked
+            # condition and stamps startedAt (the backfill-ETA/deadline
+            # clock), not the act of releasing — a transient write fault
+            # between the gate lift and this status landing must not leave
+            # a running gang marked WaitingForSlices forever
+            self._unpark(job, status, "WaitingForSlices", "Scheduled")
+            import time as _time
+
+            status.setdefault("startedAt", _time.time())
 
         if all(ph == "Succeeded" for ph in phases) and pods:
             status["phase"] = "Succeeded"
@@ -278,6 +301,12 @@ class JAXJobController(Controller):
         set_condition(job, cond_type, "True", reason=reason, message=message)
         if not was_true:
             record_event(self.server, job, "Warning", cond_type, message)
+        if cond_type == "WaitingForSlices":
+            # parked on capacity = the gang holds NO slices (a gang with
+            # its own hold re-releases unconditionally), so any previous
+            # release timestamp is void: an evicted gang must not keep
+            # burning its maxRunSeconds budget while queued
+            status.pop("startedAt", None)
         status["phase"] = "Pending"
         status["conditions"] = job["status"]["conditions"]
         key = (req.namespace, req.name)

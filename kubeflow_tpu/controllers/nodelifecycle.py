@@ -1,0 +1,129 @@
+"""Node lifecycle: heartbeat staleness is how host loss becomes visible.
+
+The platform's executors are its kubelets, and a kubelet that dies takes
+its pods' status reporting with it: a preempted host, a crashed node, or a
+killed executor leaves every bound pod ``Running`` in the store forever —
+the gang never restarts and the slice is held hostage.  Borg treats
+machine loss as the NORMAL case (Verma et al., EuroSys'15 §3.1), so this
+controller makes it a first-class, detected event:
+
+- executors register a ``Node`` object and renew ``status.heartbeatTime``
+  (controllers.executor.NodeHeartbeat — kubelet node-lease semantics);
+- a node whose heartbeat is older than ``ttl`` is marked NotReady and
+  every non-terminal pod bound to it (``spec.nodeName`` or
+  ``status.nodeName``) is marked ``Failed`` with ``reason: NodeLost`` —
+  the kube-controller-manager pod-GC semantics;
+- the Failed pods flow into the owners' existing recovery paths: the
+  JAXJob controller restarts the gang (checkpoint resume picks up from
+  the last committed step), the workload controllers replace the pod;
+- a returning heartbeat flips the node back to Ready (its old pods stay
+  lost — the processes died with the host).
+
+NodeLost failures are infrastructure faults, not workload bugs: the
+JAXJob controller does not count them against ``spec.maxRestarts``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.events import record_event
+from kubeflow_tpu.core.quota import TERMINAL_PHASES
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+HEARTBEAT_AGE = REGISTRY.gauge(
+    "node_heartbeat_age_seconds",
+    "seconds since the node's last heartbeat, sampled at reconcile",
+    labels=("node",))
+PODS_NODE_LOST = REGISTRY.counter(
+    "pods_node_lost_total",
+    "pods marked Failed because their node stopped heartbeating")
+
+NODE_LOST_REASON = "NodeLost"
+
+
+class NodeLifecycleController(Controller):
+    """Marks stale nodes NotReady and garbage-collects their pods."""
+
+    kind = "Node"
+
+    def __init__(self, server, *, ttl: float | None = None):
+        super().__init__(server)
+        # staleness threshold: how long a silent node stays trusted.  The
+        # default rides KF_NODE_TTL so deployments tune detection latency
+        # vs. false positives without code changes (kubelet's 40s lease
+        # scaled to this platform's sub-second reconcile timescales)
+        self.ttl = (float(os.environ.get("KF_NODE_TTL", "5.0"))
+                    if ttl is None else float(ttl))
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            node = self.server.get("Node", req.name)
+        except NotFound:
+            HEARTBEAT_AGE.labels(req.name).set(0.0)
+            return None
+        status = node.get("status", {})
+        # a registered node that never heartbeat ages from registration
+        hb = float(status.get("heartbeatTime")
+                   or node["metadata"].get("creationTimestamp", 0.0))
+        age = time.time() - hb
+        HEARTBEAT_AGE.labels(req.name).set(age)
+        if age <= self.ttl:
+            if status.get("ready") is not True:
+                self.server.patch_status("Node", req.name, None, {
+                    **status, "ready": True, "message": ""})
+                if status.get("ready") is False:
+                    record_event(self.server, node, "Normal", "NodeReady",
+                                 "heartbeat resumed")
+            # re-check the moment the current heartbeat would go stale
+            return Result(requeue_after=max(0.05, self.ttl - age + 0.01))
+        if status.get("ready") is not False:
+            self.server.patch_status("Node", req.name, None, {
+                **status, "ready": False,
+                "message": f"no heartbeat for {age:.1f}s"})
+            record_event(self.server, node, "Warning", "NodeNotReady",
+                         f"no heartbeat for {age:.1f}s (ttl {self.ttl}s)")
+        lost = self._fail_bound_pods(req.name)
+        if lost:
+            PODS_NODE_LOST.inc(lost)
+            self.log.warning("pods lost with node", node=req.name,
+                             pods=lost, heartbeat_age=round(age, 2))
+        # keep sweeping while stale: pods can bind to a node the instant
+        # before it dies, and recovery (a fresh heartbeat) re-enqueues us
+        # through the Node MODIFIED event
+        return Result(requeue_after=self.ttl)
+
+    def _fail_bound_pods(self, node_name: str) -> int:
+        """Pod-GC: every non-terminal pod bound to the dead node is marked
+        Failed/NodeLost so owner controllers see the loss and recover.
+        Candidates come from two field-matched lists (binding lives in
+        spec.nodeName once a kubelet claims the pod, status.nodeName once
+        it runs) — a full-copy cluster-wide list() per sweep is the exact
+        per-reconcile scan shape that went quadratic at 500-pod scale."""
+        lost = 0
+        seen: set[tuple] = set()
+        for field in ("spec.nodeName", "status.nodeName"):
+            for pod in self.server.list("Pod",
+                                        field_match={field: node_name}):
+                md = pod["metadata"]
+                key = (md.get("namespace"), md["name"])
+                if key in seen:
+                    continue
+                seen.add(key)
+                status = pod.get("status", {})
+                if status.get("phase") in TERMINAL_PHASES:
+                    continue
+                try:
+                    self.server.patch_status("Pod", md["name"],
+                                             md.get("namespace"), {
+                        **status, "phase": "Failed",
+                        "reason": NODE_LOST_REASON,
+                        "message": f"node {node_name} stopped "
+                                   "heartbeating"})
+                    lost += 1
+                except NotFound:
+                    pass  # deleted while we swept
+        return lost

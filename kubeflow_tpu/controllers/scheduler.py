@@ -33,19 +33,36 @@ exists, so decisions serialize on its single worker thread):
 
 from __future__ import annotations
 
+from kubeflow_tpu.core import Controller, Request, Result
+from kubeflow_tpu.core.events import record_event
 from kubeflow_tpu.core.objects import api_object
 from kubeflow_tpu.core.quota import TERMINAL_PHASES
 from kubeflow_tpu.core.store import APIServer, NotFound
+from kubeflow_tpu.utils.metrics import REGISTRY
 
 POOL_KIND = "TpuSlicePool"
 POOL_NAME = "default"
 TOPOLOGY_LABEL = "jaxjob-topology"
 
+GANG_PREEMPTIONS = REGISTRY.counter(
+    "jaxjob_gang_preemptions_total",
+    "gangs evicted because their slices became unavailable")
 
-def new_pool(capacity: dict[str, int], *, backfill: bool = False) -> dict:
-    """Cluster-scoped slice inventory, e.g. {"v5e-8": 2}."""
+
+def new_pool(capacity: dict[str, int], *, backfill: bool = False,
+             unavailable: dict[str, int] | None = None,
+             cordon: dict[str, bool] | None = None) -> dict:
+    """Cluster-scoped slice inventory, e.g. {"v5e-8": 2}.
+
+    ``unavailable`` (topology -> count) models slices the cloud has
+    preempted or taken for maintenance: physically in the pool, currently
+    unusable — releases subtract them, and the SlicePreemptionController
+    evicts running gangs off them.  ``cordon`` (topology -> bool) is
+    drain: running gangs finish, no NEW gang releases on that topology."""
     return api_object(POOL_KIND, POOL_NAME,
                       spec={"capacity": dict(capacity),
+                            "unavailable": dict(unavailable or {}),
+                            "cordon": dict(cordon or {}),
                             "backfill": backfill})
 
 
@@ -55,6 +72,19 @@ def pool_capacity(server: APIServer) -> dict[str, int] | None:
     except NotFound:
         return None
     return pool.get("spec", {}).get("capacity") or None
+
+
+def _available(pool: dict, topology: str) -> int:
+    """Usable slice count for ``topology``: capacity minus the slices the
+    pool currently marks unavailable (preempted / under maintenance)."""
+    spec = pool.get("spec", {})
+    cap = int((spec.get("capacity") or {}).get(topology, 0))
+    unavailable = int((spec.get("unavailable") or {}).get(topology, 0))
+    return max(0, cap - unavailable)
+
+
+def _cordoned(pool: dict, topology: str) -> bool:
+    return bool((pool.get("spec", {}).get("cordon") or {}).get(topology))
 
 
 # gang accounting selects on the controller-owned TOPOLOGY_LABEL, NOT
@@ -204,8 +234,16 @@ def may_release(server: APIServer, job: dict,
     if me in released:
         # this gang already holds its slices (backfilling a deleted worker):
         # re-release unconditionally or it deadlocks against its own hold
+        # — even mid-drain, since a partial gang is useless either way
         return True, ""
-    free = cap - sum(released.values())
+    if _cordoned(pool, topology):
+        # drain: running gangs finish, nothing new starts.  Checked AFTER
+        # the own-hold re-release above, BEFORE queue position — a
+        # cordoned topology has no meaningful queue order to report.
+        return False, (f"topology {topology} is cordoned (draining); "
+                       "no new gangs released")
+    # preempted/maintenance slices are out of the release budget
+    free = _available(pool, topology) - sum(released.values())
     queue = sorted(
         (key for key, slices in waiting.items() if slices <= cap),
         key=lambda key: (_job_created(server, key), key))
@@ -251,3 +289,98 @@ def _may_backfill(server: APIServer, released: dict, waiting: dict,
     if now + float(my_max) <= eta:
         return True, "backfilled ahead of the queue head (provably no delay)"
     return False, "would delay the queue head"
+
+
+class SlicePreemptionController(Controller):
+    """Enforces ``pool.spec.unavailable``: when slices leave the pool
+    (cloud preemption, maintenance), the youngest released gang(s) of that
+    topology are evicted until the remaining gangs fit the usable
+    capacity.
+
+    Eviction is the Borg move — delete the whole gang's pods (a slice
+    gang is useless partially placed, so partial eviction only wastes the
+    survivors) and let the JAXJob controller's existing recreate path
+    bring it back: the pods re-enter gated, park on WaitingForSlices with
+    backoff, and release again when capacity returns.  Youngest-first
+    mirrors the release FIFO: the gang that started last has the least
+    sunk work and re-queues closest to the head.
+
+    Cordon ≠ preemption: a cordoned topology only stops NEW releases
+    (``may_release``) and never evicts — that is drain.  This controller
+    acts ONLY on ``unavailable`` overcommit."""
+
+    kind = POOL_KIND
+
+    def __init__(self, server):
+        super().__init__(server)
+        # releases happen without any TpuSlicePool event, so a release
+        # racing a pool edit could overcommit the shrunken pool and stay
+        # overcommitted forever if only pool edits re-enqueued us: route
+        # gang-pod releases (MODIFIED with gates lifted) back to the pool
+        self.watch_mappers = {"Pod": self._pod_released}
+
+    def _pod_released(self, ev):
+        if ev.type == "DELETED":
+            return
+        md = ev.object.get("metadata", {})
+        if TOPOLOGY_LABEL not in md.get("labels", {}):
+            return
+        if ev.object.get("spec", {}).get("schedulingGates"):
+            return
+        if ev.object.get("status", {}).get("phase") in TERMINAL_PHASES:
+            return
+        yield Request(None, POOL_NAME)
+
+    def reconcile(self, req: Request) -> Result | None:
+        try:
+            pool = self.server.get(POOL_KIND, req.name)
+        except NotFound:
+            return None
+        evicted = 0
+        for topology in (pool.get("spec", {}).get("capacity") or {}):
+            evicted += self._enforce(pool, topology)
+        if evicted:
+            GANG_PREEMPTIONS.inc(evicted)
+        return None
+
+    def _enforce(self, pool: dict, topology: str) -> int:
+        avail = _available(pool, topology)
+        released, _waiting = _scan_gangs(self.server, topology)
+        held = sum(released.values())
+        if held <= avail:
+            return 0
+        # youngest released gang first (ties broken by key for determinism)
+        order = sorted(released,
+                       key=lambda key: (_job_created(self.server, key), key),
+                       reverse=True)
+        evicted = 0
+        for key in order:
+            if held <= avail:
+                break
+            self._evict(key, topology)
+            held -= released[key]
+            evicted += 1
+        return evicted
+
+    def _evict(self, key: tuple, topology: str) -> None:
+        ns, gang, _uid = key
+        self.log.warning("preempting gang", gang=f"{ns}/{gang}",
+                         topology=topology)
+        job = _job_get(self.server, key)
+        if job is not None:
+            record_event(self.server, job, "Warning", "GangPreempted",
+                         f"slice(s) of {topology} became unavailable; "
+                         "gang evicted and requeued")
+        for pod in self.server.project(
+                "Pod", ("metadata.name", "metadata.ownerReferences"),
+                namespace=ns,
+                label_selector={"matchLabels": {"gang": gang,
+                                                TOPOLOGY_LABEL: topology}}):
+            if key[2] is not None and not any(
+                    r.get("uid") == key[2]
+                    for r in pod["metadata"].get("ownerReferences", [])):
+                continue  # same-name recreation's pods are a different gang
+            try:
+                self.server.delete("Pod", pod["metadata"]["name"], ns)
+            except NotFound:
+                pass

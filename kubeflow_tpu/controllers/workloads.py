@@ -53,7 +53,7 @@ class _TemplateWorkloadController(Controller):
         # fields below are all the roll-up needs
         pods = [p for p in self.server.project(
             "Pod", ("metadata.name", "metadata.ownerReferences",
-                    "status.phase", "status.message"),
+                    "status.phase", "status.message", "status.reason"),
             namespace=req.namespace, label_selector=selector)
             if any(r.get("uid") == obj["metadata"]["uid"]
                    for r in p["metadata"].get("ownerReferences", []))]
@@ -62,6 +62,17 @@ class _TemplateWorkloadController(Controller):
         want_names = [self._pod_name(req.name, i) for i in range(replicas)]
         admission_failure: str | None = None
         for name in want_names:
+            lost = by_name.get(name, {}).get("status", {})
+            if lost.get("phase") == "Failed" and \
+                    lost.get("reason") == "NodeLost":
+                # pod-GC semantics: a pod that died with its node is
+                # deleted and replaced (a Failed pod from a workload bug
+                # stays visible — only infrastructure loss self-heals)
+                try:
+                    self.server.delete("Pod", name, req.namespace)
+                except NotFound:
+                    pass
+                by_name.pop(name, None)
             if name not in by_name:
                 try:
                     self.server.create(

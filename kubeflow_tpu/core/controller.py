@@ -314,6 +314,18 @@ class Controller:
     def reconcile(self, req: Request) -> Result | None:  # pragma: no cover
         raise NotImplementedError
 
+    # -- lifecycle hooks -------------------------------------------------------
+    # The manager calls start() once before any worker runs (controllers
+    # with background machinery — node heartbeats, pollers — launch it
+    # here, never in __init__: a constructed-but-never-started controller
+    # must not leak threads) and stop() during Manager.stop() before the
+    # worker threads are joined.
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
     # -- event routing ---------------------------------------------------------
     def requests_for(self, ev: WatchEvent) -> Iterable[Request]:
         md = ev.object.get("metadata", {})
@@ -347,6 +359,8 @@ class Manager:
         self._workers: dict[str, int] = {}
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._stopped = threading.Event()  # set once stop() fully wound down
+        self._stop_lock = threading.Lock()
         self._leader_election = leader_election
         self._identity = identity
         self._default_workers = max(1, default_workers)
@@ -390,6 +404,11 @@ class Manager:
                                  name="lease-renew")
             t.start()
             self._threads.append(t)
+        # lifecycle hooks BEFORE the seed list: an executor registers its
+        # Node here, so pods reconciled by the very first worker pass
+        # already bind to a registered, heartbeating node
+        for c in self.controllers:
+            c.start()
         # register the watch BEFORE the seed list so objects created in
         # between are not lost (the queue dedups the overlap)
         watch = self.server.watch(self._watched_kinds())
@@ -424,16 +443,23 @@ class Manager:
 
     def _lease_renewer(self) -> None:
         """Renew the leadership lease; losing it stops this manager so two
-        leaders never reconcile concurrently."""
-        while not self._stop.is_set():
-            time.sleep(LEASE_TTL / 3)
-            if self._stop.is_set():
+        leaders never reconcile concurrently.  A single failed renewal is
+        retried once before abdicating: acquire_lease returns False on a
+        transient write Conflict (a status writer racing the lease update,
+        an injected chaos fault) even while this identity still holds the
+        lease, and abdication tears the whole manager down — far too big a
+        response to a lost optimistic-concurrency race."""
+        while not self._stop.wait(LEASE_TTL / 3):
+            if acquire_lease(self.server, "manager-leader", self._identity):
+                continue
+            self.log.warning("lease renewal failed; retrying once")
+            if self._stop.wait(min(1.0, LEASE_TTL / 10)):
                 return
-            if not acquire_lease(self.server, "manager-leader",
-                                 self._identity):
-                self.log.error("lost leadership lease; stopping")
-                self.stop()
-                return
+            if acquire_lease(self.server, "manager-leader", self._identity):
+                continue
+            self.log.error("lost leadership lease; stopping")
+            self.stop()
+            return
 
     def _lease_waiter(self) -> None:
         while not self._stop.is_set():
@@ -441,7 +467,7 @@ class Manager:
                 self.log.info("acquired leadership")
                 self._start_loops()
                 return
-            time.sleep(0.2)
+            self._stop.wait(0.2)
 
     def _worker(self, controller: Controller) -> None:
         q = self._queues[controller.name]
@@ -474,14 +500,49 @@ class Manager:
                     time.perf_counter() - t0)
                 ACTIVE_WORKERS.labels(name).inc(-1)
 
-    def stop(self) -> None:
-        self._stop.set()
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop and JOIN every worker/watch/lease thread (bounded).
+
+        Returning with reconciles still in flight raced test teardown and
+        platform restarts: an unjoined worker kept mutating the store (or
+        a successor manager's view of it) after stop() "completed".  Each
+        thread gets the remaining slice of ``timeout``; a reconcile stuck
+        past that is logged and abandoned rather than hanging shutdown."""
+        with self._stop_lock:
+            first = not self._stop.is_set()
+            self._stop.set()
+        if not first:
+            # another caller is (or was) tearing down: wait for its join
+            # pass to finish rather than returning with threads alive —
+            # unless WE are one of the manager's own threads (the lease
+            # renewer racing an owner's stop), where waiting would
+            # deadlock against our own join
+            if threading.current_thread() not in self._threads:
+                self._stopped.wait(timeout)
+            return
+        # teardown hooks first (heartbeat threads etc.), then the queues:
+        # a worker parked in q.get wakes on shutdown and sees _stop set
+        for c in self.controllers:
+            try:
+                c.stop()
+            except Exception:
+                self.log.error("controller stop hook failed", name=c.name,
+                               exc_info=True)
         for q in self._queues.values():
             q.shutdown()
         if hasattr(self, "_watch"):
             self._watch.stop()
+        deadline = time.monotonic() + timeout
+        me = threading.current_thread()
+        for t in self._threads:
+            if t is me:  # the lease renewer calls stop() from itself
+                continue
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                self.log.error("thread did not stop in time", thread=t.name)
         if self._leader_election:
             release_lease(self.server, "manager-leader", self._identity)
+        self._stopped.set()
 
     def wait_idle(self, timeout: float = 10.0, settle: float = 0.15) -> bool:
         """Test helper: wait until all queues drain AND all in-flight

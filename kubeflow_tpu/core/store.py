@@ -73,7 +73,8 @@ class WatchEvent:
 
 
 # kinds that live outside any namespace (mirrors k8s built-ins + our CRDs)
-CLUSTER_SCOPED = {"Namespace", "Profile", "ClusterRole", "PersistentVolume"}
+CLUSTER_SCOPED = {"Namespace", "Profile", "ClusterRole", "PersistentVolume",
+                  "Node"}
 
 _MISSING = object()  # sentinel: dotted path absent in a projected object
 

@@ -70,6 +70,7 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/metrics/<mtype>", self.metrics_route)
         self.add_route("GET", "/api/autoscale/<ns>", self.autoscale_route)
         self.add_route("GET", "/api/serving-cache", self.serving_cache_route)
+        self.add_route("GET", "/api/nodes", self.nodes_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -133,6 +134,12 @@ class DashboardApp(CrudApp):
         """Serving-engine prefix-cache standing (hit rate, cached bytes,
         evictions) + TTFT p50/p99 from the promoted histogram."""
         return "200 OK", self.metrics.get_serving_cache_state()
+
+    def nodes_route(self, req: Request):
+        """Node heartbeat standing + failure-recovery counters (pods lost
+        to dead nodes, gang preemptions, injected chaos faults) — the
+        cluster robustness card."""
+        return "200 OK", self.metrics.get_cluster_health()
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

@@ -72,6 +72,44 @@ def serving_cache_state() -> dict:
     }
 
 
+def cluster_health(server) -> dict:
+    """Node heartbeat standing + failure-recovery counters (the
+    robustness card): per-node heartbeat age/readiness straight from the
+    Node objects the executors maintain, plus the process-local counters
+    the node-lifecycle, preemption, and chaos layers export.  Store-
+    derived like autoscaler_state — correct under any metrics backend."""
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    def val(name: str) -> float:
+        m = REGISTRY.get_metric(name)
+        return m.get() if m is not None else 0.0
+
+    now = time.time()
+    nodes = []
+    for node in server.list("Node"):
+        name = node["metadata"]["name"]
+        st = node.get("status", {})
+        hb = st.get("heartbeatTime")
+        nodes.append({
+            "name": name,
+            "ready": st.get("ready"),
+            "executor": node.get("spec", {}).get("executor"),
+            "heartbeat_age_s": (round(now - float(hb), 3)
+                                if hb is not None else None),
+            "message": st.get("message", ""),
+            "pods": server.count("Pod",
+                                 field_match={"status.nodeName": name}),
+        })
+    chaos = REGISTRY.get_metric("chaos_faults_injected_total")
+    return {
+        "nodes": nodes,
+        "pods_node_lost": val("pods_node_lost_total"),
+        "gang_preemptions": val("jaxjob_gang_preemptions_total"),
+        # labeled by fault type: sum the family
+        "chaos_faults": chaos.total() if chaos is not None else 0.0,
+    }
+
+
 class MetricsService(Protocol):
     def get_node_cpu_utilization(self, span_s: int) -> list[dict]: ...
 
@@ -84,6 +122,8 @@ class MetricsService(Protocol):
     def get_autoscaler_state(self) -> list[dict]: ...
 
     def get_serving_cache_state(self) -> dict: ...
+
+    def get_cluster_health(self) -> dict: ...
 
 
 class LocalMetricsService:
@@ -133,6 +173,9 @@ class LocalMetricsService:
 
     def get_serving_cache_state(self) -> dict:
         return serving_cache_state()
+
+    def get_cluster_health(self) -> dict:
+        return cluster_health(self.server)
 
 
 class CloudMonitoringMetricsService:
@@ -192,6 +235,11 @@ class CloudMonitoringMetricsService:
     def get_serving_cache_state(self):
         # serving counters live in the process-local registry either way
         return serving_cache_state()
+
+    def get_cluster_health(self):
+        # node heartbeats live in the platform's own store, like the
+        # autoscaler's standing
+        return cluster_health(self.server) if self.server else {"nodes": []}
 
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:

@@ -68,6 +68,9 @@ PROXIED = REGISTRY.counter("gateway_requests_total",
                            labels=("code",))
 DENIED = REGISTRY.counter("gateway_denied_total",
                           "requests denied by AuthorizationPolicy")
+EJECTIONS = REGISTRY.counter(
+    "gateway_backend_ejections_total",
+    "backends temporarily ejected from rotation after connect failures")
 
 log = get_logger("gateway")
 
@@ -84,6 +87,44 @@ HOP_BY_HOP = {"connection", "keep-alive", "proxy-authenticate",
 
 class NoBackend(RuntimeError):
     """A VirtualService matched but no live pod backs its destination."""
+
+
+class EjectionList:
+    """Outlier detection (Envoy's outlier ejection, minimal form): a
+    backend whose connect failed is taken out of rotation for ``ttl``
+    seconds so traffic shifts to healthy pods immediately, instead of
+    every request re-paying the full connect-retry budget against a dead
+    pod while the controller replaces it.  Entries expire (the address
+    may be reused) and a successful response clears the entry early."""
+
+    def __init__(self, ttl: float = 10.0):
+        import threading
+
+        self.ttl = ttl
+        self._until: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def eject(self, host: str, port: int) -> None:
+        with self._lock:
+            self._until[(host, port)] = time.monotonic() + self.ttl
+        EJECTIONS.inc()
+        log.warning("backend ejected from rotation", backend=f"{host}:{port}",
+                    ttl=self.ttl)
+
+    def clear(self, host: str, port: int) -> None:
+        with self._lock:
+            self._until.pop((host, port), None)
+
+    def contains(self, host: str, port: int) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            until = self._until.get((host, port))
+            if until is None:
+                return False
+            if until <= now:
+                del self._until[(host, port)]
+                return False
+            return True
 
 
 @dataclass
@@ -290,8 +331,8 @@ def resolve_backend(server: APIServer, path: str) -> Backend | None:
     return backend_for_route(server, route, path)
 
 
-def backend_for_route(server: APIServer, route: Route,
-                      path: str) -> Backend:
+def backend_for_route(server: APIServer, route: Route, path: str,
+                      ejected: EjectionList | None = None) -> Backend:
     parts = route.dest_host.split(".")
     if len(parts) < 2:
         raise NoBackend(f"unresolvable destination {route.dest_host!r}")
@@ -309,6 +350,7 @@ def backend_for_route(server: APIServer, route: Route,
         raise NoBackend(
             f"service {svc_ns}/{svc_name} has no port {route.dest_port}")
     selector = {"matchLabels": svc["spec"].get("selector", {})}
+    fallback = None
     for pod in server.list("Pod", namespace=svc_ns,
                            label_selector=selector):
         status = pod.get("status", {})
@@ -317,11 +359,21 @@ def backend_for_route(server: APIServer, route: Route,
         host_port = (status.get("portMap") or {}).get(str(target_port))
         if host_port is None:
             continue
-        return Backend(host=status.get("podIP", "127.0.0.1"),
-                       port=int(host_port),
-                       path=route.rewritten(path),
-                       set_headers=route.set_headers,
-                       timeout_s=route.timeout_s)
+        backend = Backend(host=status.get("podIP", "127.0.0.1"),
+                          port=int(host_port),
+                          path=route.rewritten(path),
+                          set_headers=route.set_headers,
+                          timeout_s=route.timeout_s)
+        if ejected is not None and ejected.contains(backend.host,
+                                                    backend.port):
+            # out of rotation after a connect failure — but keep it as a
+            # last resort: with EVERY candidate ejected, one failing
+            # attempt beats an unconditional 503 (Envoy's panic threshold)
+            fallback = fallback or backend
+            continue
+        return backend
+    if fallback is not None:
+        return fallback
     raise NoBackend(f"no running pod backs {svc_ns}/{svc_name}"
                     f":{target_port}")
 
@@ -450,6 +502,11 @@ class Gateway:
         self.connect_retries = connect_retries
         self.retry_delay = retry_delay
         self.pool = _BackendPool()
+        # outlier ejection: connect-failed backends leave rotation so
+        # traffic shifts to healthy pods while the controller replaces
+        # the dead one (instead of re-paying the connect-retry budget on
+        # every request)
+        self.ejections = EjectionList()
         # autoscale integration: per-destination in-flight counts feed the
         # concurrency autoscaler, and the activator holds requests hitting
         # an autoscaled InferenceService at zero replicas (scale-from-zero)
@@ -492,7 +549,8 @@ class Gateway:
             handler.send_error(403, explain=why)
             return True
         try:
-            backend = backend_for_route(self.server, route, path)
+            backend = backend_for_route(self.server, route, path,
+                                        self.ejections)
         except NoBackend as e:
             PROXIED.labels("503").inc()
             handler.send_error(503, explain=str(e))
@@ -515,6 +573,7 @@ class Gateway:
                 break
             except OSError:
                 if attempt + 1 == self.connect_retries:
+                    self.ejections.eject(backend.host, backend.port)
                     PROXIED.labels("502").inc()
                     handler.send_error(502,
                                        explain="backend connection failed")
@@ -574,6 +633,9 @@ class Gateway:
                 and len(status[1]) == 3 and status[1][:1] in b"12345":
             code = status[1].decode("ascii")
         PROXIED.labels(code).inc()
+        # the backend answered the handshake: back in rotation (matches
+        # the HTTP path's early un-ejection)
+        self.ejections.clear(backend.host, backend.port)
         sock.settimeout(None)
         try:
             client.sendall(buf)
@@ -627,7 +689,8 @@ class Gateway:
                            [("Content-Type", "text/plain")])
             return [f"{why}\n".encode()]
         try:
-            backend = backend_for_route(self.server, route, path)
+            backend = backend_for_route(self.server, route, path,
+                                        self.ejections)
         except NoBackend as e:
             backend = self._activate(route, path)
             if backend is None:
@@ -720,6 +783,7 @@ class Gateway:
                 # a streamed (unbuffered) body may be partially consumed
                 # and cannot be replayed
                 if attempt + 1 == self.connect_retries or not retriable:
+                    self.ejections.eject(backend.host, backend.port)
                     PROXIED.labels("502").inc()
                     start_response("502 Bad Gateway",
                                    [("Content-Type", "text/plain")])
@@ -733,6 +797,7 @@ class Gateway:
                     # idle): retry on a fresh connect, no backoff
                     force_fresh = True
                     continue
+                self.ejections.eject(backend.host, backend.port)
                 PROXIED.labels("502").inc()
                 start_response("502 Bad Gateway",
                                [("Content-Type", "text/plain")])
@@ -742,6 +807,9 @@ class Gateway:
             start_response("502 Bad Gateway",
                            [("Content-Type", "text/plain")])
             return [b"backend unavailable\n"]
+        # the backend answered: if it was serving as an ejected-fallback
+        # (or just recovered), put it back in rotation early
+        self.ejections.clear(backend.host, backend.port)
 
         out_headers = [(k, v) for k, v in resp.getheaders()
                        if k.lower() not in HOP_BY_HOP]

@@ -29,6 +29,8 @@ def build_platform(executor: str = "fake", *, extra_env: dict | None = None,
     from kubeflow_tpu.api import jaxjob as jaxjob_api
     from kubeflow_tpu.controllers.executor import FakeExecutor, LocalExecutor
     from kubeflow_tpu.controllers.jaxjob import JAXJobController
+    from kubeflow_tpu.controllers.nodelifecycle import NodeLifecycleController
+    from kubeflow_tpu.controllers.scheduler import SlicePreemptionController
 
     server = APIServer()
     server.register_validating_hook(
@@ -55,7 +57,13 @@ def build_platform(executor: str = "fake", *, extra_env: dict | None = None,
                 workers=pod_workers)
     elif executor == "fake":
         mgr.add(FakeExecutor(server), workers=pod_workers)
-    # executor == "none": an external kubelet owns pod lifecycle
+    # executor == "none": an external kubelet owns pod lifecycle (it still
+    # registers a Node and heartbeats, so node-loss detection below holds)
+    # host loss detection (heartbeat staleness -> NodeLost pod GC) and
+    # slice preemption/drain enforcement: single-worker each — both
+    # read-then-act on shared capacity views, so decisions serialize
+    mgr.add(NodeLifecycleController(server), workers=1)
+    mgr.add(SlicePreemptionController(server), workers=1)
 
     _register_optional(server, mgr, enable)
     return server, mgr

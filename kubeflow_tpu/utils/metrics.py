@@ -38,6 +38,11 @@ class _Metric:
     def get(self, *label_values: str) -> float:
         return self._values.get(tuple(str(v) for v in label_values), 0.0)
 
+    def total(self) -> float:
+        """Sum over every label combination (sum-without-by semantics)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def expose(self, kind: str) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {kind}"]
         with self._lock:

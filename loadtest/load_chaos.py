@@ -1,0 +1,367 @@
+"""Chaos convergence loadtest: gangs + notebooks + an InferenceService under
+a seeded fault schedule (silent node outages, slice preemptions, injected
+write Conflicts and latency).
+
+The invariants this run proves — the ones chaos engineering says rot unless
+continuously exercised:
+
+1. CONVERGENCE: every gang reaches a terminal phase despite hosts dying
+   silently mid-run (no Failed status ever posted by the executor — only
+   heartbeat staleness reveals the loss).
+2. NO OVERCOMMIT: at every observation, released (non-terminal, ungated)
+   gang slices never exceed the pool's capacity, through preemptions and
+   restarts alike.
+3. CLEAN ACCOUNTING: namespace TPU quota usage returns to zero once all
+   gangs are terminal — no leaked charges from killed incarnations.
+4. DETERMINISM: the same seed yields the same final ``state_digest``
+   (volatile fields stripped) — the fault schedule, and recovery from it,
+   is reproducible.
+
+Faults are STATE-TRIGGERED (fire at gang-completion thresholds, recover
+once every killed pod is observed detected), not wall-clock-triggered, so
+the schedule is the same logical schedule on any machine speed.
+
+Usage: python loadtest/load_chaos.py [N_GANGS] [M_SLICES]
+       [--notebooks N] [--seed S] [--conflict-rate R] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TOPOLOGY = "v5e-8"          # 2 hosts x 4 chips per gang
+NS_TRAIN = "chaos-train"
+NS_NB = "chaos-nb"
+NS_SRV = "chaos-srv"
+
+
+def build(seed: int, m_slices: int, n_gangs: int, conflict_rate: float,
+          latency_rate: float, run_for: float, node_ttl: float):
+    from kubeflow_tpu.api import jaxjob as api
+    from kubeflow_tpu.chaos import ChaosInjector, ChaoticAPIServer
+    from kubeflow_tpu.controllers import (
+        inferenceservice,
+        notebook,
+        scheduler,
+    )
+    from kubeflow_tpu.controllers.executor import FakeExecutor
+    from kubeflow_tpu.controllers.jaxjob import JAXJobController
+    from kubeflow_tpu.controllers.nodelifecycle import NodeLifecycleController
+    from kubeflow_tpu.core import Manager, api_object, quota
+
+    server = ChaoticAPIServer(seed=seed, conflict_rate=conflict_rate,
+                              latency_rate=latency_rate, latency_s=0.001)
+    quota.register(server)
+    server.register_validating_hook(
+        lambda o: api.validate(o) if o.get("kind") == api.KIND else None)
+    # the pool starts FULLY unavailable: every gang deterministically parks
+    # on WaitingForSlices first (identical condition history on every job,
+    # every run — the digest invariant needs that), then the injector
+    # "delivers" the slices
+    server.create(scheduler.new_pool(
+        {TOPOLOGY: m_slices}, unavailable={TOPOLOGY: m_slices}))
+    # quota generous enough to admit every gang's pods at once: quota
+    # CHARGING stays exercised (invariant 3) without nondeterministic
+    # admission parking
+    server.create(api_object(
+        "ResourceQuota", quota.QUOTA_NAME, NS_TRAIN,
+        spec={"hard": {"cloud-tpu.google.com/v5e": 8 * n_gangs,
+                       "pods": 4 * n_gangs}}))
+
+    # gang pods complete; notebook/predictor pods are long-running servers
+    executor = FakeExecutor(
+        server, run_for=run_for,
+        server_pods=lambda pod: "jaxjob" not in pod["metadata"].get(
+            "labels", {}))
+    mgr = Manager(server)
+    mgr.add(JAXJobController(server), workers=1)  # decisions serialize
+    mgr.add(executor, workers=4)
+    mgr.add(NodeLifecycleController(server, ttl=node_ttl), workers=1)
+    mgr.add(scheduler.SlicePreemptionController(server), workers=1)
+    notebook.register(server, mgr)          # + StatefulSet/Deployment
+    inferenceservice.register(server, mgr)
+    injector = ChaosInjector(server, executor, seed=seed)
+    return server, mgr, executor, injector
+
+
+def run_once(n_gangs: int, m_slices: int, n_notebooks: int, seed: int,
+             conflict_rate: float, latency_rate: float,
+             run_for: float = 0.15, node_ttl: float = 0.6) -> dict:
+    from kubeflow_tpu.api import jaxjob as api
+    from kubeflow_tpu.core import quota
+    from kubeflow_tpu.core.store import state_digest
+
+    server, mgr, executor, injector = build(
+        seed, m_slices, n_gangs, conflict_rate, latency_rate, run_for,
+        node_ttl)
+    mgr.start()
+    server.arm()  # chaos on: everything from here runs under write faults
+
+    t0 = time.perf_counter()
+    for i in range(n_gangs):
+        _create_retry(server,
+                      api.new(f"gang-{i:03d}", NS_TRAIN, topology=TOPOLOGY))
+    for i in range(n_notebooks):
+        _create_retry(server, _notebook(f"nb-{i}"))
+    _create_retry(server, _isvc("llm"))
+    # every gang must OBSERVE the empty pool (park on WaitingForSlices)
+    # before the slices "arrive" — a state-triggered gate, so each run
+    # replays the same logical schedule regardless of machine speed
+    _wait(lambda: _all_parked(server, n_gangs), 30,
+          "gangs never parked on the empty pool")
+    injector.restore_slices(TOPOLOGY, m_slices)
+
+    # state-triggered fault schedule: two full node outages and two slice
+    # preemptions, fired at gang-completion thresholds
+    outage_at = {max(1, n_gangs // 5), max(2, (3 * n_gangs) // 5)}
+    preempt_at = {max(1, (2 * n_gangs) // 5), max(2, (4 * n_gangs) // 5)}
+    fired_outage: set[int] = set()
+    fired_preempt: set[int] = set()
+    pending_detect: list[tuple] = []   # killed pods awaiting detection
+    outage_active = False              # heartbeat currently stopped
+    pending_restore: list[int] = []    # preempted slice batches to return
+    overcommit_max = 0
+
+    deadline = time.perf_counter() + max(120, n_gangs * 6)
+    done = 0
+    while time.perf_counter() < deadline:
+        done = _terminal_gangs(server)
+        # -- invariant 2: released slices never exceed pool capacity
+        released = _released_slices(server)
+        overcommit_max = max(overcommit_max, released)
+        assert released <= m_slices, (
+            f"OVERCOMMIT: {released} slices released on a {m_slices} pool")
+        # -- fault schedule
+        for threshold in sorted(outage_at):
+            if done >= threshold and threshold not in fired_outage:
+                fired_outage.add(threshold)
+                pending_detect = injector.node_outage()
+                outage_active = True
+        if outage_active and _all_detected(server, pending_detect):
+            # every silently-killed pod was detected via heartbeat
+            # staleness (vacuously so for an outage that caught no pod
+            # Running) -> the node may come back
+            pending_detect = []
+            outage_active = False
+            injector.node_recovery()
+        for threshold in sorted(preempt_at):
+            if done >= threshold and threshold not in fired_preempt:
+                fired_preempt.add(threshold)
+                k = max(1, m_slices // 2)
+                injector.preempt_slices(TOPOLOGY, k)
+                pending_restore.append(k)
+        if pending_restore and released <= m_slices - sum(pending_restore):
+            # eviction observed (the preemption controller pushed released
+            # usage back under the shrunken budget): the cloud hands the
+            # slices back — never gated on gang completions, which the
+            # preemption itself may be blocking
+            injector.restore_slices(TOPOLOGY, pending_restore.pop(0))
+        if done >= n_gangs and not outage_active and not pending_restore:
+            break
+        time.sleep(0.02)
+    makespan = time.perf_counter() - t0
+
+    # -- invariant 1: convergence
+    assert done >= n_gangs, (
+        f"STALL: only {done}/{n_gangs} gangs reached a terminal phase")
+    phases = _gang_phases(server)
+    assert all(p == "Succeeded" for p in phases.values()), (
+        f"gangs failed terminally under infra-only faults: "
+        f"{ {k: v for k, v in phases.items() if v != 'Succeeded'} }")
+    # servers recovered too: notebooks + predictor back to ready
+    _wait(lambda: _servers_ready(server, n_notebooks), 30,
+          "notebooks/InferenceService never recovered")
+    # -- invariant 3: quota accounting drains to zero
+    _wait(lambda: not any(
+        v for k, v in quota.namespace_usage(server, NS_TRAIN).items()
+        if k.startswith(quota.TPU_PREFIX)), 15,
+        "TPU quota usage did not return to zero")
+    # the node itself must settle Ready (a sweep racing the recovery beat
+    # can transiently re-mark NotReady; the next heartbeat corrects it)
+    _wait(lambda: server.get("Node", executor.node_name)
+          .get("status", {}).get("ready") or None, 15,
+          "node never returned to Ready after recovery")
+    mgr.wait_idle(timeout=30)
+    digest = state_digest(server)
+    mgr.stop()
+
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    faults = REGISTRY.get_metric("chaos_faults_injected_total")
+    result = {
+        "gangs": n_gangs, "slices": m_slices, "seed": seed,
+        "makespan_s": round(makespan, 3),
+        "max_released": overcommit_max,
+        "outages": len(fired_outage), "preemptions": len(fired_preempt),
+        "pods_node_lost": REGISTRY.get_metric(
+            "pods_node_lost_total").get(),
+        "gang_preemptions": REGISTRY.get_metric(
+            "jaxjob_gang_preemptions_total").get(),
+        "faults_injected": faults.total() if faults else 0.0,
+        "digest": digest,
+    }
+    print(json.dumps(result))
+    return result
+
+
+# -- workload + observation helpers -------------------------------------------
+
+def _create_retry(server, obj: dict) -> None:
+    """The harness is a store client like any other: its writes eat
+    injected transient Conflicts too, and retry."""
+    from kubeflow_tpu.core.store import Conflict, NotFound
+
+    for _ in range(100):
+        try:
+            server.create(obj)
+            return
+        except Conflict:
+            md = obj["metadata"]
+            try:
+                server.get(obj["kind"], md["name"], md.get("namespace"))
+                return  # landed: the conflict was "already exists"
+            except NotFound:
+                time.sleep(0.002)  # injected: retry the create
+    raise RuntimeError(f"could not create {obj['kind']}")
+
+
+def _notebook(name: str) -> dict:
+    from kubeflow_tpu.core import api_object
+
+    return api_object("Notebook", name, NS_NB, spec={
+        "template": {"spec": {"containers": [
+            {"name": name, "image": "jax-nb:v1"}]}}})
+
+
+def _isvc(name: str) -> dict:
+    from kubeflow_tpu.core import api_object
+
+    return api_object("InferenceService", name, NS_SRV, spec={
+        "predictor": {"model": "llama", "size": "tiny",
+                      "topology": "v5e-4"}})
+
+
+def _all_parked(server, n_gangs: int):
+    from kubeflow_tpu.api import jaxjob as api
+
+    parked = sum(
+        1 for j in server.project(api.KIND, ("status.conditions",),
+                                  namespace=NS_TRAIN)
+        if any(c.get("type") == "WaitingForSlices"
+               and c.get("status") == "True"
+               for c in j.get("status", {}).get("conditions", [])))
+    return True if parked >= n_gangs else None
+
+
+def _terminal_gangs(server) -> int:
+    from kubeflow_tpu.api import jaxjob as api
+
+    return sum(1 for j in server.project(
+        api.KIND, ("status.phase",), namespace=NS_TRAIN)
+        if j.get("status", {}).get("phase") in ("Succeeded", "Failed"))
+
+
+def _gang_phases(server) -> dict:
+    from kubeflow_tpu.api import jaxjob as api
+
+    return {j["metadata"]["name"]: j.get("status", {}).get("phase")
+            for j in server.project(
+                api.KIND, ("metadata.name", "status.phase"),
+                namespace=NS_TRAIN)}
+
+
+def _released_slices(server) -> int:
+    """Slices held by released gangs, from the pod view (the scheduler's
+    own accounting definition): non-terminal, gate-free pods, deduped per
+    gang."""
+    held: dict[tuple, int] = {}
+    for pod in server.project(
+            "Pod", ("metadata.namespace", "metadata.labels", "status.phase",
+                    "spec.schedulingGates"),
+            label_selector={"matchLabels": {"jaxjob-topology": TOPOLOGY}}):
+        if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            continue
+        if pod.get("spec", {}).get("schedulingGates"):
+            continue
+        labels = pod.get("metadata", {}).get("labels", {})
+        gang = labels.get("gang")
+        if gang:
+            held[(pod["metadata"].get("namespace"), gang)] = int(
+                labels.get("jaxjob-num-slices", "1"))
+    return sum(held.values())
+
+
+def _all_detected(server, killed: list[tuple]) -> bool:
+    """Every silently-killed incarnation was seen by the control plane:
+    marked Failed (NodeLost) or already replaced/deleted."""
+    from kubeflow_tpu.core.store import NotFound
+
+    for ns, name, uid in killed:
+        try:
+            pod = server.get("Pod", name, ns)
+        except NotFound:
+            continue
+        if pod["metadata"]["uid"] != uid:
+            continue  # replaced incarnation
+        if pod.get("status", {}).get("phase") != "Failed":
+            return False
+    return True
+
+
+def _servers_ready(server, n_notebooks: int):
+    for i in range(n_notebooks):
+        nb = server.get("Notebook", f"nb-{i}", NS_NB)
+        if not nb.get("status", {}).get("readyReplicas"):
+            return None
+    isvc = server.get("InferenceService", "llm", NS_SRV)
+    return True if isvc.get("status", {}).get("ready") else None
+
+
+def _wait(fn, timeout: float, msg: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser("load_chaos")
+    ap.add_argument("n_gangs", nargs="?", type=int, default=12)
+    ap.add_argument("m_slices", nargs="?", type=int, default=3)
+    ap.add_argument("--notebooks", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--conflict-rate", type=float, default=0.05)
+    ap.add_argument("--latency-rate", type=float, default=0.10)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small-N CI profile (4 gangs, 2 slices, 2 nbs)")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.n_gangs, args.m_slices, args.notebooks = 4, 2, 2
+
+    # invariant 4: the same seed converges to the SAME final state
+    results = [run_once(args.n_gangs, args.m_slices, args.notebooks,
+                        args.seed, args.conflict_rate, args.latency_rate)
+               for _ in range(2)]
+    if results[0]["digest"] != results[1]["digest"]:
+        print("FAIL: same seed produced different final state digests")
+        return 1
+    print(f"converged under chaos twice; state digest identical "
+          f"({results[0]['digest'][:16]}…); "
+          f"faults={results[1]['faults_injected'] - results[0]['faults_injected']:.0f} in run 2")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

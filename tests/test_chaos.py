@@ -1,0 +1,236 @@
+"""Chaos layer: seeded write faults, slice preemption/drain, determinism.
+
+The invariants here are the ones Basiri et al. argue rot without
+continuous fault injection: controllers converge THROUGH injected
+transient Conflicts, slice preemption evicts exactly the youngest gang,
+cordon drains without evicting, and the whole fault schedule is
+reproducible (same seed ⇒ same final state digest).
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.api import jaxjob as api
+from kubeflow_tpu.chaos import ChaosInjector, ChaoticAPIServer
+from kubeflow_tpu.controllers import scheduler
+from kubeflow_tpu.controllers.executor import FakeExecutor
+from kubeflow_tpu.controllers.jaxjob import JAXJobController
+from kubeflow_tpu.core import APIServer, Manager
+from kubeflow_tpu.core.objects import get_condition
+from kubeflow_tpu.core.store import Conflict
+
+
+def wait_for(fn, timeout=15.0):
+    from tests.conftest import poll_until
+
+    return poll_until(fn, timeout=timeout, interval=0.03)
+
+
+def job_phase(server, name, ns="ml"):
+    return server.get(api.KIND, name, ns).get("status", {}).get("phase")
+
+
+def gang_pods(server, name, ns="ml"):
+    return server.list("Pod", namespace=ns, label_selector={
+        "matchLabels": {"jaxjob": name}})
+
+
+# -- chaotic store -------------------------------------------------------------
+
+def test_chaotic_server_injects_transient_conflicts_when_armed():
+    server = ChaoticAPIServer(seed=1, conflict_rate=1.0)
+    from kubeflow_tpu.core import api_object
+
+    server.create(api_object("Widget", "w", "ns"))  # disarmed: clean
+    server.arm()
+    with pytest.raises(Conflict, match="injected"):
+        server.create(api_object("Widget", "x", "ns"))
+    # the fault fired BEFORE any mutation: the object never landed
+    assert server.count("Widget") == 1
+    server.arm(False)
+    server.create(api_object("Widget", "x", "ns"))
+    assert server.count("Widget") == 2
+
+
+def test_controllers_converge_through_injected_conflicts():
+    """Every controller is level-triggered + retried: a 30% transient
+    Conflict rate on all writes must slow nothing but the clock."""
+    server = ChaoticAPIServer(seed=42, conflict_rate=0.3,
+                              latency_rate=0.2, latency_s=0.001)
+    server.register_validating_hook(
+        lambda o: api.validate(o) if o.get("kind") == api.KIND else None)
+    mgr = Manager(server)
+    mgr.add(JAXJobController(server))
+    mgr.add(FakeExecutor(server))
+    mgr.start()
+    server.arm()
+    try:
+        for i in range(3):
+            _create_retry(server, api.new(f"j{i}", "ml", topology="v5e-8"))
+        for i in range(3):
+            wait_for(lambda i=i: job_phase(server, f"j{i}") == "Succeeded"
+                     or None, timeout=30)
+    finally:
+        mgr.stop()
+
+
+# -- preemption / drain --------------------------------------------------------
+
+@pytest.fixture()
+def pool_harness():
+    server = APIServer()
+    mgr = Manager(server)
+    mgr.add(JAXJobController(server))
+    executor = FakeExecutor(server, complete=False)
+    mgr.add(executor)
+    mgr.add(scheduler.SlicePreemptionController(server))
+    mgr.start()
+    yield server, mgr, executor
+    mgr.stop()
+
+
+def test_preemption_evicts_youngest_released_gang(pool_harness):
+    server, mgr, executor = pool_harness
+    server.create(scheduler.new_pool({"v5e-8": 2}))
+    server.create(api.new("older", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "older") == "Running" or None)
+    server.create(api.new("younger", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "younger") == "Running" or None)
+
+    injector = ChaosInjector(server, executor, seed=0)
+    injector.preempt_slices("v5e-8", 1)
+    # the YOUNGER gang is evicted back to the queue; the older keeps its
+    # slice and keeps running
+    wait_for(lambda: (get_condition(server.get(api.KIND, "younger", "ml"),
+                                    "WaitingForSlices") or {})
+             .get("status") == "True" or None)
+    assert job_phase(server, "older") == "Running"
+    assert all(p["spec"].get("schedulingGates")
+               for p in gang_pods(server, "younger"))
+    assert scheduler.GANG_PREEMPTIONS.get() >= 1
+
+    # the slice returns: the evicted gang is re-released
+    injector.restore_slices("v5e-8", 1)
+    wait_for(lambda: job_phase(server, "younger") == "Running" or None)
+
+
+def test_cordon_drains_without_evicting(pool_harness):
+    """Cordon-vs-preempt semantics: cordon lets the running gang FINISH
+    (no eviction) but refuses any new release on that topology until the
+    cordon lifts."""
+    server, mgr, executor = pool_harness
+    server.create(scheduler.new_pool({"v5e-8": 2}))
+    server.create(api.new("running", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "running") == "Running" or None)
+
+    pool = server.get(scheduler.POOL_KIND, scheduler.POOL_NAME)
+    pool["spec"]["cordon"] = {"v5e-8": True}
+    server.update(pool)
+    # the running gang is untouched — drain, not eviction
+    time.sleep(0.3)
+    assert job_phase(server, "running") == "Running"
+    assert not any(p["spec"].get("schedulingGates")
+                   for p in gang_pods(server, "running"))
+
+    # a new gang parks with the cordon reason even though a slice is free
+    server.create(api.new("blocked", "ml", topology="v5e-8"))
+    parked = wait_for(lambda: (
+        lambda j: j if (get_condition(j, "WaitingForSlices") or {})
+        .get("status") == "True" else None)(
+        server.get(api.KIND, "blocked", "ml")))
+    assert "cordoned" in get_condition(parked,
+                                       "WaitingForSlices")["message"]
+
+    # uncordon -> the parked gang releases promptly (pool watch mapper)
+    pool = server.get(scheduler.POOL_KIND, scheduler.POOL_NAME)
+    pool["spec"]["cordon"] = {}
+    server.update(pool)
+    wait_for(lambda: job_phase(server, "blocked") == "Running" or None)
+
+
+def test_unavailable_capacity_blocks_new_release(pool_harness):
+    """may_release budgets against capacity - unavailable, not raw
+    capacity."""
+    server, mgr, executor = pool_harness
+    server.create(scheduler.new_pool({"v5e-8": 2},
+                                     unavailable={"v5e-8": 1}))
+    server.create(api.new("one", "ml", topology="v5e-8"))
+    wait_for(lambda: job_phase(server, "one") == "Running" or None)
+    server.create(api.new("two", "ml", topology="v5e-8"))
+    parked = wait_for(lambda: (
+        lambda j: j if (get_condition(j, "WaitingForSlices") or {})
+        .get("status") == "True" else None)(
+        server.get(api.KIND, "two", "ml")))
+    assert "waiting for capacity" in get_condition(
+        parked, "WaitingForSlices")["message"]
+
+
+def test_node_outage_is_detected_and_counted():
+    """ChaosInjector.node_outage silences every running pod + stops the
+    heartbeat; nothing but staleness reveals it."""
+    from kubeflow_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController,
+        PODS_NODE_LOST,
+    )
+
+    server = APIServer()
+    mgr = Manager(server)
+    mgr.add(JAXJobController(server))
+    executor = FakeExecutor(server, complete=False, heartbeat_interval=0.1)
+    mgr.add(executor)
+    mgr.add(NodeLifecycleController(server, ttl=0.5))
+    mgr.start()
+    try:
+        server.create(api.new("job", "ml", topology="v5e-8"))
+        wait_for(lambda: job_phase(server, "job") == "Running" or None)
+
+        injector = ChaosInjector(server, executor, seed=3)
+        before = PODS_NODE_LOST.get()
+        old_uids = {p["metadata"]["uid"] for p in gang_pods(server, "job")}
+        killed = injector.node_outage()
+        assert len(killed) == 2  # both gang workers were running
+        wait_for(lambda: PODS_NODE_LOST.get() >= before + 2 or None,
+                 timeout=10)
+        injector.node_recovery()
+        # the gang comes back with fresh incarnations and keeps running
+        wait_for(lambda: (
+            job_phase(server, "job") == "Running"
+            and {p["metadata"]["uid"]
+                 for p in gang_pods(server, "job")}.isdisjoint(old_uids)
+            and all(p.get("status", {}).get("phase") == "Running"
+                    for p in gang_pods(server, "job"))) or None, timeout=20)
+        for p in gang_pods(server, "job"):
+            server.patch_status("Pod", p["metadata"]["name"], "ml",
+                                {"phase": "Succeeded"})
+        wait_for(lambda: job_phase(server, "job") == "Succeeded" or None,
+                 timeout=20)
+    finally:
+        mgr.stop()
+
+
+# -- determinism ---------------------------------------------------------------
+
+def test_chaos_loadtest_smoke_is_deterministic():
+    """Same seed ⇒ same fault schedule ⇒ same final state digest.  This is
+    the CI smoke profile of loadtest/load_chaos.py, in-process."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "loadtest"))
+    import load_chaos
+
+    digests = {load_chaos.run_once(3, 2, 1, seed=5, conflict_rate=0.05,
+                                   latency_rate=0.1)["digest"]
+               for _ in range(2)}
+    assert len(digests) == 1, "same seed diverged"
+
+
+def _create_retry(server, obj):
+    for _ in range(100):
+        try:
+            server.create(obj)
+            return
+        except Conflict:
+            time.sleep(0.002)
+    raise RuntimeError("create never landed")

@@ -231,3 +231,127 @@ def test_error_backoff_retries():
         assert len(attempts) >= 3
     finally:
         mgr.stop()
+
+
+def test_manager_stop_joins_all_threads():
+    """stop() must not return while workers/watch threads are still able
+    to mutate the store — in-flight reconciles raced test teardown and
+    platform restarts."""
+    import threading
+
+    server = APIServer()
+    release = threading.Event()
+    entered = threading.Event()
+
+    class Slow(Controller):
+        kind = "Widget"
+
+        def reconcile(self, req):
+            entered.set()
+            release.wait(5.0)
+            return None
+
+    mgr = Manager(server)
+    mgr.add(Slow(server), workers=2)
+    mgr.start()
+    server.create(api_object("Widget", "w", "ns"))
+    assert entered.wait(5.0)
+    release.set()
+    mgr.stop()
+    assert all(not t.is_alive() for t in mgr._threads), [
+        t.name for t in mgr._threads if t.is_alive()]
+
+
+def test_manager_stop_runs_controller_teardown_hooks():
+    server = APIServer()
+    stopped = []
+
+    class Hooked(Controller):
+        kind = "Widget"
+
+        def reconcile(self, req):
+            return None
+
+        def stop(self):
+            stopped.append(self.name)
+
+    mgr = Manager(server)
+    mgr.add(Hooked(server))
+    mgr.start()
+    mgr.stop()
+    mgr.stop()  # idempotent: a lost lease may already have stopped us
+    assert stopped == ["Hooked"]
+
+
+def test_lease_renewal_survives_one_transient_conflict(monkeypatch):
+    """A single failed renewal (injected write Conflict) must be retried,
+    not answered by abdicating the whole manager."""
+    from kubeflow_tpu.core import controller as ctl
+    from kubeflow_tpu.core.store import Conflict
+
+    monkeypatch.setattr(ctl, "LEASE_TTL", 0.4)
+
+    class FlakyLeaseServer(APIServer):
+        def __init__(self):
+            super().__init__()
+            self.fail_next_lease_update = False
+
+        def update(self, obj):
+            if obj.get("kind") == "Lease" and self.fail_next_lease_update:
+                self.fail_next_lease_update = False
+                raise Conflict("injected")
+            return super().update(obj)
+
+    server = FlakyLeaseServer()
+    mgr = Manager(server, leader_election=True, identity="node-a")
+    mgr.add(WidgetController(server))
+    mgr.start()
+    try:
+        server.fail_next_lease_update = True
+        # ride through two renewal periods: the single Conflict is
+        # retried and the manager keeps running
+        time.sleep(1.0)
+        assert not mgr._stop.is_set()
+        server.create(api_object("Widget", "alive", "ns"))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                if server.get("Widget", "alive", "ns").get(
+                        "status", {}).get("phase") == "Ready":
+                    break
+            except NotFound:
+                pass
+            time.sleep(0.02)
+        assert server.get("Widget", "alive",
+                          "ns")["status"]["phase"] == "Ready"
+    finally:
+        mgr.stop()
+
+
+def test_genuine_lease_loss_stops_manager_cleanly(monkeypatch):
+    from kubeflow_tpu.core import controller as ctl
+
+    monkeypatch.setattr(ctl, "LEASE_TTL", 0.4)
+    server = APIServer()
+    mgr = Manager(server, leader_election=True, identity="node-a")
+    mgr.add(WidgetController(server))
+    mgr.start()
+    try:
+        # another identity steals the lease for real (fresh renewTime)
+        lease = server.get("Lease", "manager-leader", "kube-system")
+        lease["spec"].update(holder="node-b", renewTime=time.time() + 60,
+                             ttl=60.0)
+        server.update(lease)
+        deadline = time.monotonic() + 10
+        while not mgr._stop.is_set() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert mgr._stop.is_set(), "manager kept leading a lost lease"
+        # clean stop: every thread (except the renewer that called stop on
+        # itself, which exits right after) winds down
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and any(
+                t.is_alive() for t in mgr._threads):
+            time.sleep(0.05)
+        assert all(not t.is_alive() for t in mgr._threads)
+    finally:
+        mgr.stop()

@@ -831,3 +831,78 @@ def test_route_table_tracks_virtualservice_mutations():
                            "port": {"number": 80}}}]}]}})
     assert gw.match_route(server, "/a/ns1/x/p").dest_host == "x.ns1.svc"
     assert gw.match_route(server, "/b/ns1/x/q").dest_host == "x.ns1.svc"
+
+
+def test_connect_failed_backend_ejected_and_traffic_shifts():
+    """Outlier ejection: a backend whose connect fails is taken out of
+    rotation (with expiry + metric) so the NEXT request goes straight to a
+    healthy pod instead of re-paying the connect-retry budget against the
+    dead one while the controller replaces it."""
+    import io
+    import socket
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from kubeflow_tpu.core import APIServer, api_object
+
+    server = APIServer()
+    server.create(api_object("VirtualService", "app", "default", spec={
+        "http": [{"match": [{"uri": {"prefix": "/web/default/app/"}}],
+                  "rewrite": {"uri": "/"},
+                  "route": [{"destination": {"host": "app.default.svc",
+                                             "port": {"number": 80}}}]}]}))
+    server.create(api_object("Service", "app", "default", spec={
+        "selector": {"app": "web"},
+        "ports": [{"port": 80, "targetPort": 8080}]}))
+
+    class Quiet(BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    live = HTTPServer(("127.0.0.1", 0), Quiet)
+    threading.Thread(target=live.serve_forever, daemon=True).start()
+    with socket.socket() as s:  # a port with nothing listening
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+
+    def make_pod(name, port):
+        pod = api_object("Pod", name, "default", labels={"app": "web"},
+                         spec={"containers": [{"name": "c"}]})
+        server.create(pod)
+        server.patch_status("Pod", name, "default", {
+            "phase": "Running", "podIP": "127.0.0.1",
+            "portMap": {"8080": port}})
+
+    # list() orders by name: pod-a (dead) resolves first
+    make_pod("pod-a", dead_port)
+    make_pod("pod-b", live.server_address[1])
+
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01)
+
+    def call():
+        status = {}
+        environ = {"REQUEST_METHOD": "GET",
+                   "PATH_INFO": "/web/default/app/x",
+                   "wsgi.input": io.BytesIO(b"")}
+        body = b"".join(gateway(
+            environ, lambda s, h: status.update(code=s)))
+        return status["code"], body
+
+    try:
+        before = gw.EJECTIONS.get()
+        code, _ = call()
+        assert code.startswith("502")        # dead backend, retries spent
+        assert gw.EJECTIONS.get() == before + 1
+        code, body = call()                  # traffic shifted, no retries
+        assert code.startswith("200") and body == b"ok"
+        # expiry puts the backend back in rotation eventually
+        gateway.ejections._until.clear()
+        assert not gateway.ejections.contains("127.0.0.1", dead_port)
+    finally:
+        live.shutdown()
