@@ -37,6 +37,10 @@ class MetricsCollector:
         self._lock = threading.Lock()
         self._inflight: dict[Key, int] = {}
         self._held: dict[Key, int] = {}
+        # (host, port) -> live proxied streams: the reconciler's drain
+        # quiesce check (a scale-down victim is deleted only once its
+        # stream count reaches zero or the drain grace expires)
+        self._backend_inflight: dict[tuple, int] = {}
         # key -> stats fn returning a dict with "active"/"queued" counts
         self._sources: dict[Key, Callable[[], dict]] = {}
 
@@ -52,6 +56,25 @@ class MetricsCollector:
                 self._inflight[key] = n
             else:
                 self._inflight.pop(key, None)
+
+    # -- per-backend streams (drain quiesce) -----------------------------------
+    def inc_backend(self, addr: tuple) -> None:
+        with self._lock:
+            self._backend_inflight[addr] = \
+                self._backend_inflight.get(addr, 0) + 1
+
+    def dec_backend(self, addr: tuple) -> None:
+        with self._lock:
+            n = self._backend_inflight.get(addr, 0) - 1
+            if n > 0:
+                self._backend_inflight[addr] = n
+            else:
+                self._backend_inflight.pop(addr, None)
+
+    def backend_inflight(self, addr: tuple) -> int:
+        """Live proxied streams to one ``(host, port)`` backend."""
+        with self._lock:
+            return self._backend_inflight.get(addr, 0)
 
     # -- activator holds -------------------------------------------------------
     def hold(self, key: Key, limit: int) -> "_Hold":

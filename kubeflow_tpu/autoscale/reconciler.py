@@ -26,10 +26,20 @@ Opt-in + tuning via annotations on the InferenceService:
     autoscaling.kubeflow.org/scaleDownDelay    default 0 s
     autoscaling.kubeflow.org/initialScale      default 1
     autoscaling.kubeflow.org/tick              sample period s, default 1
+    autoscaling.kubeflow.org/drainGrace        default 30 s
+
+Drain-aware scale-down: before ``spec.replicas`` drops, the victim pods
+(the top ordinals — exactly the ones the Deployment controller deletes)
+are marked draining via the gateway (``serving.kubeflow.org/draining``),
+which takes them out of backend rotation immediately; the replicas patch
+is then DEFERRED until every victim's live proxied-stream count reaches
+zero (or ``drainGrace`` expires), so scale-down never kills a stream a
+client is still reading.
 """
 
 from __future__ import annotations
 
+import re
 import time
 
 from kubeflow_tpu.autoscale.decider import Decider, DeciderSpec, Decision
@@ -52,6 +62,9 @@ PARKED = REGISTRY.gauge("autoscaler_parked_replicas",
 PANIC = REGISTRY.gauge("autoscaler_panic_mode",
                        "1 while the revision is in panic scaling",
                        labels=("namespace", "name"))
+DRAINING = REGISTRY.gauge("autoscaler_draining_pods",
+                          "scale-down victims finishing in-flight streams",
+                          labels=("namespace", "name"))
 
 
 def autoscaling_enabled(isvc: dict) -> bool:
@@ -85,6 +98,18 @@ def spec_from(isvc: dict) -> DeciderSpec:
         initial_scale=max(num("initialScale", 1, int), 0),
         tick=max(num("tick", 1.0), 0.01),
     )
+
+
+def drain_grace(isvc: dict) -> float:
+    """Seconds a scale-down victim may keep live streams before the
+    replicas patch proceeds anyway (a wedged stream must not park the
+    scale-down forever)."""
+    raw = (isvc.get("metadata", {}).get("annotations") or {}) \
+        .get(ANNO_PREFIX + "drainGrace")
+    try:
+        return max(0.0, float(raw))
+    except (TypeError, ValueError):
+        return 30.0
 
 
 def initial_replicas(isvc: dict) -> int:
@@ -124,6 +149,9 @@ class Autoscaler(Controller):
         # off-cadence, and the window average is a mean over sample
         # COUNT — unthrottled event samples would skew it toward bursts
         self._last_sample: dict[tuple, float] = {}
+        # (namespace, pod-name) -> clock() when its drain mark was set;
+        # the scale-down patch waits on these until quiesce or grace
+        self._drain_started: dict[tuple, float] = {}
 
     def reconcile(self, req: Request) -> Result | None:
         try:
@@ -161,9 +189,28 @@ class Autoscaler(Controller):
         decision = decider.desired(now, ready)
         applied, parked = self._quota_clamp(isvc, req.namespace,
                                             current, decision.desired)
-        if applied != current:
-            self._patch_replicas(dep, applied)
-        self._mirror(isvc, decision, applied, parked, concurrency)
+        draining = 0
+        if applied < current:
+            # drain-aware scale-down: victims leave rotation FIRST; the
+            # replicas patch (which deletes their pods) waits for their
+            # live streams to finish — up to the drain grace
+            waiting = self._drain_scale_down(isvc, req, current, applied,
+                                             now)
+            if waiting:
+                draining = len(self._drain_keys(req))
+            else:
+                self._patch_replicas(dep, applied)
+                for key in self._drain_keys(req):
+                    self._drain_started.pop(key, None)
+        else:
+            if applied > current:
+                self._patch_replicas(dep, applied)
+            # a pending scale-down was re-decided upward: victims return
+            # to rotation
+            self._undrain(req)
+        DRAINING.labels(req.namespace, req.name).set(draining)
+        self._mirror(isvc, decision, applied, parked, concurrency,
+                     draining)
         return Result(requeue_after=spec.tick)
 
     # -- pieces ----------------------------------------------------------------
@@ -197,6 +244,73 @@ class Autoscaler(Controller):
                 return n, desired - n
         return current, desired - current
 
+    def _drain_scale_down(self, isvc: dict, req: Request, current: int,
+                          applied: int, now: float) -> bool:
+        """Mark the scale-down victims — pods ``{name}-{i}`` for
+        ``i in [applied, current)``, exactly the ordinals the Deployment
+        controller deletes when replicas drop — draining via the gateway,
+        and return True while the replicas patch must wait (some victim
+        still carries live proxied streams inside its drain grace)."""
+        from kubeflow_tpu import gateway as gw
+
+        grace = drain_grace(isvc)
+        waiting = False
+        # a shallower re-decision (desired rose while the drain was
+        # pending) shrinks the victim range: ex-victims return to
+        # rotation NOW, or they'd keep the draining mark forever as
+        # live-but-unroutable replicas
+        victims = {f"{req.name}-{i}" for i in range(applied, current)}
+        for ns, pod_name in self._drain_keys(req):
+            if pod_name not in victims:
+                gw.mark_draining(self.server, pod_name, ns,
+                                 draining=False)
+                self._drain_started.pop((ns, pod_name), None)
+        for i in range(applied, current):
+            pod_name = f"{req.name}-{i}"
+            dkey = (req.namespace, pod_name)
+            try:
+                pod = self.server.get("Pod", pod_name, req.namespace)
+            except NotFound:
+                # never materialized (or already gone): nothing to drain
+                self._drain_started.pop(dkey, None)
+                continue
+            if not gw.pod_draining(pod):
+                if not gw.mark_draining(self.server, pod_name,
+                                        req.namespace):
+                    # the mark didn't land (conflict storm / pod raced
+                    # away): deleting an unmarked pod would kill streams
+                    # the gateway is still routing to it — hold the patch
+                    # and retry the mark next tick
+                    waiting = True
+                    continue
+            started = self._drain_started.setdefault(dkey, now)
+            if now - started >= grace:
+                continue  # grace spent: delete even with a wedged stream
+            if self._pod_streams(pod) > 0:
+                waiting = True
+        return waiting
+
+    def _pod_streams(self, pod: dict) -> int:
+        """Live gateway streams into this pod, summed over its ports."""
+        st = pod.get("status", {})
+        ip = st.get("podIP", "127.0.0.1")
+        return sum(self.collector.backend_inflight((ip, int(hp)))
+                   for hp in (st.get("portMap") or {}).values())
+
+    def _drain_keys(self, req: Request) -> list[tuple]:
+        # exact ordinal match ({name}-{i}), not a name prefix: service
+        # "m" must not claim the drain state of a sibling "m-foo"
+        pat = re.compile(re.escape(req.name) + r"-\d+\Z")
+        return [k for k in self._drain_started
+                if k[0] == req.namespace and pat.match(k[1])]
+
+    def _undrain(self, req: Request) -> None:
+        from kubeflow_tpu import gateway as gw
+
+        for ns, pod_name in self._drain_keys(req):
+            gw.mark_draining(self.server, pod_name, ns, draining=False)
+            self._drain_started.pop((ns, pod_name), None)
+
     def _patch_replicas(self, dep: dict, replicas: int) -> None:
         dep["spec"]["replicas"] = replicas
         try:
@@ -211,7 +325,8 @@ class Autoscaler(Controller):
     _EPHEMERAL_STATE = ("stableConcurrency", "panicConcurrency")
 
     def _mirror(self, isvc: dict, decision: Decision, applied: int,
-                parked: int, concurrency: float) -> None:
+                parked: int, concurrency: float,
+                draining: int = 0) -> None:
         ns = isvc["metadata"]["namespace"]
         name = isvc["metadata"]["name"]
         state = {
@@ -219,6 +334,7 @@ class Autoscaler(Controller):
             "appliedReplicas": applied,
             "parked": parked,
             "panic": decision.panic,
+            "draining": draining,
             "stableConcurrency": round(decision.stable_concurrency, 2),
             "panicConcurrency": round(decision.panic_concurrency, 2),
         }
@@ -249,6 +365,10 @@ class Autoscaler(Controller):
                     if k[0] == ns and k[1] == name]:
             del self._deciders[key]
             self._last_sample.pop(key, None)  # else it leaks per dkey
+        pat = re.compile(re.escape(name) + r"-\d+\Z")
+        for key in [k for k in self._drain_started
+                    if k[0] == ns and pat.match(k[1])]:
+            del self._drain_started[key]
 
 
 def register(server, mgr) -> None:

@@ -108,15 +108,19 @@ class ChaosInjector:
       heartbeat stops, so ONLY heartbeat staleness can reveal the loss;
     - :meth:`preempt_slices` / :meth:`restore_slices` — the cloud takes
       slices away: bumps ``TpuSlicePool.spec.unavailable`` so the
-      SlicePreemptionController evicts the youngest released gang(s).
+      SlicePreemptionController evicts the youngest released gang(s);
+    - :meth:`stall_decode` — the serving engine's next decode dispatch
+      wedges (the network-attached-TPU hiccup), the fault the overload
+      loadtest injects mid-storm to prove bounded admission holds.
 
     Targets the :class:`~kubeflow_tpu.controllers.executor.FakeExecutor`
     surface (``silence(name, uid)`` + ``heartbeat``); schedules live in
     the harness (loadtest/load_chaos.py) where they can be state-triggered
-    for determinism.
+    for determinism.  ``executor`` may be None when only store- or
+    engine-level faults are used (serving overload harness).
     """
 
-    def __init__(self, server: APIServer, executor, *, seed: int = 0):
+    def __init__(self, server: APIServer, executor=None, *, seed: int = 0):
         self.server = server
         self.executor = executor
         self.rng = random.Random(seed)
@@ -176,6 +180,16 @@ class ChaosInjector:
         incarnations stay dead — their processes died with the machine."""
         self.resume_heartbeat()
         log.info("chaos: node recovered", node=self.executor.node_name)
+
+    # -- serving faults --------------------------------------------------------
+    def stall_decode(self, engine, seconds: float = 0.25) -> None:
+        """Wedge the serving engine's next decode dispatch for ``seconds``
+        — host-side scheduling keeps running while device work stalls,
+        exactly the shape a TPU-tunnel hiccup produces.  One-shot: the
+        dispatch after the stalled one runs normally."""
+        engine.chaos_stall(seconds)
+        CHAOS_FAULTS.labels("decode_stall").inc()
+        log.info("chaos: decode stall injected", seconds=seconds)
 
     # -- slice faults ----------------------------------------------------------
     def preempt_slices(self, topology: str, count: int = 1) -> None:

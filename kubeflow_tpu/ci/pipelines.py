@@ -118,7 +118,9 @@ COMPONENTS: dict[str, dict[str, Any]] = {
     "serving": {
         "include_dirs": ["kubeflow_tpu/serving/*",
                          "kubeflow_tpu/api/inferenceservice.py",
-                         "kubeflow_tpu/controllers/inferenceservice.py"],
+                         "kubeflow_tpu/controllers/inferenceservice.py",
+                         "loadtest/load_serving.py",
+                         "loadtest/load_overload.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
                      "tests/test_serving.py", "tests/test_serving_engine.py",
                      "tests/test_prefix_cache.py", "tests/test_quant.py"],
@@ -127,6 +129,12 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         # real engine traffic (KF_SKIP_SMOKE=1 opts out)
         "smoke_cmd": [sys.executable, "loadtest/load_serving.py",
                       "--smoke"],
+        # 4x-capacity overload storm with a decode-stall fault: asserts
+        # bounded admitted-TTFT, sub-second sheds with Retry-After, and
+        # zero leaked slots/KV/prefix-pins after the storm
+        # (KF_SKIP_OVERLOAD=1 opts out, mirroring the chaos smoke)
+        "overload_cmd": [sys.executable, "loadtest/load_overload.py",
+                         "--smoke"],
         "image": "images/predictor",
     },
     "autoscale": {
@@ -181,6 +189,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "chaos_cmd" in spec:
         steps.append({"name": "chaos", "run": spec["chaos_cmd"],
                       "depends": ["test"]})
+    if "overload_cmd" in spec:
+        steps.append({"name": "overload", "run": spec["overload_cmd"],
+                      "depends": ["test"]})
     if spec.get("image"):
         # kaniko executor (the reference's builder): --no-push is the
         # presubmit mode (ci/notebook_servers pattern)
@@ -216,6 +227,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "chaos_cmd" in spec
                 and os.environ.get("KF_SKIP_CHAOS") != "1"):
             ok = subprocess.run(spec["chaos_cmd"]).returncode == 0
+        if (ok and "overload_cmd" in spec
+                and os.environ.get("KF_SKIP_OVERLOAD") != "1"):
+            ok = subprocess.run(spec["overload_cmd"]).returncode == 0
         results[name] = ok
     return results
 

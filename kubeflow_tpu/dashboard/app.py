@@ -70,6 +70,8 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/metrics/<mtype>", self.metrics_route)
         self.add_route("GET", "/api/autoscale/<ns>", self.autoscale_route)
         self.add_route("GET", "/api/serving-cache", self.serving_cache_route)
+        self.add_route("GET", "/api/serving-health",
+                       self.serving_health_route)
         self.add_route("GET", "/api/nodes", self.nodes_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
@@ -134,6 +136,12 @@ class DashboardApp(CrudApp):
         """Serving-engine prefix-cache standing (hit rate, cached bytes,
         evictions) + TTFT p50/p99 from the promoted histogram."""
         return "200 OK", self.metrics.get_serving_cache_state()
+
+    def serving_health_route(self, req: Request):
+        """Serving overload standing (the robustness card): request
+        outcomes by ok/shed/cancelled/deadline_exceeded, admission-wait
+        percentiles, gateway shed relays, queue depth, drain state."""
+        return "200 OK", self.metrics.get_serving_health()
 
     def nodes_route(self, req: Request):
         """Node heartbeat standing + failure-recovery counters (pods lost

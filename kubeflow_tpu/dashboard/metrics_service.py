@@ -72,6 +72,34 @@ def serving_cache_state() -> dict:
     }
 
 
+def serving_health_state() -> dict:
+    """Overload/robustness standing of the serving path in this process
+    (the serving-cache card's sibling): request outcomes split by ok /
+    shed / cancelled / deadline_exceeded, admission-wait percentiles from
+    the bounded-admission histogram, gateway shed relays, live queue
+    depth, and whether any engine is draining."""
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    def val(name: str) -> float:
+        m = REGISTRY.get_metric(name)
+        return m.get() if m is not None else 0.0
+
+    reqs = REGISTRY.get_metric("serving_requests_total")
+    outcomes = ("ok", "shed", "cancelled", "deadline_exceeded", "error",
+                "shutdown")
+    wait = REGISTRY.get_metric("serving_admission_wait_seconds")
+    return {
+        "requests": {o: (reqs.get(o) if reqs is not None else 0.0)
+                     for o in outcomes},
+        "admission_wait_p50_s": wait.percentile(50) if wait else 0.0,
+        "admission_wait_p99_s": wait.percentile(99) if wait else 0.0,
+        "gateway_shed": val("gateway_shed_responses_total"),
+        "queue_depth": val("serving_queue_depth"),
+        "active": val("serving_active_requests"),
+        "draining": bool(val("serving_draining")),
+    }
+
+
 def cluster_health(server) -> dict:
     """Node heartbeat standing + failure-recovery counters (the
     robustness card): per-node heartbeat age/readiness straight from the
@@ -123,6 +151,8 @@ class MetricsService(Protocol):
 
     def get_serving_cache_state(self) -> dict: ...
 
+    def get_serving_health(self) -> dict: ...
+
     def get_cluster_health(self) -> dict: ...
 
 
@@ -173,6 +203,9 @@ class LocalMetricsService:
 
     def get_serving_cache_state(self) -> dict:
         return serving_cache_state()
+
+    def get_serving_health(self) -> dict:
+        return serving_health_state()
 
     def get_cluster_health(self) -> dict:
         return cluster_health(self.server)
@@ -235,6 +268,9 @@ class CloudMonitoringMetricsService:
     def get_serving_cache_state(self):
         # serving counters live in the process-local registry either way
         return serving_cache_state()
+
+    def get_serving_health(self):
+        return serving_health_state()
 
     def get_cluster_health(self):
         # node heartbeats live in the platform's own store, like the

@@ -71,8 +71,18 @@ DENIED = REGISTRY.counter("gateway_denied_total",
 EJECTIONS = REGISTRY.counter(
     "gateway_backend_ejections_total",
     "backends temporarily ejected from rotation after connect failures")
+SHED = REGISTRY.counter(
+    "gateway_shed_responses_total",
+    "backend load-shed responses (429 / busy-503 with Retry-After) "
+    "relayed — healthy-busy, never an ejection")
 
 log = get_logger("gateway")
+
+# a pod carrying this annotation is DRAINING: it finishes its in-flight
+# streams but gets no new traffic — the autoscale reconciler marks the
+# scale-down victim before patching replicas, and a SIGTERM'd predictor
+# flips its own readiness the same way
+DRAINING_ANNOTATION = "serving.kubeflow.org/draining"
 
 # the mesh identity header, wire-format (profile.py/kfam write policies
 # keyed on exactly this name)
@@ -87,6 +97,44 @@ HOP_BY_HOP = {"connection", "keep-alive", "proxy-authenticate",
 
 class NoBackend(RuntimeError):
     """A VirtualService matched but no live pod backs its destination."""
+
+
+def pod_draining(pod: dict) -> bool:
+    return (pod.get("metadata", {}).get("annotations") or {}) \
+        .get(DRAINING_ANNOTATION) == "true"
+
+
+def mark_draining(server: APIServer, name: str, namespace: str | None,
+                  draining: bool = True) -> bool:
+    """Flip the drain mark on a pod (Conflict-safe read-modify-write).
+    Backend resolution skips draining pods, so marking the victim BEFORE
+    a scale-down's replicas patch means its deletion kills no live
+    stream.  Returns False when the pod does not exist."""
+    from kubeflow_tpu.core.store import Conflict
+
+    for _ in range(10):
+        try:
+            pod = server.get("Pod", name, namespace)
+        except NotFound:
+            return False
+        annos = dict(pod["metadata"].get("annotations") or {})
+        if draining:
+            if annos.get(DRAINING_ANNOTATION) == "true":
+                return True
+            annos[DRAINING_ANNOTATION] = "true"
+        else:
+            if DRAINING_ANNOTATION not in annos:
+                return True
+            annos.pop(DRAINING_ANNOTATION, None)
+        pod["metadata"]["annotations"] = annos
+        try:
+            server.update(pod)
+            return True
+        except Conflict:
+            continue
+        except NotFound:
+            return False
+    return False
 
 
 class EjectionList:
@@ -173,13 +221,19 @@ def _scale_key(route: Route) -> tuple | None:
     return (route.dest_namespace, svc) if svc else None
 
 
-def _counted(result, collector, key):
-    """Wrap a WSGI response iterable so the in-flight count drops only
-    when the body is fully streamed (or the client goes away)."""
+def _counted(result, collector, key, addr_ref=None):
+    """Wrap a WSGI response iterable so the in-flight counts (revision
+    concurrency and per-backend stream count) drop only when the body is
+    fully streamed (or the client goes away).  ``addr_ref`` is a one-slot
+    list because a shed response may re-dispatch to a sibling backend
+    before any byte streams — the proxy updates the slot in place."""
     try:
         yield from result
     finally:
-        collector.dec(key)
+        if key is not None:
+            collector.dec(key)
+        if addr_ref is not None:
+            collector.dec_backend(addr_ref[0])
 
 
 def _prefix_owned(prefix: str, vs_namespace: str | None) -> bool:
@@ -332,7 +386,12 @@ def resolve_backend(server: APIServer, path: str) -> Backend | None:
 
 
 def backend_for_route(server: APIServer, route: Route, path: str,
-                      ejected: EjectionList | None = None) -> Backend:
+                      ejected: EjectionList | None = None,
+                      exclude: set | None = None) -> Backend:
+    """Resolve a live backend for ``route``.  DRAINING pods never
+    participate (they are finishing in-flight streams — a scale-down
+    victim or a SIGTERM'd predictor); ``exclude`` skips specific
+    ``(host, port)`` addresses (the shed-retry path trying a sibling)."""
     parts = route.dest_host.split(".")
     if len(parts) < 2:
         raise NoBackend(f"unresolvable destination {route.dest_host!r}")
@@ -356,6 +415,10 @@ def backend_for_route(server: APIServer, route: Route, path: str,
         status = pod.get("status", {})
         if status.get("phase") != "Running":
             continue
+        if pod_draining(pod):
+            # out of rotation for good, not as a fallback: routing a new
+            # stream here would die with the pod moments later
+            continue
         host_port = (status.get("portMap") or {}).get(str(target_port))
         if host_port is None:
             continue
@@ -364,6 +427,8 @@ def backend_for_route(server: APIServer, route: Route, path: str,
                           path=route.rewritten(path),
                           set_headers=route.set_headers,
                           timeout_s=route.timeout_s)
+        if exclude and (backend.host, backend.port) in exclude:
+            continue
         if ejected is not None and ejected.contains(backend.host,
                                                     backend.port):
             # out of rotation after a connect failure — but keep it as a
@@ -394,6 +459,19 @@ def _request_headers(environ: dict, backend: Backend) -> dict:
     if environ.get("REMOTE_ADDR"):
         headers["X-Forwarded-For"] = environ["REMOTE_ADDR"]
     headers["X-Forwarded-Proto"] = environ.get("wsgi.url_scheme", "http")
+    # deadline propagation: a client-sent X-Request-Deadline rides through
+    # (clamped to the route timeout); otherwise the route's timeout IS the
+    # deadline — so the serving engine can evict work for callers whose
+    # proxy deadline already passed instead of decoding into the void
+    try:
+        client_deadline = float(headers.get("X-Request-Deadline", ""))
+    except ValueError:
+        client_deadline = None
+    if client_deadline is not None and client_deadline > 0:
+        headers["X-Request-Deadline"] = str(
+            min(client_deadline, backend.timeout_s))
+    else:
+        headers["X-Request-Deadline"] = str(backend.timeout_s)
     headers.update(backend.set_headers)
     return headers
 
@@ -695,22 +773,34 @@ class Gateway:
             backend = self._activate(route, path)
             if backend is None:
                 PROXIED.labels("503").inc()
+                # Retry-After marks this shed-not-dead for clients and
+                # upstream balancers (drain and activator-overflow 503s
+                # resolve within seconds, not never)
                 start_response("503 Service Unavailable",
-                               [("Content-Type", "text/plain")])
+                               [("Content-Type", "text/plain"),
+                                ("Retry-After", "1")])
                 return [f"no backend: {e}\n".encode()]
-        key = _scale_key(route) if self.collector is not None else None
-        if key is None:
-            return self._proxy(backend, environ, start_response)
+        if self.collector is None:
+            return self._proxy(backend, environ, start_response, route)
         # count the request in-flight for the autoscaler's concurrency
-        # view: incremented before the upstream connect, released when the
+        # view — and per BACKEND for the reconciler's drain quiesce check
+        # (scale-down waits for the victim's stream count to hit zero):
+        # incremented before the upstream connect, released when the
         # response stream is fully delivered (or the proxy errors out)
-        self.collector.inc(key)
+        key = _scale_key(route)
+        addr_ref = [(backend.host, backend.port)]
+        self.collector.inc_backend(addr_ref[0])
+        if key is not None:
+            self.collector.inc(key)
         try:
-            result = self._proxy(backend, environ, start_response)
+            result = self._proxy(backend, environ, start_response, route,
+                                 addr_ref)
         except BaseException:
-            self.collector.dec(key)
+            if key is not None:
+                self.collector.dec(key)
+            self.collector.dec_backend(addr_ref[0])
             raise
-        return _counted(result, self.collector, key)
+        return _counted(result, self.collector, key, addr_ref)
 
     def _activate(self, route: Route, path: str):
         """Scale-from-zero: hold the request while the activator brings up
@@ -728,39 +818,18 @@ class Gateway:
                         error=str(e))
             return None
 
-    def _proxy(self, backend: Backend, environ, start_response):
-        method = environ["REQUEST_METHOD"]
-        url = backend.path
-        qs = environ.get("QUERY_STRING")
-        if qs:
-            url += "?" + qs
-        headers = _request_headers(environ, backend)
-        try:
-            length = int(environ.get("CONTENT_LENGTH") or 0)
-        except ValueError:
-            length = 0
-        headers["Content-Length"] = str(length)
-        # small bodies buffer whole so they survive connect retries (the
-        # first click after "ready" is usually a POST hitting the pod's
-        # bind-race window); only large uploads stream unbuffered and
-        # forfeit the retry
-        if 0 < length <= self.BUFFER_BODY_MAX:
-            body: object = environ["wsgi.input"].read(length)
-            retriable = True
-        else:
-            body = (_body_chunks(environ["wsgi.input"], length)
-                    if length else b"")
-            retriable = length == 0
-
-        conn = None
-        resp = None
+    def _fetch(self, backend: Backend, method, url, headers, body,
+               retriable, idempotent):
+        """The connect/retry loop against ONE backend.  Returns
+        ``(conn, resp, None)`` on an answered request or
+        ``(None, None, error_bytes)`` after spending the retry budget
+        (the backend is ejected on the way out)."""
         force_fresh = False
         # pooled keep-alive connections carry a replay hazard: a pod that
         # dies after committing but before responding makes the send look
         # stale-connection-shaped, and re-sending would execute the
         # operation twice.  Envoy/urllib3 draw the same line: only
         # idempotent methods ride (and retry on) reused connections.
-        idempotent = method in ("GET", "HEAD", "OPTIONS")
         for attempt in range(self.connect_retries):
             # fresh connection when: a pooled one just went stale
             # (force_fresh), the method could replay a side effect
@@ -776,18 +845,14 @@ class Gateway:
                                              backend.timeout_s)
             try:
                 conn.request(method, url, body=body, headers=headers)
-                resp = conn.getresponse()
-                break
+                return conn, conn.getresponse(), None
             except ConnectionRefusedError:
                 conn.close()
                 # a streamed (unbuffered) body may be partially consumed
                 # and cannot be replayed
                 if attempt + 1 == self.connect_retries or not retriable:
                     self.ejections.eject(backend.host, backend.port)
-                    PROXIED.labels("502").inc()
-                    start_response("502 Bad Gateway",
-                                   [("Content-Type", "text/plain")])
-                    return [b"backend connection refused\n"]
+                    return None, None, b"backend connection refused\n"
                 time.sleep(self.retry_delay)
             except (OSError, http.client.HTTPException) as e:
                 conn.close()
@@ -798,18 +863,92 @@ class Gateway:
                     force_fresh = True
                     continue
                 self.ejections.eject(backend.host, backend.port)
+                return None, None, f"backend error: {e}\n".encode()
+        return None, None, b"backend unavailable\n"
+
+    def _finish_conn(self, backend: Backend, conn, resp) -> None:
+        """Drain and pool/close a response the client will never see
+        (the shed-retry path abandoning a 429 for a sibling backend)."""
+        try:
+            resp.read()
+        except (OSError, http.client.HTTPException):
+            conn.close()
+            return
+        if resp.isclosed() and not resp.will_close:
+            self.pool.put(backend.host, backend.port, conn)
+        else:
+            conn.close()
+
+    def _proxy(self, backend: Backend, environ, start_response,
+               route: Route | None = None, addr_ref: list | None = None):
+        method = environ["REQUEST_METHOD"]
+        qs = environ.get("QUERY_STRING")
+        try:
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            length = 0
+        # small bodies buffer whole so they survive connect retries (the
+        # first click after "ready" is usually a POST hitting the pod's
+        # bind-race window); only large uploads stream unbuffered and
+        # forfeit the retry
+        if 0 < length <= self.BUFFER_BODY_MAX:
+            body: object = environ["wsgi.input"].read(length)
+            retriable = True
+        else:
+            body = (_body_chunks(environ["wsgi.input"], length)
+                    if length else b"")
+            retriable = length == 0
+        idempotent = method in ("GET", "HEAD", "OPTIONS")
+
+        tried: set[tuple] = set()
+        while True:
+            url = backend.path + ("?" + qs if qs else "")
+            headers = _request_headers(environ, backend)
+            headers["Content-Length"] = str(length)
+            conn, resp, err = self._fetch(backend, method, url, headers,
+                                          body, retriable, idempotent)
+            if err is not None:
                 PROXIED.labels("502").inc()
                 start_response("502 Bad Gateway",
                                [("Content-Type", "text/plain")])
-                return [f"backend error: {e}\n".encode()]
-        if resp is None:  # loop exhausted without a response
-            PROXIED.labels("502").inc()
-            start_response("502 Bad Gateway",
-                           [("Content-Type", "text/plain")])
-            return [b"backend unavailable\n"]
-        # the backend answered: if it was serving as an ejected-fallback
-        # (or just recovered), put it back in rotation early
-        self.ejections.clear(backend.host, backend.port)
+                return [err]
+            # the backend answered: if it was serving as an ejected-
+            # fallback (or just recovered), put it back in rotation early
+            self.ejections.clear(backend.host, backend.port)
+            retry_after = resp.getheader("Retry-After")
+            shed = resp.status == 429 or (resp.status == 503
+                                          and retry_after is not None)
+            if not shed:
+                break
+            # load shed is healthy-busy, NOT an outlier: no EjectionList
+            # entry (ejecting a busy pod under overload collapses the
+            # whole revision), counted separately from failures
+            SHED.inc()
+            alt = None
+            if retriable and route is not None and not tried:
+                # a SIBLING pod may have queue room — re-dispatch is safe
+                # here and ONLY here: the shed response proves the backend
+                # executed nothing, the buffered body replays, and no
+                # response byte has been streamed to the client yet
+                # (start_response is still unfired); once a body streams,
+                # a re-dispatch would interleave two responses
+                tried.add((backend.host, backend.port))
+                try:
+                    alt = backend_for_route(self.server, route,
+                                            environ.get("PATH_INFO", "/"),
+                                            self.ejections, exclude=tried)
+                except NoBackend:
+                    alt = None
+            if alt is None:
+                break  # relay the shed response, Retry-After intact
+            self._finish_conn(backend, conn, resp)
+            backend = alt
+            if addr_ref is not None and self.collector is not None:
+                # keep the per-backend stream accounting on the pod that
+                # actually serves the response
+                self.collector.dec_backend(addr_ref[0])
+                addr_ref[0] = (backend.host, backend.port)
+                self.collector.inc_backend(addr_ref[0])
 
         out_headers = [(k, v) for k, v in resp.getheaders()
                        if k.lower() not in HOP_BY_HOP]

@@ -60,9 +60,38 @@ PREFIX_HITS = REGISTRY.counter(
 PREFIX_MISSES = REGISTRY.counter(
     "serving_prefix_cache_misses_total",
     "admissions that found no usable cached prefix")
+ADMISSION_WAIT = REGISTRY.histogram(
+    "serving_admission_wait_seconds",
+    "queue wait from submit() to slot admission",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
+DRAINING_GAUGE = REGISTRY.gauge(
+    "serving_draining",
+    "engines currently draining (in-flight finish, new submits rejected)")
 
 PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
 DECODE_CHUNKS = (8, 16, 32, 64, 128)
+
+
+class QueueFull(RuntimeError):
+    """Bounded admission shed: the queue is full (or the caller's deadline
+    cannot survive the estimated queue wait).  ``retry_after`` is the
+    engine's wait estimate — the predictor surfaces it as a ``Retry-After``
+    header so clients and load balancers back off instead of piling on."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = max(0.1, retry_after)
+
+
+class Draining(RuntimeError):
+    """The engine is draining: in-flight requests finish, new ones are
+    rejected (readiness has already flipped at the predictor)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before generation completed; the
+    engine evicted it and freed its slot."""
 
 
 @dataclass
@@ -74,16 +103,42 @@ class GenRequest:
     seed: int = 0
     top_k: int = 0        # 0 = disabled
     top_p: float = 0.0    # 0 or >= 1 = disabled
+    deadline: float | None = None   # absolute perf_counter() deadline
     submitted_at: float = field(default_factory=time.perf_counter)
+    admitted_at: float | None = None
     first_token_at: float | None = None
     generated: list[int] = field(default_factory=list)
     _done: threading.Event = field(default_factory=threading.Event)
     error: str | None = None
+    outcome: str | None = None      # terminal serving_requests_total label
+    _cancel_requested: bool = False
+    _engine: object | None = field(default=None, repr=False)
+
+    def expired(self, now: float | None = None) -> bool:
+        return (self.deadline is not None
+                and (time.perf_counter() if now is None else now)
+                >= self.deadline)
+
+    def cancel(self, reason: str = "cancelled by caller") -> None:
+        """Ask the engine to evict this request (queued or mid-decode).
+        Idempotent; a no-op once the request is done.  The slot, its KV
+        row, and any queue entry free within one decode chunk."""
+        self._cancel_requested = True
+        eng = self._engine
+        if eng is not None and not self._done.is_set():
+            with eng._work:
+                eng._work.notify_all()
 
     def result(self, timeout: float = 300.0) -> list[int]:
         if not self._done.wait(timeout):
+            # the waiter is abandoning the request: cancel it so the slot
+            # is reclaimed within one decode chunk instead of decoding all
+            # the way to max_new_tokens for a reader that left
+            self.cancel("result() waiter timed out")
             raise TimeoutError("generation did not complete in time")
         if self.error:
+            if self.outcome == "deadline_exceeded":
+                raise DeadlineExceeded(self.error)
             raise ValueError(self.error)
         return self.ids + self.generated
 
@@ -93,7 +148,8 @@ class ContinuousBatcher:
 
     def __init__(self, module, params, cfg, *, max_batch: int = 4,
                  max_seq: int = 512, mesh=None,
-                 prefix_cache_bytes: int = 0, prefill_chunk: int = 512):
+                 prefix_cache_bytes: int = 0, prefill_chunk: int = 512,
+                 max_queue: int = 0):
         from kubeflow_tpu.models import llama as llama_mod
 
         self.module = module
@@ -137,11 +193,22 @@ class ContinuousBatcher:
         self.keys = jnp.zeros((max_batch, 2), jnp.uint32)
         self.slots: list[GenRequest | None] = [None] * max_batch
         self.queue: list[GenRequest] = []
+        # bounded admission: > max_queue waiters means the newest arrival
+        # would wait longer than any client will — shed it instead (0 =
+        # unbounded, the pre-overload behavior)
+        self.max_queue = max_queue
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._auto_seed = 0
         self._stop = False
         self._closed = False  # terminal: submit() rejects until restart()
+        self._draining = False  # in-flight finish; new submits rejected
+        # EWMA of request service time (admission -> done) feeding the
+        # estimated-wait admission check and Retry-After hints
+        self._service_ewma = 0.0
+        # chaos hook (chaos/injector.py stall_decode): the next decode
+        # dispatch sleeps this long first — a wedged-TPU-tunnel fault
+        self._chaos_stall_s = 0.0
         self._thread: threading.Thread | None = None
         self._prefill_cache: dict[int, object] = {}
         self._decode_cache: dict[tuple[int, bool], object] = {}
@@ -155,7 +222,8 @@ class ContinuousBatcher:
     def submit(self, ids: list[int], max_new_tokens: int = 32,
                temperature: float = 0.0, eos_id: int | None = None,
                seed: int | None = None, top_k: int = 0,
-               top_p: float = 0.0) -> GenRequest:
+               top_p: float = 0.0,
+               deadline_s: float | None = None) -> GenRequest:
         if len(ids) + max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt+new ({len(ids) + max_new_tokens}) > max_seq "
@@ -169,6 +237,8 @@ class ContinuousBatcher:
         if top_p >= 1.0:
             top_p = 0.0  # the full distribution: normalize to "disabled"
                          # so it doesn't force the filtered decode variant
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
         with self._work:
             # one critical section for the closed check, seed assignment,
             # enqueue, and thread (re)spawn: a concurrent shutdown() can
@@ -177,11 +247,33 @@ class ContinuousBatcher:
                 raise RuntimeError(
                     "serving engine is shut down (call restart() to serve "
                     "again)")
+            if self._draining:
+                raise Draining(
+                    "serving engine is draining (finishing in-flight "
+                    "requests, accepting no new ones)")
+            est_wait = self._estimated_wait_locked()
+            if self.max_queue and len(self.queue) >= self.max_queue:
+                REQS_TOTAL.labels("shed").inc()
+                raise QueueFull(
+                    f"admission queue full ({self.max_queue} waiting)",
+                    retry_after=est_wait)
+            if deadline_s is not None and est_wait >= deadline_s > 0:
+                # the deadline cannot survive the queue: shedding NOW is
+                # strictly better than burning a prefill on a request the
+                # deadline sweep will evict anyway
+                REQS_TOTAL.labels("shed").inc()
+                raise QueueFull(
+                    f"estimated queue wait {est_wait:.2f}s exceeds the "
+                    f"request deadline {deadline_s:.2f}s",
+                    retry_after=est_wait)
             if seed is None:
                 self._auto_seed += 1
                 seed = self._auto_seed
             req = GenRequest(list(ids), max_new_tokens, temperature, eos_id,
                              seed=seed, top_k=top_k, top_p=top_p)
+            if deadline_s is not None:
+                req.deadline = req.submitted_at + deadline_s
+            req._engine = self
             self.queue.append(req)
             QUEUE_DEPTH.set(len(self.queue))
             if self._thread is None or not self._thread.is_alive():
@@ -196,13 +288,24 @@ class ContinuousBatcher:
     def generate_sync(self, batch: list[list[int]], max_new_tokens: int = 32,
                       temperature: float = 0.0, eos_id: int | None = None,
                       seed: int | None = None, top_k: int = 0,
-                      top_p: float = 0.0) -> list[list[int]]:
-        """Submit a whole (possibly ragged) batch and wait for all rows."""
-        reqs = [self.submit(ids, max_new_tokens, temperature, eos_id,
-                            seed=None if seed is None else seed + i,
-                            top_k=top_k, top_p=top_p)
-                for i, ids in enumerate(batch)]
-        return [r.result() for r in reqs]
+                      top_p: float = 0.0,
+                      deadline_s: float | None = None) -> list[list[int]]:
+        """Submit a whole (possibly ragged) batch and wait for all rows.
+        All-or-nothing: if any row's submit is shed or any row fails,
+        the already-submitted siblings are cancelled — the caller gets
+        one error, so decoding for the survivors would serve nobody."""
+        reqs: list[GenRequest] = []
+        try:
+            for i, ids in enumerate(batch):
+                reqs.append(self.submit(
+                    ids, max_new_tokens, temperature, eos_id,
+                    seed=None if seed is None else seed + i,
+                    top_k=top_k, top_p=top_p, deadline_s=deadline_s))
+            return [r.result() for r in reqs]
+        except BaseException:
+            for r in reqs:
+                r.cancel("sibling row failed")
+            raise
 
     def stats(self) -> dict:
         """Point-in-time load snapshot for the autoscaler's metrics
@@ -215,9 +318,55 @@ class ContinuousBatcher:
                 "queued": len(self.queue),
                 "max_batch": self.max_batch,
             }
+            if self.max_queue:
+                out["max_queue"] = self.max_queue
+            if self._draining:
+                out["draining"] = True
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
         return out
+
+    def _estimated_wait_locked(self) -> float:
+        """Rough seconds until a NEW arrival would reach a slot: waiters
+        ahead over slot capacity, times the observed per-request service
+        time.  Zero until the first request completes (cold start never
+        sheds on an estimate)."""
+        if self._service_ewma <= 0.0:
+            return 0.0
+        waves = len(self.queue) / max(self.max_batch, 1)
+        return waves * self._service_ewma
+
+    def drain(self) -> None:
+        """Stop admitting: queued and in-flight requests run to completion,
+        new ``submit()`` calls raise :class:`Draining`.  The predictor
+        flips readiness the moment this is called; ``drained()`` reports
+        when the engine is idle.  ``restart()`` reopens."""
+        with self._work:
+            if not self._draining:
+                self._draining = True
+                # counts draining ENGINES (inc/dec on the transition, not
+                # set): several models share one process, and one
+                # engine's restart() must not erase a sibling's state
+                DRAINING_GAUGE.inc()
+            self._work.notify_all()
+
+    def drained(self, timeout: float = 60.0) -> bool:
+        """Block until no request is queued or decoding (or ``timeout``);
+        meaningful during drain but safe to call any time."""
+        deadline = time.monotonic() + timeout
+        with self._work:
+            while self.queue or any(s is not None for s in self.slots):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._work.wait(remaining)
+        return True
+
+    def chaos_stall(self, seconds: float) -> None:
+        """Chaos hook: wedge the next decode dispatch for ``seconds``
+        (the network-attached-TPU hiccup shape — host scheduling keeps
+        running, device work stalls)."""
+        self._chaos_stall_s = max(0.0, float(seconds))
 
     def shutdown(self) -> None:
         """Terminal: pending and in-flight requests fail, and any
@@ -227,15 +376,22 @@ class ContinuousBatcher:
         with self._work:
             self._closed = True
             self._stop = True
+            if self._draining:
+                # a shut-down engine no longer counts as draining
+                self._draining = False
+                DRAINING_GAUGE.inc(-1)
             self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
 
     def restart(self) -> None:
-        """Reopen a shut-down engine; the batcher thread respawns on the
-        next submit()."""
+        """Reopen a shut-down (or draining) engine; the batcher thread
+        respawns on the next submit()."""
         with self._work:
             self._closed = False
+            if self._draining:
+                self._draining = False
+                DRAINING_GAUGE.inc(-1)
 
     # -- compiled pieces -------------------------------------------------------
     def _prefill(self, bucket: int):
@@ -403,6 +559,70 @@ class ContinuousBatcher:
         return self._decode_cache[key]
 
     # -- the scheduling loop ---------------------------------------------------
+    def _fail(self, req: GenRequest, outcome: str, msg: str, *,
+              notify: bool = False) -> None:
+        """Terminal accounting for a request that will not complete.
+        ``notify`` wakes ``drained()`` waiters — pass it from call sites
+        that do NOT already hold ``_work`` (the lock is not reentrant)
+        and whose eviction may be the one that makes the engine idle."""
+        req.error = msg
+        req.outcome = outcome
+        REQS_TOTAL.labels(outcome).inc()
+        req._done.set()
+        if notify:
+            with self._work:
+                self._work.notify_all()
+
+    def _dead_outcome(self, req: GenRequest,
+                      now: float | None = None) -> str | None:
+        """Why this request must be evicted (None = it lives): explicit
+        cancellation wins over deadline expiry, shutdown over both."""
+        if self._stop:
+            return "shutdown"
+        if req._cancel_requested:
+            return "cancelled"
+        if req.expired(now):
+            return "deadline_exceeded"
+        return None
+
+    _DEAD_MSG = {
+        "shutdown": "serving engine shut down",
+        "cancelled": "request cancelled",
+        "deadline_exceeded": "request deadline exceeded",
+    }
+
+    def _sweep_dead(self) -> None:
+        """Evict cancelled and deadline-expired requests: queued ones
+        before they burn a prefill dispatch, slotted ones mid-decode.
+        Clearing the slot IS the resource release — the row's KV is
+        garbage the next admission overwrites, and prefix-cache pins are
+        only held across prefill (released by ``_run_prefill``)."""
+        now = time.perf_counter()
+        dead: list[tuple[GenRequest, str]] = []
+        with self._work:
+            live_q = []
+            for req in self.queue:
+                outcome = self._dead_outcome(req, now)
+                if outcome is None:
+                    live_q.append(req)
+                else:
+                    dead.append((req, outcome))
+            if len(live_q) != len(self.queue):
+                self.queue[:] = live_q
+                QUEUE_DEPTH.set(len(self.queue))
+            for i, req in enumerate(self.slots):
+                if req is None:
+                    continue
+                outcome = self._dead_outcome(req, now)
+                if outcome is not None:
+                    self.slots[i] = None
+                    dead.append((req, outcome))
+            if dead:
+                ACTIVE_SLOTS.set(sum(1 for s in self.slots if s))
+                self._work.notify_all()
+        for req, outcome in dead:
+            self._fail(req, outcome, self._DEAD_MSG[outcome])
+
     def _loop(self) -> None:
         try:
             while True:
@@ -414,12 +634,16 @@ class ContinuousBatcher:
                         # fail anything still pending so callers don't hang
                         for req in list(self.queue) + [s for s in self.slots
                                                        if s]:
-                            req.error = "serving engine shut down"
-                            REQS_TOTAL.labels("shutdown").inc()
-                            req._done.set()
+                            self._fail(req, "shutdown",
+                                       "serving engine shut down")
                         self.queue.clear()
                         self.slots = [None] * self.max_batch
+                        self._work.notify_all()
                         return
+                # cancelled/expired requests leave before admission (no
+                # wasted prefill) and between decode chunks (slot freed
+                # within one chunk of the cancel/deadline)
+                self._sweep_dead()
                 self._admit()
                 # queue state is re-read AFTER admission: requests that
                 # arrived or stayed queued while _admit ran must keep
@@ -433,12 +657,11 @@ class ContinuousBatcher:
             self.log.error("batcher loop crashed", exc_info=True)
             with self._work:
                 for req in list(self.queue) + [s for s in self.slots if s]:
-                    req.error = "serving engine crashed"
-                    REQS_TOTAL.labels("error").inc()
-                    req._done.set()
+                    self._fail(req, "error", "serving engine crashed")
                 self.queue.clear()
                 self.slots = [None] * self.max_batch
                 self._thread = None
+                self._work.notify_all()
 
     def _admit(self) -> None:
         """Prefill queued requests into free slots (continuous admission)."""
@@ -451,11 +674,26 @@ class ContinuousBatcher:
                     return
                 req = self.queue.pop(0)
                 QUEUE_DEPTH.set(len(self.queue))
+            outcome = self._dead_outcome(req)
+            if outcome is not None:   # died while queued; skip the prefill
+                self._fail(req, outcome, self._DEAD_MSG[outcome],
+                           notify=True)
+                continue
+            req.admitted_at = time.perf_counter()
+            ADMISSION_WAIT.observe(req.admitted_at - req.submitted_at)
             prompt_len = len(req.ids)
             # the request's own key chain starts at its seed
             k_first, k_chain = jax.random.split(
                 jax.random.PRNGKey(req.seed))
             tok, small_cache, fully_cached = self._run_prefill(req, k_first)
+            if tok is None:
+                # bailed out mid-chunked-prefill (cancel/deadline/stop):
+                # the pin was released in _run_prefill's finally, nothing
+                # was inserted, the slot stays free
+                outcome = self._dead_outcome(req) or "cancelled"
+                self._fail(req, outcome, self._DEAD_MSG[outcome],
+                           notify=True)
+                continue
             if self.prefix_cache is not None and not fully_cached:
                 # cache the WHOLE prompt's KV (RadixAttention discipline:
                 # insert everything, let LRU sort out what traffic shares),
@@ -465,6 +703,13 @@ class ContinuousBatcher:
                 snap = self._bucket_for(prompt_len)
                 self.prefix_cache.insert(
                     req.ids, self._snap(snap)(small_cache))
+            outcome = self._dead_outcome(req)
+            if outcome is not None:
+                # died during its own prefill: the prompt KV was still
+                # worth caching above, but the request takes no slot
+                self._fail(req, outcome, self._DEAD_MSG[outcome],
+                           notify=True)
+                continue
             self.cache = self._insert()(self.cache, small_cache,
                                         jnp.int32(free))
             tok_host = int(tok)
@@ -489,7 +734,9 @@ class ContinuousBatcher:
         """Run the prompt and sample the first token; returns
         ``(token, batch-1 kv tree, fully_cached)`` ready for slot
         insertion (``fully_cached``: the radix tree already holds the
-        whole prompt, so re-inserting it would be a wasted dispatch).
+        whole prompt, so re-inserting it would be a wasted dispatch), or
+        ``(None, None, False)`` when the request died (cancel, deadline,
+        shutdown) between prefill chunks — the pin is still released.
 
         Three shapes, all token-identical (the per-position KV and the
         last-position logits are bitwise independent of how the prompt is
@@ -532,6 +779,11 @@ class ContinuousBatcher:
                 small = self._zeros()()
             pos = usable
             while True:
+                if self._dead_outcome(req) is not None:
+                    # cancel/deadline/shutdown between prefill chunks: bail
+                    # before the next dispatch; the finally below releases
+                    # the pin, the caller skips seating the request
+                    return None, None, False
                 take = min(prompt_len - pos, self.prefill_chunk)
                 # pad the chunk up to a bucket, but never past max_seq:
                 # dynamic_update_slice CLAMPS an out-of-range start index,
@@ -565,11 +817,16 @@ class ContinuousBatcher:
             return
         # a waiting queue can only be admitted when a slot frees, and the
         # earliest that happens is min(remaining) steps away — so decode
-        # right up to that point in one dispatch.  The exception is eos
-        # traffic: a request may finish mid-chunk, so keep chunks small to
-        # re-check while someone is waiting.
-        eos_active = any(s.eos_id is not None for s in self.slots if s)
-        if not queue_empty and eos_active:
+        # right up to that point in one dispatch.  The exception is any
+        # slot that can free mid-chunk — eos traffic, a deadline that may
+        # expire, a cancel already requested — keep chunks small to
+        # re-check while someone is waiting (the sweep only runs between
+        # dispatches, so chunk length IS the eviction latency).
+        reclaim_active = any(
+            (s.eos_id is not None or s.deadline is not None
+             or s._cancel_requested)
+            for s in self.slots if s)
+        if not queue_empty and reclaim_active:
             chunk = DECODE_CHUNKS[0]
         else:
             # prefer ONE slightly-too-long dispatch over several short ones:
@@ -582,6 +839,11 @@ class ContinuousBatcher:
             else:
                 chunk = next((c for c in reversed(DECODE_CHUNKS)
                               if c <= mn), DECODE_CHUNKS[0])
+        stall = self._chaos_stall_s
+        if stall:
+            # injected decode-stall fault (chaos): the dispatch wedges once
+            self._chaos_stall_s = 0.0
+            time.sleep(stall)
         t0 = time.perf_counter()
         filtered = any(s is not None and (s.top_k or s.top_p)
                        for s in self.slots)
@@ -631,6 +893,18 @@ class ContinuousBatcher:
             with self._work:
                 self.slots[slot] = None
                 ACTIVE_SLOTS.set(sum(1 for s in self.slots if s))
+                # feed the estimated-wait admission check (EWMA of
+                # ADMISSION -> done: queue wait must stay out of it, or
+                # the wait estimate — waves x service time — would count
+                # the queue twice and over-shed exactly under overload);
+                # under the lock so drained() also wakes
+                dur = time.perf_counter() - (req.admitted_at
+                                             or req.submitted_at)
+                self._service_ewma = (dur if self._service_ewma <= 0.0
+                                      else 0.8 * self._service_ewma
+                                      + 0.2 * dur)
+                self._work.notify_all()
+            req.outcome = "ok"
             REQS_TOTAL.labels("ok").inc()
             req._done.set()
             return True

@@ -37,7 +37,8 @@ class GenerativePredictor:
                  max_batch: int = 4, max_seq: int = 512, seed: int = 0,
                  quantize: bool = False, fast_init: bool = False,
                  tp: int = 1, ep: int = 1,
-                 prefix_cache_mb: float = 0.0, prefill_chunk: int = 512):
+                 prefix_cache_mb: float = 0.0, prefill_chunk: int = 512,
+                 max_queue: int = 0):
         from kubeflow_tpu.models import registry
 
         self.log = get_logger("predictor", model=model_name, size=size)
@@ -124,13 +125,17 @@ class GenerativePredictor:
         # system prompts prefill once, later admissions copy the cached
         # block and prefill only their suffix (HBM budget in MB because
         # annotations/CLI carry human-sized numbers)
+        # max_queue > 0 bounds admission: over-limit submits raise
+        # QueueFull, which the HTTP layer turns into 429 + Retry-After
+        # (load shedding beats queue collapse under sustained overload)
         self.engine = ContinuousBatcher(self.module, self.params, self.cfg,
                                         max_batch=max_batch,
                                         max_seq=self.max_seq,
                                         mesh=self.mesh,
                                         prefix_cache_bytes=int(
                                             prefix_cache_mb * (1 << 20)),
-                                        prefill_chunk=prefill_chunk)
+                                        prefill_chunk=prefill_chunk,
+                                        max_queue=max_queue)
         self.log.info("predictor ready",
                       params=sum(x.size for x in
                                  jax.tree_util.tree_leaves(self.params)))
@@ -149,17 +154,22 @@ class GenerativePredictor:
     def generate(self, ids: list[list[int]], max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0,
                  eos_id: int | None = None, top_k: int = 0,
-                 top_p: float = 0.0) -> dict:
+                 top_p: float = 0.0,
+                 deadline_s: float | None = None) -> dict:
         """Generate continuations for a (possibly RAGGED) batch of prompts.
 
         Routed through the continuous-batching engine: each prompt becomes a
         request sharing decode iterations with any other in-flight traffic;
         concurrent HTTP callers batch together automatically.
+        ``deadline_s`` (from X-Request-Deadline or the route timeout) rides
+        into every GenRequest: an expired request is evicted mid-decode and
+        its slot freed instead of decoding for a client that gave up.
         """
         t0 = time.perf_counter()
         out_ids = self.engine.generate_sync(
             ids, max_new_tokens=max_new_tokens, temperature=temperature,
-            eos_id=eos_id, seed=seed, top_k=top_k, top_p=top_p)
+            eos_id=eos_id, seed=seed, top_k=top_k, top_p=top_p,
+            deadline_s=deadline_s)
         dt = time.perf_counter() - t0
         generated = sum(len(o) - len(i) for o, i in zip(out_ids, ids))
         return {
@@ -167,6 +177,25 @@ class GenerativePredictor:
             "tokens_generated": generated,
             "tokens_per_sec": generated / dt,
         }
+
+    # -- lifecycle -------------------------------------------------------------
+    def drain(self) -> None:
+        """Graceful shutdown, phase 1: readiness flips, in-flight requests
+        finish, new submits are rejected (SIGTERM / scale-down path)."""
+        self.engine.drain()
+
+    @property
+    def draining(self) -> bool:
+        return bool(getattr(self.engine, "_draining", False))
+
+    def stop(self, timeout: float = 60.0) -> bool:
+        """Graceful shutdown, phase 2: wait for the engine to go idle,
+        then shut it down terminally.  Returns False when in-flight work
+        outlived ``timeout`` (the engine is shut down regardless)."""
+        self.drain()
+        idle = self.engine.drained(timeout)
+        self.engine.shutdown()
+        return idle
 
 
 
@@ -206,19 +235,46 @@ class ClassifierPredictor:
 
 
 class PredictorApp:
-    """WSGI wrapper exposing one or more predictors."""
+    """WSGI wrapper exposing one or more predictors.
+
+    Overload behavior: a bounded-admission shed (engine ``QueueFull``)
+    returns 429 with a ``Retry-After`` hint; a draining predictor returns
+    503 (also with ``Retry-After``) and reports not-ready on ``/healthz``
+    so orchestrators take it out of rotation while in-flight streams
+    finish; a request whose deadline expired returns 504."""
 
     def __init__(self, predictors: dict[str, Any]):
         self.predictors = predictors
         self.log = get_logger("predictor.http")
 
     def __call__(self, environ, start_response):
+        from kubeflow_tpu.serving.engine import (
+            DeadlineExceeded,
+            Draining,
+            QueueFull,
+        )
+
         path = environ.get("PATH_INFO", "/")
         method = environ["REQUEST_METHOD"]
+        headers: list[tuple[str, str]] = []
         try:
-            status, body = self._route(method, path, environ)
+            out = self._route(method, path, environ)
+            status, body = out[0], out[1]
+            if len(out) > 2:
+                headers = list(out[2])
         except KeyError as e:
             status, body = "404 Not Found", {"error": f"no model {e}"}
+        except QueueFull as e:
+            # load shed, not failure: the client (and the gateway) should
+            # back off and retry — Retry-After carries the engine's queue
+            # wait estimate
+            status, body = "429 Too Many Requests", {"error": str(e)}
+            headers = [("Retry-After", f"{max(1, round(e.retry_after))}")]
+        except Draining as e:
+            status, body = "503 Service Unavailable", {"error": str(e)}
+            headers = [("Retry-After", "1")]
+        except DeadlineExceeded as e:
+            status, body = "504 Gateway Timeout", {"error": str(e)}
         except ValueError as e:
             status, body = "422 Unprocessable Entity", {"error": str(e)}
         except Exception as e:  # pragma: no cover
@@ -230,11 +286,56 @@ class PredictorApp:
             payload = json.dumps(body).encode()
             ctype = "application/json"
         start_response(status, [("Content-Type", ctype),
-                                ("Content-Length", str(len(payload)))])
+                                ("Content-Length", str(len(payload)))]
+                       + headers)
         return [payload]
+
+    # -- drain lifecycle -------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return any(getattr(p, "draining", False)
+                   for p in self.predictors.values())
+
+    def drain(self) -> None:
+        """SIGTERM phase 1 for every generative predictor: readiness
+        flips immediately, in-flight generations finish, new requests
+        get 503 + Retry-After."""
+        for pred in self.predictors.values():
+            if hasattr(pred, "drain"):
+                pred.drain()
+
+    def drained(self, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        ok = True
+        for pred in self.predictors.values():
+            engine = getattr(pred, "engine", None)
+            if engine is not None:
+                ok &= engine.drained(max(0.0, deadline - time.monotonic()))
+        return ok
+
+    @staticmethod
+    def _deadline_s(environ, body) -> float | None:
+        """Per-request deadline: the X-Request-Deadline header (seconds,
+        set by clients or stamped by the gateway from Route.timeout_s)
+        or a 'deadline_s' body field; header wins."""
+        raw = environ.get("HTTP_X_REQUEST_DEADLINE")
+        if raw is None:
+            raw = body.get("deadline_s")
+        if raw is None:
+            return None
+        try:
+            val = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return val if val > 0 else None
 
     def _route(self, method, path, environ):
         if path == "/healthz":
+            if self.draining:
+                # not-ready, not dead: readiness gates rotate traffic away
+                # while in-flight streams finish
+                return ("503 Service Unavailable", {"status": "draining"},
+                        [("Retry-After", "1")])
             return "200 OK", {"status": "ok"}
         if path == "/metrics":
             from kubeflow_tpu.utils.metrics import REGISTRY
@@ -256,12 +357,14 @@ class PredictorApp:
                         temperature=float(body.get("temperature", 0.0)),
                         eos_id=int(eos) if eos is not None else None,
                         top_k=int(body.get("top_k", 0)),
-                        top_p=float(body.get("top_p", 0.0)))
+                        top_p=float(body.get("top_p", 0.0)),
+                        deadline_s=self._deadline_s(environ, body))
                 if verb == "predict":
                     return "200 OK", pred.predict(body["instances"])
             else:
                 pred = self.predictors[rest]
-                meta = {"name": rest, "ready": True}
+                ready = not getattr(pred, "draining", False)
+                meta = {"name": rest, "ready": ready}
                 engine = getattr(pred, "engine", None)
                 if engine is not None:
                     # live load snapshot (engine.stats()): for operators
@@ -305,6 +408,10 @@ def main(argv=None) -> int:
     parser.add_argument("--prefill-chunk", type=int, default=512,
                         help="max prompt tokens per prefill dispatch "
                              "(longer prompts prefill in chunks)")
+    parser.add_argument("--max-queue", type=int, default=0,
+                        help="bounded admission: submits past this many "
+                             "queued requests are shed with 429 + "
+                             "Retry-After (0 = unbounded)")
     args = parser.parse_args(argv)
 
     specs = [m for m in (args.models or []) if m] or ["llama"]
@@ -333,7 +440,8 @@ def main(argv=None) -> int:
                 prefix_cache_mb=float(opts.get("prefix_cache_mb",
                                                args.prefix_cache_mb)),
                 prefill_chunk=int(opts.get("prefill_chunk",
-                                           args.prefill_chunk)))
+                                           args.prefill_chunk)),
+                max_queue=int(opts.get("max_queue", args.max_queue)))
         else:
             predictors[name] = ClassifierPredictor(name,
                                                    checkpoint_dir=ckpt)
@@ -343,7 +451,30 @@ def main(argv=None) -> int:
     import os
 
     port = int(os.environ.get("KF_POD_PORT", args.port))
-    httpd, thread = serve(PredictorApp(predictors), port)
+    app = PredictorApp(predictors)
+    httpd, thread = serve(app, port)
+
+    # graceful drain on SIGTERM (the kubelet's stop signal and the
+    # autoscaler's scale-down path): readiness flips to not-ready
+    # immediately, in-flight generations run to completion, new requests
+    # get 503 + Retry-After, and only then does the listener close
+    import signal
+    import threading as threading_mod
+
+    def _drain_and_exit():
+        app.drain()
+        print("predictor draining: finishing in-flight requests",
+              flush=True)
+        app.drained(timeout=float(os.environ.get("KF_DRAIN_GRACE", "60")))
+        httpd.shutdown()
+
+    def _on_sigterm(signum, frame):
+        threading_mod.Thread(target=_drain_and_exit, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded use)
     print(f"predictor serving {sorted(predictors)} on :{port}",
           flush=True)
     thread.join()

@@ -73,6 +73,7 @@ class PrefixCache:
         self.root = _Node((), None)
         self.bytes = 0
         self._blocked: set[_Node] = set()   # nodes currently holding a block
+        self._pins = 0                      # outstanding match(pin=True) holds
         self._lock = threading.Lock()
 
     # -- matching --------------------------------------------------------------
@@ -101,6 +102,7 @@ class PrefixCache:
             holder.last_used = time.monotonic()
             if pin:
                 holder.refs += 1
+                self._pins += 1
             return holder, usable
 
     def _walk(self, tokens: tuple):
@@ -132,7 +134,9 @@ class PrefixCache:
 
     def release(self, node: _Node) -> None:
         with self._lock:
-            node.refs = max(0, node.refs - 1)
+            if node.refs > 0:
+                node.refs -= 1
+                self._pins -= 1
 
     # -- insertion / eviction --------------------------------------------------
     def insert(self, tokens, block) -> bool:
@@ -203,8 +207,12 @@ class PrefixCache:
     # -- introspection ---------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
+            # "pinned" must be zero whenever no admission is mid-prefill:
+            # a nonzero steady-state value is a leaked refcount that makes
+            # its block unevictable forever (the overload loadtest asserts
+            # this invariant after every storm)
             return {"bytes": self.bytes, "max_bytes": self.max_bytes,
-                    "blocks": len(self._blocked)}
+                    "blocks": len(self._blocked), "pinned": self._pins}
 
     def _publish(self) -> None:
         CACHED_BYTES.set(float(self.bytes))

@@ -194,6 +194,211 @@ def test_reconciler_ignores_unannotated_isvc():
                       "serving")["spec"]["replicas"] == 1
 
 
+# -- drain-aware scale-down (ISSUE 6) ----------------------------------------
+
+def _two_pod_deployment(server, ports=(9001, 9002)):
+    server.create(api_object("Deployment", "m", "serving",
+                             spec={"replicas": 2, "template": {}}))
+    server.patch_status("Deployment", "m", "serving",
+                        {"replicas": 2, "readyReplicas": 2})
+    for i, port in enumerate(ports):
+        pod = api_object("Pod", f"m-{i}", "serving",
+                         labels={"isvc": "m"},
+                         spec={"containers": [{"name": "c"}]})
+        server.create(pod)
+        server.patch_status("Pod", f"m-{i}", "serving", {
+            "phase": "Running", "podIP": "127.0.0.1",
+            "portMap": {"8602": port}})
+
+
+def _drive(scaler, req, now, ticks, step=0.5):
+    for _ in range(ticks):
+        now[0] += step
+        scaler.reconcile(req)
+
+
+def test_scale_down_drains_victim_before_replicas_patch():
+    """The acceptance flow: the victim pod (highest ordinal — exactly the
+    one the Deployment controller deletes) is marked draining via the
+    gateway BEFORE any replicas patch, the patch waits while the victim
+    still carries a live stream, and lands the tick after quiesce."""
+    from kubeflow_tpu import gateway as gw
+
+    server = APIServer()
+    collector = autoscale.get_collector(server)
+    now = [0.0]
+    scaler = Autoscaler(server, collector, clock=lambda: now[0])
+    server.create(_annotated_isvc(target="2", minReplicas="1", window="2",
+                                  panicThreshold="100",
+                                  drainGrace="600"))
+    _two_pod_deployment(server)
+    req = Request("serving", "m")
+
+    for _ in range(4):                   # sustained 4 -> desired 2
+        collector.inc(("serving", "m"))
+    _drive(scaler, req, now, 10)
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] == 2
+
+    # load drops to 1 (-> desired 1) while the victim pod m-1 still
+    # carries one live proxied stream
+    for _ in range(3):
+        collector.dec(("serving", "m"))
+    collector.inc_backend(("127.0.0.1", 9002))
+    _drive(scaler, req, now, 12)
+    assert gw.pod_draining(server.get("Pod", "m-1", "serving"))
+    assert not gw.pod_draining(server.get("Pod", "m-0", "serving"))
+    # the patch is DEFERRED: replicas still 2 while the stream lives
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] == 2
+    state = server.get(api.KIND, "m", "serving")["status"]["autoscaler"]
+    assert state["draining"] == 1
+
+    # the stream finishes -> the very next tick patches replicas down
+    collector.dec_backend(("127.0.0.1", 9002))
+    _drive(scaler, req, now, 2)
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] == 1
+    state = server.get(api.KIND, "m", "serving")["status"]["autoscaler"]
+    assert state["draining"] == 0
+
+
+def test_shallower_redecision_undrains_ex_victims_only():
+    """A pending 3->1 scale-down re-decided to 3->2 shrinks the victim
+    range: m-1 (no longer a victim) must return to rotation immediately,
+    while m-2 stays draining until its streams quiesce — a stale
+    draining mark on a surviving replica is a permanent capacity
+    blackhole."""
+    from kubeflow_tpu import gateway as gw
+
+    server = APIServer()
+    collector = autoscale.get_collector(server)
+    now = [0.0]
+    scaler = Autoscaler(server, collector, clock=lambda: now[0])
+    server.create(_annotated_isvc(target="2", minReplicas="1", window="2",
+                                  panicThreshold="100",
+                                  drainGrace="600"))
+    server.create(api_object("Deployment", "m", "serving",
+                             spec={"replicas": 3, "template": {}}))
+    server.patch_status("Deployment", "m", "serving",
+                        {"replicas": 3, "readyReplicas": 3})
+    for i, port in enumerate((9001, 9002, 9003)):
+        server.create(api_object("Pod", f"m-{i}", "serving",
+                                 labels={"isvc": "m"},
+                                 spec={"containers": [{"name": "c"}]}))
+        server.patch_status("Pod", f"m-{i}", "serving", {
+            "phase": "Running", "podIP": "127.0.0.1",
+            "portMap": {"8602": port}})
+    req = Request("serving", "m")
+
+    for _ in range(6):                   # sustained 6 -> desired 3
+        collector.inc(("serving", "m"))
+    _drive(scaler, req, now, 10)
+    # load drops to 1 -> desired 1; BOTH victims carry live streams, so
+    # the patch defers and m-1 + m-2 are both draining
+    for _ in range(5):
+        collector.dec(("serving", "m"))
+    collector.inc_backend(("127.0.0.1", 9002))
+    collector.inc_backend(("127.0.0.1", 9003))
+    _drive(scaler, req, now, 12)
+    assert gw.pod_draining(server.get("Pod", "m-1", "serving"))
+    assert gw.pod_draining(server.get("Pod", "m-2", "serving"))
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] == 3
+
+    # load rises to 3 -> desired 2: m-1 leaves the victim range and must
+    # be undrained even though its stream still lives; m-2 keeps draining
+    for _ in range(2):
+        collector.inc(("serving", "m"))
+    _drive(scaler, req, now, 12)
+    assert not gw.pod_draining(server.get("Pod", "m-1", "serving"))
+    assert gw.pod_draining(server.get("Pod", "m-2", "serving"))
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] == 3
+
+    # m-2 quiesces -> the patch lands at 2, m-1 still routable
+    collector.dec_backend(("127.0.0.1", 9003))
+    _drive(scaler, req, now, 3)
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] == 2
+    assert not gw.pod_draining(server.get("Pod", "m-1", "serving"))
+    state = server.get(api.KIND, "m", "serving")["status"]["autoscaler"]
+    assert state["draining"] == 0
+    collector.dec_backend(("127.0.0.1", 9002))
+
+
+def test_drain_state_is_per_service_not_name_prefix():
+    """Service "m" must not claim (or undrain) the drain state of a
+    sibling service "m-foo": victim keys match the exact {name}-{ordinal}
+    pattern, not a name prefix."""
+    server = APIServer()
+    collector = autoscale.get_collector(server)
+    now = [0.0]
+    scaler = Autoscaler(server, collector, clock=lambda: now[0])
+    scaler._drain_started[("serving", "m-foo-1")] = 0.0
+    assert scaler._drain_keys(Request("serving", "m")) == []
+    assert scaler._drain_keys(Request("serving", "m-foo")) == [
+        ("serving", "m-foo-1")]
+
+
+def test_scale_down_drain_grace_expiry_forces_patch():
+    """A wedged stream must not park the scale-down forever: once
+    drainGrace expires the replicas patch proceeds regardless."""
+    server = APIServer()
+    collector = autoscale.get_collector(server)
+    now = [0.0]
+    scaler = Autoscaler(server, collector, clock=lambda: now[0])
+    server.create(_annotated_isvc(target="2", minReplicas="1", window="2",
+                                  panicThreshold="100",
+                                  drainGrace="1.5"))
+    _two_pod_deployment(server)
+    req = Request("serving", "m")
+    for _ in range(4):
+        collector.inc(("serving", "m"))
+    _drive(scaler, req, now, 10)
+    for _ in range(3):
+        collector.dec(("serving", "m"))
+    collector.inc_backend(("127.0.0.1", 9002))   # wedged forever
+    _drive(scaler, req, now, 12)                  # > grace worth of ticks
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] == 1
+    collector.dec_backend(("127.0.0.1", 9002))
+
+
+def test_scale_up_mid_drain_returns_victim_to_rotation():
+    """A pending scale-down re-decided upward must UNDRAIN the victim —
+    capacity the decider wants back goes back in rotation."""
+    from kubeflow_tpu import gateway as gw
+
+    server = APIServer()
+    collector = autoscale.get_collector(server)
+    now = [0.0]
+    scaler = Autoscaler(server, collector, clock=lambda: now[0])
+    server.create(_annotated_isvc(target="2", minReplicas="1", window="2",
+                                  panicThreshold="100",
+                                  drainGrace="600"))
+    _two_pod_deployment(server)
+    req = Request("serving", "m")
+    for _ in range(4):
+        collector.inc(("serving", "m"))
+    _drive(scaler, req, now, 10)
+    for _ in range(3):
+        collector.dec(("serving", "m"))
+    collector.inc_backend(("127.0.0.1", 9002))
+    _drive(scaler, req, now, 12)
+    assert gw.pod_draining(server.get("Pod", "m-1", "serving"))
+
+    for _ in range(3):                   # the burst returns -> desired 2
+        collector.inc(("serving", "m"))
+    _drive(scaler, req, now, 12)
+    assert not gw.pod_draining(server.get("Pod", "m-1", "serving"))
+    assert server.get("Deployment", "m",
+                      "serving")["spec"]["replicas"] == 2
+    collector.dec_backend(("127.0.0.1", 9002))
+    for _ in range(4):
+        collector.dec(("serving", "m"))
+
+
 # -- quota parking: a scale-up past TPU quota parks, never flaps -------------
 
 def test_scale_up_beyond_quota_parks_without_flapping():

@@ -183,3 +183,18 @@ def test_serving_cache_route(stack):
                                           "bytes", "evictions"}
     assert "ttft_p50_s" in state and "ttft_p99_s" in state
     assert "prefill_dispatches" in state
+
+
+def test_serving_health_route(stack):
+    """Overload standing (ISSUE 6): request outcomes by shed / cancelled /
+    deadline_exceeded, admission-wait percentiles, and drain state."""
+    server, mgr, base = stack
+    code, state = req(base, "/dashboard/api/serving-health",
+                      user="alice@corp.com")
+    assert code == 200
+    assert set(state["requests"]) >= {"ok", "shed", "cancelled",
+                                      "deadline_exceeded"}
+    assert "admission_wait_p50_s" in state
+    assert "admission_wait_p99_s" in state
+    assert "gateway_shed" in state
+    assert state["draining"] in (True, False)

@@ -833,6 +833,171 @@ def test_route_table_tracks_virtualservice_mutations():
     assert gw.match_route(server, "/b/ns1/x/q").dest_host == "x.ns1.svc"
 
 
+def _shed_stack(backends):
+    """A routed Service with one pod per ``backends`` entry; each entry is
+    a WSGI-style behavior: 'shed' answers 429 + Retry-After, 'busy503'
+    answers 503 + Retry-After, 'ok' answers 200.  Returns (server, pods)
+    where pods maps name -> (host, port)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubeflow_tpu.core import APIServer, api_object
+
+    server = APIServer()
+    server.create(api_object("VirtualService", "app", "default", spec={
+        "http": [{"match": [{"uri": {"prefix": "/web/default/app/"}}],
+                  "rewrite": {"uri": "/"},
+                  "route": [{"destination": {"host": "app.default.svc",
+                                             "port": {"number": 80}}}]}]}))
+    server.create(api_object("Service", "app", "default", spec={
+        "selector": {"app": "web"},
+        "ports": [{"port": 80, "targetPort": 8080}]}))
+
+    def make_handler(mode):
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _serve(self):
+                if mode == "shed":
+                    body = b"busy\n"
+                    self.send_response(429)
+                    self.send_header("Retry-After", "3")
+                elif mode == "busy503":
+                    body = b"busy\n"
+                    self.send_response(503)
+                    self.send_header("Retry-After", "2")
+                else:
+                    body = b"ok"
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = _serve
+
+            def log_message(self, *a):
+                pass
+        return H
+
+    pods = {}
+    servers = []
+    for i, mode in enumerate(backends):
+        # threading + daemon handlers: the gateway pools keep-alive
+        # connections, and a blocked reader must not wedge shutdown()
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(mode))
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(httpd)
+        name = f"pod-{chr(ord('a') + i)}"
+        pod = api_object("Pod", name, "default", labels={"app": "web"},
+                         spec={"containers": [{"name": "c"}]})
+        server.create(pod)
+        server.patch_status("Pod", name, "default", {
+            "phase": "Running", "podIP": "127.0.0.1",
+            "portMap": {"8080": httpd.server_address[1]}})
+        pods[name] = ("127.0.0.1", httpd.server_address[1])
+    return server, pods, servers
+
+
+def _call(gateway, path="/web/default/app/x", method="GET", body=b""):
+    import io
+
+    status = {}
+    headers = {}
+
+    def start_response(s, h):
+        status["code"] = s
+        headers.update({k.lower(): v for k, v in h})
+
+    environ = {"REQUEST_METHOD": method, "PATH_INFO": path,
+               "wsgi.input": io.BytesIO(body),
+               "CONTENT_LENGTH": str(len(body))}
+    out = b"".join(gateway(environ, start_response))
+    return status["code"], headers, out
+
+
+def test_shed_response_relayed_with_retry_after_no_ejection():
+    """A 429 from the ONLY backend is healthy-busy: relayed once with its
+    Retry-After intact, counted in gateway_shed_responses_total, and the
+    backend is NOT ejected (ejecting busy pods under overload collapses
+    the revision)."""
+    server, pods, stubs = _shed_stack(["shed"])
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01)
+    try:
+        shed0 = gw.SHED.get()
+        ej0 = gw.EJECTIONS.get()
+        code, headers, _ = _call(gateway)
+        assert code.startswith("429")
+        assert headers.get("retry-after") == "3"   # propagated, not eaten
+        assert gw.SHED.get() == shed0 + 1
+        assert gw.EJECTIONS.get() == ej0
+        assert not gateway.ejections.contains(*pods["pod-a"])
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+def test_shed_retries_once_on_sibling_before_any_byte_streams():
+    """pod-a sheds, pod-b has room: the gateway re-dispatches the request
+    to the sibling — legal exactly because the shed response proves
+    nothing executed and no response byte has been streamed — and the
+    client sees a clean 200.  A POST with a buffered body replays too."""
+    server, pods, stubs = _shed_stack(["shed", "ok"])
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01)
+    try:
+        code, _, body = _call(gateway)
+        assert code.startswith("200") and body == b"ok"
+        code, _, body = _call(gateway, method="POST", body=b'{"x":1}')
+        assert code.startswith("200") and body == b"ok"
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+def test_busy_503_with_retry_after_counts_as_shed():
+    """A 503 carrying Retry-After is shed-not-dead (Knative/Envoy treat
+    it as healthy-busy); a bare 503 is NOT counted as shed."""
+    server, pods, stubs = _shed_stack(["busy503"])
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01)
+    try:
+        shed0 = gw.SHED.get()
+        code, headers, _ = _call(gateway)
+        assert code.startswith("503")
+        assert headers.get("retry-after") == "2"
+        assert gw.SHED.get() == shed0 + 1
+        assert not gateway.ejections.contains(*pods["pod-a"])
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+def test_draining_pod_leaves_rotation_immediately():
+    """A pod marked draining (scale-down victim / SIGTERM'd predictor)
+    serves no NEW requests — traffic shifts to its sibling at once, and
+    with every pod draining the route is 503 (with Retry-After), never a
+    mid-death dispatch."""
+    server, pods, stubs = _shed_stack(["ok", "ok"])
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01)
+    try:
+        assert gw.mark_draining(server, "pod-a", "default")
+        route = gw.match_route(server, "/web/default/app/x")
+        backend = gw.backend_for_route(server, route, "/web/default/app/x")
+        assert (backend.host, backend.port) == pods["pod-b"]
+        # un-draining puts it back
+        assert gw.mark_draining(server, "pod-a", "default",
+                                draining=False)
+        assert not gw.pod_draining(server.get("Pod", "pod-a", "default"))
+        # every pod draining -> shed-shaped 503, not a doomed dispatch
+        gw.mark_draining(server, "pod-a", "default")
+        gw.mark_draining(server, "pod-b", "default")
+        code, headers, _ = _call(gateway)
+        assert code.startswith("503")
+        assert headers.get("retry-after") is not None
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
 def test_connect_failed_backend_ejected_and_traffic_shifts():
     """Outlier ejection: a backend whose connect fails is taken out of
     rotation (with expiry + metric) so the NEXT request goes straight to a

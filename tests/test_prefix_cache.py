@@ -217,6 +217,67 @@ def test_warm_chunked_suffix_identical(cold):
         eng.shutdown()
 
 
+def test_pin_balance_zero_after_cancel_storm_and_shutdown():
+    """ISSUE 6 satellite: every match(pin=True) must be released on EVERY
+    exit path — completed, cancelled mid-decode, cancelled mid-prefill,
+    queued-but-never-admitted at shutdown.  A leaked pin makes its block
+    unevictable forever, so the invariant is pins == 0 whenever the
+    engine is idle or shut down."""
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+    from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+    p = GenerativePredictor("llama", size="tiny", max_batch=2, max_seq=128,
+                            prefix_cache_mb=8)
+    eng = p.engine
+    pc = eng.prefix_cache
+    prompt = SYS + [41, 42]
+    eng.submit(prompt, max_new_tokens=2).result(120)      # populate tree
+    assert pc.stats()["pinned"] == 0
+
+    # a storm of prefix-hitting requests, every one abandoned mid-flight
+    reqs = [eng.submit(prompt + [50 + i], max_new_tokens=100, eos_id=0)
+            for i in range(6)]
+    for r in reqs:
+        r.cancel()
+    for r in reqs:
+        assert r._done.wait(60)
+    assert eng.drained(timeout=30)
+    assert pc.stats()["pinned"] == 0
+
+    # queued-but-never-admitted + mid-prefill requests at shutdown()
+    eng.chaos_stall(0.5)
+    held = [eng.submit(prompt + [70 + i], max_new_tokens=100, eos_id=0)
+            for i in range(5)]
+    eng.shutdown()
+    for r in held:
+        assert r._done.wait(60)
+    assert pc.stats()["pinned"] == 0
+
+    # restart() reopens with the same balanced cache
+    eng.restart()
+    out = eng.submit(prompt, max_new_tokens=2).result(120)
+    assert out[:len(prompt)] == prompt
+    assert pc.stats()["pinned"] == 0
+    eng.shutdown()
+
+    # chunked-prefill cancel: the bail-out between extend chunks must
+    # release the pin it holds across dispatches
+    eng2 = ContinuousBatcher(p.module, p.params, p.cfg, max_batch=1,
+                             max_seq=128, prefill_chunk=16,
+                             prefix_cache_bytes=8 << 20)
+    try:
+        shared = list(range(3, 19))                       # 16 tokens
+        eng2.generate_sync([shared + [99]], max_new_tokens=2)
+        long_req = eng2.submit(shared + list(range(30, 70)),
+                               max_new_tokens=4)
+        long_req.cancel()                # may land mid-chunked-prefill
+        assert long_req._done.wait(60)
+        assert eng2.drained(timeout=30)
+        assert eng2.prefix_cache.stats()["pinned"] == 0
+    finally:
+        eng2.shutdown()
+
+
 def test_prefix_metrics_exported(warm):
     from kubeflow_tpu.utils.metrics import REGISTRY
 

@@ -89,6 +89,176 @@ def test_predictor_http_api(llama_predictor):
     httpd.shutdown()
 
 
+class TestOverloadHTTP:
+    """ISSUE 6: the HTTP surface of bounded admission, deadlines, and
+    graceful drain — what the gateway and clients actually see."""
+
+    @pytest.fixture()
+    def app_stack(self):
+        p = GenerativePredictor("llama", size="tiny", max_batch=1,
+                                max_seq=128)
+        p.engine.submit([1, 2, 3], max_new_tokens=4).result(120)  # warm
+        app = PredictorApp({"llama": p})
+        httpd, _ = serve(app, 0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        yield p, app, base
+        httpd.shutdown()
+        p.engine.shutdown()
+
+    @staticmethod
+    def _post(base, body, headers=None, timeout=60):
+        req = urllib.request.Request(
+            base + "/v1/models/llama:generate",
+            data=json.dumps(body).encode(), method="POST",
+            headers=headers or {})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+
+    def test_queue_overflow_returns_429_with_retry_after(self, app_stack):
+        import urllib.error
+
+        import time
+
+        p, app, base = app_stack
+        p.engine.max_queue = 1
+        p.engine.chaos_stall(1.0)       # hold the slot while we overflow
+        held = [p.engine.submit([1, 2], max_new_tokens=100, eos_id=0)]
+        deadline = time.time() + 10     # wait for slot admission so the
+        while not p.engine.stats()["active"]:   # next submit fills the
+            assert time.time() < deadline        # queue, not the slot
+            time.sleep(0.005)
+        held.append(p.engine.submit([3, 4], max_new_tokens=100, eos_id=0))
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                self._post(base, {"ids": [[7, 9]], "max_new_tokens": 4})
+            assert exc.value.code == 429
+            assert float(exc.value.headers["Retry-After"]) > 0
+        finally:
+            for r in held:
+                r.cancel()
+            p.engine.max_queue = 0
+
+    def test_deadline_header_expires_to_504(self, app_stack):
+        import urllib.error
+
+        p, app, base = app_stack
+        p.engine._service_ewma = 0.0    # exercise eviction, not the shed
+        p.engine.chaos_stall(0.6)       # decode wedges past the deadline
+        blocker = p.engine.submit([1, 2], max_new_tokens=100, eos_id=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                self._post(base, {"ids": [[7, 9]], "max_new_tokens": 90,
+                                  "eos_id": 0},
+                           headers={"X-Request-Deadline": "0.15"})
+            assert exc.value.code == 504
+        finally:
+            blocker.cancel()
+
+    def test_drain_finishes_stream_rejects_new_flips_readiness(
+            self, app_stack):
+        """The SIGTERM e2e (in-process trigger): mid-generation drain —
+        the in-flight request completes, /healthz goes not-ready, model
+        metadata reports ready=False, and a new generate gets 503 with
+        Retry-After."""
+        import urllib.error
+
+        p, app, base = app_stack
+        p.engine.chaos_stall(0.5)       # keep the stream in flight
+        inflight = p.engine.submit([5, 6], max_new_tokens=40, eos_id=0)
+        app.drain()                     # what the SIGTERM handler calls
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            self._post(base, {"ids": [[1, 2]], "max_new_tokens": 2})
+        assert exc.value.code == 503
+        assert float(exc.value.headers["Retry-After"]) > 0
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            with urllib.request.urlopen(base + "/healthz", timeout=10):
+                pass
+        assert exc.value.code == 503
+
+        with urllib.request.urlopen(base + "/v1/models/llama",
+                                    timeout=10) as r:
+            assert json.loads(r.read())["ready"] is False
+
+        # the in-flight stream still completes — drain kills nothing
+        out = inflight.result(timeout=60)
+        assert len(out) == 2 + 40
+        assert app.drained(timeout=30)
+
+        p.engine.restart()
+        status, _, body = self._post(base, {"ids": [[1, 2]],
+                                            "max_new_tokens": 2})
+        assert status == 200 and len(body["ids"][0]) == 4
+
+    @pytest.mark.slow
+    def test_sigterm_subprocess_drains_and_exits(self, tmp_path):
+        """The REAL signal path: a predictor subprocess receives SIGTERM
+        mid-generation — the in-flight request completes with 200, a
+        follow-up request is refused, and the process exits cleanly."""
+        import os
+        import signal
+        import socket
+        import subprocess
+        import threading
+        import time
+        import urllib.error
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ, JAX_PLATFORMS="cpu", KF_POD_PORT=str(port))
+        proc = subprocess.Popen(
+            [__import__("sys").executable, "-m",
+             "kubeflow_tpu.serving.predictor", "--model", "llama",
+             "--size", "tiny", "--max-seq", "128"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        try:
+            base = f"http://127.0.0.1:{port}"
+            deadline = time.time() + 120
+            while time.time() < deadline:   # wait for jax import + bind
+                try:
+                    with urllib.request.urlopen(base + "/healthz",
+                                                timeout=2):
+                        break
+                except (urllib.error.URLError, OSError):
+                    time.sleep(0.5)
+            else:
+                raise AssertionError("predictor never became ready")
+            # warm the executables so the drained generation is fast
+            self._post(base, {"ids": [[1, 2]], "max_new_tokens": 2},
+                       timeout=120)
+
+            result = {}
+
+            def long_generate():
+                try:
+                    result["out"] = self._post(
+                        base, {"ids": [[5, 6]], "max_new_tokens": 90,
+                               "eos_id": 0}, timeout=120)
+                except Exception as e:   # noqa: BLE001 - recorded for assert
+                    result["err"] = e
+
+            t = threading.Thread(target=long_generate, daemon=True)
+            t.start()
+            time.sleep(0.3)              # the generation is in flight
+            proc.send_signal(signal.SIGTERM)
+            t.join(timeout=120)
+            assert "out" in result, f"in-flight stream died: {result}"
+            status, _, body = result["out"]
+            assert status == 200 and len(body["ids"][0]) == 2 + 90
+            assert proc.wait(timeout=60) == 0
+            # the listener is gone: a new request cannot land anywhere
+            with pytest.raises((urllib.error.URLError, OSError)):
+                with urllib.request.urlopen(base + "/healthz", timeout=2):
+                    pass
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
 def test_classifier_predictor():
     p = ClassifierPredictor("mnist_mlp")
     import numpy as np

@@ -199,6 +199,180 @@ def test_top_k_sampling_restricts_tokens(predictor):
         predictor.engine.submit([1], top_k=-2)
 
 
+class TestOverload:
+    """ISSUE 6: deadlines, cancellation, bounded admission, drain.  All
+    eviction tests force CHUNKED decode (eos traffic + a non-empty queue
+    keeps chunks at DECODE_CHUNKS[0]) so the sweep between chunks is what
+    frees the slot — the path a long-decode production request takes."""
+
+    NEVER = 0  # tiny-llama greedy never emits token 0 for these prompts
+
+    @pytest.fixture()
+    def engine(self):
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        p = GenerativePredictor("llama", size="tiny", max_batch=1,
+                                max_seq=128)
+        p.engine.submit([1, 2, 3], max_new_tokens=4).result(120)  # warm
+        yield p.engine
+        p.engine.shutdown()
+
+    def _wait_idle(self, eng, timeout=10.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            s = eng.stats()
+            if not s["active"] and not s["queued"]:
+                return s
+            time.sleep(0.005)
+        raise AssertionError(f"engine never went idle: {eng.stats()}")
+
+    def test_result_timeout_cancels_and_frees_slot(self, engine):
+        """The satellite regression: a timed-out result() waiter used to
+        leave the request decoding to max_new_tokens in its slot; now it
+        cancels, and the slot frees within one decode chunk."""
+        from kubeflow_tpu.serving.engine import REQS_TOTAL
+
+        c0 = REQS_TOTAL.get("cancelled")
+        engine.chaos_stall(0.5)   # wedge the first decode dispatch so the
+        # waiter reliably times out while the request is mid-decode
+        ra = engine.submit([1, 2], max_new_tokens=120, eos_id=self.NEVER)
+        rb = engine.submit([8, 9], max_new_tokens=100, eos_id=self.NEVER)
+        with pytest.raises(TimeoutError):
+            ra.result(timeout=0.05)
+        # the abandoned request must terminate (cancelled), not run to
+        # max_new_tokens: rb gets the slot and both reach terminal state
+        assert ra._done.wait(30)
+        assert ra.outcome == "cancelled"
+        assert len(ra.generated) < 120
+        rb.result(timeout=60)
+        assert REQS_TOTAL.get("cancelled") - c0 == 1
+        self._wait_idle(engine)
+
+    def test_deadline_expiry_mid_decode_frees_slot_and_pins(self):
+        """An expired deadline evicts mid-decode: slot freed within one
+        chunk, prefix-cache pins balanced, outcome counted."""
+        from kubeflow_tpu.serving.engine import (
+            REQS_TOTAL,
+            DeadlineExceeded,
+        )
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        p = GenerativePredictor("llama", size="tiny", max_batch=1,
+                                max_seq=128, prefix_cache_mb=8)
+        eng = p.engine
+        try:
+            eng.submit([1, 2, 3], max_new_tokens=4).result(120)  # warm
+            d0 = REQS_TOTAL.get("deadline_exceeded")
+            eng._service_ewma = 0.0   # isolate the mid-decode path from
+            # the estimated-wait shed (first-request EWMA includes compile)
+            eng.chaos_stall(0.5)      # decode wedges past the deadline
+            ra = eng.submit([4, 5], max_new_tokens=120,
+                            eos_id=self.NEVER, deadline_s=0.2)
+            rb = eng.submit([6, 7], max_new_tokens=8, eos_id=self.NEVER)
+            with pytest.raises(DeadlineExceeded):
+                ra.result(timeout=60)
+            assert len(ra.generated) < 120      # evicted, not completed
+            rb.result(timeout=60)               # the successor got the slot
+            assert REQS_TOTAL.get("deadline_exceeded") - d0 == 1
+            self._wait_idle(eng)
+            assert eng.prefix_cache.stats()["pinned"] == 0
+        finally:
+            eng.shutdown()
+
+    def test_queued_expiry_skips_prefill(self, engine):
+        """A request that dies while queued must not burn a prefill
+        dispatch on its way out."""
+        from kubeflow_tpu.serving.engine import (
+            PREFILL_DISPATCHES,
+            DeadlineExceeded,
+        )
+
+        engine._service_ewma = 0.0      # isolate from estimated-wait shed
+        engine.chaos_stall(0.3)         # hold the slot while the queued
+        blocker = engine.submit([1, 2], max_new_tokens=100,  # deadline dies
+                                eos_id=self.NEVER)
+        doomed = engine.submit([3, 4], max_new_tokens=4,
+                               deadline_s=0.01)
+        time.sleep(0.05)                # let the deadline lapse in queue
+        d0 = PREFILL_DISPATCHES.get()
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=30)
+        assert PREFILL_DISPATCHES.get() == d0   # no prefill for the dead
+        blocker.cancel()
+        self._wait_idle(engine)
+
+    def test_max_queue_overflow_sheds_with_retry_after(self, engine):
+        from kubeflow_tpu.serving.engine import REQS_TOTAL, QueueFull
+
+        engine.max_queue = 2
+        s0 = REQS_TOTAL.get("shed")
+        held = [engine.submit([1, 2], max_new_tokens=100,
+                              eos_id=self.NEVER)]
+        # fill the queue to its bound, then overflow
+        t0 = time.time()
+        with pytest.raises(QueueFull) as exc:
+            for i in range(6):
+                held.append(engine.submit([3 + i, 4], max_new_tokens=100,
+                                          eos_id=self.NEVER))
+        assert time.time() - t0 < 1.0           # shed fails FAST
+        assert exc.value.retry_after > 0
+        assert REQS_TOTAL.get("shed") - s0 >= 1
+        assert engine.stats()["max_queue"] == 2
+        for r in held:
+            r.cancel()
+        engine.max_queue = 0
+        self._wait_idle(engine)
+
+    def test_generate_sync_cancels_siblings_on_shed(self, engine):
+        """All-or-nothing batches: when a later row's submit is shed
+        (QueueFull), the rows already submitted must be cancelled — the
+        caller got one error, so decoding the survivors serves nobody."""
+        from kubeflow_tpu.serving.engine import REQS_TOTAL, QueueFull
+
+        engine.max_queue = 1
+        engine._service_ewma = 0.0      # isolate from estimated-wait shed
+        c0 = REQS_TOTAL.get("cancelled")
+        engine.chaos_stall(0.4)         # hold the slot so the queue fills
+        with pytest.raises(QueueFull):
+            engine.generate_sync([[1, 2], [3, 4], [5, 6], [7, 8]],
+                                 max_new_tokens=120, eos_id=self.NEVER)
+        engine.max_queue = 0
+        # the submitted siblings terminate as cancelled (within a chunk),
+        # not by decoding 120 tokens each for a caller that already 429'd
+        self._wait_idle(engine)
+        assert REQS_TOTAL.get("cancelled") - c0 >= 1
+
+    def test_estimated_wait_sheds_unmeetable_deadline(self, engine):
+        """With a service-time estimate on record and a backed-up queue,
+        a deadline shorter than the estimated wait is shed at submit
+        (no slot, no prefill) rather than admitted to die later."""
+        from kubeflow_tpu.serving.engine import QueueFull
+
+        assert engine._service_ewma > 0         # warmed by the fixture
+        held = [engine.submit([i + 1, 2], max_new_tokens=100,
+                              eos_id=self.NEVER) for i in range(4)]
+        with pytest.raises(QueueFull):
+            engine.submit([9, 9], max_new_tokens=4, deadline_s=1e-4)
+        for r in held:
+            r.cancel()
+        self._wait_idle(engine)
+
+    def test_drain_finishes_inflight_rejects_new(self, engine):
+        from kubeflow_tpu.serving.engine import Draining
+
+        r = engine.submit([5, 6], max_new_tokens=30, eos_id=self.NEVER)
+        engine.drain()
+        assert engine.stats().get("draining") is True
+        with pytest.raises(Draining):
+            engine.submit([1], max_new_tokens=1)
+        # the in-flight request runs to completion, then the engine idles
+        out = r.result(timeout=60)
+        assert len(out) == 2 + 30
+        assert engine.drained(timeout=30)
+        engine.restart()
+        assert engine.submit([1, 2], max_new_tokens=2).result(60)
+
+
 class TestShardedServing:
     """tp>1 predictors (VERDICT r3 #4): weights and KV cache shard over a
     pure-tp mesh; decode output must match the single-chip engine
