@@ -4,8 +4,21 @@ Follows the reference's pattern of wrapping raw pod payloads in thin typed
 specs (NotebookSpec embeds a full PodSpec, notebook_types.go:27-35): each
 schema module provides ``new_*`` constructors, validation, and status helpers
 over plain dict resources served by core.APIServer.
+
+Submodules load lazily (PEP 562): ``jaxjob`` pulls the jax runtime via the
+topology catalogue (~3s cold), and eager package import taxed every process
+that only needed a schema-free sibling — the persistence layer's replay
+(``api.versions``) was paying the whole jax import to read a WAL, which
+made the crash-point sweep's per-child cost 6x the workload itself.
 """
 
-from kubeflow_tpu.api import jaxjob, notebook, poddefault, profile, tensorboard
+import importlib
 
-__all__ = ["jaxjob", "notebook", "poddefault", "profile", "tensorboard"]
+__all__ = ["experiment", "inferenceservice", "jaxjob", "notebook",
+           "pipeline", "poddefault", "profile", "tensorboard", "versions"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(f"kubeflow_tpu.api.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -12,7 +12,11 @@ This package is the platform's fault-injection layer:
 - :class:`ChaosInjector` — host/slice faults against a running platform:
   silent pod kills (no status transition — the host died, nobody reports),
   node heartbeat stops, and slice preemptions injected into the
-  ``TpuSlicePool``.
+  ``TpuSlicePool``;
+- :class:`FaultPlan` + :class:`FaultyIO` (``chaos.fsfault``) — storage
+  faults under the persistence layer: short writes, ENOSPC/EIO, bit
+  flips on read, and crash-here markers at every write boundary
+  (``loadtest/load_crash.py`` SIGKILLs a real process at each one).
 
 Everything is driven by one ``random.Random(seed)``: the same seed
 produces the same fault schedule, so ``loadtest/load_chaos.py`` can assert
@@ -20,10 +24,16 @@ that two runs under identical faults converge to the same
 ``state_digest``.
 """
 
+from kubeflow_tpu.chaos.fsfault import (
+    CrashHere,
+    FaultPlan,
+    FaultyIO,
+)
 from kubeflow_tpu.chaos.injector import (
     CHAOS_FAULTS,
     ChaosInjector,
     ChaoticAPIServer,
 )
 
-__all__ = ["CHAOS_FAULTS", "ChaosInjector", "ChaoticAPIServer"]
+__all__ = ["CHAOS_FAULTS", "ChaosInjector", "ChaoticAPIServer",
+           "CrashHere", "FaultPlan", "FaultyIO"]

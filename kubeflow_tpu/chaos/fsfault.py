@@ -1,0 +1,342 @@
+"""Seeded storage-fault injection for the durable-state layer.
+
+:class:`FaultyIO` implements the persistence layer's :class:`FileIO`
+surface — the injectable seam ``persistence.attach(io=...)`` accepts, so
+no test ever monkeypatches a file op — and consults a :class:`FaultPlan`
+at every WAL/snapshot operation.  Three fault families, matching how
+real storage dies:
+
+- **write faults**: ENOSPC/EIO raised on writes (optionally after
+  letting N bytes through — a *short write* whose torn prefix reaches
+  the OS, exactly the fragment a full disk leaves mid-line), on flush,
+  fsync, rename/replace, unlink;
+- **read faults**: seeded bit flips on read — the silent corruption the
+  CRC framing exists to catch;
+- **crash-here markers**: every mutating op is a numbered *write
+  boundary* (``plan.crossings``); a plan with ``crash_at=K`` SIGKILLs
+  the process at boundary K (tests may substitute ``on_crash``).
+  ``loadtest/load_crash.py`` enumerates the boundaries of a seeded
+  workload (``record=True`` keeps the named trace) and then kills a real
+  child at each one in turn.
+
+Rules match ops by fnmatch over names like ``write:wal.jsonl``,
+``fsync:snapshot.json.tmp``, ``rename:wal.jsonl``,
+``replace:snapshot.json``, ``remove:wal.jsonl.3`` — the basename keeps
+plans independent of tmp dirs.  Like the rest of ``chaos``, everything
+draws from one ``random.Random(seed)`` so a fault schedule replays
+bit-identically.
+"""
+
+from __future__ import annotations
+
+import errno
+import fnmatch
+import os
+import random
+import signal
+import threading
+
+from kubeflow_tpu.core.persistence import FileIO
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+FS_FAULTS = REGISTRY.counter(
+    "chaos_fs_faults_injected_total",
+    "storage faults injected by the fsfault layer", labels=("fault",))
+
+_ERRNOS = {"enospc": errno.ENOSPC, "eio": errno.EIO}
+
+
+class CrashHere(RuntimeError):
+    """What a test-supplied ``on_crash`` hook typically raises — the real
+    default is ``SIGKILL`` (a crash is not an exception)."""
+
+
+class Rule:
+    """One fault rule.  Ops matching ``pattern`` raise ``error``
+    (``enospc``/``eio``) — after letting ``after_bytes`` through first
+    (short writes), at most ``times`` times (None = until ``disarm()``).
+    ``flip=True`` rules corrupt reads instead of raising."""
+
+    def __init__(self, pattern: str, *, error: str = "enospc",
+                 times: int | None = None, after_bytes: int = 0,
+                 flip: bool = False, armed: bool = True):
+        if error not in _ERRNOS:
+            raise ValueError(f"unknown fault error {error!r}")
+        self.pattern = pattern
+        self.error = error
+        self.times = times
+        self.after_bytes = after_bytes
+        self.flip = flip
+        self.armed = armed
+
+    def arm(self) -> None:
+        self.armed = True
+
+    def disarm(self) -> None:
+        self.armed = False
+
+    def _spend(self) -> None:
+        if self.times is not None:
+            self.times -= 1
+            if self.times <= 0:
+                self.armed = False
+
+    def _raise(self, op: str) -> None:
+        FS_FAULTS.labels(self.error).inc()
+        raise OSError(_ERRNOS[self.error],
+                      f"injected {self.error} on {op}")
+
+
+class FaultPlan:
+    """Seeded, declarative plan of storage faults + crash points."""
+
+    def __init__(self, *, seed: int = 0, crash_at: int | None = None,
+                 on_crash=None, record: bool = False):
+        self.rng = random.Random(seed)
+        self.crash_at = crash_at
+        self.on_crash = on_crash  # None = SIGKILL this process
+        self.record = record
+        self.crossings = 0        # write boundaries crossed so far
+        self.trace: list[str] = []  # boundary names (record mode)
+        self._rules: list[Rule] = []
+        self._lock = threading.Lock()
+
+    def fail(self, pattern: str, *, error: str = "enospc",
+             times: int | None = None, after_bytes: int = 0,
+             armed: bool = True) -> Rule:
+        rule = Rule(pattern, error=error, times=times,
+                    after_bytes=after_bytes, armed=armed)
+        self._rules.append(rule)
+        return rule
+
+    def flip_reads(self, pattern: str, *, times: int | None = 1,
+                   armed: bool = True) -> Rule:
+        rule = Rule(pattern, flip=True, times=times, armed=armed)
+        self._rules.append(rule)
+        return rule
+
+    # -- crash markers ---------------------------------------------------------
+    def crossing(self, op: str) -> None:
+        """One write boundary.  Called by FaultyIO immediately before
+        every mutating op; fires the crash when the counter hits
+        ``crash_at``."""
+        with self._lock:
+            self.crossings += 1
+            if self.record:
+                self.trace.append(op)
+            crash = (self.crash_at is not None
+                     and self.crossings == self.crash_at)
+        if crash:
+            if self.on_crash is not None:
+                self.on_crash(op)
+                return
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- rule resolution -------------------------------------------------------
+    def check(self, op: str) -> None:
+        """Raise the first armed byte-budget-free error rule matching
+        ``op`` (flush/fsync/rename/replace/remove/open faults)."""
+        with self._lock:
+            rule = next(
+                (r for r in self._rules
+                 if r.armed and not r.flip and r.after_bytes == 0
+                 and fnmatch.fnmatch(op, r.pattern)), None)
+            if rule is not None:
+                rule._spend()
+        if rule is not None:
+            rule._raise(op)
+
+    def budget(self, op: str, n: int) -> tuple[int, Rule | None]:
+        """For an ``n``-byte write: (bytes allowed, rule to raise after
+        writing them — None when the whole write passes).  A rule with
+        remaining ``after_bytes`` budget eats into it; the write that
+        exhausts the budget lands short and then errors."""
+        with self._lock:
+            for rule in self._rules:
+                if (not rule.armed or rule.flip
+                        or not fnmatch.fnmatch(op, rule.pattern)):
+                    continue
+                if rule.after_bytes > 0:
+                    take = min(n, rule.after_bytes)
+                    rule.after_bytes -= take
+                    if rule.after_bytes > 0:
+                        return n, None  # budget left: whole write passes
+                    rule._spend()
+                    return take, rule
+                rule._spend()
+                return 0, rule
+        return n, None
+
+    def flip_rule(self, op: str) -> Rule | None:
+        with self._lock:
+            rule = next(
+                (r for r in self._rules
+                 if r.armed and r.flip and fnmatch.fnmatch(op, r.pattern)),
+                None)
+            if rule is not None:
+                rule._spend()
+        return rule
+
+
+def _flip(data: bytes, rng: random.Random) -> bytes:
+    ba = bytearray(data)
+    ba[rng.randrange(len(ba))] ^= 1 << rng.randrange(8)
+    return bytes(ba)
+
+
+class _FaultyWriter:
+    """Write-mode file handle: every write/flush/truncate is a crash
+    boundary and consults the plan; a short write flushes its torn
+    prefix to the OS before raising (what a real ENOSPC leaves)."""
+
+    def __init__(self, f, base: str, plan: FaultPlan):
+        self._f = f
+        self._base = base
+        self.plan = plan
+
+    @property
+    def name(self):
+        return self._f.name
+
+    def write(self, data):
+        op = f"write:{self._base}"
+        self.plan.crossing(op)
+        allowed, rule = self.plan.budget(op, len(data))
+        if rule is not None:
+            if allowed:
+                self._f.write(data[:allowed])
+                try:
+                    self._f.flush()  # the torn prefix reaches the OS
+                except OSError:
+                    pass
+            rule._raise(op)
+        return self._f.write(data)
+
+    def flush(self):
+        op = f"flush:{self._base}"
+        self.plan.crossing(op)
+        self.plan.check(op)
+        self._f.flush()
+
+    def truncate(self, size=None):
+        op = f"truncate:{self._base}"
+        self.plan.crossing(op)
+        self.plan.check(op)
+        return self._f.truncate(size)
+
+    def tell(self):
+        return self._f.tell()
+
+    def fileno(self):
+        return self._f.fileno()
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _FaultyReader:
+    """Read-mode file handle: reads pass through armed bit-flip rules
+    (``read:<basename>``) — the CRC framing's adversary."""
+
+    def __init__(self, f, base: str, plan: FaultPlan):
+        self._f = f
+        self._base = base
+        self.plan = plan
+
+    @property
+    def name(self):
+        return self._f.name
+
+    def _maybe_flip(self, data):
+        """Apply an armed flip rule to one read chunk (bytes or str)."""
+        if not data or self.plan.flip_rule(f"read:{self._base}") is None:
+            return data
+        FS_FAULTS.labels("bitflip").inc()
+        if isinstance(data, bytes):
+            return _flip(data, self.plan.rng)
+        i = self.plan.rng.randrange(len(data))
+        return data[:i] + chr(ord(data[i]) ^ 1) + data[i + 1:]
+
+    def read(self, *args):
+        return self._maybe_flip(self._f.read(*args))
+
+    def readline(self, *args):
+        return self._maybe_flip(self._f.readline(*args))
+
+    def __iter__(self):
+        # line iteration is a read path too (the WAL replays this way)
+        for line in self._f:
+            yield self._maybe_flip(line)
+
+    def tell(self):
+        return self._f.tell()
+
+    def fileno(self):
+        return self._f.fileno()
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class FaultyIO(FileIO):
+    """``persistence.FileIO`` with a :class:`FaultPlan` wired into every
+    op — pass to ``persistence.attach(io=FaultyIO(plan))``."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def open(self, path: str, mode: str = "r", encoding: str | None = None):
+        base = os.path.basename(path)
+        op = f"open:{base}"
+        writing = bool(set(mode) & set("wa+"))
+        if writing:
+            self.plan.crossing(op)  # "w" truncates: a write boundary
+        self.plan.check(op)
+        f = open(path, mode, encoding=encoding)
+        if writing:
+            return _FaultyWriter(f, base, self.plan)
+        return _FaultyReader(f, base, self.plan)
+
+    def fsync(self, f) -> None:
+        op = f"fsync:{os.path.basename(getattr(f, 'name', '?'))}"
+        self.plan.crossing(op)
+        self.plan.check(op)
+        os.fsync(f.fileno())
+
+    def replace(self, src: str, dst: str) -> None:
+        op = f"replace:{os.path.basename(dst)}"
+        self.plan.crossing(op)
+        self.plan.check(op)
+        os.replace(src, dst)
+
+    def rename(self, src: str, dst: str) -> None:
+        op = f"rename:{os.path.basename(src)}"
+        self.plan.crossing(op)
+        self.plan.check(op)
+        os.rename(src, dst)
+
+    def remove(self, path: str) -> None:
+        op = f"remove:{os.path.basename(path)}"
+        self.plan.crossing(op)
+        self.plan.check(op)
+        os.remove(path)
+
+    def fsync_dir(self, path: str) -> None:
+        op = "fsyncdir"
+        self.plan.crossing(op)
+        self.plan.check(op)
+        super().fsync_dir(path)

@@ -73,6 +73,19 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         # opts out on constrained hosts.
         "chaos_cmd": [sys.executable, "loadtest/load_chaos.py", "--smoke"],
     },
+    "durability": {
+        "include_dirs": ["kubeflow_tpu/core/persistence.py",
+                         "kubeflow_tpu/chaos/fsfault.py",
+                         "loadtest/load_crash.py"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+                     "tests/test_persistence.py"],
+        # crash-point recovery sweep (smoke subset): SIGKILL a real
+        # subprocess at a sampled set of WAL/snapshot write boundaries,
+        # re-attach, and assert every acknowledged mutation recovered
+        # with deterministic digests.  KF_SKIP_CRASH_SWEEP=1 opts out on
+        # constrained hosts.
+        "crash_cmd": [sys.executable, "loadtest/load_crash.py", "--smoke"],
+    },
     "notebooks": {
         "include_dirs": ["kubeflow_tpu/controllers/notebook.py",
                          "kubeflow_tpu/controllers/culler.py",
@@ -189,6 +202,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "chaos_cmd" in spec:
         steps.append({"name": "chaos", "run": spec["chaos_cmd"],
                       "depends": ["test"]})
+    if "crash_cmd" in spec:
+        steps.append({"name": "crash-sweep", "run": spec["crash_cmd"],
+                      "depends": ["test"]})
     if "overload_cmd" in spec:
         steps.append({"name": "overload", "run": spec["overload_cmd"],
                       "depends": ["test"]})
@@ -227,6 +243,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "chaos_cmd" in spec
                 and os.environ.get("KF_SKIP_CHAOS") != "1"):
             ok = subprocess.run(spec["chaos_cmd"]).returncode == 0
+        if (ok and "crash_cmd" in spec
+                and os.environ.get("KF_SKIP_CRASH_SWEEP") != "1"):
+            ok = subprocess.run(spec["crash_cmd"]).returncode == 0
         if (ok and "overload_cmd" in spec
                 and os.environ.get("KF_SKIP_OVERLOAD") != "1"):
             ok = subprocess.run(spec["overload_cmd"]).returncode == 0

@@ -66,8 +66,13 @@ class RestAPI:
     def __call__(self, environ, start_response):
         if environ.get("PATH_INFO", "").rstrip("/") == "/apis/watch":
             return self._watch_stream(environ, start_response)
+        extra_headers: list[tuple[str, str]] = []
         try:
-            status, body = self._route(environ)
+            out = self._route(environ)
+            if len(out) == 3:  # (status, body, extra response headers)
+                status, body, extra_headers = out
+            else:
+                status, body = out
         except NotFound as e:
             status, body = "404 Not Found", {"error": str(e)}
         except Conflict as e:
@@ -87,7 +92,8 @@ class RestAPI:
             payload = json.dumps(body).encode()
             ctype = "application/json"
         start_response(status, [("Content-Type", ctype),
-                                ("Content-Length", str(len(payload)))])
+                                ("Content-Length", str(len(payload)))]
+                       + extra_headers)
         return [payload]
 
     # -- routing ---------------------------------------------------------------
@@ -107,6 +113,16 @@ class RestAPI:
         if not parts or parts[0] != "apis":
             raise NotFound(f"no route {path}")
         parts = parts[1:]
+
+        if method != "GET" and getattr(self.server, "degraded", False):
+            # etcd's NOSPACE-alarm contract: a store whose journal cannot
+            # reach disk refuses NEW mutations instead of acknowledging
+            # writes it may lose; reads keep serving, and the persister's
+            # prober lifts the flag once the WAL accepts appends again
+            from kubeflow_tpu.core.store import DEGRADED_MSG
+
+            return ("503 Service Unavailable", {"error": DEGRADED_MSG},
+                    [("Retry-After", "1")])
 
         if not parts and method == "GET":
             # kind discovery (k8s API-group discovery's role): a

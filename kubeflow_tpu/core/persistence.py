@@ -24,9 +24,45 @@ High-churn ephemeral status (``status.logTail``) is elided from journaled
 records — log lines are re-derived from the live pod on demand and are not
 part of durable state.
 
+Integrity (ISSUE 7, etcd's per-record CRC + snapshot hash):
+
+- every WAL record is framed ``crc32hex|json`` (8 hex chars, a pipe, the
+  payload); legacy unframed lines still replay.  On replay, a bad FINAL
+  line of the FINAL log (the live WAL at crash time) is a *torn tail* —
+  tolerated, logged with file+offset, counted in
+  ``persistence_torn_records_total``, and truncated away by the boot
+  compaction.  A bad line anywhere ELSE is *corruption* — counted in
+  ``persistence_corrupt_records_total`` and raised loud
+  (:class:`WALCorrupt` with the offending byte offset), never replayed
+  as garbage.
+- snapshots carry a whole-file CRC32 in a ``#crc32:`` footer
+  (:func:`read_snapshot` verifies it; footer-less legacy snapshots still
+  load).  Each compaction keeps the PREVIOUS snapshot as
+  ``snapshot.json.bak`` until the next one succeeds; a corrupt or
+  missing primary falls back to the ``.bak`` + surviving segments
+  (counted in ``persistence_snapshot_fallbacks_total``).
+
+Degraded mode (etcd's NOSPACE alarm):  an IO failure inside the journal
+hook (ENOSPC, EIO) must never fail or block a mutation that already
+committed in memory, and must never silently drop durability either.  The
+failed record — and every record journaled while the fault persists —
+buffers in memory, the store flips ``server.degraded`` (httpapi answers
+mutations 503 + ``Retry-After``; reads still serve), and a background
+prober retries the WAL with backoff, replays the buffered records IN
+ORDER, and lifts the flag only once everything acknowledged is durable
+again.
+
+All disk access goes through an injectable IO seam (:class:`FileIO`):
+``chaos.fsfault.FaultyIO`` wraps it with seeded fault plans (short
+writes, ENOSPC after N bytes, EIO on fsync, bit flips on read,
+crash-here markers) — no monkeypatching.  ``loadtest/load_crash.py``
+SIGKILLs a real subprocess at every write boundary the fault layer
+reports and proves recovery of everything acknowledged.
+
 Layout under ``data_dir``:
-    snapshot.json   {"rv": N, "objects": [...]} — full store at compaction
-    wal.jsonl       one {"op": "put"|"del", ...} line per mutation since
+    snapshot.json      {"rv": N, "objects": [...]} + ``#crc32:`` footer
+    snapshot.json.bak  the previous snapshot (corruption fallback)
+    wal.jsonl          one ``crc|{"op": ...}`` line per mutation since
 
 Records are flushed per append (a liveness-probe restart loses nothing
 acknowledged); fsync per record is opt-in (``fsync=True``) for
@@ -47,9 +83,12 @@ were already admitted when first written, and no watcher exists before
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
+import time
+import zlib
 
 from kubeflow_tpu.core.store import APIServer
 from kubeflow_tpu.utils.logging import get_logger
@@ -58,6 +97,7 @@ from kubeflow_tpu.utils.metrics import REGISTRY
 log = get_logger("persistence")
 
 SNAPSHOT = "snapshot.json"
+BAK = SNAPSHOT + ".bak"
 WAL = "wal.jsonl"
 
 # runtime compaction thresholds (either trips it)
@@ -81,51 +121,155 @@ COMPACTION_FAILURES = REGISTRY.counter(
 COMPACTION_FAILURE_STREAK = REGISTRY.gauge(
     "persistence_compaction_failure_streak",
     "consecutive failed background compactions (0 = healthy)")
+TORN_RECORDS = REGISTRY.counter(
+    "persistence_torn_records_total",
+    "torn WAL tails dropped during replay (crash mid-append)")
+CORRUPT_RECORDS = REGISTRY.counter(
+    "persistence_corrupt_records_total",
+    "mid-stream WAL records failing CRC/parse (replay refuses them)")
+SNAPSHOT_FALLBACKS = REGISTRY.counter(
+    "persistence_snapshot_fallbacks_total",
+    "recoveries served from snapshot.json.bak (primary corrupt/missing)")
+JOURNAL_ERRORS = REGISTRY.counter(
+    "persistence_journal_errors_total",
+    "WAL append/probe failures (ENOSPC, EIO) absorbed by degraded mode")
+DEGRADED = REGISTRY.gauge(
+    "persistence_degraded",
+    "1 while the WAL is unreachable and mutations buffer in memory")
+PENDING = REGISTRY.gauge(
+    "persistence_pending_records",
+    "acknowledged records buffered in memory awaiting WAL replay")
 
 # ephemeral status fields never journaled: high-churn, re-derivable
 EPHEMERAL_STATUS = ("logTail",)
 
 LOCKFILE = "LOCK"
 
+_FOOTER = b"\n#crc32:"
 
-def _fsync_dir(path: str) -> None:
-    """Make renames in ``path`` durable: fsync the directory itself."""
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
+
+class CorruptionError(RuntimeError):
+    """Checksum/parse failure in durable state (not a torn tail)."""
+
+
+class WALCorrupt(CorruptionError):
+    """A mid-stream WAL record failed its CRC or did not parse."""
+
+
+class SnapshotCorrupt(CorruptionError):
+    """A snapshot file failed its whole-file checksum or did not parse."""
+
+
+class FileIO:
+    """The one seam persistence touches disk through.  Chaos tests pass
+    ``chaos.fsfault.FaultyIO`` (same surface, seeded fault plan) into
+    ``attach(io=...)`` instead of monkeypatching file ops."""
+
+    def open(self, path: str, mode: str = "r", encoding: str | None = None):
+        return open(path, mode, encoding=encoding)
+
+    def fsync(self, f) -> None:
+        os.fsync(f.fileno())
+
+    def replace(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.rename(src, dst)
+
+    def remove(self, path: str) -> None:
+        os.remove(path)
+
+    def fsync_dir(self, path: str) -> None:
+        """Make renames in ``path`` durable: fsync the directory itself."""
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+_IO = FileIO()
 
 
 class WriteAheadLog:
-    def __init__(self, path: str, *, fsync: bool = False):
+    def __init__(self, path: str, *, fsync: bool = False,
+                 io: FileIO | None = None):
         self.path = path
         self.fsync = fsync
+        self.io = io or _IO
         self._lock = threading.Lock()
-        self._f = open(path, "a", encoding="utf-8")
+        self._f = self.io.open(path, "a", encoding="utf-8")
         self.bytes = self._f.tell()
         self.records = 0
         self._seg_n: int | None = None  # lazily seeded from disk
+        # set when an append failed mid-line: the file may hold a torn
+        # fragment past self.bytes that must be truncated away before the
+        # next append can merge with it into mid-stream garbage
+        self._needs_repair = False
 
     def append(self, record: dict) -> None:
-        line = json.dumps(record, separators=(",", ":"))
+        payload = json.dumps(record, separators=(",", ":"))
+        # etcd-style integrity framing: crc32 of the payload bytes, then
+        # the payload (json.dumps is ASCII-safe, so len == byte length)
+        line = f"{zlib.crc32(payload.encode()):08x}|{payload}\n"
         with self._lock:
-            self._f.write(line + "\n")
-            self._f.flush()
-            if self.fsync:
-                os.fsync(self._f.fileno())
-            self.bytes += len(line) + 1
+            if self._needs_repair:
+                self._repair()  # raises OSError while still unwritable
+            try:
+                self._f.write(line)
+                self._f.flush()
+                if self.fsync:
+                    self.io.fsync(self._f)
+            except OSError:
+                self._needs_repair = True
+                try:
+                    self._repair()
+                except OSError:
+                    pass  # stays marked; next append retries the repair
+                raise
+            self.bytes += len(line)
             self.records += 1
+
+    def _repair(self) -> None:
+        """Re-anchor the file to the last known-good byte: reopen and
+        truncate any partial write past ``self.bytes``.  Caller holds
+        ``_lock``; raises OSError if the file is still unusable."""
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+        f = self.io.open(self.path, "a", encoding="utf-8")
+        try:
+            size = f.tell()
+            if size > self.bytes:
+                f.truncate(self.bytes)
+            elif size < self.bytes:
+                self.bytes = size  # external truncation: re-anchor
+        except OSError:
+            try:
+                f.close()
+            except OSError:
+                pass
+            raise
+        self._f = f
+        self._needs_repair = False
 
     def truncate(self) -> None:
         """Reset to an empty log (caller has just snapshotted)."""
         with self._lock:
-            self._f.close()
-            self._f = open(self.path, "w", encoding="utf-8")
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = self.io.open(self.path, "w", encoding="utf-8")
             self._f.flush()
-            os.fsync(self._f.fileno())
+            self.io.fsync(self._f)
             self.bytes = 0
             self.records = 0
+            self._needs_repair = False
 
     def rotate(self) -> str:
         """Move the live log aside as a numbered segment and start fresh.
@@ -135,6 +279,8 @@ class WriteAheadLog:
         number would break replay order when an uncovered newer segment
         outlives a covered older one."""
         with self._lock:
+            if self._needs_repair:
+                self._repair()
             if self._seg_n is None:
                 existing = [0]
                 d, base = os.path.split(self.path)
@@ -144,21 +290,47 @@ class WriteAheadLog:
                         existing.append(int(suffix))
                 self._seg_n = max(existing)
             self._seg_n += 1
-            self._f.close()
             seg = f"{self.path}.{self._seg_n}"
-            os.rename(self.path, seg)
-            self._f = open(self.path, "w", encoding="utf-8")
+            try:
+                self._f.close()
+            except OSError:
+                self._f = None
+                self._needs_repair = True
+                raise
+            try:
+                self.io.rename(self.path, seg)
+            except OSError:
+                # rotation did NOT happen: reattach to the un-rotated log
+                self._seg_n -= 1
+                self._f = None
+                self._needs_repair = True
+                try:
+                    self._repair()
+                except OSError:
+                    pass
+                raise
+            self.bytes = 0
+            self.records = 0
+            try:
+                self._f = self.io.open(self.path, "w", encoding="utf-8")
+            except OSError:
+                # rotation DID happen; the fresh log reopens on repair
+                self._f = None
+                self._needs_repair = True
+                raise
             # the rename (and the fresh file's dirent) is durable only
             # once the directory is — without this, a power failure could
             # drop records already fsync'd into the new file
-            _fsync_dir(os.path.dirname(self.path) or ".")
-            self.bytes = 0
-            self.records = 0
+            self.io.fsync_dir(os.path.dirname(self.path) or ".")
             return seg
 
     def close(self) -> None:
         with self._lock:
-            self._f.close()
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
 
 
 def _wal_segments(data_dir: str) -> list[str]:
@@ -172,36 +344,181 @@ def _wal_segments(data_dir: str) -> list[str]:
     return [p for _, p in sorted(segs)]
 
 
-def _load_records(data_dir: str):
-    """Yield ("put", obj) / ("del", key) from snapshot, then any rotated
-    WAL segments (a crash can leave them mid-compaction; replaying records
-    the snapshot already holds is idempotent), then the live WAL — skipping
-    a torn final line (a crash mid-append must not poison recovery)."""
-    snap_path = os.path.join(data_dir, SNAPSHOT)
-    if os.path.exists(snap_path):
-        with open(snap_path, encoding="utf-8") as f:
-            snap = json.load(f)
-        for obj in snap.get("objects", []):
-            yield "put", obj
-    for wal_path in _wal_segments(data_dir) + [os.path.join(data_dir,
-                                                            WAL)]:
-        if not os.path.exists(wal_path):
-            continue
-        with open(wal_path, encoding="utf-8") as f:
-            for n, line in enumerate(f):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    log.warning("dropping torn WAL record", line_no=n,
-                                path=wal_path)
-                    continue
-                if rec.get("op") == "put":
-                    yield "put", rec["obj"]
-                elif rec.get("op") == "del":
-                    yield "del", tuple(rec["key"])
+def _parse_wal_line(raw: bytes):
+    """(record, None) or (None, why-it-is-bad).  ``crc|json`` framed lines
+    verify the CRC first; legacy unframed lines (pre-ISSUE-7 WALs start
+    with ``{``, which can never parse as 8 hex chars) parse directly.
+    A record must be a JSON OBJECT: a torn fragment can parse as a bare
+    scalar (``41ab2c3d|...`` torn after two bytes leaves ``41``, valid
+    JSON!) and must classify as bad, not crash replay downstream."""
+    if len(raw) > 9 and raw[8:9] == b"|":
+        try:
+            want = int(raw[:8], 16)
+        except ValueError:
+            want = None
+        if want is not None:
+            payload = raw[9:]
+            if zlib.crc32(payload) != want:
+                return None, "crc mismatch"
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                return None, "unparseable payload behind matching crc"
+            if isinstance(rec, dict):
+                return rec, None
+            return None, "non-object record behind matching crc"
+    try:
+        rec = json.loads(raw)
+    except ValueError:
+        return None, "unparseable record"
+    if isinstance(rec, dict):
+        return rec, None
+    return None, "non-object record"
+
+
+def _iter_wal(path: str, io: FileIO, tail_ok: bool):
+    """Yield parsed records from one WAL file.  A bad FINAL line is a torn
+    tail when ``tail_ok`` (this is the last file in replay order): dropped,
+    logged with file+offset, counted.  A bad line anywhere else — or in a
+    non-final file — is corruption: counted and raised loud with the
+    offending byte offset, because replaying past it would resurrect a
+    store that silently diverges from what was acknowledged."""
+    def parse(off: int, line: bytes, last: bool):
+        rec, bad = _parse_wal_line(line)
+        if bad is None:
+            return rec
+        if tail_ok and last:
+            TORN_RECORDS.inc()
+            log.warning("dropping torn WAL tail", path=path,
+                        offset=off, reason=bad)
+            return None
+        CORRUPT_RECORDS.inc()
+        raise WALCorrupt(
+            f"corrupt WAL record in {path} at byte offset {off}: "
+            f"{bad} (mid-stream, not a torn tail — refusing to "
+            "replay past it)")
+
+    # streamed with ONE line of lookahead (a pending entry is only
+    # parsed once a later non-empty line proves it is not the tail):
+    # slurping the whole file held 2x+ its size live, and a WAL is
+    # unbounded while a compaction-failure streak stops reclaiming it
+    with io.open(path, "rb") as f:
+        offset = 0
+        pending: tuple[int, bytes] | None = None
+        for raw in f:
+            if raw.strip():
+                if pending is not None:
+                    rec = parse(*pending, last=False)
+                    if rec is not None:
+                        yield rec
+                pending = (offset, raw.rstrip(b"\n"))
+            offset += len(raw)
+        if pending is not None:
+            rec = parse(*pending, last=True)
+            if rec is not None:
+                yield rec
+
+
+def read_snapshot(path: str, io: FileIO | None = None) -> dict:
+    """Load + verify one snapshot file.  New snapshots end in a
+    ``#crc32:XXXXXXXX`` footer over every byte before it; legacy
+    footer-less snapshots load unverified.  Raises :class:`SnapshotCorrupt`
+    on checksum mismatch or unparseable JSON."""
+    io = io or _IO
+    with io.open(path, "rb") as f:
+        raw = f.read()
+    idx = raw.rfind(_FOOTER)
+    body = raw
+    if idx != -1:
+        body, footer = raw[:idx], raw[idx + 1:].strip()
+        try:
+            want = int(footer[len(_FOOTER) - 1:], 16)
+        except ValueError as e:
+            raise SnapshotCorrupt(f"{path}: mangled checksum footer ({e})")
+        if zlib.crc32(body) != want:
+            raise SnapshotCorrupt(
+                f"{path}: whole-file checksum mismatch "
+                f"(want {want:08x}, got {zlib.crc32(body):08x})")
+    try:
+        return json.loads(body)
+    except ValueError as e:
+        raise SnapshotCorrupt(f"{path}: unparseable snapshot ({e})")
+
+
+def _snapshot_objects(data_dir: str, io: FileIO) -> list[dict]:
+    """Objects from the best available snapshot: the primary when it
+    verifies, else ``snapshot.json.bak`` (kept by every compaction until
+    the next succeeds) — corruption of BOTH is unrecoverable and raises.
+
+    Two distinct fallback windows, logged at different severities:
+
+    - primary MISSING, ``.bak`` present — the crash landed between the
+      bak-rename and the new snapshot's rename.  Recovery is COMPLETE:
+      the segments the unborn snapshot would have covered are still on
+      disk (they are only deleted after it lands).
+    - primary CORRUPT (bit rot caught by the footer CRC) — recovery is
+      BEST-EFFORT: records journaled between the ``.bak`` snapshot and
+      the corrupt primary survive only in segments the primary's
+      compaction may already have reclaimed.  Partial acked state beats
+      refusing to boot (etcd keeps no fallback at all here), but the
+      possible gap is an ERROR the operator must see, never a silent
+      revert."""
+    primary = os.path.join(data_dir, SNAPSHOT)
+    bak = os.path.join(data_dir, BAK)
+    primary_err: SnapshotCorrupt | None = None
+    if os.path.exists(primary):
+        try:
+            return read_snapshot(primary, io).get("objects", [])
+        except SnapshotCorrupt as e:
+            primary_err = e
+    if os.path.exists(bak):
+        objs = read_snapshot(bak, io).get("objects", [])  # may raise too
+        SNAPSHOT_FALLBACKS.inc()
+        if primary_err is not None:
+            # sideline the corrupt primary BEFORE the boot compaction
+            # runs: _persist_snapshot rolls the current primary into
+            # ``.bak``, and rolling a file that failed verification over
+            # the last GOOD snapshot would leave corruption as the only
+            # fallback.  Kept as ``.corrupt`` for forensics.
+            try:
+                io.replace(primary, primary + ".corrupt")
+            except OSError:
+                pass
+            log.error(
+                "primary snapshot CORRUPT; recovering from "
+                "snapshot.json.bak — records journaled after the .bak "
+                "snapshot survive only in still-on-disk WAL segments; "
+                "any reclaimed by the corrupt primary's compaction are "
+                "lost", error=str(primary_err), objects=len(objs),
+                surviving_segments=len(_wal_segments(data_dir)))
+        else:
+            log.warning("primary snapshot missing (crash between "
+                        "snapshot renames); recovering from "
+                        "snapshot.json.bak + its covered segments",
+                        objects=len(objs))
+        return objs
+    if primary_err is not None:
+        raise primary_err
+    return []
+
+
+def _load_records(data_dir: str, io: FileIO | None = None):
+    """Yield ("put", obj) / ("del", key) from snapshot (with ``.bak``
+    fallback), then any rotated WAL segments (a crash can leave them
+    mid-compaction; replaying records the snapshot already holds is
+    idempotent), then the live WAL.  Only the LAST existing log may end in
+    a tolerated torn tail; corruption anywhere else fails loud."""
+    io = io or _IO
+    for obj in _snapshot_objects(data_dir, io):
+        yield "put", obj
+    wal_files = [p for p in _wal_segments(data_dir)
+                 + [os.path.join(data_dir, WAL)] if os.path.exists(p)]
+    for i, wal_path in enumerate(wal_files):
+        for rec in _iter_wal(wal_path, io, tail_ok=i == len(wal_files) - 1):
+            if rec.get("op") == "put":
+                yield "put", rec["obj"]
+            elif rec.get("op") == "del":
+                yield "del", tuple(rec["key"])
 
 
 def _journal_view(obj: dict) -> dict:
@@ -225,25 +542,52 @@ class Persister:
     def __init__(self, server: APIServer, data_dir: str, *,
                  fsync: bool = False,
                  compact_bytes: int = COMPACT_BYTES,
-                 compact_records: int = COMPACT_RECORDS):
+                 compact_records: int = COMPACT_RECORDS,
+                 io: FileIO | None = None,
+                 sync_compact: bool = False,
+                 probe_interval: float = 0.25):
         self.server = server
         self.data_dir = data_dir
         self.compact_bytes = compact_bytes
         self.compact_records = compact_records
-        self.wal = WriteAheadLog(os.path.join(data_dir, WAL), fsync=fsync)
+        self.io = io or _IO
+        self.sync_compact = sync_compact
+        self.probe_interval = probe_interval
+        self.wal = WriteAheadLog(os.path.join(data_dir, WAL), fsync=fsync,
+                                 io=self.io)
         self._inflight: threading.Thread | None = None
         self._lock_fd: int | None = None  # flock on data_dir/LOCK
         self.consecutive_failures = 0  # background compactions in a row
+        # -- degraded mode (all guarded by server._lock, the journal's
+        # calling context): records acknowledged while the WAL is
+        # unreachable buffer here IN ORDER until the prober replays them
+        # (deque: the replay drains from the left under the store lock —
+        # a list's pop(0) would go quadratic on a long outage's backlog)
+        self.degraded = False
+        self._pending: collections.deque[dict] = collections.deque()
+        self._prober: threading.Thread | None = None
+        self._closed = False  # detach() happened; prober must exit
 
     def journal(self, op: str, payload) -> None:
         if op == "put":
-            self.wal.append({"op": "put", "obj": _journal_view(payload)})
+            rec = {"op": "put", "obj": _journal_view(payload)}
         else:
-            self.wal.append({"op": "del", "key": list(payload)})
+            rec = {"op": "del", "key": list(payload)}
+        if self.degraded:
+            # the mutation already committed in memory and will be
+            # acknowledged; dropping the record would silently lose
+            # durability, raising would fail a write that happened.
+            # Buffer it — the prober replays _pending in order before
+            # the degraded flag clears.
+            self._buffer(rec)
+            return
+        try:
+            self.wal.append(rec)
+        except OSError as e:
+            self._enter_degraded(rec, e)
+            return
         if (self.wal.bytes >= self.compact_bytes
                 or self.wal.records >= self.compact_records):
-            import time as _t
-
             from kubeflow_tpu.core.store import _jcopy
 
             # under the store lock (journal's contract): the live WAL is
@@ -251,31 +595,145 @@ class Persister:
             # snapshot write is in flight); the copy + spawn happens only
             # when no write is running — the next crossing after it
             # finishes covers any segments that piled up meanwhile
-            self.wal.rotate()
-            if self._inflight is not None and self._inflight.is_alive():
+            try:
+                self.wal.rotate()
+            except OSError as e:
+                # disk refused the rotation: segments/snapshot untouched,
+                # the live WAL keeps growing; the next crossing retries
+                self.consecutive_failures += 1
+                COMPACTION_FAILURES.inc()
+                COMPACTION_FAILURE_STREAK.set(self.consecutive_failures)
+                log.error("WAL rotation failed", error=str(e),
+                          consecutive_failures=self.consecutive_failures)
                 return
-            t0 = _t.perf_counter()
+            if (not self.sync_compact and self._inflight is not None
+                    and self._inflight.is_alive()):
+                return
+            t0 = time.perf_counter()
             objs = [_jcopy(o) for o in self.server._objects.values()]
             rv = self.server._rv
             segs = _wal_segments(self.data_dir)
-            pause = _t.perf_counter() - t0
+            pause = time.perf_counter() - t0
             COMPACTION_PAUSE.set(pause)
+            if self.sync_compact:
+                # deterministic mode (the crash-point harness): snapshot
+                # write + segment reclaim run inline under the store
+                # lock, so every write boundary is crossed on ONE thread
+                # in a reproducible order
+                self._write_snapshot(objs, rv, segs, pause)
+                return
             self._inflight = threading.Thread(
                 target=self._write_snapshot, args=(objs, rv, segs, pause),
                 daemon=True)
             self._inflight.start()
 
+    # -- degraded mode ---------------------------------------------------------
+    def _buffer(self, rec: dict) -> None:
+        from kubeflow_tpu.core.store import _jcopy
+
+        # _journal_view's aliasing argument ("json.dumps happens
+        # immediately, under the store lock") does not hold here: a
+        # buffered record serializes only when the prober flushes,
+        # possibly much later.  Copy the object now so the WAL records
+        # acknowledged history even if a future store change mutates
+        # objects in place.
+        if "obj" in rec:
+            rec = {"op": rec["op"], "obj": _jcopy(rec["obj"])}
+        self._pending.append(rec)
+        PENDING.set(len(self._pending))
+        if len(self._pending) % 10_000 == 0:
+            log.warning("storage degraded: unjournaled records piling up "
+                        "in memory", pending=len(self._pending))
+
+    def _enter_degraded(self, rec: dict, err: OSError) -> None:
+        """Called under the store lock when a WAL append fails: flip the
+        store read-only over HTTP, buffer the record, start the prober."""
+        JOURNAL_ERRORS.inc()
+        self._buffer(rec)
+        if self.degraded:
+            return
+        self.degraded = True
+        self.server.degraded = True
+        DEGRADED.set(1)
+        log.error("WAL append failed; store degraded (httpapi refuses "
+                  "new mutations, reads still serve, committed records "
+                  "buffer until the WAL heals)", error=str(err),
+                  error_type=type(err).__name__)
+        # spawn unconditionally on every False->True transition: gating
+        # on the previous prober's is_alive() races its teardown (it can
+        # report alive after its loop already returned, leaving nobody
+        # to retry — permanent 503s).  A straggler from the previous
+        # episode just flushes or exits under the same lock; harmless.
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        daemon=True, name="wal-prober")
+        self._prober.start()
+
+    def _flush_pending(self) -> None:
+        """Replay buffered records into the WAL in order (caller holds
+        the store lock).  Raises OSError at the first record the WAL
+        still refuses; everything appended before that is durable and
+        leaves the buffer."""
+        while self._pending:
+            self.wal.append(self._pending[0])
+            self._pending.popleft()
+            PENDING.set(len(self._pending))
+
+    def _probe_loop(self) -> None:
+        backoff = self.probe_interval
+        while True:
+            time.sleep(backoff)
+            with self.server._lock:
+                if self._closed or not self.degraded:
+                    return
+                try:
+                    self._flush_pending()
+                except OSError:
+                    JOURNAL_ERRORS.inc()
+                else:
+                    # every acknowledged record is durable again
+                    self.degraded = False
+                    self.server.degraded = False
+                    DEGRADED.set(0)
+                    log.info("WAL writable again; store un-degraded")
+                    return
+            backoff = min(backoff * 2, 2.0)
+
+    def health(self) -> dict:
+        """Dashboard-facing standing of this data dir."""
+        return {
+            "degraded": self.degraded,
+            "pending_records": len(self._pending),
+            "wal_bytes": self.wal.bytes,
+            "wal_records": self.wal.records,
+            "segments": len(_wal_segments(self.data_dir)),
+            "snapshot_failure_streak": self.consecutive_failures,
+        }
+
+    # -- snapshots -------------------------------------------------------------
     def _persist_snapshot(self, objs, rv: int) -> None:
         """The one atomic-snapshot sequence both compaction paths share:
-        tmp write, file fsync, rename, directory fsync."""
-        snap_tmp = os.path.join(self.data_dir, SNAPSHOT + ".tmp")
-        snap = {"rv": rv, "objects": [_journal_view(o) for o in objs]}
-        with open(snap_tmp, "w", encoding="utf-8") as f:
-            json.dump(snap, f)
+        tmp write (+ checksum footer), file fsync, roll the previous
+        snapshot to ``.bak``, rename, directory fsync.  If a crash lands
+        between the two renames, recovery finds no primary and serves the
+        ``.bak`` — whose rotated segments are still on disk."""
+        snap_path = os.path.join(self.data_dir, SNAPSHOT)
+        snap_tmp = snap_path + ".tmp"
+        body = json.dumps({"rv": rv,
+                           "objects": [_journal_view(o) for o in objs]})
+        f = self.io.open(snap_tmp, "w", encoding="utf-8")
+        try:
+            f.write(body)
+            f.write(f"\n#crc32:{zlib.crc32(body.encode()):08x}\n")
             f.flush()
-            os.fsync(f.fileno())
-        os.replace(snap_tmp, os.path.join(self.data_dir, SNAPSHOT))
-        _fsync_dir(self.data_dir)
+            self.io.fsync(f)
+        finally:
+            f.close()
+        if os.path.exists(snap_path):
+            # keep the previous snapshot until THIS compaction succeeds:
+            # a flipped bit in the new primary stays recoverable
+            self.io.replace(snap_path, os.path.join(self.data_dir, BAK))
+        self.io.replace(snap_tmp, snap_path)
+        self.io.fsync_dir(self.data_dir)
 
     def _write_snapshot(self, objs: list[dict], rv: int, segs: list[str],
                         pause: float) -> None:
@@ -287,7 +745,7 @@ class Persister:
         try:
             self._persist_snapshot(objs, rv)
             for seg in segs:
-                os.remove(seg)
+                self.io.remove(seg)
             WAL_COMPACTIONS.inc()
             self.consecutive_failures = 0
             COMPACTION_FAILURE_STREAK.set(0)
@@ -295,7 +753,7 @@ class Persister:
                      lock_pause_ms=round(pause * 1e3, 1))
         except Exception as e:  # NOT just OSError (ADVICE r5): a
             # non-JSON-serializable value in the store raises TypeError
-            # from json.dump, and swallowing it with a bare traceback
+            # from json.dumps, and swallowing it with a bare traceback
             # would silently kill compaction while every later threshold
             # crossing rotates another never-reclaimed segment.  Segments
             # stay on disk; the next crossing retries with a fresh
@@ -323,18 +781,22 @@ class Persister:
                                self.server._rv)
         self.wal.truncate()
         for seg in _wal_segments(self.data_dir):
-            os.remove(seg)
+            self.io.remove(seg)
 
 
 def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
            compact_bytes: int = COMPACT_BYTES,
-           compact_records: int = COMPACT_RECORDS) -> APIServer:
+           compact_records: int = COMPACT_RECORDS,
+           io: FileIO | None = None,
+           sync_compact: bool = False,
+           probe_interval: float = 0.25) -> APIServer:
     """Replay ``data_dir`` into ``server``, compact, and hook the journal so
     every further mutation is logged.  Idempotent per process; the server
     must not have a journal attached already."""
     if server._journal is not None:
         raise RuntimeError("store already has a journal attached")
     os.makedirs(data_dir, exist_ok=True)
+    io = io or _IO
 
     # one live writer per data dir, enforced before the first read: an
     # abandoned writer's background snapshot could otherwise clobber a
@@ -353,7 +815,8 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
             "(LOCK held); detach() it first")
 
     # everything past the flock must release it on failure (ADVICE r5):
-    # a raise during replay, orphan GC, or the post-replay compact would
+    # a raise during replay — including a WALCorrupt/SnapshotCorrupt from
+    # the integrity checks — orphan GC, or the post-replay compact would
     # otherwise leak the held LOCK fd, making every in-process retry of
     # attach() fail "already has a live writer" with no writer alive
     persister: Persister | None = None
@@ -368,7 +831,7 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
         objects: dict[tuple, dict] = {}
         max_rv = 0
         count = 0
-        for op, payload in _load_records(data_dir):
+        for op, payload in _load_records(data_dir, io):
             count += 1
             if op == "put":
                 try:
@@ -415,11 +878,14 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
 
         persister = Persister(server, data_dir, fsync=fsync,
                               compact_bytes=compact_bytes,
-                              compact_records=compact_records)
+                              compact_records=compact_records,
+                              io=io, sync_compact=sync_compact,
+                              probe_interval=probe_interval)
         persister._lock_fd = lock_fd
         with server._lock:
             persister.compact()
             server._journal = persister.journal
+            server.degraded = False
         if objects:
             log.info("state recovered", objects=len(objects),
                      records_replayed=count, rv=max_rv)
@@ -450,24 +916,43 @@ def detach(server: APIServer, timeout: float = 30.0) -> None:
     the snapshot would hand a successor exactly the stale-clobber the
     flock exists to prevent.  The journal is only unhooked under the
     store lock once no snapshot is in flight, so no mutation ever lands
-    in an unjournaled gap."""
-    import time as _t
+    in an unjournaled gap.
 
+    A degraded store gets ONE final chance to re-journal its buffered
+    records; if the WAL still refuses, the loss is logged loud (the
+    records were acknowledged) rather than silently dropped."""
     j = server._journal
     if j is None:
         return
     persister = j.__self__
-    deadline = _t.monotonic() + timeout
+    deadline = time.monotonic() + timeout
     while True:
-        persister.quiesce(max(0.0, deadline - _t.monotonic()))
+        persister.quiesce(max(0.0, deadline - time.monotonic()))
         with server._lock:
             t = persister._inflight
             if t is None or not t.is_alive():
                 # holding the lock: no mutation (hence no new journal
                 # append or compaction) can race the unhook
+                if persister._pending:
+                    try:
+                        persister._flush_pending()
+                        persister.degraded = False
+                    except OSError as e:
+                        log.error(
+                            "detach with WAL still unwritable: "
+                            "acknowledged records LOST with this process",
+                            lost=len(persister._pending), error=str(e))
+                        persister._pending.clear()
+                    # either way this store no longer holds a degraded
+                    # journal: a stuck persistence_degraded=1 with no
+                    # attached writer would be a permanent false alarm
+                    DEGRADED.set(0)
+                    PENDING.set(0)
+                persister._closed = True
                 server._journal = None
+                server.degraded = False
                 break
-            if _t.monotonic() >= deadline:
+            if time.monotonic() >= deadline:
                 raise RuntimeError(
                     "background compaction still running after "
                     f"{timeout:.0f}s; data dir not released")

@@ -33,6 +33,14 @@ from typing import Any, Callable, Iterable
 from kubeflow_tpu.core import objects as ob
 
 
+# what every HTTP mutation surface answers (503 + Retry-After) while
+# server.degraded is set — the check lives in each frontend's dispatch
+# (httpapi, CrudApp, kfam) because in-PROCESS writers must keep
+# committing; only NEW external acknowledgements stop
+DEGRADED_MSG = ("storage degraded: WAL unavailable; mutations refused "
+                "until durability recovers")
+
+
 class NotFound(KeyError):
     pass
 
@@ -132,6 +140,12 @@ class APIServer:
         # ("put", obj) / ("del", (kind, ns, name)) after every committed
         # state change — None = memory-only (tests, envtest-style harness)
         self._journal: Callable[[str, Any], None] | None = None
+        # storage-degraded flag (core.persistence, etcd NOSPACE-alarm
+        # semantics): True while the journal cannot reach disk.  httpapi
+        # refuses NEW mutations with 503 + Retry-After while set;
+        # in-process writers keep committing (their records buffer in the
+        # persister until the WAL heals, so nothing acknowledged is lost)
+        self.degraded = False
 
     def _record(self, op: str, payload) -> None:
         if self._journal is not None:

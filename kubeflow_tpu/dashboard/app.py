@@ -73,6 +73,8 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/serving-health",
                        self.serving_health_route)
         self.add_route("GET", "/api/nodes", self.nodes_route)
+        self.add_route("GET", "/api/persistence-health",
+                       self.persistence_health_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -148,6 +150,13 @@ class DashboardApp(CrudApp):
         to dead nodes, gang preemptions, injected chaos faults) — the
         cluster robustness card."""
         return "200 OK", self.metrics.get_cluster_health()
+
+    def persistence_health_route(self, req: Request):
+        """Durable-state standing (the storage robustness card): WAL
+        bytes/segments, degraded flag + buffered records, snapshot
+        failure streak, and the torn/corrupt/fallback integrity
+        counters."""
+        return "200 OK", self.metrics.get_persistence_health()
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

@@ -100,6 +100,41 @@ def serving_health_state() -> dict:
     }
 
 
+def persistence_health_state(server) -> dict:
+    """Durable-state standing (the storage robustness card): WAL size and
+    rotated-segment count, whether the store is degraded (journal
+    unreachable; httpapi answering mutations 503), records buffered in
+    memory awaiting replay, snapshot failure streak, and the integrity
+    counters — torn tails tolerated, corrupt records refused, and
+    recoveries served from ``snapshot.json.bak``.  Live figures come off
+    the attached Persister; counters from the process registry."""
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    def val(name: str) -> float:
+        m = REGISTRY.get_metric(name)
+        return m.get() if m is not None else 0.0
+
+    j = getattr(server, "_journal", None)
+    persister = getattr(j, "__self__", None) if j is not None else None
+    state = {
+        "attached": persister is not None,
+        "degraded": bool(getattr(server, "degraded", False)),
+        "wal_bytes": 0, "wal_records": 0, "segments": 0,
+        "pending_records": 0, "snapshot_failure_streak": 0,
+    }
+    if persister is not None:
+        state.update(persister.health())
+    state.update({
+        "torn_records": val("persistence_torn_records_total"),
+        "corrupt_records": val("persistence_corrupt_records_total"),
+        "snapshot_fallbacks": val("persistence_snapshot_fallbacks_total"),
+        "journal_errors": val("persistence_journal_errors_total"),
+        "compactions": val("persistence_wal_compactions_total"),
+        "compaction_failures": val("persistence_compaction_failures_total"),
+    })
+    return state
+
+
 def cluster_health(server) -> dict:
     """Node heartbeat standing + failure-recovery counters (the
     robustness card): per-node heartbeat age/readiness straight from the
@@ -155,6 +190,8 @@ class MetricsService(Protocol):
 
     def get_cluster_health(self) -> dict: ...
 
+    def get_persistence_health(self) -> dict: ...
+
 
 class LocalMetricsService:
     """Derives series from the in-memory API server (pod counts as a proxy
@@ -209,6 +246,9 @@ class LocalMetricsService:
 
     def get_cluster_health(self) -> dict:
         return cluster_health(self.server)
+
+    def get_persistence_health(self) -> dict:
+        return persistence_health_state(self.server)
 
 
 class CloudMonitoringMetricsService:
@@ -276,6 +316,11 @@ class CloudMonitoringMetricsService:
         # node heartbeats live in the platform's own store, like the
         # autoscaler's standing
         return cluster_health(self.server) if self.server else {"nodes": []}
+
+    def get_persistence_health(self):
+        # the WAL is this process's disk, never a cloud series
+        return (persistence_health_state(self.server) if self.server
+                else {"attached": False})
 
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:

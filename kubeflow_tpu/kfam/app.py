@@ -62,8 +62,20 @@ class KfamApp:
         path = environ.get("PATH_INFO", "/").rstrip("/")
         method = environ["REQUEST_METHOD"]
         user = self._user(environ)
+        extra_headers: list[tuple[str, str]] = []
         try:
-            status, body = self._route(method, path, environ, user)
+            if (method not in ("GET", "HEAD", "OPTIONS")
+                    and getattr(self.server, "degraded", False)):
+                # storage-degraded fence (see core.httpapi): profile and
+                # binding writes must not be acknowledged while the WAL
+                # cannot journal them
+                from kubeflow_tpu.core.store import DEGRADED_MSG
+
+                extra_headers.append(("Retry-After", "1"))
+                status, body = ("503 Service Unavailable",
+                                {"error": DEGRADED_MSG})
+            else:
+                status, body = self._route(method, path, environ, user)
         except PermissionError as e:
             status, body = "403 Forbidden", {"error": str(e)}
         except NotFound as e:
@@ -80,7 +92,8 @@ class KfamApp:
             payload = json.dumps(body).encode()
             ctype = "application/json"
         start_response(status, [("Content-Type", ctype),
-                                ("Content-Length", str(len(payload)))])
+                                ("Content-Length", str(len(payload)))]
+                       + extra_headers)
         return [payload]
 
     def _route(self, method, path, environ, user):

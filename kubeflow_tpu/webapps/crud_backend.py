@@ -88,6 +88,16 @@ class CrudApp:
             route, params = self._match(method, path)
             user = self._authn(environ, route)
             self._csrf(environ, method, headers)
+            if (method not in SAFE_METHODS
+                    and getattr(self.server, "degraded", False)):
+                # storage-degraded fence, shared by every CrudApp-based
+                # frontend (dashboard, webapps): never acknowledge a
+                # mutation the WAL cannot journal (core.httpapi and kfam
+                # carry the same check in their own dispatch)
+                from kubeflow_tpu.core.store import DEGRADED_MSG
+
+                headers.append(("Retry-After", "1"))
+                raise HTTPError("503 Service Unavailable", DEGRADED_MSG)
             req = Request(self, environ, user, params)
             status, body = route.fn(req)
         except HTTPError as e:

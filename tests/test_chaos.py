@@ -234,3 +234,91 @@ def _create_retry(server, obj):
         except Conflict:
             time.sleep(0.002)
     raise RuntimeError("create never landed")
+
+
+# -- storage-fault layer (chaos.fsfault, ISSUE 7) ------------------------------
+
+def test_fsfault_short_write_leaves_torn_prefix(tmp_path):
+    """An ENOSPC-after-N-bytes rule lands exactly N bytes (the torn
+    fragment a real full disk leaves) and then raises — the shape the
+    WAL's repair path must truncate away."""
+    from kubeflow_tpu.chaos.fsfault import FaultPlan, FaultyIO
+
+    plan = FaultPlan(seed=0)
+    plan.fail("write:f.txt", error="enospc", after_bytes=5, times=1)
+    io = FaultyIO(plan)
+    f = io.open(str(tmp_path / "f.txt"), "w", encoding="utf-8")
+    with pytest.raises(OSError) as e:
+        f.write("0123456789")
+    assert e.value.errno == 28  # ENOSPC
+    f.close()
+    assert open(tmp_path / "f.txt").read() == "01234"
+    # the rule is spent: the next write passes whole
+    f = io.open(str(tmp_path / "f.txt"), "a", encoding="utf-8")
+    f.write("rest")
+    f.close()
+    assert open(tmp_path / "f.txt").read() == "01234rest"
+
+
+def test_fsfault_eio_on_fsync_and_rule_lifecycle(tmp_path):
+    from kubeflow_tpu.chaos.fsfault import FaultPlan, FaultyIO
+
+    plan = FaultPlan(seed=0)
+    rule = plan.fail("fsync:*", error="eio")
+    io = FaultyIO(plan)
+    f = io.open(str(tmp_path / "f.txt"), "w", encoding="utf-8")
+    f.write("x")
+    f.flush()
+    with pytest.raises(OSError) as e:
+        io.fsync(f)
+    assert e.value.errno == 5  # EIO
+    rule.disarm()
+    io.fsync(f)  # disarmed: real fsync passes
+    f.close()
+
+
+def test_fsfault_bitflip_on_read_is_caught_by_snapshot_checksum(tmp_path):
+    """A seeded bit flip on the read path — silent media corruption — is
+    detected by the snapshot's whole-file CRC, never loaded as truth."""
+    from kubeflow_tpu.chaos.fsfault import FaultPlan, FaultyIO
+    from kubeflow_tpu.core import persistence
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    persistence.attach(server, str(tmp_path))
+    server.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": "x", "namespace": "d"},
+                   "spec": {"payload": "A" * 200}})
+    persistence.detach(server)
+    persistence.attach(server := APIServer(), str(tmp_path))
+    persistence.detach(server)  # second compaction: snapshot holds the CM
+
+    plan = FaultPlan(seed=5)
+    plan.flip_reads("read:snapshot.json", times=1)
+    with pytest.raises(persistence.SnapshotCorrupt):
+        persistence.read_snapshot(
+            os.path.join(str(tmp_path), persistence.SNAPSHOT),
+            FaultyIO(plan))
+
+
+def test_fsfault_crash_marker_fires_at_exact_boundary(tmp_path):
+    """crash_at=K fires at the K-th write boundary — the primitive the
+    crash-point sweep builds on (tests substitute on_crash; the real
+    default is SIGKILL)."""
+    from kubeflow_tpu.chaos.fsfault import CrashHere, FaultPlan, FaultyIO
+
+    crashed_at = []
+
+    def on_crash(op):
+        crashed_at.append(op)
+        raise CrashHere(op)
+
+    plan = FaultPlan(seed=0, crash_at=3, on_crash=on_crash, record=True)
+    io = FaultyIO(plan)
+    f = io.open(str(tmp_path / "f.txt"), "w", encoding="utf-8")  # 1: open
+    f.write("a")                                                 # 2: write
+    with pytest.raises(CrashHere):
+        f.write("b")                                             # 3: boom
+    assert plan.trace == ["open:f.txt", "write:f.txt", "write:f.txt"]
+    assert crashed_at == ["write:f.txt"]
+    f.close()

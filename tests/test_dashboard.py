@@ -198,3 +198,59 @@ def test_serving_health_route(stack):
     assert "admission_wait_p99_s" in state
     assert "gateway_shed" in state
     assert state["draining"] in (True, False)
+
+
+def test_persistence_health_route(stack, tmp_path):
+    """Durable-state card (ISSUE 7): WAL bytes/segments, degraded flag,
+    buffered records, snapshot failure streak, torn/corrupt/fallback
+    counters — live off the attached Persister once a data dir exists,
+    gracefully 'attached: False' before."""
+    from kubeflow_tpu.core import persistence
+
+    server, mgr, base = stack
+    code, state = req(base, "/dashboard/api/persistence-health",
+                      user="alice@corp.com")
+    assert code == 200 and state["attached"] is False
+
+    persistence.attach(server, str(tmp_path))
+    try:
+        server.create(api_object("ConfigMap", "journaled", "team-a",
+                                 spec={}))
+        code, state = req(base, "/dashboard/api/persistence-health",
+                          user="alice@corp.com")
+        assert code == 200
+        assert state["attached"] is True and state["degraded"] is False
+        assert state["wal_records"] >= 1 and state["wal_bytes"] > 0
+        assert set(state) >= {"segments", "pending_records",
+                              "snapshot_failure_streak", "torn_records",
+                              "corrupt_records", "snapshot_fallbacks",
+                              "journal_errors", "compactions",
+                              "compaction_failures"}
+    finally:
+        persistence.detach(server)
+
+
+def test_dashboard_degraded_store_503s_writes(stack):
+    """The shared CrudApp fence (ISSUE 7): every dashboard/webapp
+    mutation 503s with Retry-After while the store is degraded — a
+    workgroup create must not be acknowledged into an unjournalable
+    WAL.  Reads keep serving."""
+    import urllib.error
+
+    server, mgr, base = stack
+    carol = Session(base, "carol@corp.com")  # primes the CSRF cookie
+    server.degraded = True
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            carol.req("/dashboard/api/workgroup/create", "POST",
+                      {"namespace": "team-c"})
+        assert e.value.code == 503
+        assert e.value.headers["Retry-After"] == "1"
+        code, _ = req(base, "/dashboard/api/namespaces",
+                      user="carol@corp.com")
+        assert code == 200
+    finally:
+        server.degraded = False
+    from kubeflow_tpu.core.store import NotFound
+    with pytest.raises(NotFound):
+        server.get("Profile", "team-c")

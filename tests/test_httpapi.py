@@ -211,3 +211,50 @@ def test_truncated_framed_response_closes_connection():
         conn.close()
     finally:
         httpd.shutdown()
+
+
+def test_degraded_store_rejects_writes_503_serves_reads(endpoint, tmp_path):
+    """ISSUE 7 ENOSPC drill, REST leg: while the WAL is unreachable the
+    API answers every mutation 503 + Retry-After (etcd NOSPACE-alarm
+    semantics) but keeps serving reads; once the disk heals and the
+    prober un-degrades the store, writes flow again and everything
+    acknowledged during the fault is durable."""
+    import time as _t
+
+    from kubeflow_tpu.chaos.fsfault import FaultPlan, FaultyIO
+    from kubeflow_tpu.core import persistence
+
+    server, base = endpoint
+    plan = FaultPlan(seed=3)
+    persistence.attach(server, str(tmp_path), io=FaultyIO(plan),
+                       probe_interval=0.02)
+    code, _ = req(f"{base}/apis/Notebook", "POST",
+                  api_object("Notebook", "pre", "team", spec={}))
+    assert code == 201
+    rule = plan.fail("write:wal.jsonl", error="enospc")
+    # an IN-PROCESS writer (a controller) commits during the fault: that
+    # record buffers — it must survive, HTTP just stops taking NEW risk
+    server.create(api_object("Notebook", "inproc", "team", spec={}))
+    assert server.degraded
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(f"{base}/apis/Notebook", "POST",
+            api_object("Notebook", "refused", "team", spec={}))
+    assert e.value.code == 503
+    assert e.value.headers["Retry-After"] == "1"
+    code, listing = req(f"{base}/apis/Notebook?namespace=team")  # reads OK
+    assert code == 200 and len(listing["items"]) == 2
+    rule.disarm()
+    deadline = _t.monotonic() + 5
+    while server.degraded and _t.monotonic() < deadline:
+        _t.sleep(0.01)
+    assert not server.degraded
+    code, _ = req(f"{base}/apis/Notebook", "POST",
+                  api_object("Notebook", "after", "team", spec={}))
+    assert code == 201
+    persistence.detach(server)
+    s2 = APIServer()
+    persistence.attach(s2, str(tmp_path))
+    names = {o["metadata"]["name"] for o in s2.list("Notebook",
+                                                    namespace="team")}
+    assert names == {"pre", "inproc", "after"}
+    persistence.detach(s2)

@@ -99,7 +99,8 @@ def test_compaction_bounds_wal(tmp_path):
 
     _attach(tmp_path, prev=s1)  # restart compacts: snapshot fills, WAL empties
     assert os.path.getsize(wal) == 0
-    snap = json.load(open(os.path.join(tmp_path, persistence.SNAPSHOT)))
+    snap = persistence.read_snapshot(
+        os.path.join(tmp_path, persistence.SNAPSHOT))
     assert len(snap["objects"]) == 50
 
 
@@ -342,7 +343,8 @@ def test_replay_upconverts_stale_storage_versions(tmp_path):
     assert stored["spec"]["template"]["spec"]["containers"][0][
         "image"] == "jax:v1"
     # the compacted snapshot on disk is pure hub-version
-    snap = json.load(open(os.path.join(tmp_path, persistence.SNAPSHOT)))
+    snap = persistence.read_snapshot(
+        os.path.join(tmp_path, persistence.SNAPSHOT))
     assert snap["objects"][0]["apiVersion"] == "kubeflow-tpu.org/v1"
 
 
@@ -401,3 +403,323 @@ def test_recovery_collects_orphans_of_interrupted_cascade(tmp_path):
     # ...and owned objects with LIVE owners survive
     s2.get("Notebook", "keep", "t")
     s2.get("StatefulSet", "keep", "t")
+
+
+# -- ISSUE 7: integrity framing, corruption drills, degraded mode -------------
+
+def test_wal_records_carry_crc_and_legacy_lines_replay(tmp_path):
+    """Every appended record is ``crc32hex|json`` framed (etcd's
+    per-record CRC); unframed lines from a pre-upgrade WAL still replay,
+    so an in-place upgrade never loses the old journal."""
+    import re
+
+    with open(os.path.join(tmp_path, persistence.WAL), "w") as f:
+        f.write(json.dumps({"op": "put", "obj": {
+            "kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": "legacy", "namespace": "d",
+                         "resourceVersion": "1", "uid": "u0"},
+            "spec": {}}}) + "\n")
+    s1 = _attach(tmp_path)
+    s1.get("ConfigMap", "legacy", "d")  # unframed record recovered
+    s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+               "metadata": {"name": "framed", "namespace": "d"},
+               "spec": {}})
+    line = open(os.path.join(tmp_path, persistence.WAL)).readline()
+    assert re.match(r"^[0-9a-f]{8}\|\{", line)
+    s2 = _attach(tmp_path, prev=s1)
+    s2.get("ConfigMap", "legacy", "d")
+    s2.get("ConfigMap", "framed", "d")
+    persistence.detach(s2)
+
+
+def test_torn_tail_is_counted_and_logged(tmp_path):
+    """The torn-final-line drop is no longer silent: it bumps
+    persistence_torn_records_total (satellite: a counter the dashboard
+    card surfaces) and recovery still succeeds."""
+    s1 = _attach(tmp_path)
+    s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+               "metadata": {"name": "ok", "namespace": "d"}, "spec": {}})
+    with open(os.path.join(tmp_path, persistence.WAL), "a") as f:
+        f.write('deadbeef|{"op": "put", "obj": {"kind"')  # crash mid-append
+    before = persistence.TORN_RECORDS.get()
+    s2 = _attach(tmp_path, prev=s1)
+    assert persistence.TORN_RECORDS.get() == before + 1
+    s2.get("ConfigMap", "ok", "d")
+    persistence.detach(s2)
+
+
+def test_midstream_corruption_fails_loud_with_offset(tmp_path):
+    """A flipped bit in a NON-final WAL record is detected by its CRC and
+    refused with the offending file+offset — replaying past it would
+    silently diverge from what was acknowledged.  The failed attach
+    releases the flock (satellite regression): a retry after repair must
+    not see a phantom live writer."""
+    s1 = _attach(tmp_path)
+    for i in range(3):
+        s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": f"cm-{i}", "namespace": "d"},
+                   "spec": {}})
+    persistence.detach(s1)
+    wal = os.path.join(tmp_path, persistence.WAL)
+    intact = open(wal, "rb").read()
+    lines = intact.split(b"\n")
+    flipped = bytearray(lines[0])
+    flipped[40] ^= 0x01  # one bit, mid-record
+    corrupt = persistence.CORRUPT_RECORDS.get()
+    with open(wal, "wb") as f:
+        f.write(b"\n".join([bytes(flipped)] + lines[1:]))
+    with pytest.raises(persistence.WALCorrupt, match="byte offset 0"):
+        persistence.attach(APIServer(), str(tmp_path))
+    assert persistence.CORRUPT_RECORDS.get() == corrupt + 1
+    # flock was released on the failure path: repair + retry IN PROCESS
+    with open(wal, "wb") as f:
+        f.write(intact)
+    s2 = _attach(tmp_path)
+    assert len(s2.list("ConfigMap", namespace="d")) == 3
+    persistence.detach(s2)
+
+
+def test_corrupt_snapshot_without_bak_fails_loud_and_releases_flock(
+        tmp_path):
+    s1 = _attach(tmp_path)
+    for i in range(3):
+        s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": f"cm-{i}", "namespace": "d"},
+                   "spec": {}})
+    persistence.detach(s1)
+    snap = os.path.join(tmp_path, persistence.SNAPSHOT)
+    raw = bytearray(open(snap, "rb").read())
+    raw[len(raw) // 4] ^= 0x04
+    with open(snap, "wb") as f:
+        f.write(raw)
+    with pytest.raises(persistence.SnapshotCorrupt, match="checksum"):
+        persistence.attach(APIServer(), str(tmp_path))
+    # no .bak to fall back on — but the flock is free, so dropping the
+    # corrupt snapshot (its records are still in the WAL) recovers
+    os.remove(snap)
+    s2 = _attach(tmp_path)
+    assert len(s2.list("ConfigMap", namespace="d")) == 3
+    persistence.detach(s2)
+
+
+def test_corrupt_snapshot_falls_back_to_bak_and_segments(tmp_path):
+    """The acceptance drill: a flipped bit in the primary snapshot is
+    detected by the whole-file checksum, and recovery reconstructs the
+    FULL state from snapshot.json.bak (kept by every compaction until
+    the next succeeds) + the rotated segments + the live WAL."""
+    s1 = _attach(tmp_path)
+    for i in range(10):
+        s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": f"cm-{i}", "namespace": "d"},
+                   "spec": {}})
+    s2 = _attach(tmp_path, prev=s1)  # compacts: snapshot B(10), .bak=A
+    persister = s2._journal.__self__
+    for i in range(10, 15):
+        s2.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": f"cm-{i}", "namespace": "d"},
+                   "spec": {}})
+    persister.wal.rotate()  # 5 records now live in a segment
+    for i in range(15, 17):
+        s2.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": f"cm-{i}", "namespace": "d"},
+                   "spec": {}})
+    # snapshot C(17) lands, rolling B to .bak — the crash window where C
+    # then rots on disk while its covered segments still exist
+    persister._persist_snapshot(s2._objects.values(), s2._rv)
+    persistence.detach(s2)
+    snap = os.path.join(tmp_path, persistence.SNAPSHOT)
+    raw = bytearray(open(snap, "rb").read())
+    raw[len(raw) // 2] ^= 0x10
+    with open(snap, "wb") as f:
+        f.write(raw)
+    fallbacks = persistence.SNAPSHOT_FALLBACKS.get()
+    s3 = _attach(tmp_path)
+    assert len(s3.list("ConfigMap", namespace="d")) == 17
+    assert persistence.SNAPSHOT_FALLBACKS.get() == fallbacks + 1
+    # the corrupt primary was SIDELINED (.corrupt), never rolled into
+    # .bak by the boot compaction — both on-disk snapshots verify, so a
+    # second corruption event still has a good fallback
+    assert os.path.exists(snap + ".corrupt")
+    persistence.read_snapshot(snap)
+    persistence.read_snapshot(os.path.join(tmp_path, persistence.BAK))
+    persistence.detach(s3)
+
+
+def test_enospc_degrades_buffers_and_recovers(tmp_path):
+    """The ENOSPC drill: a full disk mid-journal never fails the mutation
+    (it already committed in memory), flips the degraded flag, buffers
+    every acknowledged record, and un-degrades — with the buffer replayed
+    into the WAL in order — once appends succeed again."""
+    import time as _t
+
+    from kubeflow_tpu.chaos.fsfault import FaultPlan, FaultyIO
+
+    plan = FaultPlan(seed=7)
+    server = APIServer()
+    persistence.attach(server, str(tmp_path), io=FaultyIO(plan),
+                       probe_interval=0.02)
+    server.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": "pre", "namespace": "d"},
+                   "spec": {}})
+    rule = plan.fail("write:wal.jsonl", error="enospc")
+    server.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": "during", "namespace": "d"},
+                   "spec": {}})  # acknowledged despite the dead disk
+    assert server.degraded
+    server.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": "during2", "namespace": "d"},
+                   "spec": {}})
+    persister = server._journal.__self__
+    assert len(persister._pending) == 2
+    assert persister.health()["degraded"]
+    rule.disarm()  # space returns
+    deadline = _t.monotonic() + 5
+    while server.degraded and _t.monotonic() < deadline:
+        _t.sleep(0.01)
+    assert not server.degraded and not persister._pending
+    s2 = _attach(tmp_path, prev=server)  # nothing acknowledged was lost
+    assert {o["metadata"]["name"] for o in s2.list("ConfigMap",
+                                                   namespace="d")} == {
+        "pre", "during", "during2"}
+    persistence.detach(s2)
+
+
+def test_eio_on_fsync_degrades(tmp_path):
+    """EIO from fsync (dying disk, fsync=True durability mode) takes the
+    same degraded path as ENOSPC on write."""
+    import time as _t
+
+    from kubeflow_tpu.chaos.fsfault import FaultPlan, FaultyIO
+
+    plan = FaultPlan(seed=8)
+    server = APIServer()
+    persistence.attach(server, str(tmp_path), io=FaultyIO(plan),
+                       fsync=True, probe_interval=0.02)
+    rule = plan.fail("fsync:wal.jsonl", error="eio")
+    server.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": "x", "namespace": "d"},
+                   "spec": {}})
+    assert server.degraded
+    rule.disarm()
+    deadline = _t.monotonic() + 5
+    while server.degraded and _t.monotonic() < deadline:
+        _t.sleep(0.01)
+    assert not server.degraded
+    s2 = _attach(tmp_path, prev=server)
+    s2.get("ConfigMap", "x", "d")
+    persistence.detach(s2)
+
+
+def test_subprocess_sigkill_mid_storm_recovers_all_acked(tmp_path):
+    """Satellite: a REAL child process is SIGKILLed mid-write-storm; the
+    parent re-attaches the data dir and every mutation the child
+    acknowledged over its pipe before dying is present (complements the
+    seeded in-process crash-point sweep in loadtest/load_crash.py)."""
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+    import time as _t
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = f"""
+import json, sys
+sys.path.insert(0, {root!r})
+from kubeflow_tpu.core import persistence
+from kubeflow_tpu.core.store import APIServer
+server = APIServer()
+persistence.attach(server, sys.argv[1])
+i = 0
+while True:
+    obj = server.create({{"kind": "ConfigMap", "apiVersion": "v1",
+                          "metadata": {{"name": f"cm-{{i}}",
+                                        "namespace": "d"}},
+                          "spec": {{"i": i}}}})
+    print(json.dumps({{"name": obj["metadata"]["name"],
+                       "rv": obj["metadata"]["resourceVersion"]}}),
+          flush=True)
+    i += 1
+"""
+    proc = subprocess.Popen([_sys.executable, "-c", script, str(tmp_path)],
+                            stdout=subprocess.PIPE, text=True)
+    acked = []
+    deadline = _t.monotonic() + 30
+    while len(acked) < 25 and _t.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.endswith("\n"):
+            acked.append(json.loads(line))
+    assert len(acked) >= 25, "child never produced a write storm"
+    proc.kill()  # SIGKILL: no atexit, no flush, mid-write with luck
+    proc.wait(timeout=10)
+    rest, _ = proc.communicate()
+    for line in rest.splitlines(keepends=True):
+        if line.endswith("\n"):  # a torn final line was never delivered
+            acked.append(json.loads(line))
+    assert proc.returncode == -_signal.SIGKILL
+
+    server = APIServer()  # the flock died with the child
+    persistence.attach(server, str(tmp_path))
+    for ack in acked:
+        obj = server.get("ConfigMap", ack["name"], "d")
+        assert int(obj["metadata"]["resourceVersion"]) == int(ack["rv"])
+    # no resurrections: at most the single in-flight create beyond acks
+    assert len(server.list("ConfigMap", namespace="d")) <= len(acked) + 1
+    persistence.detach(server)
+
+
+def test_corrupt_primary_after_segment_reclaim_boots_best_effort(
+        tmp_path):
+    """The OTHER fallback window: when the corrupt primary's compaction
+    already reclaimed its covered segments, ``.bak`` recovery is
+    best-effort — records journaled between the two snapshots are gone.
+    The contract is to boot with partial acked state LOUDLY (error log +
+    fallback counter) rather than refuse entirely or silently revert:
+    this must never look like a clean recovery."""
+    s1 = _attach(tmp_path)
+    for i in range(3):
+        s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": f"old-{i}", "namespace": "d"},
+                   "spec": {}})
+    s2 = _attach(tmp_path, prev=s1)   # primary B(3 old), .bak = A
+    for i in range(3):
+        s2.create({"kind": "ConfigMap", "apiVersion": "v1",
+                   "metadata": {"name": f"new-{i}", "namespace": "d"},
+                   "spec": {}})
+    s3 = _attach(tmp_path, prev=s2)   # primary C(6), .bak = B(3 old),
+    persistence.detach(s3)            # WAL truncated, segments reclaimed
+    snap = os.path.join(tmp_path, persistence.SNAPSHOT)
+    raw = bytearray(open(snap, "rb").read())
+    raw[len(raw) // 2] ^= 0x20
+    with open(snap, "wb") as f:
+        f.write(raw)
+    fallbacks = persistence.SNAPSHOT_FALLBACKS.get()
+    s4 = _attach(tmp_path)
+    names = {o["metadata"]["name"]
+             for o in s4.list("ConfigMap", namespace="d")}
+    assert names == {"old-0", "old-1", "old-2"}  # .bak state, not silence
+    assert persistence.SNAPSHOT_FALLBACKS.get() == fallbacks + 1
+    persistence.detach(s4)
+
+
+def test_torn_tail_parsing_as_bare_scalar_is_tolerated(tmp_path):
+    """A crash can tear a framed line down to a digit-only CRC prefix
+    ('41ab...' torn after two bytes leaves '41' — VALID json, but not a
+    record).  As a tail it is torn (tolerated); mid-stream it is
+    corruption (WALCorrupt), never an AttributeError deep in replay."""
+    s1 = _attach(tmp_path)
+    s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+               "metadata": {"name": "ok", "namespace": "d"}, "spec": {}})
+    persistence.detach(s1)
+    wal = os.path.join(tmp_path, persistence.WAL)
+    intact = open(wal).read()
+    with open(wal, "a") as f:
+        f.write("41")  # torn tail, parses as a bare JSON int
+    torn = persistence.TORN_RECORDS.get()
+    s2 = _attach(tmp_path)
+    assert persistence.TORN_RECORDS.get() == torn + 1
+    s2.get("ConfigMap", "ok", "d")
+    persistence.detach(s2)
+    # the same fragment MID-stream fails loud with the offset
+    with open(wal, "w") as f:
+        f.write("41\n" + intact)
+    with pytest.raises(persistence.WALCorrupt, match="byte offset 0"):
+        persistence.attach(APIServer(), str(tmp_path))

@@ -325,3 +325,24 @@ def test_deleting_clusterrole_revokes_access():
     assert can_i(server, "alice@corp.com", "create", "Notebook", "team")
     server.delete("ClusterRole", "kubeflow-admin")
     assert not can_i(server, "alice@corp.com", "create", "Notebook", "team")
+
+
+def test_kfam_degraded_store_503s_writes(kfam):
+    """The storage-degraded fence covers kfam too (ISSUE 7): profile and
+    binding mutations are never acknowledged while the WAL is down —
+    503 + Retry-After, reads unaffected."""
+    server, mgr, base = kfam
+    server.degraded = True
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            kreq(base, "/kfam/v1/profiles", "POST", {"name": "nope"},
+                 user="x@corp.com")
+        assert e.value.code == 503
+        assert e.value.headers["Retry-After"] == "1"
+        code, _ = kreq(base, "/kfam/v1/role/clusteradmin",
+                       user="x@corp.com")
+        assert code == 200
+    finally:
+        server.degraded = False
+    with pytest.raises(NotFound):
+        server.get("Profile", "nope")
