@@ -1,0 +1,13 @@
+"""kfvet — the platform's project-invariant static analyzer.
+
+``python -m kubeflow_tpu.analysis [--format=text|json] [paths...]``
+
+AST-based (stdlib only), fixture-tested, wired into every CI component
+(``ci/pipelines.py`` ``vet_cmd``, ``KF_SKIP_VET=1`` opt-out).  Rules and
+the ``# kfvet: ignore[rule]`` suppression syntax are documented in
+README.md ("Static checks") and ARCHITECTURE.md decision 16.
+"""
+
+from kubeflow_tpu.analysis.framework import (  # noqa: F401
+    Finding, ModuleInfo, Pass, all_rules, analyze_paths, register)
+from kubeflow_tpu.analysis import passes  # noqa: F401  (registers passes)

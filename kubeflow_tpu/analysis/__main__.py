@@ -1,0 +1,56 @@
+"""kfvet CLI: ``python -m kubeflow_tpu.analysis [options] [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.  JSON mode additionally
+prints one greppable ``kfvet_findings_total{rule="..."} N`` line per rule
+to stderr so CI/loadtest logs stay searchable without parsing the blob.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter as _Counter
+
+from kubeflow_tpu.analysis import all_rules, analyze_paths
+
+DEFAULT_PATHS = ["kubeflow_tpu/"]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu.analysis",
+        description="kfvet: project-invariant static analysis")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories (default: kubeflow_tpu/)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print every rule id and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(rule)
+        return 0
+
+    findings = analyze_paths(args.paths or DEFAULT_PATHS)
+    per_rule = _Counter(f.rule for f in findings)
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "summary": {"total": len(findings), "by_rule": dict(per_rule)},
+        }, indent=2, sort_keys=True))
+        for rule in sorted(per_rule):
+            print(f'kfvet_findings_total{{rule="{rule}"}} {per_rule[rule]}',
+                  file=sys.stderr)
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"kfvet: {len(findings)} finding(s) in "
+                  f"{len(per_rule)} rule(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

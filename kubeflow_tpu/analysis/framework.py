@@ -1,0 +1,249 @@
+"""kfvet pass framework: parse cache, suppressions, pass registry.
+
+The platform's correctness now rests on invariants no runtime test checks
+deterministically — "never block under the store lock", "deciders take an
+injected clock", "counters end in ``_total``" (ARCHITECTURE.md decision 16).
+Go projects encode exactly this class of rule in ``go vet``/staticcheck
+analyzers and run them on every presubmit; this is the Python equivalent,
+built on stdlib ``ast`` only.
+
+Mechanics:
+
+- every scanned file is parsed ONCE per (mtime, size) and shared by all
+  passes (the parse cache — passes see a :class:`ModuleInfo`);
+- findings are suppressible per line with ``# kfvet: ignore[rule]`` (or
+  ``ignore[rule-a,rule-b]``), either trailing the offending line or on a
+  standalone comment line immediately above it;
+- a suppression that silences nothing is itself a finding
+  (``unused-suppression``), so stale opt-outs cannot accumulate;
+- passes are registered classes, instantiated fresh per run: per-file
+  ``check`` plus a cross-file ``finalize`` for whole-program rules
+  (duplicate metric registration, dashboard references).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable
+
+SUPPRESS_RE = re.compile(r"#\s*kfvet:\s*ignore\[([A-Za-z0-9_,\- ]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclass
+class Suppression:
+    decl_line: int        # line the comment sits on
+    covered_line: int     # line whose findings it silences
+    rules: tuple[str, ...]
+    used: bool = False
+
+
+@dataclass
+class ModuleInfo:
+    path: str                     # as given (posix separators)
+    tree: ast.Module
+    lines: list[str]
+    suppressions: list[Suppression] = field(default_factory=list)
+
+    def in_scope(self, *fragments: str) -> bool:
+        """True when the module path falls under any scope fragment
+        (substring match on the posix path, e.g. ``kubeflow_tpu/core/``)."""
+        return any(f in self.path for f in fragments)
+
+
+def _parse_suppressions(source: str) -> list[Suppression]:
+    """Real COMMENT tokens only — a docstring that *mentions* the syntax
+    (this file's does) must not count as a suppression."""
+    import io
+    import tokenize
+
+    out: list[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except tokenize.TokenError:  # pragma: no cover - ast.parse already passed
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        line = tok.start[0]
+        # a standalone comment governs the NEXT line; trailing governs its own
+        covered = line + 1 if tok.line.lstrip().startswith("#") else line
+        out.append(Suppression(decl_line=line, covered_line=covered,
+                               rules=rules))
+    return out
+
+
+# (abspath) -> (mtime_ns, size, ModuleInfo) — one parse per file revision,
+# shared across passes and across repeated in-process runs (the test suite,
+# long-lived CI runners)
+_CACHE: dict[str, tuple[int, int, ModuleInfo]] = {}
+
+
+def load_module(path: str) -> ModuleInfo:
+    abspath = os.path.abspath(path)
+    st = os.stat(abspath)
+    hit = _CACHE.get(abspath)
+    if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
+        return hit[2]
+    with open(abspath, encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+    mod = ModuleInfo(path=path.replace(os.sep, "/"), tree=tree, lines=lines,
+                     suppressions=_parse_suppressions(source))
+    _CACHE[abspath] = (st.st_mtime_ns, st.st_size, mod)
+    return mod
+
+
+class Pass:
+    """One invariant.  ``rules`` lists every rule id the pass can emit
+    (``--list-rules``, suppression validation); ``check`` runs per module,
+    ``finalize`` once over all modules for cross-file rules."""
+
+    rules: tuple[str, ...] = ()
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, mods: list[ModuleInfo]) -> Iterable[Finding]:
+        return ()
+
+
+PASS_CLASSES: list[type[Pass]] = []
+
+
+def register(cls: type[Pass]) -> type[Pass]:
+    PASS_CLASSES.append(cls)
+    return cls
+
+
+def all_rules() -> list[str]:
+    out: list[str] = []
+    for cls in PASS_CLASSES:
+        out.extend(cls.rules)
+    out.append("unused-suppression")
+    return sorted(set(out))
+
+
+def collect_files(paths: Iterable[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    return files
+
+
+def analyze_paths(paths: Iterable[str]) -> list[Finding]:
+    """Run every registered pass over ``paths``; returns post-suppression
+    findings (including ``unused-suppression``), sorted by location."""
+    mods: list[ModuleInfo] = []
+    findings: list[Finding] = []
+    for f in collect_files(paths):
+        try:
+            mods.append(load_module(f))
+        except SyntaxError as e:
+            findings.append(Finding("parse-error", f.replace(os.sep, "/"),
+                                    e.lineno or 0, str(e.msg)))
+    for cls in PASS_CLASSES:
+        p = cls()
+        for mod in mods:
+            findings.extend(p.check(mod))
+        findings.extend(p.finalize(mods))
+
+    by_path = {m.path: m for m in mods}
+    # ModuleInfo is cached across runs: reset usage so a suppression that
+    # mattered in a previous (e.g. wider) scan cannot silently pass the
+    # unused-suppression check in this one
+    for mod in mods:
+        for s in mod.suppressions:
+            s.used = False
+    kept: list[Finding] = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        suppressed = False
+        if mod is not None:
+            for s in mod.suppressions:
+                if s.covered_line == f.line and f.rule in s.rules:
+                    s.used = True
+                    suppressed = True
+        if not suppressed:
+            kept.append(f)
+    for mod in mods:
+        for s in mod.suppressions:
+            if not s.used:
+                kept.append(Finding(
+                    "unused-suppression", mod.path, s.decl_line,
+                    f"suppression ignore[{','.join(s.rules)}] silences "
+                    "nothing; delete it"))
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept
+
+
+# -- shared AST helpers --------------------------------------------------------
+
+def call_name(call: ast.Call) -> str:
+    """Dotted source of the called object ('time.sleep', 'self._lock.wait')."""
+    try:
+        return ast.unparse(call.func)
+    except Exception:  # pragma: no cover - unparse is total on parsed trees
+        return ""
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def time_aliases(tree: ast.Module) -> tuple[set[str], dict[str, str]]:
+    """Names bound to the ``time`` module and to its functions.
+
+    Returns ``(module_aliases, func_aliases)``: ``import time as _time``
+    contributes ``'_time'`` to the former; ``from time import monotonic as
+    mono`` contributes ``{'mono': 'monotonic'}`` to the latter."""
+    module_aliases: set[str] = set()
+    func_aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    module_aliases.add(alias.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in ("time", "monotonic", "sleep"):
+                    func_aliases[alias.asname or alias.name] = alias.name
+    return module_aliases, func_aliases

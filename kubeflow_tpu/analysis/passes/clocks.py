@@ -1,0 +1,73 @@
+"""Clock injection: deciders and controllers must not read the wall clock.
+
+A module qualifies as *clock-injected* when it already declares the
+discipline: any function takes a parameter named ``clock``, or — for
+controller/autoscale modules — a parameter named ``now`` (the decider
+convention: callers pass the timestamp in, tests drive a fake clock).
+Inside a qualifying module, every direct call to ``time.time()``,
+``time.monotonic()`` or ``time.sleep()`` (under any import alias) is
+flagged: it re-introduces the hidden global the injection was built to
+remove, and the code it times becomes untestable without real sleeps.
+
+Default-argument *references* (``clock=time.monotonic``) are not calls and
+are allowed — that is exactly how the injection declares its production
+default.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kubeflow_tpu.analysis.framework import (
+    Finding, ModuleInfo, Pass, register, time_aliases)
+
+NOW_PARAM_SCOPE = ("kubeflow_tpu/controllers/", "kubeflow_tpu/autoscale/")
+BANNED = {"time", "monotonic", "sleep"}
+
+
+def _params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in
+            (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def clock_injected(mod: ModuleInfo) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = _params(node)
+            if "clock" in params:
+                return True
+            if "now" in params and mod.in_scope(*NOW_PARAM_SCOPE):
+                return True
+    return False
+
+
+@register
+class ClockInjectionPass(Pass):
+    rules = ("clock-injection",)
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not clock_injected(mod):
+            return []
+        time_mods, time_funcs = time_aliases(mod.tree)
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            called: str | None = None
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in time_mods
+                    and func.attr in BANNED):
+                called = f"{func.value.id}.{func.attr}"
+            elif (isinstance(func, ast.Name)
+                  and time_funcs.get(func.id) in BANNED):
+                called = func.id
+            if called is not None:
+                findings.append(Finding(
+                    "clock-injection", mod.path, node.lineno,
+                    f"direct {called}() in a clock-injected module; "
+                    "route it through the injected clock/now parameter"))
+        return findings

@@ -1,0 +1,80 @@
+"""Exception swallowing: broad handlers in hot control paths must speak.
+
+``silent-except``: a bare ``except:`` or ``except Exception:`` in the
+reconcile/journal/drain packages (``core/``, ``controllers/``,
+``serving/``, ``autoscale/``) whose body neither re-raises, nor logs, nor
+counts a metric.  A silently swallowed Exception in a reconcile loop turns
+a real bug (a typo'd key, a store regression) into an invisible no-op
+reconcile that retries forever; the journal/drain equivalents lose data or
+wedge shutdown with no trace.  Typed handlers (``except NotFound:``) are
+exempt — they encode an expected outcome, not a dragnet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kubeflow_tpu.analysis.framework import Finding, ModuleInfo, Pass, register
+
+SCOPE = ("kubeflow_tpu/core/", "kubeflow_tpu/controllers/",
+         "kubeflow_tpu/serving/", "kubeflow_tpu/autoscale/")
+
+# call-attribute verbs that count as "speaking up"
+METRIC_VERBS = {"inc", "observe", "set", "labels"}
+LOG_HINTS = ("log", "logger", "logging", "warn", "print")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name) and t.id in ("Exception", "BaseException"):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(el, ast.Name)
+                   and el.id in ("Exception", "BaseException")
+                   for el in t.elts)
+    return False
+
+
+def _speaks(handler: ast.ExceptHandler) -> bool:
+    # `except Exception as e:` followed by any USE of `e` is not
+    # swallowing — the error reaches a status message, an HTTP body, etc.
+    bound = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if (bound is not None and isinstance(node, ast.Name)
+                and node.id == bound and isinstance(node.ctx, ast.Load)):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in METRIC_VERBS:
+                    return True
+                dotted = ast.unparse(func).lower()
+                if any(h in dotted for h in LOG_HINTS):
+                    return True
+            elif isinstance(func, ast.Name):
+                if any(h in func.id.lower() for h in LOG_HINTS):
+                    return True
+    return False
+
+
+@register
+class SilentExceptPass(Pass):
+    rules = ("silent-except",)
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not mod.in_scope(*SCOPE):
+            return []
+        findings = []
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.ExceptHandler) and _is_broad(node)
+                    and not _speaks(node)):
+                findings.append(Finding(
+                    "silent-except", mod.path, node.lineno,
+                    "broad except swallows the error silently; log it, "
+                    "count a metric, or narrow the exception type"))
+        return findings

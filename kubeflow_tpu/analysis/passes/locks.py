@@ -1,0 +1,138 @@
+"""Lock discipline: no blocking calls under a lock, consistent order.
+
+Scope: the concurrency-heavy packages (``core/``, ``serving/``,
+``autoscale/``, ``gateway.py``).  Two rules:
+
+``lock-blocking-call``
+    A call that can park the thread for unbounded/IO time is flagged when
+    it sits LEXICALLY inside a ``with self._lock:`` body: ``time.sleep``,
+    anything on ``subprocess``, socket verbs (``accept``/``recv``/
+    ``connect``/``sendall``), builtin ``open``, ``urllib.request.urlopen``,
+    a Future's ``.result()`` without timeout, and ``.get()`` without a
+    timeout on a receiver whose name mentions a queue.  Condition
+    ``.wait()`` is deliberately NOT flagged — it releases the lock.
+
+``lock-order``
+    Per module, every lexically nested ``with``-lock pair contributes an
+    acquisition-order edge; a pair acquired in BOTH orders anywhere in the
+    module is a deadlock waiting for the right interleaving.
+
+Known false negatives (ARCHITECTURE.md decision 16): the analysis is
+lexical, so a helper function called under the lock hides its blocking
+calls, and locks passed across modules are invisible to the per-module
+order table.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from kubeflow_tpu.analysis.framework import (
+    Finding, ModuleInfo, Pass, call_name, keyword_arg, register,
+    time_aliases)
+
+SCOPE = ("kubeflow_tpu/core/", "kubeflow_tpu/serving/",
+         "kubeflow_tpu/autoscale/", "kubeflow_tpu/gateway.py")
+
+SOCKET_VERBS = {"accept", "recv", "recv_into", "recvfrom", "connect",
+                "sendall", "makefile"}
+
+
+def _is_lock_expr(expr: ast.expr) -> bool:
+    """``with self._lock:`` / ``with self._pool_lock:`` — an attribute (or
+    bare name) whose final component mentions ``lock``."""
+    if isinstance(expr, ast.Attribute):
+        return "lock" in expr.attr.lower()
+    if isinstance(expr, ast.Name):
+        return "lock" in expr.id.lower()
+    return False
+
+
+def _blocking_reason(call: ast.Call, time_mods: set[str],
+                     time_funcs: dict[str, str]) -> str | None:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        name = call_name(call)
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            if recv.id in time_mods and func.attr == "sleep":
+                return f"{name}() sleeps"
+            if recv.id == "subprocess":
+                return f"{name}() forks and waits on a child process"
+            if recv.id == "socket":
+                return f"{name}() performs socket IO"
+        if func.attr in SOCKET_VERBS:
+            return f".{func.attr}() performs socket IO"
+        if (func.attr == "result" and not call.args
+                and keyword_arg(call, "timeout") is None):
+            return ".result() without timeout blocks on a future"
+        if (func.attr == "get" and "queue" in ast.unparse(recv).lower()
+                and not call.args and keyword_arg(call, "timeout") is None):
+            return ".get() without timeout blocks on a queue"
+        if name == "urllib.request.urlopen":
+            return f"{name}() performs network IO"
+    elif isinstance(func, ast.Name):
+        if func.id == "open":
+            return "open() performs file IO"
+        if time_funcs.get(func.id) == "sleep":
+            return f"{func.id}() sleeps"
+    return None
+
+
+@register
+class LockDisciplinePass(Pass):
+    rules = ("lock-blocking-call", "lock-order")
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not mod.in_scope(*SCOPE):
+            return []
+        time_mods, time_funcs = time_aliases(mod.tree)
+        # (outer, inner) -> first line the order was observed at
+        order_edges: dict[tuple[str, str], int] = {}
+
+        def visit(node: ast.AST, held: tuple[str, ...]) -> Iterator[Finding]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # a nested def's body runs later, not under the held locks
+                for child in ast.iter_child_nodes(node):
+                    yield from visit(child, ())
+                return
+            if isinstance(node, ast.With):
+                locks = [ast.unparse(item.context_expr)
+                         for item in node.items
+                         if _is_lock_expr(item.context_expr)]
+                for i, inner in enumerate(locks):
+                    for outer in held + tuple(locks[:i]):
+                        if outer != inner:
+                            order_edges.setdefault((outer, inner),
+                                                   node.lineno)
+                for item in node.items:
+                    yield from visit(item.context_expr, held)
+                inner_held = held + tuple(locks)
+                for stmt in node.body:
+                    yield from visit(stmt, inner_held)
+                return
+            if isinstance(node, ast.Call) and held:
+                reason = _blocking_reason(node, time_mods, time_funcs)
+                if reason is not None:
+                    yield Finding(
+                        "lock-blocking-call", mod.path, node.lineno,
+                        f"{reason} while holding {held[-1]}; move the "
+                        "blocking work outside the lock")
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, held)
+
+        findings = list(visit(mod.tree, ()))
+        reported: set[frozenset[str]] = set()
+        for (a, b), line in sorted(order_edges.items(),
+                                   key=lambda kv: kv[1]):
+            rev = order_edges.get((b, a))
+            if rev is not None and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                findings.append(Finding(
+                    "lock-order", mod.path, max(line, rev),
+                    f"locks {a} and {b} are acquired in both orders "
+                    f"(lines {min(line, rev)} and {max(line, rev)}); "
+                    "pick one order"))
+        return findings

@@ -1,0 +1,146 @@
+"""Metrics hygiene: naming rules, duplicate registration, dead references.
+
+Three rules over every ``REGISTRY.counter/gauge/histogram("name", ...)``
+call site (literal first argument) in the scanned tree:
+
+``metric-name``
+    Prometheus naming conventions the dashboards and loadtest greps rely
+    on: counters end in ``_total``, histograms in ``_seconds`` (every
+    in-tree histogram times a duration), and a gauge must NOT end in
+    ``_total`` (a counter-shaped name invites ``rate()`` over a level).
+
+``metric-duplicate``
+    The same metric name registered twice with a different kind or a
+    different label set.  The runtime registry dedupes by name and
+    silently returns the FIRST registration, so the second site's labels
+    never exist — ``.labels(...)`` there raises at runtime, in whatever
+    code path finally touches it.
+
+``metric-unknown-ref``
+    A metric name referenced by the dashboard's metrics service
+    (``get_metric("...")`` / ``val("...")``) that no scanned module
+    registers: the panel renders zeros forever and nobody notices.  The
+    cross-check is skipped when the scan saw no registrations outside the
+    dashboard package (a partial-tree invocation cannot judge it).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterable
+
+from kubeflow_tpu.analysis.framework import (
+    Finding, ModuleInfo, Pass, const_str, keyword_arg, register)
+
+REGISTER_METHODS = {"counter", "gauge", "histogram"}
+DASHBOARD_FRAGMENT = "dashboard/"
+REF_FUNCS = {"get_metric", "val"}
+
+
+@dataclass
+class _Reg:
+    name: str
+    kind: str
+    labels: tuple[str, ...] | None  # None = not statically known
+    path: str
+    line: int
+
+
+def _literal_labels(call: ast.Call) -> tuple[str, ...] | None:
+    node = keyword_arg(call, "labels")
+    if node is None:
+        # positional: counter(name, help, labels)
+        if len(call.args) >= 3:
+            node = call.args[2]
+        else:
+            return ()
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            s = const_str(el)
+            if s is None:
+                return None
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+@register
+class MetricsHygienePass(Pass):
+    rules = ("metric-name", "metric-duplicate", "metric-unknown-ref")
+
+    def __init__(self) -> None:
+        self._regs: list[_Reg] = []
+        self._refs: list[tuple[str, str, int]] = []  # (name, path, line)
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in REGISTER_METHODS and node.args):
+                name = const_str(node.args[0])
+                if name is None:
+                    continue
+                kind = func.attr
+                self._regs.append(_Reg(name, kind, _literal_labels(node),
+                                       mod.path, node.lineno))
+                if kind == "counter" and not name.endswith("_total"):
+                    findings.append(Finding(
+                        "metric-name", mod.path, node.lineno,
+                        f"counter {name!r} must end in '_total'"))
+                elif kind == "histogram" and not name.endswith("_seconds"):
+                    findings.append(Finding(
+                        "metric-name", mod.path, node.lineno,
+                        f"histogram {name!r} must end in '_seconds'"))
+                elif kind == "gauge" and name.endswith("_total"):
+                    findings.append(Finding(
+                        "metric-name", mod.path, node.lineno,
+                        f"gauge {name!r} must not end in '_total' "
+                        "(counter-shaped name on a level)"))
+            if DASHBOARD_FRAGMENT in mod.path:
+                ref_name = None
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in REF_FUNCS and node.args):
+                    ref_name = const_str(node.args[0])
+                elif (isinstance(func, ast.Name) and func.id in REF_FUNCS
+                      and node.args):
+                    ref_name = const_str(node.args[0])
+                if ref_name is not None:
+                    self._refs.append((ref_name, mod.path, node.lineno))
+        return findings
+
+    def finalize(self, mods: list[ModuleInfo]) -> Iterable[Finding]:
+        findings = []
+        first: dict[str, _Reg] = {}
+        for reg in self._regs:
+            prev = first.get(reg.name)
+            if prev is None:
+                first[reg.name] = reg
+                continue
+            if prev.kind != reg.kind:
+                findings.append(Finding(
+                    "metric-duplicate", reg.path, reg.line,
+                    f"metric {reg.name!r} already registered as a "
+                    f"{prev.kind} at {prev.path}:{prev.line}; this "
+                    f"{reg.kind} registration raises at import"))
+            elif (prev.labels is not None and reg.labels is not None
+                  and prev.labels != reg.labels):
+                findings.append(Finding(
+                    "metric-duplicate", reg.path, reg.line,
+                    f"metric {reg.name!r} registered with labels "
+                    f"{reg.labels} but {prev.path}:{prev.line} registered "
+                    f"{prev.labels}; the registry keeps the first — "
+                    "these labels will never exist"))
+        outside = any(DASHBOARD_FRAGMENT not in r.path for r in self._regs)
+        if outside:
+            for name, path, line in self._refs:
+                if name not in first:
+                    findings.append(Finding(
+                        "metric-unknown-ref", path, line,
+                        f"dashboard references metric {name!r} but no "
+                        "scanned module registers it"))
+        return findings

@@ -1,0 +1,104 @@
+"""Thread lifecycle: every thread is daemonized or joined at shutdown.
+
+``thread-join``: a ``threading.Thread(...)`` construction must either pass
+``daemon=True`` (the process may exit under it) or be joined by the owning
+class's teardown — a ``stop()``/``close()``/``shutdown()`` method somewhere
+in the same class that calls ``.join(``.  A non-daemon thread with neither
+keeps the interpreter alive after main exits; a daemon thread without a
+join can still outlive ``stop()`` and mutate shared state mid-teardown,
+but daemonization is the declared opt-out (Manager.stop's bounded-join
+pattern is the gold standard: daemon=True AND joined).
+
+Threads constructed outside any class must be daemon=True or joined within
+the same function (the gateway's pump-pair pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kubeflow_tpu.analysis.framework import (
+    Finding, ModuleInfo, Pass, keyword_arg, register)
+
+TEARDOWN_METHODS = {"stop", "close", "shutdown", "detach", "__exit__"}
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    func = call.func
+    if (isinstance(func, ast.Attribute) and func.attr == "Thread"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading"):
+        return True
+    return isinstance(func, ast.Name) and func.id == "Thread"
+
+
+def _daemon_true(call: ast.Call) -> bool:
+    kw = keyword_arg(call, "daemon")
+    return (isinstance(kw, ast.Constant) and kw.value is True)
+
+
+def _has_join(node: ast.AST) -> bool:
+    """A plausible THREAD join: ``.join(`` whose receiver is a name or
+    attribute — not a string literal (``", ".join``) and not the path
+    modules (``os.path.join``).  Receiver identity is not tracked back to
+    the Thread assignment (threads round-trip through lists and loop
+    variables), so a teardown that joins some OTHER name/attribute still
+    satisfies the rule — a documented imprecision."""
+    for sub in ast.walk(node):
+        if not (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "join"):
+            continue
+        recv = sub.func.value
+        if isinstance(recv, (ast.Constant, ast.JoinedStr)):
+            continue  # string-literal .join
+        if isinstance(recv, ast.Attribute) and recv.attr == "path":
+            continue  # os.path.join / ntpath-style
+        if isinstance(recv, ast.Name) and recv.id in ("os", "posixpath",
+                                                      "ntpath", "sep"):
+            continue
+        return True
+    return False
+
+
+@register
+class ThreadLifecyclePass(Pass):
+    rules = ("thread-join",)
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        findings = []
+
+        def scan(node: ast.AST, cls: ast.ClassDef | None,
+                 fn: ast.AST | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner_cls = child if isinstance(child, ast.ClassDef) else cls
+                inner_fn = (child if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)) else fn)
+                if isinstance(child, ast.Call) and _is_thread_ctor(child):
+                    if not _daemon_true(child) and not self._joined(
+                            cls, fn, child):
+                        where = (f"class {cls.name}" if cls is not None
+                                 else "module scope")
+                        findings.append(Finding(
+                            "thread-join", mod.path, child.lineno,
+                            "Thread is neither daemon=True nor joined in "
+                            f"a stop()/close()/shutdown() of {where}; it "
+                            "can outlive teardown"))
+                scan(child, inner_cls, inner_fn)
+
+        scan(mod.tree, None, None)
+        return findings
+
+    @staticmethod
+    def _joined(cls: ast.ClassDef | None, fn: ast.AST | None,
+                call: ast.Call) -> bool:
+        if cls is not None:
+            for item in cls.body:
+                if (isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and item.name in TEARDOWN_METHODS
+                        and _has_join(item)):
+                    return True
+            return False
+        # no owning class: accept a join anywhere in the enclosing function
+        return fn is not None and _has_join(fn)

@@ -25,7 +25,13 @@ import threading
 import weakref
 from typing import Callable
 
+from kubeflow_tpu.utils.metrics import REGISTRY
+
 Key = tuple  # (namespace, service-name)
+
+COLLECTOR_ERRORS = REGISTRY.counter(
+    "autoscaler_collector_errors_total",
+    "stats sources that raised while the collector sampled them")
 
 
 class HeldOverflow(RuntimeError):
@@ -121,7 +127,10 @@ class MetricsCollector:
                 total += float(stats.get("active", 0)
                                + stats.get("queued", 0))
             except Exception:
-                pass  # a dying engine must not take the autoscaler down
+                # a dying engine must not take the autoscaler down — but a
+                # source that ALWAYS raises starves the decider of demand
+                # data, so count it where an operator can alert on it
+                COLLECTOR_ERRORS.inc()
         return total
 
     def queue_depth(self, key: Key) -> int:

@@ -17,6 +17,16 @@ import subprocess
 import sys
 from typing import Any
 
+# kfvet, the project-invariant static analyzer (kubeflow_tpu/analysis):
+# lock discipline, clock injection, metrics hygiene, thread lifecycle,
+# exception swallowing.  Runs the FULL tree on every component — the
+# metrics cross-checks (duplicate registration, dashboard references) are
+# whole-program properties a per-component path slice cannot judge, and a
+# full parse of the tree is subsecond.  KF_SKIP_VET=1 opts out, mirroring
+# the TSAN/smoke escape hatches.
+VET_CMD = [sys.executable, "-m", "kubeflow_tpu.analysis", "--format=json",
+           "kubeflow_tpu/", "loadtest/"]
+
 # component -> {include_dirs, test_cmd, image (optional)}
 COMPONENTS: dict[str, dict[str, Any]] = {
     "core": {
@@ -33,6 +43,11 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         # hosts whose libtsan interceptors are unreliable (pre-4.8
         # kernels report spurious double-locks).
         "tsan_cmd": ["make", "-C", "native", "-s", "wq-tsan-run"],
+        # AddressSanitizer+UBSan build of the same workqueue stress: TSAN
+        # sees races, ASan sees the lifetime bugs TSAN is blind to
+        # (use-after-free of parked keys, buffer overruns in the key
+        # round-trip).  KF_SKIP_ASAN=1 opts out like KF_SKIP_TSAN.
+        "asan_cmd": ["make", "-C", "native", "-s", "wq-asan-run"],
     },
     "training": {
         "include_dirs": ["kubeflow_tpu/models/*", "kubeflow_tpu/ops/*",
@@ -164,7 +179,21 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
                      "tests/test_pipeline.py", "tests/test_ci_events.py"],
     },
+    "analysis": {
+        # the analyzer's own component: its unit tests plus the
+        # full-tree sweep (which every other component also runs as
+        # vet_cmd — this one exists so analyzer changes get CI coverage
+        # even when nothing else changed)
+        "include_dirs": ["kubeflow_tpu/analysis/*"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q",
+                     "tests/test_analysis.py"],
+    },
 }
+
+# every component vets the tree; a finding fails the component like a
+# failing test would (go vet presubmit semantics)
+for _spec in COMPONENTS.values():
+    _spec.setdefault("vet_cmd", VET_CMD)
 
 
 def changed_components(changed_files: list[str]) -> list[str]:
@@ -193,6 +222,12 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
                       "depends": ["checkout"]})
     if "tsan_cmd" in spec:
         steps.append({"name": "tsan", "run": spec["tsan_cmd"],
+                      "depends": [steps[-1]["name"]]})
+    if "asan_cmd" in spec:
+        steps.append({"name": "asan", "run": spec["asan_cmd"],
+                      "depends": [steps[-1]["name"]]})
+    if "vet_cmd" in spec:
+        steps.append({"name": "vet", "run": spec["vet_cmd"],
                       "depends": [steps[-1]["name"]]})
     steps.append({"name": "test", "run": spec["test_cmd"],
                   "depends": [steps[-1]["name"]]})
@@ -227,6 +262,10 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
     import os
 
     results = {}
+    # every component shares the identical full-tree vet command; run it
+    # once per invocation and reuse the verdict (the generated workflows
+    # keep a per-component vet step — they run on separate machines)
+    vet_cache: dict[tuple, bool] = {}
     for name in components:
         spec = COMPONENTS[name]
         ok = True
@@ -235,6 +274,16 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "tsan_cmd" in spec
                 and os.environ.get("KF_SKIP_TSAN") != "1"):
             ok = subprocess.run(spec["tsan_cmd"]).returncode == 0
+        if (ok and "asan_cmd" in spec
+                and os.environ.get("KF_SKIP_ASAN") != "1"):
+            ok = subprocess.run(spec["asan_cmd"]).returncode == 0
+        if (ok and "vet_cmd" in spec
+                and os.environ.get("KF_SKIP_VET") != "1"):
+            cmd = tuple(spec["vet_cmd"])
+            if cmd not in vet_cache:
+                vet_cache[cmd] = subprocess.run(
+                    spec["vet_cmd"]).returncode == 0
+            ok = vet_cache[cmd]
         if ok:
             ok = subprocess.run(spec["test_cmd"]).returncode == 0
         if (ok and "smoke_cmd" in spec

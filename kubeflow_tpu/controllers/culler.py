@@ -24,6 +24,9 @@ import urllib.request
 from typing import Callable
 
 from kubeflow_tpu.utils.config import Config, config_field
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger("culler")
 
 ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
 ACTIVITY_FILE_ENV = "NB_ACTIVITY_FILE"
@@ -100,7 +103,11 @@ def http_activity_probe(nb: dict, server=None) -> dt.datetime | None:
         with urllib.request.urlopen(url, timeout=2) as r:
             data = json.loads(r.read())
         return _parse_ts(data["last_activity"])
-    except Exception:
+    except Exception as e:
+        # unreachable == treated-as-active by the probe chain, but an
+        # ALWAYS-failing endpoint means culling never fires — leave a
+        # trace an operator can find
+        log.debug("notebook status probe failed", url=url, error=str(e))
         return None
 
 

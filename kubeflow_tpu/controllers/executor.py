@@ -25,6 +25,12 @@ import time
 
 from kubeflow_tpu.core import Controller, Request, Result, api_object
 from kubeflow_tpu.core.store import Conflict, NotFound
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+HEARTBEAT_ERRORS = REGISTRY.counter(
+    "node_heartbeat_errors_total",
+    "node heartbeat renewals that failed (staleness still signals death; "
+    "this counts the write faults themselves)")
 
 
 class NodeHeartbeat:
@@ -69,8 +75,10 @@ class NodeHeartbeat:
         except Exception:
             # transient write faults (injected Conflict, store teardown)
             # must not kill the renewal loop — staleness, not an exception,
-            # is how node death is signalled
-            pass
+            # is how node death is signalled.  Counted so a PERSISTENTLY
+            # failing renewal (auth drift, schema bug) is visible before
+            # the node gets declared dead.
+            HEARTBEAT_ERRORS.inc()
 
     def _loop(self) -> None:
         while not self._stopped.wait(self.interval):
@@ -306,14 +314,39 @@ class LocalExecutor(Controller):
         # it either
         self._silenced: dict[tuple, str] = {}
         self._lock = threading.Lock()
+        # runner threads (one per launched pod) tracked for stop(): they
+        # post pod status, so they must not mutate the store after the
+        # manager tears down (kfvet thread-join audit)
+        self._runners: list[threading.Thread] = []
+        self._stopping = False
+        # how long stop() waits for in-flight pods to finish (and their
+        # terminal status to land) before abandoning the stragglers
+        self.stop_grace = 2.0
         self.heartbeat = NodeHeartbeat(server, self.node_name,
                                        interval=heartbeat_interval,
                                        executor="local")
 
     def start(self) -> None:
+        self._stopping = False
         self.heartbeat.start()
 
     def stop(self) -> None:
+        """Bounded-join every pod runner thread, then stop the heartbeat.
+
+        Join FIRST, flag after: a pod that finishes inside the
+        ``stop_grace`` window gets its terminal status written normally
+        (the manager is still tearing down — the store is ours until
+        stop() returns).  Only a runner that outlives the window keeps
+        running as a daemon with ``_stopping`` set, which suppresses
+        every later status write (terminal, log-flush heartbeat, metrics
+        scrape): after stop() returns, nothing here mutates the store a
+        successor manager may now own."""
+        deadline = time.monotonic() + self.stop_grace
+        with self._lock:
+            runners = list(self._runners)
+        for t in runners:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        self._stopping = True
         self.heartbeat.stop()
 
     def silence(self, name: str, namespace: str | None = None) -> str | None:
@@ -406,6 +439,9 @@ class LocalExecutor(Controller):
             status["portMap"] = portmap
         self.server.patch_status("Pod", req.name, req.namespace, status)
         t = threading.Thread(target=self._run, args=(pod,), daemon=True)
+        with self._lock:
+            self._runners = [r for r in self._runners if r.is_alive()]
+            self._runners.append(t)
         t.start()
         return None
 
@@ -460,7 +496,7 @@ class LocalExecutor(Controller):
         if not isinstance(rec, dict) or rec.get("msg") != "train":
             return
         metrics = {k: rec[k] for k in self.METRIC_KEYS if k in rec}
-        if "step" not in metrics:
+        if "step" not in metrics or self._stopping:
             return
         try:
             current = self.server.get("Pod", md["name"], md.get("namespace"))
@@ -487,7 +523,7 @@ class LocalExecutor(Controller):
             except subprocess.TimeoutExpired:
                 if _time.monotonic() >= deadline:
                     raise
-                if len(log_tail) == flushed:
+                if len(log_tail) == flushed or self._stopping:
                     continue
                 flushed = len(log_tail)
                 try:
@@ -615,6 +651,11 @@ class LocalExecutor(Controller):
         with self._lock:
             if self._silenced.get(key) == uid:
                 return  # host died silently (chaos): nobody reports status
+        if self._stopping:
+            # this runner outlived stop()'s join window: stop() has
+            # returned, so a status write now is exactly the post-stop
+            # mutation Manager.stop guards against
+            return
         status = {"phase": phase, "result": result}
         if log_tail:
             status["logTail"] = list(log_tail)

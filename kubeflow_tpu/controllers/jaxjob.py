@@ -21,6 +21,9 @@ Status mirroring follows notebook_controller.go:200-250's pattern.
 
 from __future__ import annotations
 
+import time
+from typing import Callable
+
 from kubeflow_tpu.api import jaxjob as api
 from kubeflow_tpu.core import Controller, Request, Result
 from kubeflow_tpu.core import quota
@@ -53,8 +56,12 @@ class JAXJobController(Controller):
     # storm that froze the 500-gang loadtest
     UNPARK_FANOUT = 8
 
-    def __init__(self, server):
+    def __init__(self, server, *, clock: Callable[[], float] = time.time):
         super().__init__(server)
+        # injected clock (kfvet clock-injection): startedAt stamps, the
+        # maxRunSeconds deadline math, and the scheduler's backfill-ETA
+        # all read THIS — tests drive a fake clock instead of sleeping
+        self._clock = clock
         # parked-jobs index: (ns, name) -> (creationTimestamp, topology,
         # condition) for gangs parked on a PARK_CONDITIONS condition.
         # Kept by _park/_unpark so pod events re-enqueue exactly the
@@ -220,9 +227,7 @@ class JAXJobController(Controller):
         max_run = spec.get("maxRunSeconds")
         started = status.get("startedAt")
         if max_run is not None and started is not None:
-            import time as _time
-
-            remaining = float(started) + float(max_run) - _time.time()
+            remaining = float(started) + float(max_run) - self._clock()
             if remaining <= 0:
                 for p in pods:
                     try:
@@ -252,7 +257,7 @@ class JAXJobController(Controller):
         if gated and len(pods) == gang_size:
             from kubeflow_tpu.controllers import scheduler
 
-            ok, why = scheduler.may_release(self.server, job)
+            ok, why = scheduler.may_release(self.server, job, self._clock())
             if not ok:
                 return self._park(job, status, req, "WaitingForSlices",
                                   "NoCapacity", why)
@@ -267,9 +272,7 @@ class JAXJobController(Controller):
             # between the gate lift and this status landing must not leave
             # a running gang marked WaitingForSlices forever
             self._unpark(job, status, "WaitingForSlices", "Scheduled")
-            import time as _time
-
-            status.setdefault("startedAt", _time.time())
+            status.setdefault("startedAt", self._clock())
 
         if all(ph == "Succeeded" for ph in phases) and pods:
             status["phase"] = "Succeeded"

@@ -50,7 +50,8 @@ class NodeLifecycleController(Controller):
 
     kind = "Node"
 
-    def __init__(self, server, *, ttl: float | None = None):
+    def __init__(self, server, *, ttl: float | None = None,
+                 clock=time.time):
         super().__init__(server)
         # staleness threshold: how long a silent node stays trusted.  The
         # default rides KF_NODE_TTL so deployments tune detection latency
@@ -58,6 +59,10 @@ class NodeLifecycleController(Controller):
         # scaled to this platform's sub-second reconcile timescales)
         self.ttl = (float(os.environ.get("KF_NODE_TTL", "5.0"))
                     if ttl is None else float(ttl))
+        # injected clock (kfvet clock-injection): heartbeat AGE is the
+        # whole controller — tests age nodes by advancing a fake clock
+        # instead of sleeping past real TTLs
+        self._clock = clock
 
     def reconcile(self, req: Request) -> Result | None:
         try:
@@ -69,7 +74,7 @@ class NodeLifecycleController(Controller):
         # a registered node that never heartbeat ages from registration
         hb = float(status.get("heartbeatTime")
                    or node["metadata"].get("creationTimestamp", 0.0))
-        age = time.time() - hb
+        age = self._clock() - hb
         HEARTBEAT_AGE.labels(req.name).set(age)
         if age <= self.ttl:
             if status.get("ready") is not True:

@@ -205,14 +205,15 @@ def _head_eta(server: APIServer, released: dict[tuple, int], free: int,
     return None  # not enough capacity tracked (shouldn't happen)
 
 
-def may_release(server: APIServer, job: dict,
-                now: float | None = None) -> tuple[bool, str]:
+def may_release(server: APIServer, job: dict, now: float) -> tuple[bool, str]:
     """(ok, reason): whether this job's complete, gated gang may be released
     under the slice pool — strict FIFO per topology, all-or-nothing, with
-    optional conservative backfill (module docstring)."""
-    import time as _time
+    optional conservative backfill (module docstring).
 
-    now = _time.time() if now is None else now
+    ``now`` is REQUIRED (kfvet clock-injection): the backfill-ETA math
+    must run off the caller's clock so tests and replay drive it
+    deterministically — the JAXJob controller passes its injected clock.
+    """
     spec = job["spec"]
     topology = spec["topology"]
     need = int(spec.get("numSlices", 1))

@@ -274,7 +274,9 @@ class NativeWorkQueue:
     def __del__(self) -> None:
         try:
             self._lib.kf_wq_free(self._q)
-        except Exception:
+        except Exception:  # kfvet: ignore[silent-except]
+            # interpreter teardown: the native lib may already be
+            # unloaded, and logging from __del__ can itself raise
             pass
 
 

@@ -1,0 +1,527 @@
+"""kfvet: per-pass fixtures, suppressions, CLI contract, full-tree sweep."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.analysis import all_rules, analyze_paths
+from kubeflow_tpu.analysis.__main__ import main
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    """Write fixture modules under scope-shaped relative paths and
+    analyze the whole fixture tree."""
+
+    def write(rel: str, source: str) -> Path:
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(source)
+        return p
+
+    def run() -> list:
+        return analyze_paths([str(tmp_path)])
+
+    write.run = run  # type: ignore[attr-defined]
+    write.root = tmp_path  # type: ignore[attr-defined]
+    return write
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- pass 1: lock discipline ---------------------------------------------------
+
+def test_lock_blocking_call_fires(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+import time
+
+class A:
+    def f(self):
+        with self._lock:
+            time.sleep(1)
+""")
+    (f,) = tree.run()
+    assert f.rule == "lock-blocking-call"
+    assert f.line == 6
+    assert "self._lock" in f.message
+
+
+def test_lock_blocking_call_negative_and_wait_ok(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+import time
+
+class A:
+    def f(self):
+        time.sleep(1)          # no lock held
+        with self._lock:
+            self._lock.wait(0.1)   # releases the lock: allowed
+            self.q.get(timeout=1)  # bounded: allowed
+            fut.result(timeout=2)  # bounded: allowed
+""")
+    assert tree.run() == []
+
+
+def test_lock_blocking_call_skips_nested_def(tree):
+    tree("kubeflow_tpu/serving/m.py", """\
+import time
+
+class A:
+    def f(self):
+        with self._lock:
+            def later():
+                time.sleep(1)  # runs OUTSIDE the lock
+            self.cb = later
+""")
+    assert tree.run() == []
+
+
+def test_lock_blocking_call_out_of_scope_dir(tree):
+    tree("kubeflow_tpu/training/m.py", """\
+import time
+
+class A:
+    def f(self):
+        with self._lock:
+            time.sleep(1)
+""")
+    assert tree.run() == []
+
+
+def test_lock_order_both_orders_fires(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+class A:
+    def f(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def g(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+""")
+    (f,) = tree.run()
+    assert f.rule == "lock-order"
+    assert "both orders" in f.message
+
+
+def test_lock_order_single_order_clean(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+class A:
+    def f(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def g(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+""")
+    assert tree.run() == []
+
+
+# -- pass 2: clock injection ---------------------------------------------------
+
+def test_clock_injection_fires_with_clock_param(tree):
+    tree("kubeflow_tpu/serving/m.py", """\
+import time
+
+class D:
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+
+    def f(self):
+        return time.time()
+""")
+    (f,) = tree.run()
+    assert f.rule == "clock-injection"
+    assert f.line == 8  # the default-arg REFERENCE on line 4 is allowed
+
+
+def test_clock_injection_now_param_scoped_to_controllers(tree):
+    tree("kubeflow_tpu/controllers/m.py", """\
+import time as _time
+
+def decide(state, now):
+    return now
+
+
+def helper():
+    return _time.monotonic()
+""")
+    (f,) = tree.run()
+    assert f.rule == "clock-injection"
+    assert f.line == 8
+
+
+def test_clock_injection_not_flagged_without_injection(tree):
+    tree("kubeflow_tpu/controllers/m.py", """\
+import time
+
+def helper():
+    return time.time()
+""")
+    assert tree.run() == []
+
+
+def test_clock_injection_now_param_outside_controller_dirs(tree):
+    tree("kubeflow_tpu/serving/m.py", """\
+import time
+
+def expired(deadline, now=None):
+    return (now or time.time()) > deadline
+""")
+    assert tree.run() == []
+
+
+# -- pass 3: metrics hygiene ---------------------------------------------------
+
+def test_metric_name_rules(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+C = REGISTRY.counter("things", "missing suffix")
+H = REGISTRY.histogram("latency_ms", "wrong unit suffix")
+G = REGISTRY.gauge("depth_total", "counter-shaped gauge")
+OK1 = REGISTRY.counter("things_total", "ok")
+OK2 = REGISTRY.histogram("latency_seconds", "ok")
+OK3 = REGISTRY.gauge("depth", "ok")
+""")
+    found = tree.run()
+    assert rules_of(found) == ["metric-name"] * 3
+    assert [f.line for f in found] == [1, 2, 3]
+
+
+def test_metric_duplicate_labels_and_kind(tree):
+    tree("kubeflow_tpu/core/a.py", """\
+A = REGISTRY.counter("x_total", "first", labels=("a",))
+""")
+    tree("kubeflow_tpu/core/b.py", """\
+B = REGISTRY.counter("x_total", "other labels", labels=("b",))
+C = REGISTRY.gauge("x_total", "other kind entirely")
+""")
+    found = tree.run()
+    assert sorted(rules_of(found)) == ["metric-duplicate",
+                                       "metric-duplicate", "metric-name"]
+    dups = [f for f in found if f.rule == "metric-duplicate"]
+    assert "('b',)" in dups[0].message
+    assert "gauge" in dups[1].message
+
+
+def test_metric_unknown_dashboard_ref(tree):
+    tree("kubeflow_tpu/core/a.py", """\
+A = REGISTRY.counter("exists_total", "registered")
+""")
+    tree("kubeflow_tpu/dashboard/ms.py", """\
+def val(name):
+    return 0
+
+X = val("exists_total")
+Y = val("ghost_total")
+Z = REGISTRY.get_metric("also_ghost_total")
+""")
+    found = tree.run()
+    assert rules_of(found) == ["metric-unknown-ref", "metric-unknown-ref"]
+    assert {f.line for f in found} == {5, 6}
+
+
+def test_metric_unknown_ref_skipped_on_partial_scan(tree):
+    # dashboard alone: no registrations outside it -> cross-check skipped
+    tree("kubeflow_tpu/dashboard/ms.py", """\
+def val(name):
+    return 0
+
+Y = val("ghost_total")
+""")
+    assert tree.run() == []
+
+
+# -- pass 4: thread lifecycle --------------------------------------------------
+
+def test_thread_join_fires_without_daemon_or_join(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+import threading
+
+class A:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+""")
+    (f,) = tree.run()
+    assert f.rule == "thread-join"
+    assert "class A" in f.message
+
+
+def test_thread_join_ignores_string_and_path_joins(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+import os
+import threading
+
+class A:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+
+    def stop(self):
+        msg = ", ".join(self.errors)        # str.join is not a thread join
+        path = os.path.join("a", "b")       # neither is os.path.join
+""")
+    (f,) = tree.run()
+    assert f.rule == "thread-join"
+
+
+def test_thread_join_daemon_or_teardown_join_ok(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+import threading
+
+class Daemonized:
+    def start(self):
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+class Joined:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+
+    def stop(self):
+        self._t.join(timeout=2.0)
+
+def pump_pair(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=5.0)
+""")
+    assert tree.run() == []
+
+
+# -- pass 5: silent except -----------------------------------------------------
+
+def test_silent_except_fires_in_controller_path(tree):
+    tree("kubeflow_tpu/controllers/m.py", """\
+def reconcile():
+    try:
+        work()
+    except Exception:
+        pass
+""")
+    (f,) = tree.run()
+    assert f.rule == "silent-except"
+    assert f.line == 4
+
+
+def test_silent_except_log_metric_use_or_typed_ok(tree):
+    tree("kubeflow_tpu/controllers/m.py", """\
+def a():
+    try:
+        work()
+    except Exception:
+        log.warning("failed")
+
+def b():
+    try:
+        work()
+    except Exception:
+        ERRORS.inc()
+
+def c():
+    try:
+        work()
+    except Exception as e:
+        status = str(e)   # the error reaches a status message
+
+def d():
+    try:
+        work()
+    except NotFound:
+        pass              # typed: an expected outcome, not a dragnet
+""")
+    assert tree.run() == []
+
+
+def test_silent_except_out_of_scope(tree):
+    tree("kubeflow_tpu/webapps/m.py", """\
+def f():
+    try:
+        work()
+    except Exception:
+        pass
+""")
+    assert tree.run() == []
+
+
+# -- suppressions --------------------------------------------------------------
+
+def test_trailing_suppression_silences(tree):
+    tree("kubeflow_tpu/controllers/m.py", """\
+def f():
+    try:
+        work()
+    except Exception:  # kfvet: ignore[silent-except]
+        pass
+""")
+    assert tree.run() == []
+
+
+def test_standalone_comment_suppresses_next_line(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+import time
+
+class A:
+    def f(self):
+        with self._lock:
+            # kfvet: ignore[lock-blocking-call]
+            time.sleep(0.01)
+""")
+    assert tree.run() == []
+
+
+def test_wrong_rule_suppression_is_unused_and_finding_stays(tree):
+    tree("kubeflow_tpu/controllers/m.py", """\
+def f():
+    try:
+        work()
+    except Exception:  # kfvet: ignore[lock-order]
+        pass
+""")
+    found = tree.run()
+    assert rules_of(found) == ["silent-except", "unused-suppression"]
+
+
+def test_unused_suppression_is_a_finding(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+x = 1  # kfvet: ignore[silent-except]
+""")
+    (f,) = tree.run()
+    assert f.rule == "unused-suppression"
+    assert f.line == 1
+
+
+def test_suppression_usage_not_sticky_across_cached_runs(tree):
+    """ModuleInfo (and its Suppression objects) are cached across runs in
+    one process; a suppression that bit in a wider scan must still be
+    reported unused in a narrower one."""
+    tree("kubeflow_tpu/core/a.py", """\
+A = REGISTRY.counter("exists_total", "registered")
+""")
+    dash = tree("kubeflow_tpu/dashboard/ms.py", """\
+def val(name):
+    return 0
+
+Y = val("ghost_total")  # kfvet: ignore[metric-unknown-ref]
+""")
+    assert tree.run() == []  # full scan: the suppression is load-bearing
+    # dashboard-only scan: the cross-check is skipped, so the (cached)
+    # suppression now silences nothing
+    found = analyze_paths([str(dash.parent)])
+    assert rules_of(found) == ["unused-suppression"]
+
+
+def test_docstring_mention_is_not_a_suppression(tree):
+    tree("kubeflow_tpu/core/m.py", '''\
+"""Docs may say ``# kfvet: ignore[silent-except]`` without effect."""
+''')
+    assert tree.run() == []
+
+
+# -- CLI contract --------------------------------------------------------------
+
+def test_cli_json_schema_and_summary_lines(tree, capsys):
+    tree("kubeflow_tpu/controllers/m.py", """\
+def f():
+    try:
+        work()
+    except Exception:
+        pass
+""")
+    rc = main(["--format=json", str(tree.root / "kubeflow_tpu")])
+    out, err = capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(out)
+    assert set(doc) == {"findings", "summary"}
+    assert doc["summary"]["total"] == len(doc["findings"]) == 1
+    assert doc["summary"]["by_rule"] == {"silent-except": 1}
+    (finding,) = doc["findings"]
+    assert set(finding) == {"rule", "path", "line", "message"}
+    assert finding["rule"] == "silent-except"
+    # greppable per-rule line on stderr (loadtest/CI log contract)
+    assert 'kfvet_findings_total{rule="silent-except"} 1' in err
+
+
+def test_cli_clean_tree_exits_zero(tree, capsys):
+    tree("kubeflow_tpu/core/m.py", "x = 1\n")
+    rc = main([str(tree.root)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out.split()
+    for rule in ("lock-blocking-call", "lock-order", "clock-injection",
+                 "metric-name", "metric-duplicate", "metric-unknown-ref",
+                 "thread-join", "silent-except", "unused-suppression"):
+        assert rule in out
+    assert out == sorted(out)
+    assert set(out) == set(all_rules())
+
+
+def test_parse_error_is_a_finding(tree):
+    tree("kubeflow_tpu/core/bad.py", "def broken(:\n")
+    (f,) = tree.run()
+    assert f.rule == "parse-error"
+
+
+# -- the real tree -------------------------------------------------------------
+
+def test_full_tree_is_clean():
+    """`python -m kubeflow_tpu.analysis kubeflow_tpu/ loadtest/` exits 0:
+    every true finding in the merged tree is fixed or explicitly
+    suppressed, and every suppression is load-bearing (the
+    unused-suppression rule turns a stale one into a failure)."""
+    findings = analyze_paths([str(REPO / "kubeflow_tpu"),
+                              str(REPO / "loadtest")])
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_ci_wiring_every_component_vets():
+    from kubeflow_tpu.ci.pipelines import COMPONENTS, generate_workflow
+
+    assert "analysis" in COMPONENTS
+    for name, spec in COMPONENTS.items():
+        assert spec.get("vet_cmd"), f"component {name} lost its vet step"
+        steps = {s["name"]: s for s in
+                 generate_workflow(name)["spec"]["steps"]}
+        assert "vet" in steps
+        assert steps["test"]["depends"] == ["vet"]
+    core = generate_workflow("core")["spec"]["steps"]
+    names = [s["name"] for s in core]
+    assert names.index("asan") < names.index("vet") < names.index("test")
+
+
+def test_run_local_honors_skip_vet(monkeypatch):
+    from kubeflow_tpu.ci import pipelines
+
+    ran: list[list[str]] = []
+
+    class _Res:
+        returncode = 0
+
+    monkeypatch.setattr(pipelines.subprocess, "run",
+                        lambda cmd, **kw: ran.append(cmd) or _Res())
+    monkeypatch.setenv("KF_SKIP_VET", "1")
+    monkeypatch.setenv("KF_SKIP_ASAN", "1")
+    monkeypatch.setenv("KF_SKIP_TSAN", "1")
+    pipelines.run_local(["analysis"], build=False)
+    assert pipelines.VET_CMD not in ran
+    monkeypatch.delenv("KF_SKIP_VET")
+    pipelines.run_local(["analysis"], build=False)
+    assert pipelines.VET_CMD in ran
+    # the identical full-tree vet runs ONCE per invocation, not once per
+    # selected component
+    ran.clear()
+    pipelines.run_local(["analysis", "hpo", "profiles"], build=False)
+    assert ran.count(pipelines.VET_CMD) == 1

@@ -30,7 +30,7 @@ def test_changed_components_path_filtering():
 def test_generate_workflow_dag():
     wf = generate_workflow("core")
     names = [s["name"] for s in wf["spec"]["steps"]]
-    assert names == ["checkout", "build", "tsan", "test"]
+    assert names == ["checkout", "build", "tsan", "asan", "vet", "test"]
     wf = generate_workflow("serving")
     assert [s["name"] for s in wf["spec"]["steps"]][-1] == "build-image"
 

@@ -107,7 +107,7 @@ def test_ci_workflow_adapts_to_pipelinerun():
     run = api.from_workflow(generate_workflow("hpo"), "ci")
     api.validate(run)
     names = [s["name"] for s in run["spec"]["steps"]]
-    assert names == ["checkout", "test"]
+    assert names == ["checkout", "vet", "test"]
 
 
 def test_output_reference_validation():
