@@ -1,0 +1,138 @@
+"""Span hygiene: every opened span must close; names are structured.
+
+Two rules over every ``start_span`` / ``start_root`` /
+``start_server_span`` call site in the scanned tree:
+
+``span-lifecycle``
+    A span bound to a LOCAL name must provably close on every path:
+    either the call is the context expression of a ``with`` statement, or
+    the enclosing function contains a ``try``/``finally`` whose finally
+    block calls ``<name>.end()``.  A span that never closes is worse than
+    no span — it silently vanishes from the collector (only finished
+    spans are exported) and the trace reads as if the operation never
+    happened.  Spans stored on ATTRIBUTES (``req.span = ...``) are
+    exempt by design: that is the explicit cross-thread handoff shape
+    (the engine's GenRequest), and the owner closing them lives in
+    another function — lexical analysis cannot follow it, the runtime
+    span-tree invariants in loadtest/load_trace.py cover it instead.
+
+``span-name``
+    Literal span names must match ``component.operation`` (lowercase,
+    exactly one dot) — the dashboard's breakdown and the Chrome export's
+    category grouping split on it, and free-form names fragment every
+    by-component view.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from kubeflow_tpu.analysis.framework import (
+    Finding, ModuleInfo, Pass, const_str, register)
+
+START_FUNCS = {"start_span", "start_root", "start_server_span"}
+NAME_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
+
+
+def _is_start_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in START_FUNCS
+    if isinstance(func, ast.Name):
+        return func.id in START_FUNCS
+    return False
+
+
+def _with_context_exprs(fn: ast.AST) -> set[int]:
+    """ids of Call nodes used as a ``with`` item's context expression
+    (own scope only — a nested def is its own span scope)."""
+    out: set[int] = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                out.add(id(item.context_expr))
+    return out
+
+
+def _finally_ended_names(fn: ast.AST) -> set[str]:
+    """Names ``x`` with an ``x.end(...)`` call inside a finally block of
+    THIS scope — a nested function's finally runs at someone else's
+    call time and proves nothing about this scope's span."""
+    out: set[str] = set()
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "end"
+                        and isinstance(sub.func.value, ast.Name)):
+                    out.add(sub.func.value.id)
+    return out
+
+
+@register
+class SpanHygienePass(Pass):
+    rules = ("span-lifecycle", "span-name")
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        # span-name: any literal first argument of a start_* call
+        for node in ast.walk(mod.tree):
+            if not _is_start_call(node):
+                continue
+            if not node.args:
+                continue
+            name = const_str(node.args[0])
+            if name is not None and not NAME_RE.match(name):
+                findings.append(Finding(
+                    "span-name", mod.path, node.lineno,
+                    f"span name {name!r} must be 'component.operation' "
+                    "(lowercase, one dot)"))
+
+        # span-lifecycle: per function (and the module body), locally
+        # bound spans must close via with or try/finally
+        scopes: list[ast.AST] = [mod.tree]
+        scopes.extend(n for n in ast.walk(mod.tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)))
+        for fn in scopes:
+            with_exprs = _with_context_exprs(fn)
+            ended = _finally_ended_names(fn)
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not _is_start_call(node.value):
+                    continue
+                if id(node.value) in with_exprs:
+                    continue
+                targets = node.targets
+                if len(targets) != 1 or not isinstance(targets[0],
+                                                       ast.Name):
+                    continue  # attribute/tuple targets: handoff, exempt
+                name = targets[0].id
+                if name in ended:
+                    continue
+                findings.append(Finding(
+                    "span-lifecycle", mod.path, node.lineno,
+                    f"span bound to {name!r} is not closed via context "
+                    "manager or try/finally .end(); an unclosed span "
+                    "never reaches the collector"))
+        return findings
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk ``fn`` without descending into NESTED function scopes (their
+    assignments are judged against their own with/finally structure)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

@@ -179,6 +179,16 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
                      "tests/test_pipeline.py", "tests/test_ci_events.py"],
     },
+    "observability": {
+        "include_dirs": ["kubeflow_tpu/trace/*",
+                         "kubeflow_tpu/utils/profiler.py",
+                         "loadtest/load_trace.py"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+                     "tests/test_trace.py"],
+        # traced serving storm + span-tree invariants + the sampling-off
+        # overhead budget (KF_SKIP_TRACE=1 opts out on constrained hosts)
+        "trace_cmd": [sys.executable, "loadtest/load_trace.py", "--smoke"],
+    },
     "analysis": {
         # the analyzer's own component: its unit tests plus the
         # full-tree sweep (which every other component also runs as
@@ -243,6 +253,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "overload_cmd" in spec:
         steps.append({"name": "overload", "run": spec["overload_cmd"],
                       "depends": ["test"]})
+    if "trace_cmd" in spec:
+        steps.append({"name": "trace", "run": spec["trace_cmd"],
+                      "depends": ["test"]})
     if spec.get("image"):
         # kaniko executor (the reference's builder): --no-push is the
         # presubmit mode (ci/notebook_servers pattern)
@@ -298,6 +311,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "overload_cmd" in spec
                 and os.environ.get("KF_SKIP_OVERLOAD") != "1"):
             ok = subprocess.run(spec["overload_cmd"]).returncode == 0
+        if (ok and "trace_cmd" in spec
+                and os.environ.get("KF_SKIP_TRACE") != "1"):
+            ok = subprocess.run(spec["trace_cmd"]).returncode == 0
         results[name] = ok
     return results
 

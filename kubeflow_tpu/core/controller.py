@@ -17,6 +17,7 @@ reference's Go stack:
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import heapq
 import threading
@@ -24,6 +25,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Iterable
 
+from kubeflow_tpu import trace
 from kubeflow_tpu.core.store import APIServer, WatchEvent
 from kubeflow_tpu.core import objects as ob
 from kubeflow_tpu.utils.logging import get_logger
@@ -371,7 +373,28 @@ class Manager:
         # controller set — it also overrides controllers that must stay
         # single-worker (e.g. gang release decisions).
         self._force_workers = force_workers
+        # trace handoff across the workqueue (EXPLICIT, per the no-thread-
+        # local-across-pools rule): the dispatch thread parks each sampled
+        # event's span context + enqueue time here keyed by (controller,
+        # Request); the worker that pops the key takes the entry and
+        # retro-creates the workqueue.wait span.  The queue dedups keys,
+        # so last-event-wins is the matching semantic; bounded so an
+        # unsampled-but-stuck consumer can never grow it without limit.
+        self._trace_pending: dict[tuple, tuple] = {}
+        self._trace_lock = threading.Lock()
         self.log = get_logger("manager", identity=identity)
+
+    _TRACE_PENDING_MAX = 4096
+
+    def _trace_enqueue(self, controller: str, req: Request, ctx,
+                       enqueued_at: float) -> None:
+        with self._trace_lock:
+            if len(self._trace_pending) < self._TRACE_PENDING_MAX:
+                self._trace_pending[(controller, req)] = (ctx, enqueued_at)
+
+    def _trace_take(self, controller: str, req: Request):
+        with self._trace_lock:
+            return self._trace_pending.pop((controller, req), None)
 
     def add(self, controller: Controller, *, workers: int | None = None,
             ) -> None:
@@ -421,12 +444,29 @@ class Manager:
                                                  md["name"]))
 
         def dispatch() -> None:
+            tracer = trace.get_tracer()
             for ev in watch:
                 if self._stop.is_set():
                     return
-                for c in self.controllers:
-                    for req in c.requests_for(ev):
-                        self._queues[c.name].add(req)
+                md = ev.object.get("metadata", {})
+                # one root per watch event (head-sampled); every reconcile
+                # it fans out to parents here, so "why did this object
+                # churn" reads as one tree.  The root closes at enqueue —
+                # queue wait and reconcile hang off it as children.
+                root = tracer.start_root(
+                    "store.event", kind=ev.kind, type=ev.type,
+                    obj_name=md.get("name", ""),
+                    namespace=md.get("namespace") or "")
+                try:
+                    for c in self.controllers:
+                        for req in c.requests_for(ev):
+                            if root:
+                                self._trace_enqueue(c.name, req,
+                                                    root.context,
+                                                    tracer.now())
+                            self._queues[c.name].add(req)
+                finally:
+                    root.end()
 
         t = threading.Thread(target=dispatch, daemon=True, name="watch")
         t.start()
@@ -474,17 +514,37 @@ class Manager:
     def _worker(self, controller: Controller) -> None:
         q = self._queues[controller.name]
         name = controller.name
+        tracer = trace.get_tracer()
         while not self._stop.is_set():
             req = q.get(timeout=0.3)
             if req is None:
                 continue
+            # trace handoff from the dispatch thread (explicit side
+            # table, not a thread-local): the queue wait becomes its own
+            # retroactive span, and the reconcile span is scope()-bound
+            # for THIS call only so store.write / persistence.journal
+            # spans parent to it without touching controller signatures
+            entry = self._trace_take(name, req)
+            if entry is not None:
+                ctx, enq_at = entry
+                tracer.start_span("workqueue.wait", ctx,
+                                  start=enq_at, controller=name).end()
+                rec_span = tracer.start_span(
+                    "controller.reconcile", ctx, controller=name,
+                    key=f"{req.namespace}/{req.name}")
+            else:
+                rec_span = trace.NULL_SPAN
+            scope = (tracer.scope(rec_span) if rec_span
+                     else contextlib.nullcontext())
             ACTIVE_WORKERS.labels(name).inc()
             t0 = time.perf_counter()
             try:
                 try:
-                    result = controller.reconcile(req)
+                    with scope:
+                        result = controller.reconcile(req)
                 except Exception:
                     RECONCILE_TOTAL.labels(name, "error").inc()
+                    rec_span.set_attribute("outcome", "error")
                     controller.log.error(
                         "reconcile failed",
                         key=f"{req.namespace}/{req.name}", exc_info=True)
@@ -492,9 +552,11 @@ class Manager:
                 else:
                     q.forget(req)
                     RECONCILE_TOTAL.labels(name, "success").inc()
+                    rec_span.set_attribute("outcome", "success")
                     if result and result.requeue_after:
                         q.add(req, result.requeue_after)
             finally:
+                rec_span.end()
                 # done AFTER the requeue adds: they land in the dirty set
                 # and are republished here with their delay intact
                 q.done(req)
@@ -544,6 +606,8 @@ class Manager:
                 self.log.error("thread did not stop in time", thread=t.name)
         if self._leader_election:
             release_lease(self.server, "manager-leader", self._identity)
+        with self._trace_lock:
+            self._trace_pending.clear()
         self._stopped.set()
 
     def wait_idle(self, timeout: float = 10.0, settle: float = 0.15) -> bool:

@@ -24,6 +24,12 @@ from typing import Any, Callable
 from urllib.parse import parse_qs
 
 from kubeflow_tpu.core.store import APIServer, Conflict, Invalid, NotFound
+# one definition of the correlation id for every hop: the client's
+# X-Request-Id when sent (the gateway forwards it), a fresh one
+# otherwise — echoed on every response and stamped into the access-log
+# line, so one id joins client, gateway, and apiserver logs
+from kubeflow_tpu.trace import request_id
+from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import REGISTRY
 
 USERID_HEADER = "HTTP_X_GOOG_AUTHENTICATED_USER_EMAIL"
@@ -31,6 +37,8 @@ USERID_PREFIX = "accounts.google.com:"
 
 HTTP_REQS = REGISTRY.counter("apiserver_http_requests_total",
                              "REST requests", labels=("method", "code"))
+
+log = get_logger("httpapi")
 
 
 def _selector_from_query(qs: dict) -> dict | None:
@@ -66,6 +74,7 @@ class RestAPI:
     def __call__(self, environ, start_response):
         if environ.get("PATH_INFO", "").rstrip("/") == "/apis/watch":
             return self._watch_stream(environ, start_response)
+        rid = request_id(environ)
         extra_headers: list[tuple[str, str]] = []
         try:
             out = self._route(environ)
@@ -83,8 +92,12 @@ class RestAPI:
             status, body = "403 Forbidden", {"error": str(e)}
         except Exception as e:  # pragma: no cover
             status, body = "500 Internal Server Error", {"error": str(e)}
-        HTTP_REQS.labels(environ.get("REQUEST_METHOD", "?"),
-                         status.split()[0]).inc()
+        code = status.split()[0]
+        method = environ.get("REQUEST_METHOD", "?")
+        HTTP_REQS.labels(method, code).inc()
+        log.info("http access", method=method,
+                 path=environ.get("PATH_INFO", "/"), code=code,
+                 request_id=rid, user=environ.get("kubeflow.user"))
         if isinstance(body, str):
             payload = body.encode()
             ctype = "text/plain; version=0.0.4"
@@ -92,7 +105,8 @@ class RestAPI:
             payload = json.dumps(body).encode()
             ctype = "application/json"
         start_response(status, [("Content-Type", ctype),
-                                ("Content-Length", str(len(payload)))]
+                                ("Content-Length", str(len(payload))),
+                                ("X-Request-Id", rid)]
                        + extra_headers)
         return [payload]
 
@@ -202,6 +216,7 @@ class RestAPI:
         out-of-process controllers, SURVEY §1 L1).  Heartbeat lines ("{}")
         every 0.5s keep the pipe alive and surface client disconnects."""
         qs = parse_qs(environ.get("QUERY_STRING", ""))
+        rid = request_id(environ)
         raw_kinds = qs.get("kinds", [None])[0]
         kinds = ([k for k in raw_kinds.split(",") if k]
                  if raw_kinds else None)
@@ -215,14 +230,20 @@ class RestAPI:
         except PermissionError as e:
             payload = json.dumps({"error": str(e)}).encode()
             HTTP_REQS.labels("GET", "403").inc()
+            log.info("http access", method="GET", path="/apis/watch",
+                     code="403", request_id=rid)
             start_response("403 Forbidden",
                            [("Content-Type", "application/json"),
-                            ("Content-Length", str(len(payload)))])
+                            ("Content-Length", str(len(payload))),
+                            ("X-Request-Id", rid)])
             return [payload]
         watch = self.server.watch(kinds=kinds, namespace=namespace)
+        log.info("http access", method="GET", path="/apis/watch",
+                 code="200", request_id=rid)
         start_response("200 OK",
                        [("Content-Type", "application/jsonl"),
-                        ("Cache-Control", "no-cache")])
+                        ("Cache-Control", "no-cache"),
+                        ("X-Request-Id", rid)])
 
         def stream():
             try:

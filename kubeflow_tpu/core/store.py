@@ -24,6 +24,7 @@ read path scales with concurrent reconcile workers.
 from __future__ import annotations
 
 import fnmatch
+import functools
 import queue
 import threading
 import time
@@ -31,6 +32,37 @@ from dataclasses import dataclass
 from typing import Any, Callable, Iterable
 
 from kubeflow_tpu.core import objects as ob
+
+
+def _traced_write(op: str):
+    """Trace a mutating verb as a ``store.write`` child span — but ONLY
+    when the calling thread already runs inside a traced scope (a
+    reconcile span bound by Manager._worker).  The handoff into the store
+    is the thread's own scope stack, never a cross-thread ambient: an
+    untraced caller pays one thread-local read and nothing else."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            from kubeflow_tpu import trace
+
+            tracer = trace.get_tracer()
+            parent = tracer.current()
+            if parent is None:
+                return fn(self, *args, **kwargs)
+            kind = (args[0].get("kind") if args and isinstance(args[0],
+                                                               dict)
+                    else (args[0] if args else None))
+            with tracer.start_span("store.write", parent, op=op,
+                                   kind=kind) as sp, tracer.scope(sp):
+                # scope(): the journal hook below this frame parents its
+                # persistence.journal span to THIS write, not the
+                # reconcile
+                return fn(self, *args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 # what every HTTP mutation surface answers (503 + Retry-After) while
@@ -148,7 +180,18 @@ class APIServer:
         self.degraded = False
 
     def _record(self, op: str, payload) -> None:
-        if self._journal is not None:
+        if self._journal is None:
+            return
+        from kubeflow_tpu import trace
+
+        tracer = trace.get_tracer()
+        parent = tracer.current()
+        if parent is None:
+            self._journal(op, payload)
+            return
+        # "was the reconcile slow, or was it the journal fsync?" — the
+        # question this span exists to answer
+        with tracer.start_span("persistence.journal", parent, op=op):
             self._journal(op, payload)
 
     def _index_put(self, key: tuple, obj: dict) -> None:
@@ -248,6 +291,7 @@ class APIServer:
         self._validating_hooks.append(hook)
 
     # -- CRUD -----------------------------------------------------------------
+    @_traced_write("create")
     def create(self, obj: dict) -> dict:
         obj = _jcopy(obj)
         kind = obj["kind"]
@@ -360,6 +404,7 @@ class APIServer:
             n += 1
         return n
 
+    @_traced_write("update")
     def update(self, obj: dict) -> dict:
         obj = _jcopy(obj)
         kind = obj["kind"]
@@ -408,6 +453,7 @@ class APIServer:
             self._remove(kind, md.get("namespace"), md["name"])
         return out
 
+    @_traced_write("patch_status")
     def patch_status(self, kind: str, name: str, namespace: str | None,
                      status: dict) -> dict:
         """Status subresource update (no spec changes, no conflict check) —
@@ -430,6 +476,7 @@ class APIServer:
         self._emit("MODIFIED", obj)
         return _jcopy(obj)
 
+    @_traced_write("delete")
     def delete(self, kind: str, name: str, namespace: str | None = None,
                ) -> None:
         with self._lock:

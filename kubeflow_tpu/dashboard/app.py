@@ -75,6 +75,7 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/nodes", self.nodes_route)
         self.add_route("GET", "/api/persistence-health",
                        self.persistence_health_route)
+        self.add_route("GET", "/api/traces", self.traces_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -157,6 +158,12 @@ class DashboardApp(CrudApp):
         failure streak, and the torn/corrupt/fallback integrity
         counters."""
         return "200 OK", self.metrics.get_persistence_health()
+
+    def traces_route(self, req: Request):
+        """Distributed-tracing standing (the trace health card): sampling
+        config, recorded/dropped span counts, recent root spans, and a
+        critical-path breakdown of the slowest recent root."""
+        return "200 OK", self.metrics.get_trace_state()
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

@@ -135,6 +135,39 @@ def persistence_health_state(server) -> dict:
     return state
 
 
+def trace_state() -> dict:
+    """Distributed-tracing standing of this process (the trace health
+    card + ``/dashboard/api/traces``): sampling config, recorded/dropped
+    span counters, the most recent finished root spans, and a
+    critical-path breakdown of the slowest recent root — "where did the
+    time go" for the worst request the ring buffer still holds."""
+    from kubeflow_tpu import trace
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    def val(name: str) -> float:
+        m = REGISTRY.get_metric(name)
+        return m.get() if m is not None else 0.0
+
+    tracer = trace.get_tracer()
+    collector = tracer.collector
+    roots = collector.roots(limit=20)
+    slowest = max(roots, key=lambda s: s.duration or 0.0, default=None)
+    return {
+        "sample_rate": tracer.sample_rate,
+        "spans_total": val("trace_spans_total"),
+        "spans_dropped": val("trace_spans_dropped_total"),
+        "root_count": len(collector.roots()),
+        "recent_roots": [{
+            "name": r.name,
+            "trace_id": r.trace_id,
+            "duration_s": r.duration,
+            "attributes": dict(r.attributes),
+        } for r in reversed(roots)],
+        "slowest": (collector.breakdown(slowest.trace_id)
+                    if slowest is not None else None),
+    }
+
+
 def cluster_health(server) -> dict:
     """Node heartbeat standing + failure-recovery counters (the
     robustness card): per-node heartbeat age/readiness straight from the
@@ -191,6 +224,8 @@ class MetricsService(Protocol):
     def get_cluster_health(self) -> dict: ...
 
     def get_persistence_health(self) -> dict: ...
+
+    def get_trace_state(self) -> dict: ...
 
 
 class LocalMetricsService:
@@ -249,6 +284,9 @@ class LocalMetricsService:
 
     def get_persistence_health(self) -> dict:
         return persistence_health_state(self.server)
+
+    def get_trace_state(self) -> dict:
+        return trace_state()
 
 
 class CloudMonitoringMetricsService:
@@ -321,6 +359,10 @@ class CloudMonitoringMetricsService:
         # the WAL is this process's disk, never a cloud series
         return (persistence_health_state(self.server) if self.server
                 else {"attached": False})
+
+    def get_trace_state(self):
+        # the span collector is process-local under either backend
+        return trace_state()
 
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:

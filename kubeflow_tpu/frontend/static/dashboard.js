@@ -236,6 +236,30 @@
         }));
     }).catch(() => quotaCard.append(errorBox("unavailable")));
 
+    // trace health card: sampling standing, span/drop counters, and the
+    // slowest recent root decomposed into its direct children
+    const traceCard = el("div", { class: "card", id: "trace-card" },
+      el("h2", null, "Tracing"), el("div", { class: "muted" }, "…"));
+    cards.append(traceCard);
+    api.get("/dashboard/api/traces").then((t) => {
+      const rows = [
+        el("div", { class: "big" }, `${t.root_count}`),
+        el("div", { class: "muted" },
+          `recent root spans · sampling ${t.sample_rate > 0
+            ? (100 * t.sample_rate).toFixed(0) + "%" : "off"}` +
+          (t.spans_dropped ? ` · ${t.spans_dropped} dropped` : "")),
+      ];
+      if (t.slowest && t.slowest.root) {
+        rows.push(el("div", { class: "hint" },
+          `slowest: ${t.slowest.root} ` +
+          `${(1e3 * t.slowest.duration_s).toFixed(1)} ms`));
+        rows.push(el("ul", null, t.slowest.children.slice(0, 5).map(
+          (c) => el("li", { class: "hint" },
+            `${c.name}: ${(1e3 * c.duration_s).toFixed(1)} ms`))));
+      }
+      traceCard.replaceChildren(el("h2", null, "Tracing"), ...rows);
+    }).catch(() => traceCard.append(errorBox("unavailable")));
+
     // metrics cards
     for (const [mtype, title] of [["tpuduty", "TPU duty cycle"],
                                   ["podcpu", "Pod CPU"]]) {

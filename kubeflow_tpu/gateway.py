@@ -59,6 +59,7 @@ import http.client
 import time
 from dataclasses import dataclass, field
 
+from kubeflow_tpu import trace
 from kubeflow_tpu.core.store import APIServer, NotFound
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import REGISTRY
@@ -219,6 +220,23 @@ def _scale_key(route: Route) -> tuple | None:
     destination workload, matching the authorization scope."""
     svc = route.dest_service
     return (route.dest_namespace, svc) if svc else None
+
+
+def _span_stream(result, span):
+    """Close the request's root span when the response body has fully
+    streamed (or the client walked away) — the span's duration is
+    time-to-last-byte, which is what a slow-request investigation needs.
+    Unsampled requests pass through unwrapped."""
+    if not span:
+        return result
+
+    def run():
+        try:
+            yield from result
+        finally:
+            span.end()
+
+    return run()
 
 
 def _counted(result, collector, key, addr_ref=None):
@@ -443,7 +461,8 @@ def backend_for_route(server: APIServer, route: Route, path: str,
                     f":{target_port}")
 
 
-def _request_headers(environ: dict, backend: Backend) -> dict:
+def _request_headers(environ: dict, backend: Backend,
+                     trace_ctx=None, request_id: str | None = None) -> dict:
     headers: dict[str, str] = {}
     for key, value in environ.items():
         if not key.startswith("HTTP_"):
@@ -455,6 +474,17 @@ def _request_headers(environ: dict, backend: Backend) -> dict:
     if environ.get("CONTENT_TYPE"):
         headers["Content-Type"] = environ["CONTENT_TYPE"]
     headers["Host"] = f"{backend.host}:{backend.port}"
+    # trace propagation: when the gateway recorded a span for this
+    # request, the FORWARDED traceparent is that span's context (the
+    # backend's spans must parent to the gateway's, not to the client's);
+    # an unsampled request forwards a sampled-flag-clear context
+    # (trace.propagation_context) so the decision propagates.  The
+    # correlation id is forwarded alongside — minted by the gateway when
+    # the client sent none, so access logs on both sides join on one id.
+    if trace_ctx is not None:
+        headers["Traceparent"] = trace_ctx.to_traceparent()
+    if request_id is not None:
+        headers["X-Request-Id"] = request_id
     # standard reverse-proxy forwarding headers
     if environ.get("REMOTE_ADDR"):
         headers["X-Forwarded-For"] = environ["REMOTE_ADDR"]
@@ -752,9 +782,23 @@ class Gateway:
 
     def __call__(self, environ, start_response):
         path = environ.get("PATH_INFO", "/")
-        route = match_route(self.server, path)
+        # the front door ROOTS the request's trace (or continues a client
+        # traceparent); ownership is handed to the streaming wrapper,
+        # which closes the span when the last body byte is delivered —
+        # a lexical with/finally here would clock headers, not the stream
+        span = trace.start_server_span(  # kfvet: ignore[span-lifecycle]
+            "gateway.request", environ, path=path)
+        request_id = trace.request_id(environ)
+        span.set_attribute("request_id", request_id)
+        with trace.get_tracer().start_span("gateway.route_match",
+                                           span) as msp:
+            route = match_route(self.server, path)
+            if route is not None:
+                msp.set_attribute("prefix", route.prefix)
         if route is None:  # caller should have checked matches()
             PROXIED.labels("404").inc()
+            span.set_attribute("status", 404)
+            span.end()
             start_response("404 Not Found",
                            [("Content-Type", "text/plain")])
             return [b"no route\n"]
@@ -763,25 +807,41 @@ class Gateway:
         if not ok:
             DENIED.inc()
             PROXIED.labels("403").inc()
+            span.set_attribute("status", 403)
+            span.end()
             start_response("403 Forbidden",
                            [("Content-Type", "text/plain")])
             return [f"{why}\n".encode()]
-        try:
-            backend = backend_for_route(self.server, route, path,
-                                        self.ejections)
-        except NoBackend as e:
-            backend = self._activate(route, path)
-            if backend is None:
-                PROXIED.labels("503").inc()
-                # Retry-After marks this shed-not-dead for clients and
-                # upstream balancers (drain and activator-overflow 503s
-                # resolve within seconds, not never)
-                start_response("503 Service Unavailable",
-                               [("Content-Type", "text/plain"),
-                                ("Retry-After", "1")])
-                return [f"no backend: {e}\n".encode()]
+        with trace.get_tracer().start_span("gateway.backend_pick",
+                                           span) as psp:
+            try:
+                backend = backend_for_route(self.server, route, path,
+                                            self.ejections)
+            except NoBackend as e:
+                psp.add_event("activate", reason=str(e))
+                backend = self._activate(route, path)
+                if backend is None:
+                    PROXIED.labels("503").inc()
+                    psp.set_attribute("outcome", "no_backend")
+                    span.set_attribute("status", 503)
+                    span.end()
+                    # Retry-After marks this shed-not-dead for clients
+                    # and upstream balancers (drain and activator-
+                    # overflow 503s resolve within seconds, not never)
+                    start_response("503 Service Unavailable",
+                                   [("Content-Type", "text/plain"),
+                                    ("Retry-After", "1")])
+                    return [f"no backend: {e}\n".encode()]
+            psp.set_attribute("backend", f"{backend.host}:{backend.port}")
         if self.collector is None:
-            return self._proxy(backend, environ, start_response, route)
+            try:
+                result = self._proxy(backend, environ, start_response,
+                                     route, None, span, request_id)
+            except BaseException:
+                span.set_attribute("error", True)
+                span.end()
+                raise
+            return _span_stream(result, span)
         # count the request in-flight for the autoscaler's concurrency
         # view — and per BACKEND for the reconciler's drain quiesce check
         # (scale-down waits for the victim's stream count to hit zero):
@@ -794,13 +854,16 @@ class Gateway:
             self.collector.inc(key)
         try:
             result = self._proxy(backend, environ, start_response, route,
-                                 addr_ref)
+                                 addr_ref, span, request_id)
         except BaseException:
             if key is not None:
                 self.collector.dec(key)
             self.collector.dec_backend(addr_ref[0])
+            span.set_attribute("error", True)
+            span.end()
             raise
-        return _counted(result, self.collector, key, addr_ref)
+        return _span_stream(_counted(result, self.collector, key, addr_ref),
+                            span)
 
     def _activate(self, route: Route, path: str):
         """Scale-from-zero: hold the request while the activator brings up
@@ -880,7 +943,10 @@ class Gateway:
             conn.close()
 
     def _proxy(self, backend: Backend, environ, start_response,
-               route: Route | None = None, addr_ref: list | None = None):
+               route: Route | None = None, addr_ref: list | None = None,
+               span=None, request_id: str | None = None):
+        if span is None:
+            span = trace.NULL_SPAN
         method = environ["REQUEST_METHOD"]
         qs = environ.get("QUERY_STRING")
         try:
@@ -900,15 +966,22 @@ class Gateway:
             retriable = length == 0
         idempotent = method in ("GET", "HEAD", "OPTIONS")
 
+        # forwarded even when unsampled: the NEGATIVE head decision rides
+        # the cleared sampled flag so the backend doesn't re-roll and
+        # record an orphan subtree (client ids preserved when parseable)
+        fwd_ctx = trace.propagation_context(span, environ)
         tried: set[tuple] = set()
         while True:
             url = backend.path + ("?" + qs if qs else "")
-            headers = _request_headers(environ, backend)
+            headers = _request_headers(environ, backend,
+                                       trace_ctx=fwd_ctx,
+                                       request_id=request_id)
             headers["Content-Length"] = str(length)
             conn, resp, err = self._fetch(backend, method, url, headers,
                                           body, retriable, idempotent)
             if err is not None:
                 PROXIED.labels("502").inc()
+                span.set_attribute("status", 502)
                 start_response("502 Bad Gateway",
                                [("Content-Type", "text/plain")])
                 return [err]
@@ -924,6 +997,8 @@ class Gateway:
             # entry (ejecting a busy pod under overload collapses the
             # whole revision), counted separately from failures
             SHED.inc()
+            span.add_event("shed_relayed", status=resp.status,
+                           backend=f"{backend.host}:{backend.port}")
             alt = None
             if retriable and route is not None and not tried:
                 # a SIBLING pod may have queue room — re-dispatch is safe
@@ -933,12 +1008,18 @@ class Gateway:
                 # (start_response is still unfired); once a body streams,
                 # a re-dispatch would interleave two responses
                 tried.add((backend.host, backend.port))
-                try:
-                    alt = backend_for_route(self.server, route,
-                                            environ.get("PATH_INFO", "/"),
-                                            self.ejections, exclude=tried)
-                except NoBackend:
-                    alt = None
+                with trace.get_tracer().start_span("gateway.sibling_retry",
+                                                   span) as rsp:
+                    try:
+                        alt = backend_for_route(
+                            self.server, route,
+                            environ.get("PATH_INFO", "/"),
+                            self.ejections, exclude=tried)
+                    except NoBackend:
+                        alt = None
+                    rsp.set_attribute(
+                        "outcome", "redispatched" if alt is not None
+                        else "no_sibling")
             if alt is None:
                 break  # relay the shed response, Retry-After intact
             self._finish_conn(backend, conn, resp)
@@ -956,6 +1037,7 @@ class Gateway:
         # outside HTTP's range must not mint unbounded metric series
         PROXIED.labels(str(resp.status) if 100 <= resp.status <= 599
                        else "502").inc()
+        span.set_attribute("status", resp.status)
         start_response(f"{resp.status} {resp.reason}", out_headers)
 
         pool = self.pool

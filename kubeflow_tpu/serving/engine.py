@@ -27,6 +27,8 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu import trace
+from kubeflow_tpu.trace import NULL_SPAN
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import REGISTRY
 
@@ -113,6 +115,13 @@ class GenRequest:
     outcome: str | None = None      # terminal serving_requests_total label
     _cancel_requested: bool = False
     _engine: object | None = field(default=None, repr=False)
+    # distributed tracing: the spans ride ON the request object — the
+    # explicit handoff between the submitting HTTP thread and the batcher
+    # thread (never a thread-local, which would leak across the pool).
+    # NULL_SPAN when the trace is unsampled: every operation is a no-op.
+    span: object = field(default=NULL_SPAN, repr=False)        # engine.request
+    wait_span: object = field(default=NULL_SPAN, repr=False)   # admission wait
+    decode_span: object = field(default=NULL_SPAN, repr=False)
 
     def expired(self, now: float | None = None) -> bool:
         return (self.deadline is not None
@@ -223,7 +232,8 @@ class ContinuousBatcher:
                temperature: float = 0.0, eos_id: int | None = None,
                seed: int | None = None, top_k: int = 0,
                top_p: float = 0.0,
-               deadline_s: float | None = None) -> GenRequest:
+               deadline_s: float | None = None,
+               trace_ctx=None) -> GenRequest:
         if len(ids) + max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt+new ({len(ids) + max_new_tokens}) > max_seq "
@@ -239,6 +249,40 @@ class ContinuousBatcher:
                          # so it doesn't force the filtered decode variant
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError("deadline_s must be > 0")
+        # span creation BEFORE the critical section (it allocates nothing
+        # when unsampled): shed/draining rejections below still get their
+        # outcome recorded on the request span before it closes
+        req = GenRequest(list(ids), max_new_tokens, temperature, eos_id,
+                         seed=0, top_k=top_k, top_p=top_p)
+        self._start_trace(req, trace_ctx)
+        try:
+            self._enqueue(req, seed, deadline_s)
+        except BaseException as e:
+            # EVERY failing exit closes the spans (a shut-down engine's
+            # RuntimeError included) — an unended span never reaches the
+            # collector, which would hide exactly the failing requests
+            req.span.set_attribute(
+                "outcome", "shed" if isinstance(e, QueueFull)
+                else "draining" if isinstance(e, Draining) else "error")
+            req.wait_span.end()
+            req.span.end()
+            raise
+        return req
+
+    def _start_trace(self, req: GenRequest, trace_ctx) -> None:
+        tracer = trace.get_tracer()
+        if trace_ctx is not None:
+            req.span = tracer.start_span("engine.request", trace_ctx)
+        else:
+            # direct engine callers (loadtests, in-process embedding):
+            # the engine roots its own trace under head sampling
+            req.span = tracer.start_root("engine.request")
+        req.span.set_attribute("prompt_tokens", len(req.ids))
+        req.span.set_attribute("max_new_tokens", req.max_new_tokens)
+        req.wait_span = tracer.start_span("engine.admission_wait", req.span)
+
+    def _enqueue(self, req: GenRequest, seed: int | None,
+                 deadline_s: float | None) -> None:
         with self._work:
             # one critical section for the closed check, seed assignment,
             # enqueue, and thread (re)spawn: a concurrent shutdown() can
@@ -269,8 +313,7 @@ class ContinuousBatcher:
             if seed is None:
                 self._auto_seed += 1
                 seed = self._auto_seed
-            req = GenRequest(list(ids), max_new_tokens, temperature, eos_id,
-                             seed=seed, top_k=top_k, top_p=top_p)
+            req.seed = seed
             if deadline_s is not None:
                 req.deadline = req.submitted_at + deadline_s
             req._engine = self
@@ -283,13 +326,13 @@ class ContinuousBatcher:
                                                 name="serving-batcher")
                 self._thread.start()
             self._work.notify_all()
-        return req
 
     def generate_sync(self, batch: list[list[int]], max_new_tokens: int = 32,
                       temperature: float = 0.0, eos_id: int | None = None,
                       seed: int | None = None, top_k: int = 0,
                       top_p: float = 0.0,
-                      deadline_s: float | None = None) -> list[list[int]]:
+                      deadline_s: float | None = None,
+                      trace_ctx=None) -> list[list[int]]:
         """Submit a whole (possibly ragged) batch and wait for all rows.
         All-or-nothing: if any row's submit is shed or any row fails,
         the already-submitted siblings are cancelled — the caller gets
@@ -300,7 +343,8 @@ class ContinuousBatcher:
                 reqs.append(self.submit(
                     ids, max_new_tokens, temperature, eos_id,
                     seed=None if seed is None else seed + i,
-                    top_k=top_k, top_p=top_p, deadline_s=deadline_s))
+                    top_k=top_k, top_p=top_p, deadline_s=deadline_s,
+                    trace_ctx=trace_ctx))
             return [r.result() for r in reqs]
         except BaseException:
             for r in reqs:
@@ -568,6 +612,13 @@ class ContinuousBatcher:
         req.error = msg
         req.outcome = outcome
         REQS_TOTAL.labels(outcome).inc()
+        # trace epilogue: whatever was still open closes with the terminal
+        # outcome on the request span (end() is idempotent, so a wait span
+        # already closed at admission is untouched)
+        req.wait_span.end()
+        req.decode_span.end()
+        req.span.set_attribute("outcome", outcome)
+        req.span.end()
         req._done.set()
         if notify:
             with self._work:
@@ -681,6 +732,7 @@ class ContinuousBatcher:
                 continue
             req.admitted_at = time.perf_counter()
             ADMISSION_WAIT.observe(req.admitted_at - req.submitted_at)
+            req.wait_span.end()
             prompt_len = len(req.ids)
             # the request's own key chain starts at its seed
             k_first, k_chain = jax.random.split(
@@ -716,6 +768,10 @@ class ContinuousBatcher:
             req.first_token_at = time.perf_counter()
             TTFT_LAST.set(req.first_token_at - req.submitted_at)
             TTFT_HIST.observe(req.first_token_at - req.submitted_at)
+            # decode span opens at first token and closes at the terminal
+            # outcome (_finish_if_done / _fail) — handed off on the req
+            req.decode_span = trace.get_tracer().start_span(
+                "engine.decode", req.span)
             req.generated.append(tok_host)
             TOKENS_TOTAL.inc()
             self.index = self.index.at[free].set(prompt_len)
@@ -761,15 +817,23 @@ class ContinuousBatcher:
                 self.prefix_cache.release(node)
                 node, usable = None, 0
             (PREFIX_HITS if node is not None else PREFIX_MISSES).inc()
+        if self.prefix_cache is not None:
+            req.span.set_attribute("prefix_cache",
+                                   "hit" if node is not None else "miss")
+            req.span.set_attribute("prefix_matched_tokens", usable)
+        tracer = trace.get_tracer()
         try:
             if node is None and prompt_len <= self.prefill_chunk:
                 bucket = self._bucket_for(prompt_len)
                 padded = req.ids + [0] * (bucket - prompt_len)
                 arr = jnp.asarray([padded], jnp.int32)
-                tok, small = self._prefill(bucket)(
-                    self.params, arr, jnp.int32(prompt_len - 1),
-                    jnp.float32(req.temperature), k_first,
-                    jnp.int32(req.top_k), jnp.float32(req.top_p))
+                with tracer.start_span("engine.prefill", req.span,
+                                       tokens=prompt_len, start_pos=0,
+                                       bucket=bucket):
+                    tok, small = self._prefill(bucket)(
+                        self.params, arr, jnp.int32(prompt_len - 1),
+                        jnp.float32(req.temperature), k_first,
+                        jnp.int32(req.top_k), jnp.float32(req.top_p))
                 PREFILL_DISPATCHES.inc()
                 PREFILL_TOKENS.inc(prompt_len)
                 return tok, small, fully_cached
@@ -794,11 +858,14 @@ class ContinuousBatcher:
                 chunk = req.ids[pos:pos + take] + [0] * (cb - take)
                 arr = jnp.asarray([chunk], jnp.int32)
                 last = pos + take >= prompt_len
-                out = self._extend(cb, last)(
-                    self.params, arr, jnp.int32(pos), small,
-                    jnp.int32(take - 1), jnp.float32(req.temperature),
-                    k_first, jnp.int32(req.top_k),
-                    jnp.float32(req.top_p))
+                with tracer.start_span("engine.prefill", req.span,
+                                       tokens=take, start_pos=pos,
+                                       bucket=cb):
+                    out = self._extend(cb, last)(
+                        self.params, arr, jnp.int32(pos), small,
+                        jnp.int32(take - 1), jnp.float32(req.temperature),
+                        k_first, jnp.int32(req.top_k),
+                        jnp.float32(req.top_p))
                 PREFILL_DISPATCHES.inc()
                 PREFILL_TOKENS.inc(take)
                 pos += take
@@ -906,6 +973,10 @@ class ContinuousBatcher:
                 self._work.notify_all()
             req.outcome = "ok"
             REQS_TOTAL.labels("ok").inc()
+            req.decode_span.set_attribute("tokens", len(req.generated))
+            req.decode_span.end()
+            req.span.set_attribute("outcome", "ok")
+            req.span.end()
             req._done.set()
             return True
         return False

@@ -155,7 +155,8 @@ class GenerativePredictor:
                  temperature: float = 0.0, seed: int = 0,
                  eos_id: int | None = None, top_k: int = 0,
                  top_p: float = 0.0,
-                 deadline_s: float | None = None) -> dict:
+                 deadline_s: float | None = None,
+                 trace_ctx=None) -> dict:
         """Generate continuations for a (possibly RAGGED) batch of prompts.
 
         Routed through the continuous-batching engine: each prompt becomes a
@@ -169,7 +170,7 @@ class GenerativePredictor:
         out_ids = self.engine.generate_sync(
             ids, max_new_tokens=max_new_tokens, temperature=temperature,
             eos_id=eos_id, seed=seed, top_k=top_k, top_p=top_p,
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, trace_ctx=trace_ctx)
         dt = time.perf_counter() - t0
         generated = sum(len(o) - len(i) for o, i in zip(out_ids, ids))
         return {
@@ -257,28 +258,48 @@ class PredictorApp:
         path = environ.get("PATH_INFO", "/")
         method = environ["REQUEST_METHOD"]
         headers: list[tuple[str, str]] = []
+        # server span: continues the gateway's traceparent (one trace id
+        # gateway -> predictor -> engine) or roots fresh under head
+        # sampling; the engine's spans parent to it via the explicit
+        # trace_ctx handoff through generate()
+        from kubeflow_tpu import trace
+
+        span = trace.start_server_span("predictor.request", environ,
+                                       path=path)
+        # even unsampled, the engine receives an EXPLICIT context (the
+        # sampled flag clear) — trace_ctx=None means "no upstream
+        # decision" and would make the engine re-roll the dice, minting
+        # orphan engine-only traces at fractional sample rates
+        ctx = span.context if span else trace.propagation_context(
+            span, environ)
         try:
-            out = self._route(method, path, environ)
-            status, body = out[0], out[1]
-            if len(out) > 2:
-                headers = list(out[2])
-        except KeyError as e:
-            status, body = "404 Not Found", {"error": f"no model {e}"}
-        except QueueFull as e:
-            # load shed, not failure: the client (and the gateway) should
-            # back off and retry — Retry-After carries the engine's queue
-            # wait estimate
-            status, body = "429 Too Many Requests", {"error": str(e)}
-            headers = [("Retry-After", f"{max(1, round(e.retry_after))}")]
-        except Draining as e:
-            status, body = "503 Service Unavailable", {"error": str(e)}
-            headers = [("Retry-After", "1")]
-        except DeadlineExceeded as e:
-            status, body = "504 Gateway Timeout", {"error": str(e)}
-        except ValueError as e:
-            status, body = "422 Unprocessable Entity", {"error": str(e)}
-        except Exception as e:  # pragma: no cover
-            status, body = "500 Internal Server Error", {"error": str(e)}
+            try:
+                out = self._route(method, path, environ, ctx)
+                status, body = out[0], out[1]
+                if len(out) > 2:
+                    headers = list(out[2])
+            except KeyError as e:
+                status, body = "404 Not Found", {"error": f"no model {e}"}
+            except QueueFull as e:
+                # load shed, not failure: the client (and the gateway)
+                # should back off and retry — Retry-After carries the
+                # engine's queue wait estimate
+                status, body = "429 Too Many Requests", {"error": str(e)}
+                headers = [("Retry-After",
+                            f"{max(1, round(e.retry_after))}")]
+            except Draining as e:
+                status, body = "503 Service Unavailable", {"error": str(e)}
+                headers = [("Retry-After", "1")]
+            except DeadlineExceeded as e:
+                status, body = "504 Gateway Timeout", {"error": str(e)}
+            except ValueError as e:
+                status, body = "422 Unprocessable Entity", {"error": str(e)}
+            except Exception as e:  # pragma: no cover
+                status, body = ("500 Internal Server Error",
+                                {"error": str(e)})
+            span.set_attribute("status", int(status.split()[0]))
+        finally:
+            span.end()
         if isinstance(body, str):  # /metrics Prometheus text
             payload = body.encode()
             ctype = "text/plain; version=0.0.4"
@@ -329,7 +350,7 @@ class PredictorApp:
             return None
         return val if val > 0 else None
 
-    def _route(self, method, path, environ):
+    def _route(self, method, path, environ, trace_ctx=None):
         if path == "/healthz":
             if self.draining:
                 # not-ready, not dead: readiness gates rotate traffic away
@@ -358,7 +379,8 @@ class PredictorApp:
                         eos_id=int(eos) if eos is not None else None,
                         top_k=int(body.get("top_k", 0)),
                         top_p=float(body.get("top_p", 0.0)),
-                        deadline_s=self._deadline_s(environ, body))
+                        deadline_s=self._deadline_s(environ, body),
+                        trace_ctx=trace_ctx)
                 if verb == "predict":
                     return "200 OK", pred.predict(body["instances"])
             else:

@@ -36,37 +36,56 @@ def trace(directory: str | None):
 class StepWindowTracer:
     """Captures exactly ``num_steps`` loop iterations starting at
     ``start_step`` — call ``on_step(step)`` at the top of each iteration and
-    ``close()`` after the loop (idempotent)."""
+    ``close()`` after the loop (idempotent).
+
+    One window per tracer, EVER: a checkpoint-resume replays step numbers
+    (the loop restarts at the restored step, which can be <= ``start``),
+    and a second ``start_trace`` against the runtime raises / clobbers the
+    first capture — so once a window has been written, a replayed
+    ``step == start`` is a no-op.
+
+    ``backend`` injects the profiler implementation (anything with
+    ``start_trace(dir)`` / ``stop_trace()``); the default resolves
+    ``jax.profiler`` lazily so the guard logic is unit-testable without
+    jax in the loop.
+    """
 
     def __init__(self, directory: str | None, start_step: int,
-                 num_steps: int = 5):
+                 num_steps: int = 5, backend=None):
         self.directory = directory
         self.start = start_step
         self.stop_at = start_step + num_steps
         self._active = False
+        self._done = False   # a window was captured; never start another
+        self._backend = backend
+
+    def _profiler(self):
+        if self._backend is None:
+            import jax
+
+            self._backend = jax.profiler
+        return self._backend
 
     def on_step(self, step: int) -> None:
         if not self.directory:
             return
-        import jax
-
-        if step == self.start and not self._active:
+        if step == self.start and not self._active and not self._done:
             os.makedirs(self.directory, exist_ok=True)
-            jax.profiler.start_trace(self.directory)
+            self._profiler().start_trace(self.directory)
             self._active = True
             log.info("profiler window start", step=step,
                      directory=self.directory)
         elif step >= self.stop_at and self._active:
-            jax.profiler.stop_trace()
+            self._profiler().stop_trace()
             self._active = False
+            self._done = True
             log.info("profiler window written", directory=self.directory)
 
     def close(self) -> None:
         if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
+            self._profiler().stop_trace()
             self._active = False
+            self._done = True
             log.info("profiler window written", directory=self.directory)
 
 

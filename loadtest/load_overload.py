@@ -181,9 +181,14 @@ def main() -> int:
 
     # deterministic epilogue (the storm may shed the impatient clients
     # before they ever hold a slot): prove the cancel and deadline paths
-    # evict mid-decode on a quiet engine
+    # evict mid-decode on a quiet engine.  Each probe pins its race with
+    # a one-shot decode stall: a fast host finishes all max_new greedy
+    # tokens in <20 ms, which would let the probed request COMPLETE
+    # before its timeout/deadline ever bites (observed on bare-metal CI
+    # — the probe then reports a false eviction failure).
     from kubeflow_tpu.serving.engine import DeadlineExceeded
 
+    engine.chaos_stall(0.2)
     ra = engine.submit(prompts[0], max_new_tokens=max_new, eos_id=eos)
     rb = engine.submit(prompts[1], max_new_tokens=max_new, eos_id=eos)
     try:
@@ -192,6 +197,7 @@ def main() -> int:
     except TimeoutError:
         cancel_ok = True
     rb.result(timeout=120)
+    engine.chaos_stall(0.2)
     rc = engine.submit(prompts[2], max_new_tokens=max_new, eos_id=eos,
                        deadline_s=0.02)
     rd = engine.submit(prompts[3], max_new_tokens=max_new, eos_id=eos)

@@ -1,0 +1,309 @@
+"""Tracing loadtest (ISSUE 10 acceptance): span-tree invariants under the
+serving storm + the sampling-off overhead budget.
+
+Phase 1 — traced storm (sampling ON): replays the load_serving traffic
+shape (N concurrent requests over K shared prompts, plus client cancels
+and tight deadlines) through the real continuous-batching engine with a
+rate-1.0 tracer, then audits the collector:
+
+- every non-root span parents to a live span of the same trace;
+- no negative or missing durations on finished traces;
+- queue-wait + prefill + decode cover the request end-to-end within a
+  scheduling-slack tolerance (the spans ACCOUNT for the time, which is
+  the whole point of the subsystem);
+- cancel/deadline storms land their outcomes on the spans.
+
+Phase 2 — overhead budget (sampling OFF): with a rate-0 tracer every
+trace call is a no-op on NULL_SPAN.  The per-request cost of that no-op
+path is microbenchmarked directly and priced against the measured TTFT
+p50 of the same engine — the acceptance budget is <=1% (recorded in
+PERF.md).  A sampled run is timed too, so PERF.md can price sampling ON.
+
+Usage: python loadtest/load_trace.py [N_REQUESTS] [K_PROMPTS] [--smoke]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _prompts(k: int, sys_len: int, vocab: int) -> list[list[int]]:
+    out = []
+    state = 0x2545F491
+    for i in range(k):
+        toks = []
+        for _ in range(sys_len + 4 + i % 3):
+            state = (state * 1103515245 + 12345) & 0x7FFFFFFF
+            toks.append(1 + state % (vocab - 1))
+        out.append(toks)
+    return out
+
+
+def _pct(vals: list[float], p: float) -> float:
+    vals = sorted(vals)
+    return vals[min(int(len(vals) * p / 100), len(vals) - 1)]
+
+
+def _build_engine(shape: dict, max_seq: int, chunk: int, vocab: int = 256):
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import llama as lm
+    from kubeflow_tpu.parallel.sharding import unbox_params
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    cfg = lm.LlamaConfig(vocab_size=vocab, max_seq_len=1024,
+                         use_flash=False, **shape)
+    module = lm.LlamaModel(cfg)
+    params = unbox_params(module.init(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, 8), jnp.int32))
+                          ["params"])
+    return ContinuousBatcher(module, params, cfg, max_batch=4,
+                             max_seq=max_seq, prefill_chunk=chunk)
+
+
+def _audit_tree(spans) -> list[str]:
+    """Span-tree invariants over the whole collector; returns violation
+    strings (empty = clean)."""
+    errors: list[str] = []
+    by_trace: dict[str, dict[str, object]] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, {})[s.span_id] = s
+    for tid, idx in by_trace.items():
+        for s in idx.values():
+            if s.duration is None:
+                errors.append(f"{tid[:8]} {s.name}: span never ended")
+            elif s.duration < 0:
+                errors.append(f"{tid[:8]} {s.name}: negative duration")
+            if s.parent_id is not None and s.parent_id not in idx:
+                errors.append(
+                    f"{tid[:8]} {s.name}: parent {s.parent_id} not a "
+                    "live span of this trace")
+    return errors
+
+
+def _audit_accounting(spans, tol_frac: float, tol_abs: float) -> list[str]:
+    """Per completed request: queue-wait + prefill + decode must cover
+    the end-to-end duration up to scheduling slack."""
+    errors: list[str] = []
+    by_trace: dict[str, list] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    for tid, ss in by_trace.items():
+        req = next((s for s in ss if s.name == "engine.request"), None)
+        if req is None or req.attributes.get("outcome") != "ok":
+            continue
+        wait = sum(s.duration for s in ss
+                   if s.name == "engine.admission_wait")
+        prefill = sum(s.duration for s in ss if s.name == "engine.prefill")
+        decode = sum(s.duration for s in ss if s.name == "engine.decode")
+        parts = wait + prefill + decode
+        slack = req.duration - parts
+        tol = max(tol_frac * req.duration, tol_abs)
+        if slack < -1e-6:
+            errors.append(f"{tid[:8]}: components {parts:.4f}s exceed "
+                          f"end-to-end {req.duration:.4f}s")
+        elif slack > tol:
+            errors.append(
+                f"{tid[:8]}: unaccounted {slack * 1e3:.1f} ms of "
+                f"{req.duration * 1e3:.1f} ms (tol {tol * 1e3:.1f} ms)")
+    return errors
+
+
+def _storm(engine, prompts, n: int, max_new: int) -> dict:
+    """N concurrent submits plus two CANCEL victims (long decodes,
+    cancelled right after submission — deterministically still in
+    flight) and tight-deadline requests — the overload shapes whose
+    outcomes must land on the spans."""
+    from kubeflow_tpu.serving.engine import (
+        DeadlineExceeded,
+        QueueFull,
+    )
+
+    reqs = []
+    for i in range(n):
+        deadline = 0.002 if i % 7 == 3 else None
+        try:
+            reqs.append(engine.submit(prompts[i % len(prompts)],
+                                      max_new_tokens=max_new,
+                                      deadline_s=deadline))
+        except QueueFull:
+            reqs.append(None)
+    # cancel victims ride BEHIND the storm with long decodes: the cancel
+    # lands while they are queued or mid-decode, never after completion
+    victims = [engine.submit(prompts[0], max_new_tokens=64)
+               for _ in range(2)]
+    for v in victims:
+        v.cancel("storm cancel")
+    outcomes = {"ok": 0, "cancelled": 0, "deadline_exceeded": 0,
+                "shed": 0, "error": 0}
+    for r in reqs + victims:
+        if r is None:
+            outcomes["shed"] += 1
+            continue
+        try:
+            r.result(timeout=600)
+            outcomes["ok"] += 1
+        except DeadlineExceeded:
+            outcomes["deadline_exceeded"] += 1
+        except ValueError:
+            outcomes["cancelled"] += 1
+    return outcomes
+
+
+def _probe_ttft(engine, prompts, repeats: int, max_new: int) -> list[float]:
+    out = []
+    for _ in range(repeats):
+        for p in prompts:
+            r = engine.submit(p, max_new_tokens=max_new)
+            r.result(timeout=600)
+            out.append(r.first_token_at - r.submitted_at)
+    return out
+
+
+class _ReqShape:
+    """Attribute holder mirroring GenRequest's span handoff fields, so
+    the microbenchmark pays the same attribute loads the engine does."""
+
+    __slots__ = ("span", "wait_span", "decode_span")
+
+
+def _noop_trace_cost_s() -> float:
+    """Per-request cost of the sampling-off trace path: one head-sampling
+    decision + the NULL_SPAN operations a request performs end to end,
+    in the engine's own handoff shape (spans stored on the request)."""
+    from kubeflow_tpu import trace
+
+    tracer = trace.Tracer(0.0)
+    iters = 20000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        req = _ReqShape()
+        req.span = tracer.start_root("engine.request")
+        req.span.set_attribute("prompt_tokens", 8)
+        req.span.set_attribute("max_new_tokens", 8)
+        req.wait_span = tracer.start_span("engine.admission_wait",
+                                          req.span)
+        req.wait_span.end()
+        with tracer.start_span("engine.prefill", req.span, tokens=8,
+                               start_pos=0, bucket=16):
+            pass
+        req.decode_span = tracer.start_span("engine.decode", req.span)
+        req.decode_span.set_attribute("tokens", 8)
+        req.decode_span.end()
+        req.span.set_attribute("outcome", "ok")
+        req.span.end()
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    smoke = "--smoke" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    if smoke:
+        n, k, sys_len, max_seq, chunk, max_new = 14, 2, 24, 128, 16, 4
+        shape = dict(hidden_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, intermediate_size=128)
+        budget_frac = 0.05   # CI hosts are noisy; the full run holds 1%
+    else:
+        n = int(args[0]) if args else 32
+        k = int(args[1]) if len(args) > 1 else 4
+        sys_len, max_seq, chunk, max_new = 96, 256, 64, 8
+        shape = dict(hidden_size=128, num_layers=4, num_heads=4,
+                     num_kv_heads=2, intermediate_size=256)
+        budget_frac = 0.01   # the acceptance budget
+
+    from kubeflow_tpu import trace
+
+    # -- phase 1: traced storm -------------------------------------------------
+    tracer = trace.set_tracer(trace.Tracer(
+        1.0, collector=trace.Collector(65536)))
+    engine = _build_engine(shape, max_seq, chunk)
+    prompts = _prompts(k, sys_len, 256)
+    # warm the executables so span durations are dispatch, not compiles
+    for p in prompts[:2]:
+        engine.submit(p, max_new_tokens=max_new).result(timeout=600)
+    tracer.collector.clear()
+
+    t0 = time.perf_counter()
+    outcomes = _storm(engine, prompts, n, max_new)
+    storm_wall = time.perf_counter() - t0
+    spans = tracer.collector.spans()
+    tree_errors = _audit_tree(spans)
+    acct_errors = _audit_accounting(spans, tol_frac=0.35, tol_abs=0.25)
+    outcomes_on_spans = {
+        s.attributes.get("outcome")
+        for s in spans if s.name == "engine.request"}
+    engine.shutdown()
+
+    # -- phase 2: overhead budget (sampling off) -------------------------------
+    trace.set_tracer(trace.Tracer(0.0))
+    engine_off = _build_engine(shape, max_seq, chunk)
+    for p in prompts[:2]:
+        engine_off.submit(p, max_new_tokens=max_new).result(timeout=600)
+    repeats = 2 if smoke else 4
+    ttft_off = _probe_ttft(engine_off, prompts, repeats, max_new)
+    engine_off.shutdown()
+
+    trace.set_tracer(trace.Tracer(1.0,
+                                  collector=trace.Collector(65536)))
+    engine_on = _build_engine(shape, max_seq, chunk)
+    for p in prompts[:2]:
+        engine_on.submit(p, max_new_tokens=max_new).result(timeout=600)
+    ttft_on = _probe_ttft(engine_on, prompts, repeats, max_new)
+    engine_on.shutdown()
+    trace.set_tracer(trace.Tracer(0.0))
+
+    noop_cost = _noop_trace_cost_s()
+    p50_off = _pct(ttft_off, 50)
+    overhead_frac = noop_cost / max(p50_off, 1e-9)
+
+    result = {
+        "requests": n,
+        "shared_prompts": k,
+        "storm_wall_s": round(storm_wall, 2),
+        "outcomes": outcomes,
+        "spans_recorded": len(spans),
+        "tree_violations": tree_errors,
+        "accounting_violations": acct_errors,
+        "ttft_p50_ms_sampling_off": round(p50_off * 1e3, 3),
+        "ttft_p99_ms_sampling_off": round(_pct(ttft_off, 99) * 1e3, 3),
+        "ttft_p50_ms_sampling_on": round(_pct(ttft_on, 50) * 1e3, 3),
+        "noop_trace_cost_us_per_request": round(noop_cost * 1e6, 3),
+        "overhead_fraction_of_ttft_p50": round(overhead_frac, 6),
+        "overhead_budget": budget_frac,
+    }
+    print(json.dumps(result))
+
+    ok = True
+    if tree_errors:
+        print("FAIL: span-tree invariants violated:\n  "
+              + "\n  ".join(tree_errors[:10]), file=sys.stderr)
+        ok = False
+    if acct_errors:
+        print("FAIL: span time accounting violated:\n  "
+              + "\n  ".join(acct_errors[:10]), file=sys.stderr)
+        ok = False
+    if not {"ok", "cancelled"} <= outcomes_on_spans:
+        print(f"FAIL: span outcomes missing storm shapes: "
+              f"{sorted(str(o) for o in outcomes_on_spans)}",
+              file=sys.stderr)
+        ok = False
+    if outcomes["ok"] == 0:
+        print("FAIL: storm completed no requests", file=sys.stderr)
+        ok = False
+    if overhead_frac > budget_frac:
+        print(f"FAIL: sampling-off trace cost {noop_cost * 1e6:.2f} us "
+              f"is {overhead_frac:.2%} of TTFT p50 "
+              f"(budget {budget_frac:.0%})", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -525,3 +525,81 @@ def test_run_local_honors_skip_vet(monkeypatch):
     ran.clear()
     pipelines.run_local(["analysis", "hpo", "profiles"], build=False)
     assert ran.count(pipelines.VET_CMD) == 1
+
+
+# -- pass 6: span hygiene ------------------------------------------------------
+
+def test_span_lifecycle_unclosed_local_fires(tree):
+    tree("kubeflow_tpu/serving/m.py", """\
+def f(tracer):
+    span = tracer.start_span("engine.request", None)
+    span.set_attribute("x", 1)
+""")
+    (f,) = tree.run()
+    assert f.rule == "span-lifecycle"
+    assert f.line == 2
+
+
+def test_span_lifecycle_with_and_finally_ok(tree):
+    tree("kubeflow_tpu/serving/m.py", """\
+def f(tracer):
+    with tracer.start_span("engine.prefill", None):
+        pass
+    s = tracer.start_root("engine.request")
+    try:
+        pass
+    finally:
+        s.end()
+""")
+    assert tree.run() == []
+
+
+def test_span_lifecycle_attribute_handoff_exempt(tree):
+    """``req.span = start_span(...)`` is the explicit cross-thread
+    handoff shape — closed by another function, invisible to lexical
+    analysis, covered by the loadtest's span-tree invariants."""
+    tree("kubeflow_tpu/serving/m.py", """\
+def f(tracer, req):
+    req.span = tracer.start_span("engine.request", None)
+""")
+    assert tree.run() == []
+
+
+def test_span_lifecycle_nested_def_scoped_separately(tree):
+    """A nested function's finally must not satisfy the OUTER scope's
+    assignment (and vice versa)."""
+    tree("kubeflow_tpu/serving/m.py", """\
+def f(tracer):
+    span = tracer.start_span("engine.request", None)
+
+    def inner(other):
+        try:
+            pass
+        finally:
+            span.end()
+""")
+    (f,) = tree.run()
+    assert f.rule == "span-lifecycle"
+
+
+def test_span_name_shape_enforced(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+def f(tracer):
+    with tracer.start_root("JustOneWord"):
+        pass
+    with tracer.start_root("too.many.dots"):
+        pass
+    with tracer.start_root("good.name"):
+        pass
+""")
+    found = tree.run()
+    assert rules_of(found) == ["span-name", "span-name"]
+
+
+def test_span_lifecycle_suppression_works(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+def f(tracer):
+    span = tracer.start_root("gateway.request")  # kfvet: ignore[span-lifecycle]
+    return span
+""")
+    assert tree.run() == []

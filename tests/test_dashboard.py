@@ -254,3 +254,50 @@ def test_dashboard_degraded_store_503s_writes(stack):
     from kubeflow_tpu.core.store import NotFound
     with pytest.raises(NotFound):
         server.get("Profile", "team-c")
+
+
+def test_traces_route_reports_roots_drops_and_slowest_breakdown(stack):
+    """Trace health card (ISSUE 10): root count, dropped-span counter,
+    recent roots, and the slowest recent root's critical-path breakdown
+    come off the process collector."""
+    from kubeflow_tpu import trace
+
+    server, mgr, base = stack
+    tracer = trace.set_tracer(trace.Tracer(1.0,
+                                           collector=trace.Collector(8)))
+    try:
+        # a fast and a slow root; the slow one has two children
+        fast = tracer.start_root("gateway.request")
+        fast.end()
+        slow = tracer.start_root("gateway.request")
+        with tracer.start_span("gateway.route_match", slow):
+            pass
+        child = tracer.start_span("predictor.request", slow)
+        child.end(at=child.start + 0.5)
+        slow.end(at=slow.start + 1.0)
+
+        code, state = req(base, "/dashboard/api/traces",
+                          user="alice@corp.com")
+        assert code == 200
+        assert state["sample_rate"] == 1.0
+        assert state["root_count"] == 2
+        assert state["spans_total"] >= 4
+        names = [r["name"] for r in state["recent_roots"]]
+        assert names[0] == "gateway.request"
+        slowest = state["slowest"]
+        assert slowest["root"] == "gateway.request"
+        assert slowest["duration_s"] == pytest.approx(1.0)
+        kids = {c["name"]: c for c in slowest["children"]}
+        assert set(kids) == {"gateway.route_match", "predictor.request"}
+        assert slowest["self_s"] == pytest.approx(
+            1.0 - 0.5 - kids["gateway.route_match"]["duration_s"],
+            abs=1e-6)
+
+        # overflow the 8-slot ring: drops surface on the card
+        for _ in range(20):
+            tracer.start_root("engine.request").end()
+        code, state = req(base, "/dashboard/api/traces",
+                          user="alice@corp.com")
+        assert state["spans_dropped"] >= 1
+    finally:
+        trace.set_tracer(trace.Tracer(0.0))

@@ -258,3 +258,54 @@ def test_degraded_store_rejects_writes_503_serves_reads(endpoint, tmp_path):
                                                     namespace="team")}
     assert names == {"pre", "inproc", "after"}
     persistence.detach(s2)
+
+
+def test_x_request_id_minted_and_echoed(endpoint):
+    """Every response carries X-Request-Id: minted when the client sent
+    none, echoed verbatim when it did (ISSUE 10 satellite — one id joins
+    client, gateway, and apiserver access logs)."""
+    server, base = endpoint
+    r = urllib.request.Request(base + "/healthz")
+    with urllib.request.urlopen(r) as resp:
+        minted = resp.headers.get("X-Request-Id")
+        assert minted
+    r = urllib.request.Request(base + "/healthz",
+                               headers={"X-Request-Id": "rid-7"})
+    with urllib.request.urlopen(r) as resp:
+        assert resp.headers.get("X-Request-Id") == "rid-7"
+    # error responses echo too
+    r = urllib.request.Request(base + "/no/such/route",
+                               headers={"X-Request-Id": "rid-8"})
+    try:
+        urllib.request.urlopen(r)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        assert e.headers.get("X-Request-Id") == "rid-8"
+
+
+def test_access_log_lines_carry_request_id(endpoint):
+    """The structured access log records method/path/code/request_id."""
+    import io
+    import logging
+
+    from kubeflow_tpu.utils.logging import _JsonFormatter
+
+    base = endpoint[1]
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    handler.setFormatter(_JsonFormatter())
+    logger = logging.getLogger("kubeflow_tpu.httpapi")
+    logger.addHandler(handler)
+    try:
+        r = urllib.request.Request(base + "/healthz",
+                                   headers={"X-Request-Id": "rid-log-1"})
+        with urllib.request.urlopen(r):
+            pass
+    finally:
+        logger.removeHandler(handler)
+    lines = [json.loads(ln) for ln in buf.getvalue().splitlines()
+             if '"http access"' in ln]
+    mine = [ln for ln in lines if ln.get("request_id") == "rid-log-1"]
+    assert mine and mine[0]["path"] == "/healthz"
+    assert mine[0]["code"] == "200"

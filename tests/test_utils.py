@@ -57,3 +57,75 @@ def test_metrics_exposition():
     assert 'requests_total{code="500"} 1.0' in text
     assert "# TYPE up gauge" in text
     assert c.get("200") == 2.0
+
+
+class _FakeProfiler:
+    """Counts start/stop calls — the injectable backend that makes the
+    window guard testable without jax."""
+
+    def __init__(self):
+        self.starts = 0
+        self.stops = 0
+
+    def start_trace(self, directory):
+        self.starts += 1
+
+    def stop_trace(self):
+        self.stops += 1
+
+
+def test_step_window_tracer_captures_one_window(tmp_path):
+    from kubeflow_tpu.utils.profiler import StepWindowTracer
+
+    prof = _FakeProfiler()
+    t = StepWindowTracer(str(tmp_path), start_step=3, num_steps=2,
+                         backend=prof)
+    for step in range(6):
+        t.on_step(step)
+    t.close()
+    assert (prof.starts, prof.stops) == (1, 1)
+
+
+def test_step_window_tracer_replayed_start_step_never_double_starts(
+        tmp_path):
+    """Checkpoint-resume replays step numbers: after the window is
+    written, seeing ``start_step`` again must NOT call start_trace a
+    second time (a second live trace raises inside the runtime)."""
+    from kubeflow_tpu.utils.profiler import StepWindowTracer
+
+    prof = _FakeProfiler()
+    t = StepWindowTracer(str(tmp_path), start_step=2, num_steps=2,
+                         backend=prof)
+    for step in (2, 3, 4):        # window captured: steps 2..3
+        t.on_step(step)
+    assert (prof.starts, prof.stops) == (1, 1)
+    for step in (2, 3, 4, 5):     # resume replays the window start
+        t.on_step(step)
+    t.close()
+    assert (prof.starts, prof.stops) == (1, 1)
+
+
+def test_step_window_tracer_repeated_start_step_single_start(tmp_path):
+    """The same step number arriving twice while the window is OPEN
+    (retried step after preemption) starts exactly one trace."""
+    from kubeflow_tpu.utils.profiler import StepWindowTracer
+
+    prof = _FakeProfiler()
+    t = StepWindowTracer(str(tmp_path), start_step=1, num_steps=3,
+                         backend=prof)
+    for step in (1, 1, 2):
+        t.on_step(step)
+    assert prof.starts == 1
+    t.close()
+    assert prof.stops == 1
+
+
+def test_step_window_tracer_noop_without_directory():
+    from kubeflow_tpu.utils.profiler import StepWindowTracer
+
+    prof = _FakeProfiler()
+    t = StepWindowTracer(None, start_step=0, num_steps=2, backend=prof)
+    for step in range(4):
+        t.on_step(step)
+    t.close()
+    assert (prof.starts, prof.stops) == (0, 0)
