@@ -14,15 +14,23 @@ KIND = "InferenceService"
 PORT = 8602
 
 # opt-in radix-tree KV prefix reuse on the predictor: the value is the HBM
-# byte budget in MB for cached prefix blocks (0/absent = disabled)
+# byte budget in MB for cached prefix pages (0/absent = disabled)
 PREFIX_CACHE_ANNOTATION = "serving.kubeflow.org/prefix-cache-mb"
+# tokens per KV page — the sharing granularity of the paged block pool
+# the prefix cache and admissions draw from (absent = engine default)
+KV_PAGE_SIZE_ANNOTATION = "serving.kubeflow.org/kv-page-size"
+# max draft tokens per speculative-decoding verify round (0/absent =
+# disabled; output is token-identical either way)
+SPECULATIVE_TOKENS_ANNOTATION = "serving.kubeflow.org/speculative-tokens"
 
 
 def new(name: str, namespace: str, *, model: str = "llama",
         size: str = "tiny", topology: str = "v5e-4",
         model_config: dict | None = None,
         checkpoint_dir: str | None = None, min_replicas: int = 1,
-        prefix_cache_mb: float | None = None) -> dict:
+        prefix_cache_mb: float | None = None,
+        kv_page_size: int | None = None,
+        speculative_tokens: int | None = None) -> dict:
     isvc = api_object(KIND, name, namespace, spec={
         "predictor": {
             "model": model,
@@ -32,9 +40,15 @@ def new(name: str, namespace: str, *, model: str = "llama",
             "topology": topology,
             "minReplicas": min_replicas,
         }})
+    annotations = isvc["metadata"].setdefault("annotations", {})
     if prefix_cache_mb:
-        isvc["metadata"].setdefault("annotations", {})[
-            PREFIX_CACHE_ANNOTATION] = str(prefix_cache_mb)
+        annotations[PREFIX_CACHE_ANNOTATION] = str(prefix_cache_mb)
+    if kv_page_size:
+        annotations[KV_PAGE_SIZE_ANNOTATION] = str(kv_page_size)
+    if speculative_tokens:
+        annotations[SPECULATIVE_TOKENS_ANNOTATION] = str(speculative_tokens)
+    if not annotations:
+        del isvc["metadata"]["annotations"]
     return isvc
 
 
@@ -45,6 +59,24 @@ def prefix_cache_mb(isvc: dict) -> float:
     if raw is None:
         return 0.0
     return float(raw)
+
+
+def kv_page_size(isvc: dict) -> int:
+    """The annotated KV page size in tokens (0 = engine default)."""
+    raw = isvc.get("metadata", {}).get("annotations", {}).get(
+        KV_PAGE_SIZE_ANNOTATION)
+    if raw is None:
+        return 0
+    return int(raw)
+
+
+def speculative_tokens(isvc: dict) -> int:
+    """The annotated speculative draft budget in tokens (0 = disabled)."""
+    raw = isvc.get("metadata", {}).get("annotations", {}).get(
+        SPECULATIVE_TOKENS_ANNOTATION)
+    if raw is None:
+        return 0
+    return int(raw)
 
 
 def validate(isvc: dict) -> None:
@@ -69,3 +101,17 @@ def validate(isvc: dict) -> None:
             f"{PREFIX_CACHE_ANNOTATION} must be a finite number (MB)")
     if mb < 0:
         raise ValueError(f"{PREFIX_CACHE_ANNOTATION} must be >= 0")
+    try:
+        ps = kv_page_size(isvc)
+    except ValueError:
+        raise ValueError(
+            f"{KV_PAGE_SIZE_ANNOTATION} must be an integer (tokens)")
+    if ps < 0:
+        raise ValueError(f"{KV_PAGE_SIZE_ANNOTATION} must be >= 0")
+    try:
+        spec = speculative_tokens(isvc)
+    except ValueError:
+        raise ValueError(
+            f"{SPECULATIVE_TOKENS_ANNOTATION} must be an integer (tokens)")
+    if spec < 0:
+        raise ValueError(f"{SPECULATIVE_TOKENS_ANNOTATION} must be >= 0")

@@ -153,8 +153,12 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                      "tests/test_serving.py", "tests/test_serving_engine.py",
                      "tests/test_prefix_cache.py", "tests/test_quant.py"],
         # small-N shared-prefix loadtest: asserts the prefix cache still
-        # cuts prefill dispatches and that warm output == cold output on
-        # real engine traffic (KF_SKIP_SMOKE=1 opts out)
+        # cuts prefill dispatches, warm output == cold output, the
+        # speculative stream is token-identical to plain decode, the
+        # paged KV pool holds zero orphan pages when idle, and decode
+        # tokens/s clears a throughput floor (KF_DECODE_FLOOR, default
+        # ~25% of what CI hardware sustains — a regression canary, not a
+        # benchmark; KF_SKIP_SMOKE=1 opts the whole step out)
         "smoke_cmd": [sys.executable, "loadtest/load_serving.py",
                       "--smoke"],
         # 4x-capacity overload storm with a decode-stall fault: asserts

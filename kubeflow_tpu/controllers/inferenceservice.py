@@ -64,6 +64,12 @@ class InferenceServiceController(Controller):
         cache_mb = api.prefix_cache_mb(isvc)
         if cache_mb > 0:
             args += ["--prefix-cache-mb", str(cache_mb)]
+        page_size = api.kv_page_size(isvc)
+        if page_size > 0:
+            args += ["--kv-page-size", str(page_size)]
+        spec_tokens = api.speculative_tokens(isvc)
+        if spec_tokens > 0:
+            args += ["--speculative-tokens", str(spec_tokens)]
         container = {
             "name": "predictor",
             "image": pred.get("image", "kubeflow-tpu/predictor:latest"),

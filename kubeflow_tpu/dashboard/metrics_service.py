@@ -41,12 +41,14 @@ def autoscaler_state(server) -> list[dict]:
 
 
 def serving_cache_state() -> dict:
-    """Prefix-cache + TTFT standing of the serving engines sharing this
-    process's metrics registry (tests and the single-binary dev platform;
-    a scraped deployment reads the same series off each predictor's
-    ``/metrics``): hit rate, cached bytes/blocks, evictions, prefill
-    dispatch count, and TTFT p50/p99 from the histogram the engine
-    promoted (the last-value gauge stays for old panels)."""
+    """Prefix-cache + KV-page-pool + speculative-decoding + TTFT standing
+    of the serving engines sharing this process's metrics registry (tests
+    and the single-binary dev platform; a scraped deployment reads the
+    same series off each predictor's ``/metrics``): hit rate, cached
+    pages/bytes, evictions, page-pool capacity/free/utilization,
+    speculative accept rate, prefill dispatch count, decode throughput,
+    and TTFT p50/p99 from the histogram the engine promoted (the
+    last-value gauge stays for old panels)."""
     from kubeflow_tpu.utils.metrics import REGISTRY
 
     def val(name: str) -> float:
@@ -56,6 +58,12 @@ def serving_cache_state() -> dict:
     hits = val("serving_prefix_cache_hits_total")
     misses = val("serving_prefix_cache_misses_total")
     ttft = REGISTRY.get_metric("serving_time_to_first_token_seconds")
+    capacity = val("serving_kv_pages_capacity")
+    free = val("serving_kv_pages_free")
+    cached_pages = val("serving_prefix_cache_pages")
+    proposed = val("serving_spec_tokens_proposed_total")
+    accepted = val("serving_spec_tokens_accepted_total")
+    decode_s = val("serving_decode_seconds_total")
     return {
         "prefix_cache": {
             "hits": hits,
@@ -63,10 +71,30 @@ def serving_cache_state() -> dict:
             "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
             "evictions": val("serving_prefix_cache_evictions_total"),
             "bytes": val("serving_prefix_cache_bytes"),
+            "pages": cached_pages,
             "blocks": val("serving_prefix_cache_nodes"),
+        },
+        "kv_pool": {
+            "pages": capacity,
+            "free": free,
+            "in_use": capacity - free,
+            # pages neither free nor cache-owned: a steady-state nonzero
+            # value is a leaked admission commit
+            "pinned": max(capacity - free - cached_pages, 0.0),
+            "utilization": ((capacity - free) / capacity) if capacity
+            else 0.0,
+        },
+        "speculative": {
+            "proposed": proposed,
+            "accepted": accepted,
+            "accept_rate": (accepted / proposed) if proposed else 0.0,
+            "rounds": val("serving_spec_rounds_total"),
         },
         "prefill_dispatches": val("serving_prefill_dispatches_total"),
         "prefill_tokens": val("serving_prefill_tokens_total"),
+        "decode_tokens": val("serving_decode_tokens_total"),
+        "decode_tokens_per_sec": (val("serving_decode_tokens_total")
+                                  / decode_s) if decode_s else 0.0,
         "ttft_p50_s": ttft.percentile(50) if ttft is not None else 0.0,
         "ttft_p99_s": ttft.percentile(99) if ttft is not None else 0.0,
     }

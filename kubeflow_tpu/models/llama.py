@@ -88,7 +88,44 @@ class LlamaAttention(nn.Module):
         q = kl.rotary_embedding(q, positions, cfg.rope_base)
         k = kl.rotary_embedding(k, positions, cfg.rope_base)
 
-        if cache is not None:
+        if cache is not None and "pages" in cache:
+            # PAGED cache (vLLM-style): the KV pool is [N, page, Hkv, D]
+            # per layer and this sequence batch addresses it through a
+            # page TABLE ``pages`` [B, P] of page ids (page 0 = the null
+            # page padding unallocated slots).  Each of the s incoming
+            # tokens scatters its k/v into (page, offset) computed from
+            # its absolute position, then attention runs over the
+            # gathered logical view — prefix pages shared by reference
+            # between requests are read in place, never copied.
+            # NOTE: this is the accelerator-native formulation, kept
+            # bitwise-equal to the contiguous branch by
+            # tests/test_models.py.  The serving engine's hot loop uses
+            # a resident contiguous view instead because XLA CPU copies
+            # donated pool buffers at jit boundaries (ARCHITECTURE
+            # decision 18); a backend with true donation aliasing should
+            # route decode through this branch.
+            pool_k, pool_v = cache["pool_k"], cache["pool_v"]
+            pages, idx = cache["pages"], cache["index"]
+            page = pool_k.shape[1]
+            b_, s_ = x.shape[0], x.shape[1]
+            span = pages.shape[1] * page
+            pos = idx[:, None] + jnp.arange(s_)[None, :]       # [B, s] abs
+            # clamp keeps frozen/overshooting rows in-table; their writes
+            # land in their own reserved tail (or the null page) and are
+            # re-written before any query ever attends to them
+            pos = jnp.clip(pos, 0, span - 1)
+            pg = jnp.take_along_axis(pages, pos // page, axis=1)
+            off = pos % page
+            pool_k = pool_k.at[pg, off].set(k)
+            pool_v = pool_v.at[pg, off].set(v)
+            ck = pool_k[pages].reshape(b_, span, *pool_k.shape[2:])
+            cv = pool_v[pages].reshape(b_, span, *pool_v.shape[2:])
+            cache = {"pool_k": pool_k, "pool_v": pool_v, "pages": pages,
+                     "index": idx + s_}
+            pos_k = jnp.arange(span)[None, None, None, :]
+            valid = pos_k <= positions[:, None, :, None]
+            out = dot_product_attention(q, ck, cv, mask=valid)
+        elif cache is not None:
             # cache is dict(k=[B,S,Hkv,D], v=..., index) where index is a
             # scalar (equal-length batches, and the serving engine's
             # batch-1 prefill-from-index: a multi-token block continues
@@ -96,6 +133,12 @@ class LlamaAttention(nn.Module):
             # chunked prefill) or [B] (ragged batches / continuous
             # batching: every sequence sits at its own position)
             idx = cache["index"]
+            # the cache may hold a WIDER float type than the model dtype
+            # (the serving engine keeps its decode view in f32 as a
+            # CPU-speed representation of bf16 values); upcasting the
+            # update is exact, so storage dtype never changes the math
+            k = k.astype(cache["k"].dtype)
+            v = v.astype(cache["v"].dtype)
             if idx.ndim == 0:
                 ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, idx,
                                                          axis=1)
@@ -228,6 +271,26 @@ class LlamaModel(nn.Module):
         if cache is not None:
             out["cache"] = {"layers": new_cache}
         return out
+
+
+def init_kv_pool(cfg: LlamaConfig, num_pages: int, page_size: int):
+    """Per-layer paged KV pool: ``[num_pages, page_size, Hkv, D]`` k/v
+    arrays addressed through page tables (page 0 is the reserved null
+    page).  The serving engine attaches ``pages``/``index`` per layer at
+    dispatch time, mirroring how ``init_cache`` callers attach ``index``."""
+    layer = lambda: {  # noqa: E731
+        "k": jnp.zeros((num_pages, page_size, cfg.num_kv_heads,
+                        cfg.head_dim), cfg.jnp_dtype),
+        "v": jnp.zeros((num_pages, page_size, cfg.num_kv_heads,
+                        cfg.head_dim), cfg.jnp_dtype),
+    }
+    return {"layers": [layer() for _ in range(cfg.num_layers)]}
+
+
+def kv_page_nbytes(cfg: LlamaConfig, page_size: int) -> int:
+    """Device bytes one page id covers across every layer (k and v)."""
+    return (2 * cfg.num_layers * page_size * cfg.num_kv_heads
+            * cfg.head_dim * cfg.jnp_dtype.itemsize)
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,

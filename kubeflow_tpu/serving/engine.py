@@ -10,8 +10,32 @@ Design constraints that shape this engine:
   per dispatch via lax.scan — K adapts: small while requests wait in the
   queue (fast admission), large when the batch is alone (fewer dispatches);
 - prompts are RAGGED: each slot keeps its own cache position (per-sequence
-  index, models/llama.py), prefill is per-request (batch 1, bucketed
-  lengths) and its KV block is inserted into the slot row.
+  index, models/llama.py).
+
+KV storage is split by WHO WRITES IT:
+
+- prompt KV lives in a PAGED block pool (vLLM's PagedAttention, Kwon et
+  al. SOSP'23; serving/page_pool.py): fixed-size immutable pages shared
+  by refcount between the prefix cache's radix tree and admissions.  A
+  cached prefix is stored ONCE no matter how many longer prefixes extend
+  it, insertion is an incref (the old design copied a snapped block per
+  node), and eviction frees pages, not whole prefixes;
+- decode KV lives in a RESIDENT per-slot view ``[max_batch, max_seq]``
+  the chunked scan and the speculative verifier mutate in place.  It is
+  held in float32 purely as a CPU-speed representation of bf16-valued
+  numbers (every bf16 is exact in f32, and the one lossy step — softmax
+  weight rounding — happens in the model dtype either way, so streams
+  are bitwise independent of the storage layout; ops/attention.py).
+
+Decode optionally runs SPECULATIVELY (Leviathan et al., ICML 2023): a
+host-side n-gram drafter (serving/speculative.py, draft-model pluggable
+via ``draft_fn``) proposes the next few tokens and one batched forward
+verifies them all.  Every accepted token is bitwise the token sequential
+decode would have produced, so speculative output is token-identical to
+plain decode.  A cost model arbitrates per iteration: a verify round
+runs only when the drafts' expected accepted tokens beat the chunked
+scan's per-step economics, so adversarial streams degrade to plain scan
+throughput instead of paying for rejected drafts.
 
 The public surface is ``submit() -> GenRequest`` + ``result()``; the HTTP
 layer submits concurrent requests and they share decode iterations.
@@ -28,6 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu import trace
+from kubeflow_tpu.serving.page_pool import PagePool, pages_for
 from kubeflow_tpu.trace import NULL_SPAN
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import REGISTRY
@@ -50,6 +75,12 @@ TTFT_HIST = REGISTRY.histogram(
              1.0, 2.5, 5.0, 10.0, 30.0))
 TOKS_PER_SEC = REGISTRY.gauge("serving_tokens_per_sec",
                               "decode throughput, last window")
+DECODE_TOKENS = REGISTRY.counter(
+    "serving_decode_tokens_total",
+    "tokens produced by decode dispatches (excludes prefill first tokens)")
+DECODE_SECONDS = REGISTRY.counter(
+    "serving_decode_seconds_total",
+    "wall seconds spent in decode dispatches (chunked scan or verify)")
 PREFILL_DISPATCHES = REGISTRY.counter(
     "serving_prefill_dispatches_total",
     "prefill forward dispatches (full-prompt or chunked extend)")
@@ -62,6 +93,15 @@ PREFIX_HITS = REGISTRY.counter(
 PREFIX_MISSES = REGISTRY.counter(
     "serving_prefix_cache_misses_total",
     "admissions that found no usable cached prefix")
+SPEC_PROPOSED = REGISTRY.counter(
+    "serving_spec_tokens_proposed_total",
+    "draft tokens proposed to speculative verification")
+SPEC_ACCEPTED = REGISTRY.counter(
+    "serving_spec_tokens_accepted_total",
+    "draft tokens accepted by speculative verification")
+SPEC_ROUNDS = REGISTRY.counter(
+    "serving_spec_rounds_total",
+    "speculative verify dispatches")
 ADMISSION_WAIT = REGISTRY.histogram(
     "serving_admission_wait_seconds",
     "queue wait from submit() to slot admission",
@@ -73,6 +113,14 @@ DRAINING_GAUGE = REGISTRY.gauge(
 
 PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
 DECODE_CHUNKS = (8, 16, 32, 64, 128)
+SEED_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+# verify-round cost model, in scan-step units: a round costs about
+# BASE steps of fixed overhead (dispatch + host sync) plus SLOPE steps
+# per extra verified token (measured on the serving decode shape; both
+# deliberately pessimistic so the policy errs toward the scan)
+SPEC_COST_BASE = 1.8
+SPEC_COST_SLOPE = 0.15
 
 
 class QueueFull(RuntimeError):
@@ -115,6 +163,7 @@ class GenRequest:
     outcome: str | None = None      # terminal serving_requests_total label
     _cancel_requested: bool = False
     _engine: object | None = field(default=None, repr=False)
+    _spec: object = field(default=None, repr=False)  # SpeculationState
     # distributed tracing: the spans ride ON the request object — the
     # explicit handoff between the submitting HTTP thread and the batcher
     # thread (never a thread-local, which would leak across the pool).
@@ -130,8 +179,8 @@ class GenRequest:
 
     def cancel(self, reason: str = "cancelled by caller") -> None:
         """Ask the engine to evict this request (queued or mid-decode).
-        Idempotent; a no-op once the request is done.  The slot, its KV
-        row, and any queue entry free within one decode chunk."""
+        Idempotent; a no-op once the request is done.  The slot and any
+        queue entry free within one decode chunk."""
         self._cancel_requested = True
         eng = self._engine
         if eng is not None and not self._done.is_set():
@@ -153,12 +202,14 @@ class GenRequest:
 
 
 class ContinuousBatcher:
-    """Shares one device cache of ``max_batch`` slots across requests."""
+    """Shares one resident decode view + one KV page pool across requests."""
 
     def __init__(self, module, params, cfg, *, max_batch: int = 4,
                  max_seq: int = 512, mesh=None,
                  prefix_cache_bytes: int = 0, prefill_chunk: int = 512,
-                 max_queue: int = 0):
+                 max_queue: int = 0, page_size: int = 16,
+                 kv_pages: int = 0, speculative_tokens: int = 0,
+                 draft_fn=None):
         from kubeflow_tpu.models import llama as llama_mod
 
         self.module = module
@@ -170,28 +221,67 @@ class ContinuousBatcher:
         # prefill in chunks so one large admission cannot block in-flight
         # decode for the whole prompt
         self.prefill_chunk = max(1, min(prefill_chunk, self.max_seq))
-        self.prefix_cache = None
+        # clamped like prefill_chunk: a page larger than max_seq could
+        # never be committed (max_seq // page_size == 0 would silently
+        # disable the prefix cache the operator asked for)
+        self.page_size = max(1, min(int(page_size), self.max_seq))
+        self.pages_per_seq = pages_for(self.max_seq, self.page_size)
+        self.page_nbytes = llama_mod.kv_page_nbytes(cfg, self.page_size)
+        # speculative decoding: max draft tokens per verify round (0 =
+        # plain chunked-scan decode); the drafter defaults to n-gram
+        # prompt lookup and accepts any (tokens, max) -> list[int] seam
+        self.spec_max = max(0, int(speculative_tokens))
+        if draft_fn is None:
+            from kubeflow_tpu.serving.speculative import ngram_draft
+
+            draft_fn = ngram_draft
+        self.draft_fn = draft_fn
+        self._spec_buckets = tuple(
+            b for b in (1, 2, 4, 8, 16, 32) if b < self.spec_max
+        ) + ((self.spec_max,) if self.spec_max else ())
+
+        cache_pages = 0
         if prefix_cache_bytes > 0:
+            cache_pages = max(1, prefix_cache_bytes // self.page_nbytes)
+        if kv_pages <= 0:
+            # the page budget: the prefix-cache allowance plus headroom
+            # for every slot's in-flight prompt pages (they are shared
+            # with — or become — cache entries, so this is an upper bound)
+            kv_pages = 1 + cache_pages + max_batch * self.pages_per_seq
+        self.pool = PagePool(kv_pages, self.page_size, self.page_nbytes)
+        self.prefix_cache = None
+        if cache_pages:
             from kubeflow_tpu.serving.prefix_cache import PrefixCache
 
-            self.prefix_cache = PrefixCache(prefix_cache_bytes)
+            self.prefix_cache = PrefixCache(self.pool, cache_pages)
         self.mesh = mesh  # tp>1: params arrive pre-sharded (serving/
-        # sharded.py); the KV cache shards heads over tp here and XLA
-        # propagates both through prefill/insert/decode
+        # sharded.py); the KV view shards heads over tp here and XLA
+        # propagates both through prefill/decode
         self.log = get_logger("serving.batcher")
 
-        # engine cache holds ONLY k/v buffers (all distinct, donate-safe);
-        # the shared per-slot index vector is attached inside the jitted
-        # steps — one aliased index buffer across layers would break
-        # donation ("donate the same buffer twice")
-        full = llama_mod.init_cache(cfg, max_batch, max_len=self.max_seq,
-                                    per_sequence=True)
-        self.cache = _kv_only(full)
+        # the RESIDENT decode view: [max_batch, max_seq] per layer,
+        # mutated in place by scan and verify dispatches.  Slot rows are
+        # (re)filled at admission; a freed slot's row is garbage nobody
+        # reads until it is refilled.  On CPU the view is held in f32 —
+        # a SPEED representation of the same bf16 values (XLA CPU pays a
+        # software convert per bf16 read; every bf16 is exact in f32 and
+        # ops/attention.py rounds softmax weights in the model dtype, so
+        # streams are bitwise identical either way — asserted by the
+        # warm==cold suites).  Accelerators keep the model dtype: there
+        # the convert is free and f32 would double the decode-KV HBM.
+        view_dtype = (jnp.float32 if jax.default_backend() == "cpu"
+                      else cfg.jnp_dtype)
+        self.view = {"layers": [
+            {"k": jnp.zeros((max_batch, self.max_seq, cfg.num_kv_heads,
+                             cfg.head_dim), view_dtype),
+             "v": jnp.zeros((max_batch, self.max_seq, cfg.num_kv_heads,
+                             cfg.head_dim), view_dtype)}
+            for _ in range(cfg.num_layers)]}
         if mesh is not None:
             from kubeflow_tpu.serving import sharded
 
-            self.cache = sharded.shard_cache(self.cache, mesh,
-                                             cfg.num_kv_heads)
+            self.view = sharded.shard_cache(self.view, mesh,
+                                            cfg.num_kv_heads)
         self.index = jnp.zeros((max_batch,), jnp.int32)
         self.last_token = jnp.zeros((max_batch,), jnp.int32)
         self.temps = jnp.zeros((max_batch,), jnp.float32)
@@ -218,14 +308,18 @@ class ContinuousBatcher:
         # chaos hook (chaos/injector.py stall_decode): the next decode
         # dispatch sleeps this long first — a wedged-TPU-tunnel fault
         self._chaos_stall_s = 0.0
+        # this engine's speculative tallies (the registry counters are
+        # process-global and sum every co-hosted model's engine)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rounds = 0
         self._thread: threading.Thread | None = None
-        self._prefill_cache: dict[int, object] = {}
         self._decode_cache: dict[tuple[int, bool], object] = {}
-        self._insert_fn = None
-        self._seed_cache: dict[int, object] = {}
+        self._verify_cache: dict[tuple[int, bool], object] = {}
         self._extend_cache: dict[tuple[int, bool], object] = {}
-        self._snap_cache: dict[int, object] = {}
-        self._zeros_fn = None
+        self._seed_cache: dict[int, object] = {}
+        self._slice_cache: dict[int, object] = {}
+        self._row_set_fn = None
 
     # -- public ----------------------------------------------------------------
     def submit(self, ids: list[int], max_new_tokens: int = 32,
@@ -254,6 +348,10 @@ class ContinuousBatcher:
         # outcome recorded on the request span before it closes
         req = GenRequest(list(ids), max_new_tokens, temperature, eos_id,
                          seed=0, top_k=top_k, top_p=top_p)
+        if self.spec_max:
+            from kubeflow_tpu.serving.speculative import SpeculationState
+
+            req._spec = SpeculationState(self.spec_max)
         self._start_trace(req, trace_ctx)
         try:
             self._enqueue(req, seed, deadline_s)
@@ -357,6 +455,8 @@ class ContinuousBatcher:
         requests queued for a slot, and the slot capacity.  Lock-held so
         the two counts are mutually consistent."""
         with self._work:
+            live_tokens = sum(len(s.ids) + len(s.generated)
+                              for s in self.slots if s is not None)
             out = {
                 "active": sum(1 for s in self.slots if s is not None),
                 "queued": len(self.queue),
@@ -366,6 +466,27 @@ class ContinuousBatcher:
                 out["max_queue"] = self.max_queue
             if self._draining:
                 out["draining"] = True
+        pool = self.pool.stats()
+        pool["live_tokens"] = live_tokens
+        cache_pages = (self.prefix_cache.stats()["pages"]
+                       if self.prefix_cache is not None else 0)
+        # pages held by nobody but an in-flight admission window should
+        # be zero whenever the engine is idle: every committed page is
+        # either cache-owned or already freed (the overload loadtest
+        # asserts this leak-free invariant after every storm)
+        pool["orphan_pages"] = pool["in_use"] - cache_pages
+        out["kv_pool"] = pool
+        if self.spec_max:
+            # instance-scoped (the registry counters aggregate every
+            # engine in the process — useless as THIS engine's signal)
+            proposed, accepted = self._spec_proposed, self._spec_accepted
+            out["speculative"] = {
+                "max_tokens": self.spec_max,
+                "proposed": proposed,
+                "accepted": accepted,
+                "accept_rate": (accepted / proposed) if proposed else 0.0,
+                "rounds": self._spec_rounds,
+            }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
         return out
@@ -430,7 +551,8 @@ class ContinuousBatcher:
 
     def restart(self) -> None:
         """Reopen a shut-down (or draining) engine; the batcher thread
-        respawns on the next submit()."""
+        respawns on the next submit().  The page pool and prefix cache
+        survive — a restarted engine keeps its warm prefixes."""
         with self._work:
             self._closed = False
             if self._draining:
@@ -438,103 +560,115 @@ class ContinuousBatcher:
                 DRAINING_GAUGE.inc(-1)
 
     # -- compiled pieces -------------------------------------------------------
-    def _prefill(self, bucket: int):
-        """One dispatch per admission: run the prompt, pick the logits at
-        the last REAL position, and sample the first token in the same
-        executable (separate index/sample dispatches cost tunnel RTTs)."""
-        if bucket not in self._prefill_cache:
-            from kubeflow_tpu.models import llama as llama_mod
-
-            cache0 = llama_mod.init_cache(self.cfg, 1, max_len=self.max_seq,
-                                          per_sequence=True)
-
-            @jax.jit
-            def fn(params, ids, last_pos, temp, key, top_k, top_p):
-                out = self.module.apply({"params": params}, ids,
-                                        cache=cache0)
-                logits = jax.lax.dynamic_index_in_dim(
-                    out["logits"][0], last_pos, axis=0, keepdims=False)
-                tok = _sample_rows(logits[None, :], temp[None], key[None, :],
-                                   top_k[None], top_p[None])
-                return tok[0], _kv_only(out["cache"])
-
-            self._prefill_cache[bucket] = fn
-        return self._prefill_cache[bucket]
-
     def _bucket_for(self, n: int) -> int:
         bucket = next((b for b in PREFILL_BUCKETS if b >= n), self.max_seq)
         return min(bucket, self.max_seq)
 
-    def _zeros(self):
-        """Jitted: a fresh batch-1 kv tree (chunked cold prefill seeds from
-        nothing)."""
-        if self._zeros_fn is None:
+    def _seed(self, n_pages: int):
+        """Jitted: materialize a batch-1 prefill scratch whose head is the
+        concatenation of ``n_pages`` cached pages — ONE dispatch sized by
+        the reused prefix, regardless of how many radix nodes share those
+        pages.  Callers pad the page list by repeating the tail page; the
+        overhang (and any page tail beyond the matched token count) is
+        garbage the suffix prefill overwrites before anything reads it."""
+        if n_pages not in self._seed_cache:
+            shape = (1, self.max_seq, self.cfg.num_kv_heads,
+                     self.cfg.head_dim)
+            dtype = self.cfg.jnp_dtype
+            span = min(n_pages * self.page_size, self.max_seq)
+
+            @jax.jit
+            def fn(pages):
+                out = {"layers": []}
+                for li in range(self.cfg.num_layers):
+                    k = jnp.concatenate([p["layers"][li]["k"]
+                                         for p in pages])[None, :span]
+                    v = jnp.concatenate([p["layers"][li]["v"]
+                                         for p in pages])[None, :span]
+                    out["layers"].append({
+                        "k": jax.lax.dynamic_update_slice(
+                            jnp.zeros(shape, dtype), k, (0, 0, 0, 0)),
+                        "v": jax.lax.dynamic_update_slice(
+                            jnp.zeros(shape, dtype), v, (0, 0, 0, 0)),
+                    })
+                return out
+
+            self._seed_cache[n_pages] = fn
+        return self._seed_cache[n_pages]
+
+    def _slice_pages(self, n_pages: int):
+        """Jitted: cut ``n_pages`` page arrays out of a batch-1 prefill
+        scratch starting at page index ``first`` — the commit that turns
+        freshly computed prompt KV into immutable pool pages.  Cost is
+        the size of the NEW pages only (a prefix hit never re-slices the
+        pages it shared)."""
+        if n_pages not in self._slice_cache:
+            ps = self.page_size
+
+            @jax.jit
+            def fn(scratch, first):
+                pages = []
+                for i in range(n_pages):
+                    tree = {"layers": []}
+                    for l in scratch["layers"]:
+                        start = (first + i) * ps
+                        tree["layers"].append({
+                            "k": jax.lax.dynamic_slice(
+                                l["k"][0], (start, 0, 0),
+                                (ps,) + l["k"].shape[2:]),
+                            "v": jax.lax.dynamic_slice(
+                                l["v"][0], (start, 0, 0),
+                                (ps,) + l["v"].shape[2:]),
+                        })
+                    pages.append(tree)
+                return pages
+
+            self._slice_cache[n_pages] = fn
+        return self._slice_cache[n_pages]
+
+    def _row_set(self):
+        """Jitted: install a finished prefill scratch as slot row ``b`` of
+        the resident decode view (bf16 -> f32 upcast is exact)."""
+        if self._row_set_fn is None:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def fn(view, scratch, b):
+                out = {"layers": []}
+                for vl, sl in zip(view["layers"], scratch["layers"]):
+                    out["layers"].append({
+                        "k": jax.lax.dynamic_update_slice(
+                            vl["k"], sl["k"].astype(vl["k"].dtype),
+                            (b, 0, 0, 0)),
+                        "v": jax.lax.dynamic_update_slice(
+                            vl["v"], sl["v"].astype(vl["v"].dtype),
+                            (b, 0, 0, 0)),
+                    })
+                return out
+
+            self._row_set_fn = fn
+        return self._row_set_fn
+
+    def _extend(self, chunk_len: int, sample: bool, cold: bool = False):
+        """Prefill ``chunk_len`` prompt tokens against a batch-1 scratch
+        whose first ``start`` positions already hold valid KV (cached
+        prefix pages and/or earlier chunks). ``cold=True`` (a cache-miss
+        prompt's FIRST chunk) materializes the zero scratch inside the
+        executable instead of taking one — separate zeros/prefill
+        dispatches cost tunnel RTTs on the TTFT path. ``sample=True``
+        (the final chunk) also picks the logits at the last real
+        position and samples the first token in the same executable — a
+        full-prefix hit is exactly one such dispatch, a short cold
+        prompt exactly one cold+sample dispatch."""
+        key = (chunk_len, sample, cold)
+        if key not in self._extend_cache:
             shape = (1, self.max_seq, self.cfg.num_kv_heads,
                      self.cfg.head_dim)
             dtype = self.cfg.jnp_dtype
             n_layers = self.cfg.num_layers
 
-            @jax.jit
-            def fn():
-                return {"layers": [{"k": jnp.zeros(shape, dtype),
-                                    "v": jnp.zeros(shape, dtype)}
-                                   for _ in range(n_layers)]}
-
-            self._zeros_fn = fn
-        return self._zeros_fn
-
-    def _seed(self, block_len: int):
-        """Jitted: materialize a batch-1 working cache with a cached prefix
-        block (snapped to ``block_len``) copied in at position 0 — ONE
-        dispatch regardless of how long the reused prefix is."""
-        if block_len not in self._seed_cache:
-            shape = (1, self.max_seq, self.cfg.num_kv_heads,
-                     self.cfg.head_dim)
-            dtype = self.cfg.jnp_dtype
-
-            @jax.jit
-            def fn(block):
-                out = {"layers": []}
-                for l in block["layers"]:
-                    out["layers"].append({
-                        "k": jax.lax.dynamic_update_slice(
-                            jnp.zeros(shape, dtype), l["k"], (0, 0, 0, 0)),
-                        "v": jax.lax.dynamic_update_slice(
-                            jnp.zeros(shape, dtype), l["v"], (0, 0, 0, 0)),
-                    })
-                return out
-
-            self._seed_cache[block_len] = fn
-        return self._seed_cache[block_len]
-
-    def _snap(self, bucket: int):
-        """Jitted: slice a batch-1 kv tree down to ``bucket`` positions —
-        the device-resident block a radix node owns."""
-        if bucket not in self._snap_cache:
-            @jax.jit
-            def fn(small):
-                return {"layers": [
-                    {"k": jax.lax.slice_in_dim(l["k"], 0, bucket, axis=1),
-                     "v": jax.lax.slice_in_dim(l["v"], 0, bucket, axis=1)}
-                    for l in small["layers"]]}
-
-            self._snap_cache[bucket] = fn
-        return self._snap_cache[bucket]
-
-    def _extend(self, chunk_len: int, sample: bool):
-        """Prefill CONTINUED from a non-zero cache index: run ``chunk_len``
-        prompt tokens against a batch-1 cache whose first ``start``
-        positions already hold valid KV (cached prefix and/or earlier
-        chunks). ``sample=True`` (the final chunk) also picks the logits
-        at the last real position and samples the first token in the same
-        executable — a full-prefix hit is exactly one such dispatch."""
-        key = (chunk_len, sample)
-        if key not in self._extend_cache:
-            @functools.partial(jax.jit, donate_argnums=(3,))
-            def fn(params, ids, start, small, last_pos, temp, key, top_k,
-                   top_p):
+            def run(params, ids, start, scratch, last_pos, temp, key,
+                    top_k, top_p):
                 full = {"layers": [dict(l, index=start)
-                                   for l in small["layers"]]}
+                                   for l in scratch["layers"]]}
                 out = self.module.apply({"params": params}, ids, cache=full)
                 new_kv = _kv_only(out["cache"])
                 if not sample:
@@ -545,43 +679,42 @@ class ContinuousBatcher:
                                    top_k[None], top_p[None])
                 return tok[0], new_kv
 
+            if cold:
+                @jax.jit
+                def fn(params, ids, last_pos, temp, key, top_k, top_p):
+                    scratch = {"layers": [
+                        {"k": jnp.zeros(shape, dtype),
+                         "v": jnp.zeros(shape, dtype)}
+                        for _ in range(n_layers)]}
+                    return run(params, ids, jnp.int32(0), scratch,
+                               last_pos, temp, key, top_k, top_p)
+            else:
+                @functools.partial(jax.jit, donate_argnums=(3,))
+                def fn(params, ids, start, scratch, last_pos, temp, key,
+                       top_k, top_p):
+                    return run(params, ids, start, scratch, last_pos,
+                               temp, key, top_k, top_p)
+
             self._extend_cache[key] = fn
         return self._extend_cache[key]
 
-    def _insert(self):
-        """Jitted: copy a batch-1 prefill cache into slot row ``b``.
-        The big cache is DONATED so XLA updates the row in place instead of
-        materializing a full copy per admission."""
-        if self._insert_fn is None:
-            @functools.partial(jax.jit, donate_argnums=(0,))
-            def fn(big, small, b):
-                out = {"layers": []}
-                for big_l, small_l in zip(big["layers"], small["layers"]):
-                    out["layers"].append({
-                        "k": jax.lax.dynamic_update_slice(
-                            big_l["k"], small_l["k"], (b, 0, 0, 0)),
-                        "v": jax.lax.dynamic_update_slice(
-                            big_l["v"], small_l["v"], (b, 0, 0, 0)),
-                    })
-                return out
-
-            self._insert_fn = fn
-        return self._insert_fn
-
     def _decode(self, chunk: int, filtered: bool):
-        """filtered=False compiles the sort-free sampling variant: the
+        """Chunked-scan decode over the resident view (donated: XLA
+        updates it in place across the scan).
+
+        filtered=False compiles the sort-free sampling variant: the
         per-token [B, V] sort/softmax/cumsum of top-k/top-p filtering is
         pure overhead when no active request asked for it, so the hot
         default path must not pay it."""
         key = (chunk, filtered)
         if key not in self._decode_cache:
             @functools.partial(jax.jit, donate_argnums=(2,))
-            def fn(params, token, cache_kv, index, temps, keys,
+            def fn(params, token, view, index, temps, keys,
                    top_ks, top_ps):
                 def body(carry, _):
-                    token, cache_kv, index, keys = carry
+                    token, view, index, keys = carry
                     full = {"layers": [dict(l, index=index)
-                                       for l in cache_kv["layers"]]}
+                                       for l in view["layers"]]}
                     out = self.module.apply({"params": params},
                                             token[:, None], cache=full)
                     # advance each ROW's own chain one step (chunk-size
@@ -595,12 +728,53 @@ class ContinuousBatcher:
                     return (nxt, _kv_only(out["cache"]), index + 1,
                             split[:, 1]), nxt
 
-                (token, cache_kv, index, keys), toks = jax.lax.scan(
-                    body, (token, cache_kv, index, keys), None, length=chunk)
-                return toks, cache_kv, keys  # toks: [chunk, B]
+                (token, view, index, keys), toks = jax.lax.scan(
+                    body, (token, view, index, keys), None, length=chunk)
+                return toks, view, keys  # toks: [chunk, B]
 
             self._decode_cache[key] = fn
         return self._decode_cache[key]
+
+    def _verify(self, s: int, filtered: bool):
+        """Speculative verify: ONE forward over ``s`` tokens per row
+        ([last_token, draft...]) against the resident view.  Position j's
+        logits see exactly the tokens sequential decode would have seen
+        once drafts 0..j-1 are accepted, so the sampled/argmax choice at
+        j is bitwise the sequential token — acceptance never changes the
+        output stream, only how many tokens this dispatch yields.
+        Returns per-position choices, the per-step PRNG chain states
+        (the host rewinds each row's chain to the tokens it actually
+        kept), and the updated view.  Rejected positions leave garbage
+        KV behind; the index rewind makes the next dispatch overwrite
+        every such position before any query attends to it."""
+        key = (s, filtered)
+        if key not in self._verify_cache:
+            @functools.partial(jax.jit, donate_argnums=(2,))
+            def fn(params, toks, view, index, temps, keys, top_ks, top_ps):
+                full = {"layers": [dict(l, index=index)
+                                   for l in view["layers"]]}
+                out = self.module.apply({"params": params}, toks,
+                                        cache=full)
+
+                def kstep(ks, _):
+                    sp = jax.vmap(lambda k_: jax.random.split(k_, 2))(ks)
+                    return sp[:, 1], (sp[:, 0], sp[:, 1])
+
+                _, (use_keys, next_keys) = jax.lax.scan(
+                    kstep, keys, None, length=s)
+                # choices[j] samples with the SAME key chain position a
+                # sequential decode step j would use — identity holds for
+                # seeded sampling, not just greedy
+                choices = jax.vmap(
+                    lambda lg, ks: _sample_rows(
+                        lg, temps, ks,
+                        top_ks if filtered else None,
+                        top_ps if filtered else None),
+                    in_axes=(1, 0))(out["logits"], use_keys)
+                return choices, next_keys, _kv_only(out["cache"])
+
+            self._verify_cache[key] = fn
+        return self._verify_cache[key]
 
     # -- the scheduling loop ---------------------------------------------------
     def _fail(self, req: GenRequest, outcome: str, msg: str, *,
@@ -645,7 +819,7 @@ class ContinuousBatcher:
     def _sweep_dead(self) -> None:
         """Evict cancelled and deadline-expired requests: queued ones
         before they burn a prefill dispatch, slotted ones mid-decode.
-        Clearing the slot IS the resource release — the row's KV is
+        Clearing the slot IS the resource release — the row's view KV is
         garbage the next admission overwrites, and prefix-cache pins are
         only held across prefill (released by ``_run_prefill``)."""
         now = time.perf_counter()
@@ -703,7 +877,8 @@ class ContinuousBatcher:
                 with self._work:
                     queue_empty = not self.queue
                 if any(self.slots):
-                    self._decode_chunk(queue_empty)
+                    if not (self.spec_max and self._spec_step()):
+                        self._decode_chunk(queue_empty)
         except Exception:
             self.log.error("batcher loop crashed", exc_info=True)
             with self._work:
@@ -737,33 +912,23 @@ class ContinuousBatcher:
             # the request's own key chain starts at its seed
             k_first, k_chain = jax.random.split(
                 jax.random.PRNGKey(req.seed))
-            tok, small_cache, fully_cached = self._run_prefill(req, k_first)
+            tok, scratch = self._run_prefill(req, k_first)
             if tok is None:
                 # bailed out mid-chunked-prefill (cancel/deadline/stop):
-                # the pin was released in _run_prefill's finally, nothing
-                # was inserted, the slot stays free
+                # the pin was released in _run_prefill's finally, any
+                # committed pages are cache-owned, the slot stays free
                 outcome = self._dead_outcome(req) or "cancelled"
                 self._fail(req, outcome, self._DEAD_MSG[outcome],
                            notify=True)
                 continue
-            if self.prefix_cache is not None and not fully_cached:
-                # cache the WHOLE prompt's KV (RadixAttention discipline:
-                # insert everything, let LRU sort out what traffic shares),
-                # snapped to a bucket so seeding compiles once per bucket.
-                # A full-prefix hit skips this: insert() would just drop
-                # the freshly snapped copy, so don't pay its dispatch.
-                snap = self._bucket_for(prompt_len)
-                self.prefix_cache.insert(
-                    req.ids, self._snap(snap)(small_cache))
             outcome = self._dead_outcome(req)
             if outcome is not None:
                 # died during its own prefill: the prompt KV was still
-                # worth caching above, but the request takes no slot
+                # worth caching, but the request takes no slot
                 self._fail(req, outcome, self._DEAD_MSG[outcome],
                            notify=True)
                 continue
-            self.cache = self._insert()(self.cache, small_cache,
-                                        jnp.int32(free))
+            self.view = self._row_set()(self.view, scratch, jnp.int32(free))
             tok_host = int(tok)
             req.first_token_at = time.perf_counter()
             TTFT_LAST.set(req.first_token_at - req.submitted_at)
@@ -787,67 +952,63 @@ class ContinuousBatcher:
                 continue
 
     def _run_prefill(self, req: GenRequest, k_first) -> tuple:
-        """Run the prompt and sample the first token; returns
-        ``(token, batch-1 kv tree, fully_cached)`` ready for slot
-        insertion (``fully_cached``: the radix tree already holds the
-        whole prompt, so re-inserting it would be a wasted dispatch), or
-        ``(None, None, False)`` when the request died (cancel, deadline,
+        """Run the prompt and sample the first token; returns ``(token,
+        batch-1 kv scratch)`` ready to install as the slot's view row, or
+        ``(None, None)`` when the request died (cancel, deadline,
         shutdown) between prefill chunks — the pin is still released.
 
         Three shapes, all token-identical (the per-position KV and the
         last-position logits are bitwise independent of how the prompt is
         split — asserted by tests/test_prefix_cache.py):
-        - longest-prefix HIT: copy the cached block in (one dispatch) and
-          prefill only the suffix, so TTFT no longer depends on how long
-          the shared prefix is;
-        - short cold prompt: the classic single full-prefill dispatch;
-        - long cold prompt (> prefill_chunk): chunked extend from zero, so
-          admission interleaves with in-flight decode instead of blocking
-          it for the whole prompt.
-        """
+        - longest-prefix HIT: concatenate the cached PAGES into the
+          scratch head (one dispatch sized by the prefix) and prefill
+          only the suffix, so TTFT no longer depends on how long the
+          shared prefix is;
+        - cold prompt: prefill from zero, in ``prefill_chunk`` chunks so
+          admission interleaves with in-flight decode instead of
+          blocking it for the whole prompt.
+
+        The prompt's NEW pages are committed to the pool and inserted
+        into the radix tree before the pin drops — a reference insert
+        (pages shared with the matched prefix are increfed, never
+        recomputed or copied), not the old per-node block copy."""
         prompt_len = len(req.ids)
-        node, usable, fully_cached = None, 0, False
+        node, usable = None, 0
         if self.prefix_cache is not None:
             node, matched = self.prefix_cache.match(req.ids, pin=True)
-            fully_cached = matched >= prompt_len
             # always leave >= 1 suffix token: the extend dispatch is where
-            # the first-token logits come from (blocks hold KV, not logits)
+            # the first-token logits come from (pages hold KV, not logits)
             usable = min(matched, prompt_len - 1)
             if node is not None and usable <= 0:
                 self.prefix_cache.release(node)
                 node, usable = None, 0
             (PREFIX_HITS if node is not None else PREFIX_MISSES).inc()
-        if self.prefix_cache is not None:
             req.span.set_attribute("prefix_cache",
                                    "hit" if node is not None else "miss")
             req.span.set_attribute("prefix_matched_tokens", usable)
         tracer = trace.get_tracer()
         try:
-            if node is None and prompt_len <= self.prefill_chunk:
-                bucket = self._bucket_for(prompt_len)
-                padded = req.ids + [0] * (bucket - prompt_len)
-                arr = jnp.asarray([padded], jnp.int32)
-                with tracer.start_span("engine.prefill", req.span,
-                                       tokens=prompt_len, start_pos=0,
-                                       bucket=bucket):
-                    tok, small = self._prefill(bucket)(
-                        self.params, arr, jnp.int32(prompt_len - 1),
-                        jnp.float32(req.temperature), k_first,
-                        jnp.int32(req.top_k), jnp.float32(req.top_p))
-                PREFILL_DISPATCHES.inc()
-                PREFILL_TOKENS.inc(prompt_len)
-                return tok, small, fully_cached
             if node is not None:
-                small = self._seed(node.block_len)(node.block)
+                n_seed = pages_for(usable, self.page_size)
+                bucket = next((b for b in SEED_BUCKETS if b >= n_seed),
+                              self.pages_per_seq)
+                page_ids = list(node.pages[:n_seed])
+                # pad by repeating the tail page: the overhang beyond
+                # ``usable`` is garbage the suffix prefill overwrites
+                page_ids += [page_ids[-1]] * (bucket - len(page_ids))
+                scratch = self._seed(bucket)(
+                    [self.pool.get(p) for p in page_ids])
             else:
-                small = self._zeros()()
+                # cold: the FIRST chunk's executable materializes its own
+                # zero scratch (one dispatch, not zeros + extend)
+                scratch = None
             pos = usable
             while True:
                 if self._dead_outcome(req) is not None:
                     # cancel/deadline/shutdown between prefill chunks: bail
                     # before the next dispatch; the finally below releases
                     # the pin, the caller skips seating the request
-                    return None, None, False
+                    return None, None
                 take = min(prompt_len - pos, self.prefill_chunk)
                 # pad the chunk up to a bucket, but never past max_seq:
                 # dynamic_update_slice CLAMPS an out-of-range start index,
@@ -861,21 +1022,73 @@ class ContinuousBatcher:
                 with tracer.start_span("engine.prefill", req.span,
                                        tokens=take, start_pos=pos,
                                        bucket=cb):
-                    out = self._extend(cb, last)(
-                        self.params, arr, jnp.int32(pos), small,
-                        jnp.int32(take - 1), jnp.float32(req.temperature),
-                        k_first, jnp.int32(req.top_k),
-                        jnp.float32(req.top_p))
+                    if scratch is None:
+                        out = self._extend(cb, last, cold=True)(
+                            self.params, arr, jnp.int32(take - 1),
+                            jnp.float32(req.temperature), k_first,
+                            jnp.int32(req.top_k), jnp.float32(req.top_p))
+                    else:
+                        out = self._extend(cb, last)(
+                            self.params, arr, jnp.int32(pos), scratch,
+                            jnp.int32(take - 1),
+                            jnp.float32(req.temperature), k_first,
+                            jnp.int32(req.top_k), jnp.float32(req.top_p))
                 PREFILL_DISPATCHES.inc()
                 PREFILL_TOKENS.inc(take)
                 pos += take
                 if last:
-                    tok, small = out
-                    return tok, small, fully_cached
-                small = out
+                    tok, scratch = out
+                    break
+                scratch = out
+            fully_cached = node is not None and usable >= prompt_len - 1
+            if self.prefix_cache is not None and not fully_cached:
+                # cache the WHOLE prompt (RadixAttention discipline:
+                # insert everything, let LRU sort out what traffic
+                # shares): shared pages by reference, only the suffix
+                # pages are newly committed.  Inside the pin window so
+                # the matched node's pages cannot be evicted from under
+                # the insert.
+                self._commit_and_insert(req.ids, usable, node, scratch)
+            return tok, scratch
         finally:
             if node is not None:
                 self.prefix_cache.release(node)
+
+    def _commit_and_insert(self, ids: list[int], usable: int, node,
+                           scratch) -> None:
+        """Commit the prompt's NEW pages (beyond the shared prefix) from
+        the prefill scratch into the pool and insert the whole prompt
+        into the radix tree.  Pool pressure evicts LRU cache entries; if
+        the budget still cannot host the pages the prompt simply is not
+        cached — admission never blocks on cache capacity."""
+        prompt_len = len(ids)
+        # only pages that lie FULLY inside the scratch are committable:
+        # when page_size does not divide max_seq, a tail page's slice
+        # start would be clamped by dynamic_slice and the page would hold
+        # KV shifted from earlier positions — silently wrong on a later
+        # hit.  The uncovered prompt tail simply is not cached.
+        max_pages = self.max_seq // self.page_size
+        needed = min(pages_for(prompt_len, self.page_size), max_pages)
+        ids = ids[:min(prompt_len, needed * self.page_size)]
+        shared = usable // self.page_size if node is not None else 0
+        n_new = needed - shared
+        if n_new <= 0 or not ids:
+            return
+        fresh = self.pool.alloc(n_new)
+        while fresh is None:
+            if (self.prefix_cache is None
+                    or not self.prefix_cache.evict_lru()):
+                return
+            fresh = self.pool.alloc(n_new)
+        bucket = next((b for b in SEED_BUCKETS if b >= n_new),
+                      self.pages_per_seq)
+        trees = self._slice_pages(bucket)(scratch, jnp.int32(shared))
+        for pid, tree in zip(fresh, trees):
+            self.pool.put(pid, tree)
+        shared_ids = list(node.pages[:shared]) if shared else []
+        self.prefix_cache.insert(ids, shared_ids + fresh)
+        # the tree holds its own references now; drop the alloc's
+        self.pool.decref(fresh)
 
     def _decode_chunk(self, queue_empty: bool) -> None:
         remaining = [s.max_new_tokens - len(s.generated)
@@ -906,6 +1119,22 @@ class ContinuousBatcher:
             else:
                 chunk = next((c for c in reversed(DECODE_CHUNKS)
                               if c <= mn), DECODE_CHUNKS[0])
+        if self.spec_max:
+            # speculation needs dispatch boundaries to re-probe at — an
+            # unbounded chunk would swallow a whole generation before the
+            # drafter ever sees the stream turn repetitive.  A slot whose
+            # drafts have been LANDING gets the tight cadence; otherwise
+            # a moderate cap (~2% dispatch overhead) keeps the re-probe
+            # alive.  The 0.65 bar sits strictly above note_skip's
+            # optimistic reset (0.6), so only observed acceptance — never
+            # mere re-probe optimism — pays the tight-cadence overhead.
+            hot = any(s is not None and s._spec is not None
+                      and s._spec.accept_ewma > 0.65 for s in self.slots)
+            solo = len(remaining) == 1
+            # solo streams also get the tight cadence cold: a γ=2 probe
+            # on a lone row pays for itself in expectation, and catching
+            # a repetitive stretch early is worth ~3% dispatch overhead
+            chunk = min(chunk, 32 if hot or solo else 64)
         stall = self._chaos_stall_s
         if stall:
             # injected decode-stall fault (chaos): the dispatch wedges once
@@ -914,8 +1143,8 @@ class ContinuousBatcher:
         t0 = time.perf_counter()
         filtered = any(s is not None and (s.top_k or s.top_p)
                        for s in self.slots)
-        toks, self.cache, self.keys = self._decode(chunk, filtered)(
-            self.params, self.last_token, self.cache, self.index,
+        toks, self.view, self.keys = self._decode(chunk, filtered)(
+            self.params, self.last_token, self.view, self.index,
             self.temps, self.keys, self.top_ks, self.top_ps)
         host_toks = jax.device_get(toks)  # [chunk, B] — the sync point
         dt = time.perf_counter() - t0
@@ -924,6 +1153,11 @@ class ContinuousBatcher:
         taken = 0
         for i in active_before:
             req = self.slots[i]
+            if req._spec is not None:
+                # the drafter was passed over for a whole chunk; let it
+                # re-probe soon (weighted by how much stream went by, so
+                # a 64-token chunk re-opens probing at its boundary)
+                req._spec.note_skip(weight=chunk // 32)
             want = req.max_new_tokens - len(req.generated)
             col = [int(host_toks[step][i]) for step in range(chunk)]
             for tok in col[:want]:
@@ -931,10 +1165,118 @@ class ContinuousBatcher:
                 taken += 1
                 if req.eos_id is not None and tok == req.eos_id:
                     break
+        # counters BEFORE completion events: a caller woken by result()
+        # must observe the tokens that completed it already counted
+        TOKENS_TOTAL.inc(taken)
+        DECODE_TOKENS.inc(taken)
+        DECODE_SECONDS.inc(dt)
+        if dt > 0:
+            TOKS_PER_SEC.set(taken / dt)
+        for i in active_before:
             self._finish_if_done(i)
-        # frozen/finished rows advanced inside the chunk; restore truth.
-        # next write slot = prompt + generated - 1 (generated[-1] is the
-        # NEXT decode input; its kv is not in the cache yet)
+        self._restore_host_truth()
+
+    def _spec_step(self) -> bool:
+        """One speculative decode round, if the cost model approves:
+        host-draft each active slot, verify every draft in ONE batched
+        forward, keep each row's accepted prefix plus the model's own
+        correction token.  Returns False (and runs nothing) when the
+        expected accepted tokens don't beat the chunked scan — the
+        caller falls back to a plain chunk, so adversarial streams never
+        pay for rejected drafts."""
+        active = [(i, s) for i, s in enumerate(self.slots) if s]
+        if not active:
+            return False
+        allowed = self.max_seq - 1 - max(
+            len(s.ids) + len(s.generated) - 1 for _, s in active)
+        drafts: dict[int, list[int]] = {}
+        desired = 0
+        for i, s in active:
+            want = s.max_new_tokens - len(s.generated)
+            limit = min(s._spec.next_len, want - 1, allowed)
+            d = self.draft_fn(s.ids + s.generated, limit) if limit > 0 \
+                else []
+            drafts[i] = d = list(d[:max(limit, 0)])
+            desired = max(desired, len(d))
+        if desired <= 0:
+            return False
+        gamma = min(next(b for b in self._spec_buckets if b >= desired),
+                    allowed)
+        # the round must beat the scan step it displaces: expected
+        # accepted+corrected tokens vs the round's cost in step units
+        expected = sum(1.0 + s._spec.accept_ewma * len(drafts[i])
+                       for i, s in active)
+        if expected < len(active) * (SPEC_COST_BASE
+                                     + SPEC_COST_SLOPE * gamma):
+            # no note_skip here: the scan chunk this decline falls back
+            # to records the skip (counting both would halve the backoff)
+            return False
+        s_len = gamma + 1
+        toks = []
+        for i in range(self.max_batch):
+            req = self.slots[i]
+            t0_tok = int(req.generated[-1]) if req else 0
+            d = drafts.get(i, [])[:gamma]
+            toks.append([t0_tok] + d + [0] * (gamma - len(d)))
+        stall = self._chaos_stall_s
+        if stall:
+            self._chaos_stall_s = 0.0
+            time.sleep(stall)
+        t0 = time.perf_counter()
+        filtered = any(s is not None and (s.top_k or s.top_p)
+                       for s in self.slots)
+        choices, next_keys, self.view = self._verify(s_len, filtered)(
+            self.params, jnp.asarray(toks, jnp.int32), self.view,
+            self.index, self.temps, self.keys, self.top_ks, self.top_ps)
+        choices_h = jax.device_get(choices)    # [s, B]
+        keys_h = jax.device_get(next_keys)     # [s, B, 2]
+        dt = time.perf_counter() - t0
+        SPEC_ROUNDS.inc()
+        self._spec_rounds += 1
+
+        taken_total = 0
+        new_keys = [keys_h[0][i] for i in range(self.max_batch)]
+        for i, req in active:
+            draft = drafts.get(i, [])[:gamma]
+            col = [int(choices_h[j][i]) for j in range(s_len)]
+            accepted = 0
+            while accepted < len(draft) and draft[accepted] == col[accepted]:
+                accepted += 1
+            outputs = col[:accepted + 1]
+            want = req.max_new_tokens - len(req.generated)
+            taken = 0
+            for tok in outputs[:want]:
+                req.generated.append(tok)
+                taken += 1
+                if req.eos_id is not None and tok == req.eos_id:
+                    break
+            taken_total += taken
+            if draft:
+                SPEC_PROPOSED.inc(len(draft))
+                SPEC_ACCEPTED.inc(accepted)
+                self._spec_proposed += len(draft)
+                self._spec_accepted += accepted
+            req._spec.observe(len(draft), accepted)
+            # rewind this row's PRNG chain to the tokens it actually kept:
+            # chain state after n samples is next_keys[n-1] (taken >= 1)
+            new_keys[i] = keys_h[taken - 1][i]
+        # counters BEFORE completion events (see _decode_chunk)
+        TOKENS_TOTAL.inc(taken_total)
+        DECODE_TOKENS.inc(taken_total)
+        DECODE_SECONDS.inc(dt)
+        if dt > 0:
+            TOKS_PER_SEC.set(taken_total / dt)
+        for i, _ in active:
+            self._finish_if_done(i)
+        self.keys = jnp.asarray(new_keys, jnp.uint32)
+        self._restore_host_truth()
+        return True
+
+    def _restore_host_truth(self) -> None:
+        """Rows advanced inside the dispatch (overshoot, rejected drafts,
+        finished slots); restore index and last_token from host truth.
+        next write slot = prompt + generated - 1 (generated[-1] is the
+        NEXT decode input; its kv is not in the cache yet)."""
         new_index = []
         for i in range(self.max_batch):
             req = self.slots[i]
@@ -946,9 +1288,6 @@ class ContinuousBatcher:
         self.last_token = jnp.asarray(
             [(self.slots[i].generated[-1] if self.slots[i] else 0)
              for i in range(self.max_batch)], jnp.int32)
-        TOKENS_TOTAL.inc(taken)
-        if dt > 0:
-            TOKS_PER_SEC.set(taken / dt)
 
     def _finish_if_done(self, slot: int) -> bool:
         req = self.slots[slot] if slot < len(self.slots) else None

@@ -1,0 +1,150 @@
+"""Fixed-size KV page pool: refcounted ids + the device page store.
+
+vLLM's PagedAttention insight (Kwon et al., SOSP 2023) applied to this
+engine: prompt KV is cut into fixed-size PAGES (``page_size`` token
+positions, all layers) and every consumer — the prefix cache's radix
+tree and each admission's seed — holds page IDS into one shared pool
+instead of owning private prefix copies.  The pool is the single source
+of KV-storage truth:
+
+- a page is a refcounted unit: inserting a prefix into the radix tree
+  increfs the pages (zero copies — a longer cached prefix shares every
+  page of the shorter one it extends), and a page only frees when the
+  last holder drops it — eviction frees pages, not whole prefixes;
+- pages are IMMUTABLE once committed (decode state lives in the
+  engine's resident view), so sharing is literal buffer sharing with no
+  write-ordering hazards;
+- the allocator is pure host bookkeeping (no dispatch): alloc/free cost
+  is a list append/pop, so admission-time page math never touches the
+  tunnel.  ``num_pages`` is the HBM budget — an alloc past it fails and
+  the caller evicts LRU cache entries instead.
+
+Thread-safety: the engine's batcher thread is the only allocator writer,
+but stats() is read by scrapers — a lock keeps the counters consistent.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+PAGES_CAPACITY = REGISTRY.gauge(
+    "serving_kv_pages_capacity",
+    "allocatable KV pages in the device pool (excludes the null page)")
+PAGES_FREE = REGISTRY.gauge(
+    "serving_kv_pages_free",
+    "KV pages currently on the free list")
+
+NULL_PAGE = 0
+
+
+class PagePool:
+    """Refcounted allocator over ``num_pages`` page ids plus the device
+    STORE mapping each live id to its per-layer k/v arrays.
+
+    Pages are WRITE-ONCE: the engine commits a page's arrays exactly once
+    (right after prefill computes them) and every later consumer — a
+    radix-tree node, a prefix-hit seed — reads the same immutable buffers.
+    Sharing is therefore literal object sharing; "copy-on-write" never
+    arises because nothing ever writes (decode state lives in the
+    engine's resident view, not in pages).  Dropping the last reference
+    deletes the store entry, which frees the device buffers."""
+
+    def __init__(self, num_pages: int, page_size: int, page_nbytes: int = 0):
+        if num_pages < 2:
+            raise ValueError("pool needs >= 2 pages (one is the null page)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.page_nbytes = int(page_nbytes)  # all-layer bytes, for stats
+        self._lock = threading.Lock()
+        # page 0 is the null page: permanently "allocated", never handed
+        # out (keeps the device-side page-TABLE convention of
+        # models/llama.py, where id 0 pads unallocated table slots)
+        self._refs = [0] * self.num_pages
+        self._refs[NULL_PAGE] = 1
+        self._free = list(range(self.num_pages - 1, NULL_PAGE, -1))
+        self._store: dict[int, object] = {}   # live id -> per-layer arrays
+        PAGES_CAPACITY.set(float(self.num_pages - 1))
+        PAGES_FREE.set(float(len(self._free)))
+
+    # -- device store ----------------------------------------------------------
+    def put(self, page: int, tree) -> None:
+        """Attach the (immutable) device arrays for an allocated page."""
+        with self._lock:
+            if self._refs[page] <= 0:
+                raise ValueError(f"put on free page {page}")
+            self._store[page] = tree
+
+    def get(self, page: int):
+        with self._lock:
+            return self._store[page]
+
+    # -- allocation ------------------------------------------------------------
+    def alloc(self, n: int) -> list[int] | None:
+        """Take ``n`` pages (each born with refcount 1); None when the
+        free list cannot cover the request (caller evicts or waits —
+        partial allocations are never handed out)."""
+        if n <= 0:
+            return []
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._refs[p] = 1
+            PAGES_FREE.set(float(len(self._free)))
+            return pages
+
+    def incref(self, pages: list[int]) -> None:
+        """Add a holder to already-allocated pages (prefix sharing)."""
+        with self._lock:
+            for p in pages:
+                if p == NULL_PAGE:
+                    continue
+                if self._refs[p] <= 0:
+                    raise ValueError(f"incref of free page {p}")
+                self._refs[p] += 1
+
+    def decref(self, pages: list[int]) -> None:
+        """Drop a holder; a page returns to the free list at refcount 0."""
+        with self._lock:
+            for p in pages:
+                if p == NULL_PAGE:
+                    continue
+                if self._refs[p] <= 0:
+                    raise ValueError(f"decref of free page {p}")
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    self._free.append(p)
+                    # dropping the store entry releases the device buffers
+                    self._store.pop(p, None)
+            PAGES_FREE.set(float(len(self._free)))
+
+    # -- introspection ---------------------------------------------------------
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs[page]
+
+    def stats(self) -> dict:
+        with self._lock:
+            free = len(self._free)
+            return {
+                "pages": self.num_pages - 1,
+                "free": free,
+                "in_use": self.num_pages - 1 - free,
+                "page_size": self.page_size,
+                "page_nbytes": self.page_nbytes,
+            }
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed to cover ``tokens`` positions."""
+    return max(0, -(-int(tokens) // int(page_size)))

@@ -38,7 +38,8 @@ class GenerativePredictor:
                  quantize: bool = False, fast_init: bool = False,
                  tp: int = 1, ep: int = 1,
                  prefix_cache_mb: float = 0.0, prefill_chunk: int = 512,
-                 max_queue: int = 0):
+                 max_queue: int = 0, kv_page_size: int = 16,
+                 speculative_tokens: int = 0):
         from kubeflow_tpu.models import registry
 
         self.log = get_logger("predictor", model=model_name, size=size)
@@ -121,10 +122,14 @@ class GenerativePredictor:
                                                self.mesh)
         from kubeflow_tpu.serving.engine import ContinuousBatcher
 
-        # prefix_cache_mb > 0 opts into radix-tree KV prefix reuse: shared
-        # system prompts prefill once, later admissions copy the cached
-        # block and prefill only their suffix (HBM budget in MB because
-        # annotations/CLI carry human-sized numbers)
+        # prefix_cache_mb > 0 opts into radix-tree KV prefix reuse over
+        # shared refcounted pages: shared system prompts prefill once and
+        # later admissions seed from the cached pages, prefilling only
+        # their suffix (HBM budget in MB because annotations/CLI carry
+        # human-sized numbers); kv_page_size sets the sharing granularity
+        # speculative_tokens > 0 enables n-gram speculative decoding
+        # (token-identical; a cost model falls back to plain decode on
+        # draft-hostile streams)
         # max_queue > 0 bounds admission: over-limit submits raise
         # QueueFull, which the HTTP layer turns into 429 + Retry-After
         # (load shedding beats queue collapse under sustained overload)
@@ -135,7 +140,10 @@ class GenerativePredictor:
                                         prefix_cache_bytes=int(
                                             prefix_cache_mb * (1 << 20)),
                                         prefill_chunk=prefill_chunk,
-                                        max_queue=max_queue)
+                                        max_queue=max_queue,
+                                        page_size=kv_page_size,
+                                        speculative_tokens=(
+                                            speculative_tokens))
         self.log.info("predictor ready",
                       params=sum(x.size for x in
                                  jax.tree_util.tree_leaves(self.params)))
@@ -434,6 +442,14 @@ def main(argv=None) -> int:
                         help="bounded admission: submits past this many "
                              "queued requests are shed with 429 + "
                              "Retry-After (0 = unbounded)")
+    parser.add_argument("--kv-page-size", type=int, default=16,
+                        help="tokens per KV page: the sharing granularity "
+                             "of the paged block pool the prefix cache "
+                             "and admissions draw from")
+    parser.add_argument("--speculative-tokens", type=int, default=0,
+                        help="max draft tokens per speculative-decoding "
+                             "verify round (0 disables; output is token-"
+                             "identical either way)")
     args = parser.parse_args(argv)
 
     specs = [m for m in (args.models or []) if m] or ["llama"]
@@ -463,7 +479,11 @@ def main(argv=None) -> int:
                                                args.prefix_cache_mb)),
                 prefill_chunk=int(opts.get("prefill_chunk",
                                            args.prefill_chunk)),
-                max_queue=int(opts.get("max_queue", args.max_queue)))
+                max_queue=int(opts.get("max_queue", args.max_queue)),
+                kv_page_size=int(opts.get("kv_page_size",
+                                          args.kv_page_size)),
+                speculative_tokens=int(opts.get("speculative_tokens",
+                                                args.speculative_tokens)))
         else:
             predictors[name] = ClassifierPredictor(name,
                                                    checkpoint_dir=ckpt)

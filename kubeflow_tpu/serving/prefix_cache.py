@@ -1,25 +1,29 @@
-"""Host-managed radix tree over token prefixes with device-resident KV blocks.
+"""Host-managed radix tree over token prefixes, backed by shared KV pages.
 
-RadixAttention-style prefix reuse (SGLang, Zheng et al. 2024; block-level
-KV management after vLLM's PagedAttention, Kwon et al. SOSP'23) adapted to
-this engine's network-attached-TPU constraints:
+RadixAttention-style prefix reuse (SGLang, Zheng et al. 2024) unified with
+a vLLM-style paged pool (Kwon et al. SOSP'23): the tree no longer owns
+private device blocks — every node holds a list of PAGE IDS into the one
+pool the decode slots also allocate from (serving/page_pool.py):
 
-- the TREE lives on the host (pure Python, no dispatch to walk it); only
-  the KV blocks are device arrays, so a longest-prefix match costs zero
-  tunnel RTTs;
-- every node's block covers the FULL prefix from the root (positions
-  ``[0, length)``), snapped up to a ``PREFILL_BUCKETS`` length so the
-  engine's seed/extend executables compile once per bucket, never per
-  prompt. Any matched prefix of a block is valid — k/v at position p
-  depends only on tokens ``<= p`` — so a partial match into an edge still
-  reuses the covered positions;
-- eviction is LRU under an explicit HBM byte budget, and a node PINNED by
-  an in-flight admission (``match(pin=True)`` .. ``release()``) is never
-  evicted: the engine holds the pin across its seed/extend dispatches so
-  the budget sweep cannot free a block a queued computation reads.
+- the TREE lives on the host (pure Python, no dispatch to walk it); a
+  longest-prefix match costs zero tunnel RTTs;
+- a node's pages cover the FULL prefix from the root (positions
+  ``[0, length)``, the tail page partially valid).  Insertion does not
+  copy: the node increfs the admitting slot's own prompt pages, and a
+  later hit increfs them again into the new slot's page table — prefix
+  hits share pages BY REFERENCE, the only device work on a hit is a
+  single copy-on-write of the boundary page when the match is not
+  page-aligned;
+- eviction is LRU under an explicit PAGE budget and drops node
+  REFERENCES: a page whose prefix is still live in some slot (or a
+  longer cached prefix) survives until its last holder releases it —
+  eviction frees pages, not whole prefixes;
+- a node PINNED by an in-flight admission (``match(pin=True)`` ..
+  ``release()``) is never evicted, so the budget sweep cannot free pages
+  an admission is still wiring into its table.
 
 The engine (serving/engine.py) owns all device work; this module only
-decides WHAT to reuse and WHEN to free.
+decides WHAT to share and WHEN to drop references.
 """
 
 from __future__ import annotations
@@ -27,60 +31,59 @@ from __future__ import annotations
 import threading
 import time
 
-import jax
-
+from kubeflow_tpu.serving.page_pool import PagePool, pages_for
 from kubeflow_tpu.utils.metrics import REGISTRY
 
 EVICTIONS_TOTAL = REGISTRY.counter(
     "serving_prefix_cache_evictions_total",
-    "prefix-cache KV blocks evicted under the HBM budget")
+    "prefix-cache nodes evicted under the page budget")
+CACHED_PAGES = REGISTRY.gauge(
+    "serving_prefix_cache_pages",
+    "distinct KV pages referenced by cached prefixes")
 CACHED_BYTES = REGISTRY.gauge(
     "serving_prefix_cache_bytes",
-    "device bytes held by cached prefix KV blocks")
+    "device bytes covered by cached prefix pages")
 CACHED_NODES = REGISTRY.gauge(
     "serving_prefix_cache_nodes",
-    "radix-tree nodes currently holding a KV block")
-
-
-def block_nbytes(block) -> int:
-    return sum(x.nbytes for x in jax.tree_util.tree_leaves(block))
+    "radix-tree nodes currently holding cached pages")
 
 
 class _Node:
-    __slots__ = ("edge", "length", "parent", "children", "block",
-                 "block_len", "refs", "last_used")
+    __slots__ = ("edge", "length", "parent", "children", "pages",
+                 "refs", "last_used")
 
     def __init__(self, edge: tuple, parent: "_Node | None"):
         self.edge = edge                      # tokens on the edge from parent
         self.parent = parent
         self.length = (parent.length if parent else 0) + len(edge)
         self.children: dict[int, _Node] = {}  # first edge token -> child
-        self.block = None                     # per-layer {k, v} device arrays
-        self.block_len = 0                    # snapped array length (bytes src)
+        self.pages: list[int] | None = None   # page ids covering [0, length)
         self.refs = 0                         # in-flight admissions pinning us
         self.last_used = 0.0
 
 
 class PrefixCache:
-    """Radix tree of token prefixes; nodes own snapped KV blocks, LRU-evicted
-    under ``max_bytes``. Thread-safe (the batcher thread mutates, scrapers
-    read stats)."""
+    """Radix tree of token prefixes; nodes hold refcounted page ids from
+    the shared pool, LRU-evicted under ``max_pages`` distinct pages.
+    Thread-safe (the batcher thread mutates, scrapers read stats)."""
 
-    def __init__(self, max_bytes: int):
-        if max_bytes <= 0:
-            raise ValueError("prefix cache needs a positive byte budget")
-        self.max_bytes = int(max_bytes)
+    def __init__(self, pool: PagePool, max_pages: int):
+        if max_pages <= 0:
+            raise ValueError("prefix cache needs a positive page budget")
+        self.pool = pool
+        self.page_size = pool.page_size
+        self.max_pages = int(max_pages)
         self.root = _Node((), None)
-        self.bytes = 0
-        self._blocked: set[_Node] = set()   # nodes currently holding a block
+        self._noded: set[_Node] = set()     # nodes currently holding pages
+        self._page_holders: dict[int, int] = {}  # page id -> #nodes holding
         self._pins = 0                      # outstanding match(pin=True) holds
         self._lock = threading.Lock()
 
     # -- matching --------------------------------------------------------------
     def match(self, tokens, *, pin: bool = False):
-        """Longest-prefix match: returns ``(node, usable)`` where
-        ``node.block[:, :usable]`` holds valid KV for ``tokens[:usable]``,
-        or ``(None, 0)``. With ``pin=True`` the node is refcounted before
+        """Longest-prefix match: returns ``(node, usable)`` where the
+        node's pages hold valid KV for ``tokens[:usable]``, or
+        ``(None, 0)``. With ``pin=True`` the node is refcounted before
         the lock drops — callers MUST ``release()`` it."""
         with self._lock:
             node, matched = self._walk(tuple(tokens))
@@ -88,11 +91,11 @@ class PrefixCache:
                 return None, 0
             # the stop node (or any descendant: their paths extend ours)
             # covers the whole match; an ancestor covers a shorter prefix
-            holder = self._find_block_at_or_below(node)
+            holder = self._find_pages_at_or_below(node)
             usable = matched
             if holder is None:
                 holder = node.parent if node is not self.root else None
-                while holder is not None and holder.block is None:
+                while holder is not None and holder.pages is None:
                     holder = holder.parent
                 if holder is None:
                     return None, 0
@@ -123,11 +126,11 @@ class PrefixCache:
             node = child
         return node, depth
 
-    def _find_block_at_or_below(self, node: _Node):
+    def _find_pages_at_or_below(self, node: _Node):
         stack = [node]
         while stack:
             n = stack.pop()
-            if n.block is not None:
+            if n.pages is not None:
                 return n
             stack.extend(n.children.values())
         return None
@@ -139,16 +142,23 @@ class PrefixCache:
                 self._pins -= 1
 
     # -- insertion / eviction --------------------------------------------------
-    def insert(self, tokens, block) -> bool:
-        """Attach ``block`` (snapped per-layer k/v arrays covering
-        ``tokens``) at the node for ``tokens``, splitting edges as needed;
-        evicts LRU unpinned blocks until the budget holds. Returns False
-        when the block alone exceeds the budget (not stored)."""
+    def insert(self, tokens, pages: list[int]) -> bool:
+        """Attach ``pages`` (covering ``tokens``, tail page partial) at
+        the node for ``tokens``, splitting edges as needed.  The pages
+        are INCREF'd, not copied — the caller (an admitting slot) keeps
+        its own references.  Evicts LRU unpinned nodes until the distinct
+        -page budget holds.  Returns False when the prefix alone exceeds
+        the budget (not stored)."""
         tokens = tuple(tokens)
         if not tokens:
             return False
-        nbytes = block_nbytes(block)
-        if nbytes > self.max_bytes:
+        need = pages_for(len(tokens), self.page_size)
+        if need > len(pages):
+            raise ValueError(
+                f"{need} pages required to cover {len(tokens)} tokens, "
+                f"got {len(pages)}")
+        pages = list(pages[:need])
+        if need > self.max_pages:
             return False
         with self._lock:
             node, matched = self._walk(tokens)
@@ -158,22 +168,22 @@ class PrefixCache:
                 leaf = _Node(tokens[matched:], node)
                 node.children[tokens[matched]] = leaf
                 node = leaf
-            if node.block is not None:      # already cached: refresh LRU
+            if node.pages is not None:      # already cached: refresh LRU
                 node.last_used = time.monotonic()
                 return True
-            node.block = block
-            node.block_len = max(x.shape[1] for x in
-                                 jax.tree_util.tree_leaves(block))
+            self.pool.incref(pages)
+            node.pages = pages
             node.last_used = time.monotonic()
-            self._blocked.add(node)
-            self.bytes += nbytes
+            self._noded.add(node)
+            for p in pages:
+                self._page_holders[p] = self._page_holders.get(p, 0) + 1
             self._evict_to_budget(keep=node)
             self._publish()
             return True
 
     def _split(self, node: _Node, at_length: int) -> _Node:
         """Split ``node``'s edge so a node boundary lands at path length
-        ``at_length``; the new middle node holds no block."""
+        ``at_length``; the new middle node holds no pages."""
         cut = at_length - node.parent.length
         mid = _Node(node.edge[:cut], node.parent)
         node.parent.children[node.edge[0]] = mid
@@ -183,8 +193,8 @@ class PrefixCache:
         return mid
 
     def _evict_to_budget(self, keep: _Node | None = None) -> None:
-        while self.bytes > self.max_bytes:
-            victims = [n for n in self._blocked
+        while len(self._page_holders) > self.max_pages:
+            victims = [n for n in self._noded
                        if n.refs == 0 and n is not keep]
             if not victims:
                 return  # everything live is pinned; budget temporarily over
@@ -193,27 +203,55 @@ class PrefixCache:
             EVICTIONS_TOTAL.inc()
 
     def _drop(self, node: _Node) -> None:
-        self.bytes -= block_nbytes(node.block)
-        node.block = None
-        node.block_len = 0
-        self._blocked.discard(node)
-        # prune blockless leaves so the tree doesn't accumulate dead paths
-        while (node is not self.root and node.block is None
+        pages, node.pages = node.pages, None
+        for p in pages:
+            left = self._page_holders.get(p, 0) - 1
+            if left <= 0:
+                self._page_holders.pop(p, None)
+            else:
+                self._page_holders[p] = left
+        self.pool.decref(pages)
+        self._noded.discard(node)
+        # prune pageless leaves so the tree doesn't accumulate dead paths
+        while (node is not self.root and node.pages is None
                and not node.children and node.refs == 0):
             parent = node.parent
             del parent.children[node.edge[0]]
             node = parent
+
+    def evict_lru(self) -> bool:
+        """Drop the least-recently-used unpinned node (pool-pressure path:
+        the engine calls this when slot admission cannot allocate).
+        Returns False when nothing is evictable."""
+        with self._lock:
+            victims = [n for n in self._noded if n.refs == 0]
+            if not victims:
+                return False
+            self._drop(min(victims, key=lambda n: n.last_used))
+            EVICTIONS_TOTAL.inc()
+            self._publish()
+            return True
 
     # -- introspection ---------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
             # "pinned" must be zero whenever no admission is mid-prefill:
             # a nonzero steady-state value is a leaked refcount that makes
-            # its block unevictable forever (the overload loadtest asserts
+            # its pages unevictable forever (the overload loadtest asserts
             # this invariant after every storm)
-            return {"bytes": self.bytes, "max_bytes": self.max_bytes,
-                    "blocks": len(self._blocked), "pinned": self._pins}
+            return {"pages": len(self._page_holders),
+                    "max_pages": self.max_pages,
+                    "bytes": len(self._page_holders) * self.pool.page_nbytes,
+                    "max_bytes": self.max_pages * self.pool.page_nbytes,
+                    "nodes": len(self._noded), "pinned": self._pins,
+                    # token positions the tree could serve vs the page
+                    # positions actually held: > 1.0 means page sharing
+                    # is deduplicating overlapping prefixes (the old
+                    # per-node block copies pinned this at <= 1)
+                    "covered_tokens": sum(n.length for n in self._noded)}
 
     def _publish(self) -> None:
-        CACHED_BYTES.set(float(self.bytes))
-        CACHED_NODES.set(float(len(self._blocked)))
+        CACHED_PAGES.set(float(len(self._page_holders)))
+        CACHED_BYTES.set(float(len(self._page_holders)
+                               * self.pool.page_nbytes))
+        CACHED_NODES.set(float(len(self._noded)))

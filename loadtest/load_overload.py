@@ -213,6 +213,11 @@ def main() -> int:
     idle = engine.drained(timeout=30)
     stats = engine.stats()
     pins = stats.get("prefix_cache", {}).get("pinned", 0)
+    # paged-KV leak check (ISSUE 11, mirroring the prefix-pin invariant):
+    # after the cancel/deadline storm every committed page must be
+    # cache-owned — an in-use page nobody's radix node holds is a leaked
+    # admission commit that stays unevictable forever
+    orphans = stats.get("kv_pool", {}).get("orphan_pages", 0)
     counts = {o: REQS_TOTAL.get(o) - counts0[o] for o in counts0}
 
     ttfts = [t for c in clients for t in c.ttfts]
@@ -230,6 +235,16 @@ def main() -> int:
     except Draining:
         drain_ok = True
     engine.shutdown()
+    # shutdown must not leak pages either, and a restarted engine serves
+    # from the same (still-balanced) pool and warm prefix cache
+    orphans_down = engine.stats().get("kv_pool", {}).get("orphan_pages", 0)
+    pins_down = engine.prefix_cache.stats()["pinned"]
+    engine.restart()
+    engine.submit(prompts[0], max_new_tokens=2, eos_id=eos).result(120)
+    post = engine.stats()
+    restart_ok = (post.get("kv_pool", {}).get("orphan_pages", 0) == 0
+                  and post.get("prefix_cache", {}).get("pinned", 0) == 0)
+    engine.shutdown()
 
     result = {
         "clients": n_clients,
@@ -244,10 +259,14 @@ def main() -> int:
         "shed_latency_max_ms": round(max(sheds) * 1e3, 2) if sheds else 0.0,
         "engine_counts": counts,
         "post_storm": {"active": stats["active"], "queued": stats["queued"],
-                       "prefix_pins": pins, "idle": idle,
+                       "prefix_pins": pins, "orphan_pages": orphans,
+                       "idle": idle,
                        "drain_rejects_new": drain_ok,
                        "cancel_evicts": cancel_ok,
-                       "deadline_evicts": deadline_ok},
+                       "deadline_evicts": deadline_ok,
+                       "shutdown_orphans": orphans_down,
+                       "shutdown_pins": pins_down,
+                       "restart_leak_free": restart_ok},
     }
     print(json.dumps(result))
 
@@ -256,6 +275,14 @@ def main() -> int:
         failures.append(f"leaked engine state: {stats} idle={idle}")
     if pins != 0:
         failures.append(f"leaked prefix-cache pins: {pins}")
+    if orphans != 0:
+        failures.append(f"leaked KV pages after the storm: {orphans} in "
+                        "use but not cache-owned")
+    if orphans_down != 0 or pins_down != 0:
+        failures.append(f"shutdown leaked: {orphans_down} pages / "
+                        f"{pins_down} pins")
+    if not restart_ok:
+        failures.append("restarted engine leaked pages or pins")
     if not sheds:
         failures.append("4x storm produced zero sheds — bounded admission "
                         "did not engage")

@@ -132,7 +132,10 @@ def test_engine_stats_snapshot():
 
     p = GenerativePredictor("llama", size="tiny", max_batch=2, max_seq=32)
     stats = p.engine.stats()
-    assert stats == {"active": 0, "queued": 0, "max_batch": 2}
+    # the autoscaler's keys plus the paged-KV standing (ISSUE 11)
+    assert {k: stats[k] for k in ("active", "queued", "max_batch")} \
+        == {"active": 0, "queued": 0, "max_batch": 2}
+    assert stats["kv_pool"]["orphan_pages"] == 0
     p.generate([[1, 2]], max_new_tokens=2)
     assert p.engine.stats()["active"] == 0  # drained after sync generate
 

@@ -174,15 +174,45 @@ def test_quota_route_reports_hard_and_used(stack):
 
 def test_serving_cache_route(stack):
     """Prefix-cache + TTFT standing for the serving engines sharing this
-    process's registry (PR 3): hit rate, cached bytes, TTFT percentiles."""
+    process's registry (PR 3), extended with the paged-KV pool and
+    speculative-decoding standing (ISSUE 11): page capacity/free/pinned,
+    spec accept rate, decode throughput."""
     server, mgr, base = stack
     code, state = req(base, "/dashboard/api/serving-cache",
                       user="alice@corp.com")
     assert code == 200
     assert set(state["prefix_cache"]) >= {"hits", "misses", "hit_rate",
-                                          "bytes", "evictions"}
+                                          "bytes", "pages", "evictions"}
+    assert set(state["kv_pool"]) >= {"pages", "free", "in_use", "pinned",
+                                     "utilization"}
+    assert set(state["speculative"]) >= {"proposed", "accepted",
+                                         "accept_rate", "rounds"}
+    assert 0.0 <= state["speculative"]["accept_rate"] <= 1.0
+    assert state["kv_pool"]["free"] <= state["kv_pool"]["pages"] or \
+        state["kv_pool"]["pages"] == 0
     assert "ttft_p50_s" in state and "ttft_p99_s" in state
     assert "prefill_dispatches" in state
+    assert "decode_tokens_per_sec" in state
+
+
+def test_serving_cache_state_reflects_live_engine():
+    """The dashboard numbers come from the same registry the engine
+    writes: page capacity and spec counters move when an engine serves."""
+    from kubeflow_tpu.dashboard.metrics_service import serving_cache_state
+    from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+    p = GenerativePredictor("llama", size="tiny", max_batch=1, max_seq=64,
+                            prefix_cache_mb=4, speculative_tokens=4)
+    try:
+        p.generate([[5, 8, 13, 21, 3, 9, 2, 17]], max_new_tokens=16)
+        state = serving_cache_state()
+        assert state["kv_pool"]["pages"] > 0
+        assert state["kv_pool"]["in_use"] >= 1      # the cached prompt
+        assert state["kv_pool"]["pinned"] == 0      # leak-free when idle
+        assert state["decode_tokens"] > 0
+        assert state["decode_tokens_per_sec"] > 0
+    finally:
+        p.engine.shutdown()
 
 
 def test_serving_health_route(stack):

@@ -77,3 +77,75 @@ def test_resnet_batchnorm_updates():
     after = jax.tree_util.tree_leaves(updates["batch_stats"])
     assert any(
         float(jnp.max(jnp.abs(a - b))) > 0 for a, b in zip(before, after))
+
+
+def test_llama_paged_cache_matches_contiguous():
+    """ISSUE 11: the paged-attention cache branch (KV pool addressed
+    through a page table — the accelerator-native formulation; see
+    ARCHITECTURE decision 18) is bitwise identical to the contiguous
+    per-sequence cache for prefill AND decode, including rows whose page
+    tables share pages."""
+    import numpy as np
+
+    from kubeflow_tpu.models import llama as lm
+
+    cfg = lm.llama_tiny()
+    module = lm.LlamaModel(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = module.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    ps, max_len = 16, 64
+    toks = [[(i * 7) % 511 + 1 for i in range(20)],
+            [(i * 13) % 511 + 1 for i in range(20)]]
+    ids = jnp.asarray(toks, jnp.int32)
+
+    # contiguous reference
+    ref = module.apply({"params": params}, ids,
+                       cache=lm.init_cache(cfg, 2, max_len=max_len,
+                                           per_sequence=True))
+
+    pool = lm.init_kv_pool(cfg, num_pages=16, page_size=ps)
+    tables = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+    paged = {"layers": [dict(pool_k=l["k"], pool_v=l["v"], pages=tables,
+                             index=jnp.zeros((2,), jnp.int32))
+                        for l in pool["layers"]]}
+    out = module.apply({"params": params}, ids, cache=paged)
+    assert (np.asarray(ref["logits"].astype(jnp.float32))
+            == np.asarray(out["logits"].astype(jnp.float32))).all()
+
+    # decode step on both caches
+    nxt = jnp.argmax(ref["logits"][:, -1].astype(jnp.float32),
+                     -1).astype(jnp.int32)[:, None]
+    idx = jnp.full((2,), 20, jnp.int32)
+    ref_kv = {"layers": [dict({"k": l["k"], "v": l["v"]}, index=idx)
+                         for l in ref["cache"]["layers"]]}
+    ref2 = module.apply({"params": params}, nxt, cache=ref_kv)
+    paged2 = {"layers": [dict(pool_k=l["pool_k"], pool_v=l["pool_v"],
+                              pages=tables, index=idx)
+                         for l in out["cache"]["layers"]]}
+    out2 = module.apply({"params": params}, nxt, cache=paged2)
+    assert (np.asarray(ref2["logits"].astype(jnp.float32))
+            == np.asarray(out2["logits"].astype(jnp.float32))).all()
+
+    # page SHARING: row 1's table aliases row 0's first page; with
+    # identical first-16-token prompts the logits must match a private
+    # layout exactly (shared pages are read in place, never copied)
+    shared_toks = [toks[0], toks[0][:16] + toks[1][16:]]
+    sids = jnp.asarray(shared_toks, jnp.int32)
+    ref_s = module.apply({"params": params}, sids,
+                         cache=lm.init_cache(cfg, 2, max_len=max_len,
+                                             per_sequence=True))
+    pool2 = lm.init_kv_pool(cfg, num_pages=16, page_size=ps)
+    # row 0 prefills alone into pages [1, 2]; row 1 then shares page 1
+    t0 = jnp.asarray([[1, 2]], jnp.int32)
+    p_row0 = {"layers": [dict(pool_k=l["k"], pool_v=l["v"], pages=t0,
+                              index=jnp.zeros((1,), jnp.int32))
+                         for l in pool2["layers"]]}
+    o_row0 = module.apply({"params": params}, sids[:1], cache=p_row0)
+    # row 1: shared page 1 + private page 5 — prefill only its suffix
+    t1 = jnp.asarray([[1, 5]], jnp.int32)
+    p_row1 = {"layers": [dict(pool_k=l["pool_k"], pool_v=l["pool_v"],
+                              pages=t1, index=jnp.full((1,), 16, jnp.int32))
+                         for l in o_row0["cache"]["layers"]]}
+    o_row1 = module.apply({"params": params}, sids[1:, 16:], cache=p_row1)
+    assert (np.asarray(ref_s["logits"][1, 16:].astype(jnp.float32))
+            == np.asarray(o_row1["logits"][0].astype(jnp.float32))).all()

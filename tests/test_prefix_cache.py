@@ -1,105 +1,169 @@
-"""Prefix-aware KV cache reuse (ISSUE 3): radix-tree longest-prefix match,
-LRU eviction under an HBM budget with refcounted in-flight holds, and the
-engine's warm admission path — whose outputs must be TOKEN-IDENTICAL to
-the cold path for the same (prompt, seed, sampling params)."""
+"""Prefix-aware KV cache reuse (ISSUE 3, repaged in ISSUE 11): radix-tree
+longest-prefix match over shared refcounted KV PAGES, LRU eviction under a
+page budget with refcounted in-flight holds, and the engine's warm
+admission path — whose outputs must be TOKEN-IDENTICAL to the cold path
+for the same (prompt, seed, sampling params)."""
 
-import jax.numpy as jnp
 import pytest
 
-from kubeflow_tpu.serving.prefix_cache import PrefixCache, block_nbytes
+from kubeflow_tpu.serving.page_pool import PagePool
+from kubeflow_tpu.serving.prefix_cache import PrefixCache
+
+PS = 2  # tokens per page in the unit tests
 
 
-def blk(snap: int = 16):
-    """A stand-in KV block shaped like the engine's ([1, snap, H, D])."""
-    return {"layers": [{"k": jnp.zeros((1, snap, 1, 2), jnp.float32),
-                        "v": jnp.zeros((1, snap, 1, 2), jnp.float32)}]}
+def make(max_pages: int = 1 << 20, pool_pages: int = 4096):
+    pool = PagePool(pool_pages, PS, page_nbytes=64)
+    return pool, PrefixCache(pool, max_pages)
 
 
-BLK_BYTES = block_nbytes(blk())
+def pages(pool: PagePool, tokens) -> list[int]:
+    """Allocate pages covering ``tokens`` the way an admission commit
+    does; the cache takes its own references at insert, so the caller's
+    are dropped (pages live exactly as long as the tree wants them)."""
+    n = -(-len(tokens) // PS)
+    ids = pool.alloc(n)
+    assert ids is not None
+    return ids
+
+
+def insert(pool: PrefixCache, pc, tokens) -> list[int]:
+    ids = pages(pool, tokens)
+    assert pc.insert(tokens, ids)
+    pool.decref(ids)
+    return ids
 
 
 # -- radix tree unit tests -----------------------------------------------------
 def test_longest_prefix_match_with_edge_splits():
-    pc = PrefixCache(1 << 30)
-    assert pc.insert((1, 2, 3, 4), blk())
-    assert pc.insert((1, 2, 5, 6), blk())   # splits the (1,2,3,4) edge
+    pool, pc = make()
+    insert(pool, pc, (1, 2, 3, 4))
+    insert(pool, pc, (1, 2, 5, 6))          # splits the (1,2,3,4) edge
 
     node, usable = pc.match((1, 2, 3, 4))
-    assert usable == 4 and node.block is not None
+    assert usable == 4 and node.pages is not None
     _, usable = pc.match((1, 2, 3, 9, 9))   # diverges inside an edge
     assert usable == 3
     _, usable = pc.match((1, 2, 5, 6, 7, 8))
     assert usable == 4
-    # the split point itself holds no block, but any descendant's
-    # full-prefix block covers the shorter match
+    # the split point itself holds no pages, but any descendant's
+    # full-prefix pages cover the shorter match
     node, usable = pc.match((1, 2))
-    assert usable == 2 and node.block is not None
+    assert usable == 2 and node.pages is not None
     assert node.length >= 2
     node, usable = pc.match((9, 9))
     assert node is None and usable == 0
 
 
-def test_match_prefers_covering_block_and_falls_back_to_ancestor():
-    pc = PrefixCache(1 << 30)
-    pc.insert((7, 8), blk())
-    pc.insert((7, 8, 9, 10), blk())
+def test_match_prefers_covering_node_and_falls_back_to_ancestor():
+    pool, pc = make()
+    insert(pool, pc, (7, 8))
+    insert(pool, pc, (7, 8, 9, 10))
     node, usable = pc.match((7, 8, 9, 10, 11))
     assert usable == 4
-    # drop the deep block: the (7,8) ancestor still serves 2 positions
+    # drop the deep node: the (7,8) ancestor still serves 2 positions
     pc._drop(node)
     node, usable = pc.match((7, 8, 9, 10, 11))
     assert usable == 2 and node.length == 2
 
 
-def test_eviction_is_lru_under_byte_budget():
+def test_longer_prefix_shares_pages_by_reference():
+    """The repaged tentpole invariant: a longer cached prefix holds the
+    SAME page ids as the shorter one it extends (incref, no copy), and a
+    shared page survives until its LAST holder is evicted."""
+    pool, pc = make()
+    a_ids = insert(pool, pc, (1, 2, 3, 4))
+    # the longer prompt reuses A's two pages and commits one new one
+    new = pool.alloc(1)
+    assert pc.insert((1, 2, 3, 4, 5, 6), list(a_ids) + new)
+    pool.decref(new)
+    assert pc.stats()["pages"] == 3          # 3 DISTINCT pages, not 5
+    for p in a_ids:
+        assert pool.refcount(p) == 2         # held by both nodes
+    node_a, _ = pc.match((1, 2, 3, 4))
+    pc._drop(node_a)                         # evict the short prefix
+    for p in a_ids:
+        assert pool.refcount(p) == 1         # still alive via the long one
+    node_ab, usable = pc.match((1, 2, 3, 4, 5, 6))
+    assert usable == 6
+    pc._drop(node_ab)
+    for p in a_ids + new:
+        assert pool.refcount(p) == 0         # last holder gone -> freed
+    assert pool.free_count == pool.num_pages - 1
+
+
+def test_eviction_is_lru_under_page_budget():
     from kubeflow_tpu.serving.prefix_cache import EVICTIONS_TOTAL
 
-    pc = PrefixCache(2 * BLK_BYTES)
-    pc.insert((1, 1, 1), blk())
-    pc.insert((2, 2, 2), blk())
-    assert pc.bytes == 2 * BLK_BYTES
+    pool, pc = make(max_pages=4)             # room for two 2-page prefixes
+    insert(pool, pc, (1, 1, 1))
+    insert(pool, pc, (2, 2, 2))
+    assert pc.stats()["pages"] == 4
     pc.match((1, 1, 1))                      # (1,1,1) is now most recent
     ev0 = EVICTIONS_TOTAL.get()
-    pc.insert((3, 3, 3), blk())              # evicts LRU (2,2,2)
-    assert pc.bytes == 2 * BLK_BYTES
+    insert(pool, pc, (3, 3, 3))              # evicts LRU (2,2,2)
+    assert pc.stats()["pages"] == 4
     assert EVICTIONS_TOTAL.get() == ev0 + 1
     assert pc.match((2, 2, 2)) == (None, 0)
     _, usable = pc.match((1, 1, 1))
     assert usable == 3
     _, usable = pc.match((3, 3, 3))
     assert usable == 3
+    # evicted pages went back to the pool, not just out of the tree
+    assert pool.free_count == pool.num_pages - 1 - 4
 
 
-def test_pinned_block_survives_eviction_until_released():
-    """The ISSUE invariant: eviction must never free a block an in-flight
+def test_pinned_node_survives_eviction_until_released():
+    """The ISSUE invariant: eviction must never free pages an in-flight
     admission holds."""
-    pc = PrefixCache(BLK_BYTES)              # budget: exactly one block
-    pc.insert((1, 1, 1), blk())
+    pool, pc = make(max_pages=2)             # budget: exactly one prefix
+    insert(pool, pc, (1, 1, 1))
     node, usable = pc.match((1, 1, 1), pin=True)
     assert usable == 3 and node.refs == 1
     # over-budget insert cannot evict the pinned node (nor itself)
-    pc.insert((2, 2, 2), blk())
-    assert node.block is not None
-    assert pc.bytes == 2 * BLK_BYTES         # temporarily over budget
+    insert(pool, pc, (2, 2, 2))
+    assert node.pages is not None
+    assert pc.stats()["pages"] == 4          # temporarily over budget
+    assert pc.stats()["pinned"] == 1
+    assert not pc.evict_lru() or node.pages is not None
     pc.release(node)
     assert node.refs == 0
-    pc.insert((3, 3, 3), blk())              # now LRU sweeps back to budget
-    assert pc.bytes <= BLK_BYTES
+    insert(pool, pc, (3, 3, 3))              # now LRU sweeps back to budget
+    assert pc.stats()["pages"] <= 2
     assert pc.match((1, 1, 1)) == (None, 0)
 
 
-def test_block_larger_than_budget_not_stored():
-    pc = PrefixCache(BLK_BYTES)
-    assert not pc.insert((1, 2, 3), blk(snap=64))
-    assert pc.bytes == 0
+def test_prefix_larger_than_budget_not_stored():
+    pool, pc = make(max_pages=1)
+    ids = pages(pool, (1, 2, 3))             # needs 2 pages > budget 1
+    assert not pc.insert((1, 2, 3), ids)
+    pool.decref(ids)
+    assert pc.stats()["pages"] == 0
+    assert pool.free_count == pool.num_pages - 1
 
 
-def test_duplicate_insert_keeps_one_block():
-    pc = PrefixCache(1 << 30)
-    pc.insert((4, 5, 6), blk())
-    pc.insert((4, 5, 6), blk())
-    assert pc.bytes == BLK_BYTES
-    assert pc.stats()["blocks"] == 1
+def test_duplicate_insert_keeps_one_node():
+    pool, pc = make()
+    insert(pool, pc, (4, 5, 6))
+    ids2 = pages(pool, (4, 5, 6))
+    assert pc.insert((4, 5, 6), ids2)        # refresh, not re-store
+    pool.decref(ids2)
+    assert pc.stats()["pages"] == 2
+    assert pc.stats()["nodes"] == 1
+    assert pool.free_count == pool.num_pages - 1 - 2
+
+
+def test_pool_refcount_guards():
+    pool = PagePool(8, PS)
+    ids = pool.alloc(2)
+    with pytest.raises(ValueError):
+        pool.decref([99] if 99 < pool.num_pages else [7])
+    pool.decref(ids)
+    with pytest.raises(ValueError):
+        pool.decref(ids)                     # double free
+    with pytest.raises(ValueError):
+        pool.incref(ids)                     # incref of free page
+    assert pool.alloc(99) is None            # over-ask fails whole
 
 
 # -- engine warm path: token identity ------------------------------------------
@@ -333,3 +397,69 @@ def test_annotation_validation_rejects_garbage():
             api.PREFIX_CACHE_ANNOTATION: bad}
         with pytest.raises(ValueError, match="finite"):
             api.validate(isvc)
+
+
+def test_kv_page_and_speculative_annotations_flow_to_args():
+    """ISSUE 11: serving.kubeflow.org/kv-page-size and
+    /speculative-tokens follow the prefix-cache-mb pattern end to end:
+    api constructor -> annotation -> controller -> predictor args."""
+    from kubeflow_tpu.api import inferenceservice as api
+
+    isvc = api.new("chat", "serving", prefix_cache_mb=64,
+                   kv_page_size=32, speculative_tokens=8)
+    assert api.kv_page_size(isvc) == 32
+    assert api.speculative_tokens(isvc) == 8
+    api.validate(isvc)
+
+    from kubeflow_tpu.controllers.inferenceservice import (
+        InferenceServiceController,
+    )
+    from kubeflow_tpu.core import APIServer
+
+    server = APIServer()
+    server.create(isvc)
+    isvc = server.get(api.KIND, "chat", "serving")
+    InferenceServiceController(server)._ensure_deployment(isvc)
+    cmd = server.get("Deployment", "chat", "serving")[
+        "spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[cmd.index("--kv-page-size") + 1] == "32"
+    assert cmd[cmd.index("--speculative-tokens") + 1] == "8"
+    # absent annotations add no flags (engine defaults rule)
+    plain = api.new("plain", "serving")
+    server.create(plain)
+    plain = server.get(api.KIND, "plain", "serving")
+    InferenceServiceController(server)._ensure_deployment(plain)
+    cmd2 = server.get("Deployment", "plain", "serving")[
+        "spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--kv-page-size" not in cmd2
+    assert "--speculative-tokens" not in cmd2
+
+
+def test_kv_page_and_speculative_annotation_validation():
+    from kubeflow_tpu.api import inferenceservice as api
+
+    isvc = api.new("chat", "serving")
+    for ann in (api.KV_PAGE_SIZE_ANNOTATION,
+                api.SPECULATIVE_TOKENS_ANNOTATION):
+        isvc["metadata"]["annotations"] = {ann: "many"}
+        with pytest.raises(ValueError, match="integer"):
+            api.validate(isvc)
+        isvc["metadata"]["annotations"] = {ann: "-4"}
+        with pytest.raises(ValueError, match=">= 0"):
+            api.validate(isvc)
+    isvc["metadata"]["annotations"] = {
+        api.KV_PAGE_SIZE_ANNOTATION: "16",
+        api.SPECULATIVE_TOKENS_ANNOTATION: "0"}
+    api.validate(isvc)
+
+
+def test_predictor_plumbs_page_and_spec_args():
+    from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+    p = GenerativePredictor("llama", size="tiny", max_batch=1, max_seq=64,
+                            kv_page_size=8, speculative_tokens=4)
+    try:
+        assert p.engine.page_size == 8
+        assert p.engine.spec_max == 4
+    finally:
+        p.engine.shutdown()

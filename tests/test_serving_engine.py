@@ -409,7 +409,7 @@ class TestShardedServing:
                      for leaf in jax.tree_util.tree_leaves(tp2.params)}
             assert any("tp" in str(s) for s in specs), specs
             cache_specs = {leaf.sharding.spec for leaf in
-                           jax.tree_util.tree_leaves(tp2.engine.cache)}
+                           jax.tree_util.tree_leaves(tp2.engine.view)}
             assert cache_specs == {P(None, None, "tp", None)}
         finally:
             tp2.engine.shutdown()
@@ -486,3 +486,234 @@ class TestShardedServing:
                                 model_config={"moe_experts": 2,
                                               "moe_every": 2},
                                 max_batch=2, max_seq=64, ep=4)
+
+
+class TestSpeculativeDecoding:
+    """ISSUE 11: speculative decoding must be TOKEN-IDENTICAL to plain
+    decode for every traffic shape — greedy, seeded sampling, ragged
+    co-batches — and on every acceptance outcome (all-rejected, partial
+    accept, full accept).  Speculation may only change how many tokens a
+    dispatch yields, never which tokens."""
+
+    PROMPT = [5, 8, 13, 21, 3, 9, 2, 17, 11, 4, 6, 12, 7, 1]
+
+    @pytest.fixture(scope="class")
+    def plain(self):
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        p = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                max_seq=96)
+        yield p
+        p.engine.shutdown()
+
+    @pytest.fixture(scope="class")
+    def spec(self):
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        p = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                max_seq=96, speculative_tokens=4)
+        assert p.engine.spec_max == 4
+        yield p
+        p.engine.shutdown()
+
+    def test_greedy_identical(self, plain, spec):
+        want = plain.generate([self.PROMPT], max_new_tokens=40)["ids"][0]
+        got = spec.generate([self.PROMPT], max_new_tokens=40)["ids"][0]
+        assert got == want
+
+    def test_seeded_sampling_identical(self, plain, spec):
+        kw = dict(max_new_tokens=24, temperature=1.1, seed=9, top_k=8,
+                  top_p=0.9)
+        want = plain.engine.submit(self.PROMPT, **kw).result(120)
+        got = spec.engine.submit(self.PROMPT, **kw).result(120)
+        assert got == want
+
+    def test_ragged_cobatch_identical(self, plain, spec):
+        """Two requests at different lengths decoding TOGETHER under
+        speculation emit exactly their solo plain-decode streams."""
+        a, b = self.PROMPT, self.PROMPT[:6] + [30, 31]
+        solo = [plain.generate([p], max_new_tokens=20)["ids"][0]
+                for p in (a, b)]
+        ra = spec.engine.submit(a, max_new_tokens=20)
+        time.sleep(0.02)
+        rb = spec.engine.submit(b, max_new_tokens=20)
+        assert [ra.result(120), rb.result(120)] == solo
+
+    def test_all_rejected_path(self, plain):
+        """A drafter that is ALWAYS wrong: every verify round rejects the
+        whole draft and keeps only the model's own token — the stream
+        must be untouched and acceptance must read zero."""
+        from kubeflow_tpu.serving.engine import (
+            SPEC_ACCEPTED,
+            SPEC_PROPOSED,
+            ContinuousBatcher,
+        )
+
+        eng = ContinuousBatcher(
+            plain.module, plain.params, plain.cfg, max_batch=2,
+            max_seq=96, speculative_tokens=4,
+            draft_fn=lambda toks, n: [(t % 511) + 1 for t in toks[-n:]]
+            if n > 0 else [])
+        try:
+            p0, a0 = SPEC_PROPOSED.get(), SPEC_ACCEPTED.get()
+            want = plain.generate([self.PROMPT],
+                                  max_new_tokens=30)["ids"][0]
+            got = eng.generate_sync([self.PROMPT], max_new_tokens=30)[0]
+            assert got == want
+            assert SPEC_ACCEPTED.get() == a0  # nothing ever accepted
+            assert SPEC_PROPOSED.get() > p0   # but drafts were verified
+        finally:
+            eng.shutdown()
+
+    def test_partial_accept_path(self, plain):
+        """An oracle-prefix drafter: the first draft token continues the
+        true stream, the second is wrong — every round accepts exactly
+        one and corrects at the rejection point."""
+        from kubeflow_tpu.serving.engine import (
+            SPEC_ACCEPTED,
+            SPEC_PROPOSED,
+            ContinuousBatcher,
+        )
+
+        want = plain.generate([self.PROMPT], max_new_tokens=30)["ids"][0]
+        stream = want[len(self.PROMPT):]
+
+        def oracle_then_wrong(toks, n):
+            done = len(toks) - len(self.PROMPT)
+            if n <= 0 or done < 1 or done >= len(stream):
+                return []
+            good = stream[done]
+            return [good, (good % 511) + 1][:n]
+
+        eng = ContinuousBatcher(
+            plain.module, plain.params, plain.cfg, max_batch=2,
+            max_seq=96, speculative_tokens=4,
+            draft_fn=oracle_then_wrong)
+        try:
+            p0, a0 = SPEC_PROPOSED.get(), SPEC_ACCEPTED.get()
+            got = eng.generate_sync([self.PROMPT], max_new_tokens=30)[0]
+            assert got == want
+            accepted = SPEC_ACCEPTED.get() - a0
+            proposed = SPEC_PROPOSED.get() - p0
+            assert accepted > 0          # the oracle prefix landed
+            assert accepted < proposed   # the poisoned tail never did
+        finally:
+            eng.shutdown()
+
+    def test_eos_inside_accepted_draft_stops(self, plain, spec):
+        """EOS discovered inside a verify round's outputs terminates the
+        request exactly where sequential decode would."""
+        probe = plain.generate([self.PROMPT], max_new_tokens=12)["ids"][0]
+        eos = probe[len(self.PROMPT) + 5]   # a token 6 steps in
+        want = plain.generate([self.PROMPT], max_new_tokens=40,
+                              eos_id=eos)["ids"][0]
+        got = spec.generate([self.PROMPT], max_new_tokens=40,
+                            eos_id=eos)["ids"][0]
+        assert got == want
+
+    def test_spec_metrics_and_stats(self, spec):
+        from kubeflow_tpu.utils.metrics import REGISTRY
+
+        spec.generate([self.PROMPT], max_new_tokens=30)
+        text = REGISTRY.expose()
+        for series in ("serving_spec_tokens_proposed_total",
+                       "serving_spec_tokens_accepted_total",
+                       "serving_spec_rounds_total",
+                       "serving_decode_tokens_total",
+                       "serving_decode_seconds_total"):
+            assert series in text, series
+        st = spec.engine.stats()
+        assert st["speculative"]["max_tokens"] == 4
+        assert 0.0 <= st["speculative"]["accept_rate"] <= 1.0
+
+
+class TestPagedKVPool:
+    """ISSUE 11: the paged pool's leak-free accounting — every committed
+    page is cache-owned whenever the engine is idle, across completion,
+    cancellation, shutdown, and restart."""
+
+    def test_pages_balanced_after_traffic_and_restart(self):
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        p = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                max_seq=96, prefix_cache_mb=8,
+                                speculative_tokens=4)
+        eng = p.engine
+        prompt = [5, 8, 13, 21, 3, 9, 2, 17, 11, 4, 6, 12]
+        eng.generate_sync([prompt, prompt + [7]], max_new_tokens=8)
+        assert eng.drained(timeout=30)
+        assert eng.stats()["kv_pool"]["orphan_pages"] == 0
+
+        # cancel storm: abandoned mid-decode requests must not leak pages
+        reqs = [eng.submit(prompt + [50 + i], max_new_tokens=60, eos_id=0)
+                for i in range(5)]
+        for r in reqs:
+            r.cancel()
+        for r in reqs:
+            assert r._done.wait(60)
+        assert eng.drained(timeout=30)
+        assert eng.stats()["kv_pool"]["orphan_pages"] == 0
+        assert eng.prefix_cache.stats()["pinned"] == 0
+
+        eng.shutdown()
+        assert eng.stats()["kv_pool"]["orphan_pages"] == 0
+        eng.restart()
+        out = eng.submit(prompt, max_new_tokens=4).result(120)
+        assert out[:len(prompt)] == prompt
+        assert eng.stats()["kv_pool"]["orphan_pages"] == 0
+        eng.shutdown()
+
+    def test_cache_eviction_returns_pages_to_pool(self):
+        """Pool pressure evicts LRU prefixes and their pages become
+        allocatable again (eviction frees pages, not whole prefixes:
+        a page shared with a longer live prefix survives)."""
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        p = GenerativePredictor("llama", size="tiny", max_batch=1,
+                                max_seq=96, prefix_cache_mb=8)
+        eng = p.engine
+        pc = eng.prefix_cache
+        ps = eng.page_size
+        base = [(i * 7) % 511 + 1 for i in range(40)]     # 3 pages
+        longer = base + [(i * 11) % 511 + 1 for i in range(12)]  # 4 pages
+        eng.generate_sync([base], max_new_tokens=2)
+        eng.generate_sync([longer], max_new_tokens=2)
+        st = pc.stats()
+        # the longer prefix SHARES the shorter one's full pages: distinct
+        # pages held < what per-node block copies would have stored
+        assert st["nodes"] == 2
+        naive = -(-len(base) // ps) + -(-len(longer) // ps)
+        assert st["pages"] < naive, st
+        free0 = eng.pool.free_count
+        while pc.evict_lru():
+            pass
+        assert pc.stats()["pages"] == 0
+        assert eng.pool.free_count > free0
+        assert eng.stats()["kv_pool"]["orphan_pages"] == 0
+        eng.shutdown()
+
+    def test_non_dividing_page_size_stays_token_identical(self):
+        """page_size that does not divide max_seq: the tail page cannot
+        be committed (a clamped slice would cache SHIFTED positions), so
+        the prompt tail simply is not cached — and warm streams stay
+        identical to cold."""
+        from kubeflow_tpu.serving.engine import ContinuousBatcher
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        ref = GenerativePredictor("llama", size="tiny", max_batch=1,
+                                  max_seq=100)
+        eng = ContinuousBatcher(ref.module, ref.params, ref.cfg,
+                                max_batch=1, max_seq=100, page_size=24,
+                                prefix_cache_bytes=8 << 20)
+        try:
+            prompt = [(i * 7) % 511 + 1 for i in range(95)]  # 4 full +
+            want = ref.generate([prompt], max_new_tokens=4)["ids"][0]
+            assert eng.generate_sync([prompt], max_new_tokens=4)[0] == want
+            # second pass hits the (capped) cached prefix
+            assert eng.generate_sync([prompt], max_new_tokens=4)[0] == want
+            st = eng.prefix_cache.stats()
+            assert st["pages"] <= 100 // 24   # no clamped tail page
+            assert eng.stats()["kv_pool"]["orphan_pages"] == 0
+        finally:
+            eng.shutdown()
+            ref.engine.shutdown()
