@@ -1,0 +1,85 @@
+"""Handoff hygiene: disaggregation state never crosses pools in TLS.
+
+``handoff-threadlocal``
+    The prefill->decode handoff (serving/disagg.py) moves a request
+    between WORKER POOLS: the thread that committed the prompt KV is
+    never the thread that seeds the decode slot.  Any state stashed in a
+    ``threading.local()`` is therefore invisible exactly where it is
+    needed — the bug class the trace layer already banned for spans
+    (ARCHITECTURE decision 17: attributes on the request object are the
+    one legal cross-thread channel).  This rule bans ``threading.local``
+    construction outright in the serving tree and in any module that
+    touches the handoff machinery (``HandoffState`` /
+    ``submit_handoff``): handoff state rides the request, full stop.
+
+Same rule shape as the span-lifecycle pass: lexical, suppressible with
+``# kfvet: ignore[handoff-threadlocal]`` for a use that provably never
+carries per-request state (none exist today — the suppression pays rent
+via the unused-suppression rule).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kubeflow_tpu.analysis.framework import (
+    Finding, ModuleInfo, Pass, register)
+
+HANDOFF_MARKERS = {"HandoffState", "submit_handoff"}
+
+
+def _imports_threading_local(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom) and node.module == "threading"
+                and any(a.name == "local" for a in node.names)):
+            return True
+    return False
+
+
+def _is_threading_local_ctor(node: ast.AST, bare_local: bool) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if (isinstance(func, ast.Attribute) and func.attr == "local"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading"):
+        return True
+    # a bare `local()` call counts only when the module actually did
+    # `from threading import local` — any other function that happens
+    # to be named `local` is not this hazard
+    return (bare_local and isinstance(func, ast.Name)
+            and func.id == "local")
+
+
+def _touches_handoff(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in HANDOFF_MARKERS:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in HANDOFF_MARKERS:
+            return True
+        if isinstance(node, (ast.ImportFrom,)):
+            if any(a.name in HANDOFF_MARKERS for a in node.names):
+                return True
+    return False
+
+
+@register
+class HandoffThreadLocalPass(Pass):
+    rules = ("handoff-threadlocal",)
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not (mod.in_scope("kubeflow_tpu/serving/")
+                or _touches_handoff(mod.tree)):
+            return []
+        findings: list[Finding] = []
+        bare_local = _imports_threading_local(mod.tree)
+        for node in ast.walk(mod.tree):
+            if _is_threading_local_ctor(node, bare_local):
+                findings.append(Finding(
+                    "handoff-threadlocal", mod.path, node.lineno,
+                    "threading.local() in handoff-adjacent code: the "
+                    "prefill->decode handoff crosses worker-pool threads, "
+                    "so thread-local state is invisible where it is "
+                    "needed — ride the request object instead"))
+        return findings

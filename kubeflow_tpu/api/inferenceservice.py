@@ -22,6 +22,16 @@ KV_PAGE_SIZE_ANNOTATION = "serving.kubeflow.org/kv-page-size"
 # max draft tokens per speculative-decoding verify round (0/absent =
 # disabled; output is token-identical either way)
 SPECULATIVE_TOKENS_ANNOTATION = "serving.kubeflow.org/speculative-tokens"
+# disaggregated serving role: "prefill" or "decode" splits this
+# InferenceService's predictors into one phase of a disaggregated pair
+# (the controller passes --role and labels the pods so the gateway
+# routes prompts to prefill backends and handoffs to decode backends);
+# absent/"colocated" keeps the classic single-engine predictor
+ROLE_ANNOTATION = "serving.kubeflow.org/role"
+# int8 KV-cache quantization: "true" quantizes pages at prefill-commit
+# and dequantizes at decode seed (~2x effective page capacity;
+# perplexity-neutral, not bit-identical)
+KV_QUANT_ANNOTATION = "serving.kubeflow.org/kv-quant"
 
 
 def new(name: str, namespace: str, *, model: str = "llama",
@@ -30,7 +40,9 @@ def new(name: str, namespace: str, *, model: str = "llama",
         checkpoint_dir: str | None = None, min_replicas: int = 1,
         prefix_cache_mb: float | None = None,
         kv_page_size: int | None = None,
-        speculative_tokens: int | None = None) -> dict:
+        speculative_tokens: int | None = None,
+        role: str | None = None,
+        kv_quant: bool = False) -> dict:
     isvc = api_object(KIND, name, namespace, spec={
         "predictor": {
             "model": model,
@@ -47,6 +59,10 @@ def new(name: str, namespace: str, *, model: str = "llama",
         annotations[KV_PAGE_SIZE_ANNOTATION] = str(kv_page_size)
     if speculative_tokens:
         annotations[SPECULATIVE_TOKENS_ANNOTATION] = str(speculative_tokens)
+    if role:
+        annotations[ROLE_ANNOTATION] = role
+    if kv_quant:
+        annotations[KV_QUANT_ANNOTATION] = "true"
     if not annotations:
         del isvc["metadata"]["annotations"]
     return isvc
@@ -77,6 +93,20 @@ def speculative_tokens(isvc: dict) -> int:
     if raw is None:
         return 0
     return int(raw)
+
+
+def role(isvc: dict) -> str:
+    """The annotated disaggregation role ("colocated" when absent)."""
+    raw = isvc.get("metadata", {}).get("annotations", {}).get(
+        ROLE_ANNOTATION)
+    return raw if raw else "colocated"
+
+
+def kv_quant(isvc: dict) -> bool:
+    """Whether int8 KV-page quantization is enabled."""
+    raw = isvc.get("metadata", {}).get("annotations", {}).get(
+        KV_QUANT_ANNOTATION)
+    return str(raw).lower() in ("1", "true")
 
 
 def validate(isvc: dict) -> None:
@@ -115,3 +145,11 @@ def validate(isvc: dict) -> None:
             f"{SPECULATIVE_TOKENS_ANNOTATION} must be an integer (tokens)")
     if spec < 0:
         raise ValueError(f"{SPECULATIVE_TOKENS_ANNOTATION} must be >= 0")
+    if role(isvc) not in ("colocated", "prefill", "decode"):
+        raise ValueError(
+            f"{ROLE_ANNOTATION} must be one of colocated/prefill/decode")
+    raw_quant = isvc.get("metadata", {}).get("annotations", {}).get(
+        KV_QUANT_ANNOTATION)
+    if raw_quant is not None and str(raw_quant).lower() not in (
+            "1", "true", "0", "false"):
+        raise ValueError(f"{KV_QUANT_ANNOTATION} must be a boolean")

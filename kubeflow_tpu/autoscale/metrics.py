@@ -82,6 +82,12 @@ class MetricsCollector:
         with self._lock:
             return self._backend_inflight.get(addr, 0)
 
+    def backend_snapshot(self) -> dict[tuple, int]:
+        """All backends with live streams (the dashboard's per-backend
+        routing view — observable before/after disaggregation)."""
+        with self._lock:
+            return dict(self._backend_inflight)
+
     # -- activator holds -------------------------------------------------------
     def hold(self, key: Key, limit: int) -> "_Hold":
         """Context manager counting one held request; raises

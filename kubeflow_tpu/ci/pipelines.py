@@ -151,14 +151,19 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                          "loadtest/load_overload.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
                      "tests/test_serving.py", "tests/test_serving_engine.py",
-                     "tests/test_prefix_cache.py", "tests/test_quant.py"],
+                     "tests/test_prefix_cache.py", "tests/test_quant.py",
+                     "tests/test_disagg.py"],
         # small-N shared-prefix loadtest: asserts the prefix cache still
         # cuts prefill dispatches, warm output == cold output, the
         # speculative stream is token-identical to plain decode, the
         # paged KV pool holds zero orphan pages when idle, and decode
         # tokens/s clears a throughput floor (KF_DECODE_FLOOR, default
         # ~25% of what CI hardware sustains — a regression canary, not a
-        # benchmark; KF_SKIP_SMOKE=1 opts the whole step out)
+        # benchmark; KF_SKIP_SMOKE=1 opts the whole step out).  The smoke
+        # also runs the DISAGGREGATED mixed-storm phase (prefill/decode
+        # split vs colocated under a long-prompt storm, token-identical +
+        # leak-free + a KF_DISAGG_FLOOR throughput ratio;
+        # KF_SKIP_DISAGG=1 opts just that phase out)
         "smoke_cmd": [sys.executable, "loadtest/load_serving.py",
                       "--smoke"],
         # 4x-capacity overload storm with a decode-stall fault: asserts

@@ -70,6 +70,11 @@ class InferenceServiceController(Controller):
         spec_tokens = api.speculative_tokens(isvc)
         if spec_tokens > 0:
             args += ["--speculative-tokens", str(spec_tokens)]
+        role = api.role(isvc)
+        if role != "colocated":
+            args += ["--role", role]
+        if api.kv_quant(isvc):
+            args += ["--kv-quant"]
         container = {
             "name": "predictor",
             "image": pred.get("image", "kubeflow-tpu/predictor:latest"),
@@ -82,10 +87,15 @@ class InferenceServiceController(Controller):
             live = self.server.get("Deployment", name, ns)
         except NotFound:
             live = None
+        labels = {"isvc": name}
+        if role != "colocated":
+            # the gateway's role-aware backend picker reads this off the
+            # pods (prompts -> prefill backends, handoffs -> decode)
+            labels["serving.kubeflow.org/role"] = role
         desired = set_owner(api_object("Deployment", name, ns, spec={
             "replicas": self._replicas(isvc, live),
             "selector": {"matchLabels": {"isvc": name}},
-            "template": {"metadata": {"labels": {"isvc": name}},
+            "template": {"metadata": {"labels": labels},
                          "spec": {"containers": [container],
                                   "nodeSelector": {
                                       "cloud-tpu.google.com/slice":

@@ -100,12 +100,16 @@ def serving_cache_state() -> dict:
     }
 
 
-def serving_health_state() -> dict:
+def serving_health_state(server=None) -> dict:
     """Overload/robustness standing of the serving path in this process
     (the serving-cache card's sibling): request outcomes split by ok /
     shed / cancelled / deadline_exceeded, admission-wait percentiles from
     the bounded-admission histogram, gateway shed relays, live queue
-    depth, and whether any engine is draining."""
+    depth, and whether any engine is draining.  With a ``server``, also
+    the per-backend routing view (role, in-flight streams, draining) the
+    gateway's role-aware picker decides on — so routing decisions are
+    observable before and after a disaggregation rollout — plus the
+    ``gateway_backend_pick_total`` reason breakdown and handoff count."""
     from kubeflow_tpu.utils.metrics import REGISTRY
 
     def val(name: str) -> float:
@@ -116,7 +120,8 @@ def serving_health_state() -> dict:
     outcomes = ("ok", "shed", "cancelled", "deadline_exceeded", "error",
                 "shutdown")
     wait = REGISTRY.get_metric("serving_admission_wait_seconds")
-    return {
+    picks = REGISTRY.get_metric("gateway_backend_pick_total")
+    state = {
         "requests": {o: (reqs.get(o) if reqs is not None else 0.0)
                      for o in outcomes},
         "admission_wait_p50_s": wait.percentile(50) if wait else 0.0,
@@ -125,7 +130,32 @@ def serving_health_state() -> dict:
         "queue_depth": val("serving_queue_depth"),
         "active": val("serving_active_requests"),
         "draining": bool(val("serving_draining")),
+        "handoffs": val("serving_prefill_handoffs_total"),
+        "backend_picks": (picks.total() if picks is not None else 0.0),
     }
+    if server is not None:
+        from kubeflow_tpu import autoscale
+        from kubeflow_tpu.gateway import pod_draining, pod_role
+
+        inflight = autoscale.get_collector(server).backend_snapshot()
+        backends = []
+        for pod in server.list("Pod"):
+            status = pod.get("status", {})
+            port_map = status.get("portMap") or {}
+            if status.get("phase") != "Running" or not port_map:
+                continue
+            host = status.get("podIP", "127.0.0.1")
+            streams = sum(inflight.get((host, int(p)), 0)
+                          for p in port_map.values())
+            backends.append({
+                "namespace": pod["metadata"].get("namespace"),
+                "pod": pod["metadata"]["name"],
+                "role": pod_role(pod) or "colocated",
+                "draining": pod_draining(pod),
+                "in_flight": streams,
+            })
+        state["backends"] = backends
+    return state
 
 
 def persistence_health_state(server) -> dict:
@@ -305,7 +335,7 @@ class LocalMetricsService:
         return serving_cache_state()
 
     def get_serving_health(self) -> dict:
-        return serving_health_state()
+        return serving_health_state(self.server)
 
     def get_cluster_health(self) -> dict:
         return cluster_health(self.server)
@@ -376,7 +406,8 @@ class CloudMonitoringMetricsService:
         return serving_cache_state()
 
     def get_serving_health(self):
-        return serving_health_state()
+        # counters are process-local; the per-backend view is store-local
+        return serving_health_state(self.server)
 
     def get_cluster_health(self):
         # node heartbeats live in the platform's own store, like the

@@ -76,6 +76,10 @@ SHED = REGISTRY.counter(
     "gateway_shed_responses_total",
     "backend load-shed responses (429 / busy-503 with Retry-After) "
     "relayed — healthy-busy, never an ejection")
+PICKS = REGISTRY.counter(
+    "gateway_backend_pick_total",
+    "backend pick decisions by requested serving role and reason",
+    labels=("role", "reason"))
 
 log = get_logger("gateway")
 
@@ -84,6 +88,12 @@ log = get_logger("gateway")
 # scale-down victim before patching replicas, and a SIGTERM'd predictor
 # flips its own readiness the same way
 DRAINING_ANNOTATION = "serving.kubeflow.org/draining"
+
+# disaggregated serving (serving/disagg.py): pods labeled with a role
+# serve only that phase — prompts dispatch to prefill backends, decode
+# handoff targets are decode backends.  Unlabeled pods are colocated and
+# serve either phase (the fallback when a pool is empty).
+ROLE_LABEL = "serving.kubeflow.org/role"
 
 # the mesh identity header, wire-format (profile.py/kfam write policies
 # keyed on exactly this name)
@@ -103,6 +113,15 @@ class NoBackend(RuntimeError):
 def pod_draining(pod: dict) -> bool:
     return (pod.get("metadata", {}).get("annotations") or {}) \
         .get(DRAINING_ANNOTATION) == "true"
+
+
+def pod_role(pod: dict) -> str | None:
+    """The pod's serving role (prefill/decode), from its labels (the
+    controller stamps the Deployment template) or annotations; None for
+    a colocated (role-less) pod."""
+    meta = pod.get("metadata", {})
+    return ((meta.get("labels") or {}).get(ROLE_LABEL)
+            or (meta.get("annotations") or {}).get(ROLE_LABEL))
 
 
 def mark_draining(server: APIServer, name: str, namespace: str | None,
@@ -213,6 +232,7 @@ class Backend:
     path: str
     set_headers: dict
     timeout_s: float
+    role: str | None = None   # the backing pod's serving role, if any
 
 
 def _scale_key(route: Route) -> tuple | None:
@@ -239,12 +259,17 @@ def _span_stream(result, span):
     return run()
 
 
-def _counted(result, collector, key, addr_ref=None):
+def _counted(result, collector, key, addr_ref=None, peer_addr=None):
     """Wrap a WSGI response iterable so the in-flight counts (revision
     concurrency and per-backend stream count) drop only when the body is
     fully streamed (or the client goes away).  ``addr_ref`` is a one-slot
     list because a shed response may re-dispatch to a sibling backend
-    before any byte streams — the proxy updates the slot in place."""
+    before any byte streams — the proxy updates the slot in place.
+    ``peer_addr`` is the stamped decode handoff target: the decode pod
+    serves its stream for the lifetime of THIS proxied request (the
+    prefill predictor blocks on it), but its traffic never transits the
+    gateway — counting it here is what makes the least-loaded decode
+    pick see real load instead of a forever-zero."""
     try:
         yield from result
     finally:
@@ -252,6 +277,8 @@ def _counted(result, collector, key, addr_ref=None):
             collector.dec(key)
         if addr_ref is not None:
             collector.dec_backend(addr_ref[0])
+        if peer_addr is not None:
+            collector.dec_backend(peer_addr)
 
 
 def _prefix_owned(prefix: str, vs_namespace: str | None) -> bool:
@@ -405,11 +432,22 @@ def resolve_backend(server: APIServer, path: str) -> Backend | None:
 
 def backend_for_route(server: APIServer, route: Route, path: str,
                       ejected: EjectionList | None = None,
-                      exclude: set | None = None) -> Backend:
+                      exclude: set | None = None, *,
+                      role: str | None = None,
+                      collector=None) -> Backend:
     """Resolve a live backend for ``route``.  DRAINING pods never
     participate (they are finishing in-flight streams — a scale-down
     victim or a SIGTERM'd predictor); ``exclude`` skips specific
-    ``(host, port)`` addresses (the shed-retry path trying a sibling)."""
+    ``(host, port)`` addresses (the shed-retry path trying a sibling).
+
+    ``role`` restricts the pick to pods labeled with that serving role
+    (disaggregation: prompts go to prefill backends, decode handoffs to
+    decode backends); when no pod carries the requested role, unlabeled
+    (colocated) pods serve it — so a role-split rollout degrades to the
+    old behavior, never to a 503.  With ``collector`` (the autoscaler's
+    per-backend stream counts) and several candidates, the LEAST-LOADED
+    backend wins; every decision is counted in
+    ``gateway_backend_pick_total{role,reason}``."""
     parts = route.dest_host.split(".")
     if len(parts) < 2:
         raise NoBackend(f"unresolvable destination {route.dest_host!r}")
@@ -427,7 +465,8 @@ def backend_for_route(server: APIServer, route: Route, path: str,
         raise NoBackend(
             f"service {svc_ns}/{svc_name} has no port {route.dest_port}")
     selector = {"matchLabels": svc["spec"].get("selector", {})}
-    fallback = None
+    candidates: list[Backend] = []
+    ejected_pool: list[Backend] = []
     for pod in server.list("Pod", namespace=svc_ns,
                            label_selector=selector):
         status = pod.get("status", {})
@@ -444,21 +483,49 @@ def backend_for_route(server: APIServer, route: Route, path: str,
                           port=int(host_port),
                           path=route.rewritten(path),
                           set_headers=route.set_headers,
-                          timeout_s=route.timeout_s)
+                          timeout_s=route.timeout_s,
+                          role=pod_role(pod))
         if exclude and (backend.host, backend.port) in exclude:
             continue
         if ejected is not None and ejected.contains(backend.host,
                                                     backend.port):
-            # out of rotation after a connect failure — but keep it as a
+            # out of rotation after a connect failure — but kept as a
             # last resort: with EVERY candidate ejected, one failing
             # attempt beats an unconditional 503 (Envoy's panic threshold)
-            fallback = fallback or backend
+            ejected_pool.append(backend)
             continue
-        return backend
-    if fallback is not None:
-        return fallback
-    raise NoBackend(f"no running pod backs {svc_ns}/{svc_name}"
-                    f":{target_port}")
+        candidates.append(backend)
+
+    def role_filter(pool: list[Backend]) -> list[Backend]:
+        if role is None or not pool:
+            return pool
+        in_role = [b for b in pool if b.role == role]
+        # no pod carries the role -> colocated (unlabeled) pods serve it;
+        # pods labeled with a DIFFERENT role never do — the ejected
+        # fallback included (a known-bad wrong-role pod is strictly
+        # worse than a 503 the caller can retry)
+        return in_role or [b for b in pool if b.role is None]
+
+    candidates = role_filter(candidates)
+    role_label = role or "any"
+    if not candidates:
+        ejected_pool = role_filter(ejected_pool)
+        if ejected_pool:
+            PICKS.labels(role_label, "ejected_fallback").inc()
+            return ejected_pool[0]
+        raise NoBackend(f"no running pod backs {svc_ns}/{svc_name}"
+                        f":{target_port}"
+                        + (f" in role {role!r}" if role else ""))
+    if len(candidates) == 1:
+        PICKS.labels(role_label, "only_candidate").inc()
+        return candidates[0]
+    if collector is not None:
+        PICKS.labels(role_label, "least_loaded").inc()
+        return min(candidates,
+                   key=lambda b: collector.backend_inflight((b.host,
+                                                             b.port)))
+    PICKS.labels(role_label, "first_match").inc()
+    return candidates[0]
 
 
 def _request_headers(environ: dict, backend: Backend,
@@ -694,6 +761,11 @@ class Gateway:
         # backend that takes the first occurrence sees the client's value
         # (unlike the HTTP path, where headers.update overwrites).
         overridden = {n.lower() for n in backend.set_headers}
+        # gateway-only headers are scrubbed here exactly as in __call__:
+        # a client riding the upgrade tunnel (which replays headers
+        # verbatim) must not be able to smuggle a decode-peer address to
+        # a predictor that falls back to plain WSGI handling
+        overridden.add("x-kf-decode-peer")
         lines = [f"{handler.command} {target} HTTP/1.1",
                  f"Host: {backend.host}:{backend.port}"]
         for name, value in handler.headers.items():
@@ -812,11 +884,28 @@ class Gateway:
             start_response("403 Forbidden",
                            [("Content-Type", "text/plain")])
             return [f"{why}\n".encode()]
+        # disaggregated serving: a generate POST dispatches to the
+        # least-loaded PREFILL backend, and the decode handoff target
+        # (picked here by decode-backend load — the slot-availability
+        # signal the collector sees) rides the request as
+        # X-KF-Decode-Peer.  Routes without role-labeled pods resolve
+        # exactly as before.  The inbound header is DROPPED
+        # unconditionally: only the gateway may name the peer — a
+        # client-supplied value would make the prefill predictor POST
+        # the serialized prompt KV to an attacker-chosen address (SSRF
+        # + KV exfiltration) whenever no decode pool exists.
+        environ.pop("HTTP_X_KF_DECODE_PEER", None)
+        want_role = ("prefill"
+                     if (environ["REQUEST_METHOD"] == "POST"
+                         and ":generate" in path) else None)
+        peer_addr = None
         with trace.get_tracer().start_span("gateway.backend_pick",
                                            span) as psp:
             try:
                 backend = backend_for_route(self.server, route, path,
-                                            self.ejections)
+                                            self.ejections,
+                                            role=want_role,
+                                            collector=self.collector)
             except NoBackend as e:
                 psp.add_event("activate", reason=str(e))
                 backend = self._activate(route, path)
@@ -833,10 +922,27 @@ class Gateway:
                                     ("Retry-After", "1")])
                     return [f"no backend: {e}\n".encode()]
             psp.set_attribute("backend", f"{backend.host}:{backend.port}")
+            if backend.role is not None:
+                psp.set_attribute("role", backend.role)
+            if want_role == "prefill" and backend.role == "prefill":
+                try:
+                    peer = backend_for_route(self.server, route, path,
+                                             self.ejections,
+                                             role="decode",
+                                             collector=self.collector)
+                except NoBackend:
+                    peer = None
+                if peer is not None and peer.role == "decode":
+                    environ["HTTP_X_KF_DECODE_PEER"] = \
+                        f"{peer.host}:{peer.port}"
+                    peer_addr = (peer.host, peer.port)
+                    psp.set_attribute("decode_peer",
+                                      f"{peer.host}:{peer.port}")
         if self.collector is None:
             try:
                 result = self._proxy(backend, environ, start_response,
-                                     route, None, span, request_id)
+                                     route, None, span, request_id,
+                                     role=want_role)
             except BaseException:
                 span.set_attribute("error", True)
                 span.end()
@@ -850,19 +956,27 @@ class Gateway:
         key = _scale_key(route)
         addr_ref = [(backend.host, backend.port)]
         self.collector.inc_backend(addr_ref[0])
+        if peer_addr is not None:
+            # the decode peer works for this request's whole lifetime
+            # even though its bytes never transit the gateway
+            self.collector.inc_backend(peer_addr)
         if key is not None:
             self.collector.inc(key)
         try:
             result = self._proxy(backend, environ, start_response, route,
-                                 addr_ref, span, request_id)
+                                 addr_ref, span, request_id,
+                                 role=want_role)
         except BaseException:
             if key is not None:
                 self.collector.dec(key)
             self.collector.dec_backend(addr_ref[0])
+            if peer_addr is not None:
+                self.collector.dec_backend(peer_addr)
             span.set_attribute("error", True)
             span.end()
             raise
-        return _span_stream(_counted(result, self.collector, key, addr_ref),
+        return _span_stream(_counted(result, self.collector, key, addr_ref,
+                                     peer_addr),
                             span)
 
     def _activate(self, route: Route, path: str):
@@ -944,7 +1058,8 @@ class Gateway:
 
     def _proxy(self, backend: Backend, environ, start_response,
                route: Route | None = None, addr_ref: list | None = None,
-               span=None, request_id: str | None = None):
+               span=None, request_id: str | None = None,
+               role: str | None = None):
         if span is None:
             span = trace.NULL_SPAN
         method = environ["REQUEST_METHOD"]
@@ -1011,10 +1126,14 @@ class Gateway:
                 with trace.get_tracer().start_span("gateway.sibling_retry",
                                                    span) as rsp:
                     try:
+                        # per-role sibling: a shed prefill backend
+                        # retries on another prefill pod, never on a
+                        # decode one
                         alt = backend_for_route(
                             self.server, route,
                             environ.get("PATH_INFO", "/"),
-                            self.ejections, exclude=tried)
+                            self.ejections, exclude=tried,
+                            role=role, collector=self.collector)
                     except NoBackend:
                         alt = None
                     rsp.set_attribute(

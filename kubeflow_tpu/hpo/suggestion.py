@@ -137,6 +137,16 @@ class BayesianOptimization(Suggester):
         return self.space.decode(list(cands[int(np.argmax(ei))]))
 
 
+def _reflect(v: float) -> float:
+    """Fold a real draw into [0, 1] by reflecting at the walls (the
+    adaptive-Parzen convention): boundary-adjacent kernels keep their
+    mass NEAR the wall without piling it exactly ON the wall."""
+    v = abs(v)
+    if v > 1.0:
+        v = 2.0 - v
+    return min(1.0, max(0.0, v))
+
+
 def _ncdf(z: np.ndarray) -> np.ndarray:
     return 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
 
@@ -253,7 +263,13 @@ class TPE(Suggester):
                 cands[i] = [rng.random() for _ in range(x.shape[1])]
                 continue
             ci = rng.randrange(len(good))
-            cands[i] = [min(1.0, max(0.0, rng.gauss(c, bw_g[ci, j])))
+            # REFLECT out-of-range draws at the unit-cube walls instead
+            # of clamping: clamping turns every below-0/above-1 Gaussian
+            # draw into an atom EXACTLY at the boundary, and two trials
+            # whose draws both fall outside then decode to byte-identical
+            # boundary assignments (observed: duplicate lr == min under
+            # the controller's distinct-assignments contract)
+            cands[i] = [_reflect(rng.gauss(c, bw_g[ci, j]))
                         for j, c in enumerate(good[ci])]
         score = (self._log_density(cands, good, bw_g)
                  - self._log_density(cands, bad, bw_b))

@@ -1,0 +1,529 @@
+"""Disaggregated prefill/decode serving: split worker pools + KV handoff.
+
+DistServe/Splitwise-style phase disaggregation (Zhong et al. OSDI'24;
+Patel et al. ISCA'24) on top of the paged KV pool: PREFILL workers admit
+prompts through the existing prefix-cache / chunked-prefill path and
+publish the finished prompt KV as refcounted pool pages; DECODE workers
+seed a resident slot view from those pages (the exact seed-from-pages
+dispatch a prefix-cache hit already uses) and own the continuous-batching
+decode loop, speculative verify included.  The win: a burst of long cold
+prompts no longer stalls in-flight decode cadence — prefill FLOPs and
+decode FLOPs stop competing for the same chips — and the two pools scale
+independently (per-role autoscaling signals: prefill scales on queued
+prompts, decode on occupied slots).
+
+The HANDOFF is a plain data object (:class:`HandoffState`): page ids into
+the shared pool plus exactly the sampling state a decode worker needs to
+resume token-identically — last token, position (implied by ids+generated),
+and the per-request PRNG chain.  It rides the request across the pool
+boundary (never a thread-local — kfvet's ``handoff-threadlocal`` pass
+enforces this), and it owns one pool reference per page from commit until
+the decode seed (or the request's death) releases it, so eviction and
+cancel storms cannot free pages mid-handoff.
+
+Deployment shapes:
+- SAME PROCESS (tests, the single-binary platform): a
+  :class:`DisaggCoordinator` runs both pools over one shared
+  :class:`~kubeflow_tpu.serving.page_pool.PagePool`; the handoff is an
+  incref + queue append.
+- SEPARATE PROCESSES (production): each pool is its own InferenceService
+  annotated ``serving.kubeflow.org/role`` (controller -> ``--role``
+  predictor flag + pod label); the gateway routes prompts to the
+  least-loaded prefill backend and stamps the decode target (picked by
+  decode-slot availability) as ``X-KF-Decode-Peer``; the prefill
+  predictor forwards the serialized handoff (``serialize_handoff``) to
+  the decode peer's ``:resume`` endpoint and relays the stream.  A
+  prefill worker with no reachable decode peer resumes the handoff on
+  its OWN engine (colocated fallback) so availability degrades to the
+  old behavior, never to an error.
+
+Failure matrix (ARCHITECTURE.md decision 19 holds the full table): a
+request cancelled or deadline-expired mid-handoff releases its page refs
+wherever it dies; a decode worker that shuts down or crashes mid-stream
+offers its requests back to the coordinator (``failover_fn``), which
+re-runs them COLD on a surviving prefill worker — same seed, same PRNG
+chain, token-identical output; cross-process, a dead decode pod's 5xx
+maps to the gateway's per-role sibling retry.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.serving.page_pool import PagePool
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+log = get_logger("serving.disagg")
+
+FAILOVERS = REGISTRY.counter(
+    "serving_decode_failovers_total",
+    "handoff requests re-run cold after a decode worker died mid-stream")
+
+
+@dataclass
+class HandoffState:
+    """Everything a decode worker needs to resume a prefilled request
+    token-identically.  Owns ONE pool reference per page id from commit
+    until released (seed completed, or the request died)."""
+
+    ids: list[int]
+    generated: list[int]            # [first_token] at handoff time
+    max_new_tokens: int
+    temperature: float
+    eos_id: int | None
+    seed: int
+    top_k: int
+    top_p: float
+    pages: list[int]                # pool page ids covering the prompt
+    key_chain: list[int]            # per-request PRNG chain state (2xu32)
+    deadline: float | None = None   # absolute perf_counter deadline
+    committed_at: float | None = None
+    request: object = None          # in-process: the live GenRequest
+    released: bool = False          # page refs dropped (idempotence guard)
+    meta: dict = field(default_factory=dict)
+
+
+def release_handoff(pool: PagePool, state: HandoffState) -> None:
+    """Drop the handoff's page references exactly once."""
+    if state is not None and not state.released:
+        state.released = True
+        pool.decref(list(state.pages))
+
+
+# -- cross-process wire format ------------------------------------------------
+
+def serialize_handoff(state: HandoffState, pool: PagePool) -> dict:
+    """JSON-safe handoff: sampling state + the page payloads (per-layer
+    arrays as base64, dtype-tagged so int8-quantized pages ride the same
+    shape).  The absolute deadline becomes REMAINING seconds — perf
+    counters do not cross process boundaries."""
+    import numpy as np
+
+    pages = []
+    for pid in state.pages:
+        tree = pool.get(pid)
+        layers = []
+        for layer in tree["layers"]:
+            enc = {}
+            for name, arr in layer.items():
+                host = np.asarray(arr)
+                enc[name] = {
+                    "dtype": str(host.dtype),
+                    "shape": list(host.shape),
+                    "data": base64.b64encode(host.tobytes()).decode(),
+                }
+            layers.append(enc)
+        pages.append(layers)
+    remaining = None
+    if state.deadline is not None:
+        remaining = max(0.1, state.deadline - time.perf_counter())
+    return {
+        "ids": state.ids, "generated": state.generated,
+        "max_new_tokens": state.max_new_tokens,
+        "temperature": state.temperature, "eos_id": state.eos_id,
+        "seed": state.seed, "top_k": state.top_k, "top_p": state.top_p,
+        "key_chain": state.key_chain, "deadline_remaining_s": remaining,
+        "pages": pages,
+    }
+
+
+def _decode_array(enc: dict):
+    import ml_dtypes  # noqa: F401 - registers bfloat16 with numpy
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    host = np.frombuffer(base64.b64decode(enc["data"]),
+                         dtype=np.dtype(enc["dtype"]))
+    return jnp.asarray(host.reshape(enc["shape"]))
+
+
+def _validate_resume(body: dict, engine) -> tuple[list, dict]:
+    """Shape-check a ``:resume`` body against the decode engine's model
+    BEFORE any pool allocation: a malformed handoff must answer 422 at
+    the HTTP layer, never raise inside the batcher thread (where an
+    exception fails every in-flight stream as an engine crash) — and
+    never leak pages allocated before a late field error.  Returns the
+    fully parsed page trees plus every scalar HandoffState field."""
+    from kubeflow_tpu.serving.page_pool import pages_for
+
+    cfg = engine.cfg
+    ids = body.get("ids")
+    generated = body.get("generated")
+    if not ids or not isinstance(ids, list):
+        raise ValueError("resume body needs a non-empty 'ids' prompt")
+    if not isinstance(generated, list) or len(generated) != 1:
+        # exactly the prefill-sampled first token: handoff pages cover
+        # PROMPT positions only, so any extra "already generated" tokens
+        # would make decode attend to garbage KV — silently wrong output
+        # instead of a 422
+        raise ValueError("resume body needs 'generated' = exactly the "
+                         "one prefill-sampled first token")
+    key_chain = body.get("key_chain")
+    if (not isinstance(key_chain, list) or len(key_chain) != 2):
+        raise ValueError("key_chain must be the 2-word PRNG chain state")
+    try:
+        # EVERY scalar the HandoffState needs parses here, before any
+        # allocation — a missing/garbage field after alloc would leak
+        # the pages
+        eos_raw = body.get("eos_id")
+        fields = dict(
+            ids=list(ids), generated=list(generated),
+            max_new_tokens=int(body["max_new_tokens"]),
+            temperature=float(body["temperature"]),
+            eos_id=None if eos_raw is None else int(eos_raw),
+            seed=int(body.get("seed", 0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 0.0)),
+            key_chain=[int(x) for x in key_chain])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"bad resume field: {e}")
+    if len(ids) + fields["max_new_tokens"] > engine.max_seq:
+        raise ValueError(
+            f"prompt+new ({len(ids) + fields['max_new_tokens']}) > "
+            f"max_seq {engine.max_seq}")
+    pages = body.get("pages") or []
+    needed = pages_for(len(ids), engine.page_size)
+    if len(pages) != needed:
+        raise ValueError(
+            f"{needed} pages needed to cover {len(ids)} prompt tokens at "
+            f"page_size {engine.page_size}, got {len(pages)}")
+    want_keys = ({"k", "ks", "v", "vs"} if engine.kv_quant
+                 else {"k", "v"})
+    kv_shape = (engine.page_size, cfg.num_kv_heads, cfg.head_dim)
+    scale_shape = (1, cfg.num_kv_heads, 1)
+    trees = []
+    for layers in pages:
+        if len(layers) != cfg.num_layers:
+            raise ValueError(
+                f"page has {len(layers)} layers, model has "
+                f"{cfg.num_layers}")
+        tree = {"layers": []}
+        for layer in layers:
+            if set(layer) != want_keys:
+                raise ValueError(
+                    f"page layer keys {sorted(layer)} != expected "
+                    f"{sorted(want_keys)} (kv_quant={engine.kv_quant})")
+            parsed = {}
+            for name, enc in layer.items():
+                try:
+                    arr = _decode_array(enc)
+                except Exception as e:
+                    raise ValueError(f"bad page array {name!r}: {e}")
+                want = scale_shape if name in ("ks", "vs") else kv_shape
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"page array {name!r} shape {tuple(arr.shape)} "
+                        f"!= expected {want}")
+                parsed[name] = arr
+            tree["layers"].append(parsed)
+        trees.append(tree)
+    return trees, fields
+
+
+def deserialize_handoff(body: dict, engine) -> HandoffState:
+    """Materialize a serialized handoff into ``engine``'s page pool and
+    return a resumable :class:`HandoffState` (request=None — the decode
+    engine mints its own GenRequest).  The body is fully parsed and
+    shape-checked BEFORE pages are allocated, so a malformed payload
+    (ValueError -> 422) can neither leak pool pages nor reach the
+    batcher thread.  Raises the engine's ``QueueFull`` when the pool
+    cannot host the pages (429 + Retry-After upstream: shed semantics,
+    so the gateway retries a decode sibling)."""
+    from kubeflow_tpu.serving.engine import QueueFull
+
+    trees, fields = _validate_resume(body, engine)
+    deadline = None
+    if body.get("deadline_remaining_s") is not None:
+        try:
+            deadline = (time.perf_counter()
+                        + float(body["deadline_remaining_s"]))
+        except (TypeError, ValueError):
+            raise ValueError("deadline_remaining_s must be a number")
+    n = len(trees)
+    pids = engine.pool.alloc(n)
+    while pids is None:
+        if engine.prefix_cache is None or not engine.prefix_cache.evict_lru():
+            raise QueueFull(
+                f"decode worker kv pool cannot host {n} handoff pages",
+                retry_after=1.0)
+        pids = engine.pool.alloc(n)
+    for pid, tree in zip(pids, trees):
+        engine.pool.put(pid, tree)
+    return HandoffState(pages=pids, deadline=deadline,
+                        committed_at=time.perf_counter(), **fields)
+
+
+def resume_serialized(engine, body: dict, trace_ctx=None) -> list[int]:
+    """Decode-role predictor's ``:resume`` entry: pool-load the pages,
+    seed a slot, decode to completion.  Returns the full token stream."""
+    state = deserialize_handoff(body, engine)
+    try:
+        req = engine.submit_handoff(state, trace_ctx=trace_ctx)
+    except BaseException:
+        release_handoff(engine.pool, state)
+        raise
+    return req.result(timeout=600)
+
+
+def http_post_json(addr: str, path: str, payload: dict,
+                   timeout: float = 300.0) -> dict:
+    """Default handoff transport: POST ``payload`` to ``addr`` and parse
+    the JSON response; non-2xx raises with the body as the message."""
+    import http.client
+    import json
+
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port or 80), timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+        if not 200 <= resp.status < 300:
+            raise RuntimeError(
+                f"decode peer {addr} answered {resp.status}: "
+                f"{raw[:200].decode(errors='replace')}")
+        return json.loads(raw)
+    finally:
+        conn.close()
+
+
+def forward_handoff(state: HandoffState, pool: PagePool, peer: str,
+                    model: str, post_fn=None, trace_ctx=None) -> list[int]:
+    """Prefill-side forward: serialize, POST to the decode peer's
+    ``:resume``, return the completed stream.  The local page refs are
+    released only on SUCCESS — a failed POST leaves the state resumable,
+    so the caller can fall back to its own engine (``submit_handoff``)
+    instead of erroring a request both pools could still serve."""
+    payload = serialize_handoff(state, pool)
+    if trace_ctx is not None:
+        payload["traceparent"] = trace_ctx.to_traceparent()
+    post = post_fn or http_post_json
+    out = post(peer, f"/v1/models/{model}:resume", payload)
+    # parse BEFORE releasing: a 2xx with a malformed body (version skew
+    # mid-rollout) must leave the state resumable, or the local fallback
+    # would seed from already-freed pages
+    full = list(out["ids"])
+    release_handoff(pool, state)
+    return full
+
+
+def complete_forwarded(req, full_ids: list[int]) -> None:
+    """Terminal bookkeeping for a request whose decode ran on a remote
+    peer: install the stream, close the spans, wake the waiter."""
+    from kubeflow_tpu.serving.engine import REQS_TOTAL
+
+    req.generated = list(full_ids[len(req.ids):])
+    req.outcome = "ok"
+    REQS_TOTAL.labels("ok").inc()
+    req.handoff_span.end()
+    req.span.set_attribute("outcome", "ok")
+    req.span.end()
+    req._done.set()
+
+
+def fail_forwarded(req, msg: str) -> None:
+    from kubeflow_tpu.serving.engine import REQS_TOTAL
+
+    req.error = msg
+    req.outcome = "error"
+    REQS_TOTAL.labels("error").inc()
+    req.handoff_span.end()
+    req.span.set_attribute("outcome", "error")
+    req.span.end()
+    req._done.set()
+
+
+class DisaggCoordinator:
+    """Run prefill-role and decode-role engine pools over one shared page
+    pool (the in-process deployment shape; production splits the pools
+    into separate predictor processes behind the role-aware gateway).
+
+    Routing: ``submit`` dispatches the prompt to the least-loaded prefill
+    worker; the handoff target is the decode worker with the most free
+    slots (decode-slot availability).  Shed semantics stay per-role: the
+    prefill pool's ``max_queue`` bounds prompt admission, and a draining
+    decode worker simply stops receiving handoffs.
+    """
+
+    def __init__(self, module, params, cfg, *, prefill_workers: int = 1,
+                 decode_workers: int = 1, max_batch: int = 4,
+                 max_seq: int = 512, prefill_chunk: int = 512,
+                 prefix_cache_bytes: int = 0, max_queue: int = 0,
+                 page_size: int = 16, kv_pages: int = 0,
+                 speculative_tokens: int = 0, kv_quant: bool = False,
+                 draft_fn=None, mesh=None):
+        from kubeflow_tpu.models import llama as llama_mod
+        from kubeflow_tpu.serving.engine import ContinuousBatcher
+        from kubeflow_tpu.serving.page_pool import pages_for
+        from kubeflow_tpu.serving.prefix_cache import PrefixCache
+
+        max_seq = min(max_seq, cfg.max_seq_len)
+        if max_seq % page_size:
+            # a full-prompt handoff commits every page, tail included; a
+            # non-dividing page size would clamp the tail slice and hand
+            # the decode worker silently shifted KV
+            raise ValueError(
+                f"disaggregation needs page_size ({page_size}) to divide "
+                f"max_seq ({max_seq})")
+        if kv_quant:
+            from kubeflow_tpu.serving.quant import kv_page_nbytes_int8
+
+            page_nbytes = kv_page_nbytes_int8(cfg, page_size)
+        else:
+            page_nbytes = llama_mod.kv_page_nbytes(cfg, page_size)
+        cache_pages = 0
+        if prefix_cache_bytes > 0:
+            cache_pages = max(1, prefix_cache_bytes // page_nbytes)
+        pages_per_seq = pages_for(max_seq, page_size)
+        if kv_pages <= 0:
+            # headroom: every slot's prompt pages in BOTH pools, plus one
+            # extra decode-pool share for handoffs queued between commit
+            # and seed
+            kv_pages = (1 + cache_pages
+                        + (prefill_workers + 2 * decode_workers)
+                        * max_batch * pages_per_seq)
+        self.pool = PagePool(kv_pages, page_size, page_nbytes)
+        self.prefix_cache = (PrefixCache(self.pool, cache_pages)
+                             if cache_pages else None)
+        common = dict(max_batch=max_batch, max_seq=max_seq, mesh=mesh,
+                      prefill_chunk=prefill_chunk, page_size=page_size,
+                      pool=self.pool, kv_quant=kv_quant)
+        self.prefill = [
+            ContinuousBatcher(module, params, cfg, role="prefill",
+                              handoff_fn=self._handoff,
+                              prefix_cache=self.prefix_cache,
+                              max_queue=max_queue, **common)
+            for _ in range(prefill_workers)]
+        self.decode = [
+            ContinuousBatcher(module, params, cfg, role="decode",
+                              failover_fn=self._failover,
+                              speculative_tokens=speculative_tokens,
+                              draft_fn=draft_fn, **common)
+            for _ in range(decode_workers)]
+        self.log = log
+
+    # -- routing ---------------------------------------------------------------
+    def _least_loaded_prefill(self):
+        def load(eng):
+            with eng._work:
+                return len(eng.queue) + eng._prefilling
+        return min(self.prefill, key=load)
+
+    def _pick_decode(self):
+        """Most free decode slots wins (handoff target by decode-slot
+        availability); queued handoffs count against a worker so a burst
+        spreads instead of piling on one pool member.  A HEALTHY worker
+        with zero free slots still wins over the colocated fallback —
+        its queue drains as streams finish; only closed/draining workers
+        are out of the running entirely."""
+        best, best_free = None, None
+        for eng in self.decode:
+            with eng._work:
+                if eng._closed or eng._draining:
+                    continue
+                free = (sum(1 for s in eng.slots if s is None)
+                        - len(eng.queue))
+            if best is None or free > best_free:
+                best, best_free = eng, free
+        return best
+
+    def submit(self, ids: list[int], **kw):
+        """Admit a prompt into the prefill pool; the returned GenRequest
+        completes when the decode pool finishes the stream."""
+        return self._least_loaded_prefill().submit(ids, **kw)
+
+    def generate_sync(self, batch, max_new_tokens: int = 32,
+                      temperature: float = 0.0, eos_id=None, seed=None,
+                      top_k: int = 0, top_p: float = 0.0,
+                      deadline_s=None) -> list[list[int]]:
+        reqs = []
+        try:
+            for i, ids in enumerate(batch):
+                reqs.append(self.submit(
+                    ids, max_new_tokens=max_new_tokens,
+                    temperature=temperature, eos_id=eos_id,
+                    seed=None if seed is None else seed + i,
+                    top_k=top_k, top_p=top_p, deadline_s=deadline_s))
+            return [r.result(timeout=600) for r in reqs]
+        except BaseException:
+            for r in reqs:
+                r.cancel("sibling row failed")
+            raise
+
+    # -- the handoff hop -------------------------------------------------------
+    def _handoff(self, req, state: HandoffState) -> None:
+        target = self._pick_decode()
+        if target is None:
+            # every decode worker draining/closed: resume on the prefill
+            # engine itself (colocated fallback — availability over
+            # purity; the autoscaler sees the load and fixes the pool)
+            req._engine.submit_handoff(state)
+            return
+        target.submit_handoff(state)
+
+    def _failover(self, req) -> bool:
+        """A decode worker died with ``req`` mid-stream: re-run it COLD on
+        a surviving prefill worker (same seed -> token-identical).  False
+        tells the dying engine to fail the request normally."""
+        if req._cancel_requested or req.expired():
+            return False
+        req._failovers = getattr(req, "_failovers", 0) + 1
+        if req._failovers > 1:
+            return False
+        if req._handoff is not None:
+            release_handoff(self.pool, req._handoff)
+            req._handoff = None
+        req.handoff_span.end()
+        req.decode_span.end()
+        req.generated = []
+        for eng in self.prefill:
+            if eng.adopt(req):
+                FAILOVERS.inc()
+                req.span.add_event("decode_failover")
+                self.log.warning("decode worker died; re-running cold",
+                                 prompt_tokens=len(req.ids))
+                return True
+        return False
+
+    # -- lifecycle / introspection ---------------------------------------------
+    def _engines(self):
+        return list(self.prefill) + list(self.decode)
+
+    def drain(self) -> None:
+        for eng in self._engines():
+            eng.drain()
+
+    def drained(self, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        ok = True
+        for eng in self._engines():
+            ok &= eng.drained(max(0.0, deadline - time.monotonic()))
+        return ok
+
+    def shutdown(self) -> None:
+        # prefill first: no new handoffs while the decode pool finishes
+        for eng in self._engines():
+            eng.shutdown()
+
+    def restart(self) -> None:
+        for eng in self._engines():
+            eng.restart()
+
+    def stats(self) -> dict:
+        pool = self.pool.stats()
+        cache_pages = (self.prefix_cache.stats()["pages"]
+                       if self.prefix_cache is not None else 0)
+        pool["orphan_pages"] = pool["in_use"] - cache_pages
+        out = {
+            "kv_pool": pool,
+            "prefill": [e.stats() for e in self.prefill],
+            "decode": [e.stats() for e in self.decode],
+        }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out

@@ -107,6 +107,14 @@ ADMISSION_WAIT = REGISTRY.histogram(
     "queue wait from submit() to slot admission",
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5, 5.0, 10.0, 30.0))
+HANDOFFS = REGISTRY.counter(
+    "serving_prefill_handoffs_total",
+    "prefilled requests handed off to a decode worker (disaggregation)")
+HANDOFF_WAIT = REGISTRY.histogram(
+    "serving_handoff_wait_seconds",
+    "prefill-commit to decode-seed latency of a disaggregated handoff",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0))
 DRAINING_GAUGE = REGISTRY.gauge(
     "serving_draining",
     "engines currently draining (in-flight finish, new submits rejected)")
@@ -171,6 +179,11 @@ class GenRequest:
     span: object = field(default=NULL_SPAN, repr=False)        # engine.request
     wait_span: object = field(default=NULL_SPAN, repr=False)   # admission wait
     decode_span: object = field(default=NULL_SPAN, repr=False)
+    handoff_span: object = field(default=NULL_SPAN, repr=False)
+    # disaggregation: a pending HandoffState rides the request across the
+    # prefill->decode worker-pool boundary (page refs + sampling state);
+    # cleared (and its page refs dropped) at decode seed or terminal exit
+    _handoff: object = field(default=None, repr=False)
 
     def expired(self, now: float | None = None) -> bool:
         return (self.deadline is not None
@@ -209,9 +222,26 @@ class ContinuousBatcher:
                  prefix_cache_bytes: int = 0, prefill_chunk: int = 512,
                  max_queue: int = 0, page_size: int = 16,
                  kv_pages: int = 0, speculative_tokens: int = 0,
-                 draft_fn=None):
+                 draft_fn=None, role: str = "colocated", handoff_fn=None,
+                 failover_fn=None, pool=None, prefix_cache=None,
+                 kv_quant: bool = False):
         from kubeflow_tpu.models import llama as llama_mod
 
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r}")
+        if role == "prefill" and handoff_fn is None:
+            raise ValueError("a prefill-role engine needs a handoff_fn "
+                             "(who receives the finished prompt KV?)")
+        # disaggregation roles (serving/disagg.py): "prefill" admits
+        # prompts, commits their KV to pool pages, and hands off instead
+        # of seating a decode slot; "decode" seeds slots from handoff
+        # pages and owns the decode loop; "colocated" is the classic
+        # single-engine shape.  failover_fn (decode role) is offered each
+        # request dying with the engine (shutdown/crash) — returning True
+        # transfers ownership (the coordinator re-runs it cold).
+        self.role = role
+        self.handoff_fn = handoff_fn
+        self.failover_fn = failover_fn
         self.module = module
         self.params = params
         self.cfg = cfg
@@ -225,8 +255,25 @@ class ContinuousBatcher:
         # never be committed (max_seq // page_size == 0 would silently
         # disable the prefix cache the operator asked for)
         self.page_size = max(1, min(int(page_size), self.max_seq))
+        if role == "prefill" and self.max_seq % self.page_size:
+            # a handoff commits EVERY prompt page, tail included; a
+            # non-dividing page size would clamp the tail slice and hand
+            # the decode worker silently shifted KV
+            raise ValueError(
+                f"prefill role needs page_size ({self.page_size}) to "
+                f"divide max_seq ({self.max_seq})")
         self.pages_per_seq = pages_for(self.max_seq, self.page_size)
-        self.page_nbytes = llama_mod.kv_page_nbytes(cfg, self.page_size)
+        # kv_quant: pages hold int8 KV + per-head scales (quantized at
+        # prefill-commit, dequantized at decode seed) — ~2x the effective
+        # page capacity for the same HBM budget, perplexity-neutral but
+        # NOT bit-identical (opt-in via the kv-quant annotation)
+        self.kv_quant = bool(kv_quant)
+        if self.kv_quant:
+            from kubeflow_tpu.serving.quant import kv_page_nbytes_int8
+
+            self.page_nbytes = kv_page_nbytes_int8(cfg, self.page_size)
+        else:
+            self.page_nbytes = llama_mod.kv_page_nbytes(cfg, self.page_size)
         # speculative decoding: max draft tokens per verify round (0 =
         # plain chunked-scan decode); the drafter defaults to n-gram
         # prompt lookup and accepts any (tokens, max) -> list[int] seam
@@ -243,17 +290,34 @@ class ContinuousBatcher:
         cache_pages = 0
         if prefix_cache_bytes > 0:
             cache_pages = max(1, prefix_cache_bytes // self.page_nbytes)
-        if kv_pages <= 0:
-            # the page budget: the prefix-cache allowance plus headroom
-            # for every slot's in-flight prompt pages (they are shared
-            # with — or become — cache entries, so this is an upper bound)
-            kv_pages = 1 + cache_pages + max_batch * self.pages_per_seq
-        self.pool = PagePool(kv_pages, self.page_size, self.page_nbytes)
-        self.prefix_cache = None
-        if cache_pages:
+        # an INJECTED pool (or cache) is shared with sibling engines:
+        # this engine alone cannot tell an orphan from a sibling's cache
+        # entry or an in-flight handoff, so the leak accounting moves up
+        # to whoever owns the pool (the coordinator's stats())
+        self._pool_shared = pool is not None or prefix_cache is not None
+        if pool is None:
+            if kv_pages <= 0:
+                # the page budget: the prefix-cache allowance plus
+                # headroom for every slot's in-flight prompt pages (they
+                # are shared with — or become — cache entries, so this is
+                # an upper bound)
+                kv_pages = 1 + cache_pages + max_batch * self.pages_per_seq
+            pool = PagePool(kv_pages, self.page_size, self.page_nbytes)
+        elif pool.page_size != self.page_size:
+            # a shared pool (disaggregation: prefill fills, decode seeds)
+            # must agree on the sharing granularity
+            raise ValueError(
+                f"shared pool page_size {pool.page_size} != engine "
+                f"page_size {self.page_size}")
+        self.pool = pool
+        if prefix_cache is not None:
+            self.prefix_cache = prefix_cache
+        elif cache_pages:
             from kubeflow_tpu.serving.prefix_cache import PrefixCache
 
             self.prefix_cache = PrefixCache(self.pool, cache_pages)
+        else:
+            self.prefix_cache = None
         self.mesh = mesh  # tp>1: params arrive pre-sharded (serving/
         # sharded.py); the KV view shards heads over tp here and XLA
         # propagates both through prefill/decode
@@ -313,6 +377,12 @@ class ContinuousBatcher:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_rounds = 0
+        # requests currently mid-prefill on a prefill-role engine: they
+        # occupy no slot and have left the queue, but they ARE load — the
+        # autoscaler's per-role concurrency signal and drained() both
+        # count them
+        self._prefilling = 0
+        self._handoffs = 0   # instance-scoped handoff tally for stats()
         self._thread: threading.Thread | None = None
         self._decode_cache: dict[tuple[int, bool], object] = {}
         self._verify_cache: dict[tuple[int, bool], object] = {}
@@ -414,16 +484,74 @@ class ContinuousBatcher:
             req.seed = seed
             if deadline_s is not None:
                 req.deadline = req.submitted_at + deadline_s
-            req._engine = self
-            self.queue.append(req)
-            QUEUE_DEPTH.set(len(self.queue))
-            if self._thread is None or not self._thread.is_alive():
-                self._stop = False
-                self._thread = threading.Thread(target=self._loop,
-                                                daemon=True,
-                                                name="serving-batcher")
-                self._thread.start()
-            self._work.notify_all()
+            self._enqueue_locked(req)
+
+    def _enqueue_locked(self, req: GenRequest) -> None:
+        """The one enqueue tail (lock held): ownership, queue append,
+        depth gauge, batcher (re)spawn, wakeup.  Every entry point —
+        submit, handoff resume, failover adoption — funnels through here
+        so the invariants cannot drift between copies."""
+        req._engine = self
+        self.queue.append(req)
+        QUEUE_DEPTH.set(len(self.queue))
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="serving-batcher")
+            self._thread.start()
+        self._work.notify_all()
+
+    def submit_handoff(self, state, trace_ctx=None) -> GenRequest:
+        """Resume a prefilled request from its handoff pages: the decode
+        half of disaggregation (serving/disagg.py).  In-process the
+        coordinator passes the ORIGINAL GenRequest on the state; a
+        cross-process resume (``:resume``) passes ``request=None`` and a
+        fresh request is minted here.  The state's page references are
+        released at seed (or at the request's death) — never leaked.
+
+        Draining only rejects NEW work (a cross-process resume): an
+        in-process handoff continues a request that was admitted before
+        the drain began, and drain's contract is that in-flight requests
+        finish."""
+        req = state.request
+        preadmitted = req is not None
+        if req is None:
+            req = GenRequest(list(state.ids), state.max_new_tokens,
+                             state.temperature, state.eos_id,
+                             seed=state.seed, top_k=state.top_k,
+                             top_p=state.top_p)
+            req.generated = list(state.generated)
+            req.deadline = state.deadline
+            state.request = req
+            self._start_trace(req, trace_ctx)
+            req.wait_span.end()
+        if self.spec_max and req._spec is None:
+            from kubeflow_tpu.serving.speculative import SpeculationState
+
+            req._spec = SpeculationState(self.spec_max)
+        req._handoff = state
+        with self._work:
+            if self._closed:
+                raise RuntimeError(
+                    "serving engine is shut down (call restart() to serve "
+                    "again)")
+            if self._draining and not preadmitted:
+                raise Draining(
+                    "serving engine is draining (finishing in-flight "
+                    "requests, accepting no new ones)")
+            self._enqueue_locked(req)
+        return req
+
+    def adopt(self, req: GenRequest) -> bool:
+        """Take over a live request from a dying sibling engine (the
+        coordinator's decode-failover path): enqueue it as-is for a cold
+        re-run.  False when this engine cannot accept work."""
+        with self._work:
+            if self._closed or self._draining:
+                return False
+            self._enqueue_locked(req)
+        return True
 
     def generate_sync(self, batch: list[list[int]], max_new_tokens: int = 32,
                       temperature: float = 0.0, eos_id: int | None = None,
@@ -458,23 +586,37 @@ class ContinuousBatcher:
             live_tokens = sum(len(s.ids) + len(s.generated)
                               for s in self.slots if s is not None)
             out = {
-                "active": sum(1 for s in self.slots if s is not None),
+                # a prefill-role engine's mid-prefill requests occupy no
+                # slot but are load — the per-role autoscaling signal
+                # (prefill scales on queued+prefilling, decode on slots)
+                "active": (sum(1 for s in self.slots if s is not None)
+                           + self._prefilling),
                 "queued": len(self.queue),
                 "max_batch": self.max_batch,
             }
+            if self.role != "colocated":
+                out["role"] = self.role
+                out["handoffs"] = self._handoffs
             if self.max_queue:
                 out["max_queue"] = self.max_queue
             if self._draining:
                 out["draining"] = True
         pool = self.pool.stats()
         pool["live_tokens"] = live_tokens
-        cache_pages = (self.prefix_cache.stats()["pages"]
-                       if self.prefix_cache is not None else 0)
+        if self.kv_quant:
+            pool["quantized"] = True
         # pages held by nobody but an in-flight admission window should
         # be zero whenever the engine is idle: every committed page is
         # either cache-owned or already freed (the overload loadtest
-        # asserts this leak-free invariant after every storm)
-        pool["orphan_pages"] = pool["in_use"] - cache_pages
+        # asserts this leak-free invariant after every storm).  Only an
+        # engine that OWNS its pool can make this judgment — with a
+        # shared pool, sibling engines' cache entries and in-flight
+        # handoffs would read as false orphans here; the coordinator's
+        # stats() owns the shared-pool accounting.
+        if not self._pool_shared:
+            cache_pages = (self.prefix_cache.stats()["pages"]
+                           if self.prefix_cache is not None else 0)
+            pool["orphan_pages"] = pool["in_use"] - cache_pages
         out["kv_pool"] = pool
         if self.spec_max:
             # instance-scoped (the registry counters aggregate every
@@ -520,7 +662,8 @@ class ContinuousBatcher:
         meaningful during drain but safe to call any time."""
         deadline = time.monotonic() + timeout
         with self._work:
-            while self.queue or any(s is not None for s in self.slots):
+            while (self.queue or self._prefilling
+                   or any(s is not None for s in self.slots)):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
@@ -576,15 +719,28 @@ class ContinuousBatcher:
                      self.cfg.head_dim)
             dtype = self.cfg.jnp_dtype
             span = min(n_pages * self.page_size, self.max_seq)
+            kv_quant = self.kv_quant
 
             @jax.jit
             def fn(pages):
+                from kubeflow_tpu.serving.quant import dequantize_kv
+
                 out = {"layers": []}
                 for li in range(self.cfg.num_layers):
-                    k = jnp.concatenate([p["layers"][li]["k"]
-                                         for p in pages])[None, :span]
-                    v = jnp.concatenate([p["layers"][li]["v"]
-                                         for p in pages])[None, :span]
+                    if kv_quant:
+                        # int8 pages dequantize INSIDE the seed dispatch
+                        # (fused — no extra tunnel round trips)
+                        ks = [dequantize_kv(p["layers"][li]["k"],
+                                            p["layers"][li]["ks"], dtype)
+                              for p in pages]
+                        vs = [dequantize_kv(p["layers"][li]["v"],
+                                            p["layers"][li]["vs"], dtype)
+                              for p in pages]
+                    else:
+                        ks = [p["layers"][li]["k"] for p in pages]
+                        vs = [p["layers"][li]["v"] for p in pages]
+                    k = jnp.concatenate(ks)[None, :span]
+                    v = jnp.concatenate(vs)[None, :span]
                     out["layers"].append({
                         "k": jax.lax.dynamic_update_slice(
                             jnp.zeros(shape, dtype), k, (0, 0, 0, 0)),
@@ -604,22 +760,33 @@ class ContinuousBatcher:
         pages it shared)."""
         if n_pages not in self._slice_cache:
             ps = self.page_size
+            kv_quant = self.kv_quant
 
             @jax.jit
             def fn(scratch, first):
+                from kubeflow_tpu.serving.quant import quantize_kv
+
                 pages = []
                 for i in range(n_pages):
                     tree = {"layers": []}
                     for l in scratch["layers"]:
                         start = (first + i) * ps
-                        tree["layers"].append({
-                            "k": jax.lax.dynamic_slice(
-                                l["k"][0], (start, 0, 0),
-                                (ps,) + l["k"].shape[2:]),
-                            "v": jax.lax.dynamic_slice(
-                                l["v"][0], (start, 0, 0),
-                                (ps,) + l["v"].shape[2:]),
-                        })
+                        k = jax.lax.dynamic_slice(
+                            l["k"][0], (start, 0, 0),
+                            (ps,) + l["k"].shape[2:])
+                        v = jax.lax.dynamic_slice(
+                            l["v"][0], (start, 0, 0),
+                            (ps,) + l["v"].shape[2:])
+                        if kv_quant:
+                            # quantize AT COMMIT, inside the same
+                            # dispatch that cuts the page out
+                            kq, kscale = quantize_kv(k)
+                            vq, vscale = quantize_kv(v)
+                            tree["layers"].append(
+                                {"k": kq, "ks": kscale,
+                                 "v": vq, "vs": vscale})
+                        else:
+                            tree["layers"].append({"k": k, "v": v})
                     pages.append(tree)
                 return pages
 
@@ -786,10 +953,14 @@ class ContinuousBatcher:
         req.error = msg
         req.outcome = outcome
         REQS_TOTAL.labels(outcome).inc()
+        # a pending handoff's page references die with the request — a
+        # cancel/deadline storm that lands mid-handoff must leak nothing
+        self._release_handoff(req)
         # trace epilogue: whatever was still open closes with the terminal
         # outcome on the request span (end() is idempotent, so a wait span
         # already closed at admission is untouched)
         req.wait_span.end()
+        req.handoff_span.end()
         req.decode_span.end()
         req.span.set_attribute("outcome", outcome)
         req.span.end()
@@ -848,6 +1019,24 @@ class ContinuousBatcher:
         for req, outcome in dead:
             self._fail(req, outcome, self._DEAD_MSG[outcome])
 
+    def _die_with_engine(self, dying: list[GenRequest], outcome: str,
+                         msg: str) -> None:
+        """The engine is going away (shutdown or crash) with live
+        requests: each is first OFFERED to ``failover_fn`` (the
+        coordinator re-runs it cold on a surviving worker — ownership
+        transfers); the rest fail.  Runs OUTSIDE the lock: the failover
+        takes a sibling engine's lock, and holding ours across that
+        would order locks across the pool."""
+        for req in dying:
+            if (self.failover_fn is not None
+                    and not req._cancel_requested and not req.expired()):
+                try:
+                    if self.failover_fn(req):
+                        continue
+                except Exception:
+                    self.log.error("failover_fn raised", exc_info=True)
+            self._fail(req, outcome, msg)
+
     def _loop(self) -> None:
         try:
             while True:
@@ -855,16 +1044,19 @@ class ContinuousBatcher:
                     while (not self._stop and not self.queue
                            and not any(self.slots)):
                         self._work.wait(timeout=5.0)
-                    if self._stop:
-                        # fail anything still pending so callers don't hang
-                        for req in list(self.queue) + [s for s in self.slots
-                                                       if s]:
-                            self._fail(req, "shutdown",
-                                       "serving engine shut down")
+                    stopped = self._stop
+                    if stopped:
+                        # fail (or fail over) anything still pending so
+                        # callers don't hang
+                        dying = list(self.queue) + [s for s in self.slots
+                                                    if s]
                         self.queue.clear()
                         self.slots = [None] * self.max_batch
                         self._work.notify_all()
-                        return
+                if stopped:
+                    self._die_with_engine(dying, "shutdown",
+                                          "serving engine shut down")
+                    return
                 # cancelled/expired requests leave before admission (no
                 # wasted prefill) and between decode chunks (slot freed
                 # within one chunk of the cancel/deadline)
@@ -882,80 +1074,256 @@ class ContinuousBatcher:
         except Exception:
             self.log.error("batcher loop crashed", exc_info=True)
             with self._work:
-                for req in list(self.queue) + [s for s in self.slots if s]:
-                    self._fail(req, "error", "serving engine crashed")
+                dying = list(self.queue) + [s for s in self.slots if s]
                 self.queue.clear()
                 self.slots = [None] * self.max_batch
                 self._thread = None
                 self._work.notify_all()
+            self._die_with_engine(dying, "error", "serving engine crashed")
 
     def _admit(self) -> None:
-        """Prefill queued requests into free slots (continuous admission)."""
-        while True:
+        """Admit queued requests (continuous admission).  Colocated and
+        decode roles need a free slot (prefill-into-slot or seed-from-
+        handoff-pages); a prefill-role engine's plain admissions take no
+        slot at all — they prefill, commit pages, and hand off.
+
+        FAIRNESS: at most ``max_batch`` admissions per call.  A request
+        that finishes AT admission (max_new_tokens=1, eos on the first
+        sample) frees its slot immediately, so a steady arrival stream of
+        them would otherwise keep this loop saturated forever and fully
+        STARVE the in-flight decode — the pathology the disaggregated
+        tier exists to remove, but even colocated it must degrade, not
+        halt."""
+        admitted = 0
+        while admitted < self.max_batch:
+            admitted += 1
             with self._work:
+                if not self.queue:
+                    QUEUE_DEPTH.set(0)
+                    return
+                head = self.queue[0]
+                needs_slot = not (self.role == "prefill"
+                                  and head._handoff is None)
                 free = next((i for i, s in enumerate(self.slots)
                              if s is None), None)
-                if free is None or not self.queue:
+                if needs_slot and free is None:
                     QUEUE_DEPTH.set(len(self.queue))
                     return
                 req = self.queue.pop(0)
                 QUEUE_DEPTH.set(len(self.queue))
-            outcome = self._dead_outcome(req)
-            if outcome is not None:   # died while queued; skip the prefill
-                self._fail(req, outcome, self._DEAD_MSG[outcome],
-                           notify=True)
-                continue
-            req.admitted_at = time.perf_counter()
-            ADMISSION_WAIT.observe(req.admitted_at - req.submitted_at)
-            req.wait_span.end()
-            prompt_len = len(req.ids)
-            # the request's own key chain starts at its seed
-            k_first, k_chain = jax.random.split(
-                jax.random.PRNGKey(req.seed))
-            tok, scratch = self._run_prefill(req, k_first)
-            if tok is None:
-                # bailed out mid-chunked-prefill (cancel/deadline/stop):
-                # the pin was released in _run_prefill's finally, any
-                # committed pages are cache-owned, the slot stays free
-                outcome = self._dead_outcome(req) or "cancelled"
-                self._fail(req, outcome, self._DEAD_MSG[outcome],
-                           notify=True)
-                continue
-            outcome = self._dead_outcome(req)
-            if outcome is not None:
-                # died during its own prefill: the prompt KV was still
-                # worth caching, but the request takes no slot
-                self._fail(req, outcome, self._DEAD_MSG[outcome],
-                           notify=True)
-                continue
-            self.view = self._row_set()(self.view, scratch, jnp.int32(free))
-            tok_host = int(tok)
-            req.first_token_at = time.perf_counter()
-            TTFT_LAST.set(req.first_token_at - req.submitted_at)
-            TTFT_HIST.observe(req.first_token_at - req.submitted_at)
-            # decode span opens at first token and closes at the terminal
-            # outcome (_finish_if_done / _fail) — handed off on the req
-            req.decode_span = trace.get_tracer().start_span(
-                "engine.decode", req.span)
-            req.generated.append(tok_host)
-            TOKENS_TOTAL.inc()
-            self.index = self.index.at[free].set(prompt_len)
-            self.last_token = self.last_token.at[free].set(tok_host)
-            self.temps = self.temps.at[free].set(req.temperature)
-            self.top_ks = self.top_ks.at[free].set(req.top_k)
-            self.top_ps = self.top_ps.at[free].set(req.top_p)
-            self.keys = self.keys.at[free].set(k_chain)
-            with self._work:
-                self.slots[free] = req
-                ACTIVE_SLOTS.set(sum(1 for s in self.slots if s))
-            if self._finish_if_done(free):
-                continue
+                if not needs_slot:
+                    self._prefilling += 1
+            try:
+                if req._handoff is not None:
+                    self._admit_handoff(free, req)
+                elif not needs_slot:
+                    self._admit_prefill(req)
+                else:
+                    self._admit_colocated(free, req)
+            finally:
+                if not needs_slot:
+                    with self._work:
+                        self._prefilling -= 1
+                        self._work.notify_all()
 
-    def _run_prefill(self, req: GenRequest, k_first) -> tuple:
+    def _admit_colocated(self, free: int, req: GenRequest) -> None:
+        """Classic admission: prefill the prompt and seat it in ``free``."""
+        outcome = self._dead_outcome(req)
+        if outcome is not None:   # died while queued; skip the prefill
+            self._fail(req, outcome, self._DEAD_MSG[outcome], notify=True)
+            return
+        req.admitted_at = time.perf_counter()
+        ADMISSION_WAIT.observe(req.admitted_at - req.submitted_at)
+        req.wait_span.end()
+        # the request's own key chain starts at its seed
+        k_first, k_chain = jax.random.split(jax.random.PRNGKey(req.seed))
+        tok, scratch, _ = self._run_prefill(req, k_first)
+        if tok is None:
+            # bailed out mid-chunked-prefill (cancel/deadline/stop): the
+            # pin was released in _run_prefill's finally, any committed
+            # pages are cache-owned, the slot stays free
+            outcome = self._dead_outcome(req) or "cancelled"
+            self._fail(req, outcome, self._DEAD_MSG[outcome], notify=True)
+            return
+        outcome = self._dead_outcome(req)
+        if outcome is not None:
+            # died during its own prefill: the prompt KV was still worth
+            # caching, but the request takes no slot
+            self._fail(req, outcome, self._DEAD_MSG[outcome], notify=True)
+            return
+        tok_host = int(tok)
+        req.first_token_at = time.perf_counter()
+        TTFT_LAST.set(req.first_token_at - req.submitted_at)
+        TTFT_HIST.observe(req.first_token_at - req.submitted_at)
+        req.generated.append(tok_host)
+        TOKENS_TOTAL.inc()
+        self._seat(free, req, scratch, k_chain)
+
+    def _admit_prefill(self, req: GenRequest) -> None:
+        """Prefill-role admission: run the prompt, commit its KV to pool
+        pages, and hand the request off to a decode worker.  A request
+        already complete at its first token (max_new_tokens=1, or eos on
+        the first sample) finishes here — no decode hop for work with no
+        decode left."""
+        outcome = self._dead_outcome(req)
+        if outcome is not None:
+            self._fail(req, outcome, self._DEAD_MSG[outcome], notify=True)
+            return
+        req.admitted_at = time.perf_counter()
+        ADMISSION_WAIT.observe(req.admitted_at - req.submitted_at)
+        req.wait_span.end()
+        k_first, k_chain = jax.random.split(jax.random.PRNGKey(req.seed))
+        tok, scratch, pages = self._run_prefill(req, k_first,
+                                                want_pages=True)
+        if tok is None:
+            outcome = self._dead_outcome(req) or "cancelled"
+            self._fail(req, outcome, self._DEAD_MSG[outcome], notify=True)
+            return
+        tok_host = int(tok)
+        req.first_token_at = time.perf_counter()
+        TTFT_LAST.set(req.first_token_at - req.submitted_at)
+        TTFT_HIST.observe(req.first_token_at - req.submitted_at)
+        req.generated.append(tok_host)
+        TOKENS_TOTAL.inc()
+        outcome = self._dead_outcome(req)
+        hit_eos = req.eos_id is not None and tok_host == req.eos_id
+        if outcome is not None:
+            if pages is not None:
+                self.pool.decref(pages)
+            self._fail(req, outcome, self._DEAD_MSG[outcome], notify=True)
+            return
+        if len(req.generated) >= req.max_new_tokens or hit_eos:
+            if pages is not None:
+                self.pool.decref(pages)
+            self._complete_ok(req)
+            return
+        if pages is None:
+            # the pool cannot host the handoff pages even after cache
+            # eviction: fall back to a COLOCATED decode in a local slot
+            # (availability over purity) — or shed when no slot is free
+            # either, which only happens when fallbacks already fill
+            # every slot
+            with self._work:
+                free = next((i for i, s in enumerate(self.slots)
+                             if s is None), None)
+            if free is None:
+                self._fail(req, "shed",
+                           "kv page pool exhausted and no local slot "
+                           "for colocated fallback", notify=True)
+                return
+            self._seat(free, req, scratch, k_chain)
+            return
+        from kubeflow_tpu.serving.disagg import HandoffState
+
+        state = HandoffState(
+            ids=list(req.ids), generated=list(req.generated),
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature, eos_id=req.eos_id, seed=req.seed,
+            top_k=req.top_k, top_p=req.top_p, pages=pages,
+            key_chain=[int(x) for x in jax.device_get(k_chain)],
+            deadline=req.deadline, committed_at=time.perf_counter(),
+            request=req)
+        req._handoff = state
+        req.handoff_span = trace.get_tracer().start_span(
+            "engine.prefill_handoff", req.span, pages=len(pages))
+        HANDOFFS.inc()
+        with self._work:
+            self._handoffs += 1
+        try:
+            self.handoff_fn(req, state)
+        except Exception as e:
+            self.log.warning("prefill handoff failed", error=str(e))
+            self._fail(req, "error", f"prefill handoff failed: {e}",
+                       notify=True)
+
+    def _admit_handoff(self, free: int, req: GenRequest) -> None:
+        """Decode-side admission: seed the slot's view row from the
+        handoff's pool pages (the exact seed-from-pages dispatch a
+        prefix-cache hit uses) and resume the PRNG chain where prefill
+        left it — the stream is bitwise what the colocated engine would
+        have produced."""
+        state = req._handoff
+        outcome = self._dead_outcome(req)
+        if outcome is not None:
+            # _fail releases the handoff's page refs
+            self._fail(req, outcome, self._DEAD_MSG[outcome], notify=True)
+            return
+        if req.admitted_at is None:
+            req.admitted_at = time.perf_counter()
+        n = len(state.pages)
+        bucket = next((b for b in SEED_BUCKETS if b >= n),
+                      self.pages_per_seq)
+        # pad by repeating the tail page: the overhang holds garbage the
+        # decode scatter overwrites position-by-position before any query
+        # attends to it (same argument as the prefix-hit seed)
+        page_ids = list(state.pages) + [state.pages[-1]] * (bucket - n)
+        scratch = self._seed(bucket)([self.pool.get(p) for p in page_ids])
+        if state.committed_at is not None:
+            HANDOFF_WAIT.observe(time.perf_counter() - state.committed_at)
+        k_chain = jnp.asarray(state.key_chain, jnp.uint32)
+        self._release_handoff(req)
+        req.handoff_span.end()
+        self._seat(free, req, scratch, k_chain)
+
+    def _seat(self, free: int, req: GenRequest, scratch, k_chain) -> None:
+        """Install a prefilled (or handoff-seeded) scratch as slot
+        ``free``'s view row and make the request decodable."""
+        self.view = self._row_set()(self.view, scratch, jnp.int32(free))
+        # decode span opens at seating and closes at the terminal outcome
+        # (_finish_if_done / _fail) — handed off on the req
+        req.decode_span = trace.get_tracer().start_span(
+            "engine.decode", req.span)
+        pos = len(req.ids) + len(req.generated) - 1
+        self.index = self.index.at[free].set(pos)
+        self.last_token = self.last_token.at[free].set(
+            int(req.generated[-1]))
+        self.temps = self.temps.at[free].set(req.temperature)
+        self.top_ks = self.top_ks.at[free].set(req.top_k)
+        self.top_ps = self.top_ps.at[free].set(req.top_p)
+        self.keys = self.keys.at[free].set(k_chain)
+        with self._work:
+            self.slots[free] = req
+            ACTIVE_SLOTS.set(sum(1 for s in self.slots if s))
+        self._finish_if_done(free)
+
+    def _complete_ok(self, req: GenRequest) -> None:
+        """Terminal success without a decode slot (a prefill-role request
+        done at its first token)."""
+        with self._work:
+            dur = time.perf_counter() - (req.admitted_at
+                                         or req.submitted_at)
+            self._service_ewma = (dur if self._service_ewma <= 0.0
+                                  else 0.8 * self._service_ewma
+                                  + 0.2 * dur)
+            self._work.notify_all()
+        req.outcome = "ok"
+        REQS_TOTAL.labels("ok").inc()
+        req.span.set_attribute("outcome", "ok")
+        req.span.end()
+        req._done.set()
+
+    def _release_handoff(self, req: GenRequest) -> None:
+        """Drop a pending handoff's page references exactly once and
+        detach it from the request (idempotent).  The exactly-once
+        guard itself lives in ONE place — disagg.release_handoff — so
+        the engine and the coordinator cannot drift on it."""
+        state = req._handoff
+        req._handoff = None
+        if state is not None:
+            from kubeflow_tpu.serving.disagg import release_handoff
+
+            release_handoff(self.pool, state)
+
+    def _run_prefill(self, req: GenRequest, k_first,
+                     want_pages: bool = False) -> tuple:
         """Run the prompt and sample the first token; returns ``(token,
-        batch-1 kv scratch)`` ready to install as the slot's view row, or
-        ``(None, None)`` when the request died (cancel, deadline,
-        shutdown) between prefill chunks — the pin is still released.
+        batch-1 kv scratch, pages)`` ready to install as the slot's view
+        row, or ``(None, None, None)`` when the request died (cancel,
+        deadline, shutdown) between prefill chunks — the pin is still
+        released.  ``want_pages=True`` (prefill role) also commits the
+        WHOLE prompt's KV to pool pages and returns their ids with one
+        handoff reference held per page; ``pages`` is None when the pool
+        cannot host them (the caller falls back to colocated decode).
 
         Three shapes, all token-identical (the per-position KV and the
         last-position logits are bitwise independent of how the prompt is
@@ -1008,7 +1376,7 @@ class ContinuousBatcher:
                     # cancel/deadline/shutdown between prefill chunks: bail
                     # before the next dispatch; the finally below releases
                     # the pin, the caller skips seating the request
-                    return None, None
+                    return None, None, None
                 take = min(prompt_len - pos, self.prefill_chunk)
                 # pad the chunk up to a bucket, but never past max_seq:
                 # dynamic_update_slice CLAMPS an out-of-range start index,
@@ -1040,45 +1408,66 @@ class ContinuousBatcher:
                     tok, scratch = out
                     break
                 scratch = out
-            fully_cached = node is not None and usable >= prompt_len - 1
-            if self.prefix_cache is not None and not fully_cached:
-                # cache the WHOLE prompt (RadixAttention discipline:
-                # insert everything, let LRU sort out what traffic
-                # shares): shared pages by reference, only the suffix
-                # pages are newly committed.  Inside the pin window so
-                # the matched node's pages cannot be evicted from under
-                # the insert.
-                self._commit_and_insert(req.ids, usable, node, scratch)
-            return tok, scratch
+            pages = None
+            if want_pages:
+                # handoff commit: EVERY prompt page, inside the pin
+                # window (the matched node's shared pages cannot be
+                # evicted from under the incref)
+                pages = self._commit_and_insert(req.ids, usable, node,
+                                                scratch, for_handoff=True)
+            else:
+                fully_cached = (node is not None
+                                and usable >= prompt_len - 1)
+                if self.prefix_cache is not None and not fully_cached:
+                    # cache the WHOLE prompt (RadixAttention discipline:
+                    # insert everything, let LRU sort out what traffic
+                    # shares): shared pages by reference, only the suffix
+                    # pages are newly committed.  Inside the pin window so
+                    # the matched node's pages cannot be evicted from
+                    # under the insert.
+                    self._commit_and_insert(req.ids, usable, node, scratch)
+            return tok, scratch, pages
         finally:
             if node is not None:
                 self.prefix_cache.release(node)
 
     def _commit_and_insert(self, ids: list[int], usable: int, node,
-                           scratch) -> None:
+                           scratch,
+                           for_handoff: bool = False) -> list[int] | None:
         """Commit the prompt's NEW pages (beyond the shared prefix) from
         the prefill scratch into the pool and insert the whole prompt
         into the radix tree.  Pool pressure evicts LRU cache entries; if
         the budget still cannot host the pages the prompt simply is not
-        cached — admission never blocks on cache capacity."""
+        cached — admission never blocks on cache capacity.
+
+        ``for_handoff=True`` (prefill role) commits EVERY page the prompt
+        touches (the tail page included — the role requires page_size to
+        divide max_seq, so no slice is ever clamped) and returns the full
+        id list with ONE handoff reference held per page: fresh pages
+        keep their alloc reference, shared pages are increfed.  Returns
+        None when the pool cannot host the pages."""
         prompt_len = len(ids)
-        # only pages that lie FULLY inside the scratch are committable:
-        # when page_size does not divide max_seq, a tail page's slice
-        # start would be clamped by dynamic_slice and the page would hold
-        # KV shifted from earlier positions — silently wrong on a later
-        # hit.  The uncovered prompt tail simply is not cached.
-        max_pages = self.max_seq // self.page_size
-        needed = min(pages_for(prompt_len, self.page_size), max_pages)
-        ids = ids[:min(prompt_len, needed * self.page_size)]
+        if for_handoff:
+            needed = pages_for(prompt_len, self.page_size)
+        else:
+            # only pages that lie FULLY inside the scratch are
+            # committable: when page_size does not divide max_seq, a tail
+            # page's slice start would be clamped by dynamic_slice and
+            # the page would hold KV shifted from earlier positions —
+            # silently wrong on a later hit.  The uncovered prompt tail
+            # simply is not cached.
+            max_pages = self.max_seq // self.page_size
+            needed = min(pages_for(prompt_len, self.page_size), max_pages)
+            ids = ids[:min(prompt_len, needed * self.page_size)]
         shared = usable // self.page_size if node is not None else 0
         n_new = needed - shared
         if n_new <= 0 or not ids:
-            return
+            return None
         fresh = self.pool.alloc(n_new)
         while fresh is None:
             if (self.prefix_cache is None
                     or not self.prefix_cache.evict_lru()):
-                return
+                return None
             fresh = self.pool.alloc(n_new)
         bucket = next((b for b in SEED_BUCKETS if b >= n_new),
                       self.pages_per_seq)
@@ -1086,9 +1475,18 @@ class ContinuousBatcher:
         for pid, tree in zip(fresh, trees):
             self.pool.put(pid, tree)
         shared_ids = list(node.pages[:shared]) if shared else []
-        self.prefix_cache.insert(ids, shared_ids + fresh)
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(ids, shared_ids + fresh)
+        if for_handoff:
+            # handoff ownership: fresh pages keep the alloc reference,
+            # shared pages gain one — released at decode seed (or the
+            # request's death), so eviction cannot free them mid-handoff
+            if shared_ids:
+                self.pool.incref(shared_ids)
+            return shared_ids + fresh
         # the tree holds its own references now; drop the alloc's
         self.pool.decref(fresh)
+        return None
 
     def _decode_chunk(self, queue_empty: bool) -> None:
         remaining = [s.max_new_tokens - len(s.generated)

@@ -227,6 +227,7 @@ class MultiHostPredictor:
             return 1 << max(0, (n - 1).bit_length())
 
         requested_new = max_new_tokens
+        true_pad = pad_len
         # bucket the PER-REPLICA row count, then multiply by dp — the
         # batch dim must stay dp-divisible for P("dp") sharding (dp need
         # not be a power of two)
@@ -245,6 +246,21 @@ class MultiHostPredictor:
         max_new_tokens = new_b
         pad_len = min(_pow2(max(8, pad_len)),
                       self.max_seq - max_new_tokens)
+        # executable REUSE across the bucket ladder (the engine's
+        # PREFILL_BUCKETS discipline): any already-compiled program whose
+        # shapes dominate this request serves it — rows pad up, prompts
+        # pad up, the decode tail is sliced back — so a ladder of prompt
+        # lengths compiles ONE program instead of one per pow2 rung.
+        # Padding waste is bounded compute; a multi-second XLA compile
+        # (that also pins an executable forever) is not.
+        best = None
+        for (b, p, n) in self._gen_cache:
+            if (b >= batch and p >= true_pad and n >= requested_new
+                    and (best is None
+                         or b * (p + n) < best[0] * (best[1] + best[2]))):
+                best = (b, p, n)
+        if best is not None:
+            padded_b, pad_len, max_new_tokens = best
         ids = np.zeros((padded_b, pad_len), np.int32)
         last = np.zeros((padded_b,), np.int32)
         for i, p in enumerate(prompts):

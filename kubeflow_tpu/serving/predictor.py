@@ -39,9 +39,18 @@ class GenerativePredictor:
                  tp: int = 1, ep: int = 1,
                  prefix_cache_mb: float = 0.0, prefill_chunk: int = 512,
                  max_queue: int = 0, kv_page_size: int = 16,
-                 speculative_tokens: int = 0):
+                 speculative_tokens: int = 0, role: str = "colocated",
+                 kv_quant: bool = False, handoff_post=None):
         from kubeflow_tpu.models import registry
 
+        self.name = model_name
+        # disaggregation role (serving/disagg.py): "prefill" admits and
+        # prefills, then forwards the serialized handoff to the decode
+        # peer the gateway picked (X-KF-Decode-Peer) — or resumes it on
+        # its own engine when no peer is reachable; "decode" seeds slots
+        # from :resume handoffs and owns the decode loop
+        self.role = role
+        self._handoff_post = handoff_post
         self.log = get_logger("predictor", model=model_name, size=size)
         entry = registry.get(model_name)
         self.module = entry.make_model(size=size, **(model_config or {}))
@@ -133,6 +142,16 @@ class GenerativePredictor:
         # max_queue > 0 bounds admission: over-limit submits raise
         # QueueFull, which the HTTP layer turns into 429 + Retry-After
         # (load shedding beats queue collapse under sustained overload)
+        import threading
+
+        self._hand_cv = threading.Condition()
+        self._handoffs: dict[int, object] = {}
+        engine_kw = {}
+        if role == "prefill":
+            engine_kw = {"role": "prefill",
+                         "handoff_fn": self._capture_handoff}
+        elif role == "decode":
+            engine_kw = {"role": "decode"}
         self.engine = ContinuousBatcher(self.module, self.params, self.cfg,
                                         max_batch=max_batch,
                                         max_seq=self.max_seq,
@@ -143,7 +162,9 @@ class GenerativePredictor:
                                         max_queue=max_queue,
                                         page_size=kv_page_size,
                                         speculative_tokens=(
-                                            speculative_tokens))
+                                            speculative_tokens),
+                                        kv_quant=kv_quant,
+                                        **engine_kw)
         self.log.info("predictor ready",
                       params=sum(x.size for x in
                                  jax.tree_util.tree_leaves(self.params)))
@@ -158,13 +179,151 @@ class GenerativePredictor:
                                     abstract_like(self.params))
         self.log.info("restored checkpoint", directory=directory)
 
+    # -- disaggregation handoff plumbing ---------------------------------------
+    def _capture_handoff(self, req, state) -> None:
+        """Engine handoff_fn for a prefill-role predictor: park the state
+        for the HTTP worker thread driving this request (it forwards to
+        the decode peer, keeping the batcher thread free to prefill the
+        next prompt).  Keyed by object identity — the driving thread
+        holds the request, so the id cannot be reused underneath us."""
+        with self._hand_cv:
+            self._handoffs[id(req)] = state
+            self._hand_cv.notify_all()
+
+    def _await_handoff(self, req, timeout: float = 600.0):
+        """Wait for ``req``'s handoff (or its local completion/failure);
+        None means no handoff arrived — the caller distinguishes
+        'request finished locally' (req._done set) from 'gave up
+        waiting' and must clean up the latter itself.  The timeout
+        matches ``result()``'s so a slow prefill is judged once, not
+        twice."""
+        deadline = time.monotonic() + timeout
+        with self._hand_cv:
+            while id(req) not in self._handoffs:
+                if req._done.is_set():
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._hand_cv.wait(min(remaining, 0.1))
+            return self._handoffs.pop(id(req))
+
+    def resume(self, body: dict, trace_ctx=None) -> dict:
+        """Decode-role entry (``:resume``): seed a slot from a serialized
+        handoff and decode to completion."""
+        from kubeflow_tpu.serving import disagg
+
+        t0 = time.perf_counter()
+        out = disagg.resume_serialized(self.engine, body,
+                                       trace_ctx=trace_ctx)
+        generated = len(out) - len(body["ids"])
+        dt = time.perf_counter() - t0
+        return {"ids": out, "tokens_generated": generated,
+                "tokens_per_sec": generated / max(dt, 1e-9)}
+
+    def _forward_one(self, r, state, decode_peer) -> None:
+        """Forward one captured handoff to the decode peer; on peer
+        failure the state is still resumable (refs released only on
+        success), so the request degrades to a COLOCATED resume on our
+        own engine — never to an error while either pool is healthy."""
+        from kubeflow_tpu.serving import disagg
+
+        try:
+            full = disagg.forward_handoff(
+                state, self.engine.pool, decode_peer, self.name,
+                post_fn=self._handoff_post,
+                trace_ctx=r.span.context if r.span else None)
+            disagg.complete_forwarded(r, full)
+        except Exception as e:
+            self.log.warning("decode peer failed; resuming locally",
+                             peer=decode_peer, error=str(e))
+            try:
+                self.engine.submit_handoff(state)
+            except BaseException as local_err:
+                self.log.error("local resume also failed",
+                               error=str(local_err))
+                disagg.release_handoff(self.engine.pool, state)
+                disagg.fail_forwarded(
+                    r, f"decode peer {decode_peer} failed: {e}")
+
+    def _generate_prefill(self, ids, max_new_tokens, temperature, seed,
+                          eos_id, top_k, top_p, deadline_s, trace_ctx,
+                          decode_peer) -> list[list[int]]:
+        """Prefill-role generate: admit every row, then forward each
+        handoff to the decode peer CONCURRENTLY (one forwarder thread
+        per row — a batch's rows co-batch on the decode worker instead
+        of serializing their remote decodes; the batcher thread stays
+        free throughout) or resume on our own engine when no peer
+        exists."""
+        import threading
+
+        from kubeflow_tpu.serving import disagg
+
+        reqs = []
+        try:
+            for i, prompt in enumerate(ids):
+                reqs.append(self.engine.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                    eos_id=eos_id, seed=None if seed is None else seed + i,
+                    top_k=top_k, top_p=top_p, deadline_s=deadline_s,
+                    trace_ctx=trace_ctx))
+            forwarders = []
+            for r in reqs:
+                state = self._await_handoff(r)
+                if state is None:
+                    if not r._done.is_set():
+                        # gave up waiting (wedged prefill): fail THIS
+                        # row promptly — and drain a capture that raced
+                        # the timeout, or its page refs would strand
+                        r.cancel("prefill handoff wait timed out")
+                        with self._hand_cv:
+                            late = self._handoffs.pop(id(r), None)
+                        if late is not None:
+                            disagg.release_handoff(self.engine.pool,
+                                                   late)
+                            disagg.fail_forwarded(
+                                r, "prefill handoff wait timed out")
+                    continue           # finished/failed locally
+                if decode_peer is None:
+                    # no reachable decode pool: colocated fallback on
+                    # our own engine — availability degrades to the old
+                    # behavior, never to an error
+                    try:
+                        self.engine.submit_handoff(state)
+                    except BaseException as e:
+                        # shutdown/drain race: the popped state is in
+                        # OUR hands now — release it or the pages leak
+                        disagg.release_handoff(self.engine.pool, state)
+                        disagg.fail_forwarded(
+                            r, f"local resume failed: {e}")
+                    continue
+                t = threading.Thread(target=self._forward_one,
+                                     args=(r, state, decode_peer),
+                                     daemon=True)
+                t.start()
+                forwarders.append(t)
+            for t in forwarders:
+                t.join(timeout=600)
+            return [r.result(timeout=600) for r in reqs]
+        except BaseException:
+            for r in reqs:
+                r.cancel("sibling row failed")
+                # a handoff captured but never awaited would leak its
+                # page refs — the engine forgot the request at capture
+                with self._hand_cv:
+                    orphan = self._handoffs.pop(id(r), None)
+                if orphan is not None:
+                    disagg.release_handoff(self.engine.pool, orphan)
+            raise
+
     # -- API -------------------------------------------------------------------
     def generate(self, ids: list[list[int]], max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0,
                  eos_id: int | None = None, top_k: int = 0,
                  top_p: float = 0.0,
                  deadline_s: float | None = None,
-                 trace_ctx=None) -> dict:
+                 trace_ctx=None, decode_peer: str | None = None) -> dict:
         """Generate continuations for a (possibly RAGGED) batch of prompts.
 
         Routed through the continuous-batching engine: each prompt becomes a
@@ -173,12 +332,20 @@ class GenerativePredictor:
         ``deadline_s`` (from X-Request-Deadline or the route timeout) rides
         into every GenRequest: an expired request is evicted mid-decode and
         its slot freed instead of decoding for a client that gave up.
+        ``decode_peer`` (prefill role only; stamped by the gateway as
+        X-KF-Decode-Peer) is the ``host:port`` whose ``:resume`` endpoint
+        finishes the stream.
         """
         t0 = time.perf_counter()
-        out_ids = self.engine.generate_sync(
-            ids, max_new_tokens=max_new_tokens, temperature=temperature,
-            eos_id=eos_id, seed=seed, top_k=top_k, top_p=top_p,
-            deadline_s=deadline_s, trace_ctx=trace_ctx)
+        if self.role == "prefill":
+            out_ids = self._generate_prefill(
+                ids, max_new_tokens, temperature, seed, eos_id, top_k,
+                top_p, deadline_s, trace_ctx, decode_peer)
+        else:
+            out_ids = self.engine.generate_sync(
+                ids, max_new_tokens=max_new_tokens, temperature=temperature,
+                eos_id=eos_id, seed=seed, top_k=top_k, top_p=top_p,
+                deadline_s=deadline_s, trace_ctx=trace_ctx)
         dt = time.perf_counter() - t0
         generated = sum(len(o) - len(i) for o, i in zip(out_ids, ids))
         return {
@@ -380,6 +547,12 @@ class PredictorApp:
                 body = self._body(environ)
                 if verb == "generate":
                     eos = body.get("eos_id")
+                    kw = {}
+                    if getattr(pred, "role", "colocated") == "prefill":
+                        # the gateway picked the decode worker (by slot
+                        # availability) and stamped it on the request
+                        kw["decode_peer"] = environ.get(
+                            "HTTP_X_KF_DECODE_PEER")
                     return "200 OK", pred.generate(
                         body["ids"],
                         max_new_tokens=int(body.get("max_new_tokens", 32)),
@@ -388,7 +561,14 @@ class PredictorApp:
                         top_k=int(body.get("top_k", 0)),
                         top_p=float(body.get("top_p", 0.0)),
                         deadline_s=self._deadline_s(environ, body),
-                        trace_ctx=trace_ctx)
+                        trace_ctx=trace_ctx, **kw)
+                if verb == "resume" and method == "POST":
+                    # decode-role entry: seed a slot from a serialized
+                    # prefill handoff and finish the stream.  QueueFull
+                    # (pool cannot host the pages) maps to 429 +
+                    # Retry-After upstream — shed semantics, so the
+                    # gateway retries a decode sibling.
+                    return "200 OK", pred.resume(body, trace_ctx=trace_ctx)
                 if verb == "predict":
                     return "200 OK", pred.predict(body["instances"])
             else:
@@ -450,6 +630,16 @@ def main(argv=None) -> int:
                         help="max draft tokens per speculative-decoding "
                              "verify round (0 disables; output is token-"
                              "identical either way)")
+    parser.add_argument("--role", default="colocated",
+                        choices=("colocated", "prefill", "decode"),
+                        help="disaggregated-serving role: prefill workers "
+                             "admit prompts and hand finished KV pages to "
+                             "decode workers (set from the "
+                             "serving.kubeflow.org/role annotation)")
+    parser.add_argument("--kv-quant", action="store_true",
+                        help="int8-quantize KV pages at prefill-commit "
+                             "(~2x effective page capacity; perplexity-"
+                             "neutral, not bit-identical)")
     args = parser.parse_args(argv)
 
     specs = [m for m in (args.models or []) if m] or ["llama"]
@@ -483,7 +673,10 @@ def main(argv=None) -> int:
                 kv_page_size=int(opts.get("kv_page_size",
                                           args.kv_page_size)),
                 speculative_tokens=int(opts.get("speculative_tokens",
-                                                args.speculative_tokens)))
+                                                args.speculative_tokens)),
+                role=opts.get("role", args.role),
+                kv_quant=opts.get("kv_quant", "").lower()
+                in ("1", "true") or args.kv_quant)
         else:
             predictors[name] = ClassifierPredictor(name,
                                                    checkpoint_dir=ckpt)

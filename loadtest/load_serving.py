@@ -23,6 +23,16 @@ continuous-batching engine:
 - RUN-HEAVY speculation: sequential long generations on a stream whose
   greedy output is repetitive (the shape speculation exists for);
   reports the spec-on/spec-off decode ratio and accept rate.
+- DISAGGREGATED mixed storm (ISSUE 12; KF_SKIP_DISAGG=1 opts out):
+  long-decode streams measured while feeders pound the engine with long
+  COLD prompts — on the colocated engine every storm prefill serializes
+  against the decode loop and stream tokens/s craters; on the
+  disaggregated coordinator the prefill pool absorbs the storm and the
+  decode pool holds near its no-interference floor.  Asserts the disagg
+  streams are token-identical to colocated, disagg decode tokens/s >=
+  KF_DISAGG_FLOOR (default 1.5) x colocated-under-storm, admitted storm
+  TTFT p99 under KF_DISAGG_TTFT_CEIL, and zero orphan pages / leaked
+  pins after the storm.
 
 ``--smoke`` is the CI gate (small N, hard asserts, including a decode
 tokens/s floor tunable via KF_DECODE_FLOOR); the full run prints one
@@ -132,6 +142,142 @@ def _decode_phase(engine, prompts, n, max_new):
     return outs, tps, accept, d
 
 
+def _disagg_phase(module, params, cfg, *, smoke: bool, storm_len: int,
+                  max_seq: int, chunk: int) -> dict:
+    """Mixed long-prompt + long-decode storm, three ways: colocated
+    without interference (the floor), colocated under the storm (HEAD
+    behavior), disaggregated under the storm.  Decode throughput is the
+    STREAMS' tokens over wall clock from storm start — the cadence a
+    user watching a long generation experiences — not dispatch-local
+    tokens/s, which never sees the stall between dispatches."""
+    import threading
+
+    from kubeflow_tpu.serving.disagg import DisaggCoordinator
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    stream_new = 64 if smoke else 160
+    n_feeders = 2
+    stream_prompts = _prompts(2, 10, cfg.vocab_size)
+    # long cold prompts: the heavier prefill is relative to a decode
+    # step, the more a colocated engine's decode cadence suffers
+    storm_len = min(2 * storm_len, max_seq - stream_new - 16)
+
+    def storm_prompt(i: int) -> list[int]:
+        # DISTINCT per wave: every storm prompt is a cold prefill
+        state = (0xC0FFEE ^ (i * 2654435761)) & 0x7FFFFFFF
+        toks = []
+        for _ in range(storm_len):
+            state = (state * 1103515245 + 12345) & 0x7FFFFFFF
+            toks.append(1 + state % (cfg.vocab_size - 1))
+        return toks
+
+    def run(submit, storm: bool):
+        """-> (stream outputs, stream decode tok/s, storm TTFTs).  The
+        storm saturates FIRST, then the streams arrive — a user starting
+        a long generation while cold prompts pour in.  Streams carry a
+        (never-expiring) deadline, as production requests always do (the
+        gateway stamps X-Request-Deadline from the route timeout);
+        deadline-carrying slots keep colocated decode chunks SMALL while
+        the queue is non-empty, which is exactly how a prefill storm
+        steals decode cadence.  Throughput is the streams' tokens over
+        their submit-to-done wall — the cadence the user watches."""
+        stop = threading.Event()
+        ttfts: list[float] = []
+
+        def feeder(fid: int) -> None:
+            i = fid * 100000
+            while not stop.is_set():
+                r = submit(storm_prompt(i), max_new_tokens=1)
+                try:
+                    r.result(timeout=120)
+                    ttfts.append(r.first_token_at - r.submitted_at)
+                except Exception:
+                    pass
+                i += 1
+
+        feeders = [threading.Thread(target=feeder, args=(f,), daemon=True)
+                   for f in range(n_feeders)] if storm else []
+        for t in feeders:
+            t.start()
+        if feeders:
+            time.sleep(0.5)   # the storm is in full swing before the
+                              # streams arrive
+        t0 = time.perf_counter()
+        reqs = [submit(p, max_new_tokens=stream_new, deadline_s=600.0)
+                for p in stream_prompts]
+        outs = [r.result(timeout=600) for r in reqs]
+        wall = time.perf_counter() - t0
+        stop.set()
+        for t in feeders:
+            t.join(timeout=120)
+        toks = sum(len(r.generated) for r in reqs)
+        return outs, toks / max(wall, 1e-9), ttfts
+
+    # every tier gets the same prefix cache so the comparison is
+    # apples-to-apples AND the pin-leak assertion below actually has
+    # pins to count (a cacheless coordinator trivially reports zero)
+    cache_bytes = 16 << 20
+
+    def colocated():
+        return ContinuousBatcher(module, params, cfg, max_batch=4,
+                                 max_seq=max_seq, prefill_chunk=chunk,
+                                 prefix_cache_bytes=cache_bytes)
+
+    def warm(submit):
+        # compile everything the measured runs dispatch: the stream
+        # shape at FULL length (the big decode chunks a solo stream
+        # uses), a short generation (the small chunks used under queue
+        # pressure), and the storm-prompt prefill buckets
+        submit(stream_prompts[0], max_new_tokens=stream_new,
+               deadline_s=600.0).result(600)
+        for p in stream_prompts:
+            submit(p, max_new_tokens=4, deadline_s=600.0).result(600)
+        submit(storm_prompt(999999), max_new_tokens=1).result(600)
+
+    floor_eng = colocated()
+    warm(floor_eng.submit)
+    floor_out, floor_tps, _ = run(floor_eng.submit, storm=False)
+    floor_eng.shutdown()
+
+    colo_eng = colocated()
+    warm(colo_eng.submit)
+    colo_out, colo_tps, colo_ttfts = run(colo_eng.submit, storm=True)
+    colo_eng.shutdown()
+
+    co = DisaggCoordinator(module, params, cfg, max_batch=4,
+                           max_seq=max_seq, prefill_chunk=chunk,
+                           prefill_workers=1, decode_workers=1,
+                           prefix_cache_bytes=cache_bytes)
+    warm(co.submit)
+    dis_out, dis_tps, dis_ttfts = run(co.submit, storm=True)
+    assert co.drained(timeout=60)
+    stats = co.stats()
+    pins = stats.get("prefix_cache", {}).get("pinned", 0)
+    orphans = stats["kv_pool"]["orphan_pages"]
+    handoff_counts = [e.stats().get("handoffs", 0) for e in co.prefill]
+    co.shutdown()
+    return {
+        "stream_max_new": stream_new,
+        "storm_prompt_len": storm_len,
+        "floor_tokens_per_sec": round(floor_tps, 1),
+        "colocated_tokens_per_sec": round(colo_tps, 1),
+        "disagg_tokens_per_sec": round(dis_tps, 1),
+        # the headline pair: what the storm costs each architecture
+        "disagg_vs_colocated": round(dis_tps / max(colo_tps, 1e-9), 2),
+        "disagg_vs_floor": round(dis_tps / max(floor_tps, 1e-9), 3),
+        "colocated_vs_floor": round(colo_tps / max(floor_tps, 1e-9), 3),
+        "streams_identical": dis_out == colo_out == floor_out,
+        "storm_admitted": {"colocated": len(colo_ttfts),
+                           "disagg": len(dis_ttfts)},
+        "disagg_ttft_p99_ms": round(_pct(dis_ttfts or [0.0], 99) * 1e3, 2),
+        "colocated_ttft_p99_ms": round(_pct(colo_ttfts or [0.0], 99) * 1e3,
+                                       2),
+        "handoffs": sum(handoff_counts),
+        "orphan_pages": orphans,
+        "leaked_pins": pins,
+    }
+
+
 def main() -> int:
     smoke = "--smoke" in sys.argv
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
@@ -236,6 +382,17 @@ def main() -> int:
     heavy_spec_tps = hs_d["decode_tokens"] / max(hs_d["decode_seconds"],
                                                  1e-9)
     heavy_accept = hs_d["spec_accepted"] / max(hs_d["spec_proposed"], 1)
+
+    # disaggregated prefill/decode mixed storm (ISSUE 12).  The smoke
+    # shape gets a wider sequence budget than the prefix phases: the
+    # interference signal scales with storm-prompt length, and a ratio
+    # measured too close to the CI floor would flake
+    disagg = None
+    if os.environ.get("KF_SKIP_DISAGG") != "1":
+        disagg = _disagg_phase(module, params, cfg, smoke=smoke,
+                               storm_len=sys_len,
+                               max_seq=256 if smoke else max_seq,
+                               chunk=chunk)
     wall = time.perf_counter() - t0
 
     identical = warm_out == cold_out
@@ -306,6 +463,8 @@ def main() -> int:
             "spec_accept_rate": round(heavy_accept, 3),
         },
     }
+    if disagg is not None:
+        result["disagg"] = disagg
     result["dispatch_ratio"] = round(
         cold_d["dispatches"] / max(warm_d["dispatches"], 1), 2)
     result["ttft_p50_speedup"] = round(
@@ -338,6 +497,30 @@ def main() -> int:
         if spec_tps < floor:
             failures.append(
                 f"decode {spec_tps:.0f} tok/s under the {floor:.0f} floor")
+    if disagg is not None:
+        if not disagg["streams_identical"]:
+            failures.append(
+                "disaggregated streams diverged from colocated")
+        if disagg["orphan_pages"] != 0 or disagg["leaked_pins"] != 0:
+            failures.append(
+                f"disagg leak after the storm: {disagg['orphan_pages']} "
+                f"orphan pages, {disagg['leaked_pins']} pins")
+        # the interference headline: decode cadence under a prefill storm
+        # must beat colocated HEAD by the acceptance floor (1.5x; CI
+        # hosts can tune via KF_DISAGG_FLOOR) with admitted storm TTFT
+        # p99 bounded
+        ratio_floor = float(os.environ.get("KF_DISAGG_FLOOR", "1.5"))
+        if disagg["disagg_vs_colocated"] < ratio_floor:
+            failures.append(
+                f"disagg decode {disagg['disagg_tokens_per_sec']} tok/s is "
+                f"only {disagg['disagg_vs_colocated']}x colocated under "
+                f"storm (want >= {ratio_floor}x)")
+        ttft_ceil = float(os.environ.get("KF_DISAGG_TTFT_CEIL", "20"))
+        if disagg["disagg_ttft_p99_ms"] > ttft_ceil * 1e3:
+            failures.append(
+                f"disagg admitted TTFT p99 "
+                f"{disagg['disagg_ttft_p99_ms']:.0f}ms over the "
+                f"{ttft_ceil:.0f}s ceiling")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)

@@ -603,3 +603,70 @@ def f(tracer):
     return span
 """)
     assert tree.run() == []
+
+
+# -- pass 7: handoff thread-local hygiene (ISSUE 12) ---------------------------
+
+def test_handoff_threadlocal_fires_in_serving_tree(tree):
+    tree("kubeflow_tpu/serving/m.py", """\
+import threading
+
+_state = threading.local()
+""")
+    assert "handoff-threadlocal" in rules_of(tree.run())
+
+
+def test_handoff_threadlocal_fires_on_handoff_adjacent_module(tree):
+    """Outside serving/, a module touching the handoff machinery is in
+    scope — state must ride the request, wherever the code lives."""
+    tree("kubeflow_tpu/other/m.py", """\
+import threading
+from kubeflow_tpu.serving.disagg import HandoffState
+
+_tls = threading.local()
+
+def stash(state: HandoffState):
+    _tls.state = state
+""")
+    assert "handoff-threadlocal" in rules_of(tree.run())
+
+
+def test_handoff_threadlocal_ignores_unrelated_modules(tree):
+    tree("kubeflow_tpu/other/clean.py", """\
+import threading
+
+_tls = threading.local()
+""")
+    assert "handoff-threadlocal" not in rules_of(tree.run())
+
+
+def test_handoff_threadlocal_suppression_pays_rent(tree):
+    tree("kubeflow_tpu/serving/s.py", """\
+import threading
+
+_tls = threading.local()  # kfvet: ignore[handoff-threadlocal]
+""")
+    findings = tree.run()
+    assert "handoff-threadlocal" not in rules_of(findings)
+    tree("kubeflow_tpu/serving/unused.py", """\
+x = 1  # kfvet: ignore[handoff-threadlocal]
+""")
+    assert "unused-suppression" in rules_of(tree.run())
+
+
+def test_handoff_threadlocal_bare_local_needs_the_import(tree):
+    """A helper merely NAMED 'local' is not the hazard; `from threading
+    import local` is."""
+    tree("kubeflow_tpu/serving/helper.py", """\
+def local():
+    return 1
+
+x = local()
+""")
+    assert "handoff-threadlocal" not in rules_of(tree.run())
+    tree("kubeflow_tpu/serving/bare.py", """\
+from threading import local
+
+_tls = local()
+""")
+    assert "handoff-threadlocal" in rules_of(tree.run())

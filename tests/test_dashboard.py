@@ -228,6 +228,38 @@ def test_serving_health_route(stack):
     assert "admission_wait_p99_s" in state
     assert "gateway_shed" in state
     assert state["draining"] in (True, False)
+    # per-backend routing view (ISSUE 12): role + in-flight per Running
+    # pod with ports, so role-aware picks are observable
+    assert "backends" in state and "handoffs" in state
+    assert "backend_picks" in state
+
+
+def test_serving_health_backends_show_role_and_inflight(stack):
+    """The routing view the role-aware gateway picker decides on: each
+    Running pod with ports reports its role, drain mark, and live
+    proxied streams."""
+    from kubeflow_tpu import autoscale
+    from kubeflow_tpu.core.objects import api_object
+
+    server, mgr, base = stack
+    pod = api_object("Pod", "dec-0", "team-a",
+                     labels={"serving.kubeflow.org/role": "decode"},
+                     spec={"containers": [{"name": "c"}]})
+    server.create(pod)
+    server.patch_status("Pod", "dec-0", "team-a", {
+        "phase": "Running", "podIP": "127.0.0.1",
+        "portMap": {"8602": 19876}})
+    autoscale.get_collector(server).inc_backend(("127.0.0.1", 19876))
+    try:
+        code, state = req(base, "/dashboard/api/serving-health",
+                          user="alice@corp.com")
+        assert code == 200
+        entry = next(b for b in state["backends"] if b["pod"] == "dec-0")
+        assert entry["role"] == "decode"
+        assert entry["in_flight"] == 1
+        assert entry["draining"] is False
+    finally:
+        autoscale.get_collector(server).dec_backend(("127.0.0.1", 19876))
 
 
 def test_persistence_health_route(stack, tmp_path):

@@ -1,0 +1,418 @@
+"""Disaggregated prefill/decode serving (ISSUE 12): split worker pools,
+paged-KV handoff, token identity, leak-free cancel storms, failover."""
+
+import time
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models import llama as lm
+from kubeflow_tpu.parallel.sharding import unbox_params
+from kubeflow_tpu.serving.disagg import DisaggCoordinator
+from kubeflow_tpu.serving.engine import ContinuousBatcher, QueueFull
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = lm.LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                         num_heads=2, num_kv_heads=2, intermediate_size=64,
+                         max_seq_len=128, use_flash=False)
+    module = lm.LlamaModel(cfg)
+    params = unbox_params(module.init(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, 8), jnp.int32))
+                          ["params"])
+    return module, params, cfg
+
+
+def _colocated(model, **kw):
+    module, params, cfg = model
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq", 64)
+    return ContinuousBatcher(module, params, cfg, **kw)
+
+
+def _coordinator(model, **kw):
+    module, params, cfg = model
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("page_size", 16)
+    return DisaggCoordinator(module, params, cfg, **kw)
+
+
+RAGGED = [[5, 8, 13], [2, 7, 9, 11]]
+
+
+class TestTokenIdentity:
+    """Disaggregated streams must be BITWISE the colocated engine's."""
+
+    def test_greedy_identical(self, model):
+        eng = _colocated(model)
+        ref = eng.generate_sync(RAGGED, max_new_tokens=8)
+        eng.shutdown()
+        co = _coordinator(model)
+        try:
+            assert co.generate_sync(RAGGED, max_new_tokens=8) == ref
+        finally:
+            co.shutdown()
+
+    def test_seeded_sampling_identical(self, model):
+        eng = _colocated(model)
+        ref = eng.generate_sync(RAGGED, max_new_tokens=10,
+                                temperature=0.9, seed=7)
+        eng.shutdown()
+        co = _coordinator(model)
+        try:
+            out = co.generate_sync(RAGGED, max_new_tokens=10,
+                                   temperature=0.9, seed=7)
+            assert out == ref
+        finally:
+            co.shutdown()
+
+    def test_ragged_cobatch_with_prefix_cache(self, model):
+        """A warm prefix hit on the prefill worker seeds from shared
+        pages, hands off, and still matches colocated output."""
+        prompts = [list(range(2, 40)), list(range(2, 36)) + [99, 98]]
+        eng = _colocated(model, prefix_cache_bytes=1 << 20)
+        ref = eng.generate_sync(prompts, max_new_tokens=6)
+        ref2 = eng.generate_sync(prompts, max_new_tokens=6)  # warm
+        assert ref2 == ref
+        eng.shutdown()
+        co = _coordinator(model, prefix_cache_bytes=1 << 20)
+        try:
+            assert co.generate_sync(prompts, max_new_tokens=6) == ref
+            # second pass: prefix hits on the prefill worker
+            assert co.generate_sync(prompts, max_new_tokens=6) == ref
+            hits = co.prefill[0].stats()
+            assert co.stats()["kv_pool"]["orphan_pages"] == 0
+            assert hits["handoffs"] >= 4
+        finally:
+            co.shutdown()
+
+
+class TestHandoffLifecycle:
+    def test_max_new_one_finishes_at_prefill(self, model):
+        """A request complete at its first token never hops to decode."""
+        co = _coordinator(model)
+        try:
+            out = co.submit([3, 1, 4], max_new_tokens=1).result(60)
+            assert len(out) == 4
+            assert co.prefill[0].stats()["handoffs"] == 0
+            assert co.stats()["kv_pool"]["orphan_pages"] == 0
+        finally:
+            co.shutdown()
+
+    def test_handoff_spans_show_the_hop(self, model):
+        """engine.prefill_handoff -> engine.decode, one trace."""
+        from kubeflow_tpu import trace
+        from kubeflow_tpu.trace import Collector, Tracer
+
+        old = trace.set_tracer(Tracer(1.0, collector=Collector(4096)))
+        try:
+            co = _coordinator(model)
+            co.submit([5, 8, 13], max_new_tokens=6).result(60)
+            co.drained(timeout=30)
+            co.shutdown()
+            tracer = trace.get_tracer()
+            spans = tracer.collector.spans()
+            names = {s.name for s in spans}
+            assert "engine.prefill_handoff" in names
+            assert "engine.decode" in names
+            hand = next(s for s in spans
+                        if s.name == "engine.prefill_handoff")
+            dec = next(s for s in spans if s.name == "engine.decode")
+            assert hand.trace_id == dec.trace_id
+        finally:
+            trace.set_tracer(old)
+
+    def test_cancel_deadline_storm_zero_orphans(self, model):
+        """Cancels and deadline expiries landing mid-handoff release the
+        handoff's page refs: zero orphan pages, zero pins after."""
+        co = _coordinator(model, max_batch=2)
+        try:
+            reqs = []
+            for i in range(12):
+                r = co.submit([2 + i % 7, 5, 9, 4], max_new_tokens=30,
+                              deadline_s=0.05 if i % 3 == 0 else None)
+                if i % 3 == 1:
+                    r.cancel()
+                reqs.append(r)
+            for r in reqs:
+                try:
+                    r.result(timeout=120)
+                except Exception:
+                    pass
+            assert co.drained(timeout=60)
+            stats = co.stats()
+            assert stats["kv_pool"]["orphan_pages"] == 0
+            for r in reqs:
+                assert r.outcome is not None
+        finally:
+            co.shutdown()
+        assert co.stats()["kv_pool"]["orphan_pages"] == 0
+
+    def test_prefill_queue_bound_sheds(self, model):
+        """Per-role shed semantics: the prefill pool's max_queue bounds
+        prompt admission with QueueFull (-> 429 + Retry-After)."""
+        co = _coordinator(model, max_queue=1)
+        try:
+            shed, admitted = 0, []
+            for i in range(60):
+                try:
+                    admitted.append(co.submit(
+                        [5 + i % 7, 8, 13] + [3] * 40, max_new_tokens=8))
+                except QueueFull as e:
+                    assert e.retry_after > 0
+                    shed += 1
+            assert shed > 0, "bounded prefill queue never shed"
+            for r in admitted:
+                r.result(timeout=300)
+        finally:
+            co.shutdown()
+
+
+class TestFailover:
+    def test_decode_crash_mid_stream_completes_cold(self, model):
+        """A decode worker dying mid-stream re-runs its requests cold on
+        the prefill pool: same seed, token-identical result, no wedged
+        pin, no orphan pages."""
+        eng = _colocated(model)
+        ref = eng.generate_sync([[5, 8, 13]], max_new_tokens=40, seed=3)
+        eng.shutdown()
+        co = _coordinator(model, decode_workers=2,
+                          prefix_cache_bytes=1 << 20)
+        try:
+            r = co.submit([5, 8, 13], max_new_tokens=40, seed=3)
+            active = []
+            for _ in range(500):
+                active = [e for e in co.decode
+                          if e.stats()["active"] > 0]
+                if active:
+                    break
+                time.sleep(0.01)
+            assert active, "stream never reached a decode worker"
+            active[0].shutdown()
+            assert r.result(timeout=120) == ref[0]
+            assert r.outcome == "ok"
+            assert co.drained(timeout=60)
+            stats = co.stats()
+            assert stats["kv_pool"]["orphan_pages"] == 0
+            assert stats.get("prefix_cache", {}).get("pinned", 0) == 0
+        finally:
+            co.shutdown()
+
+    def test_cancelled_request_not_failed_over(self, model):
+        """Client-driven death (cancel) is terminal — no cold re-run."""
+        co = _coordinator(model, decode_workers=1)
+        try:
+            r = co.submit([5, 8, 13], max_new_tokens=40)
+            for _ in range(500):
+                if co.decode[0].stats()["active"] > 0:
+                    break
+                time.sleep(0.01)
+            r.cancel()
+            co.decode[0].shutdown()
+            with pytest.raises(ValueError):
+                r.result(timeout=60)
+            assert r.outcome in ("cancelled", "shutdown")
+        finally:
+            co.shutdown()
+
+
+class TestRoleStats:
+    def test_per_role_scaling_signals(self, model):
+        """Engine stats carry the role and count mid-prefill work as
+        active — the autoscaler's per-role concurrency signal."""
+        co = _coordinator(model)
+        try:
+            assert co.prefill[0].stats()["role"] == "prefill"
+            assert co.decode[0].stats()["role"] == "decode"
+            assert "handoffs" in co.prefill[0].stats()
+        finally:
+            co.shutdown()
+
+    def test_drain_semantics_per_pool(self, model):
+        """Draining the coordinator finishes in-flight work and rejects
+        new prompts at the prefill door."""
+        from kubeflow_tpu.serving.engine import Draining
+
+        co = _coordinator(model)
+        try:
+            r = co.submit([5, 8, 13], max_new_tokens=12)
+            co.drain()
+            with pytest.raises(Draining):
+                co.submit([2, 7], max_new_tokens=4)
+            assert r.result(timeout=120)
+            assert co.drained(timeout=60)
+        finally:
+            co.shutdown()
+
+
+class TestCrossProcessWire:
+    """serialize_handoff/:resume — the separate-predictor-pools path."""
+
+    def test_serialized_resume_token_identical(self, model):
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        ref = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                  max_seq=64)
+        expect = ref.generate(RAGGED, max_new_tokens=6)["ids"]
+        ref.engine.shutdown()
+
+        dec = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                  max_seq=64, role="decode")
+        posts = []
+
+        def post(addr, path, payload, timeout=300.0):
+            posts.append((addr, path))
+            return dec.resume(payload)
+
+        pre = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                  max_seq=64, role="prefill",
+                                  handoff_post=post)
+        try:
+            out = pre.generate(RAGGED, max_new_tokens=6,
+                               decode_peer="decode-pod:1234")
+            assert out["ids"] == expect
+            assert len(posts) == 2
+            assert all(":resume" in p for _, p in posts)
+            # both pools leak-free after the hop
+            assert pre.engine.stats()["kv_pool"]["orphan_pages"] == 0
+            assert dec.engine.stats()["kv_pool"]["orphan_pages"] == 0
+        finally:
+            pre.engine.shutdown()
+            dec.engine.shutdown()
+
+    def test_no_peer_falls_back_colocated(self, model):
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        ref = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                  max_seq=64)
+        expect = ref.generate([[5, 8, 13]], max_new_tokens=6)["ids"]
+        ref.engine.shutdown()
+        pre = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                  max_seq=64, role="prefill")
+        try:
+            out = pre.generate([[5, 8, 13]], max_new_tokens=6)
+            assert out["ids"] == expect
+        finally:
+            pre.engine.shutdown()
+
+    def test_resume_pool_exhaustion_is_shed(self, model):
+        """A decode worker whose pool cannot host the pages sheds with
+        QueueFull (-> 429 upstream -> gateway retries a sibling)."""
+        module, params, cfg = model
+        from kubeflow_tpu.serving import disagg
+
+        dec = ContinuousBatcher(module, params, cfg, max_batch=1,
+                                max_seq=64, kv_pages=2, page_size=16)
+        pre = _coordinator(model)
+        try:
+            # serialize INSIDE the handoff callback, while the state's
+            # page refs are still live
+            bodies = []
+            orig = pre.prefill[0].handoff_fn
+
+            def capture(req, state):
+                bodies.append(disagg.serialize_handoff(state, pre.pool))
+                orig(req, state)
+
+            pre.prefill[0].handoff_fn = capture
+            pre.submit(list(range(2, 50)), max_new_tokens=4).result(60)
+            assert bodies
+            with pytest.raises(QueueFull):
+                disagg.resume_serialized(dec, bodies[0])
+            assert dec.stats()["kv_pool"]["orphan_pages"] == 0
+        finally:
+            pre.shutdown()
+            dec.shutdown()
+
+
+class TestResumeHardening:
+    """Review findings: malformed :resume bodies must 422 without
+    touching the batcher thread or leaking pool pages; a dead decode
+    peer degrades to a local resume, not an error."""
+
+    def _capture_body(self, model, prompt, max_new=6):
+        from kubeflow_tpu.serving import disagg
+
+        co = _coordinator(model)
+        bodies = []
+        orig = co.prefill[0].handoff_fn
+
+        def cap(req, state):
+            bodies.append(disagg.serialize_handoff(state, co.pool))
+            orig(req, state)
+
+        co.prefill[0].handoff_fn = cap
+        expect = co.submit(prompt, max_new_tokens=max_new).result(60)
+        co.shutdown()
+        return bodies[0], expect
+
+    def test_malformed_resume_rejected_without_leak_or_crash(self, model):
+        from kubeflow_tpu.serving import disagg
+
+        body, _ = self._capture_body(model, list(range(2, 40)))
+        dec = _colocated(model, page_size=16)
+        try:
+            free0 = dec.pool.free_count
+            for mutate in (
+                lambda b: b.update(key_chain=[1, 2, 3]),
+                lambda b: b["pages"][0][0]["k"].update(shape=[1, 1, 1]),
+                lambda b: b["pages"][0][0]["k"].update(data="!!notb64"),
+                lambda b: b.update(pages=b["pages"][:1]),
+                lambda b: b.update(generated=[]),
+                lambda b: b.update(generated=[1, 2, 3]),
+                lambda b: b.update(max_new_tokens=10_000),
+            ):
+                import copy
+
+                bad = copy.deepcopy(body)
+                mutate(bad)
+                with pytest.raises(ValueError):
+                    disagg.resume_serialized(dec, bad)
+            assert dec.pool.free_count == free0   # nothing leaked
+            # the engine still serves (batcher never saw the garbage)
+            out = dec.generate_sync([[5, 8, 13]], max_new_tokens=4)
+            assert len(out[0]) == 7
+        finally:
+            dec.shutdown()
+
+    def test_dead_peer_degrades_to_local_resume(self, model):
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        ref = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                  max_seq=64)
+        expect = ref.generate(RAGGED, max_new_tokens=6)["ids"]
+        ref.engine.shutdown()
+
+        def dead_peer(addr, path, payload, timeout=300.0):
+            raise ConnectionRefusedError("decode pod is gone")
+
+        pre = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                  max_seq=64, role="prefill",
+                                  handoff_post=dead_peer)
+        try:
+            out = pre.generate(RAGGED, max_new_tokens=6,
+                               decode_peer="dead:1")
+            assert out["ids"] == expect
+            assert pre.engine.stats()["kv_pool"]["orphan_pages"] == 0
+        finally:
+            pre.engine.shutdown()
+
+    def test_full_decode_worker_still_takes_handoffs(self, model):
+        """A healthy decode worker with zero free slots queues handoffs
+        (its queue drains as streams finish) — the coordinator must not
+        dump the overflow onto the prefill engine's slots."""
+        co = _coordinator(model, max_batch=2, decode_workers=1)
+        try:
+            reqs = [co.submit([3 + i, 5, 9], max_new_tokens=24, seed=i)
+                    for i in range(5)]
+            outs = [r.result(timeout=300) for r in reqs]
+            assert all(len(o) == 3 + 24 for o in outs)
+            # every stream decoded on the decode pool, none colocated
+            assert co.prefill[0].stats()["handoffs"] == 5
+            assert co.stats()["kv_pool"]["orphan_pages"] == 0
+        finally:
+            co.shutdown()

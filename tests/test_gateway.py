@@ -1071,3 +1071,212 @@ def test_connect_failed_backend_ejected_and_traffic_shifts():
         assert not gateway.ejections.contains("127.0.0.1", dead_port)
     finally:
         live.shutdown()
+
+
+# -- disaggregated role-aware routing (ISSUE 12) ------------------------------
+
+def _role_stack(roles):
+    """APIServer + Service 'web' + one Running pod per entry of ``roles``
+    (None = unlabeled/colocated).  No live sockets — these tests exercise
+    the PICK, not the proxy."""
+    from kubeflow_tpu.core.objects import api_object
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    server.create(api_object("Service", "web", "default", spec={
+        "selector": {"app": "web"},
+        "ports": [{"port": 80, "targetPort": 8080}]}))
+    server.create(api_object(
+        "VirtualService", "web", "default",
+        spec={"hosts": ["*"],
+              "http": [{"match": [{"uri": {"prefix": "/web/default/"}}],
+                        "rewrite": {"uri": "/"},
+                        "route": [{"destination": {
+                            "host": "web.default.svc",
+                            "port": {"number": 80}}}]}]}))
+    for i, role in enumerate(roles):
+        labels = {"app": "web"}
+        if role:
+            labels[gw.ROLE_LABEL] = role
+        name = f"pod-{i}"
+        pod = api_object("Pod", name, "default", labels=labels,
+                         spec={"containers": [{"name": "c"}]})
+        server.create(pod)
+        server.patch_status("Pod", name, "default", {
+            "phase": "Running", "podIP": "127.0.0.1",
+            "portMap": {"8080": 9000 + i}})
+    route = gw.match_route(server, "/web/default/x")
+    return server, route
+
+
+def test_role_filter_picks_only_that_role():
+    server, route = _role_stack(["prefill", "decode", "decode"])
+    b = gw.backend_for_route(server, route, "/web/default/x",
+                             role="prefill")
+    assert b.port == 9000 and b.role == "prefill"
+    b = gw.backend_for_route(server, route, "/web/default/x",
+                             role="decode")
+    assert b.role == "decode" and b.port in (9001, 9002)
+
+
+def test_role_request_falls_back_to_colocated_pods():
+    """No pod carries the role: unlabeled pods serve it (rollout safety);
+    pods labeled with a DIFFERENT role never do."""
+    server, route = _role_stack([None, "decode"])
+    b = gw.backend_for_route(server, route, "/web/default/x",
+                             role="prefill")
+    assert b.port == 9000 and b.role is None
+    # only a wrong-role pod left -> NoBackend
+    server2, route2 = _role_stack(["decode"])
+    with pytest.raises(gw.NoBackend):
+        gw.backend_for_route(server2, route2, "/web/default/x",
+                             role="prefill")
+
+
+def test_least_loaded_pick_uses_collector_counts():
+    from kubeflow_tpu.autoscale.metrics import MetricsCollector
+
+    server, route = _role_stack(["decode", "decode", "decode"])
+    coll = MetricsCollector()
+    coll.inc_backend(("127.0.0.1", 9000))
+    coll.inc_backend(("127.0.0.1", 9000))
+    coll.inc_backend(("127.0.0.1", 9001))
+    before = gw.PICKS.get("decode", "least_loaded")
+    b = gw.backend_for_route(server, route, "/web/default/x",
+                             role="decode", collector=coll)
+    assert b.port == 9002          # zero in-flight wins
+    assert gw.PICKS.get("decode", "least_loaded") == before + 1
+
+
+def test_sibling_retry_stays_in_role():
+    """exclude + role: the shed-retry path re-resolves within the role."""
+    server, route = _role_stack(["prefill", "prefill", "decode"])
+    first = gw.backend_for_route(server, route, "/web/default/x",
+                                 role="prefill")
+    alt = gw.backend_for_route(server, route, "/web/default/x",
+                               exclude={(first.host, first.port)},
+                               role="prefill")
+    assert alt.role == "prefill" and alt.port != first.port
+    with pytest.raises(gw.NoBackend):
+        gw.backend_for_route(
+            server, route, "/web/default/x",
+            exclude={(first.host, first.port), (alt.host, alt.port)},
+            role="prefill")
+
+
+def test_pick_counter_labels_role_and_reason():
+    server, route = _role_stack([None])
+    before = gw.PICKS.get("any", "only_candidate")
+    gw.backend_for_route(server, route, "/web/default/x")
+    assert gw.PICKS.get("any", "only_candidate") == before + 1
+
+
+def test_generate_post_gets_decode_peer_header():
+    """The gateway stamps the decode handoff target on :generate POSTs
+    when the route is role-split — observable via the environ the proxy
+    forwards (no live backend needed: inspect after backend pick fails
+    on connect, using a stubbed _proxy)."""
+    server, route = _role_stack(["prefill", "decode"])
+    gateway = gw.Gateway(server, connect_retries=1, retry_delay=0)
+    seen = {}
+
+    def fake_proxy(backend, environ, start_response, *a, **kw):
+        seen["backend"] = (backend.port, backend.role)
+        seen["peer"] = environ.get("HTTP_X_KF_DECODE_PEER")
+        start_response("200 OK", [])
+        return [b"{}"]
+
+    gateway._proxy = fake_proxy
+    import io
+
+    environ = {"REQUEST_METHOD": "POST",
+               "PATH_INFO": "/web/default/v1/models/m:generate",
+               "wsgi.input": io.BytesIO(b"{}"), "CONTENT_LENGTH": "2"}
+    b"".join(gateway(environ, lambda s, h: None))
+    assert seen["backend"] == (9000, "prefill")
+    assert seen["peer"] == "127.0.0.1:9001"
+    # a plain GET is role-less: no peer header
+    environ = {"REQUEST_METHOD": "GET",
+               "PATH_INFO": "/web/default/v1/models/m",
+               "wsgi.input": io.BytesIO(b"")}
+    b"".join(gateway(environ, lambda s, h: None))
+    assert seen["peer"] is None
+
+
+def test_client_supplied_decode_peer_header_is_stripped():
+    """Only the gateway may name the decode peer: a client-sent
+    X-KF-Decode-Peer must never reach the prefill predictor (it would
+    make the predictor POST the serialized prompt KV to an attacker
+    address whenever no decode pool exists — SSRF + KV exfiltration)."""
+    server, route = _role_stack(["prefill"])   # no decode pods
+    gateway = gw.Gateway(server, connect_retries=1, retry_delay=0)
+    seen = {}
+
+    def fake_proxy(backend, environ, start_response, *a, **kw):
+        seen["peer"] = environ.get("HTTP_X_KF_DECODE_PEER")
+        start_response("200 OK", [])
+        return [b"{}"]
+
+    gateway._proxy = fake_proxy
+    import io
+
+    environ = {"REQUEST_METHOD": "POST",
+               "PATH_INFO": "/web/default/v1/models/m:generate",
+               "HTTP_X_KF_DECODE_PEER": "attacker.example:80",
+               "wsgi.input": io.BytesIO(b"{}"), "CONTENT_LENGTH": "2"}
+    b"".join(gateway(environ, lambda s, h: None))
+    assert seen["peer"] is None
+
+
+def test_decode_peer_load_balances_across_pool():
+    """The stamped decode peer counts as in-flight for the request's
+    lifetime (its stream never transits the gateway), so concurrent
+    generates spread across the decode pool instead of all funneling to
+    the first-listed pod."""
+    from kubeflow_tpu.autoscale.metrics import MetricsCollector
+
+    server, route = _role_stack(["prefill", "decode", "decode"])
+    gateway = gw.Gateway(server, connect_retries=1, retry_delay=0,
+                         collector=MetricsCollector())
+    peers = []
+
+    def fake_proxy(backend, environ, start_response, *a, **kw):
+        peers.append(environ.get("HTTP_X_KF_DECODE_PEER"))
+        start_response("200 OK", [])
+        return [b"{}"]
+
+    gateway._proxy = fake_proxy
+    import io
+
+    def call():
+        environ = {"REQUEST_METHOD": "POST",
+                   "PATH_INFO": "/web/default/v1/models/m:generate",
+                   "wsgi.input": io.BytesIO(b"{}"), "CONTENT_LENGTH": "2"}
+        return gateway(environ, lambda s, h: None)
+
+    # hold the first response un-consumed: its peer stays in-flight, so
+    # the second concurrent pick must choose the OTHER decode pod
+    first = call()
+    second = call()
+    assert peers[0] != peers[1]
+    assert {peers[0], peers[1]} == {"127.0.0.1:9001", "127.0.0.1:9002"}
+    b"".join(first)
+    b"".join(second)
+    # counts drain with the streams: a third pick is free to reuse
+    assert gateway.collector.backend_snapshot() == {}
+
+
+def test_ejected_wrong_role_pod_never_serves_the_role():
+    """The ejected-fallback (panic threshold) respects the role filter:
+    a known-bad DECODE pod must not catch prefill traffic — a 503 the
+    caller can retry beats a wrong-role dispatch."""
+    server, route = _role_stack(["decode"])
+    ej = gw.EjectionList()
+    ej.eject("127.0.0.1", 9000)
+    with pytest.raises(gw.NoBackend):
+        gw.backend_for_route(server, route, "/web/default/x",
+                             ejected=ej, role="prefill")
+    # same pod IS the panic fallback for its own role
+    b = gw.backend_for_route(server, route, "/web/default/x",
+                             ejected=ej, role="decode")
+    assert b.port == 9000

@@ -335,3 +335,29 @@ def test_invalid_algorithm_settings_rejected_at_admission(stack):
                 algorithm={"name": "tpe", "settings": bad},
                 parameters=[{"name": "x", "type": "double",
                              "min": 0.0, "max": 1.0}]))
+
+
+def test_tpe_boundary_draws_never_atom_at_the_walls():
+    """Out-of-range Parzen draws REFLECT into the unit cube instead of
+    clamping: clamping created probability atoms exactly at min/max, and
+    two trials whose draws both fell outside decoded to byte-identical
+    boundary assignments (a flaky violation of the distinct-assignments
+    contract above)."""
+    from kubeflow_tpu.hpo.suggestion import TPE, _reflect
+
+    assert _reflect(-0.3) == 0.3
+    assert _reflect(1.4) == pytest.approx(0.6)
+    assert _reflect(0.5) == 0.5
+    # history clustered hard against the lower wall: suggestions must
+    # still never collide exactly on the boundary across many indices
+    space = SearchSpace([{"name": "lr", "type": "double",
+                          "min": 1e-4, "max": 1e-1}])
+    history = [({"lr": 1e-4}, 0.1), ({"lr": 1.2e-4}, 0.2),
+               ({"lr": 1.1e-4}, 0.15), ({"lr": 5e-2}, 0.9),
+               ({"lr": 8e-2}, 0.95)]
+    seen = []
+    for idx in range(5, 40):
+        s = TPE(space, seed=0, maximize=False, n_initial=3)
+        seen.append(s.suggest(history, index=idx)["lr"])
+    at_min = sum(1 for v in seen if v == 1e-4)
+    assert at_min <= 1, (at_min, seen)

@@ -118,3 +118,30 @@ def test_two_process_gang_decode_matches_single_process(reference):
     assert {o["rank"] for o in outs} == {0, 1}
     assert outs[0]["ids"] == outs[1]["ids"]
     assert outs[0]["ids"] == reference[:2]
+
+
+def test_pad_bucket_ladder_reuses_compiled_executables():
+    """ISSUE 12 satellite: a dominating already-compiled executable
+    serves smaller requests (rows/prompt pad up, decode tail slices
+    back) instead of compiling one program per pow2 rung — and the
+    padded-reuse results are bitwise the exact-bucket results."""
+    from kubeflow_tpu.serving.multihost import MultiHostPredictor
+
+    p = MultiHostPredictor("llama", size="tiny", tp=1, dp=1, max_seq=96)
+    long_prompt = list(range(1, 15))        # pads to 16
+    ref_long = p.generate([long_prompt], max_new_tokens=8)
+    assert len(p._gen_cache) == 1
+    # shorter prompts / smaller max_new ride the compiled program
+    ref_short = p.generate([[1, 2, 3, 4]], max_new_tokens=8)
+    ref_mid = p.generate([[1, 2, 3, 4, 5, 6, 7, 8, 9, 10]],
+                         max_new_tokens=4)
+    assert len(p._gen_cache) == 1, "pad-bucket ladder recompiled"
+
+    # exact-bucket reference: a fresh predictor compiles per rung and
+    # must produce identical streams
+    q = MultiHostPredictor("llama", size="tiny", tp=1, dp=1, max_seq=96)
+    assert q.generate([[1, 2, 3, 4]], max_new_tokens=8) == ref_short
+    assert q.generate([[1, 2, 3, 4, 5, 6, 7, 8, 9, 10]],
+                      max_new_tokens=4) == ref_mid
+    assert q.generate([long_prompt], max_new_tokens=8) == ref_long
+    assert len(q._gen_cache) > 1

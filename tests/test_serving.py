@@ -326,3 +326,44 @@ def test_classifier_predictor_restores_checkpoint(tmp_path):
     assert np.allclose(np.asarray(a), np.asarray(b))
     out = restored.predict(np.zeros((1, 28, 28, 1)).tolist())
     assert len(out["predictions"]) == 1
+
+
+def test_inferenceservice_role_annotation_wires_through():
+    """serving.kubeflow.org/role -> --role CLI flag + pod template label
+    (the gateway's role-aware picker reads the label off the pods)."""
+    server = APIServer()
+    mgr = Manager(server)
+    register(server, mgr)
+    workloads.register(server, mgr)
+    mgr.add(FakeExecutor(server, complete=False))
+    mgr.start()
+    try:
+        isvc = api.new("llm-prefill", "serving", role="prefill",
+                       kv_quant=True)
+        server.create(isvc)
+        assert mgr.wait_idle(timeout=15)
+        dep = server.get("Deployment", "llm-prefill", "serving")
+        cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert "--role" in cmd and "prefill" in cmd
+        assert "--kv-quant" in cmd
+        labels = dep["spec"]["template"]["metadata"]["labels"]
+        assert labels["serving.kubeflow.org/role"] == "prefill"
+    finally:
+        mgr.stop()
+
+
+def test_inferenceservice_role_annotation_validated():
+    server = APIServer()
+    mgr = Manager(server)
+    register(server, mgr)
+    try:
+        bad = api.new("x", "serving")
+        bad["metadata"]["annotations"] = {api.ROLE_ANNOTATION: "both"}
+        with pytest.raises(ValueError, match="role"):
+            server.create(bad)
+        bad2 = api.new("y", "serving")
+        bad2["metadata"]["annotations"] = {api.KV_QUANT_ANNOTATION: "maybe"}
+        with pytest.raises(ValueError, match="boolean"):
+            server.create(bad2)
+    finally:
+        mgr.stop()
