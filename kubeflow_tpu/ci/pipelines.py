@@ -198,6 +198,21 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         # overhead budget (KF_SKIP_TRACE=1 opts out on constrained hosts)
         "trace_cmd": [sys.executable, "loadtest/load_trace.py", "--smoke"],
     },
+    "scale": {
+        "include_dirs": ["kubeflow_tpu/core/watchcache.py",
+                         "kubeflow_tpu/core/kubeclient.py",
+                         "loadtest/load_scale.py"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+                     "tests/test_watchcache.py"],
+        # control-plane-scale smoke: a reduced-N version of the
+        # 100k-pod/5k-gang churn — asserts the p99 reconcile budget,
+        # state digests identical across apiserver replica counts and
+        # worker sweeps, paginated full-kind lists that scan the store
+        # roughly once (not once per page), and watch resume replaying
+        # the exact event sequence a continuous watcher saw.
+        # KF_SKIP_SCALE=1 opts out on constrained hosts.
+        "scale_cmd": [sys.executable, "loadtest/load_scale.py", "--smoke"],
+    },
     "analysis": {
         # the analyzer's own component: its unit tests plus the
         # full-tree sweep (which every other component also runs as
@@ -265,6 +280,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "trace_cmd" in spec:
         steps.append({"name": "trace", "run": spec["trace_cmd"],
                       "depends": ["test"]})
+    if "scale_cmd" in spec:
+        steps.append({"name": "scale", "run": spec["scale_cmd"],
+                      "depends": ["test"]})
     if spec.get("image"):
         # kaniko executor (the reference's builder): --no-push is the
         # presubmit mode (ci/notebook_servers pattern)
@@ -323,6 +341,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "trace_cmd" in spec
                 and os.environ.get("KF_SKIP_TRACE") != "1"):
             ok = subprocess.run(spec["trace_cmd"]).returncode == 0
+        if (ok and "scale_cmd" in spec
+                and os.environ.get("KF_SKIP_SCALE") != "1"):
+            ok = subprocess.run(spec["scale_cmd"]).returncode == 0
         results[name] = ok
     return results
 

@@ -20,10 +20,12 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Callable
 from urllib.parse import parse_qs
 
 from kubeflow_tpu.core.store import APIServer, Conflict, Invalid, NotFound
+from kubeflow_tpu.core.watchcache import ResourceExpired
 # one definition of the correlation id for every hop: the client's
 # X-Request-Id when sent (the gateway forwards it), a fresh one
 # otherwise — echoed on every response and stamped into the access-log
@@ -86,6 +88,12 @@ class RestAPI:
             status, body = "404 Not Found", {"error": str(e)}
         except Conflict as e:
             status, body = "409 Conflict", {"error": str(e)}
+        except ResourceExpired as e:
+            # k8s 410 Gone: the resourceVersion / continue token points
+            # below the retained window — the client relists
+            status, body = "410 Gone", {"error": str(e),
+                                        "currentResourceVersion":
+                                        e.current_rv}
         except (Invalid, ValueError) as e:
             status, body = "422 Unprocessable Entity", {"error": str(e)}
         except PermissionError as e:
@@ -158,6 +166,23 @@ class RestAPI:
             if method == "GET":
                 self._authz(user, "list", kind, qs.get("namespace",
                                                        [None])[0])
+                try:
+                    limit = int(qs.get("limit", ["0"])[0] or 0)
+                except ValueError:
+                    raise Invalid("limit must be an integer") from None
+                cont = qs.get("continue", [None])[0]
+                if limit > 0 or cont:
+                    items, token, rv = self._list_page(
+                        kind, namespace=qs.get("namespace", [None])[0],
+                        label_selector=_selector_from_query(qs),
+                        limit=limit, continue_=cont)
+                    if version:
+                        items = [self._downconvert(o, version)
+                                 for o in items]
+                    return "200 OK", {
+                        "items": items,
+                        "metadata": {"resourceVersion": str(rv),
+                                     "continue": token or ""}}
                 items = self.server.list(
                     kind, namespace=qs.get("namespace", [None])[0],
                     label_selector=_selector_from_query(qs))
@@ -210,17 +235,41 @@ class RestAPI:
                 return "200 OK", {"status": "deleted"}
         raise NotFound(f"no route {method} {path}")
 
+    # seconds of idle stream between BOOKMARK events (tests shrink it)
+    BOOKMARK_INTERVAL = 1.0
+
     def _watch_stream(self, environ, start_response):
         """GET /apis/watch?kinds=A,B&namespace=ns — long-lived response
         streaming one JSON line per WatchEvent (the k8s watch verb for
         out-of-process controllers, SURVEY §1 L1).  Heartbeat lines ("{}")
-        every 0.5s keep the pipe alive and surface client disconnects."""
+        every 0.5s keep the pipe alive and surface client disconnects.
+
+        ``?resourceVersion=N`` resumes from the watch cache's event
+        window (replaying everything after N, 410 Gone when N fell below
+        the window); ``?allowWatchBookmarks=true`` interleaves periodic
+        BOOKMARK events carrying only the current resourceVersion, so an
+        idle watcher's resume point advances without object payloads."""
         qs = parse_qs(environ.get("QUERY_STRING", ""))
         rid = request_id(environ)
         raw_kinds = qs.get("kinds", [None])[0]
         kinds = ([k for k in raw_kinds.split(",") if k]
                  if raw_kinds else None)
         namespace = qs.get("namespace", [None])[0]
+        bookmarks = (qs.get("allowWatchBookmarks", ["false"])[0].lower()
+                     == "true")
+        raw_rv = qs.get("resourceVersion", [None])[0]
+
+        def _refuse(status: str, message: str, **extra):
+            payload = json.dumps({"error": message, **extra}).encode()
+            HTTP_REQS.labels("GET", status.split()[0]).inc()
+            log.info("http access", method="GET", path="/apis/watch",
+                     code=status.split()[0], request_id=rid)
+            start_response(status,
+                           [("Content-Type", "application/json"),
+                            ("Content-Length", str(len(payload))),
+                            ("X-Request-Id", rid)])
+            return [payload]
+
         # every requested kind must be authorized — a single-kind check
         # would let ?kinds=Allowed,Secret stream Secrets (advisor r3)
         try:
@@ -228,31 +277,73 @@ class RestAPI:
             for kind in (kinds or ["*"]):
                 self._authz(user, "watch", kind, namespace)
         except PermissionError as e:
-            payload = json.dumps({"error": str(e)}).encode()
-            HTTP_REQS.labels("GET", "403").inc()
-            log.info("http access", method="GET", path="/apis/watch",
-                     code="403", request_id=rid)
-            start_response("403 Forbidden",
-                           [("Content-Type", "application/json"),
-                            ("Content-Length", str(len(payload))),
-                            ("X-Request-Id", rid)])
-            return [payload]
-        watch = self.server.watch(kinds=kinds, namespace=namespace)
+            return _refuse("403 Forbidden", str(e))
+        try:
+            resume_rv = int(raw_rv) if raw_rv else None
+        except ValueError:
+            return _refuse("422 Unprocessable Entity",
+                           "resourceVersion must be an integer")
+        if bookmarks and getattr(self.server, "watch_cache",
+                                 "absent") is None:
+            # a bookmark-requesting client intends to RESUME later: start
+            # recording the window now, or every bookmark it saves points
+            # below the (resume-time) attach floor and answers 410
+            from kubeflow_tpu.core import watchcache
+
+            watchcache.attach(self.server)
+        cache = getattr(self.server, "watch_cache", None)
+        try:
+            if resume_rv is not None:
+                watch = self.server.watch(kinds=kinds, namespace=namespace,
+                                          resource_version=resume_rv)
+            elif bookmarks and cache is not None:
+                # bookmark streams ride the cache watch even without a
+                # resume point: safe_resume_rv needs the commit-ordered
+                # queue to certify "everything <= rv was delivered"
+                watch = cache.watch(kinds=kinds, namespace=namespace)
+            else:
+                watch = self.server.watch(kinds=kinds, namespace=namespace)
+        except ResourceExpired as e:
+            # same 410 contract as the JSON API: tell the client where
+            # to re-anchor without an extra list round-trip
+            return _refuse("410 Gone", str(e),
+                           currentResourceVersion=e.current_rv)
+        # bookmarks only when they are provably safe for THIS stream: a
+        # global-rv bookmark can outrun a queued-but-unsent event and a
+        # resume from it would skip that event forever
+        mark_fn = (cache.safe_resume_rv
+                   if bookmarks and cache is not None
+                   and hasattr(watch, "start_rv") else None)
         log.info("http access", method="GET", path="/apis/watch",
                  code="200", request_id=rid)
         start_response("200 OK",
                        [("Content-Type", "application/jsonl"),
                         ("Cache-Control", "no-cache"),
                         ("X-Request-Id", rid)])
+        interval = self.BOOKMARK_INTERVAL
 
         def stream():
+            last_mark = time.monotonic()
             try:
                 while True:
                     ev = watch.next(timeout=0.5)
                     if ev is None:
+                        now = time.monotonic()
+                        if (mark_fn is not None
+                                and now - last_mark >= interval):
+                            mark = mark_fn(watch)
+                            if mark is not None:
+                                last_mark = now
+                                yield (json.dumps(
+                                    {"type": "BOOKMARK",
+                                     "object": {"metadata": {
+                                         "resourceVersion": str(mark)}}})
+                                    .encode() + b"\n")
+                                continue
                         yield b"{}\n"  # heartbeat; write fails on a dead
                         # client and tears the watch down
                         continue
+                    last_mark = time.monotonic()
                     yield (json.dumps({"type": ev.type,
                                        "object": ev.object})
                            .encode() + b"\n")
@@ -260,6 +351,14 @@ class RestAPI:
                 watch.stop()
 
         return stream()
+
+    def _list_page(self, kind: str, **kw):
+        """Consistent paginated list through the server's watch cache
+        (attached on demand); a ControlPlaneRouter/FollowerCache server
+        brings its own list_page."""
+        from kubeflow_tpu.core import watchcache
+
+        return watchcache.list_page_fn(self.server)(kind, **kw)
 
     def _downconvert(self, obj: dict, version: str) -> dict:
         from kubeflow_tpu.api import versions

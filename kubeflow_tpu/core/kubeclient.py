@@ -16,8 +16,12 @@ processes run wherever the executor agent does (how a TPU-VM node agent
 would join the control plane).
 
 Watch resilience (the informer contract controller-runtime gets for free):
-a broken watch connection RECONNECTS with backoff, then re-LISTs the
-watched kinds (a kind-filterless watch enumerates the server's kinds via
+a broken watch connection RECONNECTS with backoff and RESUMES from the
+last observed resourceVersion (the server's watch cache replays the gap;
+periodic BOOKMARK events keep the resume point fresh while idle).  When
+the server answers 410 Gone — the gap fell below the retained window —
+the client falls back to the full re-LIST of the watched kinds,
+auto-paginated (a kind-filterless watch enumerates the server's kinds via
 GET /apis discovery, so the resync never silently skips the gap) and
 synthesizes MODIFIED events for every live object (so level-triggered
 controllers re-converge anything that changed during the gap) and DELETED
@@ -52,6 +56,7 @@ from kubeflow_tpu.core.store import (
     WatchEvent,
     _match_fields,
 )
+from kubeflow_tpu.core.watchcache import ResourceExpired
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import REGISTRY
 
@@ -62,6 +67,11 @@ WATCH_CONNECTED = REGISTRY.gauge(
     "number of currently-connected watch streams in this process")
 WATCH_RECONNECTS = REGISTRY.counter(
     "kubeclient_watch_reconnects_total", "watch stream reconnections")
+WATCH_RESUMES = REGISTRY.counter(
+    "kubeclient_watch_resumes_total",
+    "reconnect resume attempts by outcome: resumed = the server replayed "
+    "the gap from its watch cache (no relist); expired = 410, fell back "
+    "to the full relist", labels=("outcome",))
 _GAUGE_LOCK = threading.Lock()
 _CONNECTED_COUNT = 0
 
@@ -119,6 +129,8 @@ class KubeStore:
                 raise NotFound(detail or path)
             if e.code == 409:
                 raise Conflict(detail or path)
+            if e.code == 410:
+                raise ResourceExpired(detail or path)
             if e.code == 422:
                 raise Invalid(detail or path)
             if e.code == 403:
@@ -138,9 +150,7 @@ class KubeStore:
         return self._req(
             "GET", f"/apis/{kind}/{self._ns_seg(namespace)}/{name}")
 
-    def list(self, kind: str, namespace: str | None = None,
-             label_selector: dict | None = None,
-             field_match: dict | None = None) -> list[dict]:
+    def _list_query(self, namespace, label_selector) -> list[str]:
         query = []
         if namespace is not None:
             query.append(f"namespace={namespace}")
@@ -148,8 +158,60 @@ class KubeStore:
             match = label_selector.get("matchLabels", label_selector)
             sel = ",".join(f"{k}={v}" for k, v in match.items())
             query.append(f"labelSelector={sel}")
+        return query
+
+    def list_page(self, kind: str, namespace: str | None = None,
+                  label_selector: dict | None = None,
+                  limit: int = 0, continue_: str | None = None,
+                  ) -> tuple[list[dict], str | None, str | None]:
+        """One page of a paginated LIST: (items, continue token or None,
+        list resourceVersion).  A stale token raises ResourceExpired —
+        restart the list (k8s 410-on-continue semantics)."""
+        from urllib.parse import quote
+
+        query = self._list_query(namespace, label_selector)
+        if limit:
+            query.append(f"limit={int(limit)}")
+        if continue_:
+            query.append(f"continue={quote(continue_, safe='')}")
         q = ("?" + "&".join(query)) if query else ""
-        items = self._req("GET", f"/apis/{kind}{q}")["items"]
+        resp = self._req("GET", f"/apis/{kind}{q}")
+        meta = resp.get("metadata") or {}
+        return (resp["items"], meta.get("continue") or None,
+                meta.get("resourceVersion"))
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict | None = None,
+             field_match: dict | None = None,
+             limit: int | None = None) -> list[dict]:
+        """Full LIST.  With ``limit`` the client auto-paginates — the
+        server serves consistent ``limit``-sized pages off one pinned
+        snapshot instead of shipping the whole kind in one response; a
+        mid-pagination ResourceExpired (pin evicted) restarts the list
+        from the beginning, so the caller always gets one self-consistent
+        result set."""
+        if limit:
+            for attempt in (0, 1):
+                items: list[dict] = []
+                cont: str | None = None
+                try:
+                    while True:
+                        page, cont, _ = self.list_page(
+                            kind, namespace=namespace,
+                            label_selector=label_selector,
+                            limit=limit, continue_=cont)
+                        items.extend(page)
+                        if not cont:
+                            break
+                except ResourceExpired:
+                    if attempt:
+                        raise
+                    continue  # pin evicted mid-walk: restart once
+                break
+        else:
+            query = self._list_query(namespace, label_selector)
+            q = ("?" + "&".join(query)) if query else ""
+            items = self._req("GET", f"/apis/{kind}{q}")["items"]
         if field_match:
             items = [o for o in items if _match_fields(o, field_match)]
         return items
@@ -230,6 +292,9 @@ class _HttpWatch:
     """
 
     RECONNECT_DELAYS = (0.2, 0.5, 1.0, 2.0, 5.0)
+    # page size for the reconnect re-list: the server serves consistent
+    # pages off one pinned snapshot instead of one huge response
+    RELIST_PAGE = 500
 
     def __init__(self, store: KubeStore, kinds, namespace):
         self._kinds = sorted(set(kinds)) if kinds else None
@@ -239,10 +304,16 @@ class _HttpWatch:
             query.append("kinds=" + ",".join(self._kinds))
         if namespace:
             query.append(f"namespace={namespace}")
-        self._query = ("?" + "&".join(query)) if query else ""
+        # bookmarks keep the resume point advancing while the watch idles
+        query.append("allowWatchBookmarks=true")
+        self._query = "?" + "&".join(query)
         self._store = store
         self._queue: queue.Queue = queue.Queue()
         self._stopped = threading.Event()
+        # newest resourceVersion observed (events + BOOKMARKs): the
+        # reconnect resume point.  None = never connected with a cacheable
+        # position; reconnects fall back to the full re-list.
+        self._resume_rv: int | None = None
         # key -> last-seen metadata for every object this watch observed
         # alive: the baseline that lets a post-reconnect re-list
         # synthesize DELETED for vanished objects.  Metadata (labels,
@@ -256,9 +327,12 @@ class _HttpWatch:
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 
-    def _connect(self):
+    def _connect(self, resume: bool = False):
+        query = self._query
+        if resume and self._resume_rv is not None:
+            query += f"&resourceVersion={self._resume_rv}"
         r = urllib.request.Request(
-            self._store.base_url + "/apis/watch" + self._query)
+            self._store.base_url + "/apis/watch" + query)
         self._store._headers(r)
         return self._store._open(r)  # no timeout: long-lived stream
 
@@ -276,7 +350,16 @@ class _HttpWatch:
             self._known[key] = {
                 k: md[k] for k in ("namespace", "name", "uid", "labels",
                                    "ownerReferences") if k in md}
+        self._note_rv(ev.object)
         self._queue.put(ev)
+
+    def _note_rv(self, obj: dict) -> None:
+        try:
+            rv = int(obj.get("metadata", {}).get("resourceVersion"))
+        except (TypeError, ValueError):
+            return  # synthesized re-list events carry no rv
+        if self._resume_rv is None or rv > self._resume_rv:
+            self._resume_rv = rv
 
     def _pump(self) -> None:
         while not self._stopped.is_set():
@@ -288,6 +371,10 @@ class _HttpWatch:
                     if not line or line == b"{}":  # heartbeat
                         continue
                     rec = json.loads(line)
+                    if rec["type"] == "BOOKMARK":
+                        # resume point only — no object payload to emit
+                        self._note_rv(rec.get("object") or {})
+                        continue
                     self._emit(WatchEvent(rec["type"], rec["object"]))
             except (OSError, ValueError):
                 pass  # fall through to the reconnect decision below
@@ -310,15 +397,37 @@ class _HttpWatch:
             WATCH_CONNECTED.set(_CONNECTED_COUNT)
 
     def _reconnect(self) -> bool:
-        """Reopen the stream (backoff, forever until stop()), then re-list
-        and synthesize sync/delete events.  Ordering: the new watch opens
-        BEFORE the re-list so no event in between is lost — duplicates are
-        harmless under level-triggered reconcile."""
+        """Reopen the stream (backoff, forever until stop()).
+
+        RESUME first: reconnect with ``resourceVersion=<last seen>`` so
+        the server replays the gap from its watch cache — no re-list, no
+        synthesized events, the stream is exact.  Only when the server
+        answers 410 Gone (the gap fell below the window) fall back to the
+        informer re-list: synthesize MODIFIED for everything alive and
+        DELETED for objects that vanished.  Ordering: the new watch opens
+        BEFORE the re-list so no event in between is lost — duplicates
+        are harmless under level-triggered reconcile."""
         attempt = 0
+        resumed = False
         while not self._stopped.is_set():
             try:
-                self._resp = self._connect()
+                self._resp = self._connect(resume=True)
+                resumed = self._resume_rv is not None
                 break
+            except urllib.error.HTTPError as e:
+                if e.code == 410 and self._resume_rv is not None:
+                    # the window aged past our position: relist instead.
+                    # No backoff — the server is up, it just said so.
+                    WATCH_RESUMES.labels("expired").inc()
+                    log.warning("watch resume expired; falling back to "
+                                "re-list", rv=self._resume_rv)
+                    self._resume_rv = None
+                    continue
+                delay = self.RECONNECT_DELAYS[
+                    min(attempt, len(self.RECONNECT_DELAYS) - 1)]
+                attempt += 1
+                if self._stopped.wait(delay):
+                    return False
             except (OSError, urllib.error.URLError):
                 delay = self.RECONNECT_DELAYS[
                     min(attempt, len(self.RECONNECT_DELAYS) - 1)]
@@ -329,7 +438,13 @@ class _HttpWatch:
             return False
         WATCH_RECONNECTS.inc()
         self._mark_connected(True)
-        log.info("watch stream reconnected", attempts=attempt + 1)
+        log.info("watch stream reconnected", attempts=attempt + 1,
+                 resumed=resumed)
+        if resumed:
+            # the server replays the missed events in-stream: the gap is
+            # covered exactly, no re-list needed
+            WATCH_RESUMES.labels("resumed").inc()
+            return True
         alive: set[tuple] = set()
         try:
             if self._kinds is None:
@@ -342,16 +457,34 @@ class _HttpWatch:
             else:
                 relist = set(self._kinds)
             for kind in sorted(relist):
-                try:
-                    objs = self._store.list(kind,
-                                            namespace=self._namespace)
-                except NotFound:
-                    continue  # kind emptied between discovery and list
+                for attempt in (0, 1, 2):
+                    try:
+                        # auto-paginated: consistent pages off one pinned
+                        # snapshot instead of one whole-kind response
+                        objs = self._store.list(kind,
+                                                namespace=self._namespace,
+                                                limit=self.RELIST_PAGE)
+                        break
+                    except NotFound:
+                        objs = []  # kind emptied between discovery + list
+                        break
+                    except ResourceExpired:
+                        # pin evicted mid-walk TWICE (list() already
+                        # retried once) — heavy churn; restart this kind,
+                        # never let the error kill the pump thread
+                        if attempt == 2:
+                            raise
                 for obj in objs:
                     alive.add(self._key(obj))
                     self._emit(WatchEvent("MODIFIED", obj))
         except (OSError, urllib.error.URLError, NotFound):
             # server flapping again: the pump loop will land back here
+            return True
+        except ResourceExpired as e:
+            # churn outran every retry: the stream itself is up, so keep
+            # pumping — but the gap sync is lost and must be visible
+            log.error("watch re-list kept expiring; events during the "
+                      "gap are lost", error=str(e))
             return True
         except PermissionError as e:
             # list permission denied (rotated token, watch-but-not-list

@@ -503,7 +503,7 @@ def _snapshot_objects(data_dir: str, io: FileIO) -> list[dict]:
 
 
 def _load_records(data_dir: str, io: FileIO | None = None):
-    """Yield ("put", obj) / ("del", key) from snapshot (with ``.bak``
+    """Yield ("put", obj) / ("del", (key, rv)) from snapshot (with ``.bak``
     fallback), then any rotated WAL segments (a crash can leave them
     mid-compaction; replaying records the snapshot already holds is
     idempotent), then the live WAL.  Only the LAST existing log may end in
@@ -518,7 +518,8 @@ def _load_records(data_dir: str, io: FileIO | None = None):
             if rec.get("op") == "put":
                 yield "put", rec["obj"]
             elif rec.get("op") == "del":
-                yield "del", tuple(rec["key"])
+                # legacy records predate the rv field (treated as rv 0)
+                yield "del", (tuple(rec["key"]), int(rec.get("rv", 0)))
 
 
 def _journal_view(obj: dict) -> dict:
@@ -572,7 +573,11 @@ class Persister:
         if op == "put":
             rec = {"op": "put", "obj": _journal_view(payload)}
         else:
-            rec = {"op": "del", "key": list(payload)}
+            # (key, rv): the delete CONSUMED an rv; recovery must rebuild
+            # the counter past it or post-restart writes reuse rvs that
+            # watch clients already hold as resume points
+            key, rv = payload
+            rec = {"op": "del", "key": list(key), "rv": rv}
         if self.degraded:
             # the mutation already committed in memory and will be
             # acknowledged; dropping the record would silently lose
@@ -851,7 +856,9 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
                 except (TypeError, ValueError):
                     pass
             else:
-                objects.pop(payload, None)
+                key, del_rv = payload
+                objects.pop(key, None)
+                max_rv = max(max_rv, del_rv)
         # -- orphan GC (k8s background garbage collection's role): a crash
         # between an owner's journaled delete and its children's leaves
         # children referencing a dead uid; replaying them would resurrect
@@ -875,6 +882,11 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
             server._objects.update(objects)
             server._rebuild_index()
             server._rv = max(server._rv, max_rv)
+            if server.watch_cache is not None:
+                # the replay bypassed the commit stream: a watch cache
+                # attached before recovery must not claim it can replay
+                # across the gap (resumes below here answer 410)
+                server.watch_cache._reset(server._rv)
 
         persister = Persister(server, data_dir, fsync=fsync,
                               compact_bytes=compact_bytes,

@@ -16,9 +16,12 @@ semantics the controllers rely on:
 
 Thread-safe; controllers and web backends share one instance in-process, and
 core.httpapi exposes the same store over REST for out-of-process clients.
-Reads (get/list/project/count) run lock-free against copy-on-write per-kind
-snapshots published by writers (the apiserver watch-cache model), so the
-read path scales with concurrent reconcile workers.
+Reads (get/list/project/count) run lock-free: point reads hit the live
+per-kind index, scans iterate versioned copy-on-write snapshots rebuilt
+lazily after writes (the apiserver watch-cache model), so the read path
+scales with concurrent reconcile workers and the write path stays O(1) in
+kind size.  core.watchcache layers a resourceVersion-ordered event window
+on top for watch resume, paginated lists, and read replicas.
 """
 
 from __future__ import annotations
@@ -141,7 +144,95 @@ def project_object(obj: dict, split_paths: list[list[str]],
     return row
 
 
-class APIServer:
+def snapshot_match(key: tuple, obj: dict, kind: str,
+                   namespace: str | None, label_selector: dict | None,
+                   fields: list | None) -> bool:
+    """One definition of the LIST filter (namespace scope, label
+    selector, pre-compiled field match) shared by every read surface —
+    APIServer scans, watchcache.FollowerCache replicas, and the
+    paginator's key walk — so replicas can never filter differently
+    from the store they mirror."""
+    if (namespace is not None and kind not in CLUSTER_SCOPED
+            and key[1] != namespace):
+        return False
+    if not ob.match_labels(label_selector, obj["metadata"].get("labels")):
+        return False
+    return fields is None or _fields_ok(obj, fields)
+
+
+def scan_snapshot(snapshot: dict, kind: str, namespace: str | None = None,
+                  label_selector: dict | None = None,
+                  fields: list | None = None):
+    """Yield matching objects (by reference) from a per-kind snapshot."""
+    for key, obj in snapshot.items():
+        if snapshot_match(key, obj, kind, namespace, label_selector,
+                          fields):
+            yield obj
+
+
+class _LazySnapshots:
+    """The versioned lazy-snapshot read path, shared by the APIServer
+    and its follower replicas (both keep ``_lock``/``_gens``/``_kinds``/
+    ``_snapshots`` with identical invariants).  Fast path is lock-free:
+    one tuple read + one generation compare (both atomic under the GIL;
+    entry tuples are immutable).  A stale entry sends the reader through
+    the lock to copy the live index once — so a burst of B writes costs
+    ONE copy at the next read, not B copies at write time.
+    Read-your-writes holds: a writer bumps the generation before
+    returning, so any later read sees the mismatch and rebuilds."""
+
+    def _snapshot_entry(self, kind: str) -> tuple[int, dict[tuple, dict]]:
+        entry = self._snapshots.get(kind)
+        if entry is not None and entry[0] == self._gens.get(kind, 0):
+            return entry
+        with self._lock:
+            gen = self._gens.get(kind, 0)
+            entry = self._snapshots.get(kind)
+            if entry is None or entry[0] != gen:
+                entry = (gen, dict(self._kinds.get(kind, {})))
+                self._snapshots[kind] = entry
+            return entry
+
+    def _snapshot(self, kind: str) -> dict[tuple, dict]:
+        return self._snapshot_entry(kind)[1]
+
+    # the scan surface rides the snapshots, so one definition serves the
+    # APIServer and every follower replica — a filter/sort fix applied
+    # here cannot diverge the replicas
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict | None = None,
+             field_match: dict | None = None) -> list[dict]:
+        fields = _compile_fields(field_match) if field_match else None
+        out = [_jcopy(o) for o in scan_snapshot(
+            self._snapshot(kind), kind, namespace, label_selector, fields)]
+        return sorted(out, key=lambda o: (o["metadata"].get("namespace")
+                                          or "", o["metadata"]["name"]))
+
+    def project(self, kind: str, paths: tuple,
+                namespace: str | None = None,
+                label_selector: dict | None = None,
+                field_match: dict | None = None) -> list[dict]:
+        """LIST that copies ONLY the dotted ``paths`` out of each matching
+        object (k8s PartialObjectMetadata's role) — per-item cost is the
+        selected fields, not the whole object.  Hot-path scans (gang
+        scheduler, quota usage) run every scheduling decision over every
+        pod; full-object copies there were quadratic at 500-gang scale."""
+        split_paths = [p.split(".") for p in paths]
+        fields = _compile_fields(field_match) if field_match else None
+        return [project_object(obj, split_paths) for obj in scan_snapshot(
+            self._snapshot(kind), kind, namespace, label_selector, fields)]
+
+    def count(self, kind: str, namespace: str | None = None,
+              field_match: dict | None = None) -> int:
+        """Count matching objects WITHOUT copying them — for metrics and
+        other read-only tallies (a copying list() per reconcile was the
+        500-notebook quadratic)."""
+        fields = _compile_fields(field_match) if field_match else None
+        return sum(1 for _ in scan_snapshot(
+            self._snapshot(kind), kind, namespace, None, fields))
+
+
+class APIServer(_LazySnapshots):
     def __init__(self) -> None:
         self._lock = threading.RLock()
         # (kind, namespace or "", name) -> object
@@ -150,18 +241,31 @@ class APIServer:
         # the whole store (the flat scan was O(total objects) per list and
         # quadratic under controller load — 500-notebook loadtest)
         self._kinds: dict[str, dict[tuple, dict]] = {}
-        # kind -> immutable {key -> object} snapshot, republished (shallow
-        # dict copy) under the write lock after every mutation of that
-        # kind.  Readers (get/list/project/count) grab the reference
-        # WITHOUT the lock — the apiserver watch-cache's copy-on-write
-        # read path — so N reconcile workers + the gateway + the
-        # dashboard never serialize on the store mutex.  Invariant that
-        # makes this safe: a stored object is never mutated in place
-        # after it lands in a snapshot; writers replace whole objects.
-        self._snapshots: dict[str, dict[tuple, dict]] = {}
+        # kind -> (generation, immutable {key -> object} snapshot).
+        # Readers (list/project/count) grab the entry WITHOUT the lock —
+        # the apiserver watch-cache's copy-on-write read path — so N
+        # reconcile workers + the gateway + the dashboard never serialize
+        # on the store mutex.  Snapshots are VERSIONED and rebuilt
+        # lazily: a write only bumps the kind's generation; the next
+        # reader that sees a stale entry copies the live index once under
+        # the lock (_snapshot_entry).  Eager republish-per-write was
+        # O(kind size) per mutation — quadratic at 100k-pod scale, where
+        # bulk loads and churn write far more often than they list.
+        # Invariant that makes this safe: a stored object is never
+        # mutated in place after it lands in a snapshot; writers replace
+        # whole objects.
+        self._snapshots: dict[str, tuple[int, dict[tuple, dict]]] = {}
         # kind -> mutation generation: lets hot read paths (the gang
         # scheduler's pod scan) memoize "nothing of this kind changed"
         self._gens: dict[str, int] = {}
+        # owner uid -> {keys of objects holding an ownerReference to it}:
+        # cascade delete looks dependents up here in O(children) instead
+        # of scanning every stored object under the lock (that scan was
+        # O(total) per delete — minutes of lock hold at 100k objects
+        # under churn).  _owner_uids remembers what each key contributed
+        # so an update that edits ownerReferences reindexes exactly.
+        self._owned_by: dict[str, set[tuple]] = {}
+        self._owner_uids: dict[tuple, tuple[str, ...]] = {}
         # kind -> {key -> (generation, value)}: the memo() helper's store
         self._memo: dict[str, dict] = {}
         self._rv = 0
@@ -178,6 +282,11 @@ class APIServer:
         # in-process writers keep committing (their records buffer in the
         # persister until the WAL heals, so nothing acknowledged is lost)
         self.degraded = False
+        # resourceVersion-ordered event window (core.watchcache.attach):
+        # when set, every committed mutation is recorded UNDER THE LOCK so
+        # the window's order matches commit order exactly — the substrate
+        # for watch resume, 410 semantics, and read replicas
+        self.watch_cache = None
 
     def _record(self, op: str, payload) -> None:
         if self._journal is None:
@@ -197,13 +306,49 @@ class APIServer:
     def _index_put(self, key: tuple, obj: dict) -> None:
         self._kinds.setdefault(key[0], {})[key] = obj
         self._gens[key[0]] = self._gens.get(key[0], 0) + 1
-        self._publish(key[0])
+        self._index_owners(key, obj)
 
-    def _publish(self, kind: str) -> None:
-        """Republish the kind's read snapshot (called under the write
-        lock).  The shallow dict copy is the entire COW cost — the
-        objects inside are shared and immutable-after-publish."""
-        self._snapshots[kind] = dict(self._kinds.get(kind, {}))
+    def _index_owners(self, key: tuple, obj: dict) -> None:
+        new = tuple(r["uid"] for r in
+                    obj["metadata"].get("ownerReferences", ())
+                    if r.get("uid"))
+        old = self._owner_uids.get(key, ())
+        if new == old:
+            return
+        for uid in old:
+            deps = self._owned_by.get(uid)
+            if deps is not None:
+                deps.discard(key)
+                if not deps:
+                    del self._owned_by[uid]
+        if new:
+            self._owner_uids[key] = new
+            for uid in new:
+                self._owned_by.setdefault(uid, set()).add(key)
+        else:
+            self._owner_uids.pop(key, None)
+
+    def _unindex_owners(self, key: tuple) -> None:
+        for uid in self._owner_uids.pop(key, ()):
+            deps = self._owned_by.get(uid)
+            if deps is not None:
+                deps.discard(key)
+                if not deps:
+                    del self._owned_by[uid]
+
+    def _cache_record(self, etype: str, obj: dict) -> None:
+        """Feed the committed event into the watch cache's window (called
+        under the write lock, AFTER the mutation is final): the window
+        sees events in exact resourceVersion order, which per-watcher
+        queues fed outside the lock cannot guarantee."""
+        wc = self.watch_cache
+        if wc is not None:
+            wc._record(etype, obj)
+
+    def current_rv(self) -> int:
+        """The newest committed resourceVersion (atomic int read) — the
+        resume point watch bookmarks and list pagination hand out."""
+        return self._rv
 
     def kinds(self, namespace: str | None = None) -> list[str]:
         """Kinds with at least one live object — lets a kind-filterless
@@ -253,11 +398,13 @@ class APIServer:
         bulk-loads _objects directly)."""
         self._kinds = {}
         self._memo = {}
+        self._owned_by = {}
+        self._owner_uids = {}
         for key, obj in self._objects.items():
-            # no per-object publish (O(n^2) on bulk load) — once below
             self._kinds.setdefault(key[0], {})[key] = obj
             self._gens[key[0]] = self._gens.get(key[0], 0) + 1
-        self._snapshots = {kind: dict(objs)
+            self._index_owners(key, obj)
+        self._snapshots = {kind: (self._gens[kind], dict(objs))
                            for kind, objs in self._kinds.items()}
 
     # -- helpers --------------------------------------------------------------
@@ -329,80 +476,27 @@ class APIServer:
             self._objects[key] = obj
             self._index_put(key, obj)
             self._record("put", obj)
+            self._cache_record("ADDED", obj)
             out = _jcopy(obj)
         self._emit("ADDED", obj)
         return out
 
     # -- lock-free read path ---------------------------------------------------
-    # Readers resolve the kind's published snapshot (one atomic-under-GIL
-    # dict lookup) and work entirely on it: no store lock held while
-    # matching or copying, so reads scale with reconcile workers instead
-    # of serializing them.
+    # Point reads (get) resolve the LIVE per-kind index directly: two
+    # atomic-under-GIL dict lookups, O(1) regardless of write traffic
+    # (the stored objects are immutable after commit, so the reference a
+    # get races out of a concurrent writer is always internally
+    # consistent).  Scans (list/project/count) iterate a versioned
+    # snapshot — a live dict cannot be iterated while writers mutate it —
+    # rebuilt lazily on first read after a write (_snapshot_entry), so
+    # neither path holds the store lock while matching or copying.
 
     def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
         key = self._key(kind, namespace, name)
-        obj = self._snapshots.get(kind, {}).get(key)
+        obj = self._kinds.get(kind, {}).get(key)
         if obj is None:
             raise NotFound(f"{kind} {namespace}/{name} not found")
         return _jcopy(obj)
-
-    def list(self, kind: str, namespace: str | None = None,
-             label_selector: dict | None = None,
-             field_match: dict | None = None) -> list[dict]:
-        fields = _compile_fields(field_match) if field_match else None
-        out = []
-        for (_, ns, _n), obj in self._snapshots.get(kind, {}).items():
-            if (namespace is not None and kind not in CLUSTER_SCOPED
-                    and ns != namespace):
-                continue
-            if not ob.match_labels(label_selector,
-                                   obj["metadata"].get("labels")):
-                continue
-            if fields is not None and not _fields_ok(obj, fields):
-                continue
-            out.append(_jcopy(obj))
-        return sorted(out, key=lambda o: (o["metadata"].get("namespace")
-                                          or "", o["metadata"]["name"]))
-
-    def project(self, kind: str, paths: tuple,
-                namespace: str | None = None,
-                label_selector: dict | None = None,
-                field_match: dict | None = None) -> list[dict]:
-        """LIST that copies ONLY the dotted ``paths`` out of each matching
-        object (k8s PartialObjectMetadata's role) — per-item cost is the
-        selected fields, not the whole object.  Hot-path scans (gang
-        scheduler, quota usage) run every scheduling decision over every
-        pod; full-object copies there were quadratic at 500-gang scale."""
-        split_paths = [p.split(".") for p in paths]
-        fields = _compile_fields(field_match) if field_match else None
-        out = []
-        for (_, ns, _n), obj in self._snapshots.get(kind, {}).items():
-            if (namespace is not None and kind not in CLUSTER_SCOPED
-                    and ns != namespace):
-                continue
-            if not ob.match_labels(label_selector,
-                                   obj["metadata"].get("labels")):
-                continue
-            if fields is not None and not _fields_ok(obj, fields):
-                continue
-            out.append(project_object(obj, split_paths))
-        return out
-
-    def count(self, kind: str, namespace: str | None = None,
-              field_match: dict | None = None) -> int:
-        """Count matching objects WITHOUT copying them — for metrics and
-        other read-only tallies (a copying list() per reconcile was the
-        500-notebook quadratic)."""
-        fields = _compile_fields(field_match) if field_match else None
-        n = 0
-        for (_, ns, _n), obj in self._snapshots.get(kind, {}).items():
-            if (namespace is not None and kind not in CLUSTER_SCOPED
-                    and ns != namespace):
-                continue
-            if fields is not None and not _fields_ok(obj, fields):
-                continue
-            n += 1
-        return n
 
     @_traced_write("update")
     def update(self, obj: dict) -> dict:
@@ -445,6 +539,7 @@ class APIServer:
             self._objects[key] = obj
             self._index_put(key, obj)
             self._record("put", obj)
+            self._cache_record("MODIFIED", obj)
             finalize = ("deletionTimestamp" in md
                         and not md.get("finalizers"))
             out = _jcopy(obj)
@@ -473,6 +568,7 @@ class APIServer:
             self._objects[key] = obj
             self._index_put(key, obj)
             self._record("put", obj)
+            self._cache_record("MODIFIED", obj)
         self._emit("MODIFIED", obj)
         return _jcopy(obj)
 
@@ -495,6 +591,7 @@ class APIServer:
                     self._objects[key] = marked
                     self._index_put(key, marked)
                     self._record("put", marked)
+                    self._cache_record("MODIFIED", marked)
                 else:
                     return
             else:
@@ -510,19 +607,26 @@ class APIServer:
             obj = self._objects.pop(key, None)
             self._kinds.get(key[0], {}).pop(key, None)
             self._gens[key[0]] = self._gens.get(key[0], 0) + 1
-            self._publish(key[0])
             if obj is None:
                 return
-            self._record("del", key)
+            # the DELETED event carries a FRESH resourceVersion (k8s
+            # semantics): a watch resuming past this rv must not replay
+            # the deletion, and the event window needs a total order.
+            # Copy-then-stamp — readers may still hold the stored object.
+            obj = _jcopy(obj)
+            rv = self._next_rv()
+            obj["metadata"]["resourceVersion"] = rv
+            # the journal carries the consumed rv: recovery rebuilds the
+            # rv counter from the records, and a counter that regressed
+            # below a handed-out resume point would REUSE rvs — a resume
+            # at the old rv would then silently skip the reused one
+            self._record("del", (key, int(rv)))
+            self._cache_record("DELETED", obj)
+            self._unindex_owners(key)
             uid = obj["metadata"]["uid"]
-            # collect dependents for cascade delete
-            dependents = [
-                (o["kind"], o["metadata"].get("namespace"),
-                 o["metadata"]["name"])
-                for o in self._objects.values()
-                if any(r.get("uid") == uid
-                       for r in o["metadata"].get("ownerReferences", []))
-            ]
+            # cascade-delete dependents from the owner index: O(children)
+            dependents = [(k, ns or None, n) for (k, ns, n)
+                          in self._owned_by.get(uid, ())]
         self._emit("DELETED", obj)
         for dkind, dns, dname in dependents:
             try:
@@ -532,7 +636,19 @@ class APIServer:
 
     # -- watch ----------------------------------------------------------------
     def watch(self, kinds: Iterable[str] | None = None,
-              namespace: str | None = None) -> "Watch":
+              namespace: str | None = None,
+              resource_version: int | str | None = None):
+        """Live event stream; with ``resource_version`` the stream first
+        REPLAYS every event after that rv from the watch cache's window
+        (attaching one on demand), raising ``ResourceExpired`` when the
+        window no longer reaches back that far — the informer
+        relist-and-rewatch contract."""
+        if resource_version is not None:
+            from kubeflow_tpu.core import watchcache
+
+            return watchcache.attach(self).watch(
+                kinds=kinds, namespace=namespace,
+                resource_version=resource_version)
         kinds = set(kinds) if kinds else None
 
         def pred(ev: WatchEvent) -> bool:

@@ -1,0 +1,708 @@
+"""Watch-cache control plane: versioned event windows, paginated lists,
+and multi-replica apiservers (ARCHITECTURE decision 20).
+
+The store's copy-on-write snapshots give lock-free reads, but every
+list/watch client still talks to the one store and a reconnecting watcher
+must re-list the world.  This module is the layer real Kubernetes solves
+that with (the apiserver watch cache, staging/src/k8s.io/apiserver
+storage/cacher):
+
+``WatchCache``
+    A bounded, resourceVersion-ordered event window per kind, fed
+    synchronously from the store's commit path (``APIServer._cache_record``
+    runs UNDER the write lock, so window order == commit order).
+    ``watch(resource_version=N)`` replays every retained event after N and
+    then streams live with no gap; when the window no longer reaches back
+    to N it raises :class:`ResourceExpired` (HTTP 410 Gone) and the client
+    relists-and-rewatches — exactly the k8s informer contract.
+
+``list_page``
+    Consistent pagination: the first page pins the kind's immutable
+    snapshot and a sorted key index; every later page bisects into that
+    SAME pin, so a full-kind read costs O(total + pages·log n) instead of
+    pages × O(total), and writes that land mid-pagination are invisible
+    until the next fresh list.  Continue tokens are opaque and
+    HMAC-signed — they encode (origin replica, kind, snapshot generation,
+    last scanned key) and reject tampering; a token whose pin was evicted
+    answers :class:`ResourceExpired` so clients restart the list, the k8s
+    410-on-stale-continue behavior.
+
+``FollowerCache`` / ``ControlPlane``
+    Horizontal read scale: follower replicas mirror the leader store
+    through a replica watch (initial snapshot sync + rv-compared event
+    application) and serve the whole read surface from their own cache;
+    mutations proxy to the leader.  ``ControlPlane`` elects the leader
+    with the platform's lease election (core.controller.acquire_lease)
+    and keeps renewing it; ``gateway.ControlPlaneRouter`` spreads reads
+    across replicas and pins continue tokens to the replica that minted
+    them.
+"""
+
+from __future__ import annotations
+
+import base64
+import bisect
+import hashlib
+import hmac
+import json
+import queue
+import secrets
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+
+from kubeflow_tpu.core.store import (
+    APIServer,
+    Invalid,
+    WatchEvent,
+    _compile_fields,
+    _jcopy,
+    _LazySnapshots,
+    snapshot_match,
+)
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+log = get_logger("watchcache")
+
+WINDOW_SIZE = REGISTRY.gauge(
+    "store_watch_cache_window_size",
+    "retained events in the per-kind watch-cache window", labels=("kind",))
+REPLAYS = REGISTRY.counter(
+    "store_watch_cache_replays_total",
+    "watch resume attempts against the event window by outcome",
+    labels=("outcome",))
+LIST_PAGE_SECONDS = REGISTRY.histogram(
+    "apiserver_list_page_seconds", "paginated list page latency")
+SCANNED = REGISTRY.counter(
+    "apiserver_list_scanned_objects_total",
+    "objects examined by paginated list scans (the does-not-rescan "
+    "counter: a full paginated read should scan ~once, not once per page)")
+
+# lease name the apiserver replica set elects its leader under
+APISERVER_LEASE = "apiserver-leader"
+
+# process-wide token-signing secret: shared by every paginator in the
+# process so the router can read a token's origin replica; pins stay
+# per-replica, so a token presented to the wrong replica still answers
+# ResourceExpired (k8s stale-continue semantics), never wrong data
+_TOKEN_SECRET = secrets.token_bytes(32)
+
+
+class ResourceExpired(Exception):
+    """The requested resourceVersion or continue token points below the
+    retained window (HTTP 410 Gone): the client must relist-and-rewatch
+    (informers) or restart the paginated list from the beginning."""
+
+    def __init__(self, msg: str, current_rv: int | None = None):
+        super().__init__(msg)
+        self.current_rv = current_rv
+
+
+@dataclass
+class CachedEvent:
+    rv: int
+    type: str      # ADDED | MODIFIED | DELETED
+    object: dict   # the committed object (shared reference, immutable)
+
+
+def attach(server: APIServer, window: int = 4096) -> "WatchCache":
+    """Attach (idempotently) a watch cache to the store; events commit
+    into the window from this point on, so a resume below the attach rv
+    answers ResourceExpired — exactly as if the window had aged out.
+    A repeat attach keeps the FIRST window size (resizing would evict or
+    fabricate retention out from under live resume points) and logs when
+    the requested size differs, so a mis-sized attach is visible."""
+    with server._lock:
+        cache = server.watch_cache
+        if cache is None:
+            cache = server.watch_cache = WatchCache(server, window=window)
+        elif cache.window != window:
+            log.warning("watch cache already attached; keeping its window",
+                        attached=cache.window, requested=window)
+        return cache
+
+
+def pager_for(store) -> "_Paginator":
+    """The _Paginator minting ``store``'s continue tokens: the store's
+    own (follower replicas) or the attached watch cache's (APIServer).
+    ONE definition of the fallback rule, shared by the REST layer and
+    the router, so they can never resolve different paginators for the
+    same store."""
+    pager = getattr(store, "pager", None)
+    return pager if pager is not None else attach(store).pager
+
+
+def list_page_fn(store):
+    """The consistent-pagination entry point for any store-like server:
+    its own ``list_page`` (FollowerCache, ControlPlaneRouter) or the
+    attached watch cache's paginator (plain APIServer)."""
+    fn = getattr(store, "list_page", None)
+    return fn if fn is not None else pager_for(store).list_page
+
+
+def continue_origin(token: str) -> str | None:
+    """The replica name embedded in a continue token (None for a token
+    this process did not mint) — the router's stickiness key."""
+    try:
+        return _parse_continue(token)[0]
+    except Invalid:
+        return None
+
+
+def _make_continue(origin: str, kind: str, gen: int, last_key: tuple) -> str:
+    payload = json.dumps([origin, kind, gen, list(last_key)],
+                         separators=(",", ":")).encode()
+    mac = hmac.new(_TOKEN_SECRET, payload, hashlib.sha256).hexdigest()[:24]
+    body = base64.urlsafe_b64encode(payload).decode().rstrip("=")
+    return f"{body}.{mac}"
+
+
+def _parse_continue(token: str) -> tuple[str, str, int, tuple]:
+    try:
+        body, mac = token.split(".", 1)
+        payload = base64.urlsafe_b64decode(body + "=" * (-len(body) % 4))
+        want = hmac.new(_TOKEN_SECRET, payload,
+                        hashlib.sha256).hexdigest()[:24]
+        if not hmac.compare_digest(mac, want):
+            raise ValueError("bad signature")
+        origin, kind, gen, last_key = json.loads(payload)
+        return str(origin), str(kind), int(gen), tuple(last_key)
+    except (ValueError, TypeError, json.JSONDecodeError):
+        raise Invalid("malformed continue token") from None
+
+
+class _Paginator:
+    """Consistent pagination over versioned snapshots.
+
+    ``snapshot_entry(kind) -> (generation, {key: obj})`` supplies the
+    immutable snapshot; the first page of a (kind, generation) sorts its
+    keys once and PINS (snapshot, sorted keys, rv) in a small LRU so
+    continue pages bisect straight to their offset.  The pin holding the
+    snapshot reference is what makes pages consistent under concurrent
+    writes — later mutations produce NEW snapshots and never touch the
+    pinned one."""
+
+    MAX_PINS = 16
+
+    def __init__(self, snapshot_entry, current_rv, origin: str):
+        self._snapshot_entry = snapshot_entry
+        self._current_rv = current_rv
+        self.origin = origin
+        self._pins: OrderedDict[tuple, tuple] = OrderedDict()
+        self._pin_lock = threading.Lock()
+
+    def _get_pin(self, kind: str, gen: int):
+        with self._pin_lock:
+            pin = self._pins.get((kind, gen))
+            if pin is not None:
+                self._pins.move_to_end((kind, gen))
+            return pin
+
+    def _put_pin(self, kind: str, gen: int, pin: tuple) -> None:
+        with self._pin_lock:
+            self._pins[(kind, gen)] = pin
+            self._pins.move_to_end((kind, gen))
+            while len(self._pins) > self.MAX_PINS:
+                self._pins.popitem(last=False)
+
+    def list_page(self, kind: str, namespace: str | None = None,
+                  label_selector: dict | None = None,
+                  field_match: dict | None = None,
+                  limit: int = 0, continue_: str | None = None,
+                  ) -> tuple[list[dict], str | None, int]:
+        """One page: (items, continue token or None, snapshot rv).
+
+        ``limit <= 0`` means unpaginated (k8s limit-unset semantics) and
+        an oversized limit simply exhausts the snapshot — both return a
+        None token."""
+        t0 = time.perf_counter()
+        try:
+            return self._page(kind, namespace, label_selector, field_match,
+                              limit, continue_)
+        finally:
+            LIST_PAGE_SECONDS.observe(time.perf_counter() - t0)
+
+    def _page(self, kind, namespace, label_selector, field_match, limit,
+              continue_):
+        fields = _compile_fields(field_match) if field_match else None
+        if continue_:
+            origin, tkind, gen, last_key = _parse_continue(continue_)
+            if tkind != kind:
+                raise Invalid(
+                    f"continue token is for kind {tkind!r}, not {kind!r}")
+            pin = self._get_pin(kind, gen)
+            if pin is None or origin != self.origin:
+                raise ResourceExpired(
+                    "continue token expired (pinned snapshot evicted); "
+                    "restart the list", current_rv=self._current_rv())
+            snap, keys, rv = pin
+            start = bisect.bisect_right(keys, last_key)
+        else:
+            # rv BEFORE the snapshot: the snapshot then contains every
+            # write up to (at least) rv, so a list-then-watch(rv) client
+            # can only see duplicate replays, never a missed object.
+            # Captured the other way round, a write landing in between
+            # would be absent from the items yet skipped by the replay.
+            rv = self._current_rv()
+            gen, snap = self._snapshot_entry(kind)
+            pin = self._get_pin(kind, gen)
+            if pin is None:
+                # sort outside the pin lock; worst case two concurrent
+                # first pages sort twice and the second insert wins
+                keys = sorted(snap)
+                self._put_pin(kind, gen, (snap, keys, rv))
+            else:
+                snap, keys, rv = pin
+            start = 0
+
+        out: list[dict] = []
+        i, n = start, len(keys)
+        while i < n and not (limit > 0 and len(out) >= limit):
+            key = keys[i]
+            i += 1
+            obj = snap[key]
+            if snapshot_match(key, obj, kind, namespace, label_selector,
+                              fields):
+                out.append(_jcopy(obj))
+        SCANNED.inc(i - start)
+        token = _make_continue(self.origin, kind, gen, keys[i - 1]) \
+            if i < n else None
+        return out, token, rv
+
+
+class WatchCache:
+    """Per-kind resourceVersion-ordered event windows over one store,
+    plus the leader's paginator.  Construct via :func:`attach`."""
+
+    def __init__(self, server: APIServer, window: int = 4096):
+        self._server = server
+        self.window = max(1, window)
+        self._windows: dict[str, deque[CachedEvent]] = {}
+        # kind -> rv of the newest DROPPED event: a resume at rv < floor
+        # may have missed events and must relist.  Kinds with no entry
+        # fall back to the attach rv (everything before attach was
+        # "dropped" by definition).
+        self._floors: dict[str, int] = {}
+        self._attach_rv = server.current_rv()
+        # (pred, queue) fan-out entries; mutated ONLY under the server
+        # lock so subscription is atomic with the commit stream
+        self._subs: list[tuple] = []
+        self.pager = _Paginator(server._snapshot_entry, server.current_rv,
+                                origin="leader")
+
+    # -- commit-side (called under the server's write lock) -------------------
+    def _record(self, etype: str, obj: dict) -> None:
+        kind = obj["kind"]
+        rv = int(obj["metadata"]["resourceVersion"])
+        win = self._windows.get(kind)
+        if win is None:
+            win = self._windows[kind] = deque()
+        win.append(CachedEvent(rv, etype, obj))
+        while len(win) > self.window:
+            self._floors[kind] = win.popleft().rv
+        WINDOW_SIZE.labels(kind).set(len(win))
+        if self._subs:
+            # queues carry the SHARED committed object (immutable after
+            # commit); CacheWatch.next copies at delivery, outside this
+            # lock — W subscribers must not serialize every writer behind
+            # W deep copies inside the commit critical section
+            probe = WatchEvent(etype, obj)
+            for pred, q in self._subs:
+                if pred(probe):
+                    q.put(probe)
+
+    def _reset(self, rv: int) -> None:
+        """A bulk load (WAL replay, snapshot restore) bypassed the commit
+        stream: nothing at or below ``rv`` is replayable any more.  Drop
+        the windows and move the floor up so a resume across the gap
+        answers ResourceExpired instead of silently replaying nothing.
+        Called under the server's write lock."""
+        for kind, win in self._windows.items():
+            if win:
+                WINDOW_SIZE.labels(kind).set(0)
+        self._windows.clear()
+        self._floors.clear()
+        self._attach_rv = rv
+
+    # -- read side -------------------------------------------------------------
+    def floor(self, kind: str) -> int:
+        """Oldest rv a resume of ``kind`` can start from (inclusive)."""
+        return self._floors.get(kind, self._attach_rv)
+
+    def current_rv(self) -> int:
+        return self._server.current_rv()
+
+    def list_page(self, kind: str, **kw):
+        return self.pager.list_page(kind, **kw)
+
+    def watch(self, kinds=None, namespace: str | None = None,
+              resource_version: int | str | None = None) -> "CacheWatch":
+        kindset = set(kinds) if kinds else None
+
+        def pred(ev: WatchEvent) -> bool:
+            if kindset and ev.kind not in kindset:
+                return False
+            if namespace and ev.object["metadata"].get("namespace") not in (
+                    namespace, None):
+                return False
+            return True
+
+        q: queue.Queue = queue.Queue()
+        entry = (pred, q)
+        with self._server._lock:
+            if resource_version is not None:
+                rv = int(resource_version)
+                if rv > self._server.current_rv():
+                    # a resume point from a PREVIOUS store incarnation
+                    # (wiped data dir, restarted rv counter): the gap
+                    # between the client's state and ours is unknowable,
+                    # so replaying nothing would silently desync the
+                    # client forever — force the relist path instead
+                    REPLAYS.labels("expired").inc()
+                    raise ResourceExpired(
+                        f"resourceVersion {rv} is ahead of the store "
+                        f"(current {self._server.current_rv()}); relist",
+                        current_rv=self._server.current_rv())
+                check = (kindset if kindset is not None
+                         else set(self._windows) | set(self._server._kinds))
+                for k in check:
+                    if rv < self.floor(k):
+                        REPLAYS.labels("expired").inc()
+                        raise ResourceExpired(
+                            f"resourceVersion {rv} is older than the "
+                            f"{k} window (floor {self.floor(k)}); relist",
+                            current_rv=self._server.current_rv())
+                evs: list[CachedEvent] = []
+                for k in (kindset if kindset is not None
+                          else list(self._windows)):
+                    win = self._windows.get(k)
+                    if win:
+                        evs.extend(e for e in win if e.rv > rv)
+                evs.sort(key=lambda e: e.rv)
+                # replay INTO the queue before live events can follow it
+                # (we hold the commit lock); shared references only —
+                # CacheWatch.next copies at delivery, so the lock pays
+                # queue puts, never deep copies
+                for e in evs:
+                    wev = WatchEvent(e.type, e.object)
+                    if pred(wev):
+                        q.put(wev)
+                REPLAYS.labels("replayed").inc()
+            self._subs.append(entry)
+            start_rv = self._server.current_rv()
+        return CacheWatch(self, entry, start_rv)
+
+    def _unsubscribe(self, entry) -> None:
+        with self._server._lock:
+            if entry in self._subs:
+                self._subs.remove(entry)
+
+    def safe_resume_rv(self, watch: "CacheWatch") -> int | None:
+        """A resume point that cannot skip events on THIS stream: the
+        store's current rv, read under the commit lock while the watch's
+        queue is verified empty.  Every commit enqueues under that same
+        lock, so an empty queue proves everything at or below the
+        returned rv was already handed to this watcher.  Returns None
+        while events are pending — deliver those first; a bookmark
+        minted from the global rv alone could point PAST an undelivered
+        event and make a later resume skip it forever."""
+        with self._server._lock:
+            if watch._queue.empty():
+                return self._server.current_rv()
+        return None
+
+    def stats(self) -> dict:
+        """Window standing for the dashboard's control-plane card."""
+        with self._server._lock:
+            windows = {k: len(w) for k, w in self._windows.items()}
+            floors = dict(self._floors)
+        return {
+            "attached": True,
+            "window_limit": self.window,
+            "windows": windows,
+            "events_retained": sum(windows.values()),
+            "floors": floors,
+            "attach_rv": self._attach_rv,
+            "current_rv": self._server.current_rv(),
+        }
+
+
+class CacheWatch:
+    """Same surface as ``core.store.Watch``; replay (if any) is already
+    queued ahead of the live stream.  ``start_rv`` is the store rv the
+    live subscription began at.
+
+    Queued events hold the store's committed objects by REFERENCE
+    (immutable after commit); ``next`` hands each consumer its own deep
+    copy at delivery, so the commit path never pays per-subscriber
+    copies under the store lock."""
+
+    def __init__(self, cache: WatchCache, entry, start_rv: int):
+        self._cache = cache
+        self._entry = entry
+        self._queue: queue.Queue = entry[1]
+        self._stopped = False
+        self.start_rv = start_rv
+
+    def next(self, timeout: float | None = None) -> WatchEvent | None:
+        try:
+            ev = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return WatchEvent(ev.type, _jcopy(ev.object))
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._cache._unsubscribe(self._entry)
+
+    def __iter__(self):
+        while not self._stopped:
+            ev = self.next(timeout=0.2)
+            if ev is not None:
+                yield ev
+
+
+class FollowerCache(_LazySnapshots):
+    """A read replica of one leader store: the full read surface
+    (get/list/list_page/project/count/kinds) served from a local mirror
+    fed by a replica watch of the leader's watch cache; every mutation
+    proxies to the leader.  Reads follow the leader within the watch
+    pump's lag — the k8s any-apiserver-may-be-slightly-stale contract.
+    In-process the mirror SHARES object references with the leader
+    (objects are immutable after commit); a cross-host follower would
+    feed the same pump from a KubeStore watch instead.  The scan/filter
+    semantics are the leader's own code (``_LazySnapshots`` +
+    ``scan_snapshot``), not a reimplementation that could drift."""
+
+    def __init__(self, server: APIServer, name: str = "follower"):
+        self.name = name
+        self._server = server
+        self._cache = attach(server)
+        self._lock = threading.RLock()
+        self._kinds: dict[str, dict[tuple, dict]] = {}
+        self._gens: dict[str, int] = {}
+        self._snapshots: dict[str, tuple[int, dict]] = {}
+        self._applied_rv = 0
+        self._stopped = threading.Event()
+        self.pager = _Paginator(self._snapshot_entry, self.current_rv,
+                                origin=name)
+        # subscribe FIRST, then bulk-copy the snapshots: events landing in
+        # between are buffered and the rv compare in _apply makes the
+        # overlap idempotent
+        self._watch = self._cache.watch()
+        for kind in server.kinds():
+            snap = server._snapshot(kind)
+            with self._lock:
+                self._kinds[kind] = dict(snap)
+                self._gens[kind] = self._gens.get(kind, 0) + 1
+        self._applied_rv = self._watch.start_rv
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name=f"{name}-pump")
+        self._thread.start()
+
+    # -- replication -----------------------------------------------------------
+    def _pump(self) -> None:
+        while not self._stopped.is_set():
+            ev = self._watch.next(timeout=0.2)
+            if ev is not None:
+                self._apply(ev)
+
+    def _apply(self, ev: WatchEvent) -> None:
+        obj = ev.object
+        md = obj.get("metadata", {})
+        key = self._server._key(obj["kind"], md.get("namespace"),
+                                md.get("name"))
+        try:
+            rv = int(md.get("resourceVersion") or 0)
+        except ValueError:
+            rv = 0
+        with self._lock:
+            # the bootstrap copy may already contain this event's state
+            # (write landed between subscribe and snapshot); the event is
+            # still PROGRESS — advance _applied_rv before the stale skip
+            # or lag() reads nonzero forever on an idle store
+            if rv > self._applied_rv:
+                self._applied_rv = rv
+            cur = self._kinds.get(obj["kind"], {}).get(key)
+            if cur is not None:
+                cur_rv = int(cur["metadata"].get("resourceVersion") or 0)
+                if rv <= cur_rv:
+                    return  # stale replay of a state the sync already has
+            if ev.type == "DELETED":
+                self._kinds.get(obj["kind"], {}).pop(key, None)
+            else:
+                self._kinds.setdefault(obj["kind"], {})[key] = obj
+            self._gens[obj["kind"]] = self._gens.get(obj["kind"], 0) + 1
+
+    def lag(self) -> int:
+        """Leader rv minus the newest rv this replica has applied — 0
+        means caught up."""
+        return max(0, self._server.current_rv() - self._applied_rv)
+
+    def close(self) -> None:
+        self._stopped.set()
+        self._watch.stop()
+        self._thread.join(timeout=5)
+
+    # -- read surface (the leader's own code paths) ----------------------------
+    def current_rv(self) -> int:
+        return self._applied_rv
+
+    def generation(self, kind: str) -> int:
+        with self._lock:
+            return self._gens.get(kind, 0)
+
+    def get(self, kind: str, name: str, namespace: str | None = None,
+            ) -> dict:
+        from kubeflow_tpu.core.store import NotFound
+
+        key = self._server._key(kind, namespace, name)
+        obj = self._kinds.get(kind, {}).get(key)
+        if obj is None:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        return _jcopy(obj)
+
+    # list/project/count are inherited from _LazySnapshots — the
+    # leader's own scan code over this mirror's snapshots
+
+    def kinds(self, namespace: str | None = None) -> list[str]:
+        from kubeflow_tpu.core.store import CLUSTER_SCOPED
+
+        with self._lock:
+            if namespace is None:
+                return sorted(k for k, v in self._kinds.items() if v)
+            return sorted(
+                kind for kind, objs in self._kinds.items()
+                if any(kind in CLUSTER_SCOPED or key[1] == namespace
+                       for key in objs))
+
+    def list_page(self, kind: str, **kw):
+        return self.pager.list_page(kind, **kw)
+
+    def memo(self, kind: str, key, compute):
+        # follower reads are already cheap; no memo table — recompute
+        return compute()
+
+    # -- mutations proxy to the leader ----------------------------------------
+    def create(self, obj: dict) -> dict:
+        return self._server.create(obj)
+
+    def update(self, obj: dict) -> dict:
+        return self._server.update(obj)
+
+    def patch_status(self, kind: str, name: str, namespace: str | None,
+                     status: dict) -> dict:
+        return self._server.patch_status(kind, name, namespace, status)
+
+    def delete(self, kind: str, name: str, namespace: str | None = None,
+               ) -> None:
+        return self._server.delete(kind, name, namespace)
+
+    def watch(self, kinds=None, namespace=None, resource_version=None):
+        # watches are served by the leader's window (a follower-local
+        # window would just mirror it one hop later)
+        return self._server.watch(kinds=kinds, namespace=namespace,
+                                  resource_version=resource_version)
+
+    @property
+    def degraded(self) -> bool:
+        return getattr(self._server, "degraded", False)
+
+    def register_mutating_hook(self, hook) -> None:
+        raise RuntimeError("admission hooks live in the leader API server")
+
+    register_validating_hook = register_mutating_hook
+
+
+@dataclass
+class Replica:
+    name: str
+    store: object          # APIServer (leader) or FollowerCache
+    is_leader: bool
+
+
+class ControlPlane:
+    """N apiserver replicas over one backing store: the replica that wins
+    the ``apiserver-leader`` lease serves the store directly (and keeps
+    renewing the lease); every other replica is a :class:`FollowerCache`.
+    Route through ``gateway.ControlPlaneRouter``."""
+
+    def __init__(self, server: APIServer, replicas: int = 1,
+                 identity_prefix: str = "apiserver",
+                 lease: str = APISERVER_LEASE):
+        from kubeflow_tpu.core.controller import acquire_lease
+
+        self.server = server
+        self.cache = attach(server)
+        self._lease = lease
+        self._stop = threading.Event()
+        self.replicas: list[Replica] = []
+        leader: Replica | None = None
+        for i in range(max(1, replicas)):
+            name = f"{identity_prefix}-{i}"
+            if leader is None and acquire_lease(server, lease, name):
+                leader = Replica(name, server, True)
+                self.replicas.append(leader)
+            else:
+                self.replicas.append(
+                    Replica(name, FollowerCache(server, name), False))
+        if leader is None:
+            # failed election must not orphan the followers already
+            # built: each one holds a pump thread and a live cache
+            # subscription, and the caller gets no handle to close them
+            for r in self.replicas:
+                r.store.close()
+            self.replicas.clear()
+            raise RuntimeError(
+                f"no replica could acquire the {lease!r} lease")
+        self.leader = leader
+        server.control_plane = self  # the dashboard's discovery hook
+        self._renewer = threading.Thread(target=self._renew, daemon=True,
+                                         name="apiserver-lease")
+        self._renewer.start()
+
+    def _renew(self) -> None:
+        from kubeflow_tpu.core.controller import LEASE_TTL, acquire_lease
+
+        while not self._stop.wait(LEASE_TTL / 3):
+            if not acquire_lease(self.server, self._lease,
+                                 self.leader.name):
+                log.warning("apiserver leader lease renewal failed",
+                            holder=self.leader.name)
+
+    def followers(self) -> list[Replica]:
+        return [r for r in self.replicas if not r.is_leader]
+
+    def wait_synced(self, timeout: float = 30.0) -> bool:
+        """Block until every follower has applied the leader's newest rv
+        (loadtests call this before digest-comparing replicas)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(r.store.lag() == 0 for r in self.followers()):
+                return True
+            time.sleep(0.01)
+        return False
+
+    def state(self) -> list[dict]:
+        """Replica standing for the dashboard's control-plane card."""
+        out = []
+        for r in self.replicas:
+            row = {"name": r.name, "leader": r.is_leader}
+            if not r.is_leader:
+                row["lag"] = r.store.lag()
+                row["applied_rv"] = r.store.current_rv()
+            out.append(row)
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        self._renewer.join(timeout=5)
+        for r in self.followers():
+            r.store.close()
+        from kubeflow_tpu.core.controller import release_lease
+
+        release_lease(self.server, self._lease, self.leader.name)
+        if getattr(self.server, "control_plane", None) is self:
+            self.server.control_plane = None

@@ -76,6 +76,8 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/persistence-health",
                        self.persistence_health_route)
         self.add_route("GET", "/api/traces", self.traces_route)
+        self.add_route("GET", "/api/control-plane",
+                       self.control_plane_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -164,6 +166,13 @@ class DashboardApp(CrudApp):
         config, recorded/dropped span counts, recent root spans, and a
         critical-path breakdown of the slowest recent root."""
         return "200 OK", self.metrics.get_trace_state()
+
+    def control_plane_route(self, req: Request):
+        """Control-plane-scale standing (the watch-cache card): event
+        window sizes/floors, watch-resume outcomes, paginated-list
+        latency + scanned-objects counter, and apiserver replica
+        leadership/lag."""
+        return "200 OK", self.metrics.get_control_plane_state()
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

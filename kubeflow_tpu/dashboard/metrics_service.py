@@ -193,6 +193,49 @@ def persistence_health_state(server) -> dict:
     return state
 
 
+def control_plane_state(server) -> dict:
+    """Control-plane-scale standing (the watch-cache card +
+    ``/dashboard/api/control-plane``): per-kind event-window sizes and
+    floors, watch-resume outcomes (replayed from the window vs expired to
+    a relist), paginated-list latency percentiles and the scanned-objects
+    counter (a full paginated read should scan the kind roughly once —
+    this counter is how you see a per-page rescan regression), client
+    watch connectivity, and — when a replica set is running — each
+    apiserver replica's leadership and replication lag."""
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    def val(name: str) -> float:
+        m = REGISTRY.get_metric(name)
+        return m.get() if m is not None else 0.0
+
+    cache = getattr(server, "watch_cache", None)
+    replays = REGISTRY.get_metric("store_watch_cache_replays_total")
+    resumes = REGISTRY.get_metric("kubeclient_watch_resumes_total")
+    pages = REGISTRY.get_metric("apiserver_list_page_seconds")
+    state = {
+        "watch_cache": (cache.stats() if cache is not None
+                        else {"attached": False}),
+        "replays": {
+            "replayed": (replays.get("replayed") if replays else 0.0),
+            "expired": (replays.get("expired") if replays else 0.0),
+        },
+        "client_resumes": {
+            "resumed": (resumes.get("resumed") if resumes else 0.0),
+            "expired": (resumes.get("expired") if resumes else 0.0),
+        },
+        "list_pages": pages.count() if pages is not None else 0.0,
+        "list_page_p50_s": pages.percentile(50) if pages else 0.0,
+        "list_page_p99_s": pages.percentile(99) if pages else 0.0,
+        "objects_scanned": val("apiserver_list_scanned_objects_total"),
+        "watches_connected": val("kubeclient_watches_connected"),
+        "watch_reconnects": val("kubeclient_watch_reconnects_total"),
+    }
+    plane = getattr(server, "control_plane", None)
+    if plane is not None:
+        state["replicas"] = plane.state()
+    return state
+
+
 def trace_state() -> dict:
     """Distributed-tracing standing of this process (the trace health
     card + ``/dashboard/api/traces``): sampling config, recorded/dropped
@@ -285,6 +328,8 @@ class MetricsService(Protocol):
 
     def get_trace_state(self) -> dict: ...
 
+    def get_control_plane_state(self) -> dict: ...
+
 
 class LocalMetricsService:
     """Derives series from the in-memory API server (pod counts as a proxy
@@ -345,6 +390,9 @@ class LocalMetricsService:
 
     def get_trace_state(self) -> dict:
         return trace_state()
+
+    def get_control_plane_state(self) -> dict:
+        return control_plane_state(self.server)
 
 
 class CloudMonitoringMetricsService:
@@ -422,6 +470,12 @@ class CloudMonitoringMetricsService:
     def get_trace_state(self):
         # the span collector is process-local under either backend
         return trace_state()
+
+    def get_control_plane_state(self):
+        # the watch cache and replica set live in the platform's own
+        # store, like the autoscaler's standing
+        return (control_plane_state(self.server) if self.server
+                else {"watch_cache": {"attached": False}})
 
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:

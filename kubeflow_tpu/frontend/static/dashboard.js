@@ -260,6 +260,33 @@
       traceCard.replaceChildren(el("h2", null, "Tracing"), ...rows);
     }).catch(() => traceCard.append(errorBox("unavailable")));
 
+    // control-plane-scale card: watch-cache window standing, resume
+    // outcomes, paginated-list latency, and apiserver replica lag
+    const cpCard = el("div", { class: "card", id: "control-plane-card" },
+      el("h2", null, "Control plane"), el("div", { class: "muted" }, "…"));
+    cards.append(cpCard);
+    api.get("/dashboard/api/control-plane").then((cp) => {
+      const wc = cp.watch_cache || {};
+      const rows = [
+        el("div", { class: "big" }, `${wc.events_retained || 0}`),
+        el("div", { class: "muted" },
+          wc.attached
+            ? `events windowed · rv ${wc.current_rv}` : "cache detached"),
+        el("div", { class: "hint" },
+          `resumes: ${cp.replays.replayed} replayed / ` +
+          `${cp.replays.expired} expired · ` +
+          `${cp.list_pages} pages @ p99 ` +
+          `${(1e3 * cp.list_page_p99_s).toFixed(1)} ms`),
+      ];
+      if (cp.replicas) {
+        rows.push(el("ul", null, cp.replicas.map((r) =>
+          el("li", { class: "hint" },
+            r.leader ? `${r.name}: leader`
+              : `${r.name}: follower, lag ${r.lag}`))));
+      }
+      cpCard.replaceChildren(el("h2", null, "Control plane"), ...rows);
+    }).catch(() => cpCard.append(errorBox("unavailable")));
+
     // metrics cards
     for (const [mtype, title] of [["tpuduty", "TPU duty cycle"],
                                   ["podcpu", "Pod CPU"]]) {

@@ -1177,3 +1177,144 @@ class Gateway:
                     conn.close()
 
         return stream()
+
+
+# -- apiserver replica routing --------------------------------------------------
+
+APISERVER_REQS = REGISTRY.counter(
+    "gateway_apiserver_requests_total",
+    "control-plane requests routed across apiserver replicas",
+    labels=("replica", "verb"))
+
+
+class ControlPlaneRouter:
+    """The gateway's control-plane sibling of backend_for_route: one
+    store-shaped front door over a ``watchcache.ControlPlane`` replica
+    set (ARCHITECTURE decision 20).  SCAN reads (list/list_page/
+    project/count/kinds) round-robin across EVERY replica — the leader
+    plus each follower cache — so the expensive whole-kind work scales
+    horizontally under the documented any-replica-may-lag contract
+    (k8s lists served from the watch cache).  Point GETs and mutations
+    always go to the lease holder: k8s gets are quorum reads, and a
+    follower-served get would break read-your-writes for the very
+    caller that just created the object (create → get → NotFound).
+    Watches go to the leader too (followers are read replicas, not
+    event sources).  A paginated
+    list's continue token is STICKY to the replica that minted it (the
+    pinned snapshot lives in that replica's memory); a token landing on
+    a dead or wrong replica answers ResourceExpired and the client
+    restarts the list, exactly the k8s stale-continue contract.
+
+    Duck-types the store surface, so ``core.httpapi.RestAPI`` and the
+    dashboard serve a replica set unchanged: RestAPI(ControlPlaneRouter(
+    ControlPlane(server, replicas=3))) is a 3-replica apiserver."""
+
+    def __init__(self, plane):
+        import threading
+
+        self._plane = plane
+        self._replicas = list(plane.replicas)
+        self._leader = plane.leader
+        # continue tokens embed the MINTING paginator's origin (the pin
+        # lives in that replica's memory) — map origins, not replica
+        # names: the leader's paginator says "leader", followers say
+        # their replica name
+        from kubeflow_tpu.core import watchcache
+
+        self._by_origin = {}
+        for r in self._replicas:
+            self._by_origin[watchcache.pager_for(r.store).origin] = r
+        self._rr_lock = threading.Lock()
+        self._rr = 0
+
+    # -- picks -----------------------------------------------------------------
+    def _pick(self):
+        with self._rr_lock:
+            r = self._replicas[self._rr % len(self._replicas)]
+            self._rr += 1
+        return r
+
+    def _read(self, verb: str, *args, **kwargs):
+        r = self._pick()
+        APISERVER_REQS.labels(r.name, verb).inc()
+        return getattr(r.store, verb)(*args, **kwargs)
+
+    def _on_leader(self, verb: str, *args, **kwargs):
+        APISERVER_REQS.labels(self._leader.name, verb).inc()
+        return getattr(self._leader.store, verb)(*args, **kwargs)
+
+    # -- read surface ----------------------------------------------------------
+    def get(self, *args, **kwargs):
+        # leader-only (quorum-read semantics): a lagging follower would
+        # 404 an object its own caller just created; the leader's get is
+        # an O(1) live-index lookup, so there is no load to shed anyway
+        return self._on_leader("get", *args, **kwargs)
+
+    def list(self, *args, **kwargs):
+        return self._read("list", *args, **kwargs)
+
+    def project(self, *args, **kwargs):
+        return self._read("project", *args, **kwargs)
+
+    def count(self, *args, **kwargs):
+        return self._read("count", *args, **kwargs)
+
+    def kinds(self, *args, **kwargs):
+        return self._read("kinds", *args, **kwargs)
+
+    def list_page(self, kind, **kw):
+        from kubeflow_tpu.core import watchcache
+
+        cont = kw.get("continue_")
+        r = None
+        if cont:
+            r = self._by_origin.get(watchcache.continue_origin(cont) or "")
+        if r is None:
+            r = self._pick()
+        APISERVER_REQS.labels(r.name, "list_page").inc()
+        return watchcache.list_page_fn(r.store)(kind, **kw)
+
+    def generation(self, kind: str) -> int:
+        return self._leader.store.generation(kind)
+
+    def memo(self, kind: str, key, compute):
+        return self._leader.store.memo(kind, key, compute)
+
+    def current_rv(self) -> int:
+        return self._leader.store.current_rv()
+
+    # -- mutations + watch: leader only ---------------------------------------
+    def create(self, *args, **kwargs):
+        return self._on_leader("create", *args, **kwargs)
+
+    def update(self, *args, **kwargs):
+        return self._on_leader("update", *args, **kwargs)
+
+    def patch_status(self, *args, **kwargs):
+        return self._on_leader("patch_status", *args, **kwargs)
+
+    def delete(self, *args, **kwargs):
+        return self._on_leader("delete", *args, **kwargs)
+
+    def watch(self, kinds=None, namespace=None, resource_version=None):
+        APISERVER_REQS.labels(self._leader.name, "watch").inc()
+        return self._leader.store.watch(kinds=kinds, namespace=namespace,
+                                        resource_version=resource_version)
+
+    def register_mutating_hook(self, hook) -> None:
+        self._leader.store.register_mutating_hook(hook)
+
+    def register_validating_hook(self, hook) -> None:
+        self._leader.store.register_validating_hook(hook)
+
+    @property
+    def degraded(self) -> bool:
+        return getattr(self._leader.store, "degraded", False)
+
+    @property
+    def watch_cache(self):
+        return self._plane.cache
+
+    @property
+    def control_plane(self):
+        return self._plane

@@ -33,6 +33,13 @@ def build_platform(executor: str = "fake", *, extra_env: dict | None = None,
     from kubeflow_tpu.controllers.scheduler import SlicePreemptionController
 
     server = APIServer()
+    # watch-cache on by default (ARCHITECTURE d20): out-of-process
+    # informers resume across blips instead of re-listing the world,
+    # and LIST pagination serves off pinned snapshots
+    from kubeflow_tpu.core import watchcache
+
+    watchcache.attach(
+        server, window=int(os.environ.get("KF_WATCH_WINDOW", "4096")))
     server.register_validating_hook(
         lambda o: (jaxjob_api.validate(o)
                    if o.get("kind") == jaxjob_api.KIND else None))

@@ -363,3 +363,40 @@ def test_traces_route_reports_roots_drops_and_slowest_breakdown(stack):
         assert state["spans_dropped"] >= 1
     finally:
         trace.set_tracer(trace.Tracer(0.0))
+
+
+def test_control_plane_route_reports_cache_replicas_and_pages(stack):
+    """Control-plane-scale card (ISSUE 13): watch-cache window standing,
+    replay/resume outcomes, paginated-list figures, and the apiserver
+    replica roster with leadership + lag."""
+    from kubeflow_tpu.core import watchcache
+    from kubeflow_tpu.gateway import ControlPlaneRouter
+
+    server, _mgr, base = stack
+    cache = watchcache.attach(server)
+    plane = watchcache.ControlPlane(server, replicas=2)
+    router = ControlPlaneRouter(plane)
+    try:
+        server.create(api_object("CM", "c0", "team-a", spec={}))
+        server.create(api_object("CM", "c1", "team-a", spec={}))
+        # one replay + one page so the counters are nonzero
+        w = cache.watch(kinds=["CM"], resource_version=cache.current_rv()
+                        - 1)
+        w.stop()
+        router.list_page("CM", limit=1)
+        assert plane.wait_synced()
+        code, state = req(base, "/dashboard/api/control-plane",
+                          user="alice@corp.com")
+        assert code == 200
+        assert state["watch_cache"]["attached"]
+        assert state["watch_cache"]["windows"]["CM"] >= 2
+        assert state["watch_cache"]["current_rv"] == server.current_rv()
+        assert state["replays"]["replayed"] >= 1
+        assert state["list_pages"] >= 1
+        assert state["objects_scanned"] >= 1
+        roster = {r["name"]: r for r in state["replicas"]}
+        assert sum(1 for r in roster.values() if r["leader"]) == 1
+        follower = next(r for r in roster.values() if not r["leader"])
+        assert follower["lag"] == 0
+    finally:
+        plane.close()

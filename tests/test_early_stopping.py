@@ -119,8 +119,15 @@ def test_trailing_trial_early_stopped_and_slice_freed(stack):
 
     t0 = server.get(exp_api.TRIAL_KIND, "es-exp-trial-0", "hpo")
     assert t0["status"]["phase"] == "EarlyStopped"
-    assert t0["status"]["objective"] == pytest.approx(8.8)
-    assert t0["status"]["stoppedAtStep"] >= 2
+    # startStep=2 makes BOTH step 2 (loss 8.9) and step 3 (8.8) legal
+    # stop points — which one fires depends on scrape-vs-prune timing
+    # (flaked under full-suite CPU load, at HEAD and baseline alike).
+    # The contract worth asserting: the recorded objective IS the
+    # laggard's observation at the step it was stopped.
+    stopped_at = t0["status"]["stoppedAtStep"]
+    assert stopped_at >= 2
+    assert t0["status"]["objective"] == pytest.approx(
+        {1: 9.0, 2: 8.9, 3: 8.8}[stopped_at])
     # the laggard's JAXJob is gone: its slice was freed early
     with pytest.raises(NotFound):
         server.get(jaxjob_api.KIND, "es-exp-trial-0", "hpo")

@@ -309,3 +309,107 @@ def test_access_log_lines_carry_request_id(endpoint):
     mine = [ln for ln in lines if ln.get("request_id") == "rid-log-1"]
     assert mine and mine[0]["path"] == "/healthz"
     assert mine[0]["code"] == "200"
+
+
+# -- watch-cache control plane over the wire (ISSUE 13) ------------------------
+
+def test_paginated_list_with_continue_tokens(endpoint):
+    server, base = endpoint
+    for i in range(7):
+        server.create(api_object("CM", f"c{i}", "d", spec={"i": i}))
+    code, page = req(f"{base}/apis/CM?namespace=d&limit=3")
+    assert code == 200 and len(page["items"]) == 3
+    assert page["metadata"]["resourceVersion"]
+    tok = page["metadata"]["continue"]
+    assert tok
+    names = [o["metadata"]["name"] for o in page["items"]]
+    # writes after page 1 are invisible to the pinned walk
+    server.create(api_object("CM", "a-intruder", "d", spec={}))
+    while tok:
+        from urllib.parse import quote
+
+        code, page = req(f"{base}/apis/CM?namespace=d&limit=3"
+                         f"&continue={quote(tok, safe='')}")
+        assert code == 200
+        names += [o["metadata"]["name"] for o in page["items"]]
+        tok = page["metadata"]["continue"]
+    assert names == [f"c{i}" for i in range(7)]
+
+
+def test_tampered_continue_token_rejected_422(endpoint):
+    server, base = endpoint
+    for i in range(4):
+        server.create(api_object("CM", f"c{i}", "d", spec={}))
+    _, page = req(f"{base}/apis/CM?namespace=d&limit=2")
+    bad = page["metadata"]["continue"][:-1] + "x"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(f"{base}/apis/CM?namespace=d&limit=2&continue={bad}")
+    assert e.value.code == 422
+
+
+def test_watch_resume_replays_gap_and_410_below_window(endpoint):
+    from kubeflow_tpu.core import watchcache
+
+    server, base = endpoint
+    cache = watchcache.attach(server, window=4)
+    server.create(api_object("CM", "c0", "d", spec={}))
+    rv = server.current_rv()
+    server.create(api_object("CM", "c1", "d", spec={}))
+    server.create(api_object("CM", "c2", "d", spec={}))
+    # resume inside the window: the stream replays the two missed ADDEDs
+    r = urllib.request.Request(
+        f"{base}/apis/watch?kinds=CM&resourceVersion={rv}")
+    resp = urllib.request.urlopen(r, timeout=5)
+    got = []
+    for line in resp:
+        line = line.strip()
+        if not line or line == b"{}":
+            break
+        rec = json.loads(line)
+        got.append((rec["type"], rec["object"]["metadata"]["name"]))
+        if len(got) == 2:
+            break
+    resp.close()
+    assert got == [("ADDED", "c1"), ("ADDED", "c2")]
+    # age the window past rv: resume must answer 410 Gone
+    for i in range(8):
+        server.patch_status("CM", "c0", "d", {"n": i})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/apis/watch?kinds=CM&resourceVersion={rv}"),
+            timeout=5)
+    assert e.value.code == 410
+    # same 410 contract as the JSON API: the body carries the rv to
+    # re-anchor at, so the client needn't burn a list round-trip
+    body = json.loads(e.value.read())
+    assert body["currentResourceVersion"] == server.current_rv()
+
+
+def test_watch_bookmarks_advance_resume_point_without_payloads(endpoint):
+    server, base = endpoint
+    api_app = None  # BOOKMARK_INTERVAL is a class attribute
+    from kubeflow_tpu.core.httpapi import RestAPI
+
+    old = RestAPI.BOOKMARK_INTERVAL
+    RestAPI.BOOKMARK_INTERVAL = 0.05
+    try:
+        server.create(api_object("CM", "seen", "d", spec={}))
+        resp = urllib.request.urlopen(urllib.request.Request(
+            f"{base}/apis/watch?kinds=CM&allowWatchBookmarks=true"),
+            timeout=5)
+        marks = []
+        for line in resp:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("type") == "BOOKMARK":
+                obj = rec["object"]
+                assert set(obj) == {"metadata"}  # rv only, no payload
+                marks.append(int(obj["metadata"]["resourceVersion"]))
+                if len(marks) == 2:
+                    break
+        resp.close()
+        assert marks and all(m == server.current_rv() for m in marks)
+    finally:
+        RestAPI.BOOKMARK_INTERVAL = old

@@ -191,8 +191,14 @@ def test_watch_survives_facade_restart():
     """VERDICT r3 #5: the pump thread must not die silently on connection
     loss.  Kill the facade mid-watch, bring it back on the same port:
     the watch reconnects, re-lists (sync MODIFIED for survivors, DELETED
-    for objects that vanished during the gap), and live events flow."""
+    for objects that vanished during the gap), and live events flow.
+    The event window is pinned to ONE entry so the gap provably expires
+    (410) — this test is about the RELIST path; short gaps now resume
+    and replay instead (test_watch_resume_replays_gap_without_relist)."""
+    from kubeflow_tpu.core import watchcache
+
     server = APIServer()
+    watchcache.attach(server, window=1)
     httpd, _ = serve(RestAPI(server), 0)
     port = httpd.server_address[1]
     store = KubeStore(f"http://127.0.0.1:{port}")
@@ -212,6 +218,9 @@ def test_watch_survives_facade_restart():
         httpd.server_close()
         w._resp.close()
         server.delete("ConfigMap", "gone", "d")
+        # widen the gap past the 1-event window: resume must 410
+        server.patch_status("ConfigMap", "keep", "d", {"n": 1})
+        server.patch_status("ConfigMap", "keep", "d", {"n": 2})
         httpd, _ = serve(RestAPI(server), port)  # same port, same store
 
         events = {}
@@ -297,8 +306,13 @@ def test_kindless_watch_resyncs_after_facade_restart():
     the gap — on reconnect it enumerates the server's kinds (GET /apis
     discovery) and re-lists everything.  And (ADVICE r4) synthesized
     DELETED events carry the last-seen labels/ownerReferences so
-    owner/label watch-mappers can still derive reconcile Requests."""
+    owner/label watch-mappers can still derive reconcile Requests.
+    Window pinned to one entry: the gap must take the 410-relist path,
+    not the (newer) exact-replay resume."""
+    from kubeflow_tpu.core import watchcache
+
     server = APIServer()
+    watchcache.attach(server, window=1)
     httpd, _ = serve(RestAPI(server), 0)
     port = httpd.server_address[1]
     store = KubeStore(f"http://127.0.0.1:{port}")
@@ -323,6 +337,9 @@ def test_kindless_watch_resyncs_after_facade_restart():
         httpd.server_close()
         w._resp.close()
         server.delete("Pod", "gone", "d")  # the ONLY Pod vanishes
+        # widen the gap past the 1-event window: resume must 410
+        server.patch_status("ConfigMap", "keep", "d", {"n": 1})
+        server.patch_status("ConfigMap", "keep", "d", {"n": 2})
         httpd, _ = serve(RestAPI(server), port)
 
         events = {}
@@ -343,6 +360,147 @@ def test_kindless_watch_resyncs_after_facade_restart():
         # cached metadata rides the synthesized event
         assert md["labels"] == {"notebook-name": "nb9"}
         assert md["ownerReferences"][0]["uid"] == "u-nb9"
+    finally:
+        w.stop()
+        httpd.shutdown()
+
+
+# -- watch-cache resume + pagination (ISSUE 13) --------------------------------
+
+def _stop(httpd, watch=None):
+    httpd.shutdown()
+    httpd.server_close()  # release the port for the bounce
+    if watch is not None:
+        # the established stream socket survives the listener's death;
+        # sever it so the client actually experiences the outage
+        watch._resp.close()
+
+
+def _restart_on_port(server, port):
+    """Simulate an apiserver bounce: a new listener on the same port."""
+    import time as _time
+
+    for _ in range(50):
+        try:
+            httpd, _ = serve(RestAPI(server), port)
+            return httpd
+        except OSError:
+            _time.sleep(0.05)
+    raise RuntimeError(f"port {port} never freed")
+
+
+def test_list_auto_paginates_with_limit():
+    from kubeflow_tpu.core import watchcache
+
+    server = APIServer()
+    for i in range(23):
+        server.create({"kind": "CM", "apiVersion": "v1",
+                       "metadata": {"name": f"c{i:02d}", "namespace": "d"},
+                       "spec": {"i": i}})
+    httpd, _ = serve(RestAPI(server), 0)
+    try:
+        store = KubeStore(f"http://127.0.0.1:{httpd.server_address[1]}")
+        scanned0 = watchcache.SCANNED.get()
+        items = store.list("CM", namespace="d", limit=5)
+        assert [o["metadata"]["name"] for o in items] == [
+            f"c{i:02d}" for i in range(23)]
+        # the server walked the kind once, not once per page
+        assert watchcache.SCANNED.get() - scanned0 == 23
+        page, cont, rv = store.list_page("CM", namespace="d", limit=10)
+        assert len(page) == 10 and cont and rv
+    finally:
+        httpd.shutdown()
+
+
+def test_watch_resume_replays_gap_without_relist(monkeypatch):
+    """A short outage with a large window: the reconnect RESUMES and the
+    server replays exactly the missed events — no synthesized MODIFIED
+    flood from a re-list."""
+    from kubeflow_tpu.core import watchcache
+    from kubeflow_tpu.core.kubeclient import WATCH_RESUMES
+
+    server = APIServer()
+    watchcache.attach(server, window=1024)
+    server.create({"kind": "CM", "apiVersion": "v1",
+                   "metadata": {"name": "pre", "namespace": "d"},
+                   "spec": {}})
+    httpd, _ = serve(RestAPI(server), 0)
+    port = httpd.server_address[1]
+    store = KubeStore(f"http://127.0.0.1:{port}")
+    w = store.watch(kinds=["CM"])
+    try:
+        ev = w.next(timeout=5)
+        assert ev is None or ev.type  # may or may not see 'pre'
+        server.create({"kind": "CM", "apiVersion": "v1",
+                       "metadata": {"name": "before", "namespace": "d"},
+                       "spec": {}})
+        assert wait(lambda: w.next(timeout=1))  # position advances
+        resumed0 = WATCH_RESUMES.get("resumed")
+        _stop(httpd, w)  # sever, with a real gap behind it
+        for i in range(3):
+            server.create({"kind": "CM", "apiVersion": "v1",
+                           "metadata": {"name": f"gap{i}",
+                                        "namespace": "d"}, "spec": {}})
+        httpd = _restart_on_port(server, port)
+        got = []
+        deadline = 20
+        while len(got) < 3:
+            ev = w.next(timeout=1)
+            deadline -= 1
+            assert deadline > 0, f"only saw {got}"
+            if ev is not None and ev.object["metadata"][
+                    "name"].startswith("gap"):
+                got.append((ev.type, ev.object["metadata"]["name"]))
+        # the gap replayed EXACTLY: ADDED events in order, not MODIFIED
+        # relist synthetics
+        assert got == [("ADDED", f"gap{i}") for i in range(3)]
+        assert WATCH_RESUMES.get("resumed") >= resumed0 + 1
+    finally:
+        w.stop()
+        httpd.shutdown()
+
+
+def test_watch_resume_after_window_eviction_falls_back_to_relist():
+    """Regression (ISSUE 13 satellite): an outage longer than the event
+    window answers 410; the client must re-list — synthesizing DELETED
+    for vanished objects — instead of hanging or silently losing the
+    gap."""
+    from kubeflow_tpu.core import watchcache
+    from kubeflow_tpu.core.kubeclient import WATCH_RESUMES
+
+    server = APIServer()
+    watchcache.attach(server, window=4)
+    for i in range(3):
+        server.create({"kind": "CM", "apiVersion": "v1",
+                       "metadata": {"name": f"c{i}", "namespace": "d"},
+                       "spec": {}})
+    httpd, _ = serve(RestAPI(server), 0)
+    port = httpd.server_address[1]
+    store = KubeStore(f"http://127.0.0.1:{port}")
+    w = store.watch(kinds=["CM"])
+    try:
+        # the watch must OBSERVE c1 before the gap: the re-list can only
+        # synthesize DELETED for objects it knew were alive
+        server.patch_status("CM", "c1", "d", {"seen": True})
+        server.patch_status("CM", "c0", "d", {"seen": True})
+        assert wait(lambda: w.next(timeout=1))  # position advances
+        assert wait(lambda: w.next(timeout=1))
+        expired0 = WATCH_RESUMES.get("expired")
+        _stop(httpd, w)
+        # more events than the window retains, including a delete the
+        # re-list must synthesize
+        server.delete("CM", "c1", "d")
+        for i in range(6):
+            server.patch_status("CM", "c0", "d", {"n": i})
+        httpd = _restart_on_port(server, port)
+        seen_delete = wait(
+            lambda: next((ev for ev in iter(
+                lambda: w.next(timeout=0.5), None)
+                if ev.type == "DELETED"
+                and ev.object["metadata"]["name"] == "c1"), None),
+            timeout=20)
+        assert seen_delete is not None
+        assert WATCH_RESUMES.get("expired") >= expired0 + 1
     finally:
         w.stop()
         httpd.shutdown()

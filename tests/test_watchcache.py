@@ -1,0 +1,487 @@
+"""Watch-cache control plane (ISSUE 13): resourceVersion event windows,
+410 semantics, consistent pagination with opaque continue tokens, follower
+replicas, lease-elected control planes, and the gateway's replica router."""
+
+import threading
+import time
+
+import pytest
+from conftest import poll_until as wait
+
+from kubeflow_tpu.core import APIServer, api_object
+from kubeflow_tpu.core import watchcache
+from kubeflow_tpu.core.store import Invalid, NotFound, state_digest
+from kubeflow_tpu.core.watchcache import (
+    ControlPlane,
+    FollowerCache,
+    ResourceExpired,
+)
+from kubeflow_tpu.gateway import ControlPlaneRouter
+
+
+@pytest.fixture()
+def server():
+    return APIServer()
+
+
+def drain(watch, timeout=0.2):
+    out = []
+    while True:
+        ev = watch.next(timeout=timeout)
+        if ev is None:
+            return out
+        out.append((ev.type, ev.object["metadata"]["name"],
+                    int(ev.object["metadata"]["resourceVersion"])))
+
+
+# -- event window / resume ----------------------------------------------------
+
+class TestWindowReplay:
+    def test_resume_replays_exact_continuous_sequence(self, server):
+        cache = watchcache.attach(server)
+        cont = cache.watch(kinds=["Pod"])
+        for i in range(4):
+            server.create(api_object("Pod", f"p{i}", "ns", spec={}))
+        mid_rv = server.current_rv()
+        server.patch_status("Pod", "p0", "ns", {"phase": "Running"})
+        server.delete("Pod", "p2", "ns")
+        continuous = drain(cont)
+        resumed = drain(cache.watch(kinds=["Pod"],
+                                    resource_version=mid_rv))
+        assert resumed == [e for e in continuous if e[2] > mid_rv]
+        assert [e[0] for e in resumed] == ["MODIFIED", "DELETED"]
+        cont.stop()
+
+    def test_resume_zero_on_fresh_store_replays_everything(self, server):
+        cache = watchcache.attach(server)
+        server.create(api_object("Pod", "p", "ns", spec={}))
+        events = drain(cache.watch(kinds=["Pod"], resource_version=0))
+        assert [e[:2] for e in events] == [("ADDED", "p")]
+
+    def test_resume_below_window_raises_resource_expired(self, server):
+        cache = watchcache.attach(server, window=4)
+        server.create(api_object("Pod", "p", "ns", spec={}))
+        early_rv = server.current_rv()
+        for i in range(10):
+            server.patch_status("Pod", "p", "ns", {"phase": f"r{i}"})
+        before = watchcache.REPLAYS.get("expired")
+        with pytest.raises(ResourceExpired) as ei:
+            cache.watch(kinds=["Pod"], resource_version=early_rv)
+        assert ei.value.current_rv == server.current_rv()
+        assert watchcache.REPLAYS.get("expired") == before + 1
+
+    def test_attach_rv_is_the_floor_for_preexisting_history(self, server):
+        # events before attach were never recorded: resuming below the
+        # attach point must expire, not silently skip the gap
+        server.create(api_object("Pod", "old", "ns", spec={}))
+        cache = watchcache.attach(server)
+        with pytest.raises(ResourceExpired):
+            cache.watch(kinds=["Pod"], resource_version=0)
+        # at-or-after attach is fine
+        assert drain(cache.watch(
+            kinds=["Pod"], resource_version=server.current_rv())) == []
+
+    def test_resume_ahead_of_store_raises_resource_expired(self, server):
+        # a resume point saved from a PREVIOUS store incarnation (wiped
+        # data dir, restarted rv counter) can exceed the current rv; the
+        # gap is unknowable, so the client must relist — silently
+        # replaying nothing would desync it until an unrelated write
+        cache = watchcache.attach(server)
+        server.create(api_object("Pod", "p", "ns", spec={}))
+        with pytest.raises(ResourceExpired):
+            cache.watch(kinds=["Pod"],
+                        resource_version=server.current_rv() + 100)
+
+    def test_deleted_events_carry_fresh_resource_version(self, server):
+        cache = watchcache.attach(server)
+        server.create(api_object("Pod", "p", "ns", spec={}))
+        rv_created = server.current_rv()
+        server.delete("Pod", "p", "ns")
+        events = drain(cache.watch(kinds=["Pod"], resource_version=0))
+        assert events[-1][0] == "DELETED"
+        assert events[-1][2] > rv_created
+
+    def test_no_gap_between_replay_and_live(self, server):
+        """A write racing watch() lands either in the replay or the live
+        stream, never both and never neither."""
+        cache = watchcache.attach(server)
+        server.create(api_object("Pod", "seed", "ns", spec={}))
+        start = server.current_rv()
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                server.patch_status("Pod", "seed", "ns", {"n": i})
+                i += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            w = cache.watch(kinds=["Pod"], resource_version=start)
+            time.sleep(0.05)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        rvs = [e[2] for e in drain(w, timeout=0.3)]
+        # strictly increasing, no duplicates, no holes in Pod's stream
+        assert rvs == sorted(set(rvs))
+        assert rvs and rvs == list(range(rvs[0], rvs[-1] + 1))
+
+    def test_namespace_filter_matches_store_watch_semantics(self, server):
+        cache = watchcache.attach(server)
+        rv0 = server.current_rv()
+        server.create(api_object("Pod", "a", "ns-a", spec={}))
+        server.create(api_object("Pod", "b", "ns-b", spec={}))
+        server.create(api_object("Namespace", "ns-a"))  # cluster-scoped
+        events = drain(cache.watch(namespace="ns-a", resource_version=rv0))
+        assert [e[1] for e in events] == ["a", "ns-a"]
+
+
+# -- pagination ---------------------------------------------------------------
+
+class TestContinueTokens:
+    def test_pages_pin_the_first_snapshot_under_writes(self, server):
+        cache = watchcache.attach(server)
+        for i in range(7):
+            server.create(api_object("CM", f"c{i}", "d", spec={"i": i}))
+        page1, tok, rv = cache.list_page("CM", limit=3)
+        assert [o["metadata"]["name"] for o in page1] == ["c0", "c1", "c2"]
+        # concurrent writes after page 1: invisible to this walk
+        server.create(api_object("CM", "a-intruder", "d", spec={}))
+        server.delete("CM", "c5", "d")
+        page2, tok2, rv2 = cache.list_page("CM", limit=3, continue_=tok)
+        page3, tok3, _ = cache.list_page("CM", limit=3, continue_=tok2)
+        names = [o["metadata"]["name"] for o in page1 + page2 + page3]
+        assert names == [f"c{i}" for i in range(7)]
+        assert tok3 is None
+        assert rv2 == rv
+        # a FRESH list sees the new world
+        fresh, _, _ = cache.list_page("CM", limit=100)
+        fresh_names = [o["metadata"]["name"] for o in fresh]
+        assert "a-intruder" in fresh_names and "c5" not in fresh_names
+
+    def test_tokens_are_opaque_and_reject_tampering(self, server):
+        cache = watchcache.attach(server)
+        for i in range(4):
+            server.create(api_object("CM", f"c{i}", "d", spec={}))
+        _, tok, _ = cache.list_page("CM", limit=2)
+        # signed, not encrypted: altering the payload body or the MAC
+        # must both be rejected — the token only round-trips verbatim
+        flipped = tok[:-1] + ("A" if tok[-1] != "A" else "B")
+        with pytest.raises(Invalid):
+            cache.list_page("CM", limit=2, continue_=flipped)
+        body_flip = ("B" if tok[0] != "B" else "C") + tok[1:]
+        with pytest.raises(Invalid):
+            cache.list_page("CM", limit=2, continue_=body_flip)
+        with pytest.raises(Invalid):
+            cache.list_page("CM", limit=2, continue_="garbage")
+        # a token for another kind must not leak into this one
+        with pytest.raises(Invalid):
+            cache.list_page("Pod", limit=2, continue_=tok)
+
+    def test_limit_zero_and_oversized_behave_like_k8s(self, server):
+        cache = watchcache.attach(server)
+        for i in range(5):
+            server.create(api_object("CM", f"c{i}", "d", spec={}))
+        all_items, tok, _ = cache.list_page("CM", limit=0)
+        assert len(all_items) == 5 and tok is None
+        all_items, tok, _ = cache.list_page("CM", limit=10_000)
+        assert len(all_items) == 5 and tok is None
+
+    def test_evicted_pin_answers_resource_expired(self, server):
+        cache = watchcache.attach(server)
+        cache.pager.MAX_PINS = 2
+        server.create(api_object("CM", "c", "d", spec={}))
+        server.create(api_object("CM", "c2", "d", spec={}))
+        _, tok, _ = cache.list_page("CM", limit=0)
+        assert tok is None
+        _, tok, _ = cache.list_page("CM", limit=1)  # hold-open token
+        assert tok is not None
+        # churn generations until the pin LRU drops the token's snapshot
+        for i in range(4):
+            server.create(api_object("CM", f"x{i}", "d", spec={}))
+            cache.list_page("CM", limit=1)
+        with pytest.raises(ResourceExpired):
+            cache.list_page("CM", limit=1, continue_=tok)
+
+    def test_filters_apply_per_page_and_resume_correctly(self, server):
+        cache = watchcache.attach(server)
+        for i in range(6):
+            server.create(api_object(
+                "CM", f"c{i}", "d",
+                labels={"parity": "even" if i % 2 == 0 else "odd"}))
+        sel = {"matchLabels": {"parity": "even"}}
+        page1, tok, _ = cache.list_page("CM", label_selector=sel, limit=2)
+        assert [o["metadata"]["name"] for o in page1] == ["c0", "c2"]
+        page2, tok2, _ = cache.list_page("CM", label_selector=sel,
+                                         limit=2, continue_=tok)
+        assert [o["metadata"]["name"] for o in page2] == ["c4"]
+        assert tok2 is None
+
+    def test_scan_counter_counts_once_per_key_not_per_page(self, server):
+        cache = watchcache.attach(server)
+        for i in range(30):
+            server.create(api_object("CM", f"c{i:02d}", "d", spec={}))
+        before = watchcache.SCANNED.get()
+        tok = None
+        pages = 0
+        while True:
+            _, tok, _ = cache.list_page("CM", limit=7, continue_=tok)
+            pages += 1
+            if tok is None:
+                break
+        assert pages == 5
+        assert watchcache.SCANNED.get() - before == 30
+
+
+# -- follower replicas + control plane ---------------------------------------
+
+class TestReplicas:
+    def test_follower_mirrors_and_proxies_mutations(self, server):
+        server.create(api_object("CM", "pre", "d", spec={"x": 1}))
+        f = FollowerCache(server, "r1")
+        try:
+            # pre-existing state synced
+            assert f.get("CM", "pre", "d")["spec"] == {"x": 1}
+            # live events propagate
+            server.create(api_object("CM", "live", "d", spec={}))
+            wait(lambda: f.lag() == 0 or None)
+            assert f.get("CM", "live", "d")
+            # mutations proxy to the leader
+            created = f.create(api_object("CM", "via-f", "d", spec={}))
+            assert server.get("CM", "via-f", "d")
+            created["spec"]["x"] = 2
+            f.update(created)
+            f.patch_status("CM", "via-f", "d", {"ok": True})
+            f.delete("CM", "pre", "d")
+            with pytest.raises(NotFound):
+                server.get("CM", "pre", "d")
+            wait(lambda: f.lag() == 0 or None)
+            assert state_digest(f) == state_digest(server)
+            with pytest.raises(RuntimeError):
+                f.register_validating_hook(lambda o: None)
+        finally:
+            f.close()
+
+    def test_write_between_subscribe_and_bootstrap_converges_lag(
+            self, server):
+        # a write landing after the replica watch subscribes but before
+        # the bootstrap snapshot copy is ALREADY in the copy; its buffered
+        # event is stale for the mirror but still progress — lag() must
+        # converge to 0, not report the skipped event forever
+        server.create(api_object("CM", "pre", "d", spec={}))
+        real_snapshot = server._snapshot
+        fired = []
+
+        def racing_snapshot(kind):
+            if not fired:
+                fired.append(True)
+                server.create(api_object("CM", "raced", "d", spec={}))
+            return real_snapshot(kind)
+
+        server._snapshot = racing_snapshot
+        try:
+            f = FollowerCache(server, "r1")
+        finally:
+            server._snapshot = real_snapshot
+        try:
+            assert f.get("CM", "raced", "d")
+            wait(lambda: f.lag() == 0 or None)
+            assert f.lag() == 0
+        finally:
+            f.close()
+
+    def test_follower_list_page_serves_from_its_own_pin(self, server):
+        for i in range(6):
+            server.create(api_object("CM", f"c{i}", "d", spec={}))
+        f = FollowerCache(server, "r1")
+        try:
+            wait(lambda: f.lag() == 0 or None)
+            page1, tok, _ = f.list_page("CM", limit=4)
+            assert watchcache.continue_origin(tok) == "r1"
+            page2, tok2, _ = f.list_page("CM", limit=4, continue_=tok)
+            assert tok2 is None
+            assert len(page1 + page2) == 6
+        finally:
+            f.close()
+
+    def test_control_plane_elects_one_leader_via_lease(self, server):
+        plane = ControlPlane(server, replicas=3)
+        try:
+            leaders = [r for r in plane.replicas if r.is_leader]
+            assert len(leaders) == 1
+            lease = server.get("Lease", watchcache.APISERVER_LEASE,
+                               "kube-system")
+            assert lease["spec"]["holder"] == leaders[0].name
+            assert len(plane.followers()) == 2
+        finally:
+            plane.close()
+
+    def test_failed_election_closes_orphaned_followers(self, server):
+        from kubeflow_tpu.core.controller import acquire_lease
+
+        # someone else holds the lease: no replica can win, and the
+        # followers built along the way must be torn down (pump thread +
+        # cache subscription), not leaked with no handle to close them
+        assert acquire_lease(server, watchcache.APISERVER_LEASE, "other")
+        cache = watchcache.attach(server)
+        subs_before = len(cache._subs)
+        with pytest.raises(RuntimeError):
+            ControlPlane(server, replicas=2)
+        assert len(cache._subs) == subs_before
+
+    def test_router_round_robins_scans_and_leads_writes_and_gets(
+            self, server):
+        plane = ControlPlane(server, replicas=2)
+        router = ControlPlaneRouter(plane)
+        try:
+            router.create(api_object("CM", "c", "d", spec={"v": 1}))
+            assert server.get("CM", "c", "d")  # write landed on leader
+            # read-your-writes: an IMMEDIATE get through the router must
+            # see the create (gets are leader-only quorum reads; a
+            # round-robined follower get could 404 the caller's own
+            # object)
+            assert router.get("CM", "c", "d")["spec"] == {"v": 1}
+            assert plane.wait_synced()
+            from kubeflow_tpu.utils.metrics import REGISTRY
+
+            picks = REGISTRY.get_metric("gateway_apiserver_requests_total")
+            f_name = plane.followers()[0].name
+            leader_name = plane.leader.name
+            before = picks.get(f_name, "count")
+            for _ in range(4):
+                assert router.count("CM", namespace="d") == 1
+            # half the scans landed on the follower
+            assert picks.get(f_name, "count") == before + 2
+            g_before = picks.get(f_name, "get")
+            for _ in range(4):
+                router.get("CM", "c", "d")
+            assert picks.get(f_name, "get") == g_before  # never followers
+            assert picks.get(leader_name, "get") >= 4
+        finally:
+            plane.close()
+
+    def test_router_digest_equals_direct_store_digest(self, server):
+        plane = ControlPlane(server, replicas=3)
+        router = ControlPlaneRouter(plane)
+        try:
+            for i in range(10):
+                router.create(api_object("CM", f"c{i}", "d",
+                                         spec={"i": i}))
+                router.patch_status("CM", f"c{i}", "d", {"seen": True})
+            assert plane.wait_synced()
+            want = state_digest(server)
+            for rep in plane.replicas:
+                assert state_digest(rep.store) == want
+        finally:
+            plane.close()
+
+    def test_router_routes_continue_tokens_to_their_origin(self, server):
+        for i in range(9):
+            server.create(api_object("CM", f"c{i}", "d", spec={}))
+        plane = ControlPlane(server, replicas=3)
+        router = ControlPlaneRouter(plane)
+        try:
+            assert plane.wait_synced()
+            names = []
+            tok = None
+            while True:
+                items, tok, _ = router.list_page("CM", limit=2,
+                                                 continue_=tok)
+                names.extend(o["metadata"]["name"] for o in items)
+                if tok is None:
+                    break
+            assert names == [f"c{i}" for i in range(9)]
+        finally:
+            plane.close()
+
+
+# -- store semantics the cache depends on -------------------------------------
+
+class TestStoreSemantics:
+    def test_lazy_snapshot_read_your_writes(self, server):
+        server.create(api_object("CM", "c", "d", spec={"v": 1}))
+        assert [o["metadata"]["name"]
+                for o in server.list("CM", namespace="d")] == ["c"]
+        got = server.get("CM", "c", "d")
+        got["spec"]["v"] = 2
+        server.update(got)
+        assert server.list("CM", namespace="d")[0]["spec"]["v"] == 2
+        server.delete("CM", "c", "d")
+        assert server.list("CM", namespace="d") == []
+
+    def test_window_gauge_and_stats(self, server):
+        cache = watchcache.attach(server, window=3)
+        for i in range(5):
+            server.create(api_object("CM", f"c{i}", "d", spec={}))
+        stats = cache.stats()
+        assert stats["windows"]["CM"] == 3
+        assert stats["floors"]["CM"] > 0
+        assert stats["current_rv"] == server.current_rv()
+        from kubeflow_tpu.utils.metrics import REGISTRY
+
+        gauge = REGISTRY.get_metric("store_watch_cache_window_size")
+        assert gauge.get("CM") == 3
+
+    def test_attach_is_idempotent(self, server):
+        a = watchcache.attach(server, window=7)
+        b = watchcache.attach(server, window=999)
+        assert a is b and a.window == 7
+
+    def test_store_watch_resume_entrypoint(self, server):
+        """APIServer.watch(resource_version=) self-attaches a cache."""
+        rv0 = server.current_rv()
+        assert server.watch_cache is None
+        w = server.watch(kinds=["CM"], resource_version=rv0)
+        assert server.watch_cache is not None
+        server.create(api_object("CM", "c", "d", spec={}))
+        ev = w.next(timeout=2)
+        assert ev is not None and ev.type == "ADDED"
+        w.stop()
+
+    def test_wal_replay_resets_the_window_floor(self, tmp_path):
+        """A watch cache attached BEFORE persistence recovery must not
+        claim it can replay across the bulk-loaded gap: the replayed
+        history never entered the window, so resumes below the recovered
+        rv answer ResourceExpired (not an empty replay that silently
+        loses events)."""
+        from kubeflow_tpu.core import persistence
+
+        writer = APIServer()
+        persistence.attach(writer, str(tmp_path))
+        for i in range(5):
+            writer.create(api_object("CM", f"c{i}", "d", spec={}))
+        persistence.detach(writer)
+
+        reader = APIServer()
+        cache = watchcache.attach(reader)  # attached pre-recovery, rv 0
+        persistence.attach(reader, str(tmp_path))
+        assert reader.current_rv() >= 5
+        with pytest.raises(ResourceExpired):
+            cache.watch(kinds=["CM"], resource_version=1)
+        # post-recovery events replay normally
+        rv = reader.current_rv()
+        reader.create(api_object("CM", "new", "d", spec={}))
+        events = drain(cache.watch(kinds=["CM"], resource_version=rv))
+        assert [e[:2] for e in events] == [("ADDED", "new")]
+
+    def test_delete_consumed_rv_survives_restart(self, tmp_path):
+        """A delete consumes an rv (the DELETED event carries it as a
+        resume point); recovery must rebuild the counter PAST it — a
+        regressed counter would reuse rvs that watch clients already
+        hold, making their resumes silently skip the reused events."""
+        from kubeflow_tpu.core import persistence
+
+        writer = APIServer()
+        persistence.attach(writer, str(tmp_path))
+        writer.create(api_object("CM", "c", "d", spec={}))
+        writer.delete("CM", "c", "d")
+        rv_before = writer.current_rv()
+        persistence.detach(writer)
+
+        reader = APIServer()
+        persistence.attach(reader, str(tmp_path))
+        assert reader.current_rv() >= rv_before
+        created = reader.create(api_object("CM", "fresh", "d", spec={}))
+        assert int(created["metadata"]["resourceVersion"]) > rv_before
