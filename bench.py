@@ -450,10 +450,16 @@ def _stage_entry(name: str) -> None:
 
 
 def _run_stage(name: str, timeout: float, attempts: int = 2,
-               backoff: float = 20.0) -> dict:
+               backoff: float = 20.0) -> tuple[dict, str | None]:
     """Run one measurement in a child process with a hard timeout,
     respawning (with backoff) when the child reports a backend-init wedge
-    (RC_WEDGE) — the r2 failure mode where the tunnel needed a retry."""
+    (RC_WEDGE) — the r2 failure mode where the tunnel needed a retry.
+
+    Returns ``(rows, failure)``: failure is None on success, else one of
+    ``"wedge"``/``"timeout"``/``"failed"`` — the caller distinguishes an
+    unreachable tunnel (emit an infra-unreachable record, NOT an empty
+    result a driver could read as a perf regression) from a real bug.
+    """
     for attempt in range(attempts):
         try:
             p = subprocess.run([sys.executable, __file__,
@@ -463,7 +469,7 @@ def _run_stage(name: str, timeout: float, attempts: int = 2,
         except subprocess.TimeoutExpired:
             _log(f"stage '{name}' hit the {timeout:.0f}s watchdog "
                  "(tunnel wedge?); omitting its rows")
-            return {}
+            return {}, "timeout"
         if p.returncode == RC_WEDGE and attempt + 1 < attempts:
             _log(f"stage '{name}' backend init wedged; retrying in "
                  f"{backoff:.0f}s (attempt {attempt + 2}/{attempts}); "
@@ -471,20 +477,32 @@ def _run_stage(name: str, timeout: float, attempts: int = 2,
                  f"{(p.stderr or '').strip()[-600:]}")
             time.sleep(backoff)
             continue
+        if p.returncode == RC_WEDGE:
+            _log(f"stage '{name}' backend init wedged on every attempt")
+            return {}, "wedge"
         if p.returncode != 0:
             _log(f"stage '{name}' failed rc={p.returncode}: "
                  f"{(p.stderr or '').strip()[-300:]}")
-            return {}
+            return {}, "failed"
         for line in reversed((p.stdout or "").strip().splitlines()):
             try:
                 out = json.loads(line)
                 if isinstance(out, dict):
-                    return out
+                    return out, None
             except json.JSONDecodeError:
                 continue
         _log(f"stage '{name}' printed no JSON; omitting")
-        return {}
-    return {}
+        return {}, "failed"
+    return {}, "wedge"
+
+
+def _emit_unreachable(error: str) -> None:
+    """The r02–r05 lesson: a refused/wedged tunnel used to leave an
+    EMPTY result file, indistinguishable from a perf collapse.  Emit an
+    explicit status record instead — the driver's trajectory keeps the
+    round as 'infra was down', never as 'the code got slower'."""
+    print(json.dumps({"status": "infra-unreachable", "error": error}),
+          flush=True)
 
 
 def _emit(partials: dict) -> bool:
@@ -565,21 +583,30 @@ def main(only_stage: str | None = None) -> None:
             if only_stage is not None:
                 # the caller asked for THIS stage; a cached headline is
                 # not success (and its stale value is already dropped)
+                _emit_unreachable(
+                    f"stage {only_stage!r} not measured: relay refused "
+                    "TCP and the init-only confirmation attempt failed")
                 raise SystemExit(
                     f"stage {only_stage!r} not measured: tunnel down")
             if emitted:
                 return  # headline delivered from an earlier window
+            _emit_unreachable("relay refused TCP on every probed port "
+                              "and the init-only confirmation attempt "
+                              "failed; no partial results to stand")
             raise SystemExit(RC_DOWN)
         _log("init succeeded despite refusing probe; full budget")
 
+    failures: dict[str, str] = {}
     for name, timeout, attempts, backoff in todo:
-        rows = _run_stage(name, timeout=timeout, attempts=attempts,
-                          backoff=backoff)
+        rows, failure = _run_stage(name, timeout=timeout,
+                                   attempts=attempts, backoff=backoff)
         if rows:
             partials[name] = rows
             _save_partials(partials)
-        elif name == "headline" and only_stage is None:
-            break  # no headline, nothing emittable: stop burning budget
+        else:
+            failures[name] = failure or "failed"
+            if name == "headline" and only_stage is None:
+                break  # no headline, nothing emittable: stop burning
         # cumulative emission: a wedge in any later stage still leaves a
         # complete, parseable result line on stdout
         _emit(partials)
@@ -591,8 +618,18 @@ def main(only_stage: str | None = None) -> None:
     if only_stage is not None:
         # single-stage contract: the requested stage, not the headline
         if only_stage not in partials:
+            if failures.get(only_stage) in ("wedge", "timeout"):
+                _emit_unreachable(
+                    f"stage {only_stage!r}: backend init "
+                    f"{failures[only_stage]} — TPU tunnel unreachable")
             raise SystemExit(f"stage {only_stage!r} failed (see stderr)")
     elif not emitted:
+        if failures.get("headline") in ("wedge", "timeout"):
+            # unreachable infrastructure, not a measurement result
+            _emit_unreachable(
+                f"headline: backend init {failures['headline']} — TPU "
+                "tunnel unreachable (probe accepted or was unprobed, "
+                "but jax backend init never completed)")
         raise SystemExit("headline measurement failed (see stderr)")
 
 

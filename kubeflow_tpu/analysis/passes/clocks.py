@@ -2,8 +2,11 @@
 
 A module qualifies as *clock-injected* when it already declares the
 discipline: any function takes a parameter named ``clock``, or — for
-controller/autoscale modules — a parameter named ``now`` (the decider
-convention: callers pass the timestamp in, tests drive a fake clock).
+controller/autoscale/elastic modules — a parameter named ``now`` (the
+decider convention: callers pass the timestamp in, tests drive a fake
+clock).  ``kubeflow_tpu/elastic/`` is in the ``now`` scope so the
+elastic resize decider's cooldown/backlog decisions can never silently
+regrow a raw ``time.time()``.
 Inside a qualifying module, every direct call to ``time.time()``,
 ``time.monotonic()`` or ``time.sleep()`` (under any import alias) is
 flagged: it re-introduces the hidden global the injection was built to
@@ -22,7 +25,8 @@ from typing import Iterable
 from kubeflow_tpu.analysis.framework import (
     Finding, ModuleInfo, Pass, register, time_aliases)
 
-NOW_PARAM_SCOPE = ("kubeflow_tpu/controllers/", "kubeflow_tpu/autoscale/")
+NOW_PARAM_SCOPE = ("kubeflow_tpu/controllers/", "kubeflow_tpu/autoscale/",
+                   "kubeflow_tpu/elastic/")
 BANNED = {"time", "monotonic", "sleep"}
 
 

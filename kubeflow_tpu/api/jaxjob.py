@@ -12,9 +12,19 @@ spec:
   trainer: TrainerConfig dict               # the payload
   podTemplate: extra PodSpec fields merged into worker pods
   maxRestarts: gang restarts before Failed (default 3)
+  elastic: {minReplicas, maxReplicas}       # opt-in: gang may resize
+  replicas: desired worker count (elastic only; default = all hosts)
 status:
   phase: Pending | Running | Succeeded | Failed | Restarting
   conditions, restarts, workers: {ready, total}, result (trainer summary)
+  elastic: {epoch, members, size, resizes, preemptionsAbsorbed, ...}
+
+Elastic gangs (kubeflow_tpu.elastic) shrink to the surviving workers on
+infrastructure loss — NodeLost or SlicePreempted — instead of restarting,
+down to ``minReplicas``, and re-expand toward ``spec.replicas`` when the
+slice pool recovers.  Membership (``status.elastic``) is the rendezvous
+authority; the controller rewrites it with a bumped epoch on every
+resize, and workers re-shard at the next step boundary.
 """
 
 from __future__ import annotations
@@ -33,6 +43,7 @@ def new(name: str, namespace: str, *, topology: str = "v5e-4",
         trainer: dict | None = None, parallelism: dict | None = None,
         pod_template: dict | None = None, max_restarts: int = 3,
         num_slices: int = 1, max_run_seconds: float | None = None,
+        elastic: dict | None = None, replicas: int | None = None,
         image: str = "kubeflow-tpu/worker:latest") -> dict:
     if topology not in TOPOLOGIES:
         raise ValueError(
@@ -53,6 +64,10 @@ def new(name: str, namespace: str, *, topology: str = "v5e-4",
         # declared runtime bound: enforced like activeDeadlineSeconds, and
         # the admission ticket for scheduler backfill (scheduler.py)
         spec["maxRunSeconds"] = float(max_run_seconds)
+    if elastic is not None:
+        spec["elastic"] = dict(elastic)
+    if replicas is not None:
+        spec["replicas"] = int(replicas)
     return api_object(KIND, name, namespace, spec=spec)
 
 
@@ -70,6 +85,54 @@ def gang_need(job: dict) -> dict[str, int]:
     topo = TOPOLOGIES[job["spec"]["topology"]]
     n = num_slices_of(job)
     return {topo.resource_name: topo.chips * n, "pods": topo.hosts * n}
+
+
+def elastic_of(job: dict) -> tuple[int, int] | None:
+    """(minReplicas, maxReplicas) for elastic jobs, else None."""
+    e = job["spec"].get("elastic")
+    if not e:
+        return None
+    return int(e["minReplicas"]), int(e["maxReplicas"])
+
+
+def desired_replicas(job: dict) -> int:
+    """spec.replicas — the elastic desired size.  Omitted = as large as
+    allowed: every host, clamped to maxReplicas so the documented
+    default is valid for every bound choice."""
+    replicas = job["spec"].get("replicas")
+    if replicas is not None:
+        return int(replicas)
+    bounds = elastic_of(job)
+    hosts = total_hosts(job)
+    return hosts if bounds is None else min(hosts, bounds[1])
+
+
+def current_members(job: dict) -> list[int]:
+    """The live worker-index set: the controller-stamped membership for
+    elastic jobs (falling back to the initial ``[0, replicas)``), the
+    full host range otherwise."""
+    if elastic_of(job) is not None:
+        est = (job.get("status") or {}).get("elastic")
+        if est and est.get("members") is not None:
+            return sorted(int(m) for m in est["members"])
+        return list(range(desired_replicas(job)))
+    return list(range(total_hosts(job)))
+
+
+def slices_for(job: dict, members) -> int:
+    """Physical slices a member set occupies: distinct slice ordinals
+    (worker index // hosts-per-slice) — what the scheduler must account
+    when an elastic gang straddles a partial slice."""
+    hosts = TOPOLOGIES[job["spec"]["topology"]].hosts
+    return len({int(i) // hosts for i in members})
+
+
+def slice_need(job: dict) -> int:
+    """Slices this gang needs released right now: the static numSlices
+    for fixed gangs, the live membership's footprint for elastic ones."""
+    if elastic_of(job) is None:
+        return num_slices_of(job)
+    return slices_for(job, current_members(job))
 
 
 def validate(job: dict) -> None:
@@ -99,22 +162,65 @@ def validate(job: dict) -> None:
             f"dp={par.get('dp', 1)} must be a multiple of numSlices "
             f"({n_slices}) so only data-parallel traffic crosses DCN")
 
+    e = spec.get("elastic")
+    replicas = spec.get("replicas")
+    if e is None:
+        if replicas is not None:
+            raise ValueError("spec.replicas is only meaningful with "
+                             "spec.elastic (fixed gangs size by topology)")
+        return
+    hosts = TOPOLOGIES[topo].hosts * n_slices
+    for key in ("minReplicas", "maxReplicas"):
+        val = e.get(key)
+        if not isinstance(val, int) or val < 1:
+            raise ValueError(
+                f"elastic.{key} must be a positive integer, got {val!r}")
+    min_r, max_r = int(e["minReplicas"]), int(e["maxReplicas"])
+    if not min_r <= max_r <= hosts:
+        raise ValueError(
+            f"elastic bounds must satisfy 1 <= minReplicas ({min_r}) <= "
+            f"maxReplicas ({max_r}) <= total hosts ({hosts})")
+    # omitted replicas defaults to "as large as allowed" (hosts clamped
+    # to maxReplicas) — omission must be legal for every bound choice
+    want = min(hosts, max_r) if replicas is None else int(replicas)
+    if not min_r <= want <= max_r:
+        raise ValueError(
+            f"replicas ({want}) must lie within elastic bounds "
+            f"[{min_r}, {max_r}]")
+    if par:
+        # the live chip count changes under resize, so a static axis
+        # product can never hold across sizes — elastic workers derive
+        # their mesh from the membership epoch instead
+        raise ValueError("elastic jobs derive parallelism from the live "
+                         "world size; spec.parallelism must be empty")
+
 
 def worker_pod_name(job_name: str, index: int) -> str:
     return f"{job_name}-worker-{index}"
 
 
-def coordinator_address(job: dict) -> str:
-    """process-0 rendezvous endpoint (stable headless-service DNS name)."""
+def coordinator_address(job: dict, coordinator: int = 0) -> str:
+    """Rendezvous endpoint (stable headless-service DNS name) — worker 0
+    for fixed gangs; elastic membership may move it to the lowest
+    surviving index."""
     name = job["metadata"]["name"]
     ns = job["metadata"]["namespace"]
-    return (f"{worker_pod_name(name, 0)}.{name}.{ns}.svc:"
+    return (f"{worker_pod_name(name, coordinator)}.{name}.{ns}.svc:"
             f"{COORDINATOR_PORT}")
 
 
-def build_worker_pod(job: dict, index: int) -> dict:
+def build_worker_pod(job: dict, index: int, *, members=None,
+                     gated: bool = True) -> dict:
     """Worker pod for host ``index`` of the slice gang, with TPU resources
-    and rendezvous env injected (the §5.8 contract)."""
+    and rendezvous env injected (the §5.8 contract).
+
+    ``members`` (elastic gangs) is the membership the pod bootstraps
+    into: rank/world/coordinator derive from it rather than the static
+    topology — a worker admitted by an expansion starts with the live
+    epoch's view and joins at the next checkpoint boundary.  ``gated``
+    is the scheduling gate (expansion joins of an already-released gang
+    must not re-gate it).
+    """
     from kubeflow_tpu.parallel.distributed import rendezvous_env
 
     spec = job["spec"]
@@ -123,11 +229,20 @@ def build_worker_pod(job: dict, index: int) -> dict:
     ns = job["metadata"]["namespace"]
 
     n_slices = num_slices_of(job)
+    if members is None:
+        world, rank, coord = topo.hosts * n_slices, index, 0
+    else:
+        ordered = sorted(int(m) for m in members)
+        world, rank, coord = (len(ordered), ordered.index(index),
+                              ordered[0])
     env = [{"name": k, "value": v} for k, v in rendezvous_env(
-        coordinator_address(job), topo.hosts * n_slices, index).items()]
+        coordinator_address(job, coord), world, rank).items()]
     env.append({"name": "JAXJOB_NAME", "value": name})
     env.append({"name": "JAXJOB_SLICE_ID", "value": str(index // topo.hosts)})
     env.append({"name": "JAXJOB_NUM_SLICES", "value": str(n_slices)})
+    if members is not None:
+        env.append({"name": "JAXJOB_ELASTIC", "value": "1"})
+        env.append({"name": "JAXJOB_MEMBER_INDEX", "value": str(index)})
     env.append({"name": "JAXJOB_TRAINER_CONFIG", "value": _json(spec)})
 
     container = {
@@ -136,17 +251,29 @@ def build_worker_pod(job: dict, index: int) -> dict:
         "command": ["python", "-m", "kubeflow_tpu.training"],
         "env": env,
         "resources": {"limits": {topo.resource_name: topo.chips_per_host}},
-        "ports": [{"containerPort": COORDINATOR_PORT}] if index == 0 else [],
+        # the rendezvous port belongs to the COORDINATOR — worker 0 for
+        # fixed gangs, the lowest live member for elastic ones (a shrink
+        # can move it off index 0)
+        "ports": ([{"containerPort": COORDINATOR_PORT}]
+                  if index == coord else []),
     }
-    pod = api_object("Pod", worker_pod_name(name, index), ns, labels={
+    labels = {
         "jaxjob": name,
         "jaxjob-worker-index": str(index),
         "gang": name,  # atomic placement unit for the scheduler
         # the slice scheduler accounts capacity from these controller-owned
         # labels alone (spec.nodeSelector is user-overridable via podTemplate)
         "jaxjob-num-slices": str(n_slices),
+        # which physical slice this worker occupies: elastic accounting
+        # counts a gang's held slices as its DISTINCT live ordinals, so a
+        # shrink below a slice boundary actually frees the slice
+        "jaxjob-slice-ordinal": str(index // topo.hosts),
         "jaxjob-topology": spec["topology"],
-    }, spec={
+    }
+    if elastic_of(job) is not None:
+        labels["jaxjob-elastic"] = "1"
+    pod = api_object("Pod", worker_pod_name(name, index), ns, labels=labels,
+                     spec={
         "containers": [container],
         "restartPolicy": "Never",
         # per-pod DNS under the headless service requires hostname+subdomain
@@ -154,7 +281,8 @@ def build_worker_pod(job: dict, index: int) -> dict:
         "hostname": worker_pod_name(name, index),
         "subdomain": name,
         # all hosts of one slice: the scheduler must place all or none
-        "schedulingGates": [{"name": "gang-scheduling"}],
+        # (elastic expansion pods join ungated — the gang already runs)
+        "schedulingGates": ([{"name": "gang-scheduling"}] if gated else []),
         "nodeSelector": {"cloud-tpu.google.com/slice": spec["topology"]},
     })
     if n_slices > 1:

@@ -34,6 +34,11 @@ from kubeflow_tpu.chaos.injector import (
     ChaosInjector,
     ChaoticAPIServer,
 )
+from kubeflow_tpu.chaos.schedule import (
+    PreemptionSchedule,
+    StormEvent,
+)
 
 __all__ = ["CHAOS_FAULTS", "ChaosInjector", "ChaoticAPIServer",
-           "CrashHere", "FaultPlan", "FaultyIO"]
+           "CrashHere", "FaultPlan", "FaultyIO", "PreemptionSchedule",
+           "StormEvent"]

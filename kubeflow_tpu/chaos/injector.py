@@ -91,9 +91,9 @@ class ChaoticAPIServer(APIServer):
         self._maybe_fault("patch_status", kind)
         return super().patch_status(kind, name, namespace, status)
 
-    def delete(self, kind, name, namespace=None) -> None:
+    def delete(self, kind, name, namespace=None, **kwargs) -> None:
         self._maybe_fault("delete", kind)
-        return super().delete(kind, name, namespace)
+        return super().delete(kind, name, namespace, **kwargs)
 
 
 class ChaosInjector:

@@ -1,0 +1,85 @@
+"""Seeded preemption/recovery schedules for elastic-storm harnesses.
+
+A preemption *storm* is a sequence, not an event: capacity leaves in
+bursts and trickles back, and the interesting behavior (resize thrash,
+goodput collapse) lives in the sequencing.  :class:`PreemptionSchedule`
+generates that sequence deterministically from one ``random.Random(seed)``
+— the same contract as the rest of ``chaos``: same seed, same storm,
+bit-identical assertions.
+
+Events are pinned to *logical time* (the harness's tick clock), never the
+wall: ``loadtest/load_chaos.py``'s elastic phase advances ticks as its
+gang runtime steps, fires each event when the tick threshold is crossed,
+and waits for the control plane to observe it before advancing — so the
+same schedule replays the same logical storm on any machine speed and
+any controller worker count (the worker-sweep digest invariant).
+
+The schedule is a random walk of ``unavailable`` slices bounded by
+``[0, capacity - floor]``: it never preempts below the floor the harness
+wants survivable (an elastic gang's ``ceil(minReplicas / hosts)``), and
+it always returns everything by the horizon — storms end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+
+@dataclasses.dataclass(frozen=True)
+class StormEvent:
+    """One scheduled capacity change.  ``at`` is a logical tick
+    threshold; ``kind`` is ``preempt`` or ``restore``; ``count`` is
+    slices taken/returned; ``unavailable`` the cumulative total after."""
+
+    at: float
+    kind: str
+    count: int
+    unavailable: int
+
+
+class PreemptionSchedule:
+    """Deterministic storm: alternating preempt/restore bursts.
+
+    ``capacity``: pool slices; ``floor``: slices that must always stay
+    usable (events never push ``unavailable`` past ``capacity - floor``);
+    ``horizon``: logical-tick length of the storm window — events spread
+    over ``[warmup, horizon]``; ``bursts``: preempt/restore pairs.
+    """
+
+    def __init__(self, *, seed: int, capacity: int, floor: int = 1,
+                 horizon: float = 300.0, bursts: int = 3,
+                 warmup: float = 20.0):
+        if not 0 <= floor < capacity:
+            raise ValueError(f"floor {floor} must be in [0, {capacity})")
+        if bursts < 1:
+            raise ValueError("at least one burst")
+        self.seed = seed
+        self.capacity = capacity
+        self.floor = floor
+        rng = random.Random(seed)
+        max_out = capacity - floor
+        events: list[StormEvent] = []
+        # each burst: take a random bite at a random time, give it back
+        # before the next burst begins — 2*bursts ordered thresholds
+        times = sorted(rng.uniform(warmup, horizon)
+                       for _ in range(2 * bursts))
+        unavailable = 0
+        for i in range(bursts):
+            take = rng.randint(1, max_out)
+            events.append(StormEvent(times[2 * i], "preempt", take,
+                                     unavailable + take))
+            unavailable += take
+            events.append(StormEvent(times[2 * i + 1], "restore", take,
+                                     unavailable - take))
+            unavailable -= take
+        self.events: list[StormEvent] = events
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def describe(self) -> list[dict]:
+        return [dataclasses.asdict(e) for e in self.events]

@@ -75,17 +75,24 @@ COMPONENTS: dict[str, dict[str, Any]] = {
     },
     "chaos": {
         "include_dirs": ["kubeflow_tpu/chaos/*",
+                         "kubeflow_tpu/elastic/*",
                          "kubeflow_tpu/controllers/nodelifecycle.py",
                          "kubeflow_tpu/controllers/executor.py",
                          "kubeflow_tpu/controllers/scheduler.py",
                          "loadtest/load_chaos.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
-                     "tests/test_node_lifecycle.py", "tests/test_chaos.py"],
+                     "tests/test_node_lifecycle.py", "tests/test_chaos.py",
+                     "tests/test_elastic.py"],
         # seeded convergence smoke: gangs + notebooks + an InferenceService
         # under silent node outages, slice preemptions, and injected write
         # conflicts; asserts terminal convergence, zero overcommit, quota
-        # drain, and same-seed state-digest determinism.  KF_SKIP_CHAOS=1
-        # opts out on constrained hosts.
+        # drain, and same-seed state-digest determinism.  The run now ends
+        # with the ELASTIC-STORM phase: an elastic gang must out-step the
+        # restart-from-checkpoint baseline >= KF_ELASTIC_FLOOR (1.5x)
+        # through one seeded preemption schedule, with exactly-once batch
+        # delivery and digests invariant across executor worker counts.
+        # KF_SKIP_CHAOS=1 opts the whole run out; KF_SKIP_ELASTIC=1 opts
+        # out only the elastic phase (constrained hosts).
         "chaos_cmd": [sys.executable, "loadtest/load_chaos.py", "--smoke"],
     },
     "durability": {

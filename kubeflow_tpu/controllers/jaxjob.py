@@ -16,6 +16,17 @@ gang protocol here:
 4. Succeeded requires every worker Succeeded; worker-0's recorded result is
    mirrored into status.result (samples/sec, final loss).
 
+ELASTIC gangs (spec.elastic, kubeflow_tpu.elastic) relax rule 3 for
+infrastructure loss: when workers die with their host (NodeLost) or their
+slice (SlicePreempted) and the survivors still clear minReplicas, the
+controller deletes only the dead workers and REWRITES the membership —
+``status.elastic`` gets a bumped epoch and the surviving index set — so
+the gang keeps stepping at the smaller size with no restart and no
+maxRestarts charge.  When capacity returns (slice pool recovery re-
+enqueues via the watch mappers) the elastic decider re-admits workers
+toward spec.replicas; they join at the next checkpoint boundary.  A loss
+below minReplicas falls back to the NodeLost restart path (still free).
+
 Status mirroring follows notebook_controller.go:200-250's pattern.
 """
 
@@ -41,9 +52,22 @@ JOBS_CREATED = REGISTRY.counter("jaxjob_gangs_created_total",
                                 "worker gangs created")
 JOB_RESTARTS = REGISTRY.counter("jaxjob_gang_restarts_total",
                                 "gang restarts after worker failure")
+ELASTIC_RESIZES = REGISTRY.counter(
+    "jaxjob_elastic_resizes_total",
+    "elastic gang membership rewrites applied without a restart",
+    labels=("direction",))
+ELASTIC_ABSORBED = REGISTRY.counter(
+    "jaxjob_elastic_workers_absorbed_total",
+    "workers lost to infrastructure and absorbed by an elastic shrink "
+    "(no gang restart, no maxRestarts charge)")
 
 
 PARK_CONDITIONS = ("WaitingForSlices", "QuotaExceeded")
+
+# worker failure reasons that are infrastructure's fault, not the
+# workload's: never charged against spec.maxRestarts, and absorbable by
+# an elastic shrink instead of a restart
+INFRA_REASONS = ("NodeLost", "SlicePreempted")
 
 
 class JAXJobController(Controller):
@@ -56,12 +80,24 @@ class JAXJobController(Controller):
     # storm that froze the 500-gang loadtest
     UNPARK_FANOUT = 8
 
-    def __init__(self, server, *, clock: Callable[[], float] = time.time):
+    def __init__(self, server, *, clock: Callable[[], float] = time.time,
+                 decider=None):
         super().__init__(server)
         # injected clock (kfvet clock-injection): startedAt stamps, the
-        # maxRunSeconds deadline math, and the scheduler's backfill-ETA
-        # all read THIS — tests drive a fake clock instead of sleeping
+        # maxRunSeconds deadline math, the scheduler's backfill-ETA, and
+        # the elastic decider's cooldown all read THIS — tests drive a
+        # fake clock instead of sleeping
         self._clock = clock
+        # elastic expansion policy (cooldown/backlog/capacity gates);
+        # injectable so loadtests tighten the cooldown deterministically
+        from kubeflow_tpu.elastic import ElasticDecider
+
+        self._decider = decider or ElasticDecider()
+        # elastic gangs currently below their desired size, waiting on
+        # capacity: (ns, name) -> topology.  Node recovery and pool
+        # restore events re-enqueue these immediately (the poll requeue
+        # below is the fallback), mirroring the parked-gang index.
+        self._elastic_pending: dict[tuple[str | None, str], str] = {}
         # parked-jobs index: (ns, name) -> (creationTimestamp, topology,
         # condition) for gangs parked on a PARK_CONDITIONS condition.
         # Kept by _park/_unpark so pod events re-enqueue exactly the
@@ -88,11 +124,19 @@ class JAXJobController(Controller):
     def _capacity_changed(self, ev):
         """Slice-pool spec changed: re-enqueue the FIFO-oldest gangs
         parked on WaitingForSlices (any topology — the pool edit may have
-        grown any of them)."""
+        grown any of them), plus elastic gangs waiting to re-expand (a
+        slice restore is exactly the recovery they watch for).  Both
+        loops are fanout-capped: an uncapped yield per pool event is the
+        reconcile storm that froze the 500-gang loadtest.  NOT mapped:
+        Node events — node readiness never changes pool capacity, and
+        every heartbeat renewal is a Node event; pending gangs poll via
+        their decider-cooldown requeue instead."""
         parked = sorted((ts, key)
                         for key, (ts, _topo, cond) in self._parked.items()
                         if cond == "WaitingForSlices")
         for _, key in parked[:self.UNPARK_FANOUT]:
+            yield Request(*key)
+        for key in sorted(self._elastic_pending)[:self.UNPARK_FANOUT]:
             yield Request(*key)
 
     def _quota_changed(self, ev):
@@ -133,29 +177,41 @@ class JAXJobController(Controller):
             yield Request(*key)
 
     def reconcile(self, req: Request) -> Result | None:
+        key = (req.namespace, req.name)
         try:
             job = self.server.get(api.KIND, req.name, req.namespace)
         except NotFound:
-            self._parked.pop((req.namespace, req.name), None)
-            self._park_delay.pop((req.namespace, req.name), None)
+            self._parked.pop(key, None)
+            self._park_delay.pop(key, None)
+            self._elastic_pending.pop(key, None)
             return None
         if job["metadata"].get("deletionTimestamp"):
-            self._parked.pop((req.namespace, req.name), None)
-            self._park_delay.pop((req.namespace, req.name), None)
+            self._parked.pop(key, None)
+            self._park_delay.pop(key, None)
+            self._elastic_pending.pop(key, None)
             return None  # children GC'd via ownerReferences
 
         api.validate(job)
         spec = job["spec"]
-        gang_size = api.total_hosts(job)  # hosts x slices: one atomic gang
+        elastic = api.elastic_of(job)
+        # elastic gangs size by the controller-owned membership record;
+        # fixed gangs by topology (hosts x slices: one atomic gang)
+        members = api.current_members(job)
+        gang_size = len(members)
         status = dict(job.get("status") or {})
+        if elastic is not None and not status.get("elastic"):
+            # first reconcile stamps epoch 0 — the rendezvous authority
+            # every later resize rewrites
+            status["elastic"] = self._elastic_status(job, members, epoch=0)
         phase = status.get("phase", "Pending")
         if phase in ("Succeeded", "Failed"):
-            self._parked.pop((req.namespace, req.name), None)
-            self._park_delay.pop((req.namespace, req.name), None)
+            self._parked.pop(key, None)
+            self._park_delay.pop(key, None)
+            self._elastic_pending.pop(key, None)
             return None
 
         self._ensure_service(job)
-        pods, parked = self._ensure_gang(job, gang_size)
+        pods, parked = self._ensure_gang(job, members)
         if parked is not None:
             # over quota: the WHOLE gang stays un-created (a TPU slice is
             # useless partially admitted); park and retry level-triggered
@@ -182,8 +238,16 @@ class JAXJobController(Controller):
             failed = [p for p in pods
                       if p.get("status", {}).get("phase") == "Failed"]
             infra = bool(failed) and all(
-                p.get("status", {}).get("reason") == "NodeLost"
+                p.get("status", {}).get("reason") in INFRA_REASONS
                 for p in failed)
+            if elastic is not None and infra:
+                # elastic + infrastructure loss: absorb by membership
+                # rewrite when the survivors clear minReplicas — the gang
+                # keeps stepping, nothing restarts, no budget burns
+                shrunk = self._elastic_shrink(job, status, req, members,
+                                              failed)
+                if shrunk is not None:
+                    return shrunk
             restarts = int(status.get("restarts", 0))
             terminal = (not infra
                         and restarts >= int(spec.get("maxRestarts", 3)))
@@ -195,6 +259,17 @@ class JAXJobController(Controller):
                                        req.namespace)
                 except NotFound:
                     pass
+            if elastic is not None and not terminal:
+                # a full restart rebuilds at the desired size: fresh
+                # epoch, initial membership — the recreate path parks on
+                # WaitingForSlices until capacity admits it again
+                est = status.get("elastic") or {}
+                status["elastic"] = self._elastic_status(
+                    job, list(range(api.desired_replicas(job))),
+                    epoch=int(est.get("epoch", 0)) + 1,
+                    resizes=int(est.get("resizes", 0)),
+                    absorbed=int(est.get("preemptionsAbsorbed", 0)),
+                    last_resize_at=self._clock())
             if infra:
                 record_event(self.server, job, "Warning", "GangNodeLost",
                              "worker lost with its host; restarting gang")
@@ -257,7 +332,9 @@ class JAXJobController(Controller):
         if gated and len(pods) == gang_size:
             from kubeflow_tpu.controllers import scheduler
 
-            ok, why = scheduler.may_release(self.server, job, self._clock())
+            need = api.slice_need(job) if elastic is not None else None
+            ok, why = scheduler.may_release(self.server, job, self._clock(),
+                                            need=need)
             if not ok:
                 return self._park(job, status, req, "WaitingForSlices",
                                   "NoCapacity", why)
@@ -274,6 +351,17 @@ class JAXJobController(Controller):
             self._unpark(job, status, "WaitingForSlices", "Scheduled")
             status.setdefault("startedAt", self._clock())
 
+        # elastic resize toward spec.replicas: expansion when capacity
+        # recovered and the decider's gates pass, voluntary shrink when
+        # the user lowered the desired size
+        elastic_requeue: float | None = None
+        if (elastic is not None and pods and not gated
+                and all(ph == "Running" for ph in phases)):
+            resized = self._elastic_resize(job, status, req, members)
+            if isinstance(resized, Result):
+                return resized
+            elastic_requeue = resized
+
         if all(ph == "Succeeded" for ph in phases) and pods:
             status["phase"] = "Succeeded"
             result = pods[0].get("status", {}).get("result")
@@ -288,10 +376,222 @@ class JAXJobController(Controller):
                                if status.get("phase") == "Restarting"
                                else "Pending")
         self.server.patch_status(api.KIND, req.name, req.namespace, status)
-        if deadline_requeue is not None and status["phase"] not in (
-                "Succeeded", "Failed"):
-            return Result(requeue_after=deadline_requeue)
+        if status["phase"] in ("Succeeded", "Failed"):
+            self._elastic_pending.pop(key, None)
+            return None
+        pending = [r for r in (deadline_requeue, elastic_requeue)
+                   if r is not None]
+        if pending:
+            return Result(requeue_after=min(pending))
         return None
+
+    # -- elastic resize ------------------------------------------------------
+    def _elastic_status(self, job: dict, members, *, epoch: int,
+                        resizes: int = 0, absorbed: int = 0,
+                        last_resize_at: float | None = None) -> dict:
+        """The controller-owned membership record (``status.elastic``):
+        THE rendezvous authority — workers, the chaos runtime, and the
+        dashboard all read gang composition from here."""
+        min_r, max_r = api.elastic_of(job)
+        members = sorted(int(m) for m in members)
+        out = {"epoch": int(epoch), "members": members,
+               "size": len(members),
+               "coordinator": members[0] if members else None,
+               "minReplicas": min_r, "maxReplicas": max_r,
+               "desired": api.desired_replicas(job),
+               "resizes": int(resizes),
+               "preemptionsAbsorbed": int(absorbed)}
+        if last_resize_at is not None:
+            out["lastResizeAt"] = float(last_resize_at)
+        return out
+
+    def _elastic_shrink(self, job: dict, status: dict, req: Request,
+                        members: list[int],
+                        failed: list[dict]) -> Result | None:
+        """Absorb an infrastructure loss by membership rewrite: delete
+        ONLY the dead workers, bump the epoch, keep the survivors
+        stepping.  None = cannot absorb (below minReplicas) — the caller
+        falls through to the free NodeLost restart."""
+        min_r, _max_r = api.elastic_of(job)
+        failed_idx = {
+            int(p["metadata"]["labels"]["jaxjob-worker-index"])
+            for p in failed}
+        surviving = [i for i in members if i not in failed_idx]
+        if len(surviving) < min_r:
+            record_event(self.server, job, "Warning", "ElasticFloor",
+                         f"{len(failed_idx)} worker(s) lost leaves "
+                         f"{len(surviving)} < minReplicas={min_r}; "
+                         "restarting gang instead of shrinking")
+            return None
+        est = status.get("elastic") or self._elastic_status(
+            job, members, epoch=0)
+        status["elastic"] = self._elastic_status(
+            job, surviving, epoch=int(est.get("epoch", 0)) + 1,
+            resizes=int(est.get("resizes", 0)) + 1,
+            absorbed=(int(est.get("preemptionsAbsorbed", 0))
+                      + len(failed_idx)),
+            last_resize_at=self._clock())
+        ELASTIC_RESIZES.labels("shrink").inc()
+        ELASTIC_ABSORBED.inc(len(failed_idx))
+        reasons = {p.get("status", {}).get("reason") for p in failed}
+        record_event(self.server, job, "Normal", "GangShrink",
+                     f"absorbed loss of worker(s) "
+                     f"{sorted(failed_idx)} ({'/'.join(sorted(reasons))}); "
+                     f"gang resized {len(members)} -> {len(surviving)} "
+                     f"without restart (epoch "
+                     f"{status['elastic']['epoch']})")
+        running = sum(
+            1 for i in surviving
+            if self._pod_phase(req, job, i) in ("Running", "Succeeded"))
+        status["workers"] = {"ready": running, "total": len(surviving)}
+        status["phase"] = "Running" if running == len(surviving) else \
+            "Pending"
+        self._elastic_pending[(req.namespace, req.name)] = \
+            job["spec"]["topology"]
+        # PUBLISH the rewrite before actuating: a delete that lands while
+        # the status patch is still unwritten would make the next
+        # reconcile recreate the dead index as a live member — a
+        # spurious gang restart.  Membership is the authority; pods
+        # follow it (deletion included — _ensure_gang reaps stragglers
+        # if a delete below hits a transient fault).
+        self.server.patch_status(api.KIND, req.name, req.namespace, status)
+        self._delete_pods(req.namespace,
+                          [p["metadata"]["name"] for p in failed])
+        return Result(requeue_after=0.05)
+
+    def _delete_pods(self, namespace: str | None,
+                     names: list[str]) -> None:
+        """Best-effort worker teardown AFTER a membership rewrite landed.
+        Transient faults are tolerated — the non-member reap in
+        ``_ensure_gang`` converges on the next reconcile."""
+        from kubeflow_tpu.core.store import Conflict
+
+        for name in names:
+            try:
+                self.server.delete("Pod", name, namespace)
+            except (NotFound, Conflict):
+                pass
+
+    def _pod_phase(self, req: Request, job: dict, index: int) -> str:
+        try:
+            pod = self.server.get(
+                "Pod", api.worker_pod_name(job["metadata"]["name"], index),
+                req.namespace)
+        except NotFound:
+            return "Missing"
+        return pod.get("status", {}).get("phase", "Pending")
+
+    def _elastic_resize(self, job: dict, status: dict, req: Request,
+                        members: list[int]) -> Result | float | None:
+        """Level-triggered drive toward spec.replicas.  Returns a Result
+        when membership was rewritten (already patched), a requeue hint
+        while an expansion is pending its gates, or None at steady state.
+        New workers are created on the NEXT reconcile from the rewritten
+        membership — the membership record is the authority, pods follow.
+        """
+        from kubeflow_tpu.controllers import scheduler
+
+        key = (req.namespace, req.name)
+        est = status["elastic"]
+        min_r, max_r = api.elastic_of(job)
+        desired = api.desired_replicas(job)
+        topo_hosts = api.TOPOLOGIES[job["spec"]["topology"]].hosts
+        free = scheduler.free_slices(self.server, job["spec"]["topology"])
+        # slots on slices the gang already holds are free to fill; new
+        # ordinals each need a free slice from the pool
+        held_ords = {i // topo_hosts for i in members}
+        if free is None:
+            free_hosts = None
+        else:
+            partial = len(held_ords) * topo_hosts - len(members)
+            free_hosts = max(0, free) * topo_hosts + partial
+        target = self._decider.decide(
+            size=len(members), desired=desired, min_replicas=min_r,
+            max_replicas=max_r, free_hosts=free_hosts,
+            backlog_steps=self._backlog_steps(job, status),
+            last_resize_at=est.get("lastResizeAt"), now=self._clock())
+        if target == len(members):
+            if desired > len(members):
+                # blocked on a gate (cooldown/capacity): keep watching
+                self._elastic_pending[key] = job["spec"]["topology"]
+                return self._decider.cooldown_s
+            self._elastic_pending.pop(key, None)
+            return None
+        dropped: list[int] = []
+        if target < len(members):
+            # voluntary shrink (spec.replicas lowered): drop the highest
+            # indices — membership rewritten first, pods deleted after
+            keep = sorted(members)[:target]
+            dropped = [i for i in members if i not in keep]
+            new_members = keep
+            direction = "shrink"
+        else:
+            # expansion: admit the lowest absent indices, capped so new
+            # slice ordinals never exceed the pool's free slices.  A
+            # candidate whose ordinal would need a slice the budget
+            # cannot cover is SKIPPED, not a loop exit: a hole on a
+            # slice the gang already holds (a partial slice left by an
+            # earlier host loss) may sit at a HIGHER index and is always
+            # admittable — breaking early left those holes unfillable
+            add: list[int] = []
+            budget = None if free is None else max(0, free)
+            new_ords: set[int] = set()
+            candidate = 0
+            while (len(members) + len(add) < target
+                   and candidate < max_r):
+                if candidate in members or candidate in add:
+                    candidate += 1
+                    continue
+                ordinal = candidate // topo_hosts
+                if ordinal not in held_ords and ordinal not in new_ords:
+                    if budget is not None and len(new_ords) >= budget:
+                        candidate += 1
+                        continue  # no slice for this ordinal; try holes
+                    new_ords.add(ordinal)
+                add.append(candidate)
+                candidate += 1
+            if not add:
+                self._elastic_pending[key] = job["spec"]["topology"]
+                return self._decider.cooldown_s
+            new_members = sorted(members + add)
+            direction = "expand"
+        status["elastic"] = self._elastic_status(
+            job, new_members, epoch=int(est.get("epoch", 0)) + 1,
+            resizes=int(est.get("resizes", 0)) + 1,
+            absorbed=int(est.get("preemptionsAbsorbed", 0)),
+            last_resize_at=self._clock())
+        ELASTIC_RESIZES.labels(direction).inc()
+        record_event(self.server, job, "Normal",
+                     "GangExpand" if direction == "expand" else
+                     "GangShrink",
+                     f"elastic resize {len(members)} -> "
+                     f"{len(new_members)} (epoch "
+                     f"{status['elastic']['epoch']}, toward desired "
+                     f"{desired})")
+        if len(new_members) >= desired:
+            self._elastic_pending.pop(key, None)
+        else:
+            self._elastic_pending[key] = job["spec"]["topology"]
+        status["workers"] = {"ready": min(len(members), len(new_members)),
+                             "total": len(new_members)}
+        self.server.patch_status(api.KIND, req.name, req.namespace, status)
+        if dropped:
+            self._delete_pods(req.namespace,
+                              [api.worker_pod_name(req.name, i)
+                               for i in dropped])
+        return Result(requeue_after=0.05)
+
+    def _backlog_steps(self, job: dict, status: dict) -> int | None:
+        """Remaining training steps, from the scraped worker metrics vs
+        the declared trainer horizon; None (= assume plenty) when either
+        side is unknown."""
+        total = (job["spec"].get("trainer") or {}).get("steps")
+        if total is None:
+            return None
+        step = (status.get("metrics") or {}).get("step")
+        if step is None:
+            return int(total)
+        return max(0, int(total) - int(step))
 
     # -- parking -------------------------------------------------------------
     def _park(self, job: dict, status: dict, req: Request, cond_type: str,
@@ -389,18 +689,40 @@ class JAXJobController(Controller):
             self.server.create(svc)
 
     def _ensure_gang(self, job: dict,
-                     hosts: int) -> tuple[list[dict], str | None]:
+                     members: list[int]) -> tuple[list[dict], str | None]:
         """(pods, parked_reason): creates missing workers all-or-nothing.
 
-        Quota is pre-checked for the whole gang, and a mid-creation quota
-        loss (raced by another gang; the store's admission hook is the
+        ``members`` is the worker-index set to realize — the full host
+        range for fixed gangs, the live membership for elastic ones
+        (membership is rewritten FIRST, pods follow it here).  Quota is
+        pre-checked for the whole gang, and a mid-creation quota loss
+        (raced by another gang; the store's admission hook is the
         authoritative gate) rolls back every pod created this pass.
         """
         ns = job["metadata"]["namespace"]
         name = job["metadata"]["name"]
+        elastic = api.elastic_of(job) is not None
+        if elastic and self.server.count(
+                "Pod", ns,
+                field_match={"metadata.labels.jaxjob": name}) > len(members):
+            # more pods than members: a resize dropped indices whose
+            # teardown hit a transient fault.  Reap them level-triggered
+            # (membership is the authority, pods converge to it) — the
+            # copy-free count above keeps the steady-state reconcile from
+            # paying a projection scan it almost never needs
+            member_set = set(members)
+            strays = [
+                p["metadata"]["name"] for p in self.server.project(
+                    "Pod", ("metadata.name", "metadata.labels"),
+                    namespace=ns,
+                    label_selector={"matchLabels": {"jaxjob": name}})
+                if int(p["metadata"]["labels"]
+                       .get("jaxjob-worker-index", -1)) not in member_set]
+            if strays:
+                self._delete_pods(ns, strays)
         pods = []
         missing = []
-        for i in range(hosts):
+        for i in members:
             try:
                 pods.append(self.server.get(
                     "Pod", api.worker_pod_name(name, i), ns))
@@ -413,8 +735,14 @@ class JAXJobController(Controller):
         if blocker is not None:
             return pods, (f"queued behind {blocker} for namespace quota "
                           f"(FIFO)")
-        to_create = [set_owner(api.build_worker_pod(job, i), job)
-                     for i in missing]
+        # elastic expansion joins an already-released gang ungated (the
+        # capacity was checked when membership grew; re-gating would
+        # wedge on a release pass the running gang never needs)
+        released_gang = elastic and any(
+            not p["spec"].get("schedulingGates") for p in pods)
+        to_create = [set_owner(api.build_worker_pod(
+            job, i, members=members if elastic else None,
+            gated=not released_gang), job) for i in missing]
         need: dict[str, int] = {}
         for pod in to_create:
             for key, val in quota.pod_tpu_requests(pod).items():
@@ -435,7 +763,7 @@ class JAXJobController(Controller):
                     except NotFound:
                         pass
                 return pods, str(e)
-        if len(missing) == hosts:
+        if len(missing) == len(members):
             JOBS_CREATED.inc()  # fresh gang (vs. mid-restart backfill)
         pods.extend(created)
         pods.sort(key=lambda p: int(

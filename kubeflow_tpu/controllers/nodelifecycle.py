@@ -41,6 +41,10 @@ HEARTBEAT_AGE = REGISTRY.gauge(
 PODS_NODE_LOST = REGISTRY.counter(
     "pods_node_lost_total",
     "pods marked Failed because their node stopped heartbeating")
+NODE_RECOVERED = REGISTRY.counter(
+    "node_recovered_total",
+    "silenced nodes whose heartbeat resumed (NotReady -> Ready) — the "
+    "recovery signal the elastic re-expand path watches")
 
 NODE_LOST_REASON = "NodeLost"
 
@@ -63,12 +67,18 @@ class NodeLifecycleController(Controller):
         # whole controller — tests age nodes by advancing a fake clock
         # instead of sleeping past real TTLs
         self._clock = clock
+        # nodes THIS controller declared NotReady, so a resumed heartbeat
+        # is recognized as a recovery (the status flag alone can't carry
+        # the transition: the heartbeat's own renewal re-stamps
+        # ready=True before this controller ever observes the flip)
+        self._not_ready: set[str] = set()
 
     def reconcile(self, req: Request) -> Result | None:
         try:
             node = self.server.get("Node", req.name)
         except NotFound:
             HEARTBEAT_AGE.labels(req.name).set(0.0)
+            self._not_ready.discard(req.name)
             return None
         status = node.get("status", {})
         # a registered node that never heartbeat ages from registration
@@ -80,9 +90,17 @@ class NodeLifecycleController(Controller):
             if status.get("ready") is not True:
                 self.server.patch_status("Node", req.name, None, {
                     **status, "ready": True, "message": ""})
-                if status.get("ready") is False:
-                    record_event(self.server, node, "Normal", "NodeReady",
-                                 "heartbeat resumed")
+            if req.name in self._not_ready:
+                # recovery made observable: counted + evented so the
+                # elastic re-expand path (and dashboards) can see a host
+                # return instead of only ever seeing it die.  Detected
+                # from THIS controller's silenced-set, not the status
+                # flag: the resumed heartbeat's own renewal re-stamps
+                # ready=True before this reconcile can observe the flip
+                self._not_ready.discard(req.name)
+                NODE_RECOVERED.inc()
+                record_event(self.server, node, "Normal", "NodeReady",
+                             "heartbeat resumed; node recovered")
             # re-check the moment the current heartbeat would go stale
             return Result(requeue_after=max(0.05, self.ttl - age + 0.01))
         if status.get("ready") is not False:
@@ -91,6 +109,7 @@ class NodeLifecycleController(Controller):
                 "message": f"no heartbeat for {age:.1f}s"})
             record_event(self.server, node, "Warning", "NodeNotReady",
                          f"no heartbeat for {age:.1f}s (ttl {self.ttl}s)")
+        self._not_ready.add(req.name)
         lost = self._fail_bound_pods(req.name)
         if lost:
             PODS_NODE_LOST.inc(lost)

@@ -37,7 +37,7 @@ from kubeflow_tpu.core import Controller, Request, Result
 from kubeflow_tpu.core.events import record_event
 from kubeflow_tpu.core.objects import api_object
 from kubeflow_tpu.core.quota import TERMINAL_PHASES
-from kubeflow_tpu.core.store import APIServer, NotFound
+from kubeflow_tpu.core.store import APIServer, Conflict, NotFound
 from kubeflow_tpu.utils.metrics import REGISTRY
 
 POOL_KIND = "TpuSlicePool"
@@ -47,6 +47,15 @@ TOPOLOGY_LABEL = "jaxjob-topology"
 GANG_PREEMPTIONS = REGISTRY.counter(
     "jaxjob_gang_preemptions_total",
     "gangs evicted because their slices became unavailable")
+GANG_SHRINK_PREEMPTIONS = REGISTRY.counter(
+    "jaxjob_gang_slice_shrinks_total",
+    "slice preemptions absorbed by shrinking an elastic gang in place "
+    "instead of evicting it")
+
+# infrastructure failure reason stamped on the workers an elastic shrink
+# takes: the JAXJob controller treats it like NodeLost (no maxRestarts
+# burn) but absorbs it by membership rewrite instead of gang restart
+SLICE_PREEMPTED_REASON = "SlicePreempted"
 
 
 def new_pool(capacity: dict[str, int], *, backfill: bool = False,
@@ -118,6 +127,13 @@ def _scan_gangs_uncached(server: APIServer,
                          topology: str) -> tuple[dict, dict]:
     released: dict[tuple, int] = {}
     waiting: dict[tuple, int] = {}
+    # elastic gangs hold exactly their DISTINCT live slice ordinals (a
+    # shrink below a slice boundary frees that slice); fixed gangs hold
+    # their static numSlices label — a mid-restart fixed gang with pods
+    # missing still holds the whole footprint, which ordinal counting
+    # would transiently under-report and over-admit against
+    released_ords: dict[tuple, set] = {}
+    waiting_ords: dict[tuple, set] = {}
     # projection, not list: this scan runs per scheduling decision over
     # every pod — full-object copies here were the 500-gang quadratic
     for pod in server.project(
@@ -128,18 +144,33 @@ def _scan_gangs_uncached(server: APIServer,
         if pod.get("status", {}).get("phase") in TERMINAL_PHASES:
             continue
         md = pod.get("metadata", {})
-        gang = md.get("labels", {}).get("gang")
+        labels = md.get("labels", {})
+        gang = labels.get("gang")
         if not gang:
             continue
         owner_uid = next((r.get("uid")
                           for r in md.get("ownerReferences", [])
                           if r.get("kind") == "JAXJob"), None)
         key = (md.get("namespace"), gang, owner_uid)
-        slices = int(md.get("labels", {}).get("jaxjob-num-slices", "1"))
-        if pod.get("spec", {}).get("schedulingGates"):
+        gated = bool(pod.get("spec", {}).get("schedulingGates"))
+        if labels.get("jaxjob-elastic"):
+            bucket = waiting_ords if gated else released_ords
+            bucket.setdefault(key, set()).add(
+                int(labels.get("jaxjob-slice-ordinal", "0")))
+            continue
+        slices = int(labels.get("jaxjob-num-slices", "1"))
+        if gated:
             waiting[key] = slices
         else:
             released[key] = slices
+    for key, ords in released_ords.items():
+        released[key] = len(ords)
+    for key, ords in waiting_ords.items():
+        # an elastic gang's gated pods on ordinals it already holds
+        # (expansion within a live slice) add no new demand
+        extra = ords - released_ords.get(key, set())
+        if extra:
+            waiting[key] = len(extra)
     # a gang mid-release (some gates lifted) holds capacity already
     for key in released:
         waiting.pop(key, None)
@@ -205,7 +236,33 @@ def _head_eta(server: APIServer, released: dict[tuple, int], free: int,
     return None  # not enough capacity tracked (shouldn't happen)
 
 
-def may_release(server: APIServer, job: dict, now: float) -> tuple[bool, str]:
+def free_slices(server: APIServer, topology: str) -> int | None:
+    """Usable slices an elastic expansion could claim right now.  None =
+    unconstrained (no pool, or the topology is absent from it).
+
+    Expansion obeys the same admission discipline ``may_release``
+    enforces on whole gangs: a CORDONED topology is draining ("nothing
+    new starts" — growing a running gang is starting new work on it),
+    and gangs WAITING in the FIFO queue have first claim on free
+    capacity — an elastic gang re-expanding after every restore must
+    not perpetually starve a parked gang at the queue head."""
+    try:
+        pool = server.get(POOL_KIND, POOL_NAME)
+    except NotFound:
+        return None
+    cap_map = pool.get("spec", {}).get("capacity") or None
+    if cap_map is None or topology not in cap_map:
+        return None
+    if _cordoned(pool, topology):
+        return 0
+    released, waiting = _scan_gangs(server, topology)
+    if waiting:
+        return 0
+    return _available(pool, topology) - sum(released.values())
+
+
+def may_release(server: APIServer, job: dict, now: float,
+                *, need: int | None = None) -> tuple[bool, str]:
     """(ok, reason): whether this job's complete, gated gang may be released
     under the slice pool — strict FIFO per topology, all-or-nothing, with
     optional conservative backfill (module docstring).
@@ -213,10 +270,13 @@ def may_release(server: APIServer, job: dict, now: float) -> tuple[bool, str]:
     ``now`` is REQUIRED (kfvet clock-injection): the backfill-ETA math
     must run off the caller's clock so tests and replay drive it
     deterministically — the JAXJob controller passes its injected clock.
+    ``need`` overrides the spec's static numSlices (elastic gangs pass
+    their live membership's slice footprint).
     """
     spec = job["spec"]
     topology = spec["topology"]
-    need = int(spec.get("numSlices", 1))
+    if need is None:
+        need = int(spec.get("numSlices", 1))
     try:
         pool = server.get(POOL_KIND, POOL_NAME)
     except NotFound:
@@ -358,10 +418,89 @@ class SlicePreemptionController(Controller):
         for key in order:
             if held <= avail:
                 break
+            # elastic gangs absorb the loss in place: give back only the
+            # overcommitted slices (down to minReplicas' floor) and keep
+            # the survivors stepping — the whole point of elasticity.
+            # Only when the floor still doesn't fit does the gang fall
+            # through to whole-gang eviction like a fixed one.
+            shrunk = self._shrink_elastic(key, topology, released[key],
+                                          held - avail)
+            if shrunk:
+                GANG_SHRINK_PREEMPTIONS.inc(shrunk)
+                held -= shrunk
+                continue
             self._evict(key, topology)
             held -= released[key]
             evicted += 1
         return evicted
+
+    def _shrink_elastic(self, key: tuple, topology: str, holds: int,
+                        overcommit: int) -> int:
+        """Mark the victim slices' workers Failed/SlicePreempted on an
+        elastic gang; returns slices given back (0 = not elastic, or
+        already at its floor — caller evicts).  The JAXJob controller
+        turns the Failed workers into a membership rewrite."""
+        from kubeflow_tpu.api import jaxjob as api
+
+        job = _job_get(self.server, key)
+        if job is None:
+            return 0
+        bounds = api.elastic_of(job)
+        if bounds is None:
+            return 0
+        by_ordinal: dict[int, list] = {}
+        for pod in self.server.project(
+                "Pod", ("metadata.name", "metadata.labels",
+                        "metadata.ownerReferences", "status.phase"),
+                namespace=key[0],
+                label_selector={"matchLabels": {"gang": key[1],
+                                                TOPOLOGY_LABEL: topology}}):
+            md = pod["metadata"]
+            if key[2] is not None and not any(
+                    r.get("uid") == key[2]
+                    for r in md.get("ownerReferences", [])):
+                continue
+            if pod.get("status", {}).get("phase") in TERMINAL_PHASES:
+                continue
+            ordinal = int(md.get("labels", {})
+                          .get("jaxjob-slice-ordinal", "0"))
+            by_ordinal.setdefault(ordinal, []).append(md["name"])
+        # victims: the HIGHEST live ordinals (mirrors youngest-first —
+        # the least-warm end of the gang; deterministic either way).
+        # The floor is counted in WORKERS, not slices: a partial slice
+        # (earlier host loss) means slice math could approve a shrink
+        # that leaves the SURVIVOR COUNT below minReplicas, which the
+        # gang controller would then refuse — turning a "shrink in
+        # place" into the whole-gang restart this path exists to avoid.
+        surviving = sum(len(v) for v in by_ordinal.values())
+        victims: list[int] = []
+        for ordinal in sorted(by_ordinal, reverse=True):
+            if len(victims) >= overcommit:
+                break
+            if surviving - len(by_ordinal[ordinal]) < bounds[0]:
+                break  # next victim would dip below minReplicas workers
+            victims.append(ordinal)
+            surviving -= len(by_ordinal[ordinal])
+        if not victims:
+            return 0
+        self.log.warning("shrinking elastic gang off preempted slices",
+                         gang=f"{key[0]}/{key[1]}", topology=topology,
+                         slices=len(victims))
+        record_event(self.server, job, "Warning", "SlicePreempted",
+                     f"{len(victims)} slice(s) of {topology} preempted; "
+                     "shrinking gang in place (no restart)")
+        for ordinal in victims:
+            for name in by_ordinal[ordinal]:
+                try:
+                    pod = self.server.get("Pod", name, key[0])
+                    self.server.patch_status("Pod", name, key[0], {
+                        **pod.get("status", {}), "phase": "Failed",
+                        "reason": SLICE_PREEMPTED_REASON,
+                        "message": f"slice ordinal {ordinal} of "
+                                   f"{topology} preempted"})
+                except NotFound:
+                    pass
+        return len(victims)
 
     def _evict(self, key: tuple, topology: str) -> None:
         ns, gang, _uid = key
@@ -373,7 +512,9 @@ class SlicePreemptionController(Controller):
                          f"slice(s) of {topology} became unavailable; "
                          "gang evicted and requeued")
         for pod in self.server.project(
-                "Pod", ("metadata.name", "metadata.ownerReferences"),
+                "Pod", ("metadata.name", "metadata.uid",
+                        "metadata.ownerReferences",
+                        "spec.schedulingGates"),
                 namespace=ns,
                 label_selector={"matchLabels": {"gang": gang,
                                                 TOPOLOGY_LABEL: topology}}):
@@ -381,7 +522,25 @@ class SlicePreemptionController(Controller):
                     r.get("uid") == key[2]
                     for r in pod["metadata"].get("ownerReferences", [])):
                 continue  # same-name recreation's pods are a different gang
-            try:
-                self.server.delete("Pod", pod["metadata"]["name"], ns)
-            except NotFound:
-                pass
+            if pod.get("spec", {}).get("schedulingGates"):
+                # an already-gated pod (a recreation queued behind this
+                # very eviction) holds no capacity; deleting it is churn
+                continue
+            # delete EXACTLY the incarnation the scan condemned (uid
+            # precondition): the gang controller recreates workers the
+            # instant they vanish, and a name-keyed delete racing that
+            # recreation kills the replacement — one eviction becomes
+            # several uid-replacement waves for the restarted job.
+            # Transient write Conflicts are absorbed in place: aborting
+            # half-evicted and retrying later has the same race.
+            for _ in range(50):
+                try:
+                    self.server.delete("Pod", pod["metadata"]["name"], ns,
+                                       uid=pod["metadata"]["uid"])
+                    break
+                except NotFound:
+                    break
+                except Conflict as e:
+                    if "precondition" in str(e):
+                        break  # replaced incarnation: not this eviction's
+                    continue  # transient (chaos/oc race): re-issue

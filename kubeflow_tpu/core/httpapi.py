@@ -231,7 +231,10 @@ class RestAPI:
                 return "200 OK", self.server.update(obj)
             if method == "DELETE":
                 self._authz(user, "delete", kind, ns)
-                self.server.delete(kind, name, ns)
+                # ?uid= is the k8s DeleteOptions.Preconditions.UID shape:
+                # delete only that incarnation (409 when it was replaced)
+                self.server.delete(kind, name, ns,
+                                   uid=qs.get("uid", [None])[0])
                 return "200 OK", {"status": "deleted"}
         raise NotFound(f"no route {method} {path}")
 

@@ -252,9 +252,12 @@ class KubeStore:
             {"status": status})
 
     def delete(self, kind: str, name: str, namespace: str | None = None,
-               ) -> None:
+               *, uid: str | None = None) -> None:
+        from urllib.parse import quote
+
+        q = f"?uid={quote(uid)}" if uid is not None else ""
         self._req("DELETE",
-                  f"/apis/{kind}/{self._ns_seg(namespace)}/{name}")
+                  f"/apis/{kind}/{self._ns_seg(namespace)}/{name}{q}")
 
     def kinds(self, namespace: str | None = None) -> list[str]:
         """Kind discovery (GET /apis) — the reconnecting watch uses it to

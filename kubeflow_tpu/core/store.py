@@ -574,12 +574,20 @@ class APIServer(_LazySnapshots):
 
     @_traced_write("delete")
     def delete(self, kind: str, name: str, namespace: str | None = None,
-               ) -> None:
+               *, uid: str | None = None) -> None:
+        """``uid`` is a k8s DeleteOptions.Preconditions.UID: when given,
+        deletion applies only to THAT incarnation — a caller acting on a
+        scan must not kill a same-name replacement created after the scan
+        (Conflict signals the mismatch; the condemned object is gone)."""
         with self._lock:
             key = self._key(kind, namespace, name)
             obj = self._objects.get(key)
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
+            if uid is not None and obj["metadata"].get("uid") != uid:
+                raise Conflict(
+                    f"{kind} {namespace}/{name}: uid precondition failed "
+                    "(incarnation replaced since the caller observed it)")
             if obj["metadata"].get("finalizers"):
                 # finalizer protocol: mark, let controllers drain finalizers
                 if "deletionTimestamp" not in obj["metadata"]:

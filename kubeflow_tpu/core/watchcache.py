@@ -597,8 +597,8 @@ class FollowerCache(_LazySnapshots):
         return self._server.patch_status(kind, name, namespace, status)
 
     def delete(self, kind: str, name: str, namespace: str | None = None,
-               ) -> None:
-        return self._server.delete(kind, name, namespace)
+               **kwargs) -> None:
+        return self._server.delete(kind, name, namespace, **kwargs)
 
     def watch(self, kinds=None, namespace=None, resource_version=None):
         # watches are served by the leader's window (a follower-local

@@ -297,11 +297,41 @@ def cluster_health(server) -> dict:
             "pods": server.count("Pod",
                                  field_match={"status.nodeName": name}),
         })
+    # per-gang elastic standing: which gangs can absorb preemptions in
+    # place, their live vs allowed size, and how much infrastructure
+    # loss they have soaked up without a restart — straight from the
+    # controller-owned membership record (status.elastic)
+    elastic_gangs = []
+    for job in server.project(
+            "JAXJob", ("metadata.name", "metadata.namespace",
+                       "spec.elastic", "status.phase", "status.elastic")):
+        est = (job.get("status") or {}).get("elastic")
+        if not (job.get("spec", {}).get("elastic") and est):
+            continue
+        elastic_gangs.append({
+            "name": job["metadata"]["name"],
+            "namespace": job["metadata"].get("namespace"),
+            "phase": (job.get("status") or {}).get("phase"),
+            "size": est.get("size"),
+            "min": est.get("minReplicas"),
+            "max": est.get("maxReplicas"),
+            "desired": est.get("desired"),
+            "epoch": est.get("epoch"),
+            "resizes": est.get("resizes", 0),
+            "preemptions_absorbed": est.get("preemptionsAbsorbed", 0),
+        })
     chaos = REGISTRY.get_metric("chaos_faults_injected_total")
+    resizes = REGISTRY.get_metric("jaxjob_elastic_resizes_total")
     return {
         "nodes": nodes,
         "pods_node_lost": val("pods_node_lost_total"),
+        "node_recovered": val("node_recovered_total"),
         "gang_preemptions": val("jaxjob_gang_preemptions_total"),
+        "gang_slice_shrinks": val("jaxjob_gang_slice_shrinks_total"),
+        "elastic_gangs": elastic_gangs,
+        "elastic_resizes": (resizes.total()
+                            if resizes is not None else 0.0),
+        "workers_absorbed": val("jaxjob_elastic_workers_absorbed_total"),
         # labeled by fault type: sum the family
         "chaos_faults": chaos.total() if chaos is not None else 0.0,
     }

@@ -1,0 +1,44 @@
+"""Elastic gangs: JAXJob shrink/expand through preemption storms.
+
+The reliability arc made gang failure *survivable* (NodeLost gangs restart
+from checkpoint at the same size); this package makes it *absorbable*.  An
+elastic JAXJob declares ``spec.elastic: {minReplicas, maxReplicas}`` with
+``spec.replicas`` as the desired size, and the platform keeps it stepping
+through slice preemptions instead of restart-thrashing — the goodput story
+elastic Horovod and TorchElastic tell for spot capacity, rebuilt on this
+platform's gang primitives:
+
+- :mod:`protocol` — membership epochs (who is in the gang, stamped into
+  ``status.elastic`` by the controller — the store IS the rendezvous) and
+  the exactly-once data contract: global step ``k``'s batch is sharded
+  over the *current* members by rank, so no batch row is repeated or
+  skipped across a resize;
+- :mod:`decider` — clock-injected resize decisions (the training-side
+  sibling of the serving autoscaler's decider): when to re-expand after
+  the slice pool recovers, gated by cooldown and remaining-work backlog;
+- :mod:`checkpoint` — the lightweight resize checkpoint written at the
+  barrier (crc-framed, atomically replaced, through the persistence
+  ``FileIO`` seam so ``chaos.fsfault`` can crash it mid-write);
+- :mod:`runtime` — the deterministic logical-time gang runtime
+  ``loadtest/load_chaos.py``'s elastic-storm phase drives against the
+  real controllers to prove goodput beats restart-from-checkpoint.
+
+The trainer side (``training/trainer.py``) consumes :class:`Membership`
+at every step boundary: on an epoch change it saves a resize checkpoint,
+rebuilds mesh/sharding/data for the new world size, and resumes with
+strict step monotonicity.
+"""
+
+from kubeflow_tpu.elastic.checkpoint import ResizeCheckpoint
+from kubeflow_tpu.elastic.decider import ElasticDecider
+from kubeflow_tpu.elastic.protocol import (
+    BatchLedger,
+    Membership,
+    membership_from_status,
+    shard_rows,
+    step_rows,
+)
+
+__all__ = ["BatchLedger", "ElasticDecider", "Membership",
+           "ResizeCheckpoint", "membership_from_status", "shard_rows",
+           "step_rows"]

@@ -1,0 +1,88 @@
+"""The lightweight resize checkpoint written at the resize barrier.
+
+Full weights ride the orbax checkpoint (``training/checkpoint.py``); what
+a *resize* additionally has to persist is tiny and latency-critical —
+the protocol state that makes the post-resize world resumable exactly
+once: the barrier step, the membership epoch and member set, and any
+caller extras (data cursors, PRNG folds).  A torn one is worse than a
+missing one: a reader that trusts half a record resumes at the wrong
+step and the exactly-once data contract is gone.  So the write is the
+WAL discipline in miniature:
+
+- crc32-framed payload (``crc32hex|json`` — the persistence framing);
+- written to ``resize.json.tmp``, flushed, fsynced, then atomically
+  ``replace()``d over ``resize.json`` — a crash at ANY boundary leaves
+  either the previous complete record or the new complete record;
+- every file op goes through the persistence ``FileIO`` seam, so
+  ``chaos.fsfault.FaultyIO`` can crash/short-write each boundary and a
+  regression test can prove the no-torn-checkpoint property instead of
+  asserting it.
+
+``load()`` verifies the frame and returns None for missing/corrupt —
+callers fall back to the orbax checkpoint's step (one resize of progress
+re-derived, never a wrong resume).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+from kubeflow_tpu.core.persistence import FileIO
+
+_IO = FileIO()
+FILENAME = "resize.json"
+
+
+class ResizeCheckpoint:
+    """Atomic single-record store for the latest resize barrier."""
+
+    def __init__(self, directory: str, *, io: FileIO | None = None):
+        self.dir = os.path.abspath(directory)
+        self.io = io or _IO
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, FILENAME)
+
+    def save(self, *, step: int, epoch: int, members,
+             extra: dict | None = None) -> None:
+        """Persist one barrier record; atomic against crashes at every
+        write boundary (tmp + flush + fsync + replace)."""
+        record = {"step": int(step), "epoch": int(epoch),
+                  "members": [int(m) for m in sorted(members)]}
+        if extra:
+            record["extra"] = extra
+        payload = json.dumps(record, sort_keys=True)
+        framed = f"{zlib.crc32(payload.encode()) & 0xFFFFFFFF:08x}|{payload}"
+        tmp = self.path + ".tmp"
+        f = self.io.open(tmp, "w", encoding="utf-8")
+        try:
+            f.write(framed)
+            f.flush()
+            self.io.fsync(f)
+        finally:
+            f.close()
+        self.io.replace(tmp, self.path)
+
+    def load(self) -> dict | None:
+        """The latest complete barrier record, or None (missing or a
+        frame that fails its crc — never a torn/partial record)."""
+        try:
+            f = self.io.open(self.path, "r", encoding="utf-8")
+        except OSError:
+            return None
+        try:
+            framed = f.read()
+        except OSError:
+            return None
+        finally:
+            f.close()
+        crc, sep, payload = framed.partition("|")
+        if sep != "|" or len(crc) != 8:
+            return None
+        try:
+            if int(crc, 16) != (zlib.crc32(payload.encode()) & 0xFFFFFFFF):
+                return None
+            return json.loads(payload)
+        except ValueError:
+            return None

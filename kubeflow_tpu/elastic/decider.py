@@ -1,0 +1,65 @@
+"""Resize decisions for elastic gangs — the training-side sibling of the
+serving autoscaler's decider (``autoscale/decider.py``).
+
+Shrink needs no decision: infrastructure already took the workers, the
+controller just absorbs the loss.  *Expansion* is a policy call, and a
+bad one thrashes: re-admitting workers the instant one slice blips back
+means a resize barrier (checkpoint + recompile + re-shard) per blip, and
+expanding a gang that is three steps from done pays the barrier for
+nothing.  So expansion is gated the same way the autoscaler gates
+scale-down — by an injected clock, never the wall:
+
+- **cooldown**: no expansion within ``cooldown_s`` of the last resize
+  (a preemption storm's flapping capacity is absorbed at the shrunken
+  size until the pool is quiet);
+- **backlog**: expansion only pays off while enough work remains
+  (``backlog_steps`` below ``min_backlog_steps`` — the gang is nearly
+  done — keeps the current size; unknown backlog counts as large);
+- **capacity**: the target never exceeds what the slice pool can
+  actually admit (``free_hosts``), so an expansion decision is never a
+  parked pod.
+
+``now`` is REQUIRED (kfvet clock-injection — this module is in the
+pass's scope): callers pass their injected clock so tests drive the
+cooldown with a fake clock instead of sleeping.
+"""
+
+from __future__ import annotations
+
+
+class ElasticDecider:
+    """Pure sizing policy: ``decide(...)`` maps observed state to a
+    target size.  Holds NO clocks and NO store handles — the JAXJob
+    controller owns observation and actuation (level-triggered: it
+    re-asks on every reconcile)."""
+
+    def __init__(self, *, cooldown_s: float = 1.0,
+                 min_backlog_steps: int = 4):
+        self.cooldown_s = float(cooldown_s)
+        self.min_backlog_steps = int(min_backlog_steps)
+
+    def decide(self, *, size: int, desired: int, min_replicas: int,
+               max_replicas: int, free_hosts: int | None,
+               backlog_steps: int | None, last_resize_at: float | None,
+               now: float) -> int:
+        """Target gang size for this instant.
+
+        Returns ``size`` (no change), something smaller (the user shrank
+        ``spec.replicas`` — a voluntary resize), or something larger
+        (expansion passed every gate).  Never below ``min_replicas`` or
+        above ``max_replicas``.
+        """
+        target = max(min_replicas, min(int(desired), max_replicas))
+        if target <= size:
+            # voluntary shrink (or steady state): no gates — giving
+            # capacity back should never wait out a cooldown
+            return target
+        if (last_resize_at is not None
+                and now - float(last_resize_at) < self.cooldown_s):
+            return size
+        if (backlog_steps is not None
+                and backlog_steps < self.min_backlog_steps):
+            return size
+        if free_hosts is not None:
+            target = min(target, size + max(0, int(free_hosts)))
+        return max(size, target)

@@ -1,0 +1,142 @@
+"""Membership epochs + the exactly-once data contract for elastic gangs.
+
+Membership is a *versioned set of worker indices*: the JAXJob controller
+rewrites ``status.elastic`` (epoch, members) when infrastructure takes
+workers away or gives capacity back, and every consumer — the trainer's
+resize barrier, the chaos runtime, the dashboard — reads that one record.
+The epoch is the fence: two observers that agree on the epoch agree on the
+member set, the coordinator (lowest member index), and every rank.
+
+The data contract rides on it.  Global step ``k``'s batch is a fixed set
+of ``global_batch`` rows regardless of gang size; the *sharding* of those
+rows is re-keyed off ``(step, membership)``: rank ``r`` of world ``w``
+owns the strided rows ``range(r, global_batch, w)`` — the same striding
+``training/data.py`` uses — so across any resize the union of what the
+members consume is exactly each step's batch, with no row repeated and
+none skipped.  :class:`BatchLedger` is the auditor: the chaos loadtest
+records every (step, member, rows) consumption and verifies the
+exactly-once property over the whole storm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+
+@dataclasses.dataclass(frozen=True)
+class Membership:
+    """One epoch's gang composition.  ``members`` are worker indices
+    (sorted); rank = position in that order; coordinator = lowest."""
+
+    epoch: int
+    members: tuple[int, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "members",
+                           tuple(sorted(int(m) for m in self.members)))
+
+    @property
+    def size(self) -> int:
+        return len(self.members)
+
+    @property
+    def coordinator(self) -> int:
+        return self.members[0]
+
+    def rank_of(self, index: int) -> int | None:
+        """This worker's rank under the epoch, or None when it was
+        shrunk out of the gang (the worker should exit cleanly)."""
+        try:
+            return self.members.index(index)
+        except ValueError:
+            return None
+
+
+def membership_from_status(job: dict) -> Membership | None:
+    """The gang's current membership from ``status.elastic`` (the
+    controller-owned record), or None for non-elastic/unstamped jobs."""
+    est = (job.get("status") or {}).get("elastic")
+    if not est:
+        return None
+    return Membership(int(est.get("epoch", 0)),
+                      tuple(est.get("members", ())))
+
+
+def shard_rows(global_batch: int, rank: int, world: int) -> range:
+    """Rank ``rank`` of ``world``'s rows of one global batch — the
+    strided partition ``data.py`` datasets apply (``idx[rank::world]``).
+    Unions over ranks cover ``range(global_batch)`` exactly; shards are
+    ragged by at most one row when world does not divide the batch."""
+    if not 0 <= rank < world:
+        raise ValueError(f"rank {rank} outside world {world}")
+    return range(rank, global_batch, world)
+
+
+def step_rows(global_batch: int,
+              members: tuple[int, ...] | list[int]) -> dict[int, range]:
+    """Worker index -> its rows of ONE global step's batch under the
+    given membership.  The resize-invariant: for any member set this is a
+    disjoint cover of the batch, so consuming each step exactly once
+    under whatever membership held at that step never loses a row."""
+    ordered = sorted(members)
+    world = len(ordered)
+    return {m: shard_rows(global_batch, r, world)
+            for r, m in enumerate(ordered)}
+
+
+class BatchLedger:
+    """Audit log of data consumption across resizes.
+
+    ``record(step, member, rows)`` is called once per member per global
+    step; ``verify(...)`` asserts the exactly-once contract: every step in
+    ``[start, steps)`` consumed exactly once, each step's union of rows ==
+    the full batch, no overlaps.  ``digest()`` folds the whole ledger into
+    one hash — the worker-sweep determinism anchor: two runs that consumed
+    the same batches under the same membership history digest identically.
+    """
+
+    def __init__(self) -> None:
+        # step -> {member: sorted row tuple}
+        self._steps: dict[int, dict[int, tuple[int, ...]]] = {}
+
+    def record(self, step: int, member: int, rows) -> None:
+        per_member = self._steps.setdefault(int(step), {})
+        if member in per_member:
+            raise AssertionError(
+                f"member {member} consumed step {step} twice")
+        per_member[int(member)] = tuple(rows)
+
+    def verify(self, *, steps: int, global_batch: int,
+               start: int = 0) -> None:
+        """Raise AssertionError on any repeated/skipped step or row."""
+        want = set(range(start, steps))
+        got = set(self._steps)
+        if got != want:
+            missing = sorted(want - got)
+            extra = sorted(got - want)
+            raise AssertionError(
+                f"steps skipped={missing[:5]} repeated/extra={extra[:5]}")
+        full = set(range(global_batch))
+        for step, per_member in self._steps.items():
+            seen: set[int] = set()
+            for member, rows in per_member.items():
+                dup = seen.intersection(rows)
+                if dup:
+                    raise AssertionError(
+                        f"step {step}: rows {sorted(dup)[:5]} delivered "
+                        f"twice (member {member})")
+                seen.update(rows)
+            if seen != full:
+                raise AssertionError(
+                    f"step {step}: rows {sorted(full - seen)[:5]} skipped")
+
+    def digest(self) -> str:
+        canon = {str(s): {str(m): list(r) for m, r in sorted(pm.items())}
+                 for s, pm in sorted(self._steps.items())}
+        return hashlib.sha256(
+            json.dumps(canon, sort_keys=True).encode()).hexdigest()
+
+    def __len__(self) -> int:
+        return len(self._steps)

@@ -1,0 +1,298 @@
+"""Membership sources + the deterministic elastic gang runtime.
+
+Two consumers of the membership protocol live here:
+
+**Membership sources** feed the real trainer's resize barrier
+(``training/trainer.py``): ``FileMembership`` polls the JSON record an
+external agent maintains (the subprocess path — the controller cannot
+reach into a worker's memory), ``ScriptedMembership`` drives tests with a
+step-keyed schedule, no store and no sleeps.
+
+**Gang sims** are the chaos loadtest's training runtime: a *logical-time*
+model of an elastic (or restart-from-checkpoint baseline) gang driven
+against the REAL control plane.  The sim reads membership from
+``status.elastic`` and worker liveness from the actual pods; what it
+models is the part real chips would do — steps, resize barriers,
+checkpoint rollbacks — under an explicit cost model measured in *ticks*:
+
+- one full-size global step = 1 tick; a shrunken gang's step costs
+  ``world_max / world`` (fixed global batch, fewer chips);
+- an elastic resize barrier = ``resize_cost`` ticks (lightweight
+  checkpoint + recompile + re-shard);
+- a gang restart = ``restart_cost`` ticks (re-queue, re-schedule,
+  rendezvous, weights reload) PLUS rollback to the last committed
+  checkpoint — the restart-thrash elasticity exists to avoid.
+
+Because ticks are logical and the harness gates every storm event on the
+control plane *observing* it, the same seed yields bit-identical step
+logs and ledgers at any machine speed and any controller worker count —
+the determinism the elastic phase's worker-sweep assertion rides on.
+The sim audits the exactly-once data contract as it goes: every step's
+batch is recorded against the membership that consumed it
+(:class:`~kubeflow_tpu.elastic.protocol.BatchLedger`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from kubeflow_tpu.core.store import NotFound
+from kubeflow_tpu.elastic.checkpoint import ResizeCheckpoint
+from kubeflow_tpu.elastic.protocol import (
+    BatchLedger,
+    Membership,
+    membership_from_status,
+    step_rows,
+)
+
+
+class FileMembership:
+    """Trainer-side membership source backed by a JSON file
+    (``{"epoch": E, "members": [...]}``) an external agent rewrites.
+    Malformed/missing reads return the last good view (a torn rewrite
+    must not look like a resize).
+
+    The bootstrap view (no file yet) is a SOLO membership at epoch -1,
+    below any epoch the controller can stamp (it starts at 0): when the
+    real record lands — even the initial epoch-0 one — the trainer's
+    epoch-change barrier fires and re-shards.  A bootstrap at epoch 0
+    would alias the controller's first stamp and the worker would train
+    solo forever, silently duplicating every row of every batch."""
+
+    def __init__(self, path: str, index: int):
+        self.path = path
+        self.index = int(index)
+        self._last = Membership(-1, (self.index,))
+
+    def current(self, step: int) -> Membership:
+        try:
+            with open(self.path) as f:
+                raw = json.load(f)
+            self._last = Membership(int(raw["epoch"]),
+                                    tuple(raw["members"]))
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+        return self._last
+
+
+class ScriptedMembership:
+    """Test-side source: ``schedule`` maps a step threshold to the
+    membership that takes effect at that step boundary."""
+
+    def __init__(self, index: int, schedule: dict[int, Membership]):
+        if 0 not in schedule:
+            raise ValueError("schedule must define the step-0 membership")
+        self.index = int(index)
+        self._schedule = sorted(schedule.items())
+
+    def current(self, step: int) -> Membership:
+        live = self._schedule[0][1]
+        for at, membership in self._schedule:
+            if at <= step:
+                live = membership
+        return live
+
+
+class GangSim:
+    """Logical-time training runtime for ONE gang against the live store.
+
+    ``advance(allow_step=...)`` consumes at most one event per call —
+    a resize (elastic membership epoch moved), a restart (member pods
+    replaced under an unchanged epoch), or a step — and returns what it
+    did: ``"resize" | "restart" | "step" | "blocked" | "done" | "idle"``.
+    The harness owns pacing: it calls ``advance`` in a loop, fires storm
+    events at tick thresholds, and passes ``allow_step=False`` while
+    waiting for the control plane to observe a fault (the barrier
+    semantics — steps issued after the hardware died would be rolled
+    back anyway, so the model doesn't issue them).
+    """
+
+    def __init__(self, server, name: str, namespace: str, *,
+                 elastic: bool, world_max: int, global_batch: int = 32,
+                 total_steps: int = 10 ** 9, checkpoint_every: int = 10,
+                 resize_cost: float = 4.0, restart_cost: float = 60.0,
+                 ckpt_dir: str | None = None, io=None):
+        self.server = server
+        self.name = name
+        self.namespace = namespace
+        self.elastic = elastic
+        self.world_max = int(world_max)
+        self.global_batch = int(global_batch)
+        self.total_steps = int(total_steps)
+        self.checkpoint_every = int(checkpoint_every)
+        self.resize_cost = float(resize_cost)
+        self.restart_cost = float(restart_cost)
+        self.rckpt = (ResizeCheckpoint(ckpt_dir, io=io)
+                      if ckpt_dir is not None else None)
+
+        self.ticks = 0.0
+        self.step = 0               # next global step to run
+        self.ckpt_step = 0          # last committed checkpoint
+        self.step_log: list[int] = []     # completed steps, in order
+        # (step, epoch, world) per membership epoch observed.  NOT part
+        # of digest(): one storm event may land as one or two membership
+        # epochs depending on controller interleaving — the harness
+        # charges barrier cost per OBSERVED STABLE TRANSITION
+        # (charge_barrier), which is what must be deterministic
+        self.resize_log: list[tuple] = []
+        self.restarts = 0
+        self.done = False
+        self.ledger = BatchLedger() if elastic else None
+        self._epoch_seen = 0
+        self._members: list[int] = list(range(world_max))
+        # index -> uid of the incarnation we saw Running (None = a fresh
+        # join whose first incarnation is not a restart)
+        self._uids: dict[int, str | None] = {}
+
+    # -- observation ---------------------------------------------------------
+    def _job(self) -> dict | None:
+        try:
+            return self.server.get("JAXJob", self.name, self.namespace)
+        except NotFound:
+            return None
+
+    def _pod(self, index: int) -> dict | None:
+        try:
+            return self.server.get(
+                "Pod", f"{self.name}-worker-{index}", self.namespace)
+        except NotFound:
+            return None
+
+    # -- the one-event state machine -----------------------------------------
+    def advance(self, allow_step: bool = True,
+                allow_restart: bool = True) -> str:
+        """``allow_restart=False`` defers consuming a gang-restart
+        observation: while the harness is still processing a preemption
+        (capacity short, every running incarnation doomed to another
+        eviction pass), a transiently re-released gang must not be
+        charged as a completed restart — the real recovery is observed
+        after the restore, exactly once."""
+        if self.done:
+            return "done"
+        job = self._job()
+        if job is None:
+            return "blocked"
+
+        if self.elastic:
+            m = membership_from_status(job)
+            if m is not None and m.epoch != self._epoch_seen:
+                return self._consume_resize(m)
+
+        pods = {i: self._pod(i) for i in self._members}
+        running = {i: p for i, p in pods.items()
+                   if p is not None
+                   and p.get("status", {}).get("phase") == "Running"}
+        if len(running) != len(self._members):
+            return "blocked"
+        known = [i for i in self._members
+                 if self._uids.get(i) is not None]
+        replaced = [i for i in known
+                    if running[i]["metadata"]["uid"] != self._uids[i]]
+        if replaced:
+            if len(replaced) == len(known):
+                if not allow_restart:
+                    return "blocked"
+                return self._consume_restart(running)
+            # a PARTIAL replacement is mid-restart churn, not a restarted
+            # gang: this platform's gang restart tears down every worker
+            # (rendezvous is dead), so a coherent post-restart gang has
+            # every incarnation fresh.  A transient where recreated
+            # workers run beside doomed old ones (an eviction racing the
+            # backfill re-release) must not double-charge the restart.
+            return "blocked"
+        for i, p in running.items():
+            if self._uids.get(i) is None:
+                self._uids[i] = p["metadata"]["uid"]
+
+        if not allow_step:
+            return "idle"
+        return self._run_step()
+
+    def charge_barrier(self) -> None:
+        """One resize barrier's tick cost.  Charged by the HARNESS per
+        stable membership transition it gated on — not per epoch inside
+        ``advance`` — so a rewrite that lands in two store epochs costs
+        the same as one that lands in one (determinism across controller
+        interleavings)."""
+        self.ticks += self.resize_cost
+
+    def _consume_resize(self, m: Membership) -> str:
+        """The resize barrier at a step boundary: commit the protocol
+        record, adopt the new member set.  Progress is NOT rolled back —
+        that is the entire point."""
+        if self.rckpt is not None:
+            self.rckpt.save(step=self.step, epoch=m.epoch,
+                            members=m.members)
+        joined = [i for i in m.members if i not in self._members]
+        for i in list(self._uids):
+            if i not in m.members:
+                self._uids.pop(i)
+        for i in joined:
+            self._uids[i] = None   # fresh incarnation: a join, no restart
+        self._members = list(m.members)
+        self._epoch_seen = m.epoch
+        self.resize_log.append((self.step, m.epoch, m.size))
+        return "resize"
+
+    def _consume_restart(self, running: dict) -> str:
+        """A gang restart (the baseline's recovery): pay the restart
+        cost and roll progress back to the last committed checkpoint —
+        the steps since it will be RE-RUN (the step log shows the
+        replay; an elastic gang's never does)."""
+        self.ticks += self.restart_cost
+        self.step = self.ckpt_step
+        self.restarts += 1
+        self._uids = {i: p["metadata"]["uid"] for i, p in running.items()}
+        return "restart"
+
+    def _run_step(self) -> str:
+        step = self.step
+        if self.ledger is not None:
+            for member, rows in step_rows(self.global_batch,
+                                          self._members).items():
+                self.ledger.record(step, member, rows)
+        self.step += 1
+        self.step_log.append(self.step)
+        self.ticks += self.world_max / len(self._members)
+        if self.step % self.checkpoint_every == 0:
+            self.ckpt_step = self.step
+        if self.step >= self.total_steps:
+            self.done = True
+        return "step"
+
+    # -- results -------------------------------------------------------------
+    @property
+    def steps_completed(self) -> int:
+        """Distinct FORWARD progress: the furthest step reached.  For the
+        baseline this discounts replayed work (a restart re-earns steps
+        it already logged); for an elastic gang it equals len(step_log)."""
+        return max(self.step_log, default=0)
+
+    def digest(self) -> str:
+        """Determinism anchor: everything the logical run decided —
+        the step log, the data-consumption ledger, restart/rollback
+        history, and where the gang ended up.  (Epoch numbers and the
+        per-epoch resize_log are excluded: controller interleaving may
+        split one transition into two epochs without changing any of
+        the accountable outcomes.)"""
+        canon = {
+            "step_log": self.step_log,
+            "restarts": self.restarts,
+            "ticks": round(self.ticks, 6),
+            "members": self._members,
+            "ledger": self.ledger.digest() if self.ledger else None,
+        }
+        return hashlib.sha256(
+            json.dumps(canon, sort_keys=True).encode()).hexdigest()
+
+
+def write_membership_file(path: str, membership: Membership) -> None:
+    """Atomically publish a membership view for ``FileMembership``
+    consumers (tmp + rename — a reader never sees a torn record)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump({"epoch": membership.epoch,
+                   "members": list(membership.members)}, f)
+    os.replace(tmp, path)

@@ -41,16 +41,24 @@ class SyntheticDataset:
     def __iter__(self) -> Iterator[dict]:
         return self.iter_from(0)
 
-    def iter_from(self, start_step: int) -> Iterator[dict]:
+    def iter_from(self, start_step: int, *, rank: int | None = None,
+                  rows: int | None = None) -> Iterator[dict]:
         """Resume-aware iteration: batch k derives from fold_in(seed+k, rank)
         regardless of where iteration starts, so a resumed run continues the
-        schedule and ranks never collide."""
+        schedule and ranks never collide.
+
+        ``rank``/``rows`` re-key the shard after an elastic resize: the
+        trainer's resize barrier re-iterates from the current global step
+        under its NEW rank and per-rank row count, so every global step's
+        batch is generated exactly once across any membership history.
+        """
         step = start_step
+        pi = self._pi if rank is None else int(rank)
+        n = self._batch if rows is None else int(rows)
         while True:
             rng = jax.random.fold_in(jax.random.PRNGKey(self._seed + step),
-                                     self._pi)
-            yield self._entry.make_batch(self._batch, rng, self._module,
-                                         **self._kw)
+                                     pi)
+            yield self._entry.make_batch(n, rng, self._module, **self._kw)
             step += 1
 
 
@@ -152,8 +160,14 @@ class NpzDataset:
                     else process_index)
         self._pc = (jax.process_count() if process_count is None
                     else process_count)
-        if global_batch % self._pc:
-            raise ValueError("global batch must divide by process count")
+        if self._pc > global_batch:
+            # ragged worlds are supported (shard_rows strides the batch,
+            # shards differ by at most one row — the elastic resize
+            # contract); only a world leaving ranks with zero rows is
+            # unusable
+            raise ValueError(
+                f"process count {self._pc} exceeds global batch "
+                f"{global_batch}: some ranks would own no rows")
         if self._n < global_batch:
             raise ValueError(
                 f"dataset {path} has {self._n} rows < global batch "
@@ -166,9 +180,21 @@ class NpzDataset:
     def __iter__(self) -> Iterator[dict]:
         return self.iter_from(0)
 
-    def iter_from(self, start_step: int) -> Iterator[dict]:
+    def iter_from(self, start_step: int, *, rank: int | None = None,
+                  world: int | None = None) -> Iterator[dict]:
         """Resume-aware: global batch k is deterministic in (seed, k), so a
-        resumed run sees the remainder of the schedule, not a replay."""
+        resumed run sees the remainder of the schedule, not a replay.
+
+        ``rank``/``world`` re-key the shard after an elastic resize: the
+        GLOBAL batch at step k is fixed; only its strided partition
+        (``elastic.protocol.shard_rows``) changes with membership, so a
+        resumed-and-resized run's union over ranks still covers each
+        batch exactly once — no row repeated, none skipped.
+        """
+        from kubeflow_tpu.elastic.protocol import shard_rows
+
+        pi = self._pi if rank is None else int(rank)
+        pc = self._pc if world is None else int(world)
         bpe = self.batches_per_epoch
         epoch, offset = divmod(start_step, bpe)
         while True:
@@ -177,7 +203,7 @@ class NpzDataset:
                 np.random.default_rng(self._seed + epoch).shuffle(order)
             for b in range(offset, bpe):
                 idx = order[b * self._batch:(b + 1) * self._batch]
-                idx = idx[self._pi::self._pc]
+                idx = idx[list(shard_rows(len(idx), pi, pc))]
                 yield {k: v[idx] for k, v in self._arrays.items()}
             offset = 0
             epoch += 1

@@ -53,6 +53,13 @@ class TrainerConfig:
     # completing this step — simulates a slice preemption mid-training so
     # gang restart + checkpoint resume can be exercised deterministically
     fault_kill_at_step: int = 0
+    # elastic gangs: a JSON membership file ({"epoch": E, "members": [..]})
+    # an external agent maintains; polled at every step boundary — an
+    # epoch change triggers the resize barrier (checkpoint, rebuild,
+    # re-key data off the global step).  worker_index identifies THIS
+    # worker in the member set (default: JAXJOB_MEMBER_INDEX env).
+    membership_file: str | None = None
+    worker_index: int | None = None
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "TrainerConfig":
@@ -62,11 +69,24 @@ class TrainerConfig:
 
 class Trainer:
     def __init__(self, cfg: TrainerConfig,
-                 metrics_hook: Callable[[int, dict], None] | None = None):
+                 metrics_hook: Callable[[int, dict], None] | None = None,
+                 membership=None):
         self.cfg = cfg
         self.log = get_logger("trainer", model=cfg.model)
         self._metrics_hook = metrics_hook
+        # membership source (kubeflow_tpu.elastic): .index identifies this
+        # worker, .current(step) -> Membership.  Polled between steps; an
+        # epoch change runs the resize barrier.
+        if membership is None and cfg.membership_file:
+            from kubeflow_tpu.elastic.runtime import FileMembership
+
+            idx = cfg.worker_index
+            if idx is None:
+                idx = int(os.environ.get("JAXJOB_MEMBER_INDEX", "0"))
+            membership = FileMembership(cfg.membership_file, idx)
+        self._membership = membership
         self.history: list[dict] = []
+        self.resizes: list[dict] = []
 
     def run(self) -> dict:
         """Train to cfg.steps; returns final metrics summary."""
@@ -96,11 +116,34 @@ class Trainer:
         tx = make_optimizer(cfg.optimizer)
         rng = jax.random.PRNGKey(cfg.seed)
 
-        if cfg.global_batch % jax.process_count():
-            raise ValueError(
-                f"global_batch {cfg.global_batch} must divide by process "
-                f"count {jax.process_count()}")
-        local_batch = cfg.global_batch // jax.process_count()
+        # elastic: rank/world come from the membership epoch, not the
+        # static process view — a resize rewrites them at the barrier.
+        # Elastic worlds may be RAGGED (shards differ by one row, the
+        # shard_rows contract): the controller absorbs any loss down to
+        # minReplicas, so the runtime must accept every size it produces
+        member = None
+        if self._membership is not None:
+            from kubeflow_tpu.elastic.protocol import shard_rows
+
+            member = self._membership.current(0)
+            rank = member.rank_of(self._membership.index)
+            if rank is None:
+                raise ValueError(
+                    f"worker {self._membership.index} is not in the "
+                    f"initial membership {member.members}")
+            world = member.size
+            if world > cfg.global_batch:
+                raise ValueError(
+                    f"world size {world} exceeds global_batch "
+                    f"{cfg.global_batch}: some ranks would own no rows")
+            local_batch = len(shard_rows(cfg.global_batch, rank, world))
+        else:
+            rank, world = jax.process_index(), jax.process_count()
+            if cfg.global_batch % world:
+                raise ValueError(
+                    f"global_batch {cfg.global_batch} must divide by "
+                    f"process count {world}")
+            local_batch = cfg.global_batch // world
         inputs = entry.make_inputs(cfg.global_batch, rng, module)
         state, shardings = ts.init_train_state(module, tx, rng, inputs, mesh)
 
@@ -136,20 +179,18 @@ class Trainer:
 
         if cfg.data_path:
             dataset = NpzDataset(cfg.data_path, cfg.global_batch,
-                                 seed=cfg.seed)
+                                 seed=cfg.seed, process_index=rank,
+                                 process_count=world)
         else:
             dataset = SyntheticDataset(cfg.model, module, local_batch,
-                                       seed=cfg.seed)
-        # resume continues the data schedule instead of replaying batch 0
-        data_iter = dataset.iter_from(start_step)
+                                       seed=cfg.seed, process_index=rank)
 
-        example = next(data_iter)
-        bshard = jax.tree_util.tree_map(
-            lambda _: NamedSharding(mesh, P(("dp", "fsdp"))), example)
-        step_fn = ts.build_train_step(forward, tx, mesh, shardings, bshard,
-                                      grad_accum=cfg.grad_accum)
+        import itertools
 
         import numpy as np
+
+        bshard = None
+        step_fn = None
 
         def put_batch(batch):
             if jax.process_count() == 1:
@@ -160,28 +201,78 @@ class Trainer:
                 lambda x, s: jax.make_array_from_process_local_data(
                     s, np.asarray(x)), batch, bshard)
 
+        def make_batches(step0: int, rank: int, world: int):
+            """(Re)build the input pipeline + step function for a world
+            size, resuming the data schedule at global step ``step0`` —
+            data sharding is re-keyed off the global step (resume
+            continues the schedule, a resize re-partitions it), so no
+            batch is replayed or skipped across either."""
+            nonlocal bshard, step_fn
+            if isinstance(dataset, NpzDataset):
+                it = dataset.iter_from(step0, rank=rank, world=world)
+            else:
+                from kubeflow_tpu.elastic.protocol import shard_rows
+
+                it = dataset.iter_from(
+                    step0, rank=rank,
+                    rows=len(shard_rows(cfg.global_batch, rank, world)))
+            example = next(it)
+            bshard = jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh, P(("dp", "fsdp"))), example)
+            step_fn = ts.build_train_step(forward, tx, mesh, shardings,
+                                          bshard,
+                                          grad_accum=cfg.grad_accum)
+            # host batches (example was consumed to build shardings)
+            host_iter = itertools.chain([example], it)
+            if cfg.prefetch > 0:
+                # async input pipeline: host batch assembly + h2d transfer
+                # for batch k+1 overlap device compute of batch k
+                return DevicePrefetcher(host_iter, put_batch,
+                                        depth=cfg.prefetch)
+            return (put_batch(b) for b in host_iter)
+
+        # lightweight resize checkpoint (kubeflow_tpu.elastic): the
+        # barrier's protocol record — step, epoch, member set — committed
+        # atomically alongside the orbax weights
+        rckpt = None
+        if self._membership is not None and cfg.checkpoint_dir:
+            from kubeflow_tpu.elastic import ResizeCheckpoint
+
+            rckpt = ResizeCheckpoint(cfg.checkpoint_dir)
+
         from kubeflow_tpu.utils.profiler import StepWindowTracer
 
         # capture a bounded trace window (step 1 onward skips the compile)
         tracer = StepWindowTracer(cfg.profile_dir,
                                   start_step=start_step + 1,
                                   num_steps=cfg.profile_steps)
-        import itertools
-
-        # host batches (example was already consumed to build shardings)
-        host_iter = itertools.chain([example], data_iter)
-        if cfg.prefetch > 0:
-            # async input pipeline: host batch assembly + h2d transfer for
-            # batch k+1 overlap device compute of batch k
-            batches = DevicePrefetcher(host_iter, put_batch,
-                                       depth=cfg.prefetch)
-        else:
-            batches = (put_batch(b) for b in host_iter)
+        batches = make_batches(start_step, rank, world)
         t0 = time.perf_counter()
         metrics = {}
         try:
             with mesh:
                 for step in range(start_step, cfg.steps):
+                    if self._membership is not None:
+                        latest = self._membership.current(step)
+                        if latest.epoch != member.epoch:
+                            # resize barrier: commit state, rebuild the
+                            # mesh-facing pipeline for the new world
+                            # size, re-key the data shard at this step
+                            out = self._resize(latest, step, state, ckpt,
+                                               rckpt)
+                            if out is not None:
+                                # shrunk out of the gang: release the
+                                # checkpoint manager's resources too —
+                                # the normal-exit close below is skipped
+                                if ckpt is not None:
+                                    ckpt.close()
+                                return out
+                            member = latest
+                            rank = member.rank_of(self._membership.index)
+                            world = member.size
+                            if isinstance(batches, DevicePrefetcher):
+                                batches.close()
+                            batches = make_batches(step, rank, world)
                     tracer.on_step(step)
                     state, metrics = step_fn(state, next(batches))
                     if ((step + 1) % cfg.log_every == 0
@@ -218,10 +309,47 @@ class Trainer:
             ckpt.save(cfg.steps, state, wait=True)
             ckpt.close()
         final_loss = float(metrics["loss"]) if metrics else None
-        return {
+        out = {
             "final_loss": final_loss,
             "steps": cfg.steps,
             "start_step": start_step,
             "samples_per_sec": (self.history[-1]["samples_per_sec"]
                                 if self.history else 0.0),
         }
+        if self._membership is not None:
+            out["resizes"] = len(self.resizes)
+        return out
+
+    def _resize(self, membership, step: int, state, ckpt, rckpt):
+        """The resize barrier's commit half (elastic gangs): persist the
+        full state plus the lightweight protocol record at the step
+        boundary, then decide this worker's fate under the new epoch.
+        Returns a summary dict when the worker was shrunk out of the gang
+        (clean exit — its shard is re-owned by the survivors), else None
+        and the caller rebuilds the pipeline for the new world size."""
+        cfg = self.cfg
+        if ckpt is not None and ckpt.latest_step() != step:
+            # a joiner admitted at this boundary restores from exactly
+            # this committed step — "join at a checkpoint boundary"
+            ckpt.save(step, state, wait=True)
+        if rckpt is not None:
+            rckpt.save(step=step, epoch=membership.epoch,
+                       members=membership.members)
+        rank = membership.rank_of(self._membership.index)
+        if rank is None:
+            self.log.info("shrunk out of the gang; exiting cleanly",
+                          step=step, epoch=membership.epoch)
+            return {"resigned": True, "steps": cfg.steps,
+                    "start_step": step, "final_loss": None,
+                    "samples_per_sec": 0.0, "resizes": len(self.resizes)}
+        if membership.size > cfg.global_batch:
+            # ragged worlds are fine (shard_rows); a world larger than
+            # the batch would leave ranks with nothing to train on
+            raise ValueError(
+                f"resized world {membership.size} exceeds global_batch "
+                f"{cfg.global_batch}")
+        self.resizes.append({"step": step, "epoch": membership.epoch,
+                             "world": membership.size, "rank": rank})
+        self.log.info("resize", step=step, epoch=membership.epoch,
+                      world=membership.size, rank=rank)
+        return None

@@ -21,8 +21,19 @@ Faults are STATE-TRIGGERED (fire at gang-completion thresholds, recover
 once every killed pod is observed detected), not wall-clock-triggered, so
 the schedule is the same logical schedule on any machine speed.
 
+THE ELASTIC-STORM PHASE (``run_elastic_phase``) gates the goodput claim
+elasticity makes: under one seeded ``chaos.PreemptionSchedule``, an
+elastic gang (shrink in place, re-expand on recovery) must complete
+>= KF_ELASTIC_FLOOR (default 1.5) times the forward steps of the
+restart-from-checkpoint baseline inside the same logical-tick budget,
+with a strictly monotone step log, every step's batch delivered exactly
+once across all resizes (BatchLedger), zero maxRestarts consumed, and
+bit-identical digests across executor worker sweeps.  KF_SKIP_ELASTIC=1
+opts the phase out (KF_SKIP_CHAOS pattern).
+
 Usage: python loadtest/load_chaos.py [N_GANGS] [M_SLICES]
        [--notebooks N] [--seed S] [--conflict-rate R] [--smoke]
+       [--elastic-only] [--workers W1,W2]
 """
 
 from __future__ import annotations
@@ -39,6 +50,7 @@ TOPOLOGY = "v5e-8"          # 2 hosts x 4 chips per gang
 NS_TRAIN = "chaos-train"
 NS_NB = "chaos-nb"
 NS_SRV = "chaos-srv"
+NS_ELASTIC = "chaos-elastic"
 
 
 def build(seed: int, m_slices: int, n_gangs: int, conflict_rate: float,
@@ -335,6 +347,245 @@ def _wait(fn, timeout: float, msg: str):
     raise AssertionError(msg)
 
 
+# -- elastic-storm phase -------------------------------------------------------
+
+ELASTIC_CAPACITY = 4        # slices in the pool = 8 workers of v5e-8
+ELASTIC_BURSTS = 3
+ELASTIC_BATCH = 32
+# logical-tick cost model: a resize barrier (lightweight checkpoint +
+# recompile + re-shard) vs a full gang restart (re-queue, re-schedule,
+# rendezvous, weights reload) — the asymmetry elasticity monetizes
+RESIZE_COST = 4.0
+RESTART_COST = 60.0
+STORM_HORIZON = 160.0
+TICK_BUDGET = 240.0         # both gangs get the same logical-time budget
+
+
+def _drive_until(sim, pred, timeout: float, msg: str,
+                 allow_restart: bool = True):
+    """Advance the sim WITHOUT stepping until ``pred(advance-result)``
+    holds.  Unlike ``_wait`` this never swallows exceptions — a ledger
+    violation inside ``advance`` must fail the phase, not be retried.
+    ``allow_restart=False`` while waiting out a preemption: a gang
+    transiently re-released mid-eviction must not consume the restart
+    observation the post-restore wait is going to gate on."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred(sim.advance(allow_step=False,
+                            allow_restart=allow_restart)):
+            return
+        time.sleep(0.002)
+    raise AssertionError(msg)
+
+
+def _eviction_complete(server, sim, name: str, ns: str) -> bool:
+    """Every pre-preemption incarnation the sim had observed is gone or
+    re-gated.  The harness gates the restore on THIS, not on the first
+    missing pod: an injected write Conflict can interrupt the evict loop
+    mid-way, and a restore that lands on a half-evicted gang splits the
+    recovery into two uid-replacement waves — two observed restarts on
+    one schedule, which worker-count interleaving could then flip."""
+    from kubeflow_tpu.core.store import NotFound
+
+    for i, uid in sim._uids.items():
+        try:
+            pod = server.get("Pod", f"{name}-worker-{i}", ns)
+        except NotFound:
+            continue
+        if (pod["metadata"]["uid"] == uid
+                and not pod["spec"].get("schedulingGates")
+                and pod.get("status", {}).get("phase") not in
+                ("Succeeded", "Failed")):
+            return False
+    return True
+
+
+def _elastic_storm_run(*, seed: int, elastic: bool, workers: int,
+                       conflict_rate: float, latency_rate: float) -> dict:
+    """One gang — elastic or restart-from-checkpoint baseline — through
+    the SAME seeded preemption storm, against the real control plane.
+
+    Logical time: the sim's tick clock gates every storm event, steps are
+    frozen (``allow_step=False``) while the control plane is observing a
+    fault, and an idle-waiting baseline has its clock jumped to the next
+    event's threshold — so the run's accountable outcomes (step log, data
+    ledger, restarts, ticks) are identical at any machine speed and any
+    executor worker count.
+    """
+    from kubeflow_tpu.api import jaxjob as api
+    from kubeflow_tpu.chaos import (
+        ChaosInjector,
+        ChaoticAPIServer,
+        PreemptionSchedule,
+    )
+    from kubeflow_tpu.controllers import scheduler
+    from kubeflow_tpu.controllers.executor import FakeExecutor
+    from kubeflow_tpu.controllers.jaxjob import JAXJobController
+    from kubeflow_tpu.core import Manager, api_object, quota
+    from kubeflow_tpu.elastic import ElasticDecider
+    from kubeflow_tpu.elastic.runtime import GangSim
+    from kubeflow_tpu.parallel.mesh import TOPOLOGIES
+
+    hosts = TOPOLOGIES[TOPOLOGY].hosts
+    world_max = ELASTIC_CAPACITY * hosts
+    schedule = PreemptionSchedule(
+        seed=seed, capacity=ELASTIC_CAPACITY, floor=1,
+        horizon=STORM_HORIZON, bursts=ELASTIC_BURSTS)
+
+    server = ChaoticAPIServer(seed=seed, conflict_rate=conflict_rate,
+                              latency_rate=latency_rate, latency_s=0.001)
+    quota.register(server)
+    server.register_validating_hook(
+        lambda o: api.validate(o) if o.get("kind") == api.KIND else None)
+    server.create(scheduler.new_pool({TOPOLOGY: ELASTIC_CAPACITY}))
+    server.create(api_object(
+        "ResourceQuota", quota.QUOTA_NAME, NS_ELASTIC,
+        spec={"hard": {"cloud-tpu.google.com/v5e": 16 * world_max,
+                       "pods": 4 * world_max}}))
+    mgr = Manager(server)
+    # tight expansion cooldown: re-expand decisions stay level-triggered
+    # but never rate-limit the harness (steps are frozen while the
+    # control plane reacts, so wall-clock gates cannot leak into ticks)
+    mgr.add(JAXJobController(server,
+                             decider=ElasticDecider(cooldown_s=0.05)),
+            workers=1)
+    executor = FakeExecutor(
+        server, server_pods=lambda pod: True)  # workers never "finish"
+    mgr.add(executor, workers=workers)
+    mgr.add(scheduler.SlicePreemptionController(server), workers=1)
+    injector = ChaosInjector(server, executor, seed=seed)
+    mgr.start()
+    server.arm()
+
+    name = "storm-elastic" if elastic else "storm-baseline"
+    kwargs = dict(topology=TOPOLOGY, num_slices=ELASTIC_CAPACITY,
+                  max_restarts=0)  # ANY charged restart fails the job
+    if elastic:
+        kwargs["elastic"] = {"minReplicas": hosts,
+                             "maxReplicas": world_max}
+    try:
+        _create_retry(server, api.new(name, NS_ELASTIC, **kwargs))
+        sim = GangSim(server, name, NS_ELASTIC, elastic=elastic,
+                      world_max=world_max, global_batch=ELASTIC_BATCH,
+                      checkpoint_every=10, resize_cost=RESIZE_COST,
+                      restart_cost=RESTART_COST)
+        _drive_until(sim, lambda r: r == "idle", 30,
+                     f"{name} gang never released/ran")
+
+        for ev in schedule:
+            # step up to the event's logical time (a blocked baseline is
+            # idle-waiting on capacity: its clock jumps below instead)
+            while sim.ticks < ev.at and not sim.done:
+                if sim.advance(allow_step=True) == "blocked":
+                    break
+            sim.ticks = max(sim.ticks, ev.at)
+            expected = (ELASTIC_CAPACITY - ev.unavailable) * hosts
+            if ev.kind == "preempt":
+                injector.preempt_slices(TOPOLOGY, ev.count)
+                if elastic:
+                    # shrink observed: membership settles on the
+                    # survivors (1 or 2 epochs depending on controller
+                    # interleaving — cost charged once per storm event)
+                    _drive_until(
+                        sim, lambda r, n=expected: (
+                            r == "idle" and len(sim._members) == n),
+                        30, f"{name}: shrink to {expected} not observed")
+                    sim.charge_barrier()
+                else:
+                    _drive_until(
+                        sim, lambda r: (r == "blocked"
+                                        and _eviction_complete(
+                                            server, sim, name, NS_ELASTIC)),
+                        30, f"{name}: eviction not observed",
+                        allow_restart=False)
+            else:
+                injector.restore_slices(TOPOLOGY, ev.count)
+                if elastic:
+                    _drive_until(
+                        sim, lambda r, n=expected: (
+                            r == "idle" and len(sim._members) == n),
+                        30, f"{name}: expand to {expected} not observed")
+                    sim.charge_barrier()
+                else:
+                    _drive_until(sim, lambda r: r == "restart", 60,
+                                 f"{name}: gang restart not observed")
+        while sim.ticks < TICK_BUDGET and not sim.done:
+            if sim.advance(allow_step=True) == "blocked":
+                time.sleep(0.002)
+
+        job = server.get(api.KIND, name, NS_ELASTIC)
+        status = job.get("status", {})
+        assert status.get("phase") not in ("Failed",), (
+            f"{name} failed terminally: {status}")
+        # the whole point: infrastructure loss never burned maxRestarts
+        # (the job declares max_restarts=0 — one charge would Fail it)
+        assert int(status.get("restarts", 0)) == 0, status
+        if elastic:
+            # strict step monotonicity: no step replayed, none skipped
+            log = sim.step_log
+            assert all(b == a + 1 for a, b in zip(log, log[1:])), (
+                "elastic step log not strictly monotone")
+            # exactly-once data delivery across every resize
+            sim.ledger.verify(steps=sim.step, global_batch=ELASTIC_BATCH)
+            est = status.get("elastic", {})
+            assert int(est.get("preemptionsAbsorbed", 0)) > 0, est
+        return {
+            "workers": workers,
+            "steps": sim.steps_completed,
+            "ticks": round(sim.ticks, 3),
+            "restarts": sim.restarts,
+            "resizes": len(sim.resize_log),
+            "absorbed": (status.get("elastic", {})
+                         .get("preemptionsAbsorbed", 0) if elastic else 0),
+            "digest": sim.digest(),
+        }
+    finally:
+        mgr.stop()
+
+
+def run_elastic_phase(seed: int, workers_sweep: list[int],
+                      conflict_rate: float = 0.05,
+                      latency_rate: float = 0.05) -> dict:
+    """The goodput gate: elastic vs restart-baseline on one schedule."""
+    floor = float(os.environ.get("KF_ELASTIC_FLOOR", "1.5"))
+    runs = []
+    for w in workers_sweep:
+        e = _elastic_storm_run(seed=seed, elastic=True, workers=w,
+                               conflict_rate=conflict_rate,
+                               latency_rate=latency_rate)
+        b = _elastic_storm_run(seed=seed, elastic=False, workers=w,
+                               conflict_rate=conflict_rate,
+                               latency_rate=latency_rate)
+        runs.append((e, b))
+    # worker-sweep determinism: the logical run is invariant under
+    # executor concurrency — bit-identical step logs and ledgers
+    assert len({e["digest"] for e, _ in runs}) == 1, (
+        f"elastic digests diverged across workers {workers_sweep}")
+    assert len({b["digest"] for _, b in runs}) == 1, (
+        f"baseline digests diverged across workers {workers_sweep}")
+    elastic, baseline = runs[0]
+    assert baseline["restarts"] >= 1, (
+        "storm never restarted the baseline — the comparison is vacuous")
+    assert elastic["restarts"] == 0, elastic
+    goodput = elastic["steps"] / max(1, baseline["steps"])
+    assert goodput >= floor, (
+        f"elastic goodput {elastic['steps']} steps is only {goodput:.2f}x "
+        f"the restart baseline's {baseline['steps']} (floor {floor}x)")
+    result = {
+        "phase": "elastic-storm", "seed": seed,
+        "workers_sweep": workers_sweep,
+        "elastic_steps": elastic["steps"],
+        "baseline_steps": baseline["steps"],
+        "goodput_x": round(goodput, 2),
+        "elastic_resizes": elastic["resizes"],
+        "preemptions_absorbed": elastic["absorbed"],
+        "baseline_restarts": baseline["restarts"],
+        "digest": elastic["digest"],
+    }
+    print(json.dumps(result))
+    return result
+
+
 def main() -> int:
     ap = argparse.ArgumentParser("load_chaos")
     ap.add_argument("n_gangs", nargs="?", type=int, default=12)
@@ -345,21 +596,44 @@ def main() -> int:
     ap.add_argument("--latency-rate", type=float, default=0.10)
     ap.add_argument("--smoke", action="store_true",
                     help="small-N CI profile (4 gangs, 2 slices, 2 nbs)")
+    ap.add_argument("--elastic-only", action="store_true",
+                    help="run only the elastic-storm phase")
+    ap.add_argument("--workers", default="1,4",
+                    help="executor worker counts the elastic phase sweeps "
+                         "for digest invariance (comma-separated)")
     args = ap.parse_args()
 
     if args.smoke:
         args.n_gangs, args.m_slices, args.notebooks = 4, 2, 2
 
-    # invariant 4: the same seed converges to the SAME final state
-    results = [run_once(args.n_gangs, args.m_slices, args.notebooks,
-                        args.seed, args.conflict_rate, args.latency_rate)
-               for _ in range(2)]
-    if results[0]["digest"] != results[1]["digest"]:
-        print("FAIL: same seed produced different final state digests")
-        return 1
-    print(f"converged under chaos twice; state digest identical "
-          f"({results[0]['digest'][:16]}…); "
-          f"faults={results[1]['faults_injected'] - results[0]['faults_injected']:.0f} in run 2")
+    if not args.elastic_only:
+        # invariant 4: the same seed converges to the SAME final state
+        results = [run_once(args.n_gangs, args.m_slices, args.notebooks,
+                            args.seed, args.conflict_rate,
+                            args.latency_rate)
+                   for _ in range(2)]
+        if results[0]["digest"] != results[1]["digest"]:
+            print("FAIL: same seed produced different final state digests")
+            return 1
+        print(f"converged under chaos twice; state digest identical "
+              f"({results[0]['digest'][:16]}…); "
+              f"faults={results[1]['faults_injected'] - results[0]['faults_injected']:.0f} in run 2")
+
+    # elastic-storm goodput gate (KF_SKIP_ELASTIC=1 opts out, the
+    # KF_SKIP_CHAOS pattern for constrained hosts)
+    if os.environ.get("KF_SKIP_ELASTIC") == "1":
+        print("elastic-storm phase skipped (KF_SKIP_ELASTIC=1)")
+        return 0
+    sweep = [int(w) for w in args.workers.split(",") if w.strip()]
+    out = run_elastic_phase(args.seed, sweep,
+                            conflict_rate=args.conflict_rate,
+                            latency_rate=args.latency_rate)
+    print(f"elastic gang absorbed {out['preemptions_absorbed']} "
+          f"preempted worker(s) over {out['elastic_resizes']} resizes: "
+          f"{out['elastic_steps']} steps vs the restart baseline's "
+          f"{out['baseline_steps']} ({out['goodput_x']}x goodput); "
+          f"digests identical across executor workers "
+          f"{sweep}")
     return 0
 
 

@@ -400,3 +400,36 @@ def test_control_plane_route_reports_cache_replicas_and_pages(stack):
         assert follower["lag"] == 0
     finally:
         plane.close()
+
+
+def test_nodes_route_surfaces_per_gang_elastic_state(stack):
+    """The nodes (cluster robustness) card shows which gangs can absorb
+    preemptions in place: live/min/max size, membership epoch, resizes,
+    and preemptions absorbed without a restart — read from the
+    controller-owned status.elastic record."""
+    from kubeflow_tpu.api import jaxjob as jj
+
+    server, _mgr, base = stack
+    server.create(jj.new("stretch", "team-a", topology="v5e-8",
+                         num_slices=2,
+                         elastic={"minReplicas": 2, "maxReplicas": 4}))
+    server.patch_status("JAXJob", "stretch", "team-a", {
+        "phase": "Running",
+        "elastic": {"epoch": 3, "members": [0, 1], "size": 2,
+                    "coordinator": 0, "minReplicas": 2, "maxReplicas": 4,
+                    "desired": 4, "resizes": 3, "preemptionsAbsorbed": 2,
+                    "lastResizeAt": 123.0}})
+    code, health = req(base, "/dashboard/api/nodes", user="alice@corp.com")
+    assert code == 200
+    # the elastic standing rides the same payload as the node roster
+    assert "nodes" in health and "node_recovered" in health
+    gang = next(g for g in health["elastic_gangs"]
+                if g["name"] == "stretch")
+    assert gang["namespace"] == "team-a"
+    assert (gang["size"], gang["min"], gang["max"]) == (2, 2, 4)
+    assert gang["desired"] == 4 and gang["epoch"] == 3
+    assert gang["resizes"] == 3 and gang["preemptions_absorbed"] == 2
+    # a fixed gang never appears on the elastic roster
+    server.create(jj.new("rigid", "team-a", topology="v5e-8"))
+    _, health = req(base, "/dashboard/api/nodes", user="alice@corp.com")
+    assert all(g["name"] != "rigid" for g in health["elastic_gangs"])

@@ -504,3 +504,25 @@ def test_watch_resume_after_window_eviction_falls_back_to_relist():
     finally:
         w.stop()
         httpd.shutdown()
+
+
+def test_delete_uid_precondition_over_http(make_remote):
+    """The k8s DeleteOptions.Preconditions.UID shape crosses the wire:
+    a uid-guarded delete kills only THAT incarnation — a same-name
+    replacement answers 409 Conflict, exactly what the preemption
+    controller's eviction relies on to never kill a recreated pod."""
+    server, base = make_remote()
+    store = KubeStore(base)
+    first = store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                          "metadata": {"name": "c", "namespace": "d"},
+                          "spec": {}})
+    store.delete("ConfigMap", "c", "d")
+    second = store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                           "metadata": {"name": "c", "namespace": "d"},
+                           "spec": {}})
+    assert second["metadata"]["uid"] != first["metadata"]["uid"]
+    with pytest.raises(Conflict):
+        store.delete("ConfigMap", "c", "d", uid=first["metadata"]["uid"])
+    store.delete("ConfigMap", "c", "d", uid=second["metadata"]["uid"])
+    with pytest.raises(NotFound):
+        store.get("ConfigMap", "c", "d")
