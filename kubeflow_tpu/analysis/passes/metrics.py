@@ -1,6 +1,6 @@
-"""Metrics hygiene: naming rules, duplicate registration, dead references.
+"""Metrics hygiene: naming, duplicates, dead references, cardinality.
 
-Three rules over every ``REGISTRY.counter/gauge/histogram("name", ...)``
+Four rules over every ``REGISTRY.counter/gauge/histogram("name", ...)``
 call site (literal first argument) in the scanned tree:
 
 ``metric-name``
@@ -17,11 +17,26 @@ call site (literal first argument) in the scanned tree:
     code path finally touches it.
 
 ``metric-unknown-ref``
-    A metric name referenced by the dashboard's metrics service
-    (``get_metric("...")`` / ``val("...")``) that no scanned module
-    registers: the panel renders zeros forever and nobody notices.  The
-    cross-check is skipped when the scan saw no registrations outside the
-    dashboard package (a partial-tree invocation cannot judge it).
+    A metric name referenced by string that no scanned module registers:
+    the dashboard's metrics service (``get_metric("...")`` /
+    ``val("...")``), any ``get_metric("...")`` elsewhere (loadtests, the
+    obs scraper), and SLO rule definitions (``metric=`` / ``bad_metric=``
+    / ``total_metric=`` keyword literals).  An unknown name means the
+    panel/rule reads zeros forever and nobody notices — now that the obs
+    TSDB scrapes the registries, a rule on an unregistered series is an
+    alert that can never fire.  The cross-check is skipped when the scan
+    saw no registrations outside the dashboard package (a partial-tree
+    invocation cannot judge it).
+
+``metric-label-cardinality``
+    A ``.labels(...)`` argument derived from request/object identity —
+    an f-string / ``str.format`` / concatenation, anything reaching into
+    ``metadata``, or an identifier shaped like a per-request value
+    (``path``, ``user``, ``*_id`` …).  Every distinct value mints a new
+    series FOREVER (the registry never expires them, and the obs TSDB
+    now keeps a ring buffer per series), so label values must come from
+    small closed sets.  Intentional per-object gauges (one series per
+    cluster node) carry an explicit suppression.
 """
 
 from __future__ import annotations
@@ -35,7 +50,15 @@ from kubeflow_tpu.analysis.framework import (
 
 REGISTER_METHODS = {"counter", "gauge", "histogram"}
 DASHBOARD_FRAGMENT = "dashboard/"
-REF_FUNCS = {"get_metric", "val"}
+DASHBOARD_REF_FUNCS = {"get_metric", "val"}
+GLOBAL_REF_FUNCS = {"get_metric"}
+RULE_REF_KWARGS = ("metric", "bad_metric", "total_metric")
+# bare identifiers whose NAME says "per-request/per-object value":
+# labeling by one of these mints unbounded series
+SUSPECT_IDENTIFIERS = {"path", "request_path", "user", "email",
+                       "request_id", "trace_id", "span_id", "pod_name",
+                       "node_name", "object_name", "namespace"}
+SUSPECT_ATTRIBUTES = {"name", "path", "user", "request_id", "trace_id"}
 
 
 @dataclass
@@ -66,9 +89,38 @@ def _literal_labels(call: ast.Call) -> tuple[str, ...] | None:
     return None
 
 
+def _suspicious_label_arg(node: ast.expr) -> str | None:
+    """Why this ``.labels(...)`` argument looks unbounded, or None."""
+    if isinstance(node, ast.JoinedStr):
+        return "f-string label value"
+    if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                  (ast.Add, ast.Mod)):
+        return "concatenated/interpolated label value"
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "format":
+            return "str.format label value"
+        if (isinstance(func, ast.Name) and func.id == "str"
+                and node.args):
+            return _suspicious_label_arg(node.args[0])
+        return None
+    try:
+        src = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total post-parse
+        return None
+    if "metadata" in src:
+        return f"label value reaches into object metadata ({src})"
+    if isinstance(node, ast.Attribute) and node.attr in SUSPECT_ATTRIBUTES:
+        return f"label value from per-object field {src}"
+    if isinstance(node, ast.Name) and node.id in SUSPECT_IDENTIFIERS:
+        return f"label value from per-request identifier {src!r}"
+    return None
+
+
 @register
 class MetricsHygienePass(Pass):
-    rules = ("metric-name", "metric-duplicate", "metric-unknown-ref")
+    rules = ("metric-name", "metric-duplicate", "metric-unknown-ref",
+             "metric-label-cardinality")
 
     def __init__(self) -> None:
         self._regs: list[_Reg] = []
@@ -101,16 +153,38 @@ class MetricsHygienePass(Pass):
                         "metric-name", mod.path, node.lineno,
                         f"gauge {name!r} must not end in '_total' "
                         "(counter-shaped name on a level)"))
-            if DASHBOARD_FRAGMENT in mod.path:
-                ref_name = None
-                if (isinstance(func, ast.Attribute)
-                        and func.attr in REF_FUNCS and node.args):
-                    ref_name = const_str(node.args[0])
-                elif (isinstance(func, ast.Name) and func.id in REF_FUNCS
-                      and node.args):
-                    ref_name = const_str(node.args[0])
-                if ref_name is not None:
-                    self._refs.append((ref_name, mod.path, node.lineno))
+            if isinstance(func, ast.Attribute) and func.attr == "labels":
+                for arg in node.args:
+                    why = _suspicious_label_arg(arg)
+                    if why is not None:
+                        findings.append(Finding(
+                            "metric-label-cardinality", mod.path,
+                            node.lineno,
+                            f"{why}: every distinct value mints a new "
+                            "series forever — label from a small closed "
+                            "set, or suppress if the set is genuinely "
+                            "bounded"))
+            # string references to metric names: get_metric anywhere,
+            # val() in the dashboard package, SLO rule kwargs
+            ref_funcs = (DASHBOARD_REF_FUNCS
+                         if DASHBOARD_FRAGMENT in mod.path
+                         else GLOBAL_REF_FUNCS)
+            ref_name = None
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in ref_funcs and node.args):
+                ref_name = const_str(node.args[0])
+            elif (isinstance(func, ast.Name) and func.id in ref_funcs
+                  and node.args):
+                ref_name = const_str(node.args[0])
+            if ref_name is not None:
+                self._refs.append((ref_name, mod.path, node.lineno))
+            for kwarg_name in RULE_REF_KWARGS:
+                kw = keyword_arg(node, kwarg_name)
+                if kw is None:
+                    continue
+                kw_name = const_str(kw)
+                if kw_name:
+                    self._refs.append((kw_name, mod.path, node.lineno))
         return findings
 
     def finalize(self, mods: list[ModuleInfo]) -> Iterable[Finding]:

@@ -208,7 +208,9 @@ class Autoscaler(Controller):
             # a pending scale-down was re-decided upward: victims return
             # to rotation
             self._undrain(req)
-        DRAINING.labels(req.namespace, req.name).set(draining)
+        # one series per autoscaled InferenceService revision — bounded
+        # by the services deployed, the per-revision view is the point
+        DRAINING.labels(req.namespace, req.name).set(draining)  # kfvet: ignore[metric-label-cardinality]
         self._mirror(isvc, decision, applied, parked, concurrency,
                      draining)
         return Result(requeue_after=spec.tick)

@@ -197,13 +197,22 @@ COMPONENTS: dict[str, dict[str, Any]] = {
     },
     "observability": {
         "include_dirs": ["kubeflow_tpu/trace/*",
+                         "kubeflow_tpu/obs/*",
+                         "kubeflow_tpu/utils/metrics.py",
                          "kubeflow_tpu/utils/profiler.py",
-                         "loadtest/load_trace.py"],
+                         "loadtest/load_trace.py",
+                         "loadtest/load_obs.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
-                     "tests/test_trace.py"],
+                     "tests/test_trace.py", "tests/test_obs.py"],
         # traced serving storm + span-tree invariants + the sampling-off
         # overhead budget (KF_SKIP_TRACE=1 opts out on constrained hosts)
         "trace_cmd": [sys.executable, "loadtest/load_trace.py", "--smoke"],
+        # telemetry-pipeline storm: the TTFT burn-rate alert fires within
+        # 2 fast-window evaluations of a seeded overload, resolves after,
+        # stays silent through an equal-length steady phase, tail
+        # exemplars resolve to live traces, and the scrape+eval tick
+        # holds the per-request overhead budget (KF_SKIP_OBS=1 opts out)
+        "obs_cmd": [sys.executable, "loadtest/load_obs.py", "--smoke"],
     },
     "scale": {
         "include_dirs": ["kubeflow_tpu/core/watchcache.py",
@@ -287,6 +296,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "trace_cmd" in spec:
         steps.append({"name": "trace", "run": spec["trace_cmd"],
                       "depends": ["test"]})
+    if "obs_cmd" in spec:
+        steps.append({"name": "obs", "run": spec["obs_cmd"],
+                      "depends": ["test"]})
     if "scale_cmd" in spec:
         steps.append({"name": "scale", "run": spec["scale_cmd"],
                       "depends": ["test"]})
@@ -348,6 +360,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "trace_cmd" in spec
                 and os.environ.get("KF_SKIP_TRACE") != "1"):
             ok = subprocess.run(spec["trace_cmd"]).returncode == 0
+        if (ok and "obs_cmd" in spec
+                and os.environ.get("KF_SKIP_OBS") != "1"):
+            ok = subprocess.run(spec["obs_cmd"]).returncode == 0
         if (ok and "scale_cmd" in spec
                 and os.environ.get("KF_SKIP_SCALE") != "1"):
             ok = subprocess.run(spec["scale_cmd"]).returncode == 0

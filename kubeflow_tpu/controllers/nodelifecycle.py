@@ -77,7 +77,10 @@ class NodeLifecycleController(Controller):
         try:
             node = self.server.get("Node", req.name)
         except NotFound:
-            HEARTBEAT_AGE.labels(req.name).set(0.0)
+            # the node is gone: drop its series with it — a leftover
+            # 0.0 would read as a maximally-fresh heartbeat forever,
+            # and churned node names would grow the family unbounded
+            HEARTBEAT_AGE.remove(req.name)
             self._not_ready.discard(req.name)
             return None
         status = node.get("status", {})
@@ -85,7 +88,7 @@ class NodeLifecycleController(Controller):
         hb = float(status.get("heartbeatTime")
                    or node["metadata"].get("creationTimestamp", 0.0))
         age = self._clock() - hb
-        HEARTBEAT_AGE.labels(req.name).set(age)
+        HEARTBEAT_AGE.labels(req.name).set(age)  # kfvet: ignore[metric-label-cardinality]
         if age <= self.ttl:
             if status.get("ready") is not True:
                 self.server.patch_status("Node", req.name, None, {

@@ -78,6 +78,8 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/traces", self.traces_route)
         self.add_route("GET", "/api/control-plane",
                        self.control_plane_route)
+        self.add_route("GET", "/api/query", self.query_route)
+        self.add_route("GET", "/api/alerts", self.alerts_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -173,6 +175,55 @@ class DashboardApp(CrudApp):
         latency + scanned-objects counter, and apiserver replica
         leadership/lag."""
         return "200 OK", self.metrics.get_control_plane_state()
+
+    def query_route(self, req: Request):
+        """PromQL-lite over the in-memory TSDB: ``?q=<expr>`` where expr
+        is a selector / rate / increase / *_over_time /
+        quantile_over_window / sum by(...) shape (see obs.query).  With
+        ``&exemplars=1`` a quantile query also returns the trace-id
+        exemplars from the quantile's bucket upward — the click-through
+        from a tail-latency panel to ``/dashboard/api/traces``."""
+        from kubeflow_tpu import obs
+
+        # THIS server's pipeline only — the process global is for
+        # serverless consumers; falling back to it here would answer
+        # with some other (possibly torn-down) platform's TSDB
+        pipeline = getattr(self.server, "obs", None)
+        if pipeline is None:
+            raise HTTPError("503 Service Unavailable",
+                            "obs pipeline not attached")
+        q = req.query.get("q", [""])[0]
+        try:
+            expr = obs.parse_query(q)
+            vector = expr.run(pipeline.query, None)
+        except obs.QueryError as e:
+            raise HTTPError("422 Unprocessable Entity", str(e))
+        result = {"query": q,
+                  "at": pipeline.tsdb.now(),
+                  "result": [{"labels": lbl, "value": v}
+                             for lbl, v in vector]}
+        if (req.query.get("exemplars", ["0"])[0] not in ("0", "")
+                and expr.func == "quantile_over_window"):
+            bucket = pipeline.query.quantile_bucket(
+                expr.q, expr.name, expr.window_s, expr.matchers)
+            # no observations in the window -> no tail to exemplify;
+            # an unfiltered dump would present FAST traces as the
+            # click-through of a tail-latency panel.  `since` drops
+            # exemplars first scraped before the query window — a
+            # hours-old storm's trace ids must not answer for the last
+            # five minutes (their spans are likely evicted anyway)
+            result["exemplars"] = ([] if bucket is None
+                                   else pipeline.query.exemplars(
+                                       expr.name, expr.matchers,
+                                       min_le=bucket,
+                                       since=(pipeline.tsdb.now()
+                                              - expr.window_s)))
+        return "200 OK", result
+
+    def alerts_route(self, req: Request):
+        """SLO standing + burn-rate alert states + recent transition log
+        (the SLO card's backend; see obs.rules for the window math)."""
+        return "200 OK", self.metrics.get_obs_state()
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

@@ -269,6 +269,34 @@ def trace_state() -> dict:
     }
 
 
+def obs_state(server=None) -> dict:
+    """SLO/alerts standing (the SLO card + ``/dashboard/api/alerts``):
+    every rule's state/severity/burn value, currently-firing names, the
+    recent transition log, and the TSDB's own footprint.  Served off the
+    process pipeline the platform attached; ``attached: False`` when
+    nothing did (the card renders the hint instead of zeros)."""
+    from kubeflow_tpu import obs
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    # with a server, ITS pipeline is authoritative (a process-global
+    # fallback would report another platform's state for a server that
+    # never attached one); the global covers only serverless callers
+    if server is not None:
+        pipeline = getattr(server, "obs", None)
+    else:
+        pipeline = obs.get_pipeline()
+    if pipeline is None:
+        return {"attached": False, "alerts": [], "firing": [], "log": []}
+    scrape = REGISTRY.get_metric("obs_scrape_duration_seconds")
+    state = {"attached": True, **pipeline.state()}
+    state["scrape"] = {
+        "ticks": scrape.count() if scrape is not None else 0.0,
+        "p50_s": scrape.percentile(50) if scrape is not None else 0.0,
+        "p99_s": scrape.percentile(99) if scrape is not None else 0.0,
+    }
+    return state
+
+
 def cluster_health(server) -> dict:
     """Node heartbeat standing + failure-recovery counters (the
     robustness card): per-node heartbeat age/readiness straight from the
@@ -360,6 +388,8 @@ class MetricsService(Protocol):
 
     def get_control_plane_state(self) -> dict: ...
 
+    def get_obs_state(self) -> dict: ...
+
 
 class LocalMetricsService:
     """Derives series from the in-memory API server (pod counts as a proxy
@@ -423,6 +453,9 @@ class LocalMetricsService:
 
     def get_control_plane_state(self) -> dict:
         return control_plane_state(self.server)
+
+    def get_obs_state(self) -> dict:
+        return obs_state(self.server)
 
 
 class CloudMonitoringMetricsService:
@@ -506,6 +539,10 @@ class CloudMonitoringMetricsService:
         # store, like the autoscaler's standing
         return (control_plane_state(self.server) if self.server
                 else {"watch_cache": {"attached": False}})
+
+    def get_obs_state(self):
+        # the TSDB + rule engine are process-local under either backend
+        return obs_state(self.server)
 
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:

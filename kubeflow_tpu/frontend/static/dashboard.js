@@ -260,6 +260,40 @@
       traceCard.replaceChildren(el("h2", null, "Tracing"), ...rows);
     }).catch(() => traceCard.append(errorBox("unavailable")));
 
+    // SLO / alerts card: every burn-rate rule's standing off the
+    // in-memory TSDB, firing alerts first, recent transitions below
+    const sloCard = el("div", { class: "card", id: "slo-card" },
+      el("h2", null, "SLOs"), el("div", { class: "muted" }, "…"));
+    cards.append(sloCard);
+    api.get("/dashboard/api/alerts").then((a) => {
+      if (!a.attached) {
+        sloCard.replaceChildren(el("h2", null, "SLOs"),
+          el("div", { class: "muted" }, "obs pipeline not attached"));
+        return;
+      }
+      const firing = a.firing.length;
+      const rows = [
+        el("div", { class: firing ? "big hot" : "big" }, `${firing}`),
+        el("div", { class: "muted" },
+          `alerts firing · ${a.alerts.length} SLOs · ` +
+          `${a.tsdb.series} series · scrape p99 ` +
+          `${(1e3 * ((a.scrape || {}).p99_s || 0)).toFixed(2)} ms`),
+        el("ul", null, a.alerts.map((r) =>
+          el("li", { class: "hint" },
+            `${r.alert}: ${r.state}` +
+            (r.state !== "inactive"
+              ? ` (${r.severity}, ` + (r.kind === "gauge"
+                ? `level ${r.value.toFixed(1)})`
+                : `burn ${r.value.toFixed(1)}x)`) : "")))),
+      ];
+      const recent = (a.log || []).slice(-3).reverse();
+      if (recent.length) {
+        rows.push(el("div", { class: "hint" }, recent.map((e) =>
+          `${e.alert} → ${e.to}`).join(" · ")));
+      }
+      sloCard.replaceChildren(el("h2", null, "SLOs"), ...rows);
+    }).catch(() => sloCard.append(errorBox("unavailable")));
+
     // control-plane-scale card: watch-cache window standing, resume
     // outcomes, paginated-list latency, and apiserver replica lag
     const cpCard = el("div", { class: "card", id: "control-plane-card" },

@@ -80,6 +80,12 @@ PICKS = REGISTRY.counter(
     "gateway_backend_pick_total",
     "backend pick decisions by requested serving role and reason",
     labels=("role", "reason"))
+REQUEST_SECONDS = REGISTRY.histogram(
+    "gateway_request_duration_seconds",
+    "time-to-last-byte of proxied requests; tail buckets carry trace-id "
+    "exemplars when the request was sampled",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
 
 log = get_logger("gateway")
 
@@ -242,18 +248,26 @@ def _scale_key(route: Route) -> tuple | None:
     return (route.dest_namespace, svc) if svc else None
 
 
-def _span_stream(result, span):
+def _span_stream(result, span, started=None):
     """Close the request's root span when the response body has fully
     streamed (or the client walked away) — the span's duration is
     time-to-last-byte, which is what a slow-request investigation needs.
-    Unsampled requests pass through unwrapped."""
-    if not span:
+    With ``started`` (a perf_counter origin) the same boundary feeds the
+    gateway latency histogram for EVERY request, sampled or not, tagging
+    the bucket with the trace id as an exemplar when one exists — the
+    obs TSDB's tail queries hand those ids back.  Unsampled, untimed
+    requests pass through unwrapped."""
+    if not span and started is None:
         return result
 
     def run():
         try:
             yield from result
         finally:
+            if started is not None:
+                REQUEST_SECONDS.observe(
+                    time.perf_counter() - started,
+                    exemplar=span.trace_id if span else None)
             span.end()
 
     return run()
@@ -854,6 +868,7 @@ class Gateway:
 
     def __call__(self, environ, start_response):
         path = environ.get("PATH_INFO", "/")
+        started = time.perf_counter()
         # the front door ROOTS the request's trace (or continues a client
         # traceparent); ownership is handed to the streaming wrapper,
         # which closes the span when the last body byte is delivered —
@@ -947,7 +962,7 @@ class Gateway:
                 span.set_attribute("error", True)
                 span.end()
                 raise
-            return _span_stream(result, span)
+            return _span_stream(result, span, started)
         # count the request in-flight for the autoscaler's concurrency
         # view — and per BACKEND for the reconciler's drain quiesce check
         # (scale-down waits for the victim's stream count to hit zero):
@@ -977,7 +992,7 @@ class Gateway:
             raise
         return _span_stream(_counted(result, self.collector, key, addr_ref,
                                      peer_addr),
-                            span)
+                            span, started)
 
     def _activate(self, route: Route, path: str):
         """Scale-from-zero: hold the request while the activator brings up
@@ -1236,11 +1251,12 @@ class ControlPlaneRouter:
 
     def _read(self, verb: str, *args, **kwargs):
         r = self._pick()
-        APISERVER_REQS.labels(r.name, verb).inc()
+        # replica names: a closed set sized by --replicas, not tenant data
+        APISERVER_REQS.labels(r.name, verb).inc()  # kfvet: ignore[metric-label-cardinality]
         return getattr(r.store, verb)(*args, **kwargs)
 
     def _on_leader(self, verb: str, *args, **kwargs):
-        APISERVER_REQS.labels(self._leader.name, verb).inc()
+        APISERVER_REQS.labels(self._leader.name, verb).inc()  # kfvet: ignore[metric-label-cardinality]
         return getattr(self._leader.store, verb)(*args, **kwargs)
 
     # -- read surface ----------------------------------------------------------
@@ -1271,7 +1287,7 @@ class ControlPlaneRouter:
             r = self._by_origin.get(watchcache.continue_origin(cont) or "")
         if r is None:
             r = self._pick()
-        APISERVER_REQS.labels(r.name, "list_page").inc()
+        APISERVER_REQS.labels(r.name, "list_page").inc()  # kfvet: ignore[metric-label-cardinality]
         return watchcache.list_page_fn(r.store)(kind, **kw)
 
     def generation(self, kind: str) -> int:
@@ -1297,7 +1313,7 @@ class ControlPlaneRouter:
         return self._on_leader("delete", *args, **kwargs)
 
     def watch(self, kinds=None, namespace=None, resource_version=None):
-        APISERVER_REQS.labels(self._leader.name, "watch").inc()
+        APISERVER_REQS.labels(self._leader.name, "watch").inc()  # kfvet: ignore[metric-label-cardinality]
         return self._leader.store.watch(kinds=kinds, namespace=namespace,
                                         resource_version=resource_version)
 

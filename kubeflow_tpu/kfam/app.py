@@ -39,6 +39,31 @@ REQUESTS = REGISTRY.counter("kfam_requests_total", "KFAM requests",
                             labels=("path", "code"))
 HEARTBEAT = REGISTRY.counter("kfam_heartbeat_total", "liveness heartbeats")
 
+# the closed set of path labels REQUESTS may carry: raw request paths
+# embed profile names (DELETE /kfam/v1/profiles/<name>), and labeling by
+# them minted one series per tenant forever.  Keep in lockstep with
+# _route's dispatch — a route added there but not here counts as
+# "other" (bounded either way, but the per-route split goes blind).
+_ROUTE_LABELS = ("/healthz", "/metrics", "/kfam/v1/role/clusteradmin",
+                 "/kfam/v1/profiles", "/kfam/v1/bindings")
+
+
+def _strip_mount(path: str) -> str:
+    """Normalize the front-door mount spelling (/kfam/healthz ->
+    /healthz) — shared by routing and metric labeling so the two can
+    never disagree about which route a path means."""
+    if path.startswith("/kfam/") and not path.startswith("/kfam/v1"):
+        return path[len("/kfam"):]
+    return path
+
+
+def _route_label(path: str) -> str:
+    """Collapse a request path onto the route template it matched."""
+    path = _strip_mount(path)
+    if re.fullmatch(r"/kfam/v1/profiles/[^/]+", path):
+        return "/kfam/v1/profiles/{name}"
+    return path if path in _ROUTE_LABELS else "other"
+
 log = get_logger("kfam")
 
 
@@ -84,7 +109,7 @@ class KfamApp:
             status, body = "409 Conflict", {"error": str(e)}
         except (Invalid, ValueError, KeyError) as e:
             status, body = "422 Unprocessable Entity", {"error": str(e)}
-        REQUESTS.labels(path, status.split()[0]).inc()
+        REQUESTS.labels(_route_label(path), status.split()[0]).inc()
         if isinstance(body, str):
             payload = body.encode()
             ctype = "text/plain; version=0.0.4"
@@ -99,8 +124,7 @@ class KfamApp:
     def _route(self, method, path, environ, user):
         # when mounted under the platform front door, probes arrive as
         # /kfam/healthz -- normalize both spellings
-        if path.startswith("/kfam/") and not path.startswith("/kfam/v1"):
-            path = path[len("/kfam"):]
+        path = _strip_mount(path)
         if path == "/healthz":
             HEARTBEAT.inc()
             return "200 OK", {"status": "ok"}

@@ -1,0 +1,171 @@
+"""Telemetry pipeline: in-memory TSDB + PromQL-lite + SLO burn-rate alerts.
+
+The observability layer that turns the platform's ~80 instantaneous
+counters/histograms/gauges into queryable history with SLO verdicts:
+
+    tsdb.TSDB        bounded per-series ring buffers (Monarch-style)
+    tsdb.Scraper     clock-injected sampler of component registries
+                     (parses the text exposition; pulls exemplar
+                     reservoirs alongside)
+    query.QueryEngine  rate()/increase()/*_over_time/
+                     quantile_over_window + label matchers + sum by
+    rules.RuleEngine multi-window multi-burn-rate SLO alerting with
+                     firing/pending/resolved state and an alert log
+    rules.default_slos  serving TTFT p99, gateway shed rate,
+                     reconcile p99, persistence degraded mode
+
+Process wiring: ``attach(server)`` builds the pipeline against the
+process registry, publishes it for the dashboard
+(``/dashboard/api/query``, ``/dashboard/api/alerts``), and — unless
+``KF_OBS_SCRAPE_INTERVAL`` is 0 — starts the background scrape thread.
+Histogram exemplars (``Histogram.observe(v, exemplar=trace_id)``) link
+tail-latency queries back to the PR 8 trace collector, so a burning
+TTFT alert resolves to concrete slow requests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from kubeflow_tpu.obs.query import QueryEngine, QueryError, parse_query
+from kubeflow_tpu.obs.rules import (
+    FIRING,
+    INACTIVE,
+    PENDING,
+    SLO,
+    BurnWindow,
+    RuleEngine,
+    default_burn_windows,
+    default_slos,
+)
+from kubeflow_tpu.obs.tsdb import TSDB, Sample, Scraper, parse_exposition
+
+__all__ = [
+    "SLO",
+    "TSDB",
+    "BurnWindow",
+    "FIRING",
+    "INACTIVE",
+    "PENDING",
+    "Pipeline",
+    "QueryEngine",
+    "QueryError",
+    "RuleEngine",
+    "Sample",
+    "Scraper",
+    "attach",
+    "default_burn_windows",
+    "default_slos",
+    "get_pipeline",
+    "parse_exposition",
+    "parse_query",
+    "set_pipeline",
+]
+
+
+class Pipeline:
+    """One process's telemetry stack: TSDB + scraper + rules + queries."""
+
+    def __init__(self, *, tsdb: TSDB | None = None,
+                 slos: list[SLO] | None = None,
+                 scraper: Scraper | None = None,
+                 interval_s: float = 5.0, clock=None):
+        self.tsdb = tsdb or TSDB(resolution_s=interval_s)
+        self.rules = RuleEngine(self.tsdb, slos if slos is not None
+                                else default_slos(
+                                    scrape_interval_s=interval_s))
+        # a burn window too short for its scrape cadence can never hold
+        # the 2 samples a rate needs: it evaluates as no-data forever
+        # while the rule reads as a healthy "inactive" — say so loudly
+        for slo in self.rules.slos:
+            for w in slo.windows:
+                if w.short_s < 2.0 * interval_s:
+                    from kubeflow_tpu.utils.logging import get_logger
+
+                    get_logger("obs").warning(
+                        "burn window unmeasurable at this scrape "
+                        "interval; the pair will never fire",
+                        alert=slo.name, short_s=w.short_s,
+                        interval_s=interval_s)
+        self.query = QueryEngine(self.tsdb)
+        self.scraper = scraper or Scraper(
+            self.tsdb, rule_engine=self.rules, interval_s=interval_s,
+            clock=clock)
+        # set by attach(): whether the deployment wants the background
+        # scrape thread (platform.main starts it AFTER the manager is
+        # up; build_platform never does — embedders and tests would
+        # leak a ticking thread nothing they own can stop)
+        self.autostart = False
+
+    def tick(self, at: float | None = None) -> list:
+        return self.scraper.tick(at)
+
+    def start(self) -> None:
+        self.scraper.start()
+
+    def stop(self) -> None:
+        self.scraper.stop()
+
+    def state(self) -> dict:
+        """The SLO/alerts card payload: rule standing, recent
+        transitions, and the TSDB's own footprint."""
+        return {
+            "alerts": self.rules.active(),
+            "firing": self.rules.firing(),
+            "log": self.rules.log(limit=50),
+            "tsdb": self.tsdb.stats(),
+        }
+
+
+_pipeline: Pipeline | None = None
+_pipeline_lock = threading.Lock()
+
+
+def get_pipeline() -> Pipeline | None:
+    """The process pipeline, or None when nothing attached one (the
+    dashboard's obs endpoints answer 503 in that case)."""
+    return _pipeline
+
+
+def set_pipeline(p: Pipeline | None) -> Pipeline | None:
+    """Swap the process pipeline, stopping the previous one's scrape
+    thread — a replaced pipeline must not keep ticking the shared
+    registry (and mutating obs_* gauges) behind the new one's back."""
+    global _pipeline
+    with _pipeline_lock:
+        old, _pipeline = _pipeline, p
+    if old is not None and old is not p:
+        old.stop()
+    return p
+
+
+def attach(server, *, interval_s: float | None = None,
+           slos: list[SLO] | None = None, start: bool | None = None,
+           clock=None) -> Pipeline | None:
+    """Build and publish the process pipeline.  ``start=True`` runs the
+    scrape thread immediately; ``start=None`` (the platform binary's
+    path) defers it to ``platform.main`` via ``pipeline.autostart``.
+    ``KF_OBS_SCRAPE_INTERVAL=0`` opts OUT entirely: nothing is attached
+    and the dashboard honestly reports the pipeline absent — a
+    published-but-never-ticking pipeline would render as a healthy
+    monitored system.  Tests wanting deterministic ticks pass an
+    explicit ``interval_s`` with ``start=False`` and drive ``tick()``
+    themselves."""
+    if interval_s is None:
+        try:
+            interval_s = float(os.environ.get("KF_OBS_SCRAPE_INTERVAL",
+                                              "5"))
+        except ValueError:
+            interval_s = 5.0
+    if interval_s <= 0 and not start:
+        server.obs = None
+        return None
+    pipeline = Pipeline(interval_s=interval_s if interval_s > 0 else 5.0,
+                        slos=slos, clock=clock)
+    pipeline.autostart = interval_s > 0 and start is None
+    set_pipeline(pipeline)
+    server.obs = pipeline
+    if start:
+        pipeline.start()
+    return pipeline

@@ -1,0 +1,306 @@
+"""PromQL-lite: window functions + selectors + aggregation over the TSDB.
+
+The subset every consumer in this tree actually needs, implemented
+directly over :class:`~kubeflow_tpu.obs.tsdb.TSDB` rings:
+
+- selection by metric name + label equality matchers
+  (``m{a="x",b="y"}``);
+- counter window functions with reset detection: ``rate(m[30s])``,
+  ``increase(m[30s])``;
+- gauge window functions: ``avg_over_time`` / ``max_over_time`` /
+  ``min_over_time``;
+- ``quantile_over_window(0.99, m[60s])`` off histogram *bucket deltas* —
+  the quantile of observations that happened INSIDE the window, which an
+  instantaneous ``Histogram.percentile`` (all-time cumulative) cannot
+  answer;
+- ``sum by (a,b) (...)`` over any of the above.
+
+Results are vectors: ``[(labels_dict, value), ...]``.  The string form
+(`parse_query`/`evaluate`) exists for the dashboard's
+``/dashboard/api/query`` endpoint and ad-hoc debugging; programmatic
+callers (SLO rules, cards) use the functions directly.
+"""
+
+from __future__ import annotations
+
+import re
+
+from kubeflow_tpu.obs.tsdb import TSDB
+
+
+# -- window math over one ring -------------------------------------------------
+
+def counter_increase(points: list[tuple[float, float]]) -> float:
+    """Total increase across adjacent samples, re-based at counter
+    resets: a decrease means the producing component restarted and began
+    again near zero, so the post-reset value itself is the increase
+    since the reset (Prometheus's ``increase`` semantics, minus its
+    range extrapolation — we sample on a fixed grid so the window edges
+    are honest)."""
+    if len(points) < 2:
+        return 0.0
+    total = 0.0
+    prev = points[0][1]
+    for _, v in points[1:]:
+        total += (v - prev) if v >= prev else v
+        prev = v
+    return total
+
+
+class QueryEngine:
+    """Evaluates window functions at an instant ``at`` (default: the
+    TSDB's newest scrape time) looking back ``window_s`` seconds."""
+
+    def __init__(self, tsdb: TSDB):
+        self.tsdb = tsdb
+
+    # -- vectors ---------------------------------------------------------------
+    def instant(self, name: str, matchers: dict | None = None,
+                at: float | None = None) -> list[tuple[dict, float]]:
+        """Latest sample per matching series (at or before ``at``)."""
+        at = self.tsdb.now() if at is None else at
+        out = []
+        for labels, ring in self.tsdb.select(name, matchers):
+            v = ring.latest_at(at)
+            if v is not None:
+                out.append((dict(labels), v))
+        return out
+
+    def increase(self, name: str, window_s: float,
+                 matchers: dict | None = None,
+                 at: float | None = None) -> list[tuple[dict, float]]:
+        at = self.tsdb.now() if at is None else at
+        return [(dict(labels), ring.increase(at - window_s, at))
+                for labels, ring in self.tsdb.select(name, matchers)]
+
+    def rate(self, name: str, window_s: float,
+             matchers: dict | None = None,
+             at: float | None = None) -> list[tuple[dict, float]]:
+        return [(lbl, inc / window_s) for lbl, inc
+                in self.increase(name, window_s, matchers, at)]
+
+    def over_time(self, how: str, name: str, window_s: float,
+                  matchers: dict | None = None,
+                  at: float | None = None) -> list[tuple[dict, float]]:
+        if how not in ("avg", "max", "min"):
+            raise ValueError(f"unknown aggregation {how!r}")
+        at = self.tsdb.now() if at is None else at
+        out = []
+        for labels, ring in self.tsdb.select(name, matchers):
+            v = ring.agg(at - window_s, at, how)
+            if v is not None:
+                out.append((dict(labels), v))
+        return out
+
+    # -- histograms ------------------------------------------------------------
+    def bucket_increases(self, name: str, window_s: float,
+                         matchers: dict | None = None,
+                         at: float | None = None) -> dict[tuple,
+                                                          dict[float, float]]:
+        """Per label-set (excluding ``le``) -> {le: increase} over the
+        window, ``le`` parsed to float (inf for +Inf).  The raw material
+        for windowed quantiles and latency-SLO good/bad counts."""
+        at = self.tsdb.now() if at is None else at
+        out: dict[tuple, dict[float, float]] = {}
+        for labels, ring in self.tsdb.select(name + "_bucket", matchers):
+            d = dict(labels)
+            le_raw = d.pop("le", None)
+            if le_raw is None:
+                continue
+            le = float("inf") if le_raw == "+Inf" else float(le_raw)
+            key = tuple(sorted(d.items()))
+            out.setdefault(key, {})[le] = ring.increase(at - window_s, at)
+        return out
+
+    def _bucket_deltas(self, name: str, window_s: float,
+                       matchers: dict | None,
+                       at: float | None) -> list[tuple[tuple, list, list]]:
+        """Per label set: (key, sorted bounds, per-bucket deltas) with a
+        positive total — the one place cumulative buckets become deltas,
+        shared by the quantile value and its exemplar-bucket lookup so
+        the two can never diverge."""
+        out = []
+        for key, les in self.bucket_increases(name, window_s, matchers,
+                                              at).items():
+            bounds = sorted(les)
+            deltas, prev = [], 0.0
+            for le in bounds:
+                deltas.append(max(0.0, les[le] - prev))
+                prev = les[le]
+            if sum(deltas) > 0:
+                out.append((key, bounds, deltas))
+        return out
+
+    def quantile_over_window(self, q: float, name: str, window_s: float,
+                             matchers: dict | None = None,
+                             at: float | None = None
+                             ) -> list[tuple[dict, float]]:
+        """Windowed quantile estimate per label set, interpolated inside
+        the cumulative-bucket deltas exactly like
+        ``Histogram.percentile`` does over all-time counts.  ``q`` in
+        [0, 1].  +Inf clamps to the largest finite bound."""
+        out = []
+        for key, bounds, deltas in self._bucket_deltas(name, window_s,
+                                                       matchers, at):
+            rank = q * sum(deltas)
+            cum, lo, value = 0.0, 0.0, None
+            finite = [b for b in bounds if b != float("inf")]
+            for le, n in zip(bounds, deltas):
+                if cum + n >= rank and n > 0 and le != float("inf"):
+                    value = lo + (le - lo) * (rank - cum) / n
+                    break
+                cum += n
+                if le != float("inf"):
+                    lo = le
+            if value is None:
+                value = finite[-1] if finite else 0.0
+            out.append((dict(key), value))
+        return out
+
+    def quantile_bucket(self, q: float, name: str, window_s: float,
+                        matchers: dict | None = None,
+                        at: float | None = None) -> float | None:
+        """Upper bound of the bucket the q-quantile falls in (max across
+        matching label sets) — the ``min_le`` handle for exemplar
+        lookups: 'show me traces at least as slow as the p99 bucket'."""
+        best = None
+        for _, bounds, deltas in self._bucket_deltas(name, window_s,
+                                                     matchers, at):
+            rank, cum = q * sum(deltas), 0.0
+            for le, n in zip(bounds, deltas):
+                cum += n
+                if n > 0 and cum >= rank:
+                    if best is None or le > best:
+                        best = le
+                    break
+        return best
+
+    def exemplars(self, name: str, matchers: dict | None = None,
+                  min_le: float | None = None,
+                  since: float | None = None) -> list[dict]:
+        return self.tsdb.exemplars(name + "_bucket", matchers, min_le,
+                                   since)
+
+    # -- aggregation -----------------------------------------------------------
+    @staticmethod
+    def sum_by(vector: list[tuple[dict, float]],
+               by: tuple[str, ...] = ()) -> list[tuple[dict, float]]:
+        acc: dict[tuple, float] = {}
+        for labels, v in vector:
+            key = tuple((k, labels.get(k, "")) for k in by)
+            acc[key] = acc.get(key, 0.0) + v
+        return [(dict(k), v) for k, v in sorted(acc.items())]
+
+    # -- string form -----------------------------------------------------------
+    def evaluate(self, query: str, at: float | None = None) -> list[dict]:
+        """Evaluate the string form; returns
+        ``[{"labels": {...}, "value": float}, ...]``.  Raises
+        ``QueryError`` on malformed input (the dashboard maps it to
+        422)."""
+        expr = parse_query(query)
+        vector = expr.run(self, at)
+        return [{"labels": lbl, "value": v} for lbl, v in vector]
+
+
+# -- string-form parser --------------------------------------------------------
+
+class QueryError(ValueError):
+    pass
+
+
+_SELECTOR_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"(?:\[(?P<window>[0-9.]+(?:ms|s|m|h)?)\])?$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"([^"]*)"')
+_FUNCS = ("rate", "increase", "avg_over_time", "max_over_time",
+          "min_over_time", "quantile_over_window")
+
+
+def _parse_window(s: str) -> float:
+    try:
+        for suffix, m in (("ms", 1e-3), ("s", 1.0), ("m", 60.0),
+                          ("h", 3600.0)):
+            if s.endswith(suffix):
+                return float(s[:-len(suffix)]) * m
+        return float(s)
+    except ValueError:
+        # the selector regex admits any [0-9.]+ blob ("1.2.3s"); a typo
+        # must be the route's 422, not a float() traceback -> 500
+        raise QueryError(f"malformed window {s!r}")
+
+
+class _Expr:
+    def __init__(self, func: str | None, name: str, matchers: dict,
+                 window_s: float | None, q: float | None = None,
+                 by: tuple[str, ...] | None = None, inner=None):
+        self.func = func
+        self.name = name
+        self.matchers = matchers
+        self.window_s = window_s
+        self.q = q
+        self.by = by
+        self.inner = inner
+
+    def run(self, engine: QueryEngine, at: float | None):
+        if self.by is not None:
+            return engine.sum_by(self.inner.run(engine, at), self.by)
+        if self.func is None:
+            return engine.instant(self.name, self.matchers, at)
+        if self.window_s is None:
+            raise QueryError(f"{self.func}() needs a [window]")
+        if self.func == "rate":
+            return engine.rate(self.name, self.window_s, self.matchers, at)
+        if self.func == "increase":
+            return engine.increase(self.name, self.window_s,
+                                   self.matchers, at)
+        if self.func == "quantile_over_window":
+            return engine.quantile_over_window(self.q, self.name,
+                                               self.window_s,
+                                               self.matchers, at)
+        return engine.over_time(self.func.split("_", 1)[0], self.name,
+                                self.window_s, self.matchers, at)
+
+
+def _parse_selector(s: str, func: str | None = None,
+                    q: float | None = None) -> _Expr:
+    m = _SELECTOR_RE.match(s.strip())
+    if not m:
+        raise QueryError(f"malformed selector {s!r}")
+    matchers = dict(_LABEL_RE.findall(m.group("labels") or ""))
+    window = m.group("window")
+    return _Expr(func, m.group("name"), matchers,
+                 _parse_window(window) if window else None, q=q)
+
+
+def parse_query(query: str) -> _Expr:
+    """``sum by (a,b) (rate(m{x="y"}[30s]))`` and every smaller shape.
+    Recursive descent over exactly the grammar documented in the module
+    docstring — anything else is a :class:`QueryError`."""
+    s = query.strip()
+    if not s:
+        raise QueryError("empty query")
+    sum_m = re.match(r"^sum\s*(?:by\s*\(([^)]*)\))?\s*\((.*)\)$", s,
+                     re.DOTALL)
+    if sum_m:
+        by = tuple(x.strip() for x in (sum_m.group(1) or "").split(",")
+                   if x.strip())
+        inner = parse_query(sum_m.group(2))
+        return _Expr(None, "", {}, None, by=by, inner=inner)
+    func_m = re.match(r"^([a-z_]+)\s*\((.*)\)$", s, re.DOTALL)
+    if func_m and func_m.group(1) in _FUNCS:
+        func, body = func_m.group(1), func_m.group(2).strip()
+        if func == "quantile_over_window":
+            q_str, _, rest = body.partition(",")
+            try:
+                q = float(q_str)
+            except ValueError:
+                raise QueryError(
+                    f"quantile_over_window: bad quantile {q_str!r}")
+            if not 0.0 <= q <= 1.0:
+                raise QueryError("quantile must be within [0, 1]")
+            return _parse_selector(rest, func, q)
+        return _parse_selector(body, func)
+    if func_m:
+        raise QueryError(f"unknown function {func_m.group(1)!r}")
+    return _parse_selector(s)

@@ -1,0 +1,467 @@
+"""In-memory TSDB + clock-injected scraper (Monarch-style, bounded).
+
+PR 1-14 sprinkled ~80 counters/histograms/gauges across the tree, but
+every consumer read *instantaneous* registry values: no history, no
+rate-over-window, no way to say "p99 TTFT violated its SLO for five
+minutes".  This module closes that gap the way Monarch (VLDB 2020) does
+for Google: metrics live in bounded in-memory ring buffers colocated
+with the process that produced them, sampled on a fixed interval, and
+queried over windows — never shipped to an external store the platform
+would then depend on to know whether the platform is up.
+
+Design points:
+
+- **scrape, don't push.**  The scraper samples each component
+  ``Registry`` through its text exposition format — the same bytes a
+  real Prometheus would pull off ``/metrics`` — so the TSDB can never
+  diverge from what external scrapers see, and a registry gains history
+  without a single instrumentation change (``parse_exposition`` is
+  golden-file-tested against ``Registry.expose`` so the two cannot
+  drift).  Exemplar reservoirs ride alongside: they are not part of the
+  text format, so the scraper pulls them programmatically off the same
+  registry.
+- **bounded memory.**  One ring buffer per series, sized
+  retention/resolution; a series that stops appearing ages out with its
+  ring.  ``obs_tsdb_series`` / ``obs_tsdb_samples`` meter the store
+  itself.
+- **counter resets.**  Samples store RAW cumulative values; reset
+  detection happens at query time (a decrease means the component
+  restarted — the window functions in :mod:`kubeflow_tpu.obs.query`
+  re-base at the reset instead of producing a negative rate).
+- **clock injection.**  The scraper never reads the wall clock; tests
+  and the loadtest drive ``tick()`` with a fake clock and get
+  deterministic window math.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from kubeflow_tpu.utils.metrics import REGISTRY, Histogram, Registry
+
+TSDB_SERIES = REGISTRY.gauge(
+    "obs_tsdb_series", "series currently resident in the obs TSDB")
+TSDB_SAMPLES = REGISTRY.gauge(
+    "obs_tsdb_samples", "samples currently resident across all rings")
+SCRAPES_TOTAL = REGISTRY.counter(
+    "obs_scrapes_total", "scrape ticks performed by the obs scraper")
+SCRAPE_SECONDS = REGISTRY.histogram(
+    "obs_scrape_duration_seconds",
+    "wall seconds per scrape tick (sample + ingest + rule eval)",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25))
+
+
+class Sample:
+    """One parsed exposition sample: flat series name (``foo_bucket`` for
+    histogram buckets), sorted label pairs, raw value, and the TYPE of
+    the family it belongs to."""
+
+    __slots__ = ("name", "labels", "value", "kind")
+
+    def __init__(self, name: str, labels: tuple, value: float, kind: str):
+        self.name = name
+        self.labels = labels
+        self.value = value
+        self.kind = kind
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging sugar
+        return f"Sample({self.name}, {self.labels}, {self.value})"
+
+
+_LABEL_CACHE: dict[str, tuple] = {}
+_LABEL_CACHE_MAX = 4096
+
+
+def _parse_labels(blob: str) -> tuple:
+    """``a="x",b="y"`` -> sorted (("a","x"), ("b","y")).  Values never
+    contain quotes in our exposition (label values come from enum-ish
+    call sites; the kfvet cardinality rule keeps it that way).  Label
+    blobs repeat identically scrape after scrape, so the parse is
+    memoized (bounded — cardinality rules keep the blob set small, but
+    a hostile registry must not grow this without limit)."""
+    hit = _LABEL_CACHE.get(blob)
+    if hit is not None:
+        return hit
+    out = []
+    for part in blob.split(","):
+        if not part:
+            continue
+        name, _, raw = part.partition("=")
+        out.append((name.strip(), raw.strip().strip('"')))
+    key = tuple(sorted(out))
+    if len(_LABEL_CACHE) < _LABEL_CACHE_MAX:
+        _LABEL_CACHE[blob] = key
+    return key
+
+
+def parse_exposition(text: str) -> list[Sample]:
+    """Parse ``Registry.expose()`` output back into samples.
+
+    Total on the format the registry emits (golden-file-tested); unknown
+    or malformed lines are skipped rather than raised — a scraper must
+    survive whatever a component exposes.
+    """
+    samples: list[Sample] = []
+    kinds: dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        if line.startswith("{"):
+            continue
+        name, labels_blob = line, ""
+        brace = line.find("{")
+        value_str = ""
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                continue
+            name = line[:brace]
+            labels_blob = line[brace + 1:close]
+            value_str = line[close + 1:].strip()
+        else:
+            name, _, value_str = line.partition(" ")
+            value_str = value_str.strip()
+        try:
+            value = float(value_str)
+        except ValueError:
+            continue
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in kinds:
+                base = name[:-len(suffix)]
+                break
+        samples.append(Sample(name, _parse_labels(labels_blob), value,
+                              kinds.get(base, "untyped")))
+    return samples
+
+
+class SeriesRing:
+    """One series' bounded history: parallel timestamp/value lists plus
+    a running *cumulative-increase* index.
+
+    ``cum[i]`` is the reset-corrected total increase from the series'
+    first retained sample through sample ``i`` (a decrease between
+    adjacent samples means the producing component restarted, and the
+    post-reset value is the increase since the reset).  With timestamps
+    appended monotonically, every window reduction the rule engine runs
+    per tick becomes two bisects:
+
+        increase(start, end) = cum[last <= end] - cum[first >= start]
+
+    instead of an O(window) scan per series per burn-window — the
+    difference between a scrape tick that prices in microseconds and one
+    that shows up next to TTFT.  Capacity is amortized: the lists grow
+    to 2x the retention point count, then halve (del of a list prefix is
+    O(n), so trimming every append would be quadratic).
+
+    Locking: reads take ``lock`` (the owning TSDB's — shared, so one
+    acquisition covers the bisect AND the index dereference); ``append``
+    does NOT, because the scraper only ever appends while already inside
+    the TSDB lock during ingest.  Without this, a dashboard query thread
+    could bisect, lose the race to a prefix-trim, and index past the
+    just-shrunk list (or pair timestamps with wrong values)."""
+
+    __slots__ = ("kind", "ts", "vs", "cum", "_cap", "_lock")
+
+    def __init__(self, kind: str, points: int, lock=None):
+        self.kind = kind
+        self._cap = points
+        self._lock = lock if lock is not None else threading.Lock()
+        self.ts: list[float] = []
+        self.vs: list[float] = []
+        self.cum: list[float] = []
+
+    def append(self, t: float, v: float) -> int:
+        """Add a sample; returns how many old samples were evicted.
+        Caller must hold ``lock`` (the TSDB's ingest does)."""
+        if self.vs:
+            prev = self.vs[-1]
+            inc = (v - prev) if v >= prev else v
+            self.cum.append(self.cum[-1] + inc)
+        else:
+            self.cum.append(0.0)
+        self.ts.append(t)
+        self.vs.append(v)
+        if len(self.ts) > 2 * self._cap:
+            evicted = len(self.ts) - self._cap
+            del self.ts[:evicted]
+            del self.vs[:evicted]
+            del self.cum[:evicted]
+            return evicted
+        return 0
+
+    def __len__(self) -> int:
+        return len(self.ts)
+
+    def _bounds(self, start: float, end: float) -> tuple[int, int]:
+        """(lo, hi) sample indices with start <= ts <= end; hi exclusive."""
+        import bisect
+
+        lo = bisect.bisect_left(self.ts, start)
+        hi = bisect.bisect_right(self.ts, end)
+        return lo, hi
+
+    def window(self, start: float, end: float) -> list[tuple[float, float]]:
+        """Points with start <= t <= end, oldest first (snapshot)."""
+        with self._lock:
+            lo, hi = self._bounds(start, end)
+            return list(zip(self.ts[lo:hi], self.vs[lo:hi]))
+
+    def increase(self, start: float, end: float) -> float:
+        """Counter increase over the window with reset re-basing (see
+        query.counter_increase for the pairwise semantics this index
+        precomputes)."""
+        with self._lock:
+            lo, hi = self._bounds(start, end)
+            if hi - lo < 2:
+                return 0.0
+            return self.cum[hi - 1] - self.cum[lo]
+
+    def agg(self, start: float, end: float, how: str) -> float | None:
+        with self._lock:
+            lo, hi = self._bounds(start, end)
+            if hi <= lo:
+                return None
+            vals = self.vs[lo:hi]
+        if how == "avg":
+            return sum(vals) / len(vals)
+        return max(vals) if how == "max" else min(vals)
+
+    def latest_at(self, at: float) -> float | None:
+        """Newest value with t <= at."""
+        import bisect
+
+        with self._lock:
+            hi = bisect.bisect_right(self.ts, at)
+            return self.vs[hi - 1] if hi else None
+
+    def latest(self) -> tuple[float, float] | None:
+        with self._lock:
+            return (self.ts[-1], self.vs[-1]) if self.ts else None
+
+
+class TSDB:
+    """Per-series ring buffers keyed by (name, sorted label pairs).
+
+    ``retention_s / resolution_s`` bounds every ring; ingest is one lock
+    acquisition per scrape (the scraper is the only writer, queries only
+    snapshot).  Exemplars live in a sibling bounded map keyed the same
+    way, refreshed whole on each scrape — the reservoirs are already
+    bounded at the histogram, so the TSDB copy is too.
+    """
+
+    def __init__(self, *, retention_s: float = 900.0,
+                 resolution_s: float = 1.0):
+        self.retention_s = float(retention_s)
+        self.resolution_s = max(1e-6, float(resolution_s))
+        self._points = max(2, int(self.retention_s / self.resolution_s) + 1)
+        self._series: dict[tuple, SeriesRing] = {}
+        # name -> [(labels, ring), ...]: selection never scans the whole
+        # store (rule evaluation selects dozens of times per tick)
+        self._by_name: dict[str, list] = {}
+        self._exemplars: dict[tuple, dict] = {}
+        self._samples = 0
+        self._lock = threading.Lock()
+        self._last_scrape_t: float | None = None
+
+    # -- ingest ----------------------------------------------------------------
+    def ingest(self, t: float, samples: Iterable[Sample]) -> None:
+        with self._lock:
+            for s in samples:
+                key = (s.name, s.labels)
+                ring = self._series.get(key)
+                if ring is None:
+                    ring = self._series[key] = SeriesRing(
+                        s.kind, self._points, lock=self._lock)
+                    self._by_name.setdefault(s.name, []).append(
+                        (s.labels, ring))
+                self._samples += 1 - ring.append(t, s.value)
+            self._last_scrape_t = t
+            TSDB_SERIES.set(len(self._series))
+            TSDB_SAMPLES.set(self._samples)
+
+    def ingest_exemplars(self, name: str, labels: tuple,
+                         exemplars: dict, t: float | None = None) -> None:
+        """Replace the exemplar snapshot for one histogram series
+        (``{le: [{"value","ref","seq"}...]}`` as Histogram.exemplars
+        returns).  Each entry is stamped with the scrape time it FIRST
+        appeared at (reservoirs carry no clock of their own), so tail
+        queries can refuse exemplars older than their window — a storm
+        from hours ago must not answer for the last five minutes."""
+        key = (name, tuple(sorted(labels)))
+        with self._lock:
+            prev = self._exemplars.get(key) or {}
+            seen = {e["seq"]: e.get("t")
+                    for res in prev.values() for e in res}
+            self._exemplars[key] = {
+                le: [{**e, "t": seen.get(e["seq"], t)} for e in res]
+                for le, res in exemplars.items()}
+
+    # -- reads -----------------------------------------------------------------
+    def now(self) -> float:
+        """Timestamp of the newest scrape (queries default their
+        evaluation instant to this, so 'latest' never depends on a wall
+        clock the TSDB was not fed)."""
+        with self._lock:
+            return self._last_scrape_t if self._last_scrape_t is not None \
+                else 0.0
+
+    def series_names(self) -> list[str]:
+        with self._lock:
+            return sorted({name for name, _ in self._series})
+
+    def select(self, name: str,
+               matchers: dict | None = None) -> list[tuple[tuple,
+                                                           SeriesRing]]:
+        """Series of ``name`` whose labels satisfy every equality
+        matcher.  Returns (label pairs, ring) — rings are append-only by
+        the single scraper thread, and deque iteration is snapshotted by
+        the callers that window them."""
+        with self._lock:
+            items = list(self._by_name.get(name, ()))
+        if not matchers:
+            return items
+        want = tuple(matchers.items())
+        out = []
+        for labels, ring in items:
+            d = dict(labels)
+            if all(d.get(k) == v for k, v in want):
+                out.append((labels, ring))
+        return out
+
+    def exemplars(self, name: str,
+                  matchers: dict | None = None,
+                  min_le: float | None = None,
+                  since: float | None = None) -> list[dict]:
+        """Exemplars for histogram ``name`` across matching label sets,
+        optionally restricted to buckets with upper bound >= ``min_le``
+        (tail queries: exemplars from the quantile's bucket upward) and
+        to entries first scraped at or after ``since`` (windowed
+        queries must not hand back a long-gone storm's trace ids).
+        Newest-last within each bucket."""
+        want = tuple(sorted((matchers or {}).items()))
+        out: list[dict] = []
+        with self._lock:
+            items = [(k, dict(v)) for k, v in self._exemplars.items()
+                     if k[0] == name]
+        for (_, labels), per_bucket in items:
+            d = dict(labels)
+            if not all(d.get(k) == v for k, v in want):
+                continue
+            for le, res in sorted(per_bucket.items()):
+                if min_le is not None and le < min_le:
+                    continue
+                # the exposition spelling, not float('inf'): these
+                # entries go straight into JSON responses, and
+                # json.dumps would emit a bare `Infinity` no strict
+                # parser (browser JSON.parse, jq) accepts
+                le_out = "+Inf" if le == float("inf") else le
+                for ex in res:
+                    if since is not None and (ex.get("t") is None
+                                              or ex["t"] < since):
+                        continue
+                    out.append({**ex, "le": le_out, "labels": d})
+        out.sort(key=lambda e: e["seq"])
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "series": len(self._series),
+                "samples": self._samples,
+                "retention_s": self.retention_s,
+                "resolution_s": self.resolution_s,
+                "last_scrape_t": self._last_scrape_t,
+                "exemplar_series": len(self._exemplars),
+            }
+
+
+class Scraper:
+    """Samples registries into the TSDB and evaluates rules, one tick at
+    a time.  ``clock`` is injected (tests/loadtests drive fake time);
+    ``start()`` runs ticks on a daemon thread for the single-binary
+    platform, waiting on an Event so stop() is immediate and kfvet's
+    no-sleep rule holds."""
+
+    def __init__(self, tsdb: TSDB, *,
+                 registries: list[tuple[str, Registry]] | None = None,
+                 rule_engine=None,
+                 clock: Callable[[], float] = None,
+                 interval_s: float = 5.0):
+        import time as _time
+
+        self.tsdb = tsdb
+        self.registries = registries or [("platform", REGISTRY)]
+        self.rule_engine = rule_engine
+        self.clock = clock if clock is not None else _time.monotonic
+        self.interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def tick(self, at: float | None = None) -> list:
+        """One scrape + rule evaluation; returns the rule transitions
+        this tick produced (the loadtest asserts on them)."""
+        import time as _time
+
+        t = at if at is not None else self.clock()
+        started = _time.perf_counter()
+        for job, registry in self.registries:
+            samples = parse_exposition(registry.expose())
+            if job:
+                for s in samples:
+                    s.labels = tuple(sorted(s.labels + (("job", job),)))
+            self.tsdb.ingest(t, samples)
+            for kind, metric in registry.metrics():
+                if kind != "histogram" or not isinstance(metric, Histogram):
+                    continue
+                with metric._lock:
+                    keys = list(metric._data)
+                for key in keys:
+                    ex = metric.exemplars(*key)
+                    if not ex:
+                        continue
+                    labels = tuple(zip(metric.label_names, key))
+                    if job:
+                        labels = labels + (("job", job),)
+                    self.tsdb.ingest_exemplars(metric.name + "_bucket",
+                                               labels, ex, t=t)
+        transitions = []
+        if self.rule_engine is not None:
+            transitions = self.rule_engine.evaluate(t)
+        SCRAPES_TOTAL.inc()
+        SCRAPE_SECONDS.observe(_time.perf_counter() - started)
+        return transitions
+
+    # -- background mode -------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="obs-scraper")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - last-resort guard
+                # a broken registry must not kill the observability
+                # loop; the miss shows up as a gap in every series
+                from kubeflow_tpu.utils.logging import get_logger
+
+                get_logger("obs").exception("scrape tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

@@ -50,6 +50,16 @@ def build_platform(executor: str = "fake", *, extra_env: dict | None = None,
 
     versions.register(server)  # v1beta1 -> v1 storage conversion
 
+    # telemetry pipeline: the in-memory TSDB scrapes the process
+    # registry on a fixed interval and evaluates the default SLO rules.
+    # Attached here, but the background thread starts only in main()
+    # (KF_OBS_SCRAPE_INTERVAL seconds; 0 disables) — embedders and
+    # tests own no handle that could stop a thread started here, so
+    # they get a pipeline they tick deterministically instead
+    from kubeflow_tpu import obs
+
+    obs.attach(server)
+
     identity = identity or f"{socket.gethostname()}-{os.getpid()}"
     mgr = Manager(server, leader_election=leader_election, identity=identity)
     # JAXJob stays single-worker: gang release reads the free-slice count
@@ -293,6 +303,8 @@ def main(argv=None) -> int:
         except Conflict:
             pass  # recovered from the data dir on a previous boot
     mgr.start()
+    if getattr(server, "obs", None) is not None and server.obs.autostart:
+        server.obs.start()
     tokens = None
     if args.token_file:
         from kubeflow_tpu.utils.tlsutil import load_token_file
@@ -330,6 +342,8 @@ def main(argv=None) -> int:
     finally:
         httpd.shutdown()
         mgr.stop()
+        if getattr(server, "obs", None) is not None:
+            server.obs.stop()
         log.info("platform stopped")
     return 0
 

@@ -1153,8 +1153,13 @@ class ContinuousBatcher:
             return
         tok_host = int(tok)
         req.first_token_at = time.perf_counter()
-        TTFT_LAST.set(req.first_token_at - req.submitted_at)
-        TTFT_HIST.observe(req.first_token_at - req.submitted_at)
+        ttft = req.first_token_at - req.submitted_at
+        TTFT_LAST.set(ttft)
+        # a sampled request's trace id rides the bucket as an exemplar:
+        # the obs TSDB's tail queries resolve a burning TTFT alert to
+        # the concrete slow traces in the collector
+        TTFT_HIST.observe(
+            ttft, exemplar=req.span.trace_id if req.span else None)
         req.generated.append(tok_host)
         TOKENS_TOTAL.inc()
         self._seat(free, req, scratch, k_chain)
@@ -1181,8 +1186,10 @@ class ContinuousBatcher:
             return
         tok_host = int(tok)
         req.first_token_at = time.perf_counter()
-        TTFT_LAST.set(req.first_token_at - req.submitted_at)
-        TTFT_HIST.observe(req.first_token_at - req.submitted_at)
+        ttft = req.first_token_at - req.submitted_at
+        TTFT_LAST.set(ttft)
+        TTFT_HIST.observe(
+            ttft, exemplar=req.span.trace_id if req.span else None)
         req.generated.append(tok_host)
         TOKENS_TOTAL.inc()
         outcome = self._dead_outcome(req)

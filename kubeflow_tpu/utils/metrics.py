@@ -38,6 +38,15 @@ class _Metric:
     def get(self, *label_values: str) -> float:
         return self._values.get(tuple(str(v) for v in label_values), 0.0)
 
+    def remove(self, *label_values: str) -> None:
+        """Drop one label set's series entirely.  For per-object gauges
+        (one series per cluster node): when the object is deleted its
+        series must go with it — a leftover value is indistinguishable
+        from a live, healthy reading, and every scraper would retain it
+        forever."""
+        with self._lock:
+            self._values.pop(tuple(str(v) for v in label_values), None)
+
     def total(self) -> float:
         """Sum over every label combination (sum-without-by semantics)."""
         with self._lock:
@@ -75,10 +84,19 @@ class Counter(_Metric):
 
 
 class Histogram(_Metric):
-    """Prometheus histogram: cumulative le buckets + _sum/_count series."""
+    """Prometheus histogram: cumulative le buckets + _sum/_count series.
+
+    ``observe`` optionally attaches an *exemplar* — an opaque reference
+    (a trace id) to one concrete request that landed in that bucket.  A
+    bounded per-bucket reservoir keeps the most recent
+    ``EXEMPLARS_PER_BUCKET``, so a tail-latency query (the obs TSDB's
+    ``quantile_over_window``) can hand back clickable trace ids for the
+    slow bucket without the histogram ever growing with traffic.
+    """
 
     DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                        0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+    EXEMPLARS_PER_BUCKET = 4
 
     def __init__(self, name: str, help_text: str,
                  label_names: Iterable[str] = (),
@@ -87,6 +105,9 @@ class Histogram(_Metric):
         self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
         # label key -> [per-bucket counts..., +Inf count, sum]
         self._data: dict[tuple, list[float]] = {}
+        # (label key, bucket index) -> newest-last [(value, exemplar, seq)]
+        self._exemplars: dict[tuple, list] = {}
+        self._exemplar_seq = 0
 
     def labels(self, *label_values: str) -> "_HistogramHandle":
         if len(label_values) != len(self.label_names):
@@ -95,29 +116,67 @@ class Histogram(_Metric):
                 f"got {len(label_values)}")
         return _HistogramHandle(self, tuple(str(v) for v in label_values))
 
-    def observe(self, value: float) -> None:
-        self._observe((), value)
+    def observe(self, value: float, exemplar: str | None = None) -> None:
+        self._observe((), value, exemplar)
 
-    def _observe(self, key: tuple, value: float) -> None:
+    def _bucket_index(self, value: float) -> int:
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                return i
+        return len(self.buckets)  # +Inf
+
+    def _observe(self, key: tuple, value: float,
+                 exemplar: str | None = None) -> None:
         with self._lock:
             row = self._data.get(key)
             if row is None:
                 row = self._data[key] = [0.0] * (len(self.buckets) + 2)
-            for i, bound in enumerate(self.buckets):
-                if value <= bound:
-                    row[i] += 1
-                    break
-            else:
-                row[len(self.buckets)] += 1  # +Inf only
+            idx = self._bucket_index(value)
+            row[idx] += 1
             row[-1] += value
+            if exemplar:
+                self._exemplar_seq += 1
+                res = self._exemplars.setdefault((key, idx), [])
+                res.append((value, str(exemplar), self._exemplar_seq))
+                if len(res) > self.EXEMPLARS_PER_BUCKET:
+                    del res[0]
+
+    def exemplars(self, *label_values: str) -> dict:
+        """Per-bucket exemplar reservoirs for one label set:
+        ``{le: [{"value", "ref", "seq"}, ...]}`` with ``le`` the bucket's
+        upper bound (``float('inf')`` for the overflow bucket), newest
+        last.  A snapshot — safe to use without the lock."""
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            items = [(idx, list(res)) for (k, idx), res
+                     in self._exemplars.items() if k == key]
+        bounds = self.buckets + (float("inf"),)
+        return {bounds[idx]: [{"value": v, "ref": ref, "seq": seq}
+                              for v, ref, seq in res]
+                for idx, res in sorted(items)}
+
+    def remove(self, *label_values: str) -> None:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._data.pop(key, None)
+            for k in [k for k in self._exemplars if k[0] == key]:
+                del self._exemplars[k]
 
     def count(self, *label_values: str) -> float:
-        row = self._data.get(tuple(str(v) for v in label_values))
-        return sum(row[:-1]) if row else 0.0
+        with self._lock:
+            row = self._data.get(tuple(str(v) for v in label_values))
+            return sum(row[:-1]) if row else 0.0
 
     def sum(self, *label_values: str) -> float:
-        row = self._data.get(tuple(str(v) for v in label_values))
-        return row[-1] if row else 0.0
+        with self._lock:
+            row = self._data.get(tuple(str(v) for v in label_values))
+            return row[-1] if row else 0.0
+
+    def get(self, *label_values: str) -> float:
+        """Observation count for the label set (a histogram's scalar
+        reading; before this existed the inherited ``get`` silently
+        returned 0.0 from the unused ``_values`` table)."""
+        return self.count(*label_values)
 
     def percentile(self, q: float, *label_values: str) -> float:
         """Prometheus ``histogram_quantile``-style estimate: linear
@@ -167,11 +226,21 @@ class _HistogramHandle:
         self._metric = metric
         self._key = key
 
-    def observe(self, value: float) -> None:
-        self._metric._observe(self._key, value)
+    def observe(self, value: float, exemplar: str | None = None) -> None:
+        self._metric._observe(self._key, value, exemplar)
+
+    def exemplars(self) -> dict:
+        return self._metric.exemplars(*self._key)
 
 
 class Gauge(_Metric):
+    # a function-backed gauge refreshes on EVERY read path — get(),
+    # total(), expose() — not just exposition: the dashboard and the
+    # loadtests read gauges programmatically, and a value that only
+    # moves when somebody scrapes /metrics is a stale lie everywhere
+    # else (the set_function staleness bug)
+    _collect_fn: Callable[[], float] | None = None
+
     def set(self, value: float) -> None:
         self._set((), value)
 
@@ -180,6 +249,23 @@ class Gauge(_Metric):
 
     def set_function(self, fn: Callable[[], float]) -> None:
         self._collect_fn = fn
+
+    def _refresh(self) -> None:
+        fn = self._collect_fn
+        if fn is not None:
+            self._set((), float(fn()))
+
+    def get(self, *label_values: str) -> float:
+        self._refresh()
+        return super().get(*label_values)
+
+    def total(self) -> float:
+        self._refresh()
+        return super().total()
+
+    def expose(self, kind: str) -> str:
+        self._refresh()
+        return super().expose(kind)
 
 
 class Registry:
@@ -220,16 +306,18 @@ class Registry:
             entry = self._metrics.get(name)
         return entry[1] if entry else None
 
-    def expose(self) -> str:
+    def metrics(self) -> list[tuple[str, _Metric]]:
+        """Registered ``(kind, metric)`` pairs, name-sorted — the obs
+        scraper walks this to pull exemplar reservoirs alongside the text
+        samples it parses from ``expose()``."""
         with self._lock:
-            items = sorted(self._metrics.items())
-        chunks = []
-        for _, (kind, metric) in items:
-            gauge_fn = getattr(metric, "_collect_fn", None)
-            if gauge_fn is not None:
-                metric._set((), float(gauge_fn()))
-            chunks.append(metric.expose(kind))
-        return "\n".join(chunks) + "\n"
+            return [(kind, metric) for _, (kind, metric)
+                    in sorted(self._metrics.items())]
+
+    def expose(self) -> str:
+        # function-backed gauges refresh inside Gauge.expose
+        return "\n".join(metric.expose(kind)
+                         for kind, metric in self.metrics()) + "\n"
 
 
 REGISTRY = Registry()

@@ -242,6 +242,65 @@ Y = val("ghost_total")
     assert tree.run() == []
 
 
+def test_metric_unknown_ref_outside_dashboard_and_rule_kwargs(tree):
+    # get_metric anywhere + SLO rule kwargs are cross-checked too — an
+    # alert on an unregistered series can never fire
+    tree("kubeflow_tpu/core/a.py", """\
+A = REGISTRY.counter("exists_total", "registered")
+B = REGISTRY.get_metric("gone_total")
+""")
+    tree("loadtest/load_x.py", """\
+SLO(name="x", metric="exists_total")
+SLO(name="y", bad_metric="phantom_total", total_metric="exists_total")
+""")
+    found = tree.run()
+    assert rules_of(found) == ["metric-unknown-ref", "metric-unknown-ref"]
+    assert {(f.path.split("/")[-1], f.line) for f in found} == {
+        ("a.py", 2), ("load_x.py", 2)}
+    # bare val() outside the dashboard package is NOT a metric ref
+    tree("kubeflow_tpu/core/b.py", """\
+def val(name):
+    return 0
+
+Y = val("not-a-metric")
+""")
+    assert rules_of(tree.run()) == ["metric-unknown-ref",
+                                    "metric-unknown-ref"]
+
+
+def test_metric_label_cardinality_fires_on_derived_values(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+C.labels(f"pod-{name}").inc()
+C.labels(req.name).inc()
+C.labels(pod["metadata"]["name"]).inc()
+C.labels(path, "200").inc()
+C.labels("a" + suffix).inc()
+C.labels(str(obj.name)).inc()
+""")
+    found = tree.run()
+    assert rules_of(found) == ["metric-label-cardinality"] * 6
+    assert [f.line for f in found] == [1, 2, 3, 4, 5, 6]
+    assert "f-string" in found[0].message
+    assert "metadata" in found[2].message
+
+
+def test_metric_label_cardinality_clean_on_closed_sets(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+C.labels("ok").inc()
+C.labels(outcome).inc()
+C.labels(kind, "expired").inc()
+C.labels(self._metrics_label).set(3)
+""")
+    assert tree.run() == []
+
+
+def test_metric_label_cardinality_suppressible(tree):
+    tree("kubeflow_tpu/core/m.py", """\
+G.labels(req.name).set(age)  # kfvet: ignore[metric-label-cardinality]
+""")
+    assert tree.run() == []
+
+
 # -- pass 4: thread lifecycle --------------------------------------------------
 
 def test_thread_join_fires_without_daemon_or_join(tree):

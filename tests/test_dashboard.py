@@ -433,3 +433,101 @@ def test_nodes_route_surfaces_per_gang_elastic_state(stack):
     server.create(jj.new("rigid", "team-a", topology="v5e-8"))
     _, health = req(base, "/dashboard/api/nodes", user="alice@corp.com")
     assert all(g["name"] != "rigid" for g in health["elastic_gangs"])
+
+
+def test_alerts_route_unattached_then_firing(stack):
+    """SLO card backend (ISSUE 15): without a pipeline the route says so;
+    with one attached it reports rule standing, the firing list, and the
+    transition log off the process pipeline."""
+    from kubeflow_tpu import obs
+
+    server, mgr, base = stack
+    code, state = req(base, "/dashboard/api/alerts", user="alice@corp.com")
+    assert code == 200
+    assert state["attached"] is False
+
+    pipeline = obs.attach(server, interval_s=1.0, start=False,
+                          slos=[obs.SLO(
+                              name="probe", kind="gauge",
+                              metric="serving_queue_depth",
+                              threshold=5.0, for_s=0.0)])
+    try:
+        from kubeflow_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.get_metric("serving_queue_depth") or \
+            REGISTRY.gauge("serving_queue_depth", "x")
+        depth = REGISTRY.get_metric("serving_queue_depth")
+        depth.set(0.0)
+        pipeline.tick(at=1.0)
+        code, state = req(base, "/dashboard/api/alerts",
+                          user="alice@corp.com")
+        assert code == 200 and state["attached"] is True
+        assert state["firing"] == []
+        (rule,) = state["alerts"]
+        assert rule["alert"] == "probe" and rule["state"] == "inactive"
+
+        depth.set(9.0)
+        pipeline.tick(at=2.0)   # pending
+        pipeline.tick(at=3.0)   # firing (for_s=0)
+        code, state = req(base, "/dashboard/api/alerts",
+                          user="alice@corp.com")
+        assert state["firing"] == ["probe"]
+        assert [e["to"] for e in state["log"]] == ["pending", "firing"]
+        assert state["scrape"]["ticks"] >= 3
+    finally:
+        depth.set(0.0)
+        obs.set_pipeline(None)
+        server.obs = None
+
+
+def test_query_route_promql_lite_with_exemplars(stack):
+    """/dashboard/api/query evaluates PromQL-lite against the TSDB; a
+    quantile query with &exemplars=1 returns trace ids from the tail
+    buckets; malformed queries are 422."""
+    import urllib.error
+
+    from kubeflow_tpu import obs
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    server, mgr, base = stack
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "/dashboard/api/query?q=up", user="alice@corp.com")
+    assert e.value.code == 503      # no pipeline attached
+
+    pipeline = obs.attach(server, interval_s=1.0, start=False, slos=[])
+    try:
+        hist = (REGISTRY.get_metric("dash_query_seconds")
+                or REGISTRY.histogram("dash_query_seconds", "x",
+                                      buckets=(0.1, 1.0)))
+        hist.observe(0.03)              # baseline sample for the deltas
+        pipeline.tick(at=1.0)
+        hist.observe(0.05, exemplar="t-fast")
+        hist.observe(7.0, exemplar="t-slow")
+        pipeline.tick(at=2.0)
+
+        code, out = req(
+            base,
+            "/dashboard/api/query?q=increase(dash_query_seconds_count"
+            "%5B2s%5D)",
+            user="alice@corp.com")
+        assert code == 200
+        assert out["result"] == [{"labels": {"job": "platform"},
+                                  "value": 2.0}]
+
+        code, out = req(
+            base,
+            "/dashboard/api/query?q=quantile_over_window(0.99,"
+            "dash_query_seconds%5B2s%5D)&exemplars=1",
+            user="alice@corp.com")
+        assert code == 200
+        assert out["result"][0]["value"] > 0.1
+        assert "t-slow" in [e["ref"] for e in out["exemplars"]]
+        assert "t-fast" not in [e["ref"] for e in out["exemplars"]]
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(base, "/dashboard/api/query?q=rate(no_window)",
+                user="alice@corp.com")
+        assert e.value.code == 422
+    finally:
+        obs.set_pipeline(None)
+        server.obs = None

@@ -1,0 +1,411 @@
+"""Telemetry pipeline: TSDB, PromQL-lite queries, burn-rate SLO alerts.
+
+Everything here drives the scraper with a FAKE clock — windows are
+deterministic tick counts, never wall time.  The loadtest
+(loadtest/load_obs.py) covers the same pipeline against a real serving
+engine under storm; these are the window-math and lifecycle contracts.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.obs.query import QueryError, counter_increase
+from kubeflow_tpu.obs.rules import FIRING, INACTIVE, PENDING
+from kubeflow_tpu.utils.metrics import Registry
+
+
+class Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def make_stack(slos=None, *, interval=1.0, retention=300.0):
+    """(registry, clock, tsdb, scraper, rules, query) wired together."""
+    reg = Registry()
+    clock = Clock()
+    tsdb = obs.TSDB(retention_s=retention, resolution_s=interval)
+    rules = obs.RuleEngine(tsdb, slos or [])
+    scraper = obs.Scraper(tsdb, registries=[("", reg)], rule_engine=rules,
+                          clock=clock, interval_s=interval)
+    return reg, clock, tsdb, scraper, rules, obs.QueryEngine(tsdb)
+
+
+def tick(clock, scraper, n=1, dt=1.0):
+    out = []
+    for _ in range(n):
+        clock.advance(dt)
+        out.extend(scraper.tick())
+    return out
+
+
+# -- TSDB + scraper ------------------------------------------------------------
+
+def test_scraper_builds_history_per_series():
+    reg, clock, tsdb, scraper, _, q = make_stack()
+    c = reg.counter("req_total", "x", labels=("outcome",))
+    for i in range(5):
+        c.labels("ok").inc(10)
+        tick(clock, scraper)
+    assert q.instant("req_total", {"outcome": "ok"}) == [
+        ({"outcome": "ok"}, 50.0)]
+    # history, not just the latest value
+    (labels, ring), = tsdb.select("req_total", {"outcome": "ok"})
+    assert [v for _, v in ring.window(0, 99)] == [10.0, 20.0, 30.0,
+                                                  40.0, 50.0]
+
+
+def test_tsdb_rings_bounded_by_retention():
+    # rings trim amortized: up to 2x the retention point count, never
+    # more (list-prefix deletes are O(n), so trim-every-append would
+    # make ingest quadratic)
+    reg, clock, tsdb, scraper, _, _ = make_stack(retention=10.0)
+    reg.gauge("depth", "x").set(1)
+    tick(clock, scraper, n=100)
+    stats = tsdb.stats()
+    assert stats["samples"] <= 2 * 11 * stats["series"]
+    (_, ring), = tsdb.select("depth")
+    assert len(ring) <= 22
+    # the window after eviction still answers correctly
+    assert ring.latest_at(100.0) == 1.0
+    assert ring.agg(95, 100, "avg") == 1.0
+
+
+def test_counter_reset_detection_rebases():
+    # cumulative 10, 20, 5 (restart!), 15 -> increase = 10 + 5 + 10
+    assert counter_increase([(0, 10.0), (1, 20.0), (2, 5.0),
+                             (3, 15.0)]) == 25.0
+    reg, clock, _, scraper, _, q = make_stack()
+    c = reg.counter("boots_total", "x")
+    c.inc(20)
+    tick(clock, scraper)
+    c.inc(10)
+    tick(clock, scraper)
+    # component restart: fresh registry value near zero
+    c._values.clear()
+    c.inc(3)
+    tick(clock, scraper)
+    ((_, inc),) = q.increase("boots_total", 10)
+    assert inc == 13.0          # 10 before the reset + 3 after
+    ((_, rate),) = q.rate("boots_total", 10)
+    assert rate == pytest.approx(1.3)
+
+
+def test_gauge_window_functions():
+    reg, clock, _, scraper, _, q = make_stack()
+    g = reg.gauge("depth", "x")
+    for v in (1.0, 5.0, 3.0):
+        g.set(v)
+        tick(clock, scraper)
+    assert q.over_time("avg", "depth", 10) == [({}, 3.0)]
+    assert q.over_time("max", "depth", 10) == [({}, 5.0)]
+    assert q.over_time("min", "depth", 10) == [({}, 1.0)]
+    # windows clip: only the newest sample
+    assert q.over_time("avg", "depth", 0.5) == [({}, 3.0)]
+
+
+def test_quantile_over_window_sees_only_the_window():
+    reg, clock, _, scraper, _, q = make_stack()
+    h = reg.histogram("lat_seconds", "x", buckets=(0.1, 0.25, 1.0))
+    tick(clock, scraper)                # baseline scrape
+    for _ in range(10):
+        for _ in range(5):
+            h.observe(0.05)
+        tick(clock, scraper)
+    # all-time quantile says fast; then the last 3 ticks turn slow
+    for _ in range(3):
+        for _ in range(10):
+            h.observe(0.9)
+        tick(clock, scraper)
+    ((_, p99_window),) = q.quantile_over_window(0.99, "lat_seconds", 3)
+    assert p99_window > 0.25        # the window is all slow
+    ((_, p50_all),) = q.quantile_over_window(0.5, "lat_seconds", 1000)
+    assert p50_all < 0.1            # all-time still dominated by fast
+    assert q.quantile_bucket(0.99, "lat_seconds", 3) == 1.0
+
+
+def test_string_queries_and_errors():
+    reg, clock, _, scraper, _, q = make_stack()
+    c = reg.counter("req_total", "x", labels=("outcome",))
+    c.labels("ok").inc(8)
+    c.labels("shed").inc(2)
+    tick(clock, scraper)                # baseline scrape at t=1
+    assert q.evaluate('req_total{outcome="ok"}') == [
+        {"labels": {"outcome": "ok"}, "value": 8.0}]
+    c.labels("ok").inc(8)
+    c.labels("shed").inc(2)
+    tick(clock, scraper)                # t=2: the window's delta
+    total = q.evaluate('sum(increase(req_total[2s]))')
+    assert total == [{"labels": {}, "value": 10.0}]
+    by = q.evaluate('sum by (outcome) (increase(req_total[2s]))')
+    assert {r["labels"]["outcome"]: r["value"] for r in by} == {
+        "ok": 8.0, "shed": 2.0}
+    for bad in ("", "rate(req_total)", "nope(req_total[1s])",
+                "quantile_over_window(2.0, x[1s])", "sum by ((x)",
+                "rate(req_total[1.2.3s])"):
+        with pytest.raises(QueryError):
+            q.evaluate(bad)
+
+
+def test_exemplars_flow_from_histogram_through_tsdb():
+    reg, clock, _, scraper, _, q = make_stack()
+    h = reg.histogram("lat_seconds", "x", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="fast-trace")
+    h.observe(4.0, exemplar="slow-trace")
+    tick(clock, scraper)
+    tail = q.exemplars("lat_seconds", min_le=1.0)
+    assert [e["ref"] for e in tail] == ["slow-trace"]
+    # overflow-bucket exemplars spell le as "+Inf" (these dicts go into
+    # JSON responses; float('inf') would serialize as bare Infinity)
+    assert tail[0]["le"] == "+Inf"
+    import json
+
+    json.loads(json.dumps(tail, allow_nan=False))
+    everything = q.exemplars("lat_seconds")
+    assert {e["ref"] for e in everything} == {"fast-trace", "slow-trace"}
+
+
+def test_exemplars_window_filtered_by_first_seen_scrape():
+    # a storm's trace ids must not answer a windowed tail query long
+    # after the storm: entries are stamped with the scrape they FIRST
+    # appeared at, and `since` drops the stale ones
+    reg, clock, _, scraper, _, q = make_stack()
+    h = reg.histogram("lat_seconds", "x", buckets=(0.1, 1.0))
+    h.observe(4.0, exemplar="old-storm")
+    tick(clock, scraper)                        # first seen at t=1
+    tick(clock, scraper, n=10)                  # quiet ticks to t=11
+    h.observe(5.0, exemplar="fresh-tail")
+    tick(clock, scraper)                        # first seen at t=12
+    refs = [e["ref"] for e in q.exemplars("lat_seconds", min_le=1.0,
+                                          since=10.0)]
+    assert refs == ["fresh-tail"]
+    # without `since` both remain (the reservoir still holds them)
+    assert {e["ref"] for e in q.exemplars("lat_seconds", min_le=1.0)} \
+        == {"old-storm", "fresh-tail"}
+
+
+def test_metric_remove_drops_series_and_exemplars():
+    reg, clock, _, scraper, _, q = make_stack()
+    g = reg.gauge("node_age", "x", labels=("node",))
+    g.labels("n1").set(3.0)
+    g.labels("n2").set(4.0)
+    h = reg.histogram("lat_seconds", "x", labels=("op",),
+                      buckets=(0.1, 1.0))
+    h.labels("read").observe(5.0, exemplar="t1")
+    g.remove("n1")
+    h.remove("read")
+    tick(clock, scraper)
+    assert q.instant("node_age") == [({"node": "n2"}, 4.0)]
+    assert h.exemplars("read") == {}
+    assert 'node="n1"' not in reg.expose()
+
+
+# -- SLO rules -----------------------------------------------------------------
+
+def burn_slo(**kw):
+    defaults = dict(
+        name="lat-slo", kind="latency", objective=0.9,
+        metric="lat_seconds", threshold_s=0.25,
+        windows=[obs.BurnWindow(long_s=8, short_s=2, factor=2.0)])
+    defaults.update(kw)
+    return obs.SLO(**defaults)
+
+
+def test_latency_burn_rate_fires_and_resolves():
+    slo = burn_slo()
+    reg, clock, _, scraper, rules, _ = make_stack([slo])
+    h = reg.histogram("lat_seconds", "x", buckets=(0.1, 0.25, 1.0))
+
+    # steady phase: all fast -> never leaves inactive
+    for _ in range(10):
+        for _ in range(20):
+            h.observe(0.05)
+        assert tick(clock, scraper) == []
+    assert rules.active()[0]["state"] == INACTIVE
+
+    # storm: everything blows the threshold; both windows exceed
+    # factor * error budget quickly
+    transitions = []
+    for _ in range(10):
+        for _ in range(20):
+            h.observe(0.9)
+        transitions += tick(clock, scraper)
+    assert [t["to"] for t in transitions] == [FIRING]
+    assert rules.firing() == ["lat-slo"]
+    # the firing gauge is the loadtest's (and dashboards') signal
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    assert REGISTRY.get_metric("obs_alerts_firing").get("lat-slo") == 1.0
+
+    # recovery: fast again; the alert resolves once the SHORT window
+    # clears even while the long window still remembers the storm
+    transitions = []
+    for _ in range(10):
+        for _ in range(20):
+            h.observe(0.05)
+        transitions += tick(clock, scraper)
+    assert [t["to"] for t in transitions] == [INACTIVE]
+    assert rules.firing() == []
+    log = rules.log()
+    assert [e["to"] for e in log] == [FIRING, INACTIVE]
+
+
+def test_short_window_guards_against_blips():
+    # one bad tick inside an otherwise-clean long window must not page:
+    # the long window's bad fraction stays under factor * budget
+    slo = burn_slo(windows=[obs.BurnWindow(long_s=8, short_s=2,
+                                           factor=6.0)])
+    reg, clock, _, scraper, rules, _ = make_stack([slo])
+    h = reg.histogram("lat_seconds", "x", buckets=(0.1, 0.25, 1.0))
+    transitions = []
+    for i in range(16):
+        for _ in range(20):
+            h.observe(0.9 if i == 8 else 0.05)
+        transitions += tick(clock, scraper)
+    assert transitions == []
+
+
+def test_latency_threshold_below_lowest_bucket_is_no_data():
+    # a threshold the buckets cannot express must evaluate as no-data,
+    # never silently snap UP and count above-threshold observations as
+    # good (the alert would then never fire for the stated objective)
+    slo = burn_slo(threshold_s=0.001)   # buckets start at 0.1
+    reg, clock, _, scraper, rules, _ = make_stack([slo])
+    h = reg.histogram("lat_seconds", "x", buckets=(0.1, 0.25, 1.0))
+    for _ in range(8):
+        for _ in range(20):
+            h.observe(0.9)
+        assert tick(clock, scraper) == []
+    assert rules.active()[0]["state"] == INACTIVE
+
+
+def test_ratio_slo_with_no_traffic_is_not_an_outage():
+    slo = obs.SLO(name="shed", kind="ratio", objective=0.9,
+                  bad_metric="shed_total", total_metric="req_total",
+                  windows=[obs.BurnWindow(long_s=4, short_s=1,
+                                          factor=1.0)])
+    reg, clock, _, scraper, rules, _ = make_stack([slo])
+    reg.counter("req_total", "x")
+    reg.counter("shed_total", "x")
+    assert tick(clock, scraper, n=6) == []
+    assert rules.active()[0]["state"] == INACTIVE
+
+
+def test_ratio_slo_burn_lifecycle():
+    slo = obs.SLO(name="shed", kind="ratio", objective=0.9,
+                  bad_metric="shed_total", total_metric="req_total",
+                  windows=[obs.BurnWindow(long_s=4, short_s=1,
+                                          factor=2.0)])
+    reg, clock, _, scraper, rules, _ = make_stack([slo])
+    req = reg.counter("req_total", "x")
+    shed = reg.counter("shed_total", "x")
+    for _ in range(6):
+        req.inc(100)
+        tick(clock, scraper)
+    # 50% shed >> 2 * 10% budget
+    transitions = []
+    for _ in range(6):
+        req.inc(100)
+        shed.inc(50)
+        transitions += tick(clock, scraper)
+    assert [t["to"] for t in transitions] == [FIRING]
+    transitions = []
+    for _ in range(8):
+        req.inc(100)
+        transitions += tick(clock, scraper)
+    assert [t["to"] for t in transitions] == [INACTIVE]
+
+
+def test_gauge_slo_pending_then_firing_then_resolved():
+    slo = obs.SLO(name="degraded", kind="gauge", metric="degraded",
+                  threshold=0.0, for_s=3.0)
+    reg, clock, _, scraper, rules, _ = make_stack([slo])
+    g = reg.gauge("degraded", "x")
+    g.set(0.0)
+    assert tick(clock, scraper, n=2) == []
+    g.set(1.0)
+    t1 = tick(clock, scraper)
+    assert [t["to"] for t in t1] == [PENDING]
+    # held bad for for_s -> firing
+    t2 = tick(clock, scraper, n=4)
+    assert [t["to"] for t in t2] == [FIRING]
+    g.set(0.0)
+    t3 = tick(clock, scraper)
+    assert [t["to"] for t in t3] == [INACTIVE]
+    # a blip shorter than for_s never fires
+    g.set(1.0)
+    blip = tick(clock, scraper)
+    g.set(0.0)
+    blip += tick(clock, scraper, n=3)
+    assert [t["to"] for t in blip] == [PENDING, INACTIVE]
+
+
+def test_default_slos_reference_live_metrics():
+    # every metric a default rule reads must exist in the process
+    # registry once the subsystems that own them are imported (kfvet
+    # cross-checks the same thing statically)
+    import kubeflow_tpu.core.controller      # noqa: F401
+    import kubeflow_tpu.core.persistence     # noqa: F401
+    import kubeflow_tpu.gateway              # noqa: F401
+    import kubeflow_tpu.serving.engine       # noqa: F401
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    for slo in obs.default_slos():
+        for name in (slo.metric, slo.bad_metric, slo.total_metric):
+            if name:
+                assert REGISTRY.get_metric(name) is not None, name
+
+
+# -- pipeline + platform wiring ------------------------------------------------
+
+def test_pipeline_attach_and_state(monkeypatch):
+    class Server:
+        pass
+
+    server = Server()
+    # interval 0 = observability OFF: nothing attached, nothing
+    # published — a pipeline that never ticks must not render as a
+    # healthy monitored system
+    monkeypatch.setenv("KF_OBS_SCRAPE_INTERVAL", "0")
+    assert obs.attach(server) is None
+    assert server.obs is None
+
+    pipeline = obs.attach(server, interval_s=1.0, start=False)
+    try:
+        assert server.obs is pipeline
+        assert obs.get_pipeline() is pipeline
+        assert pipeline.scraper._thread is None    # start=False
+        pipeline.tick(at=1.0)
+        state = pipeline.state()
+        assert {a["alert"] for a in state["alerts"]} == {
+            "serving-ttft-p99", "gateway-shed-rate", "reconcile-p99",
+            "persistence-degraded"}
+        assert state["firing"] == []
+        assert state["tsdb"]["series"] > 0
+    finally:
+        obs.set_pipeline(None)
+
+
+def test_platform_builds_with_obs_attached(monkeypatch):
+    monkeypatch.setenv("KF_OBS_SCRAPE_INTERVAL", "5")
+    from kubeflow_tpu.platform import build_platform
+
+    server, mgr = build_platform()
+    try:
+        assert server.obs is not None
+        # build_platform never starts the thread (embedders own no
+        # handle that could stop it) — platform.main does, via autostart
+        assert server.obs.scraper._thread is None
+        assert server.obs.autostart is True
+        server.obs.tick(at=1.0)
+        assert server.obs.tsdb.stats()["series"] > 0
+    finally:
+        obs.set_pipeline(None)

@@ -59,6 +59,155 @@ def test_metrics_exposition():
     assert c.get("200") == 2.0
 
 
+def test_gauge_set_function_fresh_on_every_read():
+    # regression: _collect_fn gauges used to refresh only inside
+    # Registry.expose(), so get()/total() (dashboard + loadtest paths)
+    # read whatever the LAST exposition happened to cache
+    reg = Registry()
+    g = reg.gauge("depth", "live queue depth")
+    box = {"v": 1.0}
+    g.set_function(lambda: box["v"])
+    assert g.get() == 1.0
+    box["v"] = 42.0
+    assert g.get() == 42.0          # no expose() in between
+    assert g.total() == 42.0
+    box["v"] = 7.0
+    assert "depth 7.0" in reg.expose()
+
+
+def test_histogram_reads_locked_against_inplace_mutation():
+    # regression: count()/sum()/get() used to read the row with NO lock
+    # while _observe mutates it in place (bucket bumped, sum not yet —
+    # a torn pair).  The fix makes every read snapshot under self._lock;
+    # prove it by holding the lock and watching each read block.
+    import threading
+
+    reg = Registry()
+    h = reg.histogram("work_seconds", "x", buckets=(0.5, 2.0))
+    h.observe(1.0)
+    for read in (h.count, h.sum, h.get):
+        h._lock.acquire()
+        out = []
+        t = threading.Thread(target=lambda r=read: out.append(r()),
+                             daemon=True)
+        t.start()
+        t.join(timeout=0.2)
+        assert t.is_alive(), f"{read.__name__} read without the lock"
+        h._lock.release()
+        t.join(timeout=5)
+        assert out == [1.0]
+    # and the concurrent smoke: totals exact after racing observers
+    def writer():
+        for _ in range(4000):
+            h.observe(1.0)
+
+    stop = threading.Event()
+    seen = []
+
+    def reader():
+        while not stop.is_set():
+            seen.append((h.count(), h.sum()))
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    assert h.count() == 16001 and h.sum() == 16001.0
+    assert h.get() == h.count()     # histogram scalar reading = count
+
+
+def test_histogram_percentile_edge_cases():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "x", buckets=(0.1, 1.0))
+    # empty: no observations at all
+    assert h.percentile(99) == 0.0
+    # all-+Inf: every observation above the largest finite bound clamps
+    # to that bound
+    h.observe(50.0)
+    h.observe(99.0)
+    assert h.percentile(50) == 1.0
+    assert h.percentile(99) == 1.0
+    # single finite bucket
+    h2 = reg.histogram("one_seconds", "x", buckets=(1.0,))
+    h2.observe(0.5)
+    assert 0.0 < h2.percentile(50) <= 1.0
+    # q=0 and q=100 stay within the value domain
+    assert h2.percentile(0) == 0.0
+    assert h2.percentile(100) <= 1.0
+
+
+def test_histogram_exemplar_reservoir_bounded_and_tail_addressable():
+    from kubeflow_tpu.utils.metrics import Histogram
+
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "x", buckets=(0.1, 1.0))
+    for i in range(10):
+        h.observe(0.05, exemplar=f"fast{i}")
+    h.observe(5.0, exemplar="slow0")
+    h.observe(0.5)                      # no exemplar: reservoir untouched
+    ex = h.exemplars()
+    # bounded: the fast bucket kept only the newest K
+    assert [e["ref"] for e in ex[0.1]] == [
+        f"fast{i}" for i in range(10 - Histogram.EXEMPLARS_PER_BUCKET, 10)]
+    # the tail (+Inf) bucket addresses the slow trace
+    assert [e["ref"] for e in ex[float("inf")]] == ["slow0"]
+    assert 1.0 not in ex                # nothing ever attached there
+    # labeled histograms keep reservoirs per label set
+    hl = reg.histogram("lab_seconds", "x", labels=("op",),
+                       buckets=(0.1, 1.0))
+    hl.labels("read").observe(0.02, exemplar="r1")
+    assert [e["ref"] for e in hl.exemplars("read")[0.1]] == ["r1"]
+    assert hl.exemplars("write") == {}
+
+
+def test_exposition_golden_file_and_parser_round_trip():
+    """The obs scraper parses Registry.expose() text; this golden file
+    pins the format so the two cannot drift apart silently.  If the
+    exposition format changes ON PURPOSE, regenerate the golden (see the
+    test body) and fix obs.parse_exposition in the same commit."""
+    import pathlib
+
+    from kubeflow_tpu.obs import parse_exposition
+
+    reg = Registry()
+    c = reg.counter("requests_total", "reqs by code", labels=("code",))
+    c.labels("200").inc(3)
+    c.labels("503").inc()
+    g = reg.gauge("depth", "queue depth")
+    g.set(2.5)
+    gf = reg.gauge("fn_depth", "function-backed")
+    gf.set_function(lambda: 4.0)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(9.0)
+    hl = reg.histogram("op_seconds", "per-op latency", labels=("op",),
+                       buckets=(1.0,))
+    hl.labels("read").observe(0.5)
+    text = reg.expose()
+    golden = pathlib.Path(__file__).parent / "golden" / \
+        "metrics_exposition.txt"
+    # regenerate: golden.write_text(text)
+    assert text == golden.read_text()
+    # round trip: the parser recovers every series with its TYPE
+    samples = {(s.name, s.labels): (s.value, s.kind)
+               for s in parse_exposition(text)}
+    assert samples[("requests_total", (("code", "200"),))] == (3.0,
+                                                               "counter")
+    assert samples[("depth", ())] == (2.5, "gauge")
+    assert samples[("fn_depth", ())] == (4.0, "gauge")
+    assert samples[("lat_seconds_count", ())] == (3.0, "histogram")
+    assert samples[("lat_seconds_sum", ())] == (9.55, "histogram")
+    assert samples[("lat_seconds_bucket", (("le", "+Inf"),))][0] == 3.0
+    assert samples[("op_seconds_bucket",
+                    (("le", "1.0"), ("op", "read")))] == (1.0, "histogram")
+
+
 class _FakeProfiler:
     """Counts start/stop calls — the injectable backend that makes the
     window guard testable without jax."""
