@@ -6,7 +6,9 @@ controller/autoscale/elastic modules — a parameter named ``now`` (the
 decider convention: callers pass the timestamp in, tests drive a fake
 clock).  ``kubeflow_tpu/elastic/`` is in the ``now`` scope so the
 elastic resize decider's cooldown/backlog decisions can never silently
-regrow a raw ``time.time()``.
+regrow a raw ``time.time()``.  ``kubeflow_tpu/qos/`` qualifies
+unconditionally: the token-bucket limiter and WFQ tags must stay
+deterministic under an injected clock, declared parameter or not.
 Inside a qualifying module, every direct call to ``time.time()``,
 ``time.monotonic()`` or ``time.sleep()`` (under any import alias) is
 flagged: it re-introduces the hidden global the injection was built to
@@ -27,6 +29,12 @@ from kubeflow_tpu.analysis.framework import (
 
 NOW_PARAM_SCOPE = ("kubeflow_tpu/controllers/", "kubeflow_tpu/autoscale/",
                    "kubeflow_tpu/elastic/")
+# modules that are clock-injected by decree, whether or not any function
+# has declared the parameter yet: the QoS limiter/WFQ must stay
+# deterministic (token-bucket refill and fair tags are replayed by the
+# tenancy loadtest's digest gate), so a raw time call there is a bug
+# even before a clock param exists to catch it
+ALWAYS_INJECTED_SCOPE = ("kubeflow_tpu/qos/",)
 BANNED = {"time", "monotonic", "sleep"}
 
 
@@ -37,6 +45,8 @@ def _params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
 
 
 def clock_injected(mod: ModuleInfo) -> bool:
+    if mod.in_scope(*ALWAYS_INJECTED_SCOPE):
+        return True
     for node in ast.walk(mod.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             params = _params(node)

@@ -37,6 +37,13 @@ call site (literal first argument) in the scanned tree:
     now keeps a ring buffer per series), so label values must come from
     small closed sets.  Intentional per-object gauges (one series per
     cluster node) carry an explicit suppression.
+
+    ``tenant`` labels get their own rule: a tenant label value is
+    bounded only when it was resolved/clamped against profile names by
+    ``kubeflow_tpu.qos`` (raw identities would mint one series per
+    caller forever), so labeling by ``tenant`` is legal only in modules
+    that import from ``kubeflow_tpu.qos`` — the import is the visible
+    marker that the value went through resolve_tenant/clamp_tenant.
 """
 
 from __future__ import annotations
@@ -59,6 +66,11 @@ SUSPECT_IDENTIFIERS = {"path", "request_path", "user", "email",
                        "request_id", "trace_id", "span_id", "pod_name",
                        "node_name", "object_name", "namespace"}
 SUSPECT_ATTRIBUTES = {"name", "path", "user", "request_id", "trace_id"}
+# label values named ``tenant`` are bounded (profile names + the
+# anonymous fallback) only when the module sourced them from
+# kubeflow_tpu.qos's resolve/clamp helpers — the import is the marker
+QOS_MODULE = "kubeflow_tpu.qos"
+QOS_PATH_FRAGMENT = "kubeflow_tpu/qos/"
 
 
 @dataclass
@@ -117,6 +129,31 @@ def _suspicious_label_arg(node: ast.expr) -> str | None:
     return None
 
 
+def _tenant_label_arg(node: ast.expr) -> str | None:
+    """Why this argument is an unsanctioned tenant label, or None."""
+    if ((isinstance(node, ast.Name) and node.id == "tenant")
+            or (isinstance(node, ast.Attribute) and node.attr == "tenant")):
+        return ("tenant label value not sourced from profile names: only "
+                f"modules importing from {QOS_MODULE} (whose resolve/"
+                "clamp helpers bound tenants to profile names + the "
+                "anonymous fallback) may label by tenant")
+    return None
+
+
+def _imports_qos(mod: ModuleInfo) -> bool:
+    if QOS_PATH_FRAGMENT in mod.path:
+        return True
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.ImportFrom) and node.module
+                and node.module.startswith(QOS_MODULE)):
+            return True
+        if isinstance(node, ast.Import):
+            if any(alias.name.startswith(QOS_MODULE)
+                   for alias in node.names):
+                return True
+    return False
+
+
 @register
 class MetricsHygienePass(Pass):
     rules = ("metric-name", "metric-duplicate", "metric-unknown-ref",
@@ -128,6 +165,7 @@ class MetricsHygienePass(Pass):
 
     def check(self, mod: ModuleInfo) -> Iterable[Finding]:
         findings = []
+        qos_sourced = _imports_qos(mod)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -156,6 +194,8 @@ class MetricsHygienePass(Pass):
             if isinstance(func, ast.Attribute) and func.attr == "labels":
                 for arg in node.args:
                     why = _suspicious_label_arg(arg)
+                    if why is None and not qos_sourced:
+                        why = _tenant_label_arg(arg)
                     if why is not None:
                         findings.append(Finding(
                             "metric-label-cardinality", mod.path,

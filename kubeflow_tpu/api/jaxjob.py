@@ -44,6 +44,7 @@ def new(name: str, namespace: str, *, topology: str = "v5e-4",
         pod_template: dict | None = None, max_restarts: int = 3,
         num_slices: int = 1, max_run_seconds: float | None = None,
         elastic: dict | None = None, replicas: int | None = None,
+        priority_class: str | None = None,
         image: str = "kubeflow-tpu/worker:latest") -> dict:
     if topology not in TOPOLOGIES:
         raise ValueError(
@@ -68,6 +69,11 @@ def new(name: str, namespace: str, *, topology: str = "v5e-4",
         spec["elastic"] = dict(elastic)
     if replicas is not None:
         spec["replicas"] = int(replicas)
+    if priority_class is not None:
+        # Borg-style quota tier: orders eviction under slice pressure
+        # (low shrinks/evicts before normal before high); validated
+        # against the profile's qos.priorityTier by the controller
+        spec["priorityClass"] = priority_class
     return api_object(KIND, name, namespace, spec=spec)
 
 
@@ -85,6 +91,13 @@ def gang_need(job: dict) -> dict[str, int]:
     topo = TOPOLOGIES[job["spec"]["topology"]]
     n = num_slices_of(job)
     return {topo.resource_name: topo.chips * n, "pods": topo.hosts * n}
+
+
+def priority_class_of(job: dict) -> str:
+    """spec.priorityClass, defaulted — the scheduler's eviction key."""
+    from kubeflow_tpu.qos.tenants import DEFAULT_PRIORITY
+
+    return (job.get("spec") or {}).get("priorityClass", DEFAULT_PRIORITY)
 
 
 def elastic_of(job: dict) -> tuple[int, int] | None:
@@ -161,6 +174,14 @@ def validate(job: dict) -> None:
         raise ValueError(
             f"dp={par.get('dp', 1)} must be a multiple of numSlices "
             f"({n_slices}) so only data-parallel traffic crosses DCN")
+    cls = spec.get("priorityClass")
+    if cls is not None:
+        from kubeflow_tpu.qos.tenants import PRIORITY_CLASSES
+
+        if cls not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"priorityClass must be one of {PRIORITY_CLASSES}, "
+                f"got {cls!r}")
 
     e = spec.get("elastic")
     replicas = spec.get("replicas")

@@ -25,16 +25,23 @@ NAMESPACE_LABELS = {
 
 def new(name: str, owner_email: str, *,
         tpu_quota: dict[str, int] | None = None,
-        plugins: list[dict] | None = None) -> dict:
-    """tpu_quota: {"cloud-tpu.google.com/v5e": 32, ...} chip budgets."""
+        plugins: list[dict] | None = None,
+        qos: dict | None = None) -> dict:
+    """tpu_quota: {"cloud-tpu.google.com/v5e": 32, ...} chip budgets.
+    qos: {"share", "requestsPerSecond", "burst", "priorityTier"} — the
+    profile's serving weight, gateway rate limit, and gang quota tier
+    (kubeflow_tpu/qos/tenants.py documents the block)."""
     quota = {}
     if tpu_quota:
         quota["hard"] = {str(k): v for k, v in tpu_quota.items()}
-    return api_object(KIND, name, spec={
+    spec = {
         "owner": {"kind": "User", "name": owner_email},
         "plugins": plugins or [],
         "resourceQuotaSpec": quota,
-    })
+    }
+    if qos:
+        spec["qos"] = dict(qos)
+    return api_object(KIND, name, spec=spec)
 
 
 # namespaces the platform itself occupies; profiles may not claim them
@@ -50,6 +57,10 @@ def validate(profile: dict) -> None:
     if owner.get("kind") != "User" or not owner.get("name"):
         raise ValueError(
             f"Profile {name}: spec.owner must be a User subject with a name")
+    if profile.get("spec", {}).get("qos") is not None:
+        from kubeflow_tpu.qos.tenants import validate_qos
+
+        validate_qos(profile)
 
 
 def owner_of(profile: dict) -> str:

@@ -229,6 +229,19 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         # KF_SKIP_SCALE=1 opts out on constrained hosts.
         "scale_cmd": [sys.executable, "loadtest/load_scale.py", "--smoke"],
     },
+    "qos": {
+        "include_dirs": ["kubeflow_tpu/qos/*",
+                         "loadtest/load_tenancy.py"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+                     "tests/test_qos.py"],
+        # 4-tenant fairness storm, one tenant at 10x its share: asserts
+        # the well-behaved tenants' p99 TTFT stays within KF_TENANCY_CEIL
+        # (1.5x) of their solo baseline, their per-tenant burn-rate
+        # rules never fire, every storm-excess rejection carries
+        # 429 + Retry-After (shed, never a silent drop), and the run's
+        # state digest is seed-deterministic.  KF_SKIP_QOS=1 opts out.
+        "qos_cmd": [sys.executable, "loadtest/load_tenancy.py", "--smoke"],
+    },
     "analysis": {
         # the analyzer's own component: its unit tests plus the
         # full-tree sweep (which every other component also runs as
@@ -302,6 +315,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "scale_cmd" in spec:
         steps.append({"name": "scale", "run": spec["scale_cmd"],
                       "depends": ["test"]})
+    if "qos_cmd" in spec:
+        steps.append({"name": "qos", "run": spec["qos_cmd"],
+                      "depends": ["test"]})
     if spec.get("image"):
         # kaniko executor (the reference's builder): --no-push is the
         # presubmit mode (ci/notebook_servers pattern)
@@ -366,6 +382,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "scale_cmd" in spec
                 and os.environ.get("KF_SKIP_SCALE") != "1"):
             ok = subprocess.run(spec["scale_cmd"]).returncode == 0
+        if (ok and "qos_cmd" in spec
+                and os.environ.get("KF_SKIP_QOS") != "1"):
+            ok = subprocess.run(spec["qos_cmd"]).returncode == 0
         results[name] = ok
     return results
 

@@ -46,6 +46,7 @@ from kubeflow_tpu.core.objects import (
     set_owner,
 )
 from kubeflow_tpu.core.store import Invalid, NotFound
+from kubeflow_tpu.qos.tenants import validate_priority_class
 from kubeflow_tpu.utils.metrics import REGISTRY
 
 JOBS_CREATED = REGISTRY.counter("jaxjob_gangs_created_total",
@@ -192,6 +193,9 @@ class JAXJobController(Controller):
             return None  # children GC'd via ownerReferences
 
         api.validate(job)
+        # quota-tier check needs the profile, so it lives here rather
+        # than in the server-less api.validate
+        validate_priority_class(self.server, job)
         spec = job["spec"]
         elastic = api.elastic_of(job)
         # elastic gangs size by the controller-owned membership record;

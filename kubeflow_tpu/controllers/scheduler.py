@@ -209,6 +209,19 @@ def _job_created(server: APIServer, key: tuple) -> float:
     return ts
 
 
+def _job_priority(server: APIServer, key: tuple) -> int:
+    """Numeric priorityClass rank of the gang (absent/gone -> the
+    default tier).  Not cached: priorityClass is mutable spec, and the
+    eviction path reads it only under actual slice pressure."""
+    from kubeflow_tpu.api.jaxjob import priority_class_of
+    from kubeflow_tpu.qos.tenants import priority_rank
+
+    job = _job_get(server, key)
+    if job is None:
+        return priority_rank(None)
+    return priority_rank(priority_class_of(job))
+
+
 def _head_eta(server: APIServer, released: dict[tuple, int], free: int,
               head_need: int, now: float) -> float | None:
     """Earliest time ``head_need`` slices could be free, from the running
@@ -354,9 +367,9 @@ def _may_backfill(server: APIServer, released: dict, waiting: dict,
 
 class SlicePreemptionController(Controller):
     """Enforces ``pool.spec.unavailable``: when slices leave the pool
-    (cloud preemption, maintenance), the youngest released gang(s) of that
-    topology are evicted until the remaining gangs fit the usable
-    capacity.
+    (cloud preemption, maintenance), released gangs of that topology are
+    evicted — lowest ``spec.priorityClass`` first, youngest within a
+    class — until the remaining gangs fit the usable capacity.
 
     Eviction is the Borg move — delete the whole gang's pods (a slice
     gang is useless partially placed, so partial eviction only wastes the
@@ -410,10 +423,13 @@ class SlicePreemptionController(Controller):
         held = sum(released.values())
         if held <= avail:
             return 0
-        # youngest released gang first (ties broken by key for determinism)
+        # lowest priority class first (Borg tiers: a low-priority elastic
+        # gang shrinks before a high-priority one evicts), youngest
+        # within a class (ties broken by key for determinism)
         order = sorted(released,
                        key=lambda key: (_job_created(self.server, key), key),
                        reverse=True)
+        order.sort(key=lambda key: _job_priority(self.server, key))
         evicted = 0
         for key in order:
             if held <= avail:

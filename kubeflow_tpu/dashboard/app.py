@@ -80,6 +80,7 @@ class DashboardApp(CrudApp):
                        self.control_plane_route)
         self.add_route("GET", "/api/query", self.query_route)
         self.add_route("GET", "/api/alerts", self.alerts_route)
+        self.add_route("GET", "/api/qos", self.qos_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -224,6 +225,13 @@ class DashboardApp(CrudApp):
         """SLO standing + burn-rate alert states + recent transition log
         (the SLO card's backend; see obs.rules for the window math)."""
         return "200 OK", self.metrics.get_obs_state()
+
+    def qos_route(self, req: Request):
+        """Multi-tenant QoS standing (the QoS card): per-tenant fair
+        share vs consumption — request outcomes, gateway 429s, decode
+        tokens, slice-seconds, and tenant-labeled TTFT/admission-wait
+        percentiles."""
+        return "200 OK", self.metrics.get_qos_state()
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

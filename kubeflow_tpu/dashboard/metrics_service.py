@@ -297,6 +297,44 @@ def obs_state(server=None) -> dict:
     return state
 
 
+def qos_state(server=None) -> dict:
+    """Multi-tenant QoS standing (the QoS card +
+    ``/dashboard/api/qos``): one row per tenant joining the profile's
+    configured fair share against what the tenant actually consumed —
+    the qos.Accountant's monotone counters (request outcomes, decode
+    tokens, slice-seconds, admission waits), the gateway's per-tenant
+    429 count, and TTFT/admission-wait percentiles off the tenant-
+    labeled histogram siblings.  Row set is bounded by construction:
+    tenants are profile names plus the anonymous fallback, never raw
+    identities."""
+    from kubeflow_tpu.qos import get_accountant, tenant_shares
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    shares = tenant_shares(server) if server is not None else {}
+    usage = get_accountant().all_usage()
+    throttled = REGISTRY.get_metric("gateway_tenant_throttled_total")
+    ttft = REGISTRY.get_metric("serving_tenant_time_to_first_token_seconds")
+    wait = REGISTRY.get_metric("serving_tenant_admission_wait_seconds")
+    tenants = sorted(set(shares) | set(usage))
+    rows = []
+    for tenant in tenants:
+        u = usage.get(tenant, {})
+        rows.append({
+            "tenant": tenant,
+            "share": shares.get(tenant),
+            "requests": u.get("requests", {}),
+            "throttled_429": (throttled.get(tenant) if throttled else 0.0),
+            "decode_tokens": u.get("decode_tokens", 0),
+            "slice_seconds": round(u.get("slice_seconds", 0.0), 3),
+            "admission_wait": u.get("admission_wait", {}),
+            "ttft_p50_s": (ttft.percentile(50, tenant) if ttft else 0.0),
+            "ttft_p99_s": (ttft.percentile(99, tenant) if ttft else 0.0),
+            "admission_wait_p99_s": (wait.percentile(99, tenant)
+                                     if wait else 0.0),
+        })
+    return {"tenants": rows}
+
+
 def cluster_health(server) -> dict:
     """Node heartbeat standing + failure-recovery counters (the
     robustness card): per-node heartbeat age/readiness straight from the
@@ -390,6 +428,8 @@ class MetricsService(Protocol):
 
     def get_obs_state(self) -> dict: ...
 
+    def get_qos_state(self) -> dict: ...
+
 
 class LocalMetricsService:
     """Derives series from the in-memory API server (pod counts as a proxy
@@ -456,6 +496,9 @@ class LocalMetricsService:
 
     def get_obs_state(self) -> dict:
         return obs_state(self.server)
+
+    def get_qos_state(self) -> dict:
+        return qos_state(self.server)
 
 
 class CloudMonitoringMetricsService:
@@ -543,6 +586,11 @@ class CloudMonitoringMetricsService:
     def get_obs_state(self):
         # the TSDB + rule engine are process-local under either backend
         return obs_state(self.server)
+
+    def get_qos_state(self):
+        # the accountant and tenant-labeled histograms are process-local;
+        # shares come off the platform's own Profile objects
+        return qos_state(self.server)
 
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:

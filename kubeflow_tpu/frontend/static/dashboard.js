@@ -294,6 +294,30 @@
       sloCard.replaceChildren(el("h2", null, "SLOs"), ...rows);
     }).catch(() => sloCard.append(errorBox("unavailable")));
 
+    // multi-tenant QoS card: per-tenant fair share vs consumption —
+    // gateway 429s, decode tokens, and tenant-labeled TTFT tails
+    const qosCard = el("div", { class: "card", id: "qos-card" },
+      el("h2", null, "Tenant QoS"), el("div", { class: "muted" }, "…"));
+    cards.append(qosCard);
+    api.get("/dashboard/api/qos").then((q) => {
+      const tenants = q.tenants || [];
+      const throttled = tenants.reduce(
+        (n, t) => n + (t.throttled_429 || 0), 0);
+      const rows = [
+        el("div", { class: throttled ? "big hot" : "big" },
+          `${tenants.length}`),
+        el("div", { class: "muted" },
+          `tenants · ${throttled} throttled (429)`),
+        el("ul", null, tenants.slice(0, 6).map((t) =>
+          el("li", { class: "hint" },
+            `${t.tenant}${t.share ? ` (share ${t.share})` : ""}: ` +
+            `${t.decode_tokens || 0} tokens · ttft p99 ` +
+            `${(1e3 * (t.ttft_p99_s || 0)).toFixed(0)} ms` +
+            (t.throttled_429 ? ` · ${t.throttled_429}×429` : "")))),
+      ];
+      qosCard.replaceChildren(el("h2", null, "Tenant QoS"), ...rows);
+    }).catch(() => qosCard.append(errorBox("unavailable")));
+
     // control-plane-scale card: watch-cache window standing, resume
     // outcomes, paginated-list latency, and apiserver replica lag
     const cpCard = el("div", { class: "card", id: "control-plane-card" },

@@ -61,6 +61,8 @@ from dataclasses import dataclass, field
 
 from kubeflow_tpu import trace
 from kubeflow_tpu.core.store import APIServer, NotFound
+from kubeflow_tpu.qos import TenantLimiter, resolve_tenant, tenant_rate
+from kubeflow_tpu.qos.accounting import get_accountant
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import REGISTRY
 
@@ -76,6 +78,11 @@ SHED = REGISTRY.counter(
     "gateway_shed_responses_total",
     "backend load-shed responses (429 / busy-503 with Retry-After) "
     "relayed — healthy-busy, never an ejection")
+TENANT_THROTTLED = REGISTRY.counter(
+    "gateway_tenant_throttled_total",
+    "requests answered 429 by the per-profile token bucket; tenant is "
+    "a profile name (or the bounded anonymous fallback)",
+    labels=("tenant",))
 PICKS = REGISTRY.counter(
     "gateway_backend_pick_total",
     "backend pick decisions by requested serving role and reason",
@@ -545,6 +552,10 @@ def backend_for_route(server: APIServer, route: Route, path: str,
 def _request_headers(environ: dict, backend: Backend,
                      trace_ctx=None, request_id: str | None = None) -> dict:
     headers: dict[str, str] = {}
+    # every end-to-end header rides through — including Kubeflow-Userid,
+    # the gateway-stamped tenant (__call__ overwrites any inbound value
+    # before this runs), so the predictor labels the same tenant the
+    # gateway throttled
     for key, value in environ.items():
         if not key.startswith("HTTP_"):
             continue
@@ -709,6 +720,10 @@ class Gateway:
                 pass  # distribution without the autoscale package
         self.collector = collector
         self.activator = activator
+        # per-profile token buckets (qos): inert until a profile declares
+        # spec.qos.requestsPerSecond.  The wall clock is injected here —
+        # the qos package itself never reads time
+        self.limiter = TenantLimiter(clock=time.monotonic)
 
     def matches(self, path: str) -> bool:
         return match_route(self.server, path) is not None
@@ -899,6 +914,34 @@ class Gateway:
             start_response("403 Forbidden",
                            [("Content-Type", "text/plain")])
             return [f"{why}\n".encode()]
+        # tenancy: resolve the mesh identity to a profile name and stamp
+        # it as Kubeflow-Userid toward the backend (the reference's
+        # userid-header contract) so engine metrics/spans label the SAME
+        # tenant the gateway throttles.  The inbound value is dropped
+        # unconditionally — only the gateway names the tenant, and
+        # unresolved identities fold into the bounded "anonymous".
+        tenant = resolve_tenant(self.server, environ.get(WSGI_IDENTITY))
+        environ.pop("HTTP_KUBEFLOW_USERID", None)
+        environ["HTTP_KUBEFLOW_USERID"] = tenant
+        span.set_attribute("tenant", tenant)
+        admitted, retry_after = self.limiter.allow(
+            tenant, tenant_rate(self.server, tenant))
+        if not admitted:
+            # over the profile's declared rate: shed-not-dead, the exact
+            # classification _proxy applies to a backend 429 — counted
+            # as shed, Retry-After set, never an ejection
+            TENANT_THROTTLED.labels(tenant).inc()
+            get_accountant().record_throttled(tenant)
+            SHED.inc()
+            PROXIED.labels("429").inc()
+            span.set_attribute("status", 429)
+            span.set_attribute("outcome", "throttled")
+            span.end()
+            start_response("429 Too Many Requests",
+                           [("Content-Type", "text/plain"),
+                            ("Retry-After",
+                             str(max(1, round(retry_after))))])
+            return [f"tenant {tenant} over rate limit\n".encode()]
         # disaggregated serving: a generate POST dispatches to the
         # least-loaded PREFILL backend, and the decode handoff target
         # (picked here by decode-backend load — the slot-availability

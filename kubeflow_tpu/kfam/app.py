@@ -3,6 +3,7 @@
 Routes (kfam/routers.go:33-96):
     POST   /kfam/v1/profiles                  self-serve namespace creation
     DELETE /kfam/v1/profiles/{profile}
+    GET    /kfam/v1/profiles/{profile}/usage  per-tenant QoS accounting
     GET    /kfam/v1/bindings?namespace=       list contributors
     POST   /kfam/v1/bindings                  add contributor
     DELETE /kfam/v1/bindings                  remove contributor (body)
@@ -48,6 +49,19 @@ _ROUTE_LABELS = ("/healthz", "/metrics", "/kfam/v1/role/clusteradmin",
                  "/kfam/v1/profiles", "/kfam/v1/bindings")
 
 
+def _usage_payload(server: APIServer, name: str) -> dict:
+    """Per-tenant usage snapshot: the qos.Accountant's exact monotone
+    counters (decode tokens, slice-seconds, admission waits, outcomes)
+    plus the profile's configured QoS block so callers can relate
+    consumption to entitlement."""
+    from kubeflow_tpu.qos import get_accountant, qos_of
+
+    profile = server.get(profile_api.KIND, name)
+    return {"profile": name,
+            "qos": qos_of(profile),
+            "usage": get_accountant().usage(name)}
+
+
 def _strip_mount(path: str) -> str:
     """Normalize the front-door mount spelling (/kfam/healthz ->
     /healthz) — shared by routing and metric labeling so the two can
@@ -60,6 +74,8 @@ def _strip_mount(path: str) -> str:
 def _route_label(path: str) -> str:
     """Collapse a request path onto the route template it matched."""
     path = _strip_mount(path)
+    if re.fullmatch(r"/kfam/v1/profiles/[^/]+/usage", path):
+        return "/kfam/v1/profiles/{name}/usage"
     if re.fullmatch(r"/kfam/v1/profiles/[^/]+", path):
         return "/kfam/v1/profiles/{name}"
     return path if path in _ROUTE_LABELS else "other"
@@ -134,6 +150,11 @@ class KfamApp:
             return "200 OK", is_cluster_admin(self.server, user)
         if path == "/kfam/v1/profiles" and method == "POST":
             return self._create_profile(environ, user)
+        m = re.fullmatch(r"/kfam/v1/profiles/([^/]+)/usage", path)
+        if m and method == "GET":
+            profile = self.server.get(profile_api.KIND, m.group(1))
+            self._require_owner_or_admin(profile, user)
+            return "200 OK", _usage_payload(self.server, m.group(1))
         m = re.fullmatch(r"/kfam/v1/profiles/([^/]+)", path)
         if m and method == "DELETE":
             return self._delete_profile(m.group(1), user)
@@ -172,7 +193,8 @@ class KfamApp:
                 f"{user} may not create a profile for {owner}")
         profile = profile_api.new(name, owner,
                                   tpu_quota=body.get("tpuQuota"),
-                                  plugins=body.get("spec", {}).get("plugins"))
+                                  plugins=body.get("spec", {}).get("plugins"),
+                                  qos=body.get("spec", {}).get("qos"))
         # honor a full resourceQuotaSpec in the body (the reference's Profile
         # spec carries corev1.ResourceQuotaSpec verbatim); tpuQuota is the
         # dashboard's shorthand

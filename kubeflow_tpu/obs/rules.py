@@ -303,3 +303,31 @@ def default_slos(*, fast_long_s: float | None = None,
             description="durable store accepting mutations (degraded "
                         "mode held for 2 scrape intervals pages)"),
     ]
+
+
+def tenant_slos(tenants, *, objective: float = 0.99,
+                ttft_threshold_s: float = 0.25,
+                fast_long_s: float | None = None,
+                slow_long_s: float | None = None,
+                scrape_interval_s: float = 5.0) -> list[SLO]:
+    """Per-tenant TTFT burn-rate rules over the tenant-labeled sibling
+    of the serving TTFT histogram.  One SLO per tenant (profile name or
+    the bounded anonymous fallback) with ``matchers={"tenant": name}``,
+    so a storming tenant burning its own budget cannot page the
+    well-behaved tenants' rules — the isolation claim load_tenancy
+    gates on.  Window scaling matches default_slos."""
+    if fast_long_s is None:
+        fast_long_s = max(60.0, 16.0 * scrape_interval_s)
+    if slow_long_s is None:
+        slow_long_s = max(300.0, 40.0 * scrape_interval_s)
+    windows = default_burn_windows(fast_long_s, slow_long_s)
+    return [
+        SLO(name=f"tenant-ttft-p99-{tenant}", kind="latency",
+            objective=objective,
+            metric="serving_tenant_time_to_first_token_seconds",
+            matchers={"tenant": tenant},
+            threshold_s=ttft_threshold_s, windows=list(windows),
+            description=f"99% of {tenant}'s requests see first token "
+                        f"under {ttft_threshold_s * 1e3:.0f} ms")
+        for tenant in tenants
+    ]

@@ -1,0 +1,65 @@
+"""Multi-tenant QoS: fair-share admission, rate limits, accounting.
+
+Tenant identity is the Profile name (KFAM's tenancy boundary); the
+gateway resolves the mesh identity header to a profile and stamps it on
+every proxied request, so the whole stack labels the SAME tenant:
+
+    tenants.resolve_tenant   identity email -> profile name (bounded:
+                             unknown identities fold to "anonymous")
+    limiter.TenantLimiter    clock-injected per-profile token buckets —
+                             over-rate answers 429 + Retry-After at the
+                             gateway (shed, not dead)
+    wfq.WeightedFairQueue    virtual-time weighted-fair ordering by
+                             profile share (start-time fair queuing,
+                             DRF-style) for ContinuousBatcher admission
+    accounting.Accountant    per-tenant usage meters (decode tokens,
+                             slice-seconds, admission waits, outcomes)
+                             read by kfam's usage endpoint and the
+                             dashboard card
+
+Accounting lives HERE, not in obs: obs stores samples of metrics and
+forgets the event; billing-grade usage needs exact monotone counters
+owned by the component that admitted the work.  The obs pipeline still
+gets per-tenant SLO rules (rules.tenant_slos) from the tenant-labeled
+histograms the serving engine writes.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.qos.accounting import (
+    Accountant,
+    get_accountant,
+    set_accountant,
+)
+from kubeflow_tpu.qos.limiter import TenantLimiter, TokenBucket
+from kubeflow_tpu.qos.tenants import (
+    ANONYMOUS,
+    PRIORITY_CLASSES,
+    clamp_tenant,
+    priority_rank,
+    qos_of,
+    resolve_tenant,
+    tenant_rate,
+    tenant_shares,
+    validate_priority_class,
+)
+from kubeflow_tpu.qos.wfq import WeightedFairQueue, fair_quota
+
+__all__ = [
+    "ANONYMOUS",
+    "Accountant",
+    "PRIORITY_CLASSES",
+    "TenantLimiter",
+    "TokenBucket",
+    "WeightedFairQueue",
+    "clamp_tenant",
+    "fair_quota",
+    "get_accountant",
+    "priority_rank",
+    "qos_of",
+    "resolve_tenant",
+    "set_accountant",
+    "tenant_rate",
+    "tenant_shares",
+    "validate_priority_class",
+]

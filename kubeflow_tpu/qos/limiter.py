@@ -1,0 +1,76 @@
+"""Clock-injected token buckets: the gateway's per-tenant rate limit.
+
+No module-level clock and no direct ``time.*`` calls — the caller owns
+time (kfvet's clock-injection pass holds everything under
+``kubeflow_tpu/qos/`` to that rule).  Refill is computed from elapsed
+deltas of the injected clock and a negative delta (clock skew, test
+clocks jumping backward) refills nothing instead of draining the
+bucket.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class TokenBucket:
+    """One flow's bucket: ``burst`` capacity refilled at ``rate``/s."""
+
+    def __init__(self, rate: float, burst: float, *, clock):
+        if rate <= 0:
+            raise ValueError("token bucket rate must be > 0")
+        if burst < 1:
+            raise ValueError("token bucket burst must be >= 1")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = float(clock())
+
+    def allow(self, cost: float = 1.0) -> tuple[bool, float]:
+        """(admitted, retry_after_s).  Denials report how long until the
+        bucket holds ``cost`` tokens again at the steady refill rate —
+        the Retry-After the gateway relays."""
+        now = float(self._clock())
+        elapsed = max(0.0, now - self._last)
+        self._last = now
+        self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
+        if self._tokens >= cost:
+            self._tokens -= cost
+            return True, 0.0
+        return False, (cost - self._tokens) / self.rate
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+
+class TenantLimiter:
+    """Per-tenant buckets, lazily built from profile-declared rates.
+
+    Tenants without a declared rate are unlimited — the limiter is inert
+    until a profile opts in, so a QoS-less deployment behaves exactly as
+    before.  Rate/burst changes on a profile replace that tenant's
+    bucket on the next request."""
+
+    def __init__(self, *, clock):
+        self._clock = clock
+        self._buckets: dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+
+    def allow(self, tenant: str, limit: tuple[float, float] | None,
+              cost: float = 1.0) -> tuple[bool, float]:
+        """``limit`` is (rate, burst) or None for unlimited."""
+        if limit is None:
+            return True, 0.0
+        rate, burst = float(limit[0]), float(limit[1])
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None or bucket.rate != rate or bucket.burst != burst:
+                bucket = TokenBucket(rate, burst, clock=self._clock)
+                self._buckets[tenant] = bucket
+            return bucket.allow(cost)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buckets.clear()

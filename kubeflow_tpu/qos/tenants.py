@@ -1,0 +1,153 @@
+"""Tenant identity: profile-name resolution, shares, rates, tiers.
+
+The tenant label on every QoS metric is sourced HERE and only here: a
+tenant is either the name of an existing Profile or the single bounded
+``"anonymous"`` fallback.  That keeps metric cardinality at
+O(profiles), never O(users) — kfvet's metric-label-cardinality pass
+enforces that modules labeling by tenant import from this package.
+
+A profile opts into QoS with a ``spec.qos`` block::
+
+    qos:
+      share: 2.0               # WFQ weight (default 1.0)
+      requestsPerSecond: 5.0   # gateway token bucket (absent = unlimited)
+      burst: 10                # bucket depth (default = 2x rate)
+      priorityTier: normal     # highest JAXJob priorityClass allowed
+"""
+
+from __future__ import annotations
+
+ANONYMOUS = "anonymous"
+DEFAULT_SHARE = 1.0
+
+# accounts.google.com:user@example.com — the IAP-style principal prefix
+# kfam strips; the gateway sees the same identities
+IDENTITY_PREFIX = "accounts.google.com:"
+
+# Borg-style quota tiers, lowest first.  Eviction order follows rank:
+# the scheduler preempts low before normal before high.
+PRIORITY_CLASSES = ("low", "normal", "high")
+DEFAULT_PRIORITY = "normal"
+
+
+def priority_rank(priority_class: str | None) -> int:
+    """Numeric rank of a priorityClass (unknown/absent -> normal)."""
+    try:
+        return PRIORITY_CLASSES.index(priority_class)
+    except ValueError:
+        return PRIORITY_CLASSES.index(DEFAULT_PRIORITY)
+
+
+def qos_of(profile: dict) -> dict:
+    qos = (profile.get("spec") or {}).get("qos")
+    return qos if isinstance(qos, dict) else {}
+
+
+def validate_qos(profile: dict) -> None:
+    """Raise ValueError when a profile's spec.qos block is malformed."""
+    name = profile.get("metadata", {}).get("name", "")
+    qos = qos_of(profile)
+    share = qos.get("share", DEFAULT_SHARE)
+    if not isinstance(share, (int, float)) or share <= 0:
+        raise ValueError(f"Profile {name}: qos.share must be > 0")
+    rate = qos.get("requestsPerSecond")
+    if rate is not None and (not isinstance(rate, (int, float)) or rate <= 0):
+        raise ValueError(
+            f"Profile {name}: qos.requestsPerSecond must be > 0")
+    burst = qos.get("burst")
+    if burst is not None and (not isinstance(burst, (int, float))
+                              or burst < 1):
+        raise ValueError(f"Profile {name}: qos.burst must be >= 1")
+    tier = qos.get("priorityTier")
+    if tier is not None and tier not in PRIORITY_CLASSES:
+        raise ValueError(
+            f"Profile {name}: qos.priorityTier must be one of "
+            f"{PRIORITY_CLASSES}")
+
+
+def _directory(server) -> dict:
+    """{identity -> profile name} + {profile name -> qos spec}, memoized
+    against the Profile generation so the gateway's per-request lookup
+    is a dict hit, not a store scan."""
+    def build():
+        owners: dict[str, str] = {}
+        qos: dict[str, dict] = {}
+        for profile in server.list("Profile"):
+            name = profile["metadata"]["name"]
+            owner = (profile.get("spec", {}).get("owner") or {}).get("name")
+            if owner:
+                owners[owner] = name
+            qos[name] = qos_of(profile)
+        return {"owners": owners, "qos": qos}
+    return server.memo("Profile", ("qos-directory",), build)
+
+
+def resolve_tenant(server, identity: str | None) -> str:
+    """Mesh identity header value -> tenant (profile name).
+
+    Identities that do not own a profile — including absent/empty ones —
+    all fold into the single ``"anonymous"`` tenant: the label set stays
+    bounded by the profile count no matter what clients send."""
+    ident = (identity or "").strip()
+    if ident.startswith(IDENTITY_PREFIX):
+        ident = ident[len(IDENTITY_PREFIX):]
+    if not ident:
+        return ANONYMOUS
+    return _directory(server)["owners"].get(ident, ANONYMOUS)
+
+
+def clamp_tenant(tenant: str | None, known) -> str:
+    """Fold a claimed tenant into the known set (or anonymous).
+
+    Engine-side guard for deployments where the predictor is reachable
+    without the gateway: an arbitrary ``Kubeflow-Userid`` header must
+    not mint new metric series or WFQ flows."""
+    if tenant and known and tenant in known:
+        return tenant
+    return ANONYMOUS
+
+
+def tenant_rate(server, tenant: str) -> tuple[float, float] | None:
+    """(rate, burst) for the tenant's gateway token bucket, or None when
+    the profile declares no rate (unlimited)."""
+    qos = _directory(server)["qos"].get(tenant)
+    if not qos:
+        return None
+    rate = qos.get("requestsPerSecond")
+    if not rate or rate <= 0:
+        return None
+    burst = qos.get("burst") or max(1.0, 2.0 * float(rate))
+    return float(rate), float(burst)
+
+
+def tenant_shares(server) -> dict[str, float]:
+    """{tenant -> WFQ weight} for every profile (+ anonymous at the
+    default weight)."""
+    shares = {ANONYMOUS: DEFAULT_SHARE}
+    for name, qos in _directory(server)["qos"].items():
+        shares[name] = float(qos.get("share", DEFAULT_SHARE))
+    return shares
+
+
+def allowed_tier(server, namespace: str) -> str:
+    """The highest priorityClass the namespace's profile may use."""
+    qos = _directory(server)["qos"].get(namespace)
+    if not qos:
+        return DEFAULT_PRIORITY
+    return qos.get("priorityTier", DEFAULT_PRIORITY)
+
+
+def validate_priority_class(server, job: dict) -> None:
+    """Enforce the Borg-style quota tier: a JAXJob's spec.priorityClass
+    must not exceed its profile's qos.priorityTier.  Namespaces without
+    a profile get the default tier."""
+    cls = (job.get("spec") or {}).get("priorityClass")
+    if cls is None:
+        return
+    ns = job.get("metadata", {}).get("namespace", "")
+    tier = allowed_tier(server, ns)
+    if priority_rank(cls) > priority_rank(tier):
+        name = job.get("metadata", {}).get("name", "")
+        raise ValueError(
+            f"JAXJob {ns}/{name}: priorityClass {cls!r} exceeds the "
+            f"profile's quota tier {tier!r}")

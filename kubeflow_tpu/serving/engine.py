@@ -53,6 +53,9 @@ import jax.numpy as jnp
 
 from kubeflow_tpu import trace
 from kubeflow_tpu.serving.page_pool import PagePool, pages_for
+from kubeflow_tpu.qos.accounting import get_accountant
+from kubeflow_tpu.qos.tenants import ANONYMOUS, clamp_tenant
+from kubeflow_tpu.qos.wfq import WeightedFairQueue, fair_quota
 from kubeflow_tpu.trace import NULL_SPAN
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import REGISTRY
@@ -107,6 +110,23 @@ ADMISSION_WAIT = REGISTRY.histogram(
     "queue wait from submit() to slot admission",
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5, 5.0, 10.0, 30.0))
+# tenant-labeled SIBLINGS of the two QoS-relevant histograms, observed
+# alongside the unlabeled originals (the dashboard's cross-tenant
+# percentiles and the default SLOs keep reading those): tenant values
+# are gateway-resolved profile names clamped by qos.clamp_tenant, so
+# cardinality is bounded by the profile count
+TENANT_ADMISSION_WAIT = REGISTRY.histogram(
+    "serving_tenant_admission_wait_seconds",
+    "queue wait from submit() to slot admission, by tenant",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0),
+    labels=("tenant",))
+TENANT_TTFT = REGISTRY.histogram(
+    "serving_tenant_time_to_first_token_seconds",
+    "time to first token, by tenant (per-tenant SLO source)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0),
+    labels=("tenant",))
 HANDOFFS = REGISTRY.counter(
     "serving_prefill_handoffs_total",
     "prefilled requests handed off to a decode worker (disaggregation)")
@@ -162,6 +182,9 @@ class GenRequest:
     top_k: int = 0        # 0 = disabled
     top_p: float = 0.0    # 0 or >= 1 = disabled
     deadline: float | None = None   # absolute perf_counter() deadline
+    # the profile this request bills to (gateway-resolved, engine-clamped
+    # to the configured share map — unknown claims fold to anonymous)
+    tenant: str = ANONYMOUS
     submitted_at: float = field(default_factory=time.perf_counter)
     admitted_at: float | None = None
     first_token_at: float | None = None
@@ -170,6 +193,10 @@ class GenRequest:
     error: str | None = None
     outcome: str | None = None      # terminal serving_requests_total label
     _cancel_requested: bool = False
+    # WFQ admission ordering: virtual finish tag minted at enqueue plus
+    # an arrival sequence for deterministic cross-tenant tie-breaks
+    _vft: float = 0.0
+    _seq: int = 0
     _engine: object | None = field(default=None, repr=False)
     _spec: object = field(default=None, repr=False)  # SpeculationState
     # distributed tracing: the spans ride ON the request object — the
@@ -224,7 +251,8 @@ class ContinuousBatcher:
                  kv_pages: int = 0, speculative_tokens: int = 0,
                  draft_fn=None, role: str = "colocated", handoff_fn=None,
                  failover_fn=None, pool=None, prefix_cache=None,
-                 kv_quant: bool = False):
+                 kv_quant: bool = False,
+                 tenant_shares: dict[str, float] | None = None):
         from kubeflow_tpu.models import llama as llama_mod
 
         if role not in ("colocated", "prefill", "decode"):
@@ -360,6 +388,14 @@ class ContinuousBatcher:
         # would wait longer than any client will — shed it instead (0 =
         # unbounded, the pre-overload behavior)
         self.max_queue = max_queue
+        # multi-tenant QoS: {tenant -> WFQ weight} from profile qos
+        # shares.  None (the default) folds every request into one
+        # anonymous flow, where WFQ tags are monotone in arrival order —
+        # admission, shed, and wait estimates all reduce to the classic
+        # single-queue behavior
+        self.tenant_shares = dict(tenant_shares) if tenant_shares else None
+        self._wfq = WeightedFairQueue(shares=self.tenant_shares)
+        self._arrival = 0
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._auto_seed = 0
@@ -397,7 +433,7 @@ class ContinuousBatcher:
                seed: int | None = None, top_k: int = 0,
                top_p: float = 0.0,
                deadline_s: float | None = None,
-               trace_ctx=None) -> GenRequest:
+               trace_ctx=None, tenant: str | None = None) -> GenRequest:
         if len(ids) + max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt+new ({len(ids) + max_new_tokens}) > max_seq "
@@ -417,7 +453,8 @@ class ContinuousBatcher:
         # when unsampled): shed/draining rejections below still get their
         # outcome recorded on the request span before it closes
         req = GenRequest(list(ids), max_new_tokens, temperature, eos_id,
-                         seed=0, top_k=top_k, top_p=top_p)
+                         seed=0, top_k=top_k, top_p=top_p,
+                         tenant=clamp_tenant(tenant, self.tenant_shares))
         if self.spec_max:
             from kubeflow_tpu.serving.speculative import SpeculationState
 
@@ -447,7 +484,9 @@ class ContinuousBatcher:
             req.span = tracer.start_root("engine.request")
         req.span.set_attribute("prompt_tokens", len(req.ids))
         req.span.set_attribute("max_new_tokens", req.max_new_tokens)
+        req.span.set_attribute("tenant", req.tenant)
         req.wait_span = tracer.start_span("engine.admission_wait", req.span)
+        req.wait_span.set_attribute("tenant", req.tenant)
 
     def _enqueue(self, req: GenRequest, seed: int | None,
                  deadline_s: float | None) -> None:
@@ -463,17 +502,33 @@ class ContinuousBatcher:
                 raise Draining(
                     "serving engine is draining (finishing in-flight "
                     "requests, accepting no new ones)")
-            est_wait = self._estimated_wait_locked()
-            if self.max_queue and len(self.queue) >= self.max_queue:
-                REQS_TOTAL.labels("shed").inc()
-                raise QueueFull(
-                    f"admission queue full ({self.max_queue} waiting)",
-                    retry_after=est_wait)
+            est_wait = self._estimated_wait_locked(req.tenant)
+            if self.max_queue:
+                # the bounded queue is divided by PROFILE SHARE, not
+                # arrival order: a storming tenant exhausts its own
+                # fair-share slots and sheds while other tenants' slots
+                # stay open.  Single-flow engines degenerate to the
+                # classic whole-queue check (quota == max_queue).
+                quota = fair_quota(self.max_queue, req.tenant,
+                                   self.tenant_shares)
+                waiting = (len(self.queue) if not self.tenant_shares
+                           else sum(1 for r in self.queue
+                                    if r.tenant == req.tenant))
+                if waiting >= quota:
+                    REQS_TOTAL.labels("shed").inc()
+                    get_accountant().record_outcome(req.tenant, "shed")
+                    raise QueueFull(
+                        f"admission queue full ({quota} waiting)"
+                        if not self.tenant_shares else
+                        f"admission queue full for tenant {req.tenant} "
+                        f"({waiting}/{quota} fair-share slots)",
+                        retry_after=est_wait)
             if deadline_s is not None and est_wait >= deadline_s > 0:
                 # the deadline cannot survive the queue: shedding NOW is
                 # strictly better than burning a prefill on a request the
                 # deadline sweep will evict anyway
                 REQS_TOTAL.labels("shed").inc()
+                get_accountant().record_outcome(req.tenant, "shed")
                 raise QueueFull(
                     f"estimated queue wait {est_wait:.2f}s exceeds the "
                     f"request deadline {deadline_s:.2f}s",
@@ -492,6 +547,12 @@ class ContinuousBatcher:
         submit, handoff resume, failover adoption — funnels through here
         so the invariants cannot drift between copies."""
         req._engine = self
+        # WFQ: every entry path mints the virtual finish tag here, so a
+        # handoff resume or failover adoption queues under the same
+        # fairness regime as a fresh submit
+        self._arrival += 1
+        req._seq = self._arrival
+        req._vft = self._wfq.tag(req.tenant)
         self.queue.append(req)
         QUEUE_DEPTH.set(len(self.queue))
         if self._thread is None or not self._thread.is_alive():
@@ -558,7 +619,8 @@ class ContinuousBatcher:
                       seed: int | None = None, top_k: int = 0,
                       top_p: float = 0.0,
                       deadline_s: float | None = None,
-                      trace_ctx=None) -> list[list[int]]:
+                      trace_ctx=None,
+                      tenant: str | None = None) -> list[list[int]]:
         """Submit a whole (possibly ragged) batch and wait for all rows.
         All-or-nothing: if any row's submit is shed or any row fails,
         the already-submitted siblings are cancelled — the caller gets
@@ -570,7 +632,7 @@ class ContinuousBatcher:
                     ids, max_new_tokens, temperature, eos_id,
                     seed=None if seed is None else seed + i,
                     top_k=top_k, top_p=top_p, deadline_s=deadline_s,
-                    trace_ctx=trace_ctx))
+                    trace_ctx=trace_ctx, tenant=tenant))
             return [r.result() for r in reqs]
         except BaseException:
             for r in reqs:
@@ -633,15 +695,30 @@ class ContinuousBatcher:
             out["prefix_cache"] = self.prefix_cache.stats()
         return out
 
-    def _estimated_wait_locked(self) -> float:
+    def _estimated_wait_locked(self, tenant: str | None = None) -> float:
         """Rough seconds until a NEW arrival would reach a slot: waiters
         ahead over slot capacity, times the observed per-request service
         time.  Zero until the first request completes (cold start never
-        sheds on an estimate)."""
+        sheds on an estimate).
+
+        With tenant shares configured, the waiters and the capacity are
+        both the TENANT's: its own queued requests over its share of the
+        batch — under WFQ another tenant's backlog does not delay this
+        one beyond its share, so counting it would over-shed exactly the
+        victims the fair queue protects."""
         if self._service_ewma <= 0.0:
             return 0.0
-        waves = len(self.queue) / max(self.max_batch, 1)
-        return waves * self._service_ewma
+        if not self.tenant_shares or tenant is None:
+            waves = len(self.queue) / max(self.max_batch, 1)
+            return waves * self._service_ewma
+        weight = max(1e-9, float(self.tenant_shares.get(tenant, 1.0)))
+        total = sum(max(1e-9, float(w))
+                    for w in self.tenant_shares.values())
+        if tenant not in self.tenant_shares:
+            total += weight
+        capacity = max(1e-9, max(self.max_batch, 1) * weight / total)
+        waiting = sum(1 for r in self.queue if r.tenant == tenant)
+        return (waiting / capacity) * self._service_ewma
 
     def drain(self) -> None:
         """Stop admitting: queued and in-flight requests run to completion,
@@ -953,6 +1030,7 @@ class ContinuousBatcher:
         req.error = msg
         req.outcome = outcome
         REQS_TOTAL.labels(outcome).inc()
+        get_accountant().record_outcome(req.tenant, outcome)
         # a pending handoff's page references die with the request — a
         # cancel/deadline storm that lands mid-handoff must leak nothing
         self._release_handoff(req)
@@ -1101,7 +1179,10 @@ class ContinuousBatcher:
                 if not self.queue:
                     QUEUE_DEPTH.set(0)
                     return
-                head = self.queue[0]
+                # WFQ head: the smallest virtual finish tag, arrival
+                # order breaking ties.  Single-flow engines mint
+                # monotone tags, so this IS queue[0] — plain FIFO.
+                head = min(self.queue, key=lambda r: (r._vft, r._seq))
                 needs_slot = not (self.role == "prefill"
                                   and head._handoff is None)
                 free = next((i for i, s in enumerate(self.slots)
@@ -1109,7 +1190,9 @@ class ContinuousBatcher:
                 if needs_slot and free is None:
                     QUEUE_DEPTH.set(len(self.queue))
                     return
-                req = self.queue.pop(0)
+                self.queue.remove(head)
+                req = head
+                self._wfq.advance(req._vft)
                 QUEUE_DEPTH.set(len(self.queue))
                 if not needs_slot:
                     self._prefilling += 1
@@ -1133,7 +1216,10 @@ class ContinuousBatcher:
             self._fail(req, outcome, self._DEAD_MSG[outcome], notify=True)
             return
         req.admitted_at = time.perf_counter()
-        ADMISSION_WAIT.observe(req.admitted_at - req.submitted_at)
+        wait = req.admitted_at - req.submitted_at
+        ADMISSION_WAIT.observe(wait)
+        TENANT_ADMISSION_WAIT.labels(req.tenant).observe(wait)
+        get_accountant().record_admission_wait(req.tenant, wait)
         req.wait_span.end()
         # the request's own key chain starts at its seed
         k_first, k_chain = jax.random.split(jax.random.PRNGKey(req.seed))
@@ -1160,6 +1246,8 @@ class ContinuousBatcher:
         # the concrete slow traces in the collector
         TTFT_HIST.observe(
             ttft, exemplar=req.span.trace_id if req.span else None)
+        TENANT_TTFT.labels(req.tenant).observe(
+            ttft, exemplar=req.span.trace_id if req.span else None)
         req.generated.append(tok_host)
         TOKENS_TOTAL.inc()
         self._seat(free, req, scratch, k_chain)
@@ -1175,7 +1263,10 @@ class ContinuousBatcher:
             self._fail(req, outcome, self._DEAD_MSG[outcome], notify=True)
             return
         req.admitted_at = time.perf_counter()
-        ADMISSION_WAIT.observe(req.admitted_at - req.submitted_at)
+        wait = req.admitted_at - req.submitted_at
+        ADMISSION_WAIT.observe(wait)
+        TENANT_ADMISSION_WAIT.labels(req.tenant).observe(wait)
+        get_accountant().record_admission_wait(req.tenant, wait)
         req.wait_span.end()
         k_first, k_chain = jax.random.split(jax.random.PRNGKey(req.seed))
         tok, scratch, pages = self._run_prefill(req, k_first,
@@ -1189,6 +1280,8 @@ class ContinuousBatcher:
         ttft = req.first_token_at - req.submitted_at
         TTFT_LAST.set(ttft)
         TTFT_HIST.observe(
+            ttft, exemplar=req.span.trace_id if req.span else None)
+        TENANT_TTFT.labels(req.tenant).observe(
             ttft, exemplar=req.span.trace_id if req.span else None)
         req.generated.append(tok_host)
         TOKENS_TOTAL.inc()
@@ -1305,6 +1398,7 @@ class ContinuousBatcher:
             self._work.notify_all()
         req.outcome = "ok"
         REQS_TOTAL.labels("ok").inc()
+        get_accountant().record_outcome(req.tenant, "ok")
         req.span.set_attribute("outcome", "ok")
         req.span.end()
         req._done.set()
@@ -1556,6 +1650,7 @@ class ContinuousBatcher:
 
         active_before = [i for i, s in enumerate(self.slots) if s]
         taken = 0
+        acct = get_accountant()
         for i in active_before:
             req = self.slots[i]
             if req._spec is not None:
@@ -1565,11 +1660,19 @@ class ContinuousBatcher:
                 req._spec.note_skip(weight=chunk // 32)
             want = req.max_new_tokens - len(req.generated)
             col = [int(host_toks[step][i]) for step in range(chunk)]
+            row_taken = 0
             for tok in col[:want]:
                 req.generated.append(tok)
-                taken += 1
+                row_taken += 1
                 if req.eos_id is not None and tok == req.eos_id:
                     break
+            taken += row_taken
+            # usage attribution: the tenant bills its tokens, plus an
+            # equal split of the dispatch's wall time (every occupied
+            # slot rode the same batched forward)
+            acct.record_decode_tokens(req.tenant, row_taken)
+            acct.record_slice_seconds(req.tenant,
+                                      dt / max(1, len(active_before)))
         # counters BEFORE completion events: a caller woken by result()
         # must observe the tokens that completed it already counted
         TOKENS_TOTAL.inc(taken)
@@ -1640,6 +1743,7 @@ class ContinuousBatcher:
         self._spec_rounds += 1
 
         taken_total = 0
+        acct = get_accountant()
         new_keys = [keys_h[0][i] for i in range(self.max_batch)]
         for i, req in active:
             draft = drafts.get(i, [])[:gamma]
@@ -1656,6 +1760,8 @@ class ContinuousBatcher:
                 if req.eos_id is not None and tok == req.eos_id:
                     break
             taken_total += taken
+            acct.record_decode_tokens(req.tenant, taken)
+            acct.record_slice_seconds(req.tenant, dt / max(1, len(active)))
             if draft:
                 SPEC_PROPOSED.inc(len(draft))
                 SPEC_ACCEPTED.inc(accepted)
@@ -1717,6 +1823,7 @@ class ContinuousBatcher:
                 self._work.notify_all()
             req.outcome = "ok"
             REQS_TOTAL.labels("ok").inc()
+            get_accountant().record_outcome(req.tenant, "ok")
             req.decode_span.set_attribute("tokens", len(req.generated))
             req.decode_span.end()
             req.span.set_attribute("outcome", "ok")

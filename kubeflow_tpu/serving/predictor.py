@@ -40,7 +40,8 @@ class GenerativePredictor:
                  prefix_cache_mb: float = 0.0, prefill_chunk: int = 512,
                  max_queue: int = 0, kv_page_size: int = 16,
                  speculative_tokens: int = 0, role: str = "colocated",
-                 kv_quant: bool = False, handoff_post=None):
+                 kv_quant: bool = False, handoff_post=None,
+                 tenant_shares: dict | None = None):
         from kubeflow_tpu.models import registry
 
         self.name = model_name
@@ -164,6 +165,7 @@ class GenerativePredictor:
                                         speculative_tokens=(
                                             speculative_tokens),
                                         kv_quant=kv_quant,
+                                        tenant_shares=tenant_shares,
                                         **engine_kw)
         self.log.info("predictor ready",
                       params=sum(x.size for x in
@@ -248,7 +250,8 @@ class GenerativePredictor:
 
     def _generate_prefill(self, ids, max_new_tokens, temperature, seed,
                           eos_id, top_k, top_p, deadline_s, trace_ctx,
-                          decode_peer) -> list[list[int]]:
+                          decode_peer,
+                          tenant: str | None = None) -> list[list[int]]:
         """Prefill-role generate: admit every row, then forward each
         handoff to the decode peer CONCURRENTLY (one forwarder thread
         per row — a batch's rows co-batch on the decode worker instead
@@ -267,7 +270,7 @@ class GenerativePredictor:
                     temperature=temperature,
                     eos_id=eos_id, seed=None if seed is None else seed + i,
                     top_k=top_k, top_p=top_p, deadline_s=deadline_s,
-                    trace_ctx=trace_ctx))
+                    trace_ctx=trace_ctx, tenant=tenant))
             forwarders = []
             for r in reqs:
                 state = self._await_handoff(r)
@@ -323,7 +326,8 @@ class GenerativePredictor:
                  eos_id: int | None = None, top_k: int = 0,
                  top_p: float = 0.0,
                  deadline_s: float | None = None,
-                 trace_ctx=None, decode_peer: str | None = None) -> dict:
+                 trace_ctx=None, decode_peer: str | None = None,
+                 tenant: str | None = None) -> dict:
         """Generate continuations for a (possibly RAGGED) batch of prompts.
 
         Routed through the continuous-batching engine: each prompt becomes a
@@ -340,12 +344,12 @@ class GenerativePredictor:
         if self.role == "prefill":
             out_ids = self._generate_prefill(
                 ids, max_new_tokens, temperature, seed, eos_id, top_k,
-                top_p, deadline_s, trace_ctx, decode_peer)
+                top_p, deadline_s, trace_ctx, decode_peer, tenant=tenant)
         else:
             out_ids = self.engine.generate_sync(
                 ids, max_new_tokens=max_new_tokens, temperature=temperature,
                 eos_id=eos_id, seed=seed, top_k=top_k, top_p=top_p,
-                deadline_s=deadline_s, trace_ctx=trace_ctx)
+                deadline_s=deadline_s, trace_ctx=trace_ctx, tenant=tenant)
         dt = time.perf_counter() - t0
         generated = sum(len(o) - len(i) for o, i in zip(out_ids, ids))
         return {
@@ -561,7 +565,12 @@ class PredictorApp:
                         top_k=int(body.get("top_k", 0)),
                         top_p=float(body.get("top_p", 0.0)),
                         deadline_s=self._deadline_s(environ, body),
-                        trace_ctx=trace_ctx, **kw)
+                        trace_ctx=trace_ctx,
+                        # gateway-stamped resolved tenant (profile name or
+                        # the bounded anonymous fallback); engine clamps it
+                        # against configured shares
+                        tenant=environ.get("HTTP_KUBEFLOW_USERID"),
+                        **kw)
                 if verb == "resume" and method == "POST":
                     # decode-role entry: seed a slot from a serialized
                     # prefill handoff and finish the stream.  QueueFull

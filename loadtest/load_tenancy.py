@@ -1,0 +1,428 @@
+"""Multi-tenant QoS isolation loadtest (ISSUE 16 acceptance).
+
+Four tenants share one continuous-batching engine through weighted-fair
+admission (shares: team-a 1, team-b 1, team-c 2, storm 1).  The storm
+tenant offers 10x its fair share of load (10 concurrent threads against
+1 per well-behaved tenant) while the other three keep their steady 1x
+cadence.  Gates the isolation contract end to end:
+
+- **containment**: the well-behaved tenants' p99 TTFT under the storm
+  stays within ``KF_TENANCY_CEIL`` (default 1.5) x their solo baseline
+  plus one slot-recycle wave (a new arrival legitimately waits for a
+  running decode wave to free a slot — that term exists solo too, it is
+  just not visible on an idle engine);
+- **no collateral shed**: the storm exhausts only its OWN fair-share
+  queue quota — zero well-behaved submits are shed;
+- **shed, not dropped**: every storm-excess rejection raises
+  ``QueueFull`` with a positive ``retry_after`` (the 429 Retry-After
+  the gateway relays), and every submit reaches exactly one terminal
+  outcome — nothing silently disappears;
+- **no collateral alerts**: per-tenant burn-rate rules
+  (``obs.rules.tenant_slos``) over the tenant-labeled TTFT histogram,
+  evaluated deterministically via scraper ticks, never fire for the
+  well-behaved three;
+- **accounting**: the ``qos.Accountant`` charges each tenant exactly
+  its completed/shed requests, positive decode tokens, and admission
+  waits;
+- **determinism**: the WFQ admission order and the gateway token-bucket
+  decisions for the seeded storm schedule replay to an identical
+  sha256 state digest — same seed, same state.
+
+``--smoke`` is the CI gate (small N, hard asserts); the full run prints
+one JSON line for PERF.md / ROADMAP numbers.
+
+Usage: python loadtest/load_tenancy.py [SEED] [--smoke]
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+
+# a CPU loadtest: never try to grab the (possibly absent) TPU tunnel
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as `python loadtest/load_tenancy.py` (the CI smoke step)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WELL_BEHAVED = ("team-a", "team-b", "team-c")
+SHARES = {"team-a": 1.0, "team-b": 1.0, "team-c": 2.0, "storm": 1.0}
+STORM_FANOUT = 10                      # storm offers 10x its 1x cadence
+
+
+def _prompts(k: int, length: int, vocab: int) -> list[list[int]]:
+    out = []
+    state = 0x51AB5EED
+    for _ in range(k):
+        toks = []
+        for _ in range(length):
+            state = (state * 1103515245 + 12345) & 0x7FFFFFFF
+            toks.append(1 + state % (vocab - 1))
+        out.append(toks)
+    return out
+
+
+def _pct(vals: list[float], p: float) -> float:
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    return vals[min(int(len(vals) * p / 100), len(vals) - 1)]
+
+
+class _Client(threading.Thread):
+    """One tenant request stream: ``waves`` submits back to back,
+    recording per-request outcome, TTFT, and shed retry hints."""
+
+    def __init__(self, engine, tenant: str, prompt, *, waves: int,
+                 max_new: int, eos_id: int, think_s: float = 0.0):
+        super().__init__(daemon=True)
+        self.engine, self.tenant, self.prompt = engine, tenant, prompt
+        self.waves, self.max_new, self.eos_id = waves, max_new, eos_id
+        self.think_s = think_s           # 0 = closed-loop saturation
+        self.ttfts: list[float] = []
+        self.retry_afters: list[float] = []
+        self.outcomes: list[str] = []
+
+    def run(self) -> None:
+        from kubeflow_tpu.serving.engine import QueueFull
+
+        for _ in range(self.waves):
+            try:
+                req = self.engine.submit(
+                    self.prompt, max_new_tokens=self.max_new,
+                    eos_id=self.eos_id, deadline_s=120.0,
+                    tenant=self.tenant)
+            except QueueFull as e:
+                self.outcomes.append("shed")
+                self.retry_afters.append(e.retry_after)
+                time.sleep(min(max(e.retry_after, 0.0), 0.05))
+                continue
+            try:
+                req.result(timeout=120)
+                self.outcomes.append("ok")
+                self.ttfts.append(req.first_token_at - req.submitted_at)
+            except Exception as e:
+                self.outcomes.append(type(e).__name__)
+            if self.think_s:
+                time.sleep(self.think_s)
+
+
+class _FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _lcg_schedule(seed: int, n: int, mean_gap_s: float) -> list[float]:
+    """Deterministic arrival offsets: n gaps in (0, 2*mean]."""
+    state = (seed ^ 0x51AB5EED) & 0x7FFFFFFF or 1
+    t, out = 0.0, []
+    for _ in range(n):
+        state = (state * 1103515245 + 12345) & 0x7FFFFFFF
+        t += (1 + state % 1000) / 1000.0 * 2.0 * mean_gap_s
+        out.append(round(t, 6))
+    return out
+
+
+def _replay_digest(seed: int, arrivals_per_tenant: int) -> str:
+    """Deterministic QoS state digest: the WFQ admission order for an
+    interleaved storm arrival pattern plus the gateway token-bucket
+    verdicts for a seeded storm schedule.  Fresh objects each call —
+    identical digests prove the admission/limiter state machines hold
+    no wall-clock or ordering nondeterminism."""
+    from kubeflow_tpu.qos import TenantLimiter, WeightedFairQueue
+
+    wfq = WeightedFairQueue(shares=SHARES)
+    queued: list[tuple[float, int, str]] = []
+    order: list[str] = []
+    n = 0
+    # arrival pattern: each round, the storm files STORM_FANOUT requests
+    # and every well-behaved tenant files one; admission then drains the
+    # backlog by minimum virtual finish tag
+    for _ in range(arrivals_per_tenant):
+        for tenant in WELL_BEHAVED:
+            queued.append((wfq.tag(tenant), n, tenant))
+            n += 1
+        for _ in range(STORM_FANOUT):
+            queued.append((wfq.tag("storm"), n, "storm"))
+            n += 1
+    while queued:
+        queued.sort()
+        tag, _, tenant = queued.pop(0)
+        wfq.advance(tag)
+        order.append(tenant)
+
+    limiter = TenantLimiter(clock=(clock := _FakeClock()))
+    verdicts: list[tuple[str, int, float]] = []
+    limit = (5.0, 10.0)                  # storm profile: 5 rps, burst 10
+    for at in _lcg_schedule(seed, arrivals_per_tenant * STORM_FANOUT,
+                            mean_gap_s=0.05):
+        clock.t = at
+        ok, retry_after = limiter.allow("storm", limit)
+        verdicts.append(("storm", int(ok), round(retry_after, 6)))
+        if not ok:
+            assert retry_after > 0, "throttle verdict without Retry-After"
+    payload = json.dumps({"order": order, "verdicts": verdicts},
+                         separators=(",", ":")).encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
+def main() -> int:
+    smoke = "--smoke" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    seed = int(args[0]) if args else 0
+    if smoke:
+        waves, max_batch, max_queue = 4, 2, 8
+        prompt_len, max_new, max_seq = 12, 16, 128
+        shape = dict(hidden_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, intermediate_size=128)
+    else:
+        waves, max_batch, max_queue = 8, 4, 16
+        prompt_len, max_new, max_seq = 24, 32, 256
+        shape = dict(hidden_size=128, num_layers=4, num_heads=4,
+                     num_kv_heads=2, intermediate_size=256)
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu import obs
+    from kubeflow_tpu.models import llama as lm
+    from kubeflow_tpu.obs.rules import FIRING, tenant_slos
+    from kubeflow_tpu.parallel.sharding import unbox_params
+    from kubeflow_tpu.qos import Accountant, get_accountant, set_accountant
+    from kubeflow_tpu.serving.engine import TENANT_TTFT, ContinuousBatcher
+
+    cfg = lm.LlamaConfig(vocab_size=512, max_seq_len=512, use_flash=False,
+                         **shape)
+    module = lm.LlamaModel(cfg)
+    params = unbox_params(module.init(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, 8), jnp.int32))["params"])
+    engine = ContinuousBatcher(module, params, cfg, max_batch=max_batch,
+                               max_seq=max_seq, max_queue=max_queue,
+                               prefix_cache_bytes=32 << 20,
+                               prefill_chunk=64,
+                               tenant_shares=SHARES)
+    set_accountant(Accountant())         # fresh ledger for this run
+    acct = get_accountant()
+    eos = cfg.vocab_size - 1             # never sampled under greedy:
+    # keeps decode running to max_new so waves have a stable width
+
+    n_storm_clients = STORM_FANOUT
+    prompts = _prompts(len(WELL_BEHAVED) + n_storm_clients, prompt_len,
+                       cfg.vocab_size)
+
+    # warm the executables so the baseline measures dispatch, not XLA:
+    # the co-batched path AND the single-slot path (solo probes decode
+    # alone — a cold compile there would inflate the baseline ceiling
+    # and water down the containment gate)
+    # (as team-c — the anonymous fallback's fair-share queue quota is
+    # smaller than max_batch in the full configuration)
+    engine.generate_sync(prompts[:max_batch], max_new_tokens=max_new,
+                         eos_id=eos, tenant="team-c")
+    engine.submit(prompts[0], max_new_tokens=max_new,
+                  eos_id=eos, tenant="team-c").result(timeout=120)
+
+    # --- phase 1a: solo probes (one slot-recycle wave) ------------------
+    wave_samples: list[float] = []
+    for _ in range(waves):
+        t0 = time.perf_counter()
+        req = engine.submit(prompts[0], max_new_tokens=max_new,
+                            eos_id=eos, tenant="team-a")
+        req.result(timeout=120)
+        wave_samples.append(time.perf_counter() - t0)
+    wave_s = _pct(wave_samples, 50)      # one request's solo residency
+
+    # --- phase 1b: fair-load baseline -----------------------------------
+    # every tenant (the storm included) paced at its steady 1x cadence:
+    # think time of ~4 solo waves keeps each stream's offered load well
+    # under its fair share of the engine.  The p99 TTFT of the
+    # well-behaved three HERE is the "solo baseline" the containment
+    # gate scales — same host, same co-tenants, only the storm excess
+    # missing — so the gate isolates the effect of the 10x storm rather
+    # than folding in ambient slot/CPU contention
+    think_s = 4.0 * wave_s
+    # throwaway concurrent round first: the first co-batched mix of
+    # these prompt shapes compiles fresh executables, and that one-off
+    # would otherwise land in the baseline p99 as a fake 100x outlier
+    warm_clients = [
+        _Client(engine, tenant, prompts[i], waves=2, max_new=max_new,
+                eos_id=eos, think_s=think_s)
+        for i, tenant in enumerate((*WELL_BEHAVED, "storm"))
+    ]
+    for c in warm_clients:
+        c.start()
+    for c in warm_clients:
+        c.join(timeout=600)
+    fair_clients = [
+        _Client(engine, tenant, prompts[i], waves=waves, max_new=max_new,
+                eos_id=eos, think_s=think_s)
+        for i, tenant in enumerate((*WELL_BEHAVED, "storm"))
+    ]
+    for c in fair_clients:
+        c.start()
+    for c in fair_clients:
+        c.join(timeout=600)
+    baseline_ttfts = [t for c in fair_clients[:len(WELL_BEHAVED)]
+                      for t in c.ttfts]
+    baseline_p99 = _pct(baseline_ttfts, 99)
+
+    ceil_factor = float(os.environ.get("KF_TENANCY_CEIL", "1.5"))
+    ttft_ceiling = ceil_factor * baseline_p99 + wave_s
+
+    # --- per-tenant burn-rate rules over the tenant-labeled histogram ---
+    # threshold on the tightest histogram bucket bound at or above 2x the
+    # containment ceiling: a correct WFQ keeps every well-behaved TTFT
+    # far below it, a broken one (FIFO behind the storm backlog) blows
+    # through it and fires
+    alert_threshold = next(
+        (b for b in TENANT_TTFT.buckets if b >= 2.0 * ttft_ceiling),
+        TENANT_TTFT.buckets[-1])
+    pipeline = obs.Pipeline(
+        interval_s=5.0,
+        slos=tenant_slos(list(WELL_BEHAVED) + ["storm"],
+                         ttft_threshold_s=alert_threshold,
+                         scrape_interval_s=5.0),
+        clock=_FakeClock())
+    pipeline.tick(at=0.0)                # pre-storm baseline sample
+
+    # --- phase 2: the storm ---------------------------------------------
+    counts0 = {t: dict(acct.usage(t)["requests"]) for t in SHARES}
+    clients = [
+        _Client(engine, tenant, prompts[i], waves=waves,
+                max_new=max_new, eos_id=eos, think_s=think_s)
+        for i, tenant in enumerate(WELL_BEHAVED)
+    ]
+    storm_clients = [
+        _Client(engine, "storm", prompts[len(WELL_BEHAVED) + i],
+                waves=waves, max_new=max_new, eos_id=eos)
+        for i in range(n_storm_clients)
+    ]
+    t0 = time.perf_counter()
+    for c in clients + storm_clients:
+        c.start()
+    for c in clients + storm_clients:
+        c.join(timeout=600)
+    storm_wall = time.perf_counter() - t0
+
+    idle = engine.drained(timeout=30)
+    stats = engine.stats()
+
+    # post-storm scrape ticks across the burn windows at synthetic times:
+    # every window increase covers the storm's deltas exactly once
+    transitions = []
+    for at in range(5, 125, 5):
+        transitions += pipeline.tick(at=float(at))
+    fired = {e["alert"] for e in pipeline.rules.log(limit=200)
+             if e["to"] == FIRING} | set(pipeline.rules.firing())
+    collateral_alerts = sorted(
+        a for a in fired
+        if any(a.endswith(f"-{t}") for t in WELL_BEHAVED))
+
+    # --- deterministic state digest (two fresh replays must agree) ------
+    digest_a = _replay_digest(seed, waves)
+    digest_b = _replay_digest(seed, waves)
+
+    well_ttfts = [t for c in clients for t in c.ttfts]
+    well_sheds = sum(c.outcomes.count("shed") for c in clients)
+    storm_ttfts = [t for c in storm_clients for t in c.ttfts]
+    storm_sheds = [r for c in storm_clients for r in c.retry_afters]
+    outcomes: dict[str, int] = {}
+    for c in clients + storm_clients:
+        for o in c.outcomes:
+            outcomes[o] = outcomes.get(o, 0) + 1
+
+    usage = {t: acct.usage(t) for t in SHARES}
+    storm_delta = {
+        o: usage["storm"]["requests"].get(o, 0) - counts0["storm"].get(o, 0)
+        for o in ("ok", "shed")}
+
+    engine.shutdown()
+
+    well_p99 = _pct(well_ttfts, 99)
+    result = {
+        "seed": seed,
+        "shares": SHARES,
+        "waves_per_tenant": waves,
+        "storm_fanout": n_storm_clients,
+        "storm_wall_s": round(storm_wall, 2),
+        "baseline_ttft_p99_ms": round(baseline_p99 * 1e3, 1),
+        "wave_ms": round(wave_s * 1e3, 1),
+        "ttft_ceiling_ms": round(ttft_ceiling * 1e3, 1),
+        "well_behaved_ttft_p99_ms": round(well_p99 * 1e3, 1),
+        "well_behaved_sheds": well_sheds,
+        "storm_ttft_p99_ms": round(_pct(storm_ttfts, 99) * 1e3, 1),
+        "storm_sheds": len(storm_sheds),
+        "alert_threshold_s": alert_threshold,
+        "collateral_alerts": collateral_alerts,
+        "alert_transitions": len(transitions),
+        "usage": {t: {"requests": usage[t]["requests"],
+                      "decode_tokens": usage[t]["decode_tokens"]}
+                  for t in SHARES},
+        "state_digest": digest_a,
+        "post_storm": {"active": stats["active"],
+                       "queued": stats["queued"], "idle": idle},
+    }
+    print(json.dumps(result))
+
+    failures = []
+    if not well_ttfts:
+        failures.append("no well-behaved requests completed")
+    if well_ttfts and well_p99 > ttft_ceiling:
+        failures.append(
+            f"containment broken: well-behaved p99 TTFT "
+            f"{well_p99 * 1e3:.1f}ms exceeds ceiling "
+            f"{ttft_ceiling * 1e3:.1f}ms "
+            f"({ceil_factor}x solo baseline + one wave)")
+    if well_sheds:
+        failures.append(f"{well_sheds} well-behaved submits shed — the "
+                        "storm consumed other tenants' queue quota")
+    if not storm_sheds:
+        failures.append("10x storm produced zero sheds — per-tenant "
+                        "fair-share admission did not engage")
+    if any(r <= 0 for r in storm_sheds):
+        failures.append("storm shed without a positive retry_after "
+                        "(silent drop: the gateway would have no "
+                        "Retry-After to relay)")
+    terminal = sum(outcomes.values())
+    expected = (len(WELL_BEHAVED) + n_storm_clients) * waves
+    if terminal != expected:
+        failures.append(f"lost requests: {terminal} terminal outcomes "
+                        f"for {expected} submits")
+    if collateral_alerts:
+        failures.append("storm fired well-behaved tenants' burn-rate "
+                        f"alerts: {collateral_alerts}")
+    if digest_a != digest_b:
+        failures.append("state digest not deterministic: "
+                        f"{digest_a} != {digest_b}")
+    for tenant in WELL_BEHAVED:
+        delta_ok = (usage[tenant]["requests"].get("ok", 0)
+                    - counts0[tenant].get("ok", 0))
+        client = clients[WELL_BEHAVED.index(tenant)]
+        if delta_ok != client.outcomes.count("ok"):
+            failures.append(
+                f"accounting drift for {tenant}: ledger +{delta_ok} ok "
+                f"vs {client.outcomes.count('ok')} observed")
+        if usage[tenant]["decode_tokens"] <= 0:
+            failures.append(f"no decode tokens charged to {tenant}")
+        if usage[tenant]["admission_wait"]["count"] <= 0:
+            failures.append(f"no admission waits recorded for {tenant}")
+    if storm_delta["shed"] != len(storm_sheds):
+        failures.append(
+            f"storm shed accounting drift: ledger +{storm_delta['shed']} "
+            f"vs {len(storm_sheds)} observed")
+    if not idle or stats["active"] or stats["queued"]:
+        failures.append(f"leaked engine state: {stats} idle={idle}")
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -866,6 +866,11 @@ def _shed_stack(backends):
                     body = b"busy\n"
                     self.send_response(503)
                     self.send_header("Retry-After", "2")
+                elif mode == "echo-user":
+                    # what tenant did the gateway stamp on the request?
+                    body = (self.headers.get("Kubeflow-Userid", "")
+                            .encode() or b"-")
+                    self.send_response(200)
                 else:
                     body = b"ok"
                     self.send_response(200)
@@ -912,6 +917,24 @@ def _call(gateway, path="/web/default/app/x", method="GET", body=b""):
     environ = {"REQUEST_METHOD": method, "PATH_INFO": path,
                "wsgi.input": io.BytesIO(body),
                "CONTENT_LENGTH": str(len(body))}
+    out = b"".join(gateway(environ, start_response))
+    return status["code"], headers, out
+
+
+def _call_as(gateway, identity, path="/web/default/app/x"):
+    """_call with a mesh identity header (the IAP-style principal)."""
+    import io
+
+    status = {}
+    headers = {}
+
+    def start_response(s, h):
+        status["code"] = s
+        headers.update({k.lower(): v for k, v in h})
+
+    environ = {"REQUEST_METHOD": "GET", "PATH_INFO": path,
+               "wsgi.input": io.BytesIO(b""), "CONTENT_LENGTH": "0",
+               gw.WSGI_IDENTITY: identity}
     out = b"".join(gateway(environ, start_response))
     return status["code"], headers, out
 
@@ -966,6 +989,72 @@ def test_busy_503_with_retry_after_counts_as_shed():
         assert headers.get("retry-after") == "2"
         assert gw.SHED.get() == shed0 + 1
         assert not gateway.ejections.contains(*pods["pod-a"])
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+def test_tenant_throttle_429_is_shed_not_dead():
+    """The per-profile token bucket answers 429 with EXACTLY the shed
+    classification the backend-429 relay uses: Retry-After present,
+    counted in SHED and gateway_tenant_throttled_total{tenant}, the
+    backend never contacted and never ejected.  A throttled tenant's
+    pod must stay in rotation — the pod did nothing wrong."""
+    from kubeflow_tpu.api import profile as profile_api
+
+    server, pods, stubs = _shed_stack(["ok"])
+    server.create(profile_api.new(
+        "team-a", "alice@corp.com",
+        qos={"requestsPerSecond": 1.0, "burst": 1}))
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01)
+    identity = "accounts.google.com:alice@corp.com"
+    try:
+        shed0, ej0 = gw.SHED.get(), gw.EJECTIONS.get()
+        throttled0 = gw.TENANT_THROTTLED.get("team-a")
+        # within the burst: proxied through, 200 from the backend
+        code, _, body = _call_as(gateway, identity)
+        assert code.startswith("200") and body == b"ok"
+        # burst spent, no refill yet: the GATEWAY answers 429 — the body
+        # names the tenant, proving the backend was never dispatched
+        code, headers, body = _call_as(gateway, identity)
+        assert code.startswith("429")
+        assert int(headers["retry-after"]) >= 1
+        assert b"team-a" in body
+        assert gw.TENANT_THROTTLED.get("team-a") == throttled0 + 1
+        assert gw.SHED.get() == shed0 + 1
+        # shed-not-dead: no ejection, pod still in rotation
+        assert gw.EJECTIONS.get() == ej0
+        assert not gateway.ejections.contains(*pods["pod-a"])
+        # other tenants are untouched by team-a's exhaustion: anonymous
+        # has no profile rate, so it is unlimited
+        code, _, body = _call(gateway)
+        assert code.startswith("200") and body == b"ok"
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+def test_unresolved_identity_defaults_to_bounded_anonymous():
+    """Identities owning no profile — and absent headers — all fold into
+    the single 'anonymous' tenant and ride through unlimited; the
+    predictor sees a gateway-stamped Kubeflow-Userid either way, so an
+    inbound spoofed one can never reach the backend."""
+    from kubeflow_tpu.api import profile as profile_api
+
+    server, pods, stubs = _shed_stack(["echo-user"])
+    server.create(profile_api.new("team-a", "alice@corp.com"))
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01)
+    try:
+        # resolved owner: the backend sees the PROFILE name, not the email
+        code, _, body = _call_as(gateway,
+                                 "accounts.google.com:alice@corp.com")
+        assert code.startswith("200") and body == b"team-a"
+        # unknown identity and no identity both stamp "anonymous"
+        code, _, body = _call_as(gateway,
+                                 "accounts.google.com:stranger@corp.com")
+        assert code.startswith("200") and body == b"anonymous"
+        code, _, body = _call(gateway)
+        assert code.startswith("200") and body == b"anonymous"
     finally:
         for s in stubs:
             s.shutdown()
