@@ -9,8 +9,10 @@
     (ARCHITECTURE decision 17: attributes on the request object are the
     one legal cross-thread channel).  This rule bans ``threading.local``
     construction outright in the serving tree and in any module that
-    touches the handoff machinery (``HandoffState`` /
-    ``submit_handoff``): handoff state rides the request, full stop.
+    touches the handoff machinery (``HandoffState`` / ``submit_handoff``)
+    or the cluster prefix directory (``PrefixDirectory`` — gateway
+    workers look up while engine batchers advertise): handoff state
+    rides the request, full stop.
 
 Same rule shape as the span-lifecycle pass: lexical, suppressible with
 ``# kfvet: ignore[handoff-threadlocal]`` for a use that provably never
@@ -26,7 +28,11 @@ from typing import Iterable
 from kubeflow_tpu.analysis.framework import (
     Finding, ModuleInfo, Pass, register)
 
-HANDOFF_MARKERS = {"HandoffState", "submit_handoff"}
+# PrefixDirectory joined the marker set with the cluster KV economy:
+# directory lookups and peer page fetches cross engine/gateway threads
+# exactly like the prefill->decode handoff does, so any module touching
+# the directory inherits the same thread-local ban
+HANDOFF_MARKERS = {"HandoffState", "submit_handoff", "PrefixDirectory"}
 
 
 def _imports_threading_local(tree: ast.Module) -> bool:

@@ -155,11 +155,12 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                          "kubeflow_tpu/api/inferenceservice.py",
                          "kubeflow_tpu/controllers/inferenceservice.py",
                          "loadtest/load_serving.py",
-                         "loadtest/load_overload.py"],
+                         "loadtest/load_overload.py",
+                         "loadtest/load_kv_tiers.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q",
                      "tests/test_serving.py", "tests/test_serving_engine.py",
                      "tests/test_prefix_cache.py", "tests/test_quant.py",
-                     "tests/test_disagg.py"],
+                     "tests/test_disagg.py", "tests/test_kv_tiers.py"],
         # small-N shared-prefix loadtest: asserts the prefix cache still
         # cuts prefill dispatches, warm output == cold output, the
         # speculative stream is token-identical to plain decode, the
@@ -179,6 +180,17 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         # (KF_SKIP_OVERLOAD=1 opts out, mirroring the chaos smoke)
         "overload_cmd": [sys.executable, "loadtest/load_overload.py",
                          "--smoke"],
+        # cluster KV-economy smoke: a 2-engine fleet behind one prefix
+        # directory under an HBM budget that forces host-RAM spills —
+        # asserts spill->fault and directory-routed remote-hit streams
+        # are token-identical to cold, remote-hit TTFT lands within
+        # KF_KVTIER_REMOTE_FACTOR of a local warm hit, the draft-model
+        # drafter beats n-gram accept on run-poor text while staying
+        # within noise of spec-off on draft-hostile sampling, and both
+        # tiers balance with zero orphans/pins after the fleet drains
+        # (KF_SKIP_KVTIER=1 opts out)
+        "kvtier_cmd": [sys.executable, "loadtest/load_kv_tiers.py",
+                       "--smoke"],
         "image": "images/predictor",
     },
     "autoscale": {
@@ -306,6 +318,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "overload_cmd" in spec:
         steps.append({"name": "overload", "run": spec["overload_cmd"],
                       "depends": ["test"]})
+    if "kvtier_cmd" in spec:
+        steps.append({"name": "kv-tiers", "run": spec["kvtier_cmd"],
+                      "depends": ["test"]})
     if "trace_cmd" in spec:
         steps.append({"name": "trace", "run": spec["trace_cmd"],
                       "depends": ["test"]})
@@ -373,6 +388,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "overload_cmd" in spec
                 and os.environ.get("KF_SKIP_OVERLOAD") != "1"):
             ok = subprocess.run(spec["overload_cmd"]).returncode == 0
+        if (ok and "kvtier_cmd" in spec
+                and os.environ.get("KF_SKIP_KVTIER") != "1"):
+            ok = subprocess.run(spec["kvtier_cmd"]).returncode == 0
         if (ok and "trace_cmd" in spec
                 and os.environ.get("KF_SKIP_TRACE") != "1"):
             ok = subprocess.run(spec["trace_cmd"]).returncode == 0

@@ -142,7 +142,11 @@ class DashboardApp(CrudApp):
 
     def serving_cache_route(self, req: Request):
         """Serving-engine prefix-cache standing (hit rate, cached bytes,
-        evictions) + TTFT p50/p99 from the promoted histogram."""
+        evictions) + TTFT p50/p99 from the promoted histogram.  The
+        kv_pool block carries the tier split (hbm_pages/host_pages,
+        cumulative spills/faults, fault-wait percentiles) and the
+        directory block the cluster prefix-reuse traffic (entries,
+        lookup hit rate, peer-to-peer remote fetches)."""
         return "200 OK", self.metrics.get_serving_cache_state()
 
     def serving_health_route(self, req: Request):

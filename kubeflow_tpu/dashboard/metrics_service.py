@@ -64,6 +64,9 @@ def serving_cache_state() -> dict:
     proposed = val("serving_spec_tokens_proposed_total")
     accepted = val("serving_spec_tokens_accepted_total")
     decode_s = val("serving_decode_seconds_total")
+    fault_wait = REGISTRY.get_metric("serving_kv_fault_wait_seconds")
+    dir_hits = val("serving_kv_directory_hits_total")
+    dir_misses = val("serving_kv_directory_misses_total")
     return {
         "prefix_cache": {
             "hits": hits,
@@ -83,6 +86,27 @@ def serving_cache_state() -> dict:
             "pinned": max(capacity - free - cached_pages, 0.0),
             "utilization": ((capacity - free) / capacity) if capacity
             else 0.0,
+            # tiering (page_pool.py): HBM-resident vs host-RAM-spilled
+            # pages, cumulative spill/fault traffic, and the fault-wait
+            # tail a warm hit pays to bring spilled pages back
+            "hbm_pages": val("serving_kv_hbm_pages"),
+            "host_pages": val("serving_kv_host_pages"),
+            "spills": val("serving_kv_spills_total"),
+            "faults": val("serving_kv_faults_total"),
+            "fault_wait_p50_s": (fault_wait.percentile(50)
+                                 if fault_wait is not None else 0.0),
+            "fault_wait_p99_s": (fault_wait.percentile(99)
+                                 if fault_wait is not None else 0.0),
+        },
+        # cluster prefix reuse (serving/kv_directory.py): directory
+        # lookup traffic plus pages pulled peer-to-peer from owners
+        "directory": {
+            "entries": val("serving_kv_directory_entries"),
+            "hits": dir_hits,
+            "misses": dir_misses,
+            "hit_rate": (dir_hits / (dir_hits + dir_misses)
+                         if dir_hits + dir_misses else 0.0),
+            "remote_fetches": val("serving_kv_remote_fetches_total"),
         },
         "speculative": {
             "proposed": proposed,

@@ -455,7 +455,8 @@ def backend_for_route(server: APIServer, route: Route, path: str,
                       ejected: EjectionList | None = None,
                       exclude: set | None = None, *,
                       role: str | None = None,
-                      collector=None) -> Backend:
+                      collector=None,
+                      prefer: tuple | None = None) -> Backend:
     """Resolve a live backend for ``route``.  DRAINING pods never
     participate (they are finishing in-flight streams — a scale-down
     victim or a SIGTERM'd predictor); ``exclude`` skips specific
@@ -537,6 +538,17 @@ def backend_for_route(server: APIServer, route: Route, path: str,
         raise NoBackend(f"no running pod backs {svc_ns}/{svc_name}"
                         f":{target_port}"
                         + (f" in role {role!r}" if role else ""))
+    if prefer is not None:
+        # KV prefix affinity (serving/kv_directory.py): the preferred
+        # backend holds this prompt's longest cached prefix, so landing
+        # there skips the prefix prefill entirely.  Strictly a
+        # PREFERENCE among healthy in-role candidates — an ejected,
+        # draining, or vanished owner falls through to the normal pick
+        # (a stale directory entry may cost a cold prefill, never a 503)
+        for b in candidates:
+            if (b.host, b.port) == tuple(prefer):
+                PICKS.labels(role_label, "affinity").inc()
+                return b
     if len(candidates) == 1:
         PICKS.labels(role_label, "only_candidate").inc()
         return candidates[0]
@@ -695,8 +707,13 @@ class Gateway:
     BUFFER_BODY_MAX = 1 << 20
 
     def __init__(self, server: APIServer, *, connect_retries: int = 40,
-                 retry_delay: float = 0.25, collector=None, activator=None):
+                 retry_delay: float = 0.25, collector=None, activator=None,
+                 directory=None):
         self.server = server
+        # cluster KV prefix directory (serving/kv_directory.py): when
+        # set, :generate POSTs route by longest-prefix affinity — the
+        # prompt lands on the backend already holding its prefix pages
+        self.directory = directory
         # a pod reports Running slightly before its process binds the
         # port; a short connect-retry absorbs that startup race
         self.connect_retries = connect_retries
@@ -957,13 +974,20 @@ class Gateway:
                      if (environ["REQUEST_METHOD"] == "POST"
                          and ":generate" in path) else None)
         peer_addr = None
+        prefer = None
+        if want_role is not None and self.directory is not None:
+            prefer = self._prefix_affinity(environ)
         with trace.get_tracer().start_span("gateway.backend_pick",
                                            span) as psp:
+            if prefer is not None:
+                psp.set_attribute("prefix_affinity",
+                                  f"{prefer[0]}:{prefer[1]}")
             try:
                 backend = backend_for_route(self.server, route, path,
                                             self.ejections,
                                             role=want_role,
-                                            collector=self.collector)
+                                            collector=self.collector,
+                                            prefer=prefer)
             except NoBackend as e:
                 psp.add_event("activate", reason=str(e))
                 backend = self._activate(route, path)
@@ -1036,6 +1060,42 @@ class Gateway:
         return _span_stream(_counted(result, self.collector, key, addr_ref,
                                      peer_addr),
                             span, started)
+
+    def _prefix_affinity(self, environ) -> tuple | None:
+        """Peek the (re-wound) ``:generate`` body's first prompt and ask
+        the cluster directory who holds its longest cached prefix;
+        returns that backend's ``(host, port)`` or None.  Only bodies
+        small enough to buffer are peeked — the same bound the proxy's
+        safe-retry buffering uses — and any parse failure just means no
+        affinity, never an error."""
+        import io
+        import json
+
+        try:
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            return None
+        if not (0 < length <= self.BUFFER_BODY_MAX):
+            return None
+        raw = environ["wsgi.input"].read(length)
+        environ["wsgi.input"] = io.BytesIO(raw)
+        environ["CONTENT_LENGTH"] = str(len(raw))
+        try:
+            ids = json.loads(raw or b"{}").get("ids") or []
+            if ids and isinstance(ids[0], list):
+                ids = ids[0]  # a batch routes by its first prompt
+            ids = [int(t) for t in ids]
+        except (ValueError, TypeError, AttributeError):
+            return None
+        if not ids:
+            return None
+        hit = self.directory.lookup(ids)
+        if hit is None:
+            return None
+        host, _, port = str(hit.get("addr") or "").rpartition(":")
+        if not host or not port.isdigit():
+            return None
+        return host, int(port)
 
     def _activate(self, route: Route, path: str):
         """Scale-from-zero: hold the request while the activator brings up

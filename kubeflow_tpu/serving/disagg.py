@@ -95,28 +95,33 @@ def release_handoff(pool: PagePool, state: HandoffState) -> None:
 
 # -- cross-process wire format ------------------------------------------------
 
+def encode_page(tree) -> list[dict]:
+    """One pool page -> the JSON-safe wire form: a list of per-layer
+    dicts of base64 arrays, dtype-tagged so int8-quantized pages ride
+    the same shape.  Shared by the handoff (``:resume``) and the cluster
+    prefix-reuse export (``:pages``) — one wire format, one validator."""
+    import numpy as np
+
+    layers = []
+    for layer in tree["layers"]:
+        enc = {}
+        for name, arr in layer.items():
+            host = np.asarray(arr)
+            enc[name] = {
+                "dtype": str(host.dtype),
+                "shape": list(host.shape),
+                "data": base64.b64encode(host.tobytes()).decode(),
+            }
+        layers.append(enc)
+    return layers
+
+
 def serialize_handoff(state: HandoffState, pool: PagePool) -> dict:
     """JSON-safe handoff: sampling state + the page payloads (per-layer
     arrays as base64, dtype-tagged so int8-quantized pages ride the same
     shape).  The absolute deadline becomes REMAINING seconds — perf
     counters do not cross process boundaries."""
-    import numpy as np
-
-    pages = []
-    for pid in state.pages:
-        tree = pool.get(pid)
-        layers = []
-        for layer in tree["layers"]:
-            enc = {}
-            for name, arr in layer.items():
-                host = np.asarray(arr)
-                enc[name] = {
-                    "dtype": str(host.dtype),
-                    "shape": list(host.shape),
-                    "data": base64.b64encode(host.tobytes()).decode(),
-                }
-            layers.append(enc)
-        pages.append(layers)
+    pages = [encode_page(pool.get(pid)) for pid in state.pages]
     remaining = None
     if state.deadline is not None:
         remaining = max(0.1, state.deadline - time.perf_counter())
@@ -150,7 +155,6 @@ def _validate_resume(body: dict, engine) -> tuple[list, dict]:
     fully parsed page trees plus every scalar HandoffState field."""
     from kubeflow_tpu.serving.page_pool import pages_for
 
-    cfg = engine.cfg
     ids = body.get("ids")
     generated = body.get("generated")
     if not ids or not isinstance(ids, list):
@@ -191,6 +195,16 @@ def _validate_resume(body: dict, engine) -> tuple[list, dict]:
         raise ValueError(
             f"{needed} pages needed to cover {len(ids)} prompt tokens at "
             f"page_size {engine.page_size}, got {len(pages)}")
+    return parse_page_trees(pages, engine), fields
+
+
+def parse_page_trees(pages: list, engine) -> list:
+    """Decode + shape-check wire-format pages against ``engine``'s model
+    (the page-validation half of ``_validate_resume``, shared with the
+    cluster prefix-reuse fetch path).  Raises ValueError on anything
+    that does not match — a remote peer's pages must be proven
+    seat-able before a single pool slot is allocated for them."""
+    cfg = engine.cfg
     want_keys = ({"k", "ks", "v", "vs"} if engine.kv_quant
                  else {"k", "v"})
     kv_shape = (engine.page_size, cfg.num_kv_heads, cfg.head_dim)
@@ -221,7 +235,7 @@ def _validate_resume(body: dict, engine) -> tuple[list, dict]:
                 parsed[name] = arr
             tree["layers"].append(parsed)
         trees.append(tree)
-    return trees, fields
+    return trees
 
 
 def deserialize_handoff(body: dict, engine) -> HandoffState:

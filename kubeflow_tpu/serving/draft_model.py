@@ -1,0 +1,189 @@
+"""Draft-model speculation: a truncated target model as the drafter.
+
+Leviathan et al. (2023) speculative decoding needs a CHEAP model whose
+next-token distribution tracks the target's.  The n-gram drafter
+(speculative.py) is free but only fires on text that repeats itself;
+this module supplies a REAL drafter for run-poor text by truncating the
+target checkpoint — the first ``num_layers`` decoder blocks plus the
+target's own final norm and (tied) embedding head.  Truncation needs no
+extra checkpoint, shares the tokenizer by construction, and early
+llama-style layers already carry most next-token signal at tiny depth
+fractions — the self-speculative observation of Zhang et al. (2023),
+"Draft & Verify".
+
+The drafter is greedy and autoregressive over its OWN small contiguous
+KV cache.  Because the engine re-drafts each round with the previous
+round's tokens as a strict prefix (generated text is append-only), the
+cache is kept INCREMENTALLY: a bounded map from consumed-token prefixes
+to cache trees, so each round pays one bucketed suffix prefill plus one
+token-at-a-time scan — never a full re-prefill of the prompt.
+
+Cost model: the engine's speculation arbiter charges ``cost_per_token``
+step-units per PLANNED draft token before any draft compute runs
+(engine._spec_step's pre-gate) — an n-gram drafter costs nothing and
+gates after drafting; a model drafter must clear the bar first.  The
+default calibration is ``0.5 * num_layers / target_layers``: a drafted
+token rides a batch-1 forward of a depth-fraction model, about half a
+batched scan step per token at equal depth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.llama import LlamaConfig, LlamaModel, init_cache
+
+# suffix prefill buckets: one jit per padded suffix length, like the
+# engine's PREFILL_BUCKETS but sized for per-round extensions (a round
+# extends by accepted+1 tokens; the first call pays the prompt)
+EXTEND_BUCKETS = (8, 16, 32, 64, 128, 256, 512)
+
+
+def truncate_params(params: dict, num_layers: int) -> dict:
+    """The first ``num_layers`` blocks of a target param tree plus the
+    shared embedding and final norm — a valid param tree for a
+    ``num_layers``-deep LlamaConfig."""
+    out = {"tok_embeddings": params["tok_embeddings"],
+           "final_norm": params["final_norm"]}
+    for i in range(num_layers):
+        out[f"layer_{i}"] = params[f"layer_{i}"]
+    return out
+
+
+class DraftModel:
+    """Callable drafter over a truncated target model: engine's
+    ``draft_fn(tokens, max_tokens) -> list[int]`` protocol, plus the
+    ``cost_per_token`` attribute the arbiter's pre-gate reads."""
+
+    def __init__(self, params: dict, cfg: LlamaConfig, num_layers: int = 1,
+                 max_entries: int = 8, cost_per_token: float | None = None):
+        if not (0 < num_layers <= cfg.num_layers):
+            raise ValueError(
+                f"draft depth {num_layers} outside target depth "
+                f"{cfg.num_layers}")
+        self.cfg = dataclasses.replace(cfg, num_layers=num_layers,
+                                       remat=False)
+        self.params = truncate_params(params, num_layers)
+        self.model = LlamaModel(self.cfg)
+        self.seq_cap = int(cfg.max_seq_len)
+        self.max_entries = max(1, int(max_entries))
+        self.cost_per_token = (0.5 * num_layers / max(1, cfg.num_layers)
+                               if cost_per_token is None
+                               else float(cost_per_token))
+        self._jits: dict = {}
+        # consumed-token prefix -> (cache, next greedy token); insertion
+        # order doubles as LRU order (re-stores move to the back)
+        self._ctx: dict[tuple, tuple] = {}
+
+    # -- compiled pieces -------------------------------------------------------
+    def _extend(self, s_pad: int):
+        """Jitted: run ``s_pad`` (right-padded) suffix tokens through the
+        drafter's cache starting at position ``start``; reset the cache
+        index to the TRUE total length (pad junk beyond it sits at
+        higher slots than any real query and is overwritten by the next
+        extension) and return the greedy token after position
+        ``true_len - 1``."""
+        if ("ext", s_pad) not in self._jits:
+            model = self.model
+
+            @jax.jit
+            def fn(params, cache, suffix, start, true_len):
+                positions = start + jnp.arange(s_pad)[None, :]
+                cache = {"layers": [dict(l, index=start)
+                                    for l in cache["layers"]]}
+                out = model.apply({"params": params}, suffix,
+                                  positions=positions, cache=cache)
+                cache = {"layers": [dict(l, index=true_len)
+                                    for l in out["cache"]["layers"]]}
+                last = jnp.take(out["logits"][0], true_len - 1 - start,
+                                axis=0)
+                return cache, jnp.argmax(last).astype(jnp.int32)
+
+            self._jits[("ext", s_pad)] = fn
+        return self._jits[("ext", s_pad)]
+
+    def _scan(self, gamma: int):
+        """Jitted: ``gamma`` greedy decode steps from ``tok`` (already
+        the first draft token), returning the follow-on tokens."""
+        if ("scan", gamma) not in self._jits:
+            model = self.model
+
+            @jax.jit
+            def fn(params, cache, tok):
+                def step(carry, _):
+                    cache, tok = carry
+                    out = model.apply({"params": params}, tok[None, None],
+                                      cache=cache)
+                    nt = jnp.argmax(out["logits"][0, -1]).astype(jnp.int32)
+                    return (out["cache"], nt), nt
+
+                _, toks = jax.lax.scan(step, (cache, tok), None,
+                                       length=gamma)
+                return toks
+
+            self._jits[("scan", gamma)] = fn
+        return self._jits[("scan", gamma)]
+
+    # -- incremental context ---------------------------------------------------
+    def _lookup(self, toks: tuple):
+        """Longest stored prefix of ``toks`` (possibly ``toks`` itself)."""
+        best, best_len = None, -1
+        for key in self._ctx:
+            n = len(key)
+            if n > best_len and n <= len(toks) and toks[:n] == key:
+                best, best_len = key, n
+        return best
+
+    def _store(self, toks: tuple, cache, tok, drop: tuple | None) -> None:
+        if drop is not None:
+            # the ancestor is strictly subsumed: one entry per stream
+            self._ctx.pop(drop, None)
+        self._ctx.pop(toks, None)
+        self._ctx[toks] = (cache, tok)
+        while len(self._ctx) > self.max_entries:
+            self._ctx.pop(next(iter(self._ctx)))
+
+    def reset(self) -> None:
+        self._ctx.clear()
+
+    # -- drafting --------------------------------------------------------------
+    def __call__(self, tokens, max_tokens: int) -> list[int]:
+        return self.draft(tokens, max_tokens)
+
+    def draft(self, tokens, max_tokens: int) -> list[int]:
+        toks = tuple(int(t) for t in tokens)
+        limit = min(int(max_tokens), self.seq_cap - len(toks))
+        if not toks or limit <= 0:
+            return []
+        key = self._lookup(toks)
+        if key is not None and len(key) == len(toks):
+            cache, tok = self._ctx[key]
+        else:
+            if key is None:
+                cache = init_cache(self.cfg, 1, self.seq_cap)
+                start = 0
+            else:
+                cache, _ = self._ctx[key]
+                start = len(key)
+            suffix = toks[start:]
+            # a bucket only qualifies if the padded write still fits the
+            # cache (dynamic_update_slice would CLAMP an overflowing
+            # start and silently shift the pages); otherwise pay one
+            # exact-length compile (rare — a near-cap-length prompt)
+            fit = self.seq_cap - start
+            s_pad = next((b for b in EXTEND_BUCKETS
+                          if len(suffix) <= b <= fit), len(suffix))
+            padded = jnp.asarray([list(suffix) + [0] * (s_pad - len(suffix))],
+                                 jnp.int32)
+            cache, tok = self._extend(s_pad)(
+                self.params, cache, padded, jnp.int32(start),
+                jnp.int32(len(toks)))
+            self._store(toks, cache, tok, drop=key)
+        out = [int(tok)]
+        if limit > 1:
+            more = self._scan(limit - 1)(self.params, cache, tok)
+            out.extend(int(t) for t in jax.device_get(more))
+        return out[:limit]

@@ -248,11 +248,14 @@ class ContinuousBatcher:
                  max_seq: int = 512, mesh=None,
                  prefix_cache_bytes: int = 0, prefill_chunk: int = 512,
                  max_queue: int = 0, page_size: int = 16,
-                 kv_pages: int = 0, speculative_tokens: int = 0,
+                 kv_pages: int = 0, host_kv_pages: int = 0,
+                 speculative_tokens: int = 0,
                  draft_fn=None, role: str = "colocated", handoff_fn=None,
                  failover_fn=None, pool=None, prefix_cache=None,
                  kv_quant: bool = False,
-                 tenant_shares: dict[str, float] | None = None):
+                 tenant_shares: dict[str, float] | None = None,
+                 directory=None, engine_id: str | None = None,
+                 engine_addr: str = "", fetch_fn=None):
         from kubeflow_tpu.models import llama as llama_mod
 
         if role not in ("colocated", "prefill", "decode"):
@@ -311,6 +314,15 @@ class ContinuousBatcher:
 
             draft_fn = ngram_draft
         self.draft_fn = draft_fn
+        # a REAL draft model is not free like n-gram lookup: its own
+        # forward costs ~cost_per_token scan-step units per drafted
+        # token (a truncated-target drafter advertises depth_ratio; see
+        # serving/draft_model.py).  The arbiter folds this in, and when
+        # it is nonzero the engine cost-gates BEFORE drafting — an
+        # n-gram draft costs microseconds to produce and can be priced
+        # after the fact, a model draft cannot.
+        self.draft_cost = max(0.0, float(getattr(draft_fn,
+                                                 "cost_per_token", 0.0)))
         self._spec_buckets = tuple(
             b for b in (1, 2, 4, 8, 16, 32) if b < self.spec_max
         ) + ((self.spec_max,) if self.spec_max else ())
@@ -330,7 +342,11 @@ class ContinuousBatcher:
                 # are shared with — or become — cache entries, so this is
                 # an upper bound)
                 kv_pages = 1 + cache_pages + max_batch * self.pages_per_seq
-            pool = PagePool(kv_pages, self.page_size, self.page_nbytes)
+            # host_kv_pages opens the Mooncake-style host-RAM spill
+            # arena: pressure spills cold prefixes instead of dropping
+            # them, and a later hit faults them back (page_pool.py)
+            pool = PagePool(kv_pages, self.page_size, self.page_nbytes,
+                            host_pages=max(0, int(host_kv_pages)))
         elif pool.page_size != self.page_size:
             # a shared pool (disaggregation: prefill fills, decode seeds)
             # must agree on the sharing granularity
@@ -350,6 +366,24 @@ class ContinuousBatcher:
         # sharded.py); the KV view shards heads over tp here and XLA
         # propagates both through prefill/decode
         self.log = get_logger("serving.batcher")
+        # cluster prefix reuse (serving/kv_directory.py): the engine
+        # advertises every cached prefix to the shared directory and,
+        # on a local miss the directory covers, FETCHES the pages from
+        # the owning peer (``fetch_fn(entry, ids) -> {matched, pages}``
+        # — wire format of disagg.encode_page) instead of re-prefilling.
+        # Fetched pages commit into the local pool + radix tree, so the
+        # stream then rides the ordinary token-identity-tested warm-hit
+        # path.  All three default off; a directory without a fetch_fn
+        # still advertises (gateway affinity alone).
+        self.directory = directory
+        self.engine_id = engine_id or f"engine-{id(self):x}"
+        self.engine_addr = engine_addr
+        self.fetch_fn = fetch_fn
+        self._remote_fetches = 0
+        # costed-drafter exploration cadence (see _spec_step's pre-gate)
+        self._spec_declines = 0
+        if self.directory is not None and self.prefix_cache is not None:
+            self.prefix_cache.on_evict = self._withdraw_prefix
 
         # the RESIDENT decode view: [max_batch, max_seq] per layer,
         # mutated in place by scan and verify dispatches.  Slot rows are
@@ -693,6 +727,9 @@ class ContinuousBatcher:
             }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
+        if self.directory is not None:
+            out["remote_fetches"] = self._remote_fetches
+            out["directory"] = self.directory.stats()
         return out
 
     def _estimated_wait_locked(self, tenant: str | None = None) -> float:
@@ -733,6 +770,11 @@ class ContinuousBatcher:
                 # engine's restart() must not erase a sibling's state
                 DRAINING_GAUGE.inc()
             self._work.notify_all()
+        if self.directory is not None:
+            # a draining engine stops being a fetch target immediately —
+            # routing affinity at it would strand prompts behind a
+            # backend that refuses them
+            self.directory.drop_engine(self.engine_id)
 
     def drained(self, timeout: float = 60.0) -> bool:
         """Block until no request is queued or decoding (or ``timeout``);
@@ -768,6 +810,8 @@ class ContinuousBatcher:
             self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self.directory is not None:
+            self.directory.drop_engine(self.engine_id)
 
     def restart(self) -> None:
         """Reopen a shut-down (or draining) engine; the batcher thread
@@ -778,6 +822,11 @@ class ContinuousBatcher:
             if self._draining:
                 self._draining = False
                 DRAINING_GAUGE.inc(-1)
+        if self.directory is not None and self.prefix_cache is not None:
+            # the pool and cache survived, so the fleet should know the
+            # warm prefixes are back (drain/shutdown dropped them)
+            for toks in self.prefix_cache.cached_prefixes():
+                self._advertise_prefix(list(toks))
 
     # -- compiled pieces -------------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -1444,6 +1493,12 @@ class ContinuousBatcher:
         prompt_len = len(req.ids)
         node, usable = None, 0
         if self.prefix_cache is not None:
+            if self.directory is not None and self.fetch_fn is not None:
+                # cluster prefix reuse: when the directory knows a peer
+                # holding more of this prompt than the local tree, pull
+                # the pages in BEFORE matching — the pinned match below
+                # then sees them as an ordinary local warm hit
+                self._maybe_fetch_remote(req)
             node, matched = self.prefix_cache.match(req.ids, pin=True)
             # always leave >= 1 suffix token: the extend dispatch is where
             # the first-token logits come from (pages hold KV, not logits)
@@ -1465,6 +1520,11 @@ class ContinuousBatcher:
                 # pad by repeating the tail page: the overhang beyond
                 # ``usable`` is garbage the suffix prefill overwrites
                 page_ids += [page_ids[-1]] * (bucket - len(page_ids))
+                # spilled pages fault back to the device tier before the
+                # seed dispatch reads them (the pin guarantees nobody
+                # drops them in between); streams stay bitwise identical
+                # because device_put round-trips every dtype exactly
+                self.prefix_cache.fault(node)
                 scratch = self._seed(bucket)(
                     [self.pool.get(p) for p in page_ids])
             else:
@@ -1577,7 +1637,9 @@ class ContinuousBatcher:
             self.pool.put(pid, tree)
         shared_ids = list(node.pages[:shared]) if shared else []
         if self.prefix_cache is not None:
-            self.prefix_cache.insert(ids, shared_ids + fresh)
+            if self.prefix_cache.insert(ids, shared_ids + fresh):
+                # tell the fleet: this prompt's prefix is now warm HERE
+                self._advertise_prefix(ids)
         if for_handoff:
             # handoff ownership: fresh pages keep the alloc reference,
             # shared pages gain one — released at decode seed (or the
@@ -1588,6 +1650,119 @@ class ContinuousBatcher:
         # the tree holds its own references now; drop the alloc's
         self.pool.decref(fresh)
         return None
+
+    # -- cluster prefix reuse --------------------------------------------------
+    def _advertise_prefix(self, ids) -> None:
+        """Register every full-page prefix of ``ids`` in the cluster
+        directory (no-op without one).  Advisory: a failure here costs
+        the fleet a routing hint, never this request."""
+        if self.directory is None:
+            return
+        try:
+            self.directory.advertise(self.engine_id, self.engine_addr, ids)
+        except Exception:
+            self.log.warning("prefix advertise failed", exc_info=True)
+
+    def _withdraw_prefix(self, tokens) -> None:
+        """Prefix-cache eviction callback: the dropped node's pages are
+        gone, so its directory entries must go too — a peer fetching
+        against them would waste a round trip (never correctness: the
+        owner re-matches its own tree before exporting)."""
+        if self.directory is None:
+            return
+        try:
+            self.directory.withdraw(self.engine_id, tokens)
+        except Exception:
+            self.log.warning("prefix withdraw failed", exc_info=True)
+
+    def _maybe_fetch_remote(self, req: GenRequest) -> None:
+        """Pull a remote peer's prefix pages into the LOCAL radix tree
+        when the directory knows an owner covering strictly more full
+        pages of this prompt than the local match.  On success the
+        caller's ordinary pinned match sees a warm hit — the fetched
+        pages re-enter through the exact token-identity-tested path, so
+        a stale directory entry or a failed fetch degrades to a cold
+        prefill, never a wrong stream."""
+        from kubeflow_tpu.serving.disagg import parse_page_trees
+        from kubeflow_tpu.serving.kv_directory import (REMOTE_FETCHES,
+                                                       REMOTE_FETCH_WAIT)
+
+        _, local = self.prefix_cache.match(req.ids)  # unpinned peek
+        hit = self.directory.lookup(req.ids, exclude=self.engine_id)
+        if hit is None:
+            return
+        if hit["matched"] // self.page_size <= local // self.page_size:
+            return
+        t0 = time.perf_counter()
+        try:
+            payload = self.fetch_fn(hit, list(req.ids[:hit["matched"]]))
+        except Exception as e:
+            self.log.warning("remote prefix fetch failed",
+                             owner=hit["engine_id"], error=str(e))
+            return
+        if not isinstance(payload, dict) or not payload.get("pages"):
+            return
+        try:
+            trees = parse_page_trees(payload["pages"], self)
+        except ValueError as e:
+            self.log.warning("remote prefix pages rejected", error=str(e))
+            return
+        # the owner revalidated against its own tree: it may cover fewer
+        # tokens than advertised, and only whole shipped pages count
+        m = min(int(payload.get("matched", 0)), hit["matched"],
+                len(trees) * self.page_size)
+        n = m // self.page_size
+        if n <= 0:
+            return
+        trees = trees[:n]
+        pids = self.pool.alloc(n)
+        while pids is None:
+            if not self.prefix_cache.evict_lru():
+                return  # pool cannot host the import; prefill locally
+            pids = self.pool.alloc(n)
+        for pid, tree in zip(pids, trees):
+            self.pool.put(pid, tree)
+        inserted = self.prefix_cache.insert(
+            list(req.ids[:n * self.page_size]), pids)
+        # the tree holds its own references now (or rejected the insert);
+        # either way the alloc's reference drops
+        self.pool.decref(pids)
+        if inserted:
+            wait = time.perf_counter() - t0
+            REMOTE_FETCHES.inc()
+            REMOTE_FETCH_WAIT.observe(wait)
+            self._remote_fetches += 1
+            self.log.info("remote prefix imported",
+                          owner=hit["engine_id"], pages=n,
+                          tokens=n * self.page_size,
+                          wait_ms=round(wait * 1e3, 2))
+
+    def export_prefix(self, ids: list[int]) -> dict:
+        """Serve a peer's prefix-page fetch (the ``:pages`` verb): match
+        the local radix tree and ship the FULL pages covering the
+        longest match in the handoff wire format.  Pages ship from
+        whichever tier holds them — a spilled page exports straight from
+        host RAM without faulting (the requester re-materializes on its
+        own device anyway).  Returns matched=0 when the tree cannot
+        cover one full page: the directory entry was stale, and the
+        requester falls back to local prefill."""
+        from kubeflow_tpu.serving.disagg import encode_page
+
+        empty = {"matched": 0, "pages": []}
+        if self.prefix_cache is None or not ids:
+            return empty
+        node, usable = self.prefix_cache.match(list(ids), pin=True)
+        if node is None:
+            return empty
+        try:
+            n = usable // self.page_size  # full pages only
+            if n <= 0:
+                return empty
+            pages = [encode_page(self.pool.get(p))
+                     for p in node.pages[:n]]
+            return {"matched": n * self.page_size, "pages": pages}
+        finally:
+            self.prefix_cache.release(node)
 
     def _decode_chunk(self, queue_empty: bool) -> None:
         remaining = [s.max_new_tokens - len(s.generated)
@@ -1697,11 +1872,46 @@ class ContinuousBatcher:
             return False
         allowed = self.max_seq - 1 - max(
             len(s.ids) + len(s.generated) - 1 for _, s in active)
+        # plan draft lengths BEFORE drafting: an n-gram drafter is free,
+        # but a model drafter pays real forward passes, so a costed
+        # drafter must clear the bar before any draft compute is spent
+        # (planned lengths are the optimistic bound on what drafting
+        # returns — a round the optimistic bound can't justify is dead)
+        plans: dict[int, int] = {}
+        for i, s in active:
+            want = s.max_new_tokens - len(s.generated)
+            plans[i] = max(0, min(s._spec.next_len, want - 1, allowed))
+        if self.draft_cost > 0.0:
+            planned = max(plans.values(), default=0)
+            if planned <= 0:
+                return False
+            gamma_plan = min(next(b for b in self._spec_buckets
+                                  if b >= planned), allowed)
+            expected = sum(1.0 + s._spec.accept_ewma
+                           * min(plans[i], gamma_plan) for i, s in active)
+            cost = (len(active) * (SPEC_COST_BASE
+                                   + SPEC_COST_SLOPE * gamma_plan)
+                    + self.draft_cost * sum(plans.values()))
+            if expected < cost:
+                # the gate can only LEARN accept rates by drafting: a
+                # fresh stream's optimistic-but-short probe never pays
+                # on paper (2.2 expected vs ~2.6 with a real drafter's
+                # forward cost), so a strict gate would starve forever.
+                # Every 4th declined round runs anyway, clamped to the
+                # MIN_DRAFT probe width — bounded exploration that lets
+                # a well-matched draft model bootstrap its EWMA while a
+                # hostile stream pays ~one probe per four scan chunks
+                self._spec_declines += 1
+                if self._spec_declines % 4 != 0:
+                    # no note_skip: the scan chunk records the skip
+                    return False
+                from kubeflow_tpu.serving.speculative import MIN_DRAFT
+
+                plans = {i: min(p, MIN_DRAFT) for i, p in plans.items()}
         drafts: dict[int, list[int]] = {}
         desired = 0
         for i, s in active:
-            want = s.max_new_tokens - len(s.generated)
-            limit = min(s._spec.next_len, want - 1, allowed)
+            limit = plans[i]
             d = self.draft_fn(s.ids + s.generated, limit) if limit > 0 \
                 else []
             drafts[i] = d = list(d[:max(limit, 0)])

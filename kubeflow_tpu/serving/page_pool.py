@@ -1,4 +1,4 @@
-"""Fixed-size KV page pool: refcounted ids + the device page store.
+"""Tiered KV page pool: refcounted ids + HBM store + host-RAM spill arena.
 
 vLLM's PagedAttention insight (Kwon et al., SOSP 2023) applied to this
 engine: prompt KV is cut into fixed-size PAGES (``page_size`` token
@@ -19,6 +19,20 @@ of KV-storage truth:
   tunnel.  ``num_pages`` is the HBM budget — an alloc past it fails and
   the caller evicts LRU cache entries instead.
 
+Mooncake-style tiering (Qin et al. 2024): the pool optionally carries a
+bounded HOST-RAM arena (``host_pages``) one level below HBM.  A cold
+page is SPILLED — its device arrays pulled to pinned host memory — and
+keeps its id and refcounts, so the radix tree's references stay valid
+while the page stops counting against the HBM budget.  A later prefix
+hit FAULTS the page back before seeding; device_get/device_put round a
+page through numpy bitwise (ml_dtypes covers bf16, int8 pages spill
+as-is with their scales), so a spill→fault cycle cannot perturb a
+stream.  The id space is ``num_pages + host_pages`` wide: spilling
+genuinely frees an HBM slot for a fresh allocation instead of merely
+shuffling ids.  Faults are never refused — a seed already holds page
+references and must proceed; budget enforcement lives in ``alloc``,
+whose pressure path spills or evicts.
+
 Thread-safety: the engine's batcher thread is the only allocator writer,
 but stats() is read by scrapers — a lock keeps the counters consistent.
 """
@@ -26,6 +40,7 @@ but stats() is read by scrapers — a lock keeps the counters consistent.
 from __future__ import annotations
 
 import threading
+import time
 
 from kubeflow_tpu.utils.metrics import REGISTRY
 
@@ -34,14 +49,29 @@ PAGES_CAPACITY = REGISTRY.gauge(
     "allocatable KV pages in the device pool (excludes the null page)")
 PAGES_FREE = REGISTRY.gauge(
     "serving_kv_pages_free",
-    "KV pages currently on the free list")
+    "HBM page slots currently unoccupied")
+HBM_PAGES = REGISTRY.gauge(
+    "serving_kv_hbm_pages",
+    "allocated KV pages resident in the device (HBM) tier")
+HOST_PAGES = REGISTRY.gauge(
+    "serving_kv_host_pages",
+    "allocated KV pages spilled to the host-RAM arena")
+SPILLS_TOTAL = REGISTRY.counter(
+    "serving_kv_spills_total",
+    "KV pages spilled from HBM to the host-RAM arena")
+FAULTS_TOTAL = REGISTRY.counter(
+    "serving_kv_faults_total",
+    "KV pages faulted back from the host-RAM arena to HBM")
+FAULT_WAIT = REGISTRY.histogram(
+    "serving_kv_fault_wait_seconds",
+    "wall time a prefix-hit seed waited for spilled pages to fault in")
 
 NULL_PAGE = 0
 
 
 class PagePool:
-    """Refcounted allocator over ``num_pages`` page ids plus the device
-    STORE mapping each live id to its per-layer k/v arrays.
+    """Refcounted allocator over ``num_pages + host_pages`` page ids plus
+    the tiered stores mapping each live id to its per-layer k/v arrays.
 
     Pages are WRITE-ONCE: the engine commits a page's arrays exactly once
     (right after prefill computes them) and every later consumer — a
@@ -49,26 +79,49 @@ class PagePool:
     Sharing is therefore literal object sharing; "copy-on-write" never
     arises because nothing ever writes (decode state lives in the
     engine's resident view, not in pages).  Dropping the last reference
-    deletes the store entry, which frees the device buffers."""
+    deletes the store entry, which frees the buffers in whichever tier
+    holds them."""
 
-    def __init__(self, num_pages: int, page_size: int, page_nbytes: int = 0):
+    def __init__(self, num_pages: int, page_size: int, page_nbytes: int = 0,
+                 host_pages: int = 0):
         if num_pages < 2:
             raise ValueError("pool needs >= 2 pages (one is the null page)")
         if page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if host_pages < 0:
+            raise ValueError("host_pages must be >= 0")
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.page_nbytes = int(page_nbytes)  # all-layer bytes, for stats
+        self.host_pages = int(host_pages)    # host-RAM arena budget
+        self._ids = self.num_pages + self.host_pages
         self._lock = threading.Lock()
         # page 0 is the null page: permanently "allocated", never handed
         # out (keeps the device-side page-TABLE convention of
         # models/llama.py, where id 0 pads unallocated table slots)
-        self._refs = [0] * self.num_pages
+        self._refs = [0] * self._ids
         self._refs[NULL_PAGE] = 1
-        self._free = list(range(self.num_pages - 1, NULL_PAGE, -1))
-        self._store: dict[int, object] = {}   # live id -> per-layer arrays
+        self._free = list(range(self._ids - 1, NULL_PAGE, -1))
+        self._store: dict[int, object] = {}   # device id -> per-layer arrays
+        self._host: dict[int, object] = {}    # spilled id -> numpy arrays
+        self._live = 0                        # allocated ids (either tier)
+        self._spills = 0
+        self._faults = 0
+        self._fault_wait_count = 0
+        self._fault_wait_sum = 0.0
         PAGES_CAPACITY.set(float(self.num_pages - 1))
-        PAGES_FREE.set(float(len(self._free)))
+        PAGES_FREE.set(float(self.num_pages - 1))
+        HBM_PAGES.set(0.0)
+        HOST_PAGES.set(0.0)
+
+    # -- tier accounting (caller holds the lock) -------------------------------
+    def _hbm_used(self) -> int:
+        return self._live - len(self._host)
+
+    def _publish_locked(self) -> None:
+        PAGES_FREE.set(float(self.num_pages - 1 - self._hbm_used()))
+        HBM_PAGES.set(float(self._hbm_used()))
+        HOST_PAGES.set(float(len(self._host)))
 
     # -- device store ----------------------------------------------------------
     def put(self, page: int, tree) -> None:
@@ -79,23 +132,33 @@ class PagePool:
             self._store[page] = tree
 
     def get(self, page: int):
+        """The page's arrays from whichever tier holds them.  A spilled
+        page returns its host (numpy) tree — jnp consumers accept numpy
+        transparently, but the seed path faults explicitly first so tier
+        accounting stays truthful."""
         with self._lock:
+            if page in self._host:
+                return self._host[page]
             return self._store[page]
 
     # -- allocation ------------------------------------------------------------
     def alloc(self, n: int) -> list[int] | None:
-        """Take ``n`` pages (each born with refcount 1); None when the
-        free list cannot cover the request (caller evicts or waits —
-        partial allocations are never handed out)."""
+        """Take ``n`` pages (each born with refcount 1, device-resident);
+        None when the free id list OR the HBM budget cannot cover the
+        request (caller spills/evicts or waits — partial allocations are
+        never handed out)."""
         if n <= 0:
             return []
         with self._lock:
             if len(self._free) < n:
                 return None
+            if self._hbm_used() + n > self.num_pages - 1:
+                return None
             pages = [self._free.pop() for _ in range(n)]
             for p in pages:
                 self._refs[p] = 1
-            PAGES_FREE.set(float(len(self._free)))
+            self._live += n
+            self._publish_locked()
             return pages
 
     def incref(self, pages: list[int]) -> None:
@@ -119,15 +182,93 @@ class PagePool:
                 self._refs[p] -= 1
                 if self._refs[p] == 0:
                     self._free.append(p)
-                    # dropping the store entry releases the device buffers
+                    self._live -= 1
+                    # dropping the store entry releases the buffers in
+                    # whichever tier holds them
                     self._store.pop(p, None)
-            PAGES_FREE.set(float(len(self._free)))
+                    self._host.pop(p, None)
+            self._publish_locked()
+
+    # -- tier movement ---------------------------------------------------------
+    def spill(self, pages: list[int]) -> list[int]:
+        """Move committed device pages to the host-RAM arena; returns the
+        ids actually spilled.  A page is skipped when it is the null
+        page, holds no committed arrays, is already host-resident, or
+        the arena is full — the CALLER enforces the safety rule (only
+        cache-cold, unpinned pages whose sole holders are radix nodes),
+        exactly mirroring eviction eligibility."""
+        import jax
+
+        moved: list[int] = []
+        with self._lock:
+            for p in pages:
+                if p == NULL_PAGE or p in self._host:
+                    continue
+                if self._refs[p] <= 0 or p not in self._store:
+                    continue
+                if len(self._host) >= self.host_pages:
+                    break
+                # device_get rounds every dtype (bf16 via ml_dtypes,
+                # int8 + f32 scales) through numpy bitwise
+                self._host[p] = jax.device_get(self._store.pop(p))
+                moved.append(p)
+            if moved:
+                self._spills += len(moved)
+                SPILLS_TOTAL.inc(len(moved))
+                self._publish_locked()
+        return moved
+
+    def fault(self, pages: list[int]) -> int:
+        """Fault spilled pages back to the device tier; returns how many
+        moved.  Never refused: the caller (a prefix-hit seed, a handoff
+        admission) already holds references and must proceed — HBM
+        accounting may transiently exceed the budget, and the next
+        ``alloc`` under pressure spills or evicts it back down."""
+        t0 = time.perf_counter()
+        moved = 0
+        with self._lock:
+            todo = [p for p in pages if p in self._host]
+            if not todo:
+                return 0
+            import jax.numpy as jnp
+            from jax import tree_util
+
+            for p in todo:
+                self._store[p] = tree_util.tree_map(jnp.asarray,
+                                                    self._host.pop(p))
+                moved += 1
+            wait = time.perf_counter() - t0
+            self._faults += moved
+            self._fault_wait_count += 1
+            self._fault_wait_sum += wait
+            FAULTS_TOTAL.inc(moved)
+            FAULT_WAIT.observe(wait)
+            self._publish_locked()
+        return moved
+
+    def tier(self, page: int) -> str:
+        """``"hbm"`` | ``"host"`` | ``"none"`` (allocated, not committed)."""
+        with self._lock:
+            if page in self._host:
+                return "host"
+            if page in self._store:
+                return "hbm"
+            return "none"
 
     # -- introspection ---------------------------------------------------------
     @property
     def free_count(self) -> int:
+        """Pages an ``alloc`` could still grant: free ids capped by HBM
+        headroom (identical to the free-list length when the pool has no
+        host arena)."""
         with self._lock:
-            return len(self._free)
+            return max(0, min(len(self._free),
+                              self.num_pages - 1 - self._hbm_used()))
+
+    @property
+    def host_count(self) -> int:
+        with self._lock:
+            return len(self._host)
 
     def refcount(self, page: int) -> int:
         with self._lock:
@@ -135,11 +276,21 @@ class PagePool:
 
     def stats(self) -> dict:
         with self._lock:
-            free = len(self._free)
+            hbm = self._hbm_used()
             return {
                 "pages": self.num_pages - 1,
-                "free": free,
-                "in_use": self.num_pages - 1 - free,
+                "free": self.num_pages - 1 - hbm,
+                # BOTH tiers: orphan accounting (in_use minus cached)
+                # must see spilled pages, or a leaked host page would
+                # read as zero orphans forever
+                "in_use": self._live,
+                "hbm_pages": hbm,
+                "host_pages": len(self._host),
+                "host_capacity": self.host_pages,
+                "spills_total": self._spills,
+                "faults_total": self._faults,
+                "fault_wait_seconds": {"count": self._fault_wait_count,
+                                       "sum": self._fault_wait_sum},
                 "page_size": self.page_size,
                 "page_nbytes": self.page_nbytes,
             }

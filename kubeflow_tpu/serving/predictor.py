@@ -39,9 +39,13 @@ class GenerativePredictor:
                  tp: int = 1, ep: int = 1,
                  prefix_cache_mb: float = 0.0, prefill_chunk: int = 512,
                  max_queue: int = 0, kv_page_size: int = 16,
-                 speculative_tokens: int = 0, role: str = "colocated",
+                 host_kv_pages: int = 0,
+                 speculative_tokens: int = 0, draft_layers: int = 0,
+                 role: str = "colocated",
                  kv_quant: bool = False, handoff_post=None,
-                 tenant_shares: dict | None = None):
+                 tenant_shares: dict | None = None,
+                 directory=None, engine_id: str | None = None,
+                 engine_addr: str = ""):
         from kubeflow_tpu.models import registry
 
         self.name = model_name
@@ -153,6 +157,29 @@ class GenerativePredictor:
                          "handoff_fn": self._capture_handoff}
         elif role == "decode":
             engine_kw = {"role": "decode"}
+        # draft_layers > 0 upgrades speculation from n-gram lookup to a
+        # truncated-target draft model (serving/draft_model.py): shared
+        # vocab by construction, no extra checkpoint, and a real accept
+        # rate on run-poor text.  Construction failures (quantized or
+        # exotically sharded params the truncation cannot re-apply) log
+        # and fall back to the free n-gram drafter — speculation is an
+        # optimization, never an availability risk.
+        if draft_layers > 0 and speculative_tokens > 0:
+            try:
+                from kubeflow_tpu.serving.draft_model import DraftModel
+
+                engine_kw["draft_fn"] = DraftModel(
+                    self.params, self.cfg, num_layers=int(draft_layers))
+                self.log.info("draft model enabled",
+                              draft_layers=int(draft_layers),
+                              target_layers=self.cfg.num_layers)
+            except Exception as e:
+                self.log.warning("draft model unavailable; using n-gram",
+                                 error=str(e))
+        if directory is not None:
+            engine_kw.update(directory=directory, engine_id=engine_id,
+                             engine_addr=engine_addr,
+                             fetch_fn=self._fetch_pages)
         self.engine = ContinuousBatcher(self.module, self.params, self.cfg,
                                         max_batch=max_batch,
                                         max_seq=self.max_seq,
@@ -162,6 +189,7 @@ class GenerativePredictor:
                                         prefill_chunk=prefill_chunk,
                                         max_queue=max_queue,
                                         page_size=kv_page_size,
+                                        host_kv_pages=host_kv_pages,
                                         speculative_tokens=(
                                             speculative_tokens),
                                         kv_quant=kv_quant,
@@ -209,6 +237,21 @@ class GenerativePredictor:
                     return None
                 self._hand_cv.wait(min(remaining, 0.1))
             return self._handoffs.pop(id(req))
+
+    def _fetch_pages(self, entry: dict, ids: list[int]) -> dict:
+        """Engine fetch_fn: pull prefix pages peer-to-peer from the
+        directory-advertised owner's ``:pages`` endpoint (handoff wire
+        format; the owner ships from whichever tier holds the pages)."""
+        from kubeflow_tpu.serving.disagg import http_post_json
+
+        return http_post_json(entry["addr"],
+                              f"/v1/models/{self.name}:pages",
+                              {"ids": [int(t) for t in ids]}, timeout=30)
+
+    def export_pages(self, ids: list[int]) -> dict:
+        """``:pages`` verb: serialize the full prefix pages this engine's
+        radix tree covers for ``ids`` (a peer's remote-fetch source)."""
+        return self.engine.export_prefix([int(t) for t in ids])
 
     def resume(self, body: dict, trace_ctx=None) -> dict:
         """Decode-role entry (``:resume``): seed a slot from a serialized
@@ -578,6 +621,12 @@ class PredictorApp:
                     # Retry-After upstream — shed semantics, so the
                     # gateway retries a decode sibling.
                     return "200 OK", pred.resume(body, trace_ctx=trace_ctx)
+                if verb == "pages" and method == "POST":
+                    # cluster prefix reuse: a peer engine (on a directory
+                    # hit) pulls the pages covering its prompt instead of
+                    # re-prefilling them
+                    return "200 OK", pred.export_pages(body.get("ids")
+                                                       or [])
                 if verb == "predict":
                     return "200 OK", pred.predict(body["instances"])
             else:
@@ -635,10 +684,20 @@ def main(argv=None) -> int:
                         help="tokens per KV page: the sharing granularity "
                              "of the paged block pool the prefix cache "
                              "and admissions draw from")
+    parser.add_argument("--host-kv-pages", type=int, default=0,
+                        help="host-RAM spill arena size in KV pages: "
+                             "pressure spills cold prefix pages to host "
+                             "memory instead of dropping them, and a "
+                             "later hit faults them back (0 disables)")
     parser.add_argument("--speculative-tokens", type=int, default=0,
                         help="max draft tokens per speculative-decoding "
                              "verify round (0 disables; output is token-"
                              "identical either way)")
+    parser.add_argument("--draft-layers", type=int, default=0,
+                        help="speculative drafting with a TRUNCATED-"
+                             "target draft model of this many layers "
+                             "(shared vocab, no extra checkpoint); 0 "
+                             "keeps the free n-gram drafter")
     parser.add_argument("--role", default="colocated",
                         choices=("colocated", "prefill", "decode"),
                         help="disaggregated-serving role: prefill workers "
@@ -681,8 +740,12 @@ def main(argv=None) -> int:
                 max_queue=int(opts.get("max_queue", args.max_queue)),
                 kv_page_size=int(opts.get("kv_page_size",
                                           args.kv_page_size)),
+                host_kv_pages=int(opts.get("host_kv_pages",
+                                           args.host_kv_pages)),
                 speculative_tokens=int(opts.get("speculative_tokens",
                                                 args.speculative_tokens)),
+                draft_layers=int(opts.get("draft_layers",
+                                          args.draft_layers)),
                 role=opts.get("role", args.role),
                 kv_quant=opts.get("kv_quant", "").lower()
                 in ("1", "true") or args.kv_quant)

@@ -20,7 +20,17 @@ pool the decode slots also allocate from (serving/page_pool.py):
   eviction frees pages, not whole prefixes;
 - a node PINNED by an in-flight admission (``match(pin=True)`` ..
   ``release()``) is never evicted, so the budget sweep cannot free pages
-  an admission is still wiring into its table.
+  an admission is still wiring into its table;
+- under HBM pressure the sweep SPILLS before it drops (Mooncake-style
+  tiering, Qin et al. 2024): the coldest node's pages move to the pool's
+  bounded host-RAM arena and keep their ids/refcounts, so the prefix
+  stays servable — a later hit faults them back (``fault()``) before
+  seeding.  Only pages whose sole holders are radix nodes are
+  spill-safe: a pool refcount above the node-holder count means an
+  in-flight admission or handoff still reads the device arrays, and a
+  page under any PINNED node is excluded exactly as it is from
+  eviction.  The page budget bounds HBM-RESIDENT cached pages; the host
+  arena is bounded separately by the pool.
 
 The engine (serving/engine.py) owns all device work; this module only
 decides WHAT to share and WHEN to drop references.
@@ -32,7 +42,10 @@ import threading
 import time
 
 from kubeflow_tpu.serving.page_pool import PagePool, pages_for
+from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import REGISTRY
+
+log = get_logger("serving.prefix_cache")
 
 EVICTIONS_TOTAL = REGISTRY.counter(
     "serving_prefix_cache_evictions_total",
@@ -46,11 +59,14 @@ CACHED_BYTES = REGISTRY.gauge(
 CACHED_NODES = REGISTRY.gauge(
     "serving_prefix_cache_nodes",
     "radix-tree nodes currently holding cached pages")
+SPILLED_PAGES = REGISTRY.gauge(
+    "serving_prefix_cache_spilled_pages",
+    "cached prefix pages currently resident in the host-RAM tier")
 
 
 class _Node:
     __slots__ = ("edge", "length", "parent", "children", "pages",
-                 "refs", "last_used")
+                 "refs", "last_used", "tier")
 
     def __init__(self, edge: tuple, parent: "_Node | None"):
         self.edge = edge                      # tokens on the edge from parent
@@ -60,6 +76,7 @@ class _Node:
         self.pages: list[int] | None = None   # page ids covering [0, length)
         self.refs = 0                         # in-flight admissions pinning us
         self.last_used = 0.0
+        self.tier = "hbm"                     # "host" once any page spilled
 
 
 class PrefixCache:
@@ -76,8 +93,14 @@ class PrefixCache:
         self.root = _Node((), None)
         self._noded: set[_Node] = set()     # nodes currently holding pages
         self._page_holders: dict[int, int] = {}  # page id -> #nodes holding
+        self._spilled: set[int] = set()     # cached pages in the host tier
         self._pins = 0                      # outstanding match(pin=True) holds
         self._lock = threading.Lock()
+        # eviction hook (engine -> cluster prefix directory withdrawal):
+        # called with the dropped node's full token prefix AFTER its pages
+        # are released — the directory must stop routing remote hits to a
+        # prefix this engine can no longer serve
+        self.on_evict = None
 
     # -- matching --------------------------------------------------------------
     def match(self, tokens, *, pin: bool = False):
@@ -193,14 +216,51 @@ class PrefixCache:
         return mid
 
     def _evict_to_budget(self, keep: _Node | None = None) -> None:
-        while len(self._page_holders) > self.max_pages:
-            victims = [n for n in self._noded
-                       if n.refs == 0 and n is not keep]
+        # the budget bounds HBM-RESIDENT cached pages: spilling a cold
+        # node's pages to the host arena satisfies it without losing the
+        # prefix, so the sweep spills first and drops only when nothing
+        # more can move (arena full, or every candidate page is shared
+        # with an in-flight consumer)
+        while len(self._page_holders) - len(self._spilled) > self.max_pages:
+            victims = sorted(
+                (n for n in self._noded if n.refs == 0 and n is not keep),
+                key=lambda n: n.last_used)
             if not victims:
                 return  # everything live is pinned; budget temporarily over
-            victim = min(victims, key=lambda n: n.last_used)
-            self._drop(victim)
+            if any(self._spill_node_locked(v) for v in victims):
+                continue
+            self._drop(victims[0])
             EVICTIONS_TOTAL.inc()
+
+    def _pinned_pages_locked(self) -> set[int]:
+        """Pages under any PINNED node: excluded from spill exactly as
+        from eviction — the pinning admission is about to read their
+        device arrays into a seed dispatch."""
+        pinned: set[int] = set()
+        for n in self._noded:
+            if n.refs > 0 and n.pages:
+                pinned.update(n.pages)
+        return pinned
+
+    def _spill_node_locked(self, node: _Node) -> int:
+        """Spill ``node``'s spill-safe pages to the host arena; returns
+        how many pages moved.  Safe means: not already spilled, not under
+        a pinned node, and the pool refcount equals the node-holder count
+        (any excess reference is an in-flight admission or handoff that
+        still reads the device arrays)."""
+        if node.pages is None:
+            return 0
+        pinned = self._pinned_pages_locked()
+        safe = [p for p in node.pages
+                if p not in self._spilled and p not in pinned
+                and self.pool.refcount(p) == self._page_holders.get(p, 0)]
+        if not safe:
+            return 0
+        moved = self.pool.spill(safe)
+        if moved:
+            self._spilled.update(moved)
+            node.tier = "host"
+        return len(moved)
 
     def _drop(self, node: _Node) -> None:
         pages, node.pages = node.pages, None
@@ -208,10 +268,19 @@ class PrefixCache:
             left = self._page_holders.get(p, 0) - 1
             if left <= 0:
                 self._page_holders.pop(p, None)
+                self._spilled.discard(p)
             else:
                 self._page_holders[p] = left
         self.pool.decref(pages)
         self._noded.discard(node)
+        if self.on_evict is not None:
+            try:
+                self.on_evict(self._node_tokens(node))
+            except Exception as exc:
+                # a failed directory withdrawal must not block LRU —
+                # the directory is a hint, a stale entry only costs a
+                # wasted remote fetch
+                log.warning("on_evict callback failed", error=repr(exc))
         # prune pageless leaves so the tree doesn't accumulate dead paths
         while (node is not self.root and node.pages is None
                and not node.children and node.refs == 0):
@@ -219,18 +288,75 @@ class PrefixCache:
             del parent.children[node.edge[0]]
             node = parent
 
+    @staticmethod
+    def _node_tokens(node: _Node) -> tuple:
+        """The full token prefix a node covers (root-to-node edge concat)."""
+        parts = []
+        while node is not None and node.edge:
+            parts.append(node.edge)
+            node = node.parent
+        out: list = []
+        for edge in reversed(parts):
+            out.extend(edge)
+        return tuple(out)
+
     def evict_lru(self) -> bool:
-        """Drop the least-recently-used unpinned node (pool-pressure path:
-        the engine calls this when slot admission cannot allocate).
-        Returns False when nothing is evictable."""
+        """Free HBM held by the least-recently-used unpinned node
+        (pool-pressure path: the engine calls this when slot admission
+        cannot allocate).  Spill-before-drop: moving the coldest safe
+        pages to the host arena frees the same HBM slots WITHOUT losing
+        the prefix; references drop only when nothing can move.  Returns
+        False when nothing is evictable."""
         with self._lock:
-            victims = [n for n in self._noded if n.refs == 0]
+            victims = sorted((n for n in self._noded if n.refs == 0),
+                             key=lambda n: n.last_used)
             if not victims:
                 return False
-            self._drop(min(victims, key=lambda n: n.last_used))
+            for victim in victims:
+                if self._spill_node_locked(victim):
+                    self._publish()
+                    return True
+            self._drop(victims[0])
             EVICTIONS_TOTAL.inc()
             self._publish()
             return True
+
+    def spill_lru(self) -> int:
+        """Explicitly spill the coldest spill-safe node's pages to the
+        host arena (no references dropped); returns pages moved — 0 when
+        the arena is full or nothing is safe to move."""
+        with self._lock:
+            for victim in sorted((n for n in self._noded if n.refs == 0),
+                                 key=lambda n: n.last_used):
+                moved = self._spill_node_locked(victim)
+                if moved:
+                    self._publish()
+                    return moved
+            return 0
+
+    def fault(self, node: _Node) -> int:
+        """Fault a matched node's spilled pages back to the device tier
+        before the engine seeds from them; returns pages moved.  The
+        caller holds the node pinned, so the pages cannot be dropped
+        concurrently."""
+        with self._lock:
+            pages = list(node.pages or ())
+            if not pages:
+                return 0
+            moved = self.pool.fault(pages)
+            if moved:
+                for p in pages:
+                    self._spilled.discard(p)
+                self._publish()
+            node.tier = "hbm"
+            return moved
+
+    def cached_prefixes(self) -> list[tuple]:
+        """Full token prefixes currently holding pages — what a restarted
+        engine re-advertises to the cluster directory (drain dropped its
+        entries, but the tree and pool survived)."""
+        with self._lock:
+            return [self._node_tokens(n) for n in self._noded]
 
     # -- introspection ---------------------------------------------------------
     def stats(self) -> dict:
@@ -243,6 +369,13 @@ class PrefixCache:
                     "max_pages": self.max_pages,
                     "bytes": len(self._page_holders) * self.pool.page_nbytes,
                     "max_bytes": self.max_pages * self.pool.page_nbytes,
+                    # per-tier residency of the cached pages: the budget
+                    # bounds the HBM side, the pool's arena the host side
+                    "hbm_pages": (len(self._page_holders)
+                                  - len(self._spilled)),
+                    "host_pages": len(self._spilled),
+                    "host_nodes": sum(1 for n in self._noded
+                                      if n.tier == "host"),
                     "nodes": len(self._noded), "pinned": self._pins,
                     # token positions the tree could serve vs the page
                     # positions actually held: > 1.0 means page sharing
@@ -255,3 +388,4 @@ class PrefixCache:
         CACHED_BYTES.set(float(len(self._page_holders)
                                * self.pool.page_nbytes))
         CACHED_NODES.set(float(len(self._noded)))
+        SPILLED_PAGES.set(float(len(self._spilled)))

@@ -141,7 +141,10 @@ def main() -> int:
     engine = ContinuousBatcher(module, params, cfg, max_batch=max_batch,
                                max_seq=max_seq, max_queue=max_queue,
                                prefix_cache_bytes=32 << 20,
-                               prefill_chunk=64)
+                               prefill_chunk=64,
+                               # host-RAM spill arena: the storm's leak
+                               # invariants must hold across BOTH tiers
+                               host_kv_pages=16 if smoke else 32)
     injector = ChaosInjector(APIServer(), seed=7)
     eos = cfg.vocab_size - 1                 # never sampled under greedy:
     # keeps eos traffic active so decode runs in small chunks under queue
@@ -208,10 +211,28 @@ def main() -> int:
         deadline_ok = True
     rd.result(timeout=120)
 
+    # tier churn on the quiet engine: spill the cold cached prefixes to
+    # the host arena, then decode against one — the hit must fault its
+    # pages back and stream normally, with the tier accounting balanced
+    # throughout (hbm + host == in_use, pinned pages never spilled)
+    spilled = 0
+    while True:
+        moved = engine.prefix_cache.spill_lru()
+        if not moved:
+            break
+        spilled += moved
+    engine.submit(prompts[1], max_new_tokens=2, eos_id=eos).result(120)
+
     # post-storm: every request must have reached a terminal outcome and
     # every resource must be free
     idle = engine.drained(timeout=30)
     stats = engine.stats()
+    kvp = stats.get("kv_pool", {})
+    tier_balanced = (kvp.get("hbm_pages", 0) + kvp.get("host_pages", 0)
+                     == kvp.get("in_use", 0)
+                     and kvp.get("host_pages", 0)
+                     <= kvp.get("host_capacity", 0))
+    faulted = kvp.get("faults_total", 0)
     pins = stats.get("prefix_cache", {}).get("pinned", 0)
     # paged-KV leak check (ISSUE 11, mirroring the prefix-pin invariant):
     # after the cancel/deadline storm every committed page must be
@@ -242,8 +263,15 @@ def main() -> int:
     engine.restart()
     engine.submit(prompts[0], max_new_tokens=2, eos_id=eos).result(120)
     post = engine.stats()
-    restart_ok = (post.get("kv_pool", {}).get("orphan_pages", 0) == 0
-                  and post.get("prefix_cache", {}).get("pinned", 0) == 0)
+    post_kvp = post.get("kv_pool", {})
+    restart_ok = (post_kvp.get("orphan_pages", 0) == 0
+                  and post.get("prefix_cache", {}).get("pinned", 0) == 0
+                  # the host tier survives restart with the pool — its
+                  # accounting must still balance (no page stranded
+                  # between tiers by the shutdown/restart cycle)
+                  and (post_kvp.get("hbm_pages", 0)
+                       + post_kvp.get("host_pages", 0)
+                       == post_kvp.get("in_use", 0)))
     engine.shutdown()
 
     result = {
@@ -260,6 +288,10 @@ def main() -> int:
         "engine_counts": counts,
         "post_storm": {"active": stats["active"], "queued": stats["queued"],
                        "prefix_pins": pins, "orphan_pages": orphans,
+                       "spilled_pages": spilled,
+                       "faulted_pages": faulted,
+                       "host_pages": kvp.get("host_pages", 0),
+                       "tier_balanced": tier_balanced,
                        "idle": idle,
                        "drain_rejects_new": drain_ok,
                        "cancel_evicts": cancel_ok,
@@ -278,6 +310,11 @@ def main() -> int:
     if orphans != 0:
         failures.append(f"leaked KV pages after the storm: {orphans} in "
                         "use but not cache-owned")
+    if not tier_balanced:
+        failures.append(f"tier accounting unbalanced: {kvp}")
+    if spilled and not faulted:
+        failures.append("spilled prefixes were never faulted back by the "
+                        "post-spill warm hit")
     if orphans_down != 0 or pins_down != 0:
         failures.append(f"shutdown leaked: {orphans_down} pages / "
                         f"{pins_down} pins")

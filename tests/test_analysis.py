@@ -699,6 +699,39 @@ _tls = threading.local()
     assert "handoff-threadlocal" not in rules_of(tree.run())
 
 
+def test_handoff_threadlocal_fires_on_directory_adjacent_module(tree):
+    """PrefixDirectory is a handoff marker (ISSUE 17): directory
+    lookups cross gateway/engine threads, so a module wiring the
+    cluster prefix directory inherits the thread-local ban."""
+    tree("kubeflow_tpu/other/router.py", """\
+import threading
+from kubeflow_tpu.serving.kv_directory import PrefixDirectory
+
+_tls = threading.local()
+
+def route(d: PrefixDirectory, ids):
+    _tls.hit = d.lookup(ids)
+""")
+    assert "handoff-threadlocal" in rules_of(tree.run())
+
+
+def test_metric_name_rules_cover_kv_tier_family(tree):
+    """The serving_kv_* tier/directory metrics follow the suffix rules
+    the hygiene pass enforces — and the pass still catches a
+    wrong-suffix variant of the same family."""
+    tree("kubeflow_tpu/serving/m.py", """\
+A = REGISTRY.counter("serving_kv_spills_total", "ok")
+B = REGISTRY.counter("serving_kv_remote_fetches_total", "ok")
+C = REGISTRY.histogram("serving_kv_fault_wait_seconds", "ok")
+D = REGISTRY.gauge("serving_kv_host_pages", "ok")
+E = REGISTRY.gauge("serving_kv_directory_entries", "ok")
+BAD1 = REGISTRY.counter("serving_kv_faults", "missing _total")
+BAD2 = REGISTRY.gauge("serving_kv_spilled_total", "counter-shaped gauge")
+""")
+    found = [f for f in tree.run() if f.rule == "metric-name"]
+    assert [f.line for f in found] == [6, 7]
+
+
 def test_handoff_threadlocal_suppression_pays_rent(tree):
     tree("kubeflow_tpu/serving/s.py", """\
 import threading

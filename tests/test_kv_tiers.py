@@ -1,0 +1,539 @@
+"""Cluster-scale KV economy (serving/page_pool.py host tier,
+serving/kv_directory.py, serving/draft_model.py): spill/fault bitwise
+identity across tiers, pin/refcount exclusion from spill, cross-engine
+prefix reuse through the directory, and draft-model speculation."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.kv_directory import PrefixDirectory, prefix_hashes
+from kubeflow_tpu.serving.page_pool import PagePool
+from kubeflow_tpu.serving.prefix_cache import PrefixCache
+
+PS = 2  # unit-test page size (tokens per page)
+
+
+def _tiny_model():
+    from kubeflow_tpu.models import llama as lm
+    from kubeflow_tpu.parallel.sharding import unbox_params
+
+    cfg = lm.LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                         num_heads=2, num_kv_heads=2, intermediate_size=64,
+                         max_seq_len=128, use_flash=False)
+    module = lm.LlamaModel(cfg)
+    params = unbox_params(module.init(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, 8), jnp.int32))
+                          ["params"])
+    return module, params, cfg
+
+
+def _page_tree(seed: int, dtype=jnp.bfloat16):
+    """A committed page's per-layer k/v arrays, [page, heads, dim]."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return {"layers": [{
+        "k": jax.random.normal(k1, (PS, 2, 4)).astype(dtype),
+        "v": jax.random.normal(k2, (PS, 2, 4)).astype(dtype),
+    }]}
+
+
+def _tree_bytes(tree) -> list[bytes]:
+    return [np.asarray(jax.device_get(leaf)).tobytes()
+            for leaf in jax.tree_util.tree_leaves(tree)]
+
+
+# -- pool tier: spill/fault round trips ----------------------------------------
+def test_pool_spill_fault_bitwise_roundtrip():
+    pool = PagePool(4, PS, host_pages=4)
+    ids = pool.alloc(2)
+    trees = {p: _page_tree(p) for p in ids}
+    before = {p: _tree_bytes(trees[p]) for p in ids}
+    for p in ids:
+        pool.put(p, trees[p])
+
+    assert sorted(pool.spill(ids)) == sorted(ids)
+    for p in ids:
+        assert pool.tier(p) == "host"
+        # host tree readable (numpy) and already bitwise-equal
+        assert _tree_bytes(pool.get(p)) == before[p]
+    st = pool.stats()
+    assert st["host_pages"] == 2 and st["hbm_pages"] == 0
+    assert st["spills_total"] == 2
+    # spill is idempotent: already-host pages are skipped
+    assert pool.spill(ids) == []
+
+    assert pool.fault(ids) == 2
+    for p in ids:
+        assert pool.tier(p) == "hbm"
+        assert _tree_bytes(pool.get(p)) == before[p]
+    st = pool.stats()
+    assert st["host_pages"] == 0 and st["faults_total"] == 2
+    assert st["fault_wait_seconds"]["count"] == 1
+    pool.decref(ids)
+    assert pool.stats()["in_use"] == 0
+
+
+def test_pool_int8_page_spill_fault_bitwise():
+    """Quantized pages (int8 k/v + f32 per-head scales) must survive a
+    spill->fault cycle without a single bit moving — the int8 grid is
+    already lossy once; the tier hop must not round again."""
+    from kubeflow_tpu.serving.quant import quantize_kv
+
+    pool = PagePool(4, PS, host_pages=2)
+    (pid,) = pool.alloc(1)
+    k = jax.random.normal(jax.random.PRNGKey(3), (PS, 2, 4), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(4), (PS, 2, 4), jnp.float32)
+    qk, ks = quantize_kv(k)
+    qv, vs = quantize_kv(v)
+    tree = {"layers": [{"k": qk, "ks": ks, "v": qv, "vs": vs}]}
+    before = _tree_bytes(tree)
+    pool.put(pid, tree)
+
+    assert pool.spill([pid]) == [pid]
+    assert pool.fault([pid]) == 1
+    after = pool.get(pid)
+    assert _tree_bytes(after) == before
+    # dtypes preserved through numpy and back
+    leaf = after["layers"][0]
+    assert jnp.asarray(leaf["k"]).dtype == jnp.int8
+    assert jnp.asarray(leaf["ks"]).dtype == jnp.float32
+    pool.decref([pid])
+
+
+def test_pool_spill_frees_hbm_headroom_and_caps_arena():
+    pool = PagePool(4, PS, host_pages=2)          # 3 HBM slots, 2 host
+    ids = pool.alloc(3)
+    for p in ids:
+        pool.put(p, _page_tree(p))
+    assert pool.free_count == 0
+    assert pool.alloc(1) is None                  # HBM budget exhausted
+
+    moved = pool.spill(ids)                       # arena caps at 2
+    assert len(moved) == 2
+    assert pool.free_count == 2                   # spilling freed HBM slots
+    extra = pool.alloc(2)
+    assert extra is not None
+    st = pool.stats()
+    assert st["in_use"] == 5                      # both tiers counted
+    assert st["hbm_pages"] == 3 and st["host_pages"] == 2
+    assert st["host_capacity"] == 2
+
+    # faults are never refused, even with zero HBM headroom
+    assert pool.fault(moved) == 2
+    assert pool.stats()["host_pages"] == 0
+    pool.decref(ids)
+    pool.decref(extra)
+    assert pool.stats()["in_use"] == 0
+
+
+def test_pool_spill_skips_uncommitted_and_free_pages():
+    pool = PagePool(4, PS, host_pages=4)
+    ids = pool.alloc(2)
+    # no put() yet: nothing to ship
+    assert pool.spill(ids) == []
+    pool.put(ids[0], _page_tree(1))
+    pool.decref([ids[1]])
+    assert pool.spill(ids) == [ids[0]]            # freed id skipped
+    pool.decref([ids[0]])
+
+
+def test_pool_without_host_arena_is_unchanged():
+    """host_pages=0 keeps the exact pre-tier semantics: spill is a no-op
+    and free accounting matches the plain free list."""
+    pool = PagePool(4, PS)
+    ids = pool.alloc(2)
+    pool.put(ids[0], _page_tree(9))
+    assert pool.spill(ids) == []
+    assert pool.free_count == 1
+    assert pool.stats()["host_capacity"] == 0
+    pool.decref(ids)
+
+
+# -- cache tier: spill-safety mirrors eviction eligibility ---------------------
+def _cache(max_pages: int, pool_pages: int, host: int = 8):
+    pool = PagePool(pool_pages, PS, host_pages=host)
+    return pool, PrefixCache(pool, max_pages)
+
+
+def _insert(pool, cache, tokens):
+    n = -(-len(tokens) // PS)
+    pages = pool.alloc(n)
+    for p in pages:
+        pool.put(p, _page_tree(p))
+    assert cache.insert(tokens, pages)
+    pool.decref(pages)
+    return pages
+
+
+def test_cache_spill_lru_picks_cold_and_fault_restores_bitwise():
+    pool, cache = _cache(8, 10)
+    a = (1, 2, 3, 4)
+    b = (9, 8, 7, 6)
+    pa = _insert(pool, cache, a)
+    _insert(pool, cache, b)
+    before = {p: _tree_bytes(pool.get(p)) for p in pa}
+    node, _ = cache.match(b)                      # touch b: a is now LRU
+    assert node is not None
+
+    assert cache.spill_lru() == len(pa)
+    assert all(pool.tier(p) == "host" for p in pa)
+    st = cache.stats()
+    assert st["host_pages"] == len(pa)
+    assert st["hbm_pages"] == st["pages"] - len(pa)
+
+    node, usable = cache.match(a, pin=True)
+    assert usable == len(a)
+    try:
+        assert cache.fault(node) == len(pa)
+    finally:
+        cache.release(node)
+    assert all(pool.tier(p) == "hbm" for p in pa)
+    for p in pa:
+        assert _tree_bytes(pool.get(p)) == before[p]
+    assert cache.stats()["host_pages"] == 0
+    assert cache.stats()["pinned"] == 0
+
+
+def test_pinned_and_seed_held_pages_never_spill():
+    """The spill-safety rule is EXACTLY eviction eligibility: a pinned
+    node's pages stay put, and so do pages an in-flight seed still
+    holds (pool refcount above the radix tree's own holds) — the
+    refcount-guard regression behind the cancel-storm fix."""
+    pool, cache = _cache(8, 10)
+    a = (1, 2, 3, 4)
+    pa = _insert(pool, cache, a)
+
+    node, _ = cache.match(a, pin=True)            # admission mid-prefill
+    assert cache.spill_lru() == 0                 # pinned: not spillable
+    assert cache.evict_lru() is False             # ...nor evictable
+    cache.release(node)
+
+    pool.incref(pa)                               # a seed still reads them
+    assert cache.spill_lru() == 0
+    pool.decref(pa)                               # seed committed/freed
+
+    assert cache.spill_lru() == len(pa)           # now cold and safe
+    assert all(pool.tier(p) == "host" for p in pa)
+    assert cache.stats()["pinned"] == 0
+
+
+def test_cache_budget_spills_before_dropping():
+    """Over-budget inserts move the coldest node host-side first; pages
+    drop only when the arena cannot absorb them."""
+    pool, cache = _cache(2, 10, host=2)           # budget: 2 HBM cache pages
+    _insert(pool, cache, (1, 2, 3, 4))            # 2 pages, at budget
+    _insert(pool, cache, (5, 6, 7, 8))            # 2 more: evict path runs
+    st = cache.stats()
+    assert st["hbm_pages"] <= 2
+    assert st["host_pages"] == 2                  # spilled, not dropped
+    assert st["pages"] == 4                       # nothing lost
+    _insert(pool, cache, (9, 10, 11, 12))         # arena full: must drop
+    st = cache.stats()
+    assert st["hbm_pages"] <= 2 and st["host_pages"] <= 2
+    assert pool.stats()["in_use"] == st["pages"]  # zero orphans either tier
+
+
+# -- directory: chained hashes and ownership -----------------------------------
+def test_prefix_hashes_chain_and_alignment():
+    toks = list(range(1, 20))
+    hs = prefix_hashes(toks, 4)
+    assert len(hs) == 4                           # full pages only
+    # extending the prompt extends the chain without rewriting it
+    assert prefix_hashes(toks + [99, 98, 97, 96], 4)[:4] == hs
+    # sharing a middle window only must never alias (chain from 0)
+    assert prefix_hashes(toks[4:], 4)[0] != hs[1]
+    # a different page size seeds a different chain
+    assert prefix_hashes(toks, 2)[1] != hs[0]
+    assert prefix_hashes(toks[:3], 4) == []
+
+
+def test_directory_advertise_lookup_withdraw_drop():
+    d = PrefixDirectory(page_size=4)
+    toks = list(range(1, 13))                     # 3 full pages
+    assert d.advertise("a", "host:1", toks) == 3
+    hit = d.lookup(toks + [50, 51])
+    assert hit["engine_id"] == "a" and hit["matched"] == 12
+    assert d.lookup(toks[:6])["matched"] == 4     # longest FULL page
+    assert d.lookup(toks, exclude="a") is None    # don't route to self
+    assert d.lookup([7, 7, 7, 7]) is None
+
+    # latest advertiser wins the contested hashes
+    assert d.advertise("b", "host:2", toks[:8]) == 2
+    assert d.lookup(toks)["engine_id"] == "a"     # page 3 still a's
+    assert d.lookup(toks[:8])["engine_id"] == "b"
+
+    assert d.withdraw("a", toks) == 1             # only the hash a still owns
+    assert d.lookup(toks)["engine_id"] == "b"     # falls back to b's 8
+    assert d.drop_engine("b") == 2
+    assert d.lookup(toks) is None
+    assert d.stats()["entries"] == 0
+
+
+# -- engine integration: two engines, one directory ----------------------------
+PROMPT = [5, 8, 13, 21, 3, 9, 2, 17, 11, 4, 6, 12, 25, 31, 7, 19,
+          23, 29, 37, 41, 43, 47, 53, 59]        # 3 full pages @ ps=8
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    module, params, cfg = _tiny_model()
+    directory = PrefixDirectory(page_size=8)
+    engines = {}
+
+    def fetch(entry, ids):
+        return engines[entry["engine_id"]].export_prefix(ids)
+
+    for name in ("a", "b"):
+        engines[name] = ContinuousBatcher(
+            module, params, cfg, max_batch=2, max_seq=96, page_size=8,
+            prefix_cache_bytes=1 << 20, host_kv_pages=16,
+            directory=directory, engine_id=name,
+            engine_addr=f"local:{name}", fetch_fn=fetch)
+    cold = ContinuousBatcher(module, params, cfg, max_batch=2, max_seq=96,
+                             page_size=8)
+    yield engines, directory, cold
+    for e in (*engines.values(), cold):
+        e.shutdown()
+
+
+@pytest.mark.slow
+def test_remote_prefix_hit_stream_identical_to_cold(cluster):
+    engines, directory, cold = cluster
+    want = cold.generate_sync([PROMPT], max_new_tokens=8)[0]
+
+    got_a = engines["a"].generate_sync([PROMPT], max_new_tokens=8)[0]
+    assert got_a == want
+    assert directory.lookup(PROMPT)["engine_id"] == "a"
+
+    # engine b has a cold radix tree: the directory points it at a, the
+    # pages ship peer-to-peer, and the stream must not move one token
+    got_b = engines["b"].generate_sync([PROMPT], max_new_tokens=8)[0]
+    assert got_b == want
+    assert engines["b"].stats()["remote_fetches"] >= 1
+
+
+@pytest.mark.slow
+def test_remote_hit_seeded_ragged_cobatch_identical(cluster):
+    engines, directory, cold = cluster
+    a = PROMPT + [60, 61, 62]
+    b = PROMPT + [70]
+    kw = dict(max_new_tokens=8, temperature=1.3, seed=11, top_k=4)
+    want = cold.generate_sync([a, b], **kw)
+
+    engines["a"].generate_sync([PROMPT], max_new_tokens=2)  # a owns prefix
+    # b decodes both rows together, seeded, off remotely-fetched pages
+    got = engines["b"].generate_sync([a, b], **kw)
+    assert got == want
+
+
+def test_export_ships_full_pages_from_host_tier(cluster):
+    engines, directory, cold = cluster
+    eng = engines["a"]
+    eng.generate_sync([PROMPT], max_new_tokens=2)
+    # push a's cached prefix down to the host arena: export must still
+    # serve (from host bytes — no fault on the owner's side)
+    while eng.prefix_cache.spill_lru():
+        pass
+    faults_before = eng.pool.stats()["faults_total"]
+    out = eng.export_prefix(PROMPT)
+    assert out["matched"] == 24                   # full pages only
+    assert len(out["pages"]) == 3
+    assert eng.pool.stats()["faults_total"] == faults_before
+    assert eng.export_prefix([101, 102]) == {"matched": 0, "pages": []}
+    assert eng.stats()["prefix_cache"]["pinned"] == 0
+
+
+def test_directory_follows_drain_and_restart(cluster):
+    engines, directory, cold = cluster
+    eng = engines["a"]
+    eng.generate_sync([PROMPT], max_new_tokens=2)
+    assert directory.lookup(PROMPT) is not None
+
+    eng.drain()
+    assert eng.drained(timeout=30)
+    assert directory.lookup(PROMPT, exclude="b") is None  # a withdrew
+
+    eng.restart()                                 # pages survived the drain
+    hit = directory.lookup(PROMPT, exclude="b")
+    assert hit is not None and hit["engine_id"] == "a"
+    assert eng.generate_sync(
+        [PROMPT], max_new_tokens=8)[0] == cold.generate_sync(
+        [PROMPT], max_new_tokens=8)[0]
+
+
+# -- engine: spill -> fault stream identity ------------------------------------
+@pytest.mark.slow
+def test_spill_fault_stream_identical_greedy_and_seeded():
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    module, params, cfg = _tiny_model()
+    ref = ContinuousBatcher(module, params, cfg, max_batch=2, max_seq=96,
+                            page_size=8)
+    eng = ContinuousBatcher(module, params, cfg, max_batch=2, max_seq=96,
+                            page_size=8, prefix_cache_bytes=1 << 20,
+                            host_kv_pages=16)
+    try:
+        want = ref.generate_sync([PROMPT], max_new_tokens=8)[0]
+        eng.generate_sync([PROMPT], max_new_tokens=2)     # populate
+        while eng.prefix_cache.spill_lru():
+            pass
+        assert eng.pool.stats()["host_pages"] > 0
+        f0 = eng.pool.stats()["faults_total"]
+        assert eng.generate_sync([PROMPT], max_new_tokens=8)[0] == want
+        assert eng.pool.stats()["faults_total"] > f0      # seed faulted
+
+        kw = dict(max_new_tokens=8, temperature=0.9, seed=3, top_p=0.9)
+        want_s = ref.generate_sync([PROMPT], **kw)
+        while eng.prefix_cache.spill_lru():
+            pass
+        assert eng.generate_sync([PROMPT], **kw) == want_s
+        st = eng.stats()
+        assert st["prefix_cache"]["pinned"] == 0
+        assert st["kv_pool"]["orphan_pages"] == 0
+    finally:
+        ref.shutdown()
+        eng.shutdown()
+
+
+@pytest.mark.slow
+def test_int8_spill_fault_warm_stream_identical():
+    """kv_quant pages spill with their scales and fault back bitwise:
+    the warm hit after a tier round-trip replays the exact warm stream
+    (int8 is lossy ONCE, at commit — never again at the tier hop)."""
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    module, params, cfg = _tiny_model()
+    eng = ContinuousBatcher(module, params, cfg, max_batch=2, max_seq=96,
+                            page_size=8, prefix_cache_bytes=1 << 19,
+                            host_kv_pages=16, kv_quant=True)
+    try:
+        eng.generate_sync([PROMPT], max_new_tokens=2)     # commit int8 pages
+        warm = eng.generate_sync([PROMPT], max_new_tokens=8)[0]
+        while eng.prefix_cache.spill_lru():
+            pass
+        assert eng.pool.stats()["host_pages"] > 0
+        assert eng.generate_sync([PROMPT], max_new_tokens=8)[0] == warm
+        assert eng.stats()["kv_pool"]["orphan_pages"] == 0
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.slow
+def test_cancel_storm_under_tier_pressure_leaves_no_pins():
+    """Race a cancel storm against continuous spill pressure: every pin
+    must unwind, both tiers must balance, and the surviving prefix must
+    still fault back into the exact cold stream."""
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    module, params, cfg = _tiny_model()
+    eng = ContinuousBatcher(module, params, cfg, max_batch=4, max_seq=96,
+                            page_size=8, prefix_cache_bytes=1 << 20,
+                            kv_pages=24, host_kv_pages=16)
+    try:
+        base = PROMPT[:16]
+        want = eng.generate_sync([base], max_new_tokens=6)[0]
+
+        stop = threading.Event()
+
+        def pressure():
+            while not stop.is_set():
+                eng.prefix_cache.spill_lru()
+                time.sleep(0.001)
+
+        t = threading.Thread(target=pressure, daemon=True)
+        t.start()
+        try:
+            for round_ in range(5):
+                reqs = [eng.submit(base + [64 + round_, 64 + i],
+                                   max_new_tokens=10) for i in range(3)]
+                time.sleep(0.01)
+                for r in reqs[::2]:
+                    r.cancel()
+                for r in reqs:
+                    try:
+                        r.result(60)
+                    except (ValueError, RuntimeError):
+                        pass                      # cancelled rows raise
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = eng.stats()
+            if st["prefix_cache"]["pinned"] == 0:
+                break
+            time.sleep(0.05)
+        st = eng.stats()
+        assert st["prefix_cache"]["pinned"] == 0
+        kvp = st["kv_pool"]
+        assert kvp["orphan_pages"] == 0
+        assert kvp["hbm_pages"] + kvp["host_pages"] == kvp["in_use"]
+
+        while eng.prefix_cache.spill_lru():
+            pass
+        assert eng.generate_sync([base], max_new_tokens=6)[0] == want
+    finally:
+        eng.shutdown()
+
+
+# -- draft model ----------------------------------------------------------------
+def test_truncate_params_structure_and_cost():
+    from kubeflow_tpu.serving.draft_model import DraftModel, truncate_params
+
+    module, params, cfg = _tiny_model()
+    t = truncate_params(params, 1)
+    assert "layer_0" in t and "layer_1" not in t
+    assert "final_norm" in t and "tok_embeddings" in t
+    dm = DraftModel(params, cfg, num_layers=1)
+    assert 0.0 < dm.cost_per_token < 1.0          # cheaper than the target
+
+
+@pytest.mark.slow
+def test_draft_model_incremental_matches_fresh():
+    """The per-stream KV context cache must be invisible: drafting from
+    an extended prefix equals a cold draft of the same prefix."""
+    from kubeflow_tpu.serving.draft_model import DraftModel
+
+    module, params, cfg = _tiny_model()
+    dm = DraftModel(params, cfg, num_layers=1)
+    toks = PROMPT[:18]
+    first = dm.draft(toks, 4)
+    assert len(first) == 4
+    ext = toks + first[:2] + [99]                 # partial accept + correction
+    inc = dm.draft(ext, 4)
+    fresh = DraftModel(params, cfg, num_layers=1).draft(ext, 4)
+    assert inc == fresh
+    assert len(dm._ctx) <= dm.max_entries
+
+
+@pytest.mark.slow
+def test_draft_model_speculation_streams_identical():
+    """Speculative verify is exact: swapping the n-gram drafter for the
+    truncated-target draft model must not move a single token, greedy
+    or seeded."""
+    from kubeflow_tpu.serving.draft_model import DraftModel
+    from kubeflow_tpu.serving.engine import ContinuousBatcher
+
+    module, params, cfg = _tiny_model()
+    plain = ContinuousBatcher(module, params, cfg, max_batch=2, max_seq=96)
+    dm = DraftModel(params, cfg, num_layers=1)
+    spec = ContinuousBatcher(module, params, cfg, max_batch=2, max_seq=96,
+                             speculative_tokens=4, draft_fn=dm)
+    try:
+        assert spec.draft_cost == pytest.approx(dm.cost_per_token)
+        a, b = PROMPT[:14], PROMPT[:9]
+        assert (spec.generate_sync([a, b], max_new_tokens=10)
+                == plain.generate_sync([a, b], max_new_tokens=10))
+        kw = dict(max_new_tokens=8, temperature=1.1, seed=7, top_k=4)
+        assert (spec.generate_sync([a], **kw)
+                == plain.generate_sync([a], **kw))
+    finally:
+        plain.shutdown()
+        spec.shutdown()
