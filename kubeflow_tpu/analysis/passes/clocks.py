@@ -33,8 +33,11 @@ NOW_PARAM_SCOPE = ("kubeflow_tpu/controllers/", "kubeflow_tpu/autoscale/",
 # has declared the parameter yet: the QoS limiter/WFQ must stay
 # deterministic (token-bucket refill and fair tags are replayed by the
 # tenancy loadtest's digest gate), so a raw time call there is a bug
-# even before a clock param exists to catch it
-ALWAYS_INJECTED_SCOPE = ("kubeflow_tpu/qos/",)
+# even before a clock param exists to catch it; the model pool's LRU
+# recency and load-latency timings are under the same decree (the fleet
+# loadtest replays eviction order against a fake clock)
+ALWAYS_INJECTED_SCOPE = ("kubeflow_tpu/qos/",
+                         "kubeflow_tpu/serving/model_pool.py")
 BANNED = {"time", "monotonic", "sleep"}
 
 

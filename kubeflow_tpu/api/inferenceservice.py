@@ -32,6 +32,11 @@ ROLE_ANNOTATION = "serving.kubeflow.org/role"
 # and dequantizes at decode seed (~2x effective page capacity;
 # perplexity-neutral, not bit-identical)
 KV_QUANT_ANNOTATION = "serving.kubeflow.org/kv-quant"
+# fleet weight residency: the HBM byte budget in MB shared by all model
+# weights on the predictor (0/absent = every model stays resident; >0
+# arms the residency manager — LRU eviction parks cold models' weights
+# and re-warms them on demand, serving/model_pool.py)
+WEIGHT_BUDGET_ANNOTATION = "serving.kubeflow.org/weight-budget-mb"
 
 
 def new(name: str, namespace: str, *, model: str = "llama",
@@ -42,7 +47,8 @@ def new(name: str, namespace: str, *, model: str = "llama",
         kv_page_size: int | None = None,
         speculative_tokens: int | None = None,
         role: str | None = None,
-        kv_quant: bool = False) -> dict:
+        kv_quant: bool = False,
+        weight_budget_mb: float | None = None) -> dict:
     isvc = api_object(KIND, name, namespace, spec={
         "predictor": {
             "model": model,
@@ -63,6 +69,8 @@ def new(name: str, namespace: str, *, model: str = "llama",
         annotations[ROLE_ANNOTATION] = role
     if kv_quant:
         annotations[KV_QUANT_ANNOTATION] = "true"
+    if weight_budget_mb:
+        annotations[WEIGHT_BUDGET_ANNOTATION] = str(weight_budget_mb)
     if not annotations:
         del isvc["metadata"]["annotations"]
     return isvc
@@ -100,6 +108,15 @@ def role(isvc: dict) -> str:
     raw = isvc.get("metadata", {}).get("annotations", {}).get(
         ROLE_ANNOTATION)
     return raw if raw else "colocated"
+
+
+def weight_budget_mb(isvc: dict) -> float:
+    """The annotated fleet weight budget in MB (0 = all-resident)."""
+    raw = isvc.get("metadata", {}).get("annotations", {}).get(
+        WEIGHT_BUDGET_ANNOTATION)
+    if raw is None:
+        return 0.0
+    return float(raw)
 
 
 def kv_quant(isvc: dict) -> bool:
@@ -153,3 +170,13 @@ def validate(isvc: dict) -> None:
     if raw_quant is not None and str(raw_quant).lower() not in (
             "1", "true", "0", "false"):
         raise ValueError(f"{KV_QUANT_ANNOTATION} must be a boolean")
+    try:
+        budget = weight_budget_mb(isvc)
+    except ValueError:
+        raise ValueError(
+            f"{WEIGHT_BUDGET_ANNOTATION} must be a number (MB)")
+    if not math.isfinite(budget):
+        raise ValueError(
+            f"{WEIGHT_BUDGET_ANNOTATION} must be a finite number (MB)")
+    if budget < 0:
+        raise ValueError(f"{WEIGHT_BUDGET_ANNOTATION} must be >= 0")

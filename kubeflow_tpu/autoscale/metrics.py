@@ -49,6 +49,11 @@ class MetricsCollector:
         self._backend_inflight: dict[tuple, int] = {}
         # key -> stats fn returning a dict with "active"/"queued" counts
         self._sources: dict[Key, Callable[[], dict]] = {}
+        # (host, port) -> models weight-RESIDENT on that backend: the
+        # fleet-routing signal (serving/model_pool.py publishes it via
+        # its on_change hook; gateway.backend_for_route prefers resident
+        # replicas for a hot model's requests)
+        self._residency: dict[tuple, frozenset] = {}
 
     # -- gateway in-flight -----------------------------------------------------
     def inc(self, key: Key) -> None:
@@ -107,6 +112,32 @@ class MetricsCollector:
                 self._held[key] = n
             else:
                 self._held.pop(key, None)
+
+    # -- model residency (fleet routing) ---------------------------------------
+    def set_residency(self, addr: tuple, models) -> None:
+        """Advertise which models hold device-resident weights on one
+        backend; an empty set clears the entry (backend gone or fully
+        parked)."""
+        with self._lock:
+            if models:
+                self._residency[addr] = frozenset(models)
+            else:
+                self._residency.pop(addr, None)
+
+    def residency(self, addr: tuple) -> frozenset:
+        with self._lock:
+            return self._residency.get(addr, frozenset())
+
+    def resident_backends(self, model: str) -> list[tuple]:
+        """Backends advertising ``model`` resident (dashboard view)."""
+        with self._lock:
+            return [a for a, m in self._residency.items() if model in m]
+
+    def residency_snapshot(self) -> dict[tuple, frozenset]:
+        """All backends with advertised residency (the fleet card's
+        per-backend routing view)."""
+        with self._lock:
+            return dict(self._residency)
 
     # -- pull sources (serving engine stats) -----------------------------------
     def add_source(self, key: Key, stats_fn: Callable[[], dict]) -> None:

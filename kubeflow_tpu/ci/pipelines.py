@@ -193,6 +193,23 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                        "--smoke"],
         "image": "images/predictor",
     },
+    "fleet": {
+        "include_dirs": ["kubeflow_tpu/serving/model_pool.py",
+                         "kubeflow_tpu/serving/predictor.py",
+                         "kubeflow_tpu/gateway.py",
+                         "loadtest/load_fleet.py"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+                     "tests/test_model_pool.py"],
+        # many-model churn smoke: a power-law + diurnal request schedule
+        # over a fleet larger than the weight budget — asserts cold-start
+        # p99 under KF_FLEET_COLD_P99, hot-model p99 within
+        # KF_FLEET_HOT_FACTOR of the single-model baseline while cold
+        # models churn, K coalesced cold arrivals -> exactly 1 weight
+        # load, and zero leaked KV pages or weight bytes after the drain
+        # (KF_SKIP_FLEET=1 opts out on constrained hosts)
+        "fleet_cmd": [sys.executable, "loadtest/load_fleet.py", "--smoke"],
+        "image": "images/predictor",
+    },
     "autoscale": {
         "include_dirs": ["kubeflow_tpu/autoscale/*",
                          "kubeflow_tpu/gateway.py"],
@@ -333,6 +350,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "qos_cmd" in spec:
         steps.append({"name": "qos", "run": spec["qos_cmd"],
                       "depends": ["test"]})
+    if "fleet_cmd" in spec:
+        steps.append({"name": "fleet", "run": spec["fleet_cmd"],
+                      "depends": ["test"]})
     if spec.get("image"):
         # kaniko executor (the reference's builder): --no-push is the
         # presubmit mode (ci/notebook_servers pattern)
@@ -403,6 +423,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "qos_cmd" in spec
                 and os.environ.get("KF_SKIP_QOS") != "1"):
             ok = subprocess.run(spec["qos_cmd"]).returncode == 0
+        if (ok and "fleet_cmd" in spec
+                and os.environ.get("KF_SKIP_FLEET") != "1"):
+            ok = subprocess.run(spec["fleet_cmd"]).returncode == 0
         results[name] = ok
     return results
 

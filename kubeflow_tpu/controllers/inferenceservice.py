@@ -75,6 +75,9 @@ class InferenceServiceController(Controller):
             args += ["--role", role]
         if api.kv_quant(isvc):
             args += ["--kv-quant"]
+        budget_mb = api.weight_budget_mb(isvc)
+        if budget_mb > 0:
+            args += ["--weight-budget-mb", str(budget_mb)]
         container = {
             "name": "predictor",
             "image": pred.get("image", "kubeflow-tpu/predictor:latest"),

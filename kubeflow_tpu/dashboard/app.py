@@ -81,6 +81,7 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/query", self.query_route)
         self.add_route("GET", "/api/alerts", self.alerts_route)
         self.add_route("GET", "/api/qos", self.qos_route)
+        self.add_route("GET", "/api/fleet", self.fleet_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -236,6 +237,13 @@ class DashboardApp(CrudApp):
         tokens, slice-seconds, and tenant-labeled TTFT/admission-wait
         percentiles."""
         return "200 OK", self.metrics.get_qos_state()
+
+    def fleet_route(self, req: Request):
+        """Many-model residency standing (the fleet card): weight budget
+        vs resident bytes, donated KV pages, cold-start load latency and
+        coalescing counts, per-model residency rows, and each backend's
+        advertised resident set."""
+        return "200 OK", self.metrics.get_fleet_state()
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

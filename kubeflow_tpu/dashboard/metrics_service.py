@@ -359,6 +359,57 @@ def qos_state(server=None) -> dict:
     return {"tenants": rows}
 
 
+def fleet_state(server=None) -> dict:
+    """Many-model residency standing (the fleet card +
+    ``/dashboard/api/fleet``): the weight budget against resident bytes,
+    pages donated to the KV pool, cold-start load latency percentiles,
+    coalesced-vs-loaded counts, eviction total, and one row per
+    registered model (state/bytes/refs/loads) off this process's model
+    pool.  With a ``server``, also the per-backend residency map the
+    gateway routes on — which models each replica advertises resident."""
+    from kubeflow_tpu.serving.model_pool import get_model_pool
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    def val(name: str) -> float:
+        m = REGISTRY.get_metric(name)
+        return m.get() if m is not None else 0.0
+
+    load = REGISTRY.get_metric("serving_fleet_load_seconds")
+    loads = val("serving_coldstart_loads_total")
+    coalesced = val("serving_coldstart_coalesced_total")
+    state = {
+        "budget_bytes": val("serving_fleet_budget_bytes"),
+        "weight_bytes": val("serving_fleet_weight_bytes"),
+        "resident": val("serving_fleet_resident_models"),
+        "models": val("serving_fleet_models"),
+        "donated_pages": val("serving_fleet_donated_pages"),
+        "evictions": val("serving_fleet_evictions_total"),
+        "coldstart": {
+            "loads": loads,
+            "coalesced": coalesced,
+            # requests answered per weight load: K coalesced cold
+            # arrivals should converge on (K-1+loads)/loads ~= K
+            "requests_per_load": ((loads + coalesced) / loads
+                                  if loads else 0.0),
+            "load_p50_s": load.percentile(50) if load is not None else 0.0,
+            "load_p99_s": load.percentile(99) if load is not None else 0.0,
+        },
+    }
+    pool = get_model_pool()
+    if pool is not None:
+        state["pool"] = pool.stats()
+    if server is not None:
+        from kubeflow_tpu import autoscale
+
+        collector = autoscale.get_collector(server)
+        state["backends"] = [
+            {"host": addr[0], "port": addr[1],
+             "resident": sorted(models)}
+            for addr, models in sorted(
+                collector.residency_snapshot().items())]
+    return state
+
+
 def cluster_health(server) -> dict:
     """Node heartbeat standing + failure-recovery counters (the
     robustness card): per-node heartbeat age/readiness straight from the
@@ -454,6 +505,8 @@ class MetricsService(Protocol):
 
     def get_qos_state(self) -> dict: ...
 
+    def get_fleet_state(self) -> dict: ...
+
 
 class LocalMetricsService:
     """Derives series from the in-memory API server (pod counts as a proxy
@@ -523,6 +576,9 @@ class LocalMetricsService:
 
     def get_qos_state(self) -> dict:
         return qos_state(self.server)
+
+    def get_fleet_state(self) -> dict:
+        return fleet_state(self.server)
 
 
 class CloudMonitoringMetricsService:
@@ -615,6 +671,11 @@ class CloudMonitoringMetricsService:
         # the accountant and tenant-labeled histograms are process-local;
         # shares come off the platform's own Profile objects
         return qos_state(self.server)
+
+    def get_fleet_state(self):
+        # the model pool and residency counters are process-local; the
+        # per-backend residency map is collector-local
+        return fleet_state(self.server)
 
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:

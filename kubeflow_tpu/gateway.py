@@ -63,6 +63,9 @@ from kubeflow_tpu import trace
 from kubeflow_tpu.core.store import APIServer, NotFound
 from kubeflow_tpu.qos import TenantLimiter, resolve_tenant, tenant_rate
 from kubeflow_tpu.qos.accounting import get_accountant
+# the fleet cold-start coalescing counter lives with the residency pool
+# (one registration; model_pool keeps jax imports lazy so this is cheap)
+from kubeflow_tpu.serving.model_pool import COLDSTART_COALESCED
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import REGISTRY
 
@@ -451,12 +454,25 @@ def resolve_backend(server: APIServer, path: str) -> Backend | None:
     return backend_for_route(server, route, path)
 
 
+def model_from_path(path: str) -> str | None:
+    """The model a V1 serving path addresses (``.../v1/models/<m>`` or
+    ``.../v1/models/<m>:verb``), or None for non-serving paths — the
+    residency-routing key."""
+    marker = "/v1/models/"
+    i = path.find(marker)
+    if i < 0:
+        return None
+    model = path[i + len(marker):].split("/", 1)[0].partition(":")[0]
+    return model or None
+
+
 def backend_for_route(server: APIServer, route: Route, path: str,
                       ejected: EjectionList | None = None,
                       exclude: set | None = None, *,
                       role: str | None = None,
                       collector=None,
-                      prefer: tuple | None = None) -> Backend:
+                      prefer: tuple | None = None,
+                      model: str | None = None) -> Backend:
     """Resolve a live backend for ``route``.  DRAINING pods never
     participate (they are finishing in-flight streams — a scale-down
     victim or a SIGTERM'd predictor); ``exclude`` skips specific
@@ -552,6 +568,20 @@ def backend_for_route(server: APIServer, route: Route, path: str,
     if len(candidates) == 1:
         PICKS.labels(role_label, "only_candidate").inc()
         return candidates[0]
+    if model is not None and collector is not None:
+        # fleet residency (serving/model_pool.py advertises through the
+        # collector): a replica already holding this model's weights
+        # serves it without a cold-start load.  Strictly a preference
+        # among healthy candidates — when NO replica (or every replica)
+        # has the model resident, the normal least-loaded pick applies,
+        # so stale residency data degrades routing, never availability.
+        resident = [b for b in candidates
+                    if model in collector.residency((b.host, b.port))]
+        if resident and len(resident) < len(candidates):
+            PICKS.labels(role_label, "resident").inc()
+            return min(resident,
+                       key=lambda b: collector.backend_inflight(
+                           (b.host, b.port)))
     if collector is not None:
         PICKS.labels(role_label, "least_loaded").inc()
         return min(candidates,
@@ -741,6 +771,13 @@ class Gateway:
         # spec.qos.requestsPerSecond.  The wall clock is injected here —
         # the qos package itself never reads time
         self.limiter = TenantLimiter(clock=time.monotonic)
+        # cold-start coalescing: one LEADER per revision key rides the
+        # activator; concurrent cold requests for the same revision wait
+        # on its outcome instead of stacking redundant activation polls
+        import threading
+
+        self._coldstart_lock = threading.Lock()
+        self._coldstart_leaders: dict[tuple, object] = {}
 
     def matches(self, path: str) -> bool:
         return match_route(self.server, path) is not None
@@ -975,6 +1012,9 @@ class Gateway:
                          and ":generate" in path) else None)
         peer_addr = None
         prefer = None
+        # residency routing: a verb request names its model, and a
+        # replica already holding those weights skips the cold start
+        model = model_from_path(path) if ":" in path else None
         if want_role is not None and self.directory is not None:
             prefer = self._prefix_affinity(environ)
         with trace.get_tracer().start_span("gateway.backend_pick",
@@ -987,7 +1027,8 @@ class Gateway:
                                             self.ejections,
                                             role=want_role,
                                             collector=self.collector,
-                                            prefer=prefer)
+                                            prefer=prefer,
+                                            model=model)
             except NoBackend as e:
                 psp.add_event("activate", reason=str(e))
                 backend = self._activate(route, path)
@@ -1100,18 +1141,49 @@ class Gateway:
     def _activate(self, route: Route, path: str):
         """Scale-from-zero: hold the request while the activator brings up
         a backend; None when the route is not autoscaled (plain 503) or
-        activation fails (timeout / hold queue full)."""
+        activation fails (timeout / hold queue full).
+
+        Coalescing: the FIRST cold request for a revision leads — it
+        rides the activator's hold queue and its poke/poll loop.
+        Concurrent cold requests for the same revision are FOLLOWERS:
+        counted in ``serving_coldstart_coalesced_total``, they wait on
+        the leader's outcome and then re-resolve (the load already
+        happened, so the re-resolve is instant).  A follower whose
+        re-resolve still finds nothing (leader timed out, or its pod
+        died in the window) falls back to an activator hold of its own —
+        coalescing is an optimization, never an availability cliff."""
+        import threading
+
         if self.activator is None:
             return None
         key = self.activator.covers(route)
         if key is None:
             return None
+        with self._coldstart_lock:
+            event = self._coldstart_leaders.get(key)
+            leader = event is None
+            if leader:
+                event = self._coldstart_leaders[key] = threading.Event()
+        if not leader:
+            COLDSTART_COALESCED.inc()
+            event.wait(getattr(self.activator, "timeout", 60.0))
+            try:
+                return backend_for_route(self.server, route, path,
+                                         self.ejections,
+                                         collector=self.collector)
+            except NoBackend:
+                pass  # leader failed; take our own hold below
         try:
             return self.activator.wait(route, path, key)
         except Exception as e:
             log.warning("scale-from-zero failed", route=route.prefix,
                         error=str(e))
             return None
+        finally:
+            if leader:
+                with self._coldstart_lock:
+                    self._coldstart_leaders.pop(key, None)
+                event.set()
 
     def _fetch(self, backend: Backend, method, url, headers, body,
                retriable, idempotent):

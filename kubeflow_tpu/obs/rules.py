@@ -331,3 +331,30 @@ def tenant_slos(tenants, *, objective: float = 0.99,
                         f"under {ttft_threshold_s * 1e3:.0f} ms")
         for tenant in tenants
     ]
+
+
+def fleet_slos(models, *, objective: float = 0.99,
+               latency_threshold_s: float = 1.0,
+               fast_long_s: float | None = None,
+               slow_long_s: float | None = None,
+               scrape_interval_s: float = 5.0) -> list[SLO]:
+    """Per-model request-latency burn-rate rules over the model-labeled
+    serving histogram.  One SLO per model with ``matchers={"model":
+    name}``, so a cold model paying its own load latency cannot page the
+    resident models' rules — the cross-model isolation claim load_fleet
+    gates on.  Window scaling matches default_slos."""
+    if fast_long_s is None:
+        fast_long_s = max(60.0, 16.0 * scrape_interval_s)
+    if slow_long_s is None:
+        slow_long_s = max(300.0, 40.0 * scrape_interval_s)
+    windows = default_burn_windows(fast_long_s, slow_long_s)
+    return [
+        SLO(name=f"fleet-latency-p99-{model}", kind="latency",
+            objective=objective,
+            metric="serving_fleet_request_seconds",
+            matchers={"model": model},
+            threshold_s=latency_threshold_s, windows=list(windows),
+            description=f"99% of {model}'s requests complete under "
+                        f"{latency_threshold_s:.2f} s")
+        for model in models
+    ]

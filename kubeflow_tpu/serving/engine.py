@@ -172,6 +172,16 @@ class DeadlineExceeded(RuntimeError):
     engine evicted it and freed its slot."""
 
 
+class RequestCancelled(ValueError):
+    """The request was cancelled (caller cancel, sibling-row failure, or
+    engine shutdown) before it produced a result.  Subclasses
+    ``ValueError`` on purpose: ``result()`` historically raised a bare
+    ``ValueError`` for every failure outcome, so existing handlers —
+    including the predictor's 422 mapping — keep catching it, while new
+    callers can distinguish cancellation from a genuinely malformed
+    request."""
+
+
 @dataclass
 class GenRequest:
     ids: list[int]
@@ -237,6 +247,8 @@ class GenRequest:
         if self.error:
             if self.outcome == "deadline_exceeded":
                 raise DeadlineExceeded(self.error)
+            if self.outcome in ("cancelled", "shutdown"):
+                raise RequestCancelled(self.error)
             raise ValueError(self.error)
         return self.ids + self.generated
 
@@ -255,7 +267,8 @@ class ContinuousBatcher:
                  kv_quant: bool = False,
                  tenant_shares: dict[str, float] | None = None,
                  directory=None, engine_id: str | None = None,
-                 engine_addr: str = "", fetch_fn=None):
+                 engine_addr: str = "", fetch_fn=None,
+                 pressure_fn=None):
         from kubeflow_tpu.models import llama as llama_mod
 
         if role not in ("colocated", "prefill", "decode"):
@@ -379,6 +392,13 @@ class ContinuousBatcher:
         self.engine_id = engine_id or f"engine-{id(self):x}"
         self.engine_addr = engine_addr
         self.fetch_fn = fetch_fn
+        # pressure_fn() -> bool: the weight-residency arbiter
+        # (serving/model_pool.py).  Called when the page pool cannot
+        # cover an allocation, BEFORE any prefix-cache eviction: True
+        # means cold-model weights were evicted and their bytes donated
+        # as page capacity, so the alloc retries — cold weights go
+        # before hot KV.
+        self.pressure_fn = pressure_fn
         self._remote_fetches = 0
         # costed-drafter exploration cadence (see _spec_step's pre-gate)
         self._spec_declines = 0
@@ -1626,6 +1646,11 @@ class ContinuousBatcher:
             return None
         fresh = self.pool.alloc(n_new)
         while fresh is None:
+            # residency arbitration first: an idle model's weights are
+            # colder than anything in the prefix cache
+            if self.pressure_fn is not None and self.pressure_fn():
+                fresh = self.pool.alloc(n_new)
+                continue
             if (self.prefix_cache is None
                     or not self.prefix_cache.evict_lru()):
                 return None
@@ -1717,6 +1742,9 @@ class ContinuousBatcher:
         trees = trees[:n]
         pids = self.pool.alloc(n)
         while pids is None:
+            if self.pressure_fn is not None and self.pressure_fn():
+                pids = self.pool.alloc(n)
+                continue
             if not self.prefix_cache.evict_lru():
                 return  # pool cannot host the import; prefill locally
             pids = self.pool.alloc(n)

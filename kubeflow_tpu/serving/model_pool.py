@@ -1,0 +1,636 @@
+"""Weight residency for many-model serving: one HBM budget, N models.
+
+KServe-shaped fleets put hundreds of InferenceServices behind one
+platform, with power-law traffic — a handful of hot models take most of
+the requests while the long tail sits cold.  Dedicating a chip per model
+wastes the tail's HBM; loading on every request melts the head's
+latency.  This module is the middle path (the AlpaServe/ServerlessLLM
+observation): weights become a CACHED resource under an explicit byte
+budget, exactly like KV pages.
+
+``ModelPool`` tracks per-model residency through four states:
+
+    parked    registered, weights not on device (compiled executables
+              and tokenizer may survive in a warm engine — see
+              predictor.GenerativePredictor.park)
+    loading   one leader is streaming weights in; concurrent acquirers
+              COALESCE behind its load instead of loading again
+    resident  weights on device; ``refs`` counts in-flight requests and
+              PINS the entry against eviction
+    draining  refuses new acquires; weights free once refs hit zero
+
+Under budget pressure the least-recently-used idle (refs==0) resident
+model evicts first.  Weights and KV pages are ONE currency: when a
+serving engine's page allocator runs dry it calls :meth:`relieve`, which
+evicts a cold model and DONATES the freed bytes to that engine's
+``PagePool`` as page capacity — cold-model weights evict before
+hot-model KV spills.  A later load takes un-donated headroom back via
+``PagePool.reclaim`` (never forcing KV eviction: only free page slots
+return).
+
+Byte accounting is exact, via ``quant.quantized_bytes`` over the loaded
+tree (the same arithmetic the int8 path reports), so the zero-leak gate
+in ``loadtest/load_fleet.py`` can compare accounted bytes against the
+sum of resident entries.
+
+Streamed loading (``save_streamable``/``stream_restore``) writes one
+``.npy`` file per tensor plus a manifest; restore memory-maps each file
+and ``device_put``s tensor-by-tensor through a bounded host staging
+window — the full tree is never materialized host-side, and the restore
+report records the high-water mark so tests can assert the bound.
+
+Clock discipline: deciders here take an injected ``clock`` (kfvet's
+clocks pass holds this module in scope by decree); nothing in this
+module reads wall time directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from time import monotonic as _monotonic
+from typing import Callable
+
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+FLEET_MODELS = REGISTRY.gauge(
+    "serving_fleet_models",
+    "models registered with the weight-residency pool")
+FLEET_RESIDENT = REGISTRY.gauge(
+    "serving_fleet_resident_models",
+    "models whose weights are currently device-resident")
+FLEET_WEIGHT_BYTES = REGISTRY.gauge(
+    "serving_fleet_weight_bytes",
+    "bytes of device HBM held by resident model weights")
+FLEET_BUDGET_BYTES = REGISTRY.gauge(
+    "serving_fleet_budget_bytes",
+    "configured HBM byte budget for model weights")
+FLEET_DONATED_PAGES = REGISTRY.gauge(
+    "serving_fleet_donated_pages",
+    "KV page slots donated out of the weight budget under page-pool "
+    "pressure (weights and pages are one currency)")
+FLEET_EVICTIONS = REGISTRY.counter(
+    "serving_fleet_evictions_total",
+    "idle model weights evicted from device residency (LRU or pressure)")
+FLEET_LOAD_SECONDS = REGISTRY.histogram(
+    "serving_fleet_load_seconds",
+    "wall time one model load (parked -> resident) took, staging "
+    "included",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0, 60.0))
+COLDSTART_LOADS = REGISTRY.counter(
+    "serving_coldstart_loads_total",
+    "cold-start model loads actually performed (the coalescing "
+    "denominator)")
+COLDSTART_COALESCED = REGISTRY.counter(
+    "serving_coldstart_coalesced_total",
+    "cold-start requests that coalesced behind an in-flight load "
+    "instead of loading again")
+# per-model request latency: the fleet interference signal
+# (obs.rules.fleet_slos matches on the model label).  Model names are
+# operator-configured InferenceService models — a bounded set, like
+# tenant profile names.
+MODEL_REQUEST_SECONDS = REGISTRY.histogram(
+    "serving_fleet_request_seconds",
+    "end-to-end predictor request latency per model (the cross-model "
+    "interference signal load_fleet alerts on)",
+    labels=("model",),
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0))
+
+PARKED = "parked"
+LOADING = "loading"
+RESIDENT = "resident"
+DRAINING = "draining"
+
+log = get_logger("model_pool")
+
+
+class ModelDraining(RuntimeError):
+    """Acquire refused: the model is draining out of this process."""
+
+
+@dataclass
+class _Entry:
+    name: str
+    # loader() -> (payload, nbytes): builds/refreshes device weights and
+    # returns an opaque payload (typically the predictor) plus the exact
+    # byte count those weights occupy
+    loader: Callable[[], tuple]
+    # evictor() -> freed bytes: drops the device weights while keeping
+    # whatever warm state the owner retains (compiled engine, tokenizer)
+    evictor: Callable[[], int] | None = None
+    state: str = PARKED
+    nbytes: int = 0          # resident bytes (0 while parked)
+    hint: int = 0            # expected bytes, for pre-load budget math
+    refs: int = 0
+    last_used: float = 0.0
+    payload: object = None
+    loads: int = 0
+    evictions: int = 0
+    coalesced: int = 0
+    last_load_seconds: float = 0.0
+    error: str | None = None
+    ready: threading.Event = field(default_factory=threading.Event)
+
+
+class ModelPool:
+    """Per-process weight residency manager: LRU under ``budget_bytes``
+    with refcount pins, coalesced cold-start loads, and page-pool
+    donation under KV pressure."""
+
+    def __init__(self, budget_bytes: int, *, clock=_monotonic,
+                 on_change: Callable[[frozenset], None] | None = None):
+        if budget_bytes <= 0:
+            raise ValueError("budget_bytes must be > 0")
+        self.budget_bytes = int(budget_bytes)
+        self._clock = clock
+        # on_change(resident_names): residency advertisement hook — the
+        # serving process publishes it to the autoscale collector so the
+        # gateway can route hot models at their resident replicas
+        self._on_change = on_change
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+        # page-pool donations: pool id -> (pool, donated slot count);
+        # donated slots count against the weight budget until reclaimed
+        self._donations: dict[int, list] = {}
+        FLEET_BUDGET_BYTES.set(float(self.budget_bytes))
+
+    # -- registration ----------------------------------------------------------
+    def register(self, name: str, loader: Callable[[], tuple], *,
+                 evictor: Callable[[], int] | None = None,
+                 nbytes_hint: int = 0) -> None:
+        """Register a model (parked).  ``loader`` runs OUTSIDE the pool
+        lock on the coalescing leader's thread; ``nbytes_hint`` lets the
+        pre-load budget pass evict enough idle models up front."""
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"model {name!r} already registered")
+            self._entries[name] = _Entry(name=name, loader=loader,
+                                         evictor=evictor,
+                                         hint=int(nbytes_hint))
+            FLEET_MODELS.set(float(len(self._entries)))
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None:
+                return
+            if e.refs > 0 or e.state == LOADING:
+                raise ValueError(f"model {name!r} is busy ({e.state}, "
+                                 f"refs={e.refs})")
+            del self._entries[name]
+            FLEET_MODELS.set(float(len(self._entries)))
+            self._publish_locked()
+        self._notify()
+
+    def has(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    # -- the data path ---------------------------------------------------------
+    def acquire(self, name: str, timeout: float = 120.0):
+        """Pin ``name`` resident and return its payload.
+
+        Resident: bump the pin and return immediately.  Parked: become
+        the LOAD LEADER — free budget (LRU eviction of idle models, then
+        reclaiming donated page slots), run the loader, publish.
+        Loading: coalesce — wait on the leader's outcome and retry
+        (counted in ``serving_coldstart_coalesced_total``); a failed
+        leader parks the entry again, so exactly one waiter inherits
+        leadership per retry round."""
+        deadline = self._clock() + timeout
+        while True:
+            with self._lock:
+                e = self._entries[name]
+                if e.state == DRAINING:
+                    raise ModelDraining(f"model {name!r} is draining")
+                if e.state == RESIDENT:
+                    e.refs += 1
+                    e.last_used = self._clock()
+                    return e.payload
+                if e.state == LOADING:
+                    waiter = e.ready
+                    e.coalesced += 1
+                else:  # PARKED -> this thread leads the load
+                    e.state = LOADING
+                    e.error = None
+                    e.ready = threading.Event()
+                    waiter = None
+            if waiter is None:
+                return self._load(e)
+            COLDSTART_COALESCED.inc()
+            remaining = deadline - self._clock()
+            if remaining <= 0 or not waiter.wait(remaining):
+                raise TimeoutError(
+                    f"model {name!r} load did not finish in {timeout:.0f}s")
+            with self._lock:
+                if e.error is not None and e.state == PARKED:
+                    # leader failed; surface its error to every waiter
+                    # of THIS round (the next acquire retries fresh)
+                    raise RuntimeError(
+                        f"model {name!r} load failed: {e.error}")
+            # else: re-check state at the top (resident, or a drain
+            # raced in)
+
+    def _load(self, e: _Entry):
+        """Leader path: budget, loader, publish.  Lock is NOT held
+        across the loader — followers park on ``e.ready`` meanwhile."""
+        try:
+            self._make_room(max(e.hint, e.nbytes))
+            t0 = self._clock()
+            payload, nbytes = e.loader()
+            dt = max(0.0, self._clock() - t0)
+        except BaseException as err:
+            with self._lock:
+                e.state = PARKED
+                e.error = str(err) or err.__class__.__name__
+                e.payload = None
+                e.ready.set()
+            raise
+        with self._lock:
+            e.payload = payload
+            e.nbytes = int(nbytes)
+            e.state = RESIDENT
+            e.refs = 1
+            e.last_used = self._clock()
+            e.loads += 1
+            e.last_load_seconds = dt
+            self._publish_locked()
+            e.ready.set()
+        COLDSTART_LOADS.inc()
+        FLEET_LOAD_SECONDS.observe(dt)
+        # the loader may have overshot the hint; trim AFTER publishing
+        # so the freshly-loaded (pinned) model is never its own victim
+        self._make_room(0)
+        self._notify()
+        return payload
+
+    def release(self, name: str) -> None:
+        """Drop one pin.  LRU recency is the RELEASE time — a model that
+        just finished serving is the hottest thing in the pool."""
+        evict_now = False
+        with self._lock:
+            e = self._entries[name]
+            if e.refs <= 0:
+                raise ValueError(f"release of unpinned model {name!r}")
+            e.refs -= 1
+            e.last_used = self._clock()
+            evict_now = e.refs == 0 and e.state == DRAINING
+        if evict_now:
+            self._evict(name, draining=True)
+
+    # -- eviction / budget -----------------------------------------------------
+    def evict(self, name: str) -> int:
+        """Evict ``name`` to parked if idle; returns bytes freed (0 when
+        pinned, loading, or already parked)."""
+        return self._evict(name)
+
+    def _evict(self, name: str, draining: bool = False) -> int:
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None or e.refs > 0 or e.payload is None \
+                    or e.state not in (RESIDENT, DRAINING):
+                return 0
+            evictor, freed = e.evictor, e.nbytes
+            # flip state under the lock so a racing acquire reloads
+            # rather than pinning a payload whose weights are mid-drop
+            e.state = DRAINING if draining else PARKED
+            e.payload = None
+            e.nbytes = 0
+            e.evictions += 1
+            self._publish_locked()
+        if evictor is not None:
+            try:
+                freed = int(evictor()) or freed
+            except Exception as err:
+                log.warning("evictor failed; bytes already unaccounted",
+                            model=name, error=str(err))
+        FLEET_EVICTIONS.inc()
+        self._notify()
+        return freed
+
+    def evict_lru(self) -> int:
+        """Evict the least-recently-used IDLE resident model; returns
+        bytes freed (0 when every resident model is pinned)."""
+        with self._lock:
+            idle = [e for e in self._entries.values()
+                    if e.state == RESIDENT and e.refs == 0
+                    and e.payload is not None]
+            if not idle:
+                return 0
+            victim = min(idle, key=lambda e: e.last_used).name
+        return self._evict(victim)
+
+    def _make_room(self, need: int) -> None:
+        """Free budget for ``need`` more bytes: LRU-evict idle models,
+        then take donated page slots back from their pools.  A fully
+        pinned pool may overshoot — availability beats the budget (the
+        in-flight requests holding the pins cannot be dropped), and the
+        overshoot logs loudly."""
+        while self.weight_bytes() + self.donated_bytes() + need \
+                > self.budget_bytes:
+            if self.evict_lru() > 0:
+                continue
+            if self._reclaim_donations() > 0:
+                continue
+            if need > 0:
+                log.warning("weight budget overshoot: every resident "
+                            "model is pinned",
+                            budget=self.budget_bytes,
+                            resident=self.weight_bytes(), need=need)
+            return
+
+    # -- weights-and-pages-one-currency ----------------------------------------
+    def relieve(self, page_pool=None) -> bool:
+        """KV pressure hook (the engine's page-alloc failure path): evict
+        ONE idle model and donate the freed bytes to ``page_pool`` as
+        page capacity.  True when capacity was donated — the caller
+        retries its alloc before spilling or evicting hot KV."""
+        if page_pool is None:
+            return False
+        page_nbytes = int(getattr(page_pool, "page_nbytes", 0) or 0)
+        if page_nbytes <= 0 or not hasattr(page_pool, "donate"):
+            return False
+        freed = self.evict_lru()
+        if freed <= 0:
+            return False
+        pages = freed // page_nbytes
+        if pages <= 0:
+            # too small to mint a page: the bytes simply return to the
+            # weight budget (the eviction still happened — harmless)
+            return False
+        page_pool.donate(pages)
+        with self._lock:
+            rec = self._donations.setdefault(id(page_pool),
+                                             [page_pool, page_nbytes, 0])
+            rec[2] += pages
+            donated = sum(r[2] for r in self._donations.values())
+        FLEET_DONATED_PAGES.set(float(donated))
+        log.info("weight eviction donated KV pages", pages=pages,
+                 freed_bytes=freed)
+        return True
+
+    def _reclaim_donations(self) -> int:
+        """Pull donated page slots back (free HBM slots only — a
+        reclaim never evicts KV); returns bytes recovered."""
+        recovered = 0
+        with self._lock:
+            records = list(self._donations.values())
+        for rec in records:
+            pool, page_nbytes, outstanding = rec
+            if outstanding <= 0:
+                continue
+            got = pool.reclaim(outstanding)
+            if got > 0:
+                with self._lock:
+                    rec[2] -= got
+                    donated = sum(r[2] for r in self._donations.values())
+                FLEET_DONATED_PAGES.set(float(donated))
+                recovered += got * page_nbytes
+        return recovered
+
+    # -- lifecycle -------------------------------------------------------------
+    def drain(self, name: str) -> None:
+        """Refuse new acquires for ``name``; weights free once the last
+        pin releases (or immediately when already idle)."""
+        with self._lock:
+            e = self._entries[name]
+            if e.state == LOADING:
+                raise ValueError(f"model {name!r} is mid-load")
+            was_idle = e.refs == 0 and e.payload is not None
+            e.state = DRAINING
+            self._publish_locked()
+        if was_idle:
+            self._evict(name, draining=True)
+        self._notify()
+
+    # -- introspection ---------------------------------------------------------
+    def weight_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def donated_bytes(self) -> int:
+        with self._lock:
+            return sum(r[1] * r[2] for r in self._donations.values())
+
+    def resident_names(self) -> frozenset:
+        with self._lock:
+            return frozenset(e.name for e in self._entries.values()
+                             if e.state == RESIDENT)
+
+    def state_of(self, name: str) -> str:
+        with self._lock:
+            return self._entries[name].state
+
+    def stats(self) -> dict:
+        with self._lock:
+            models = {
+                e.name: {
+                    "state": e.state,
+                    "nbytes": e.nbytes,
+                    "refs": e.refs,
+                    "loads": e.loads,
+                    "evictions": e.evictions,
+                    "coalesced": e.coalesced,
+                    "last_load_seconds": e.last_load_seconds,
+                }
+                for e in self._entries.values()
+            }
+            donated = sum(r[2] for r in self._donations.values())
+            donated_b = sum(r[1] * r[2] for r in self._donations.values())
+            return {
+                "budget_bytes": self.budget_bytes,
+                "weight_bytes": sum(e.nbytes
+                                    for e in self._entries.values()),
+                "donated_pages": donated,
+                "donated_bytes": donated_b,
+                "resident": sum(1 for e in self._entries.values()
+                                if e.state == RESIDENT),
+                "parked": sum(1 for e in self._entries.values()
+                              if e.state == PARKED),
+                "loads_total": sum(e.loads
+                                   for e in self._entries.values()),
+                "evictions_total": sum(e.evictions
+                                       for e in self._entries.values()),
+                "coalesced_total": sum(e.coalesced
+                                       for e in self._entries.values()),
+                "models": models,
+            }
+
+    # -- internals -------------------------------------------------------------
+    def _publish_locked(self) -> None:
+        FLEET_RESIDENT.set(float(sum(1 for e in self._entries.values()
+                                     if e.state == RESIDENT)))
+        FLEET_WEIGHT_BYTES.set(float(sum(e.nbytes
+                                         for e in self._entries.values())))
+
+    def _notify(self) -> None:
+        if self._on_change is None:
+            return
+        try:
+            self._on_change(self.resident_names())
+        except Exception as err:
+            log.warning("residency on_change hook failed", error=str(err))
+
+
+# -- streamed checkpoint layout ------------------------------------------------
+#
+# One .npy per tensor + a manifest in flatten order.  np.load(...,
+# mmap_mode="r") memory-maps each file, so the "host copy" is pageable
+# mmap; device_put streams it in, and the bounded staging window below
+# caps how many tensors are in flight before the loader blocks on the
+# oldest transfer.
+
+MANIFEST = "weights_manifest.json"
+
+
+def _storage_view(arr):
+    """(storable ndarray, stored dtype string): npy can't describe
+    ml_dtypes (bfloat16) descrs, so 2-byte customs store as uint16 and
+    the manifest remembers the logical dtype."""
+    import numpy as np
+
+    if arr.dtype.kind == "V" or str(arr.dtype) == "bfloat16":
+        return np.ascontiguousarray(arr).view(np.uint16), "uint16"
+    return arr, str(arr.dtype)
+
+
+def save_streamable(params, directory: str) -> int:
+    """Write ``params`` as a streamable tensor-per-file checkpoint;
+    returns total bytes written.  The layout is the fleet cold-start
+    format — ``stream_restore`` (and the predictor's ``_restore``) picks
+    it over the orbax full-tree path when the manifest is present."""
+    import jax
+    import numpy as np
+
+    os.makedirs(directory, exist_ok=True)
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    tensors = []
+    total = 0
+    for idx, (path, leaf) in enumerate(flat):
+        host = np.asarray(jax.device_get(leaf))
+        store, stored = _storage_view(host)
+        fname = f"t{idx:05d}.npy"
+        np.save(os.path.join(directory, fname), store,
+                allow_pickle=False)
+        tensors.append({
+            "key": jax.tree_util.keystr(path),
+            "file": fname,
+            "shape": list(host.shape),
+            "dtype": str(host.dtype),
+            "stored": stored,
+            "nbytes": int(host.nbytes),
+        })
+        total += int(host.nbytes)
+    with open(os.path.join(directory, MANIFEST), "w") as f:
+        json.dump({"tensors": tensors, "total_bytes": total}, f)
+    return total
+
+
+def is_streamable(directory: str) -> bool:
+    return os.path.isfile(os.path.join(directory, MANIFEST))
+
+
+def stream_restore(directory: str, like, *,
+                   staging_bytes: int = 64 << 20,
+                   device=None, clock=_monotonic):
+    """Restore a ``save_streamable`` checkpoint tensor-by-tensor.
+
+    Each tensor is mmap'd from disk and ``device_put`` — transfers
+    overlap because the loader only blocks when the staging window
+    (``staging_bytes`` of in-flight host copies) is full, at which point
+    it waits on the OLDEST transfer and releases its mmap.  Never
+    materializes the full tree host-side.
+
+    Returns ``(params, report)`` where report carries ``tensors``,
+    ``bytes``, ``max_staged_bytes`` (the high-water mark the acceptance
+    bound asserts on) and ``seconds``."""
+    import jax
+    import numpy as np
+
+    with open(os.path.join(directory, MANIFEST)) as f:
+        manifest = json.load(f)
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    records = manifest["tensors"]
+    if len(records) != len(leaves):
+        raise ValueError(
+            f"manifest has {len(records)} tensors, restore target has "
+            f"{len(leaves)} leaves")
+    t0 = clock()
+    inflight: list[tuple] = []   # (device_array, host_nbytes)
+    staged = 0
+    max_staged = 0
+    out = []
+    total = 0
+    for rec, leaf in zip(records, leaves):
+        if tuple(rec["shape"]) != tuple(leaf.shape) \
+                or rec["dtype"] != str(leaf.dtype):
+            raise ValueError(
+                f"tensor {rec['key']}: checkpoint is "
+                f"{rec['dtype']}{rec['shape']}, target wants "
+                f"{leaf.dtype}{list(leaf.shape)}")
+        nbytes = int(rec["nbytes"])
+        while inflight and staged + nbytes > staging_bytes:
+            oldest, oldest_nbytes = inflight.pop(0)
+            # transfer complete -> its mmap'd host pages are reclaimable
+            oldest.block_until_ready()
+            staged -= oldest_nbytes
+        host = np.load(os.path.join(directory, rec["file"]),
+                       mmap_mode="r", allow_pickle=False)
+        if rec["stored"] != rec["dtype"]:
+            import jax.numpy as jnp
+
+            host = host.view(jnp.dtype(rec["dtype"]))
+        dev = jax.device_put(host, device)
+        inflight.append((dev, nbytes))
+        staged += nbytes
+        max_staged = max(max_staged, staged)
+        total += nbytes
+        out.append(dev)
+    for dev, _ in inflight:
+        dev.block_until_ready()
+    report = {
+        "tensors": len(out),
+        "bytes": total,
+        "max_staged_bytes": max_staged,
+        "seconds": max(0.0, clock() - t0),
+    }
+    return jax.tree_util.tree_unflatten(treedef, out), report
+
+
+# -- process-wide handle (dashboard's fleet card) ------------------------------
+_pool: ModelPool | None = None
+_pool_lock = threading.Lock()
+
+
+def get_model_pool() -> ModelPool | None:
+    """The process's residency pool, or None when this predictor serves
+    without a weight budget (the dashboard card reports it absent)."""
+    return _pool
+
+
+def set_model_pool(pool: ModelPool | None) -> ModelPool | None:
+    global _pool
+    with _pool_lock:
+        _pool = pool
+    return pool
+
+
+__all__ = [
+    "DRAINING",
+    "LOADING",
+    "MANIFEST",
+    "PARKED",
+    "RESIDENT",
+    "ModelDraining",
+    "ModelPool",
+    "get_model_pool",
+    "is_streamable",
+    "save_streamable",
+    "set_model_pool",
+    "stream_restore",
+]

@@ -246,6 +246,44 @@ class PagePool:
             self._publish_locked()
         return moved
 
+    # -- budget donation (weight residency arbitration) ------------------------
+    def donate(self, n: int) -> None:
+        """Grow the HBM page budget by ``n`` slots: the weight residency
+        pool (serving/model_pool.py) evicted a cold model's weights and
+        converts the freed HBM bytes into KV page capacity — weights and
+        pages are one currency, and cold-model weights evict before
+        hot-model KV spills.  New ids extend the id space so the growth
+        is real allocatable capacity, not id shuffling."""
+        add = int(n)
+        if add <= 0:
+            return
+        with self._lock:
+            new_ids = list(range(self._ids, self._ids + add))
+            self._ids += add
+            self._refs.extend([0] * add)
+            # LIFO free list: donated slots hand out first, keeping the
+            # original ids warm for the donor's eventual reclaim
+            self._free.extend(reversed(new_ids))
+            self.num_pages += add
+            PAGES_CAPACITY.set(float(self.num_pages - 1))
+            self._publish_locked()
+
+    def reclaim(self, n: int) -> int:
+        """Take back up to ``n`` donated slots (a parked model is
+        re-warming and wants its bytes).  Only FREE HBM headroom
+        returns — a reclaim never evicts or spills live KV; returns the
+        slots actually reclaimed.  The id space stays wide (ids are
+        bookkeeping); only the budget shrinks, which ``alloc`` enforces."""
+        with self._lock:
+            take = min(int(n), len(self._free),
+                       self.num_pages - 1 - self._hbm_used())
+            if take <= 0:
+                return 0
+            self.num_pages -= take
+            PAGES_CAPACITY.set(float(self.num_pages - 1))
+            self._publish_locked()
+            return take
+
     def tier(self, page: int) -> str:
         """``"hbm"`` | ``"host"`` | ``"none"`` (allocated, not committed)."""
         with self._lock:

@@ -45,7 +45,7 @@ class GenerativePredictor:
                  kv_quant: bool = False, handoff_post=None,
                  tenant_shares: dict | None = None,
                  directory=None, engine_id: str | None = None,
-                 engine_addr: str = ""):
+                 engine_addr: str = "", staging_mb: float = 64.0):
         from kubeflow_tpu.models import registry
 
         self.name = model_name
@@ -100,40 +100,17 @@ class GenerativePredictor:
                         f"divides by it (got moe_experts={experts})")
             self.mesh = sharded.serving_mesh(tp, ep)
             specs = sharded.param_specs(self.module, rng, example)
-        if quantize:
-            # weight-only int8 (serving/quant.py): init + restore +
-            # quantize happen ON THE HOST so the accelerator never holds
-            # the full-precision tree — a 7B llama (27 GB f32) quantizes
-            # down to ~6.9 GB before the only device transfer, which is
-            # what lets it serve from one 16 GB v5e chip at all
-            from kubeflow_tpu.serving.quant import (
-                quantize_params,
-                quantized_bytes,
-            )
-
-            cpu = jax.local_devices(backend="cpu")[0]
-            with jax.default_device(cpu):
-                self.params = init_params()
-                if checkpoint_dir:
-                    self._restore(checkpoint_dir)
-                before = quantized_bytes(self.params)
-                self.params = quantize_params(self.params)
-            if self.mesh is None:
-                # host-quantized tree must move to the accelerator; the
-                # tp>1 placement below handles the sharded case
-                self.params = jax.device_put(self.params, jax.devices()[0])
-            self.log.info("quantized weights int8",
-                          bytes_before=before,
-                          bytes_after=quantized_bytes(self.params))
-        else:
-            self.params = init_params()
-            if checkpoint_dir:
-                self._restore(checkpoint_dir)
-        if self.mesh is not None:
-            from kubeflow_tpu.serving import sharded
-
-            self.params = sharded.shard_params(self.params, specs,
-                                               self.mesh)
+        # everything the loader needs to run AGAIN: a warm-pool re-warm
+        # (park/warm below) replays the exact cold-construction load —
+        # same shapes, same dtypes — so the engine's jitted executables
+        # hit their caches instead of recompiling
+        self._init_params = init_params
+        self._quantize = quantize
+        self._checkpoint_dir = checkpoint_dir
+        self._staging_bytes = int(max(1.0, staging_mb) * (1 << 20))
+        self._specs = specs
+        self._parked_bytes = 0
+        self.params = self._load_params()
         from kubeflow_tpu.serving.engine import ContinuousBatcher
 
         # prefix_cache_mb > 0 opts into radix-tree KV prefix reuse over
@@ -199,15 +176,119 @@ class GenerativePredictor:
                       params=sum(x.size for x in
                                  jax.tree_util.tree_leaves(self.params)))
 
-    def _restore(self, directory: str) -> None:
-        import orbax.checkpoint as ocp
+    def _load_params(self):
+        """The ONE weight loader — cold construction and warm-pool
+        re-warm both land here.  init (or eval_shape zeros), restore
+        when a checkpoint dir is configured, int8-quantize on the host
+        when asked, then place on the accelerator (single-device or
+        sharded over the serving mesh)."""
+        if self._quantize:
+            # weight-only int8 (serving/quant.py): init + restore +
+            # quantize happen ON THE HOST so the accelerator never holds
+            # the full-precision tree — a 7B llama (27 GB f32) quantizes
+            # down to ~6.9 GB before the only device transfer, which is
+            # what lets it serve from one 16 GB v5e chip at all
+            from kubeflow_tpu.serving.quant import (
+                quantize_params,
+                quantized_bytes,
+            )
+
+            cpu = jax.local_devices(backend="cpu")[0]
+            with jax.default_device(cpu):
+                params = self._init_params()
+                if self._checkpoint_dir:
+                    params = self._restore(params)
+                before = quantized_bytes(params)
+                params = quantize_params(params)
+            if self.mesh is None:
+                # host-quantized tree must move to the accelerator; the
+                # sharded placement below handles the tp>1 case
+                params = jax.device_put(params, jax.devices()[0])
+            self.log.info("quantized weights int8",
+                          bytes_before=before,
+                          bytes_after=quantized_bytes(params))
+        else:
+            params = self._init_params()
+            if self._checkpoint_dir:
+                params = self._restore(params)
+        if self.mesh is not None:
+            from kubeflow_tpu.serving import sharded
+
+            params = sharded.shard_params(params, self._specs, self.mesh)
+        return params
+
+    def _restore(self, params):
+        """Restore ``self._checkpoint_dir`` into the structure of
+        ``params``.  A streamable checkpoint (model_pool.save_streamable
+        layout) restores tensor-by-tensor — each file mmap'd and
+        device_put through a bounded staging window, so the dominant
+        cold-start cost overlaps I/O with transfer and the full tree
+        never materializes host-side.  Anything else takes the orbax
+        full-tree path."""
+        directory = self._checkpoint_dir
+        from kubeflow_tpu.serving import model_pool as mp
 
         from kubeflow_tpu.training.checkpoint import abstract_like
 
+        if mp.is_streamable(directory):
+            params, report = mp.stream_restore(
+                directory, abstract_like(params),
+                staging_bytes=self._staging_bytes)
+            self.log.info("restored checkpoint (streamed)",
+                          directory=directory,
+                          tensors=report["tensors"],
+                          max_staged_bytes=report["max_staged_bytes"],
+                          seconds=round(report["seconds"], 3))
+            return params
+        import orbax.checkpoint as ocp
+
         ckptr = ocp.StandardCheckpointer()
-        self.params = ckptr.restore(directory,
-                                    abstract_like(self.params))
+        params = ckptr.restore(directory, abstract_like(params))
         self.log.info("restored checkpoint", directory=directory)
+        return params
+
+    # -- weight residency (serving/model_pool.py) ------------------------------
+    @property
+    def weight_bytes(self) -> int:
+        """Exact device bytes the weights occupy (quant.py arithmetic —
+        the residency pool's accounting unit); the last resident size
+        while parked."""
+        if self.params is None:
+            return self._parked_bytes
+        from kubeflow_tpu.serving.quant import quantized_bytes
+
+        return quantized_bytes(self.params)
+
+    def park(self) -> int:
+        """Warm-pool park: DROP the weights, keep everything else — the
+        engine object with its compiled executables and jit caches, the
+        KV page pool, the prefix cache.  A parked predictor serves
+        nothing until :meth:`warm` reloads; returns bytes freed."""
+        if self.params is None:
+            return 0
+        freed = self.weight_bytes
+        self._parked_bytes = freed
+        self.params = None
+        # the engine passes params explicitly into every jitted call, so
+        # clearing the reference actually frees the device buffers
+        self.engine.params = None
+        self.log.info("parked: weights evicted", bytes_freed=freed)
+        return freed
+
+    def warm(self) -> int:
+        """Re-warm a parked predictor through the same loader cold
+        construction used.  Identical tree shapes/dtypes mean every
+        jitted executable in the engine hits its cache — the re-warm
+        pays weight transfer, never XLA compilation.  Returns resident
+        bytes."""
+        if self.params is not None:
+            return self.weight_bytes
+        params = self._load_params()
+        self.params = params
+        self.engine.params = params
+        nbytes = self.weight_bytes
+        self.log.info("warmed: weights resident", bytes=nbytes)
+        return nbytes
 
     # -- disaggregation handoff plumbing ---------------------------------------
     def _capture_handoff(self, req, state) -> None:
@@ -466,8 +547,13 @@ class PredictorApp:
     so orchestrators take it out of rotation while in-flight streams
     finish; a request whose deadline expired returns 504."""
 
-    def __init__(self, predictors: dict[str, Any]):
+    def __init__(self, predictors: dict[str, Any], model_pool=None):
         self.predictors = predictors
+        # weight residency (serving/model_pool.py): verb requests to a
+        # registered model acquire a pin first — a parked model warms on
+        # the leader's thread while concurrent cold requests coalesce
+        # behind the one load
+        self.model_pool = model_pool
         self.log = get_logger("predictor.http")
 
     def __call__(self, environ, start_response):
@@ -592,47 +678,22 @@ class PredictorApp:
                 name, verb = rest.split(":", 1)
                 pred = self.predictors[name]
                 body = self._body(environ)
-                if verb == "generate":
-                    eos = body.get("eos_id")
-                    kw = {}
-                    if getattr(pred, "role", "colocated") == "prefill":
-                        # the gateway picked the decode worker (by slot
-                        # availability) and stamped it on the request
-                        kw["decode_peer"] = environ.get(
-                            "HTTP_X_KF_DECODE_PEER")
-                    return "200 OK", pred.generate(
-                        body["ids"],
-                        max_new_tokens=int(body.get("max_new_tokens", 32)),
-                        temperature=float(body.get("temperature", 0.0)),
-                        eos_id=int(eos) if eos is not None else None,
-                        top_k=int(body.get("top_k", 0)),
-                        top_p=float(body.get("top_p", 0.0)),
-                        deadline_s=self._deadline_s(environ, body),
-                        trace_ctx=trace_ctx,
-                        # gateway-stamped resolved tenant (profile name or
-                        # the bounded anonymous fallback); engine clamps it
-                        # against configured shares
-                        tenant=environ.get("HTTP_KUBEFLOW_USERID"),
-                        **kw)
-                if verb == "resume" and method == "POST":
-                    # decode-role entry: seed a slot from a serialized
-                    # prefill handoff and finish the stream.  QueueFull
-                    # (pool cannot host the pages) maps to 429 +
-                    # Retry-After upstream — shed semantics, so the
-                    # gateway retries a decode sibling.
-                    return "200 OK", pred.resume(body, trace_ctx=trace_ctx)
-                if verb == "pages" and method == "POST":
-                    # cluster prefix reuse: a peer engine (on a directory
-                    # hit) pulls the pages covering its prompt instead of
-                    # re-prefilling them
-                    return "200 OK", pred.export_pages(body.get("ids")
-                                                       or [])
-                if verb == "predict":
-                    return "200 OK", pred.predict(body["instances"])
+                if self.model_pool is not None \
+                        and self.model_pool.has(name):
+                    return self._leased(name, verb, pred, body, environ,
+                                        trace_ctx)
+                return self._dispatch(name, verb, pred, body, environ,
+                                      trace_ctx)
             else:
                 pred = self.predictors[rest]
                 ready = not getattr(pred, "draining", False)
                 meta = {"name": rest, "ready": ready}
+                if self.model_pool is not None \
+                        and self.model_pool.has(rest):
+                    # residency metadata never warms a parked model — a
+                    # readiness probe loading weights would defeat the
+                    # whole warm pool
+                    meta["residency"] = self.model_pool.state_of(rest)
                 engine = getattr(pred, "engine", None)
                 if engine is not None:
                     # live load snapshot (engine.stats()): for operators
@@ -642,6 +703,67 @@ class PredictorApp:
                     meta["stats"] = engine.stats()
                 return "200 OK", meta
         raise KeyError(path)
+
+    def _leased(self, name, verb, pred, body, environ, trace_ctx):
+        """Verb dispatch under a residency pin: acquire warms a parked
+        model (concurrent cold requests coalesce behind the one load)
+        and pins it against eviction for the request's lifetime; release
+        stamps LRU recency.  The per-model latency histogram feeds the
+        fleet interference rules (obs.rules.fleet_slos)."""
+        self.model_pool.acquire(name)
+        try:
+            t0 = time.perf_counter()
+            out = self._dispatch(name, verb, pred, body, environ,
+                                 trace_ctx)
+            from kubeflow_tpu.serving.model_pool import (
+                MODEL_REQUEST_SECONDS,
+            )
+
+            MODEL_REQUEST_SECONDS.labels(name).observe(
+                time.perf_counter() - t0)
+            return out
+        finally:
+            self.model_pool.release(name)
+
+    def _dispatch(self, name, verb, pred, body, environ, trace_ctx):
+        method = environ["REQUEST_METHOD"]
+        if verb == "generate":
+            eos = body.get("eos_id")
+            kw = {}
+            if getattr(pred, "role", "colocated") == "prefill":
+                # the gateway picked the decode worker (by slot
+                # availability) and stamped it on the request
+                kw["decode_peer"] = environ.get(
+                    "HTTP_X_KF_DECODE_PEER")
+            return "200 OK", pred.generate(
+                body["ids"],
+                max_new_tokens=int(body.get("max_new_tokens", 32)),
+                temperature=float(body.get("temperature", 0.0)),
+                eos_id=int(eos) if eos is not None else None,
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 0.0)),
+                deadline_s=self._deadline_s(environ, body),
+                trace_ctx=trace_ctx,
+                # gateway-stamped resolved tenant (profile name or
+                # the bounded anonymous fallback); engine clamps it
+                # against configured shares
+                tenant=environ.get("HTTP_KUBEFLOW_USERID"),
+                **kw)
+        if verb == "resume" and method == "POST":
+            # decode-role entry: seed a slot from a serialized
+            # prefill handoff and finish the stream.  QueueFull
+            # (pool cannot host the pages) maps to 429 +
+            # Retry-After upstream — shed semantics, so the
+            # gateway retries a decode sibling.
+            return "200 OK", pred.resume(body, trace_ctx=trace_ctx)
+        if verb == "pages" and method == "POST":
+            # cluster prefix reuse: a peer engine (on a directory
+            # hit) pulls the pages covering its prompt instead of
+            # re-prefilling them
+            return "200 OK", pred.export_pages(body.get("ids") or [])
+        if verb == "predict":
+            return "200 OK", pred.predict(body["instances"])
+        raise KeyError(f"/v1/models/{name}:{verb}")
 
     def _body(self, environ) -> dict:
         length = int(environ.get("CONTENT_LENGTH") or 0)
@@ -708,6 +830,17 @@ def main(argv=None) -> int:
                         help="int8-quantize KV pages at prefill-commit "
                              "(~2x effective page capacity; perplexity-"
                              "neutral, not bit-identical)")
+    parser.add_argument("--weight-budget-mb", type=float, default=0.0,
+                        help="HBM byte budget (MB) shared by ALL models' "
+                             "weights: idle models LRU-evict to parked "
+                             "(engine kept warm, weights dropped) and "
+                             "cold requests coalesce behind one load; "
+                             "0 disables residency management")
+    parser.add_argument("--staging-mb", type=float, default=64.0,
+                        help="host staging window (MB) for streamed "
+                             "checkpoint restore: at most this many "
+                             "bytes of mmap'd tensors are in flight to "
+                             "the device at once")
     args = parser.parse_args(argv)
 
     specs = [m for m in (args.models or []) if m] or ["llama"]
@@ -748,17 +881,47 @@ def main(argv=None) -> int:
                                           args.draft_layers)),
                 role=opts.get("role", args.role),
                 kv_quant=opts.get("kv_quant", "").lower()
-                in ("1", "true") or args.kv_quant)
+                in ("1", "true") or args.kv_quant,
+                staging_mb=float(opts.get("staging_mb", args.staging_mb)))
+            if opts.get("parked", "").lower() in ("1", "true"):
+                # warm-pool start: compile-bearing engine built, weights
+                # dropped until the first request (or a gateway-coalesced
+                # cold start) warms them
+                predictors[name].park()
         else:
             predictors[name] = ClassifierPredictor(name,
                                                    checkpoint_dir=ckpt)
+    model_pool = None
+    if args.weight_budget_mb > 0:
+        from kubeflow_tpu.serving.model_pool import (
+            ModelPool,
+            set_model_pool,
+        )
+
+        model_pool = set_model_pool(
+            ModelPool(int(args.weight_budget_mb * (1 << 20))))
+        for name, pred in predictors.items():
+            engine = getattr(pred, "engine", None)
+            if engine is None:
+                continue  # classifiers stay outside the budget
+            model_pool.register(
+                name,
+                # warm() is idempotent: a never-parked predictor's first
+                # acquire accounts its bytes without reloading
+                (lambda p=pred: (p, p.warm())),
+                evictor=pred.park,
+                nbytes_hint=pred.weight_bytes)
+            # weights-and-pages arbitration: this engine's page-alloc
+            # failures may evict an idle SIBLING model's weights
+            engine.pressure_fn = (
+                lambda pool=engine.pool, mp=model_pool: mp.relieve(pool))
     # under the LocalExecutor, KF_POD_PORT is the allocated host port the
     # gateway routes to (a one-host kubelet has no pod IPs); on a real
     # cluster the env is absent and --port binds inside the pod netns
     import os
 
     port = int(os.environ.get("KF_POD_PORT", args.port))
-    app = PredictorApp(predictors)
+    app = PredictorApp(predictors, model_pool=model_pool)
     httpd, thread = serve(app, port)
 
     # graceful drain on SIGTERM (the kubelet's stop signal and the

@@ -1,0 +1,365 @@
+"""Fleet-scale many-model serving loadtest (ISSUE 18: weight residency
+LRU, streamed loading, cold-start coalescing).
+
+Traffic model after production many-model platforms: far more registered
+models than fit in device memory, power-law popularity, and a hot set
+that drifts over the day.  Two phases:
+
+- PHASE A (real engines): three tiny-llama predictors behind one
+  ``PredictorApp`` + ``ModelPool`` whose weight budget fits TWO of them,
+  so round-robin traffic churns the cold pair through park/re-warm while
+  the hot model stays resident.  Measures the hot model's latency under
+  churn against its single-model baseline (the interference headline),
+  cold-start p99 through the pooled path, an K-concurrent cold storm
+  that must coalesce into ONE weight load with token-identical streams,
+  token identity of every re-warmed model against its pre-churn output,
+  and per-model burn-rate rules (``obs.rules.fleet_slos``) that must stay
+  silent for the hot model while its neighbours cold-start around it.
+  Leak gates: zero orphan KV pages, zero leaked pins, pool weight bytes
+  reconcile to zero after a full drain.
+
+- PHASE B (synthetic fleet): 120 ``InferenceService`` objects (the
+  weight-budget annotation round-trips through the real API helpers)
+  drive a fake-clock ``ModelPool`` with log-uniform (Zipf-ish)
+  popularity plus a diurnal hot-set drift, loaders billing simulated
+  stream-load time by size.  Gates: exact byte accounting (pool weight
+  bytes == sum of resident sizes at every probe), budget respected
+  whenever no pin is held, hits + loads == requests, a residency hit
+  rate floor (KF_FLEET_HIT_FLOOR), and no model wedged in "loading".
+
+``--smoke`` is the CI gate (small counts, hard asserts; tunables:
+KF_FLEET_COLD_P99 seconds ceiling, KF_FLEET_HOT_FACTOR multiple of the
+single-model baseline, KF_FLEET_HIT_FLOOR).  The full run prints one
+JSON line for PERF.md.
+
+Usage: python loadtest/load_fleet.py [--smoke]
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pct(vals: list[float], p: float) -> float:
+    vals = sorted(vals)
+    return vals[min(int(len(vals) * p / 100), len(vals) - 1)]
+
+
+class _FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _call(app, path: str, body: dict | None = None) -> tuple[str, dict]:
+    """One WSGI request against the PredictorApp — in-process, so the
+    storm threads contend on the real lease/coalesce path, not sockets."""
+    raw = json.dumps(body).encode() if body is not None else b""
+    env = {"REQUEST_METHOD": "POST" if body is not None else "GET",
+           "PATH_INFO": path,
+           "CONTENT_LENGTH": str(len(raw)),
+           "wsgi.input": io.BytesIO(raw)}
+    status: dict = {}
+    out = b"".join(app(env, lambda s, h: status.update(code=s)))
+    return status["code"], json.loads(out or b"null")
+
+
+def _phase_a(smoke: bool) -> tuple[dict, list[str]]:
+    from kubeflow_tpu import obs
+    from kubeflow_tpu.obs.rules import FIRING, fleet_slos
+    from kubeflow_tpu.serving.model_pool import (
+        COLDSTART_COALESCED, COLDSTART_LOADS, MODEL_REQUEST_SECONDS,
+        RESIDENT, ModelPool)
+    from kubeflow_tpu.serving.predictor import GenerativePredictor, \
+        PredictorApp
+
+    failures: list[str] = []
+    hot_reps = 6 if smoke else 12
+    waves = 3 if smoke else 10
+    storm_k = 6 if smoke else 8
+    max_new = 6
+    prompt = [[5, 8, 13, 21]]
+
+    preds = {f"m{i}": GenerativePredictor("llama", size="tiny",
+                                          max_batch=2, max_seq=64, seed=i)
+             for i in range(3)}
+    # pre-churn reference streams + compile warm-up, then park everything
+    # so every load flows through the pool and is accounted
+    baseline = {}
+    for name, p in preds.items():
+        p.generate(prompt, max_new_tokens=max_new)
+        baseline[name] = p.generate(prompt, max_new_tokens=max_new)["ids"]
+    weight_one = preds["m0"].weight_bytes
+    pool = ModelPool(2 * weight_one)            # fits 2 of the 3
+    for name, p in preds.items():
+        pool.register(name, (lambda q=p: (q, q.warm())), evictor=p.park,
+                      nbytes_hint=p.weight_bytes)
+        p.park()
+    app = PredictorApp(preds, model_pool=pool)
+
+    def ask(name: str) -> tuple[float, list]:
+        t0 = time.perf_counter()
+        code, out = _call(app, f"/v1/models/{name}:generate",
+                          {"ids": prompt, "max_new_tokens": max_new})
+        assert code.startswith("200"), (code, out)
+        return time.perf_counter() - t0, out["ids"]
+
+    # -- hot single-model baseline (m0 resident, no churn) -------------
+    ask("m0")                                   # the one cold load
+    hot_base = [ask("m0")[0] for _ in range(hot_reps)]
+    hot_base_p99 = _pct(hot_base, 99)
+
+    # per-model burn-rate rules armed BEFORE the churn: threshold at the
+    # tightest bucket >= 4x the hot baseline p99 — real cross-model
+    # interference (the hot model paying its neighbours' loads) blows
+    # through it; clean isolation never gets near it
+    threshold = next(
+        (b for b in MODEL_REQUEST_SECONDS.buckets
+         if b >= 4.0 * hot_base_p99), MODEL_REQUEST_SECONDS.buckets[-1])
+    pipeline = obs.Pipeline(
+        interval_s=5.0,
+        slos=fleet_slos(list(preds), latency_threshold_s=threshold,
+                        scrape_interval_s=5.0),
+        clock=_FakeClock())
+    pipeline.tick(at=0.0)
+
+    # -- churn: hot model interleaved with an alternating cold pair ----
+    # budget 2: m0 stays resident throughout, m1/m2 evict each other
+    hot_churn, cold_lat = [], []
+    loads0 = COLDSTART_LOADS.get()
+    for _ in range(waves):
+        for name in ("m0", "m1", "m0", "m2"):
+            cold = pool.state_of(name) != RESIDENT
+            dt, ids = ask(name)
+            (cold_lat if cold else
+             hot_churn if name == "m0" else []).append(dt)
+            if ids != baseline[name]:
+                failures.append(
+                    f"{name}: re-warmed stream diverged from baseline")
+                break
+    churn_loads = COLDSTART_LOADS.get() - loads0
+
+    for at in range(5, 325, 5):
+        pipeline.tick(at=float(at))
+    fired = {e["alert"] for e in pipeline.rules.log(limit=200)
+             if e["to"] == FIRING} | set(pipeline.rules.firing())
+    interference = sorted(a for a in fired if a.endswith("-m0"))
+
+    # -- cold-start coalescing storm on a parked model -----------------
+    if pool.state_of("m1") == RESIDENT:
+        pool.evict("m1")
+    loads0 = COLDSTART_LOADS.get()
+    coal0 = COLDSTART_COALESCED.get()
+    results: list = [None] * storm_k
+
+    def worker(i: int) -> None:
+        results[i] = ask("m1")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(storm_k)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    storm_loads = COLDSTART_LOADS.get() - loads0
+    storm_coalesced = COLDSTART_COALESCED.get() - coal0
+    if storm_loads != 1:
+        failures.append(
+            f"coalescing: {storm_k} concurrent cold requests took "
+            f"{storm_loads} weight loads (want exactly 1)")
+    for r in results:
+        if r is None or r[1] != baseline["m1"]:
+            failures.append("coalesced storm stream diverged or hung")
+            break
+
+    # -- leak gates -----------------------------------------------------
+    stats = pool.stats()
+    pinned = sum(m["refs"] for m in stats["models"].values())
+    orphans = 0
+    for name, p in preds.items():
+        p.engine.drained(timeout=30)
+        orphans += p.engine.stats()["kv_pool"]["orphan_pages"]
+    for name in list(preds):
+        if pool.state_of(name) == RESIDENT:
+            pool.evict(name)
+    leak_bytes = pool.weight_bytes() + pool.donated_bytes()
+    if pinned:
+        failures.append(f"{pinned} weight pins leaked after the storm")
+    if orphans:
+        failures.append(f"{orphans} orphan KV pages after drain")
+    if leak_bytes:
+        failures.append(f"{leak_bytes} weight bytes leaked after "
+                        "evicting every model")
+    if interference:
+        failures.append(
+            "hot-model SLO fired during neighbour churn: "
+            + ", ".join(interference))
+
+    cold_p99 = _pct(cold_lat or [0.0], 99)
+    hot_p99 = _pct(hot_churn or [0.0], 99)
+    cold_ceil = float(os.environ.get("KF_FLEET_COLD_P99", "2.5"))
+    hot_factor = float(os.environ.get("KF_FLEET_HOT_FACTOR", "3.0"))
+    if cold_p99 > cold_ceil:
+        failures.append(f"cold-start p99 {cold_p99:.3f}s over the "
+                        f"{cold_ceil:.1f}s ceiling")
+    if hot_p99 > hot_factor * hot_base_p99:
+        failures.append(
+            f"hot-model p99 under churn {hot_p99 * 1e3:.1f}ms is over "
+            f"{hot_factor:.1f}x its single-model baseline "
+            f"{hot_base_p99 * 1e3:.1f}ms")
+
+    for p in preds.values():
+        p.engine.shutdown()
+    report = {
+        "models": len(preds),
+        "weight_budget_models": 2,
+        "churn_requests": 4 * waves,
+        "churn_weight_loads": churn_loads,
+        "hot_base_p99_ms": round(hot_base_p99 * 1e3, 2),
+        "hot_churn_p99_ms": round(hot_p99 * 1e3, 2),
+        "hot_factor": round(hot_p99 / max(hot_base_p99, 1e-9), 2),
+        "cold_p50_ms": round(_pct(cold_lat or [0.0], 50) * 1e3, 2),
+        "cold_p99_ms": round(cold_p99 * 1e3, 2),
+        "storm_fanout": storm_k,
+        "storm_weight_loads": storm_loads,
+        "storm_coalesced": storm_coalesced,
+        "interference_alerts": interference,
+        "orphan_pages": orphans,
+        "leaked_pins": pinned,
+    }
+    return report, failures
+
+
+def _phase_b(smoke: bool) -> tuple[dict, list[str]]:
+    from kubeflow_tpu.api import inferenceservice as isvc_api
+    from kubeflow_tpu.core.store import APIServer
+    from kubeflow_tpu.serving.model_pool import LOADING, ModelPool
+
+    failures: list[str] = []
+    n_models = 120
+    requests = 2000 if smoke else 20000
+    clk = _FakeClock()
+    stream_bw = float(1 << 30)            # simulated restore bandwidth
+
+    # the fleet IS 120 InferenceServices: the weight-budget annotation
+    # round-trips through the real API helpers and each service's
+    # declared budget doubles as its synthetic weight size
+    server = APIServer()
+    sizes: dict[str, int] = {}
+    state = 0x2545F491
+    for i in range(n_models):
+        state = (state * 1103515245 + 12345) & 0x7FFFFFFF
+        mb = 4 + state % 60
+        name = f"svc-{i:03d}"
+        obj = isvc_api.new(name, "fleet", weight_budget_mb=float(mb))
+        server.create(obj)
+        sizes[name] = int(
+            isvc_api.weight_budget_mb(server.get(isvc_api.KIND, name,
+                                                 "fleet")) * (1 << 20))
+    avg = sum(sizes.values()) // n_models
+    pool = ModelPool(16 * avg, clock=clk)
+    for name, nbytes in sizes.items():
+        def loader(n=name, b=nbytes):
+            clk.advance(0.005 + b / stream_bw)    # bill the stream-load
+            return (n, b)
+        pool.register(name, loader, nbytes_hint=nbytes)
+
+    names = sorted(sizes)
+    hits = loads0 = 0
+    cold_lat: list[float] = []
+    state = 0xBADC0DE
+    for t in range(requests):
+        clk.advance(0.01)
+        # log-uniform popularity rank + a hot set that drifts through
+        # the namespace over the "day"
+        state = (state * 1103515245 + 12345) & 0x7FFFFFFF
+        u = state / float(1 << 31)
+        rank = int(n_models ** u) - 1
+        shift = (t * n_models) // requests
+        name = names[(rank + shift) % n_models]
+        from kubeflow_tpu.serving.model_pool import RESIDENT
+        hot = pool.state_of(name) == RESIDENT
+        t0 = clk()
+        pool.acquire(name)
+        if hot:
+            hits += 1
+        else:
+            loads0 += 1
+            cold_lat.append(clk() - t0)
+        pool.release(name)
+        # exact byte accounting at every 100th probe: the pool's gauge
+        # must equal the sum of what it says is resident, and with no
+        # pin held the budget is a hard ceiling
+        if t % 100 == 0:
+            s = pool.stats()
+            resident_sum = sum(
+                m["nbytes"] for m in s["models"].values()
+                if m["state"] == "resident")
+            if s["weight_bytes"] != resident_sum:
+                failures.append(
+                    f"byte accounting drifted at request {t}: gauge "
+                    f"{s['weight_bytes']} != resident {resident_sum}")
+                break
+            if s["weight_bytes"] > 16 * avg:
+                failures.append(
+                    f"budget overrun with zero pins at request {t}: "
+                    f"{s['weight_bytes']} > {16 * avg}")
+                break
+
+    s = pool.stats()
+    wedged = [n for n, m in s["models"].items() if m["state"] == LOADING]
+    if wedged:
+        failures.append(f"models wedged loading: {wedged[:5]}")
+    if hits + loads0 != requests:
+        failures.append(
+            f"request accounting: {hits} hits + {loads0} loads "
+            f"!= {requests}")
+    hit_rate = hits / max(requests, 1)
+    hit_floor = float(os.environ.get("KF_FLEET_HIT_FLOOR", "0.35"))
+    if hit_rate < hit_floor:
+        failures.append(f"fleet residency hit rate {hit_rate:.3f} under "
+                        f"the {hit_floor} floor")
+    return {
+        "inference_services": n_models,
+        "requests": requests,
+        "budget_bytes": 16 * avg,
+        "hit_rate": round(hit_rate, 3),
+        "weight_loads": loads0,
+        "evictions": s["evictions_total"],
+        "resident_models": s["resident"],
+        "sim_cold_p50_ms": round(_pct(cold_lat, 50) * 1e3, 2),
+        "sim_cold_p99_ms": round(_pct(cold_lat, 99) * 1e3, 2),
+    }, failures
+
+
+def main() -> int:
+    smoke = "--smoke" in sys.argv
+    t0 = time.perf_counter()
+    report_a, fail_a = _phase_a(smoke)
+    report_b, fail_b = _phase_b(smoke)
+    result = {"smoke": smoke,
+              "wall_s": round(time.perf_counter() - t0, 2),
+              "real_engines": report_a,
+              "synthetic_fleet": report_b}
+    print(json.dumps(result))
+    for f in fail_a + fail_b:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if fail_a or fail_b else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -732,6 +732,50 @@ BAD2 = REGISTRY.gauge("serving_kv_spilled_total", "counter-shaped gauge")
     assert [f.line for f in found] == [6, 7]
 
 
+def test_metric_name_rules_cover_fleet_family(tree):
+    """The serving_fleet_* / serving_coldstart_* residency metrics
+    follow the suffix rules — and the pass still rejects wrong-suffix
+    variants of the same family."""
+    tree("kubeflow_tpu/serving/m.py", """\
+A = REGISTRY.counter("serving_fleet_evictions_total", "ok")
+B = REGISTRY.counter("serving_coldstart_loads_total", "ok")
+C = REGISTRY.counter("serving_coldstart_coalesced_total", "ok")
+D = REGISTRY.histogram("serving_fleet_load_seconds", "ok")
+E = REGISTRY.histogram("serving_fleet_request_seconds", "ok",
+                       labels=("model",))
+F = REGISTRY.gauge("serving_fleet_weight_bytes", "ok")
+G = REGISTRY.gauge("serving_fleet_resident_models", "ok")
+BAD1 = REGISTRY.counter("serving_fleet_evictions", "missing _total")
+BAD2 = REGISTRY.histogram("serving_fleet_load_ms", "non-base unit")
+""")
+    found = [f for f in tree.run() if f.rule == "metric-name"]
+    assert [f.line for f in found] == [9, 10]
+
+
+def test_clock_injection_model_pool_always_in_scope(tree):
+    """serving/model_pool.py is clock-injected by decree: a raw
+    monotonic() there breaks the fleet loadtest's fake-clock replay of
+    eviction order, param or no param."""
+    tree("kubeflow_tpu/serving/model_pool.py", """\
+import time
+
+def touch(entry):
+    entry.last_used = time.monotonic()
+""")
+    assert "clock-injection" in rules_of(tree.run())
+    # the sibling serving modules are NOT under the decree
+    tree("kubeflow_tpu/serving/model_pool.py", """\
+x = 1
+""")
+    tree("kubeflow_tpu/serving/other.py", """\
+import time
+
+def touch(entry):
+    entry.last_used = time.monotonic()
+""")
+    assert "clock-injection" not in rules_of(tree.run())
+
+
 def test_handoff_threadlocal_suppression_pays_rent(tree):
     tree("kubeflow_tpu/serving/s.py", """\
 import threading

@@ -24,7 +24,11 @@ def test_changed_components_path_filtering():
     assert changed_components(["bench.py"]) == sorted(COMPONENTS)
     both = changed_components(["kubeflow_tpu/hpo/controller.py",
                                "kubeflow_tpu/serving/predictor.py"])
-    assert both == ["hpo", "serving"]
+    # predictor.py belongs to BOTH serving and the fleet component
+    # (model-pool residency rides the predictor)
+    assert both == ["fleet", "hpo", "serving"]
+    assert changed_components(
+        ["kubeflow_tpu/serving/model_pool.py"]) == ["fleet", "serving"]
 
 
 def test_generate_workflow_dag():

@@ -531,3 +531,36 @@ def test_query_route_promql_lite_with_exemplars(stack):
     finally:
         obs.set_pipeline(None)
         server.obs = None
+
+
+def test_fleet_route_reports_residency_and_coldstart(stack):
+    """/dashboard/api/fleet: budget vs resident bytes, cold-start load
+    stats, per-model pool rows, and the per-backend residency map the
+    gateway routes on."""
+    from kubeflow_tpu import autoscale
+    from kubeflow_tpu.serving import model_pool as mp
+
+    server, mgr, base = stack
+    pool = mp.ModelPool(1024)
+    pool.register("llama", lambda: ("w", 300))
+    pool.acquire("llama")
+    pool.release("llama")
+    old = mp.set_model_pool(pool)
+    collector = autoscale.get_collector(server)
+    collector.set_residency(("10.0.0.7", 9000), {"llama"})
+    try:
+        code, state = req(base, "/dashboard/api/fleet",
+                          user="alice@corp.com")
+        assert code == 200
+        assert state["budget_bytes"] == 1024
+        assert state["weight_bytes"] == 300
+        cs = state["coldstart"]
+        assert cs["loads"] >= 1
+        assert {"loads", "coalesced", "requests_per_load",
+                "load_p50_s", "load_p99_s"} <= set(cs)
+        assert state["pool"]["models"]["llama"]["state"] == "resident"
+        assert {"host": "10.0.0.7", "port": 9000,
+                "resident": ["llama"]} in state["backends"]
+    finally:
+        mp.set_model_pool(old)
+        collector.set_residency(("10.0.0.7", 9000), ())

@@ -1369,3 +1369,106 @@ def test_ejected_wrong_role_pod_never_serves_the_role():
     b = gw.backend_for_route(server, route, "/web/default/x",
                              ejected=ej, role="decode")
     assert b.port == 9000
+
+
+# -- fleet residency routing + cold-start coalescing (ISSUE 18) ----------------
+
+def test_model_from_path_extracts_serving_model():
+    assert gw.model_from_path("/ns/svc/v1/models/llama:generate") == "llama"
+    assert gw.model_from_path("/web/default/v1/models/bert") == "bert"
+    assert gw.model_from_path("/web/default/healthz") is None
+    assert gw.model_from_path("/v1/models/") is None
+
+
+def test_resident_backend_preferred_for_model():
+    """A replica advertising the model's weights resident wins the pick
+    even when busier — skipping a multi-second cold load beats a
+    marginally shorter queue."""
+    from kubeflow_tpu.autoscale.metrics import MetricsCollector
+
+    server, route = _role_stack([None, None, None])
+    coll = MetricsCollector()
+    coll.set_residency(("127.0.0.1", 9001), {"llama"})
+    coll.inc_backend(("127.0.0.1", 9001))     # busier, still preferred
+    before = gw.PICKS.get("any", "resident")
+    b = gw.backend_for_route(server, route, "/web/default/x",
+                             collector=coll, model="llama")
+    assert b.port == 9001
+    assert gw.PICKS.get("any", "resident") == before + 1
+    # a model nobody advertises falls through to least-loaded
+    b2 = gw.backend_for_route(server, route, "/web/default/x",
+                              collector=coll, model="other")
+    assert b2.port in (9000, 9002)
+    # EVERY backend resident: no routing signal, normal least-loaded pick
+    coll.set_residency(("127.0.0.1", 9000), {"llama"})
+    coll.set_residency(("127.0.0.1", 9002), {"llama"})
+    before_ll = gw.PICKS.get("any", "least_loaded")
+    gw.backend_for_route(server, route, "/web/default/x",
+                         collector=coll, model="llama")
+    assert gw.PICKS.get("any", "least_loaded") == before_ll + 1
+
+
+class _FakeActivator:
+    """Stands in for autoscale.Activator: one slow scale-from-zero that
+    records how many requests actually rode the hold path."""
+
+    timeout = 5.0
+
+    def __init__(self, server):
+        self.server = server
+        self.waits = []
+        self._lock = __import__("threading").Lock()
+
+    def covers(self, route):
+        return ("default", "web")
+
+    def wait(self, route, path, key):
+        import time as _time
+
+        from kubeflow_tpu.core.objects import api_object
+
+        with self._lock:
+            self.waits.append(path)
+        _time.sleep(0.3)                      # the "pod is booting" window
+        pod = api_object("Pod", "pod-0", "default",
+                         labels={"app": "web"},
+                         spec={"containers": [{"name": "c"}]})
+        self.server.create(pod)
+        self.server.patch_status("Pod", "pod-0", "default", {
+            "phase": "Running", "podIP": "127.0.0.1",
+            "portMap": {"8080": 9000}})
+        return gw.backend_for_route(self.server, route, path)
+
+
+def test_concurrent_cold_starts_coalesce_to_one_activation():
+    """K requests hit a scaled-to-zero revision together: ONE leader
+    rides the activator, K-1 followers wait and re-resolve against the
+    pod the leader brought up — counted in
+    serving_coldstart_coalesced_total."""
+    import threading
+
+    from kubeflow_tpu.autoscale.metrics import MetricsCollector
+    from kubeflow_tpu.serving.model_pool import COLDSTART_COALESCED
+
+    server, route = _role_stack([])           # zero pods: cold
+    coll = MetricsCollector()
+    activator = _FakeActivator(server)
+    gateway = gw.Gateway(server, collector=coll, activator=activator)
+    coalesced0 = COLDSTART_COALESCED.get()
+    K = 4
+    results = [None] * K
+
+    def worker(i):
+        results[i] = gateway._activate(route, "/web/default/x")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(K)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(activator.waits) == 1          # K cold starts -> 1 load
+    assert COLDSTART_COALESCED.get() - coalesced0 == K - 1
+    for b in results:
+        assert b is not None and b.port == 9000
+    assert gateway._coldstart_leaders == {}   # leader cleaned up

@@ -1,0 +1,609 @@
+"""Weight residency for many-model serving (ISSUE 18): LRU under one
+HBM budget with refcount pins, coalesced cold-start loads, weights-and-
+pages arbitration with the KV page pool, streamed checkpoint restore
+under a bounded staging window, and the warm-pool re-warm that must skip
+XLA compilation."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.serving.model_pool import (
+    COLDSTART_COALESCED,
+    COLDSTART_LOADS,
+    DRAINING,
+    PARKED,
+    RESIDENT,
+    ModelDraining,
+    ModelPool,
+    is_streamable,
+    save_streamable,
+    stream_restore,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _simple_loader(nbytes: int, calls: list | None = None):
+    def loader():
+        if calls is not None:
+            calls.append(nbytes)
+        return (f"weights-{nbytes}", nbytes)
+    return loader
+
+
+# -- residency LRU units -------------------------------------------------------
+
+class TestResidencyLRU:
+    def test_acquire_pins_release_unpins(self):
+        clk = FakeClock()
+        pool = ModelPool(1024, clock=clk)
+        calls = []
+        pool.register("m", _simple_loader(100, calls))
+        payload = pool.acquire("m")
+        assert payload == "weights-100"
+        assert pool.state_of("m") == RESIDENT
+        assert pool.weight_bytes() == 100
+        # pinned: evict_lru must not touch it
+        assert pool.evict_lru() == 0
+        pool.release("m")
+        assert pool.evict_lru() == 100
+        assert pool.state_of("m") == PARKED
+        assert pool.weight_bytes() == 0
+        assert calls == [100]
+        with pytest.raises(ValueError):
+            pool.release("m")           # release of unpinned
+
+    def test_lru_evicts_least_recently_released(self):
+        """Recency is the RELEASE time; the budget pass evicts the model
+        whose last request finished longest ago."""
+        clk = FakeClock()
+        pool = ModelPool(250, clock=clk)
+        for name in ("a", "b", "c"):
+            pool.register(name, _simple_loader(100))
+        pool.acquire("a")
+        pool.release("a")
+        clk.advance(1)
+        pool.acquire("b")
+        pool.release("b")
+        clk.advance(1)
+        # "c" needs room: 100+100+100 > 250 -> evict exactly one, the LRU
+        pool.acquire("c")
+        assert pool.state_of("a") == PARKED
+        assert pool.state_of("b") == RESIDENT
+        assert pool.weight_bytes() == 200
+        pool.release("c")
+
+    def test_pinned_models_exempt_budget_overshoots(self):
+        """Every resident model pinned: the budget pass has no victim
+        and the load proceeds anyway — availability beats the budget."""
+        clk = FakeClock()
+        pool = ModelPool(150, clock=clk)
+        pool.register("hot", _simple_loader(100))
+        pool.register("cold", _simple_loader(100))
+        pool.acquire("hot")             # pinned for the whole test
+        pool.acquire("cold")            # overshoot: 200 > 150
+        assert pool.weight_bytes() == 200
+        pool.release("cold")
+        pool.release("hot")
+        clk.advance(1)
+        # with pins gone the next load trims back under budget
+        pool.register("late", _simple_loader(100))
+        pool.acquire("late")
+        assert pool.weight_bytes() <= 150 + 100  # at most one + new
+
+    def test_nbytes_hint_preevicts(self):
+        """The hint frees room BEFORE the loader runs, so a well-hinted
+        fleet never transiently overshoots."""
+        clk = FakeClock()
+        pool = ModelPool(200, clock=clk)
+        pool.register("a", _simple_loader(150))
+        seen = {}
+        pool.acquire("a")
+        pool.release("a")
+        clk.advance(1)
+
+        def loader_b():
+            seen["bytes_at_load"] = pool.weight_bytes()
+            return ("wb", 150)
+
+        pool.register("b", loader_b, nbytes_hint=150)
+        pool.acquire("b")
+        assert seen["bytes_at_load"] == 0   # "a" evicted pre-load
+        pool.release("b")
+
+    def test_draining_refuses_acquire_and_frees_on_last_release(self):
+        clk = FakeClock()
+        pool = ModelPool(1024, clock=clk)
+        pool.register("m", _simple_loader(100))
+        pool.acquire("m")
+        pool.drain("m")
+        with pytest.raises(ModelDraining):
+            pool.acquire("m")
+        assert pool.weight_bytes() == 100   # pin still holds the weights
+        pool.release("m")
+        assert pool.weight_bytes() == 0     # last release evicted
+        assert pool.state_of("m") == DRAINING
+
+    def test_evictor_callback_runs_and_stats_account(self):
+        clk = FakeClock()
+        pool = ModelPool(1024, clock=clk)
+        freed = []
+        pool.register("m", _simple_loader(100),
+                      evictor=lambda: freed.append(100) or 100)
+        pool.acquire("m")
+        pool.release("m")
+        pool.evict("m")
+        assert freed == [100]
+        s = pool.stats()
+        assert s["loads_total"] == 1
+        assert s["evictions_total"] == 1
+        assert s["models"]["m"]["state"] == PARKED
+        assert s["weight_bytes"] == 0
+
+    def test_on_change_publishes_resident_set(self):
+        seen = []
+        clk = FakeClock()
+        pool = ModelPool(1024, clock=clk,
+                         on_change=lambda names: seen.append(names))
+        pool.register("m", _simple_loader(50))
+        pool.acquire("m")
+        pool.release("m")
+        assert seen[-1] == frozenset({"m"})
+        pool.evict("m")
+        assert seen[-1] == frozenset()
+
+
+# -- cold-start coalescing -----------------------------------------------------
+
+class TestColdStartCoalescing:
+    def test_k_concurrent_cold_acquires_one_load(self):
+        """The tentpole guarantee: K cold requests -> exactly ONE loader
+        run; the K-1 followers coalesce and are counted."""
+        K = 6
+        pool = ModelPool(1024)
+        calls = []
+        release_evt = threading.Event()
+
+        def slow_loader():
+            calls.append(1)
+            release_evt.wait(10)
+            return ("w", 100)
+
+        pool.register("m", slow_loader)
+        results = []
+
+        def worker():
+            payload = pool.acquire("m", timeout=30)
+            results.append(payload)
+            pool.release("m")
+
+        threads = [threading.Thread(target=worker) for _ in range(K)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)                  # every follower is parked on the
+        release_evt.set()                # leader's event by now
+        for t in threads:
+            t.join(timeout=30)
+        assert len(calls) == 1
+        assert results == ["w"] * K
+        s = pool.stats()
+        assert s["loads_total"] == 1
+        assert s["coalesced_total"] == K - 1
+        assert s["models"]["m"]["refs"] == 0    # all pins released
+
+    def test_failed_leader_surfaces_error_then_retries_fresh(self):
+        pool = ModelPool(1024)
+        attempts = []
+
+        def flaky_loader():
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise OSError("checkpoint unreachable")
+            return ("w", 100)
+
+        pool.register("m", flaky_loader)
+        with pytest.raises(OSError):
+            pool.acquire("m")
+        assert pool.state_of("m") == PARKED     # parked again, not wedged
+        assert pool.acquire("m") == "w"         # the retry leads fresh
+        pool.release("m")
+        assert len(attempts) == 2
+
+    def test_follower_sees_leader_failure(self):
+        pool = ModelPool(1024)
+        entered = threading.Event()
+        release_evt = threading.Event()
+
+        def doomed_loader():
+            entered.set()
+            release_evt.wait(10)
+            raise RuntimeError("boom")
+
+        pool.register("m", doomed_loader)
+        errors = []
+
+        def leader():
+            try:
+                pool.acquire("m", timeout=30)
+            except Exception as e:
+                errors.append(("leader", type(e).__name__))
+
+        def follower():
+            try:
+                pool.acquire("m", timeout=30)
+            except Exception as e:
+                errors.append(("follower", type(e).__name__))
+
+        tl = threading.Thread(target=leader)
+        tl.start()
+        assert entered.wait(10)
+        tf = threading.Thread(target=follower)
+        tf.start()
+        time.sleep(0.1)
+        release_evt.set()
+        tl.join(timeout=30)
+        tf.join(timeout=30)
+        assert ("leader", "RuntimeError") in errors
+        # the follower surfaces the leader's failure (RuntimeError) —
+        # it must NOT hang or silently succeed
+        assert any(who == "follower" for who, _ in errors)
+
+
+# -- weights and KV pages: one currency ----------------------------------------
+
+class TestWeightPageArbitration:
+    def test_relieve_donates_eviction_bytes_as_page_capacity(self):
+        from kubeflow_tpu.serving.page_pool import PagePool
+
+        clk = FakeClock()
+        pool = PagePool(4, 4, page_nbytes=64)   # 3 allocatable HBM slots
+        mp = ModelPool(512, clock=clk)
+        mp.register("cold", _simple_loader(256))
+        mp.acquire("cold")
+        mp.release("cold")
+        held = pool.alloc(3)
+        assert held is not None
+        assert pool.alloc(1) is None            # pool dry
+        # pressure: evict the idle model, mint 256 // 64 = 4 page slots
+        assert mp.relieve(pool) is True
+        assert mp.state_of("cold") == PARKED
+        assert mp.donated_bytes() == 256
+        assert mp.stats()["donated_pages"] == 4
+        extra = pool.alloc(1)                   # the retry now succeeds
+        assert extra is not None
+
+    def test_relieve_without_victim_or_pool_is_false(self):
+        mp = ModelPool(512)
+        assert mp.relieve(None) is False
+        from kubeflow_tpu.serving.page_pool import PagePool
+
+        pool = PagePool(4, 4, page_nbytes=64)
+        assert mp.relieve(pool) is False        # nothing resident to evict
+
+    def test_reload_reclaims_free_donated_slots_not_live_kv(self):
+        """A re-warm takes back only FREE page headroom; pages holding
+        live KV never evict for a weight load."""
+        from kubeflow_tpu.serving.page_pool import PagePool
+
+        clk = FakeClock()
+        pool = PagePool(4, 4, page_nbytes=64)
+        mp = ModelPool(512, clock=clk)
+        mp.register("a", _simple_loader(256))
+        mp.register("b", _simple_loader(384), nbytes_hint=384)
+        mp.acquire("a")
+        mp.release("a")
+        pool.alloc(3)
+        assert mp.relieve(pool) is True         # a evicted, 4 slots minted
+        clk.advance(1)
+        extra = pool.alloc(1)                   # 4 HBM pages live now
+        assert extra is not None
+        # loading b needs 384: 0 resident + 256 donated + 384 > 512, so
+        # the budget pass reclaims donated slots — but only the 3 free
+        # ones (capacity 7, 4 live)
+        mp.acquire("b")
+        assert mp.donated_bytes() == 64         # 1 slot still donated
+        assert pool.num_pages == 5              # 8 - 3 reclaimed
+        assert pool.stats()["in_use"] == 4      # live KV untouched
+        mp.release("b")
+
+    def test_donate_and_reclaim_page_pool_units(self):
+        from kubeflow_tpu.serving.page_pool import PagePool
+
+        pool = PagePool(4, 4, page_nbytes=64)
+        held = pool.alloc(3)
+        assert pool.alloc(1) is None
+        pool.donate(2)
+        assert pool.num_pages == 6
+        more = pool.alloc(2)
+        assert more is not None
+        # all slots occupied: reclaim finds no free headroom
+        assert pool.reclaim(2) == 0
+        pool.decref(more)
+        assert pool.reclaim(5) == 2             # capped at donated+free
+        assert pool.num_pages == 4
+        assert pool.alloc(1) is None            # budget shrunk back
+        pool.decref(held)
+
+
+# -- streamed checkpoint layout ------------------------------------------------
+
+class TestStreamedCheckpoint:
+    def _params(self):
+        import jax.numpy as jnp
+
+        return {
+            "dense": {"kernel": jnp.arange(8 * 16, dtype=jnp.float32)
+                      .reshape(8, 16),
+                      "bias": jnp.ones((16,), jnp.float32)},
+            "emb": jnp.full((32, 4), 0.5, jnp.bfloat16),
+        }
+
+    def test_save_restore_roundtrip_including_bf16(self, tmp_path):
+        import jax
+        import numpy as np
+
+        params = self._params()
+        d = str(tmp_path / "ckpt")
+        total = save_streamable(params, d)
+        assert is_streamable(d)
+        assert total == sum(x.nbytes
+                            for x in jax.tree_util.tree_leaves(params))
+        restored, report = stream_restore(d, params)
+        for a, b in zip(jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(restored)):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+        assert report["tensors"] == 3
+        assert report["bytes"] == total
+
+    def test_staging_window_bounds_host_copies(self, tmp_path):
+        """The acceptance bound: the restore never holds more than the
+        staging budget of in-flight host bytes (largest single tensor
+        excepted, and none here exceeds it)."""
+        import jax.numpy as jnp
+
+        params = {f"t{i}": jnp.full((32, 32), float(i), jnp.float32)
+                  for i in range(6)}               # 4096 B each
+        d = str(tmp_path / "ckpt")
+        save_streamable(params, d)
+        _, report = stream_restore(d, params, staging_bytes=6000)
+        assert 0 < report["max_staged_bytes"] <= 6000
+        # a roomy window really does overlap more
+        _, wide = stream_restore(d, params, staging_bytes=1 << 20)
+        assert wide["max_staged_bytes"] >= report["max_staged_bytes"]
+
+    def test_shape_or_dtype_mismatch_refused(self, tmp_path):
+        import jax.numpy as jnp
+
+        params = {"w": jnp.zeros((4, 4), jnp.float32)}
+        d = str(tmp_path / "ckpt")
+        save_streamable(params, d)
+        with pytest.raises(ValueError, match="checkpoint is"):
+            stream_restore(d, {"w": jnp.zeros((4, 5), jnp.float32)})
+        with pytest.raises(ValueError, match="checkpoint is"):
+            stream_restore(d, {"w": jnp.zeros((4, 4), jnp.bfloat16)})
+        with pytest.raises(ValueError, match="leaves"):
+            stream_restore(d, {"w": jnp.zeros((4, 4), jnp.float32),
+                               "extra": jnp.zeros((1,), jnp.float32)})
+
+    def test_predictor_streamed_restore_over_orbax_layout(self, tmp_path):
+        """A predictor pointed at a streamable directory restores through
+        the bounded-staging path and serves identically to the in-memory
+        weights it saved."""
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        src = GenerativePredictor("llama", size="tiny", max_batch=1,
+                                  max_seq=64)
+        d = str(tmp_path / "weights")
+        try:
+            baseline = src.generate([[5, 8, 13]], max_new_tokens=6)
+            save_streamable(src.params, d)
+        finally:
+            src.engine.shutdown()
+        dst = GenerativePredictor("llama", size="tiny", max_batch=1,
+                                  max_seq=64, checkpoint_dir=d, seed=7)
+        try:
+            # seed=7 would init DIFFERENT weights; identical output
+            # proves the streamed restore overwrote every tensor
+            out = dst.generate([[5, 8, 13]], max_new_tokens=6)
+            assert out["ids"] == baseline["ids"]
+        finally:
+            dst.engine.shutdown()
+
+
+# -- warm pool: park / re-warm skips XLA compile -------------------------------
+
+@pytest.fixture(scope="module")
+def predictor():
+    from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+    p = GenerativePredictor("llama", size="tiny", max_batch=2, max_seq=64)
+    yield p
+    p.engine.shutdown()
+
+
+def _jit_cache_sizes(eng) -> dict:
+    """(cache, key) -> compiled-executable count for every jitted entry
+    the engine has minted."""
+    sizes = {}
+    named = {"decode": eng._decode_cache, "verify": eng._verify_cache,
+             "extend": eng._extend_cache, "seed": eng._seed_cache,
+             "slice": eng._slice_cache}
+    for cname, cache in named.items():
+        for key, fn in cache.items():
+            sizes[(cname, key)] = fn._cache_size()
+    if eng._row_set_fn is not None:
+        sizes[("row_set", 0)] = eng._row_set_fn._cache_size()
+    return sizes
+
+
+class TestWarmPool:
+    def test_rewarm_skips_compile_and_is_token_identical(self, predictor):
+        """The acceptance assertion: park -> warm -> serve re-uses every
+        compiled executable (identical jit cache sizes — zero new
+        compilations) and the re-warmed stream matches the original."""
+        p = predictor
+        prompt = [[5, 8, 13, 21]]
+        baseline = p.generate(prompt, max_new_tokens=8)
+        before = _jit_cache_sizes(p.engine)
+        assert before                      # the engine really compiled
+
+        freed = p.park()
+        assert freed > 0
+        assert p.params is None and p.engine.params is None
+        assert p.weight_bytes == freed     # parked size still reported
+
+        warmed = p.warm()
+        assert warmed == freed
+        out = p.generate(prompt, max_new_tokens=8)
+        assert out["ids"] == baseline["ids"]
+        after = _jit_cache_sizes(p.engine)
+        assert after == before, (
+            f"re-warm recompiled: {before} -> {after}")
+
+    def test_warm_is_idempotent(self, predictor):
+        nbytes = predictor.warm()
+        assert nbytes == predictor.weight_bytes
+        assert predictor.warm() == nbytes   # no reload when resident
+
+    def test_pool_integrated_acquire_warms_evict_parks(self, predictor):
+        """The production wiring (predictor main()): loader is warm(),
+        evictor is park(), bytes are the exact quant.py accounting."""
+        p = predictor
+        p.warm()
+        pool = ModelPool(max(1, p.weight_bytes))
+        pool.register("llama", lambda: (p, p.warm()), evictor=p.park,
+                      nbytes_hint=p.weight_bytes)
+        got = pool.acquire("llama")
+        assert got is p
+        assert pool.weight_bytes() == p.weight_bytes
+        pool.release("llama")
+        assert pool.evict_lru() > 0
+        assert p.params is None             # really parked
+        assert pool.weight_bytes() == 0
+        # cold again: acquire re-warms through the same loader
+        assert pool.acquire("llama") is p
+        assert p.params is not None
+        pool.release("llama")
+
+
+# -- PredictorApp residency integration ----------------------------------------
+
+class TestLeasedHTTP:
+    def test_cold_http_requests_coalesce_and_match(self, predictor):
+        """K concurrent :generate calls against a PARKED model: exactly
+        one weight load, every stream token-identical to the warm
+        baseline, metadata reports residency without warming."""
+        import io
+        import json as json_mod
+
+        from kubeflow_tpu.serving.predictor import PredictorApp
+
+        p = predictor
+        p.warm()
+        baseline = p.generate([[7, 9, 11]], max_new_tokens=6)
+        pool = ModelPool(max(1, p.weight_bytes))
+        pool.register("llama", lambda: (p, p.warm()), evictor=p.park)
+        app = PredictorApp({"llama": p}, model_pool=pool)
+
+        def call(path, body=None):
+            env = {"REQUEST_METHOD": "POST" if body else "GET",
+                   "PATH_INFO": path,
+                   "wsgi.input": io.BytesIO(
+                       json_mod.dumps(body).encode() if body else b"")}
+            if body:
+                env["CONTENT_LENGTH"] = str(
+                    len(json_mod.dumps(body).encode()))
+            status = {}
+            out = b"".join(app(env, lambda s, h: status.update(code=s)))
+            return status["code"], json_mod.loads(out)
+
+        # park it; metadata must report without triggering a load
+        app.model_pool.acquire("llama")
+        app.model_pool.release("llama")
+        app.model_pool.evict("llama")
+        code, meta = call("/v1/models/llama")
+        assert code.startswith("200")
+        assert meta["residency"] == PARKED
+        assert p.params is None             # the probe did NOT warm
+
+        loads0 = COLDSTART_LOADS.get()
+        K = 4
+        results = [None] * K
+
+        def worker(i):
+            results[i] = call("/v1/models/llama:generate",
+                              {"ids": [[7, 9, 11]], "max_new_tokens": 6})
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(K)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for code, body in results:
+            assert code.startswith("200")
+            assert body["ids"] == baseline["ids"]
+        assert COLDSTART_LOADS.get() - loads0 == 1
+        code, meta = call("/v1/models/llama")
+        assert meta["residency"] == RESIDENT
+
+
+# -- RequestCancelled (satellite regression) -----------------------------------
+
+class TestRequestCancelled:
+    NEVER = 0
+
+    def test_cancel_raises_typed_error_still_a_valueerror(self):
+        from kubeflow_tpu.serving.engine import RequestCancelled
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        assert issubclass(RequestCancelled, ValueError)
+        p = GenerativePredictor("llama", size="tiny", max_batch=1,
+                                max_seq=128)
+        eng = p.engine
+        try:
+            eng.submit([1, 2, 3], max_new_tokens=4).result(120)   # warm
+            eng.chaos_stall(0.5)        # keep it mid-decode while we cancel
+            r = eng.submit([4, 5], max_new_tokens=100, eos_id=self.NEVER)
+            r.cancel("client went away")
+            with pytest.raises(RequestCancelled):
+                r.result(timeout=60)
+            # legacy handlers (the predictor's 422 mapping) keep working
+            r2 = eng.submit([6, 7], max_new_tokens=100, eos_id=self.NEVER)
+            r2.cancel()
+            try:
+                r2.result(timeout=60)
+                raise AssertionError("expected a cancellation error")
+            except ValueError:
+                pass
+        finally:
+            eng.shutdown()
+
+    def test_shutdown_outcome_is_request_cancelled(self):
+        from kubeflow_tpu.serving.engine import RequestCancelled
+        from kubeflow_tpu.serving.predictor import GenerativePredictor
+
+        p = GenerativePredictor("llama", size="tiny", max_batch=1,
+                                max_seq=128)
+        eng = p.engine
+        eng.submit([1, 2, 3], max_new_tokens=4).result(120)       # warm
+        eng.chaos_stall(0.5)
+        r = eng.submit([4, 5], max_new_tokens=100, eos_id=self.NEVER)
+        eng.shutdown()
+        with pytest.raises(RequestCancelled):
+            r.result(timeout=60)
