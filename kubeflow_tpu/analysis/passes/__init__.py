@@ -1,4 +1,4 @@
 """kfvet passes — importing this package registers every pass."""
 
 from kubeflow_tpu.analysis.passes import (  # noqa: F401
-    clocks, excepts, handoff, locks, metrics, spans, threads)
+    clocks, excepts, handoff, locks, metrics, spans, threads, timeouts)

@@ -37,7 +37,12 @@ NOW_PARAM_SCOPE = ("kubeflow_tpu/controllers/", "kubeflow_tpu/autoscale/",
 # recency and load-latency timings are under the same decree (the fleet
 # loadtest replays eviction order against a fake clock)
 ALWAYS_INJECTED_SCOPE = ("kubeflow_tpu/qos/",
-                         "kubeflow_tpu/serving/model_pool.py")
+                         "kubeflow_tpu/serving/model_pool.py",
+                         # the circuit breaker's every transition and the
+                         # netfault plan's blackhole timing are replayed
+                         # on fake clocks by their property tests
+                         "kubeflow_tpu/resilience.py",
+                         "kubeflow_tpu/chaos/netfault.py")
 BANNED = {"time", "monotonic", "sleep"}
 
 

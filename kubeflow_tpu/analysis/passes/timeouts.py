@@ -1,0 +1,77 @@
+"""Outbound HTTP/socket calls must carry an explicit timeout.
+
+Every partition postmortem has the same root cause buried in it: a
+blocking connect or read with no deadline, waiting forever on a peer
+that will never answer.  The default timeout of every stdlib dial —
+``http.client.HTTPConnection``, ``socket.create_connection``,
+``urllib.request.urlopen`` — is *no timeout*, so the failure mode is
+opt-out, and one forgotten kwarg turns a blackholed backend into a
+thread leak.
+
+Inside the outbound scope (the gateway, the kubeclient, the
+``core.net`` seam itself, and everything under ``serving/``), every
+call to one of those dials — or to the seam's own ``http_connection``
+/ ``create_connection`` / ``urlopen`` methods — must pass ``timeout=``
+as an explicit keyword.  A positional timeout does not count: the
+reader (and this pass) cannot tell a positional deadline from a
+positional body.  A literal ``timeout=None`` is also flagged — it is a
+spelled-out "block forever", legitimate only for long-lived watch
+streams, which declare the exception with
+``# kfvet: ignore[http-timeout]``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kubeflow_tpu.analysis.framework import (
+    Finding, ModuleInfo, Pass, keyword_arg, register)
+
+OUTBOUND_SCOPE = ("kubeflow_tpu/gateway.py",
+                  "kubeflow_tpu/core/kubeclient.py",
+                  "kubeflow_tpu/core/net.py",
+                  "kubeflow_tpu/serving/")
+# last dotted segment of the callee: stdlib dials plus the core.net seam
+# methods (same names by design, so the seam stays in scope)
+DIAL_NAMES = ("HTTPConnection", "HTTPSConnection", "http_connection",
+              "create_connection", "urlopen")
+
+
+def _callee_tail(call: ast.Call) -> str | None:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+@register
+class HttpTimeoutPass(Pass):
+    rules = ("http-timeout",)
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not mod.in_scope(*OUTBOUND_SCOPE):
+            return []
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _callee_tail(node)
+            if tail not in DIAL_NAMES:
+                continue
+            tmo = keyword_arg(node, "timeout")
+            if tmo is None:
+                findings.append(Finding(
+                    "http-timeout", mod.path, node.lineno,
+                    f"outbound {tail}() without an explicit timeout= "
+                    "keyword; a blackholed peer blocks this call "
+                    "forever"))
+            elif isinstance(tmo, ast.Constant) and tmo.value is None:
+                findings.append(Finding(
+                    "http-timeout", mod.path, node.lineno,
+                    f"outbound {tail}() with literal timeout=None "
+                    "(block forever); long-lived streams must declare "
+                    "the exception with a suppression"))
+        return findings

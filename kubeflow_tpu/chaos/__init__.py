@@ -16,7 +16,14 @@ This package is the platform's fault-injection layer:
 - :class:`FaultPlan` + :class:`FaultyIO` (``chaos.fsfault``) — storage
   faults under the persistence layer: short writes, ENOSPC/EIO, bit
   flips on read, and crash-here markers at every write boundary
-  (``loadtest/load_crash.py`` SIGKILLs a real process at each one).
+  (``loadtest/load_crash.py`` SIGKILLs a real process at each one);
+- :class:`NetFaultPlan` + :class:`FaultySocketFactory`
+  (``chaos.netfault``) — network faults under the ``core.net`` seam:
+  connect-refused, connect/recv blackholes (partitions), mid-stream
+  RSTs, and response delays, matched on
+  ``(src_component, dst_host:port, op)`` so partitions can be
+  asymmetric (``loadtest/load_partition.py`` storms the gateway's
+  breaker/hedging path with these).
 
 Everything is driven by one ``random.Random(seed)``: the same seed
 produces the same fault schedule, so ``loadtest/load_chaos.py`` can assert
@@ -34,11 +41,18 @@ from kubeflow_tpu.chaos.injector import (
     ChaosInjector,
     ChaoticAPIServer,
 )
+from kubeflow_tpu.chaos.netfault import (
+    NET_FAULTS,
+    FaultySocketFactory,
+    NetFaultPlan,
+    NetRule,
+)
 from kubeflow_tpu.chaos.schedule import (
     PreemptionSchedule,
     StormEvent,
 )
 
-__all__ = ["CHAOS_FAULTS", "ChaosInjector", "ChaoticAPIServer",
-           "CrashHere", "FaultPlan", "FaultyIO", "PreemptionSchedule",
-           "StormEvent"]
+__all__ = ["CHAOS_FAULTS", "NET_FAULTS", "ChaosInjector",
+           "ChaoticAPIServer", "CrashHere", "FaultPlan", "FaultyIO",
+           "FaultySocketFactory", "NetFaultPlan", "NetRule",
+           "PreemptionSchedule", "StormEvent"]

@@ -1,0 +1,399 @@
+"""Seeded network fault injection under the ``core.net`` seam.
+
+``fsfault.py`` injures disks below the persistence layer; this module
+injures the network below every outbound HTTP seam.  A
+:class:`NetFaultPlan` holds an ordered list of :class:`NetRule`\\ s
+matched on ``(src_component, dst_host:port, op)`` — all three fnmatch
+patterns — and a :class:`FaultySocketFactory` (a ``core.net.NetClient``)
+consults the plan at every connect, send, and recv crossing.  Faults:
+
+- ``refuse``    — connect raises ``ConnectionRefusedError`` (dead port);
+- ``blackhole`` — the op hangs for its full timeout, then raises
+  ``socket.timeout`` (a silent partition: packets vanish, nothing
+  answers — the failure mode that turns untimed ops into forever-hangs);
+- ``reset``     — mid-stream ``ConnectionResetError`` (peer RST after
+  ``after_ops`` successful crossings);
+- ``delay``     — the op completes after an injected sleep (gray
+  failure: slow, not dead — what hedged requests exist for).
+
+:meth:`NetFaultPlan.partition` composes blackholes into an asymmetric
+partition (A→B dead while B→A flows — src names a component, so the
+reverse direction is simply not matched).  Rules are deterministic:
+matching is by call order and per-rule budgets, never by coin flip, so
+the same plan against the same traffic injects the identical fault
+sequence (``chaos_net_faults_injected_total`` breakdown is digest-grade).
+The seed feeds only ``jitter`` on delay rules, drawn from one
+``random.Random(seed)``.
+
+Clock-injected by decree (kfvet clocks scope): every sleep routes
+through the injected ``sleep`` so tests can run partitions on a fake
+clock.
+"""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import threading
+import time
+import urllib.request
+from fnmatch import fnmatch
+
+from kubeflow_tpu.core.net import NetClient
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+NET_FAULTS = REGISTRY.counter(
+    "chaos_net_faults_injected_total",
+    "network faults injected by NetFaultPlan, by fault kind",
+    labels=("fault",))
+
+log = get_logger("chaos.netfault")
+
+_STREAM_OPS = ("send", "recv", "*")
+
+
+class NetRule:
+    """One fault rule.  ``src``/``dst``/``op`` are fnmatch patterns over
+    the component name, ``host:port``, and ``connect|send|recv``.
+    ``times`` bounds how often the rule fires (None = unlimited);
+    ``after_ops`` lets ``times`` matching crossings through before the
+    first injection (mid-stream RST after N reads); ``arm``/``disarm``
+    flip the rule live (a flapping backend is one rule armed and
+    disarmed on a schedule)."""
+
+    def __init__(self, src: str, dst: str, op: str, *, fault: str,
+                 times: int | None = None, after_ops: int = 0,
+                 delay_s: float = 0.0, armed: bool = True):
+        self.src = src
+        self.dst = dst
+        self.op = op
+        self.fault = fault
+        self.times = times
+        self.after_ops = after_ops
+        self.delay_s = delay_s
+        self.armed = armed
+        self._seen = 0
+        self._fired = 0
+
+    def arm(self) -> "NetRule":
+        self.armed = True
+        return self
+
+    def disarm(self) -> "NetRule":
+        self.armed = False
+        return self
+
+    def matches(self, src: str, dst: str, op: str) -> bool:
+        return (fnmatch(src, self.src) and fnmatch(dst, self.dst)
+                and fnmatch(op, self.op))
+
+    def _take(self) -> bool:
+        """Under the plan lock: should this crossing fault?"""
+        if not self.armed:
+            return False
+        self._seen += 1
+        if self._seen <= self.after_ops:
+            return False
+        if self.times is not None and self._fired >= self.times:
+            return False
+        self._fired += 1
+        return True
+
+
+class NetFaultPlan:
+    """The seeded rule book one :class:`FaultySocketFactory` executes."""
+
+    # a blackholed op with no finite timeout still terminates: partitions
+    # must injure, not wedge the test harness itself
+    BLACKHOLE_CAP_S = 30.0
+
+    def __init__(self, seed: int = 0, *, record: bool = False,
+                 sleep=time.sleep, clock=time.monotonic):
+        import random
+
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.rules: list[NetRule] = []
+        self._counts: dict[str, int] = {}
+        self._trace: list[tuple] | None = [] if record else None
+
+    # -- rule builders -------------------------------------------------------
+    def add(self, rule: NetRule) -> NetRule:
+        with self._lock:
+            self.rules.append(rule)
+        return rule
+
+    def refuse(self, src: str, dst: str, **kw) -> NetRule:
+        """connect(src→dst) raises ConnectionRefusedError."""
+        return self.add(NetRule(src, dst, "connect", fault="refuse", **kw))
+
+    def blackhole(self, src: str, dst: str, op: str = "connect",
+                  **kw) -> NetRule:
+        """The op hangs for its timeout, then times out."""
+        return self.add(NetRule(src, dst, op, fault="blackhole", **kw))
+
+    def reset(self, src: str, dst: str, op: str = "*", **kw) -> NetRule:
+        """Mid-stream RST (``after_ops=N`` kills the N+1th crossing)."""
+        return self.add(NetRule(src, dst, op, fault="reset", **kw))
+
+    def delay(self, src: str, dst: str, seconds: float, op: str = "recv",
+              jitter: float = 0.0, **kw) -> NetRule:
+        """The op completes late — gray failure, not an error.  Jitter
+        (``±jitter`` seconds) draws from the plan's seeded RNG."""
+        if jitter:
+            seconds = max(0.0, seconds
+                          + self._rng.uniform(-jitter, jitter))
+        return self.add(NetRule(src, dst, op, fault="delay",
+                                delay_s=seconds, **kw))
+
+    def partition(self, src: str, dst: str) -> list[NetRule]:
+        """Asymmetric partition: every src→dst crossing blackholes — new
+        connects hang-and-timeout, established streams starve on recv.
+        src→dst only; the reverse direction needs its own call (that
+        asymmetry is the point: A cannot reach B while B still reaches
+        A)."""
+        return [self.blackhole(src, dst, "connect"),
+                self.blackhole(src, dst, "recv")]
+
+    def heal(self, rules=None) -> None:
+        """Disarm ``rules`` (default: every rule) — the network repairs;
+        counters and budgets are preserved for the post-mortem digest."""
+        for r in (self.rules if rules is None else rules):
+            r.disarm()
+
+    # -- evaluation (called by FaultySocketFactory) --------------------------
+    def watches(self, src: str, dst: str) -> bool:
+        """Whether any rule — armed or not — could ever touch this
+        stream: disarmed rules still wrap, so arming mid-connection
+        (a flap) injures live sockets too."""
+        with self._lock:
+            return any(r.matches(src, dst, op) for r in self.rules
+                       for op in _STREAM_OPS)
+
+    def _note(self, rule: NetRule, src: str, dst: str, op: str) -> None:
+        self._counts[rule.fault] = self._counts.get(rule.fault, 0) + 1
+        if self._trace is not None:
+            self._trace.append((rule.fault, src, dst, op))
+        NET_FAULTS.labels(rule.fault).inc()
+        log.info("net fault injected", fault=rule.fault, src=src, dst=dst,
+                 op=op)
+
+    def check(self, src: str, dst: str, op: str,
+              timeout: float | None = None) -> None:
+        """Evaluate one crossing; raises/sleeps per the first armed
+        matching rule with budget.  Crossings are counted per rule even
+        when the rule declines (``after_ops`` windows)."""
+        with self._lock:
+            hit = None
+            for rule in self.rules:
+                if rule.matches(src, dst, op) and rule._take():
+                    hit = rule
+                    break
+            if hit is None:
+                return
+            self._note(hit, src, dst, op)
+        if hit.fault == "refuse":
+            raise ConnectionRefusedError(
+                111, f"netfault: {src}->{dst} connect refused")
+        if hit.fault == "blackhole":
+            cap = self.BLACKHOLE_CAP_S if timeout is None \
+                else min(timeout, self.BLACKHOLE_CAP_S)
+            self._sleep(cap)
+            raise socket.timeout(
+                f"netfault: {src}->{dst} {op} blackholed")
+        if hit.fault == "reset":
+            raise ConnectionResetError(
+                104, f"netfault: {src}->{dst} {op} reset by peer")
+        if hit.fault == "delay" and hit.delay_s > 0:
+            self._sleep(hit.delay_s)
+
+    # -- post-mortem ---------------------------------------------------------
+    def counts(self) -> dict[str, int]:
+        """Injected-fault breakdown by kind — the determinism digest."""
+        with self._lock:
+            return dict(self._counts)
+
+    def trace(self) -> list[tuple]:
+        with self._lock:
+            return list(self._trace or ())
+
+
+class _FaultyFile:
+    """Wraps the buffered reader a socket's ``makefile`` returns so
+    response reads cross the plan (mid-stream RST / delay / recv
+    blackhole land here — http.client reads via this file, not recv)."""
+
+    def __init__(self, fp, plan: NetFaultPlan, src: str, dst: str,
+                 timeout: float | None):
+        self._fp = fp
+        self._plan = plan
+        self._src = src
+        self._dst = dst
+        self._timeout = timeout
+
+    def _cross(self):
+        self._plan.check(self._src, self._dst, "recv",
+                         timeout=self._timeout)
+
+    def read(self, *a):
+        self._cross()
+        return self._fp.read(*a)
+
+    def read1(self, *a):
+        self._cross()
+        return self._fp.read1(*a)
+
+    def readline(self, *a):
+        self._cross()
+        return self._fp.readline(*a)
+
+    def readinto(self, b):
+        self._cross()
+        return self._fp.readinto(b)
+
+    def __iter__(self):
+        while True:
+            line = self.readline()
+            if not line:
+                return
+            yield line
+
+    def __getattr__(self, name):
+        return getattr(self._fp, name)
+
+
+class _FaultySocket:
+    """A socket proxy that routes send/recv crossings through the plan.
+    Non-blocking peeks (the gateway pool's staleness probe) pass through
+    uninjured — they are local hygiene, not traffic."""
+
+    def __init__(self, sock, plan: NetFaultPlan, src: str, dst: str):
+        self._sock = sock
+        self._plan = plan
+        self._src = src
+        self._dst = dst
+
+    def _cross(self, op: str):
+        self._plan.check(self._src, self._dst, op,
+                         timeout=self._sock.gettimeout())
+
+    def sendall(self, data, *a):
+        self._cross("send")
+        return self._sock.sendall(data, *a)
+
+    def send(self, data, *a):
+        self._cross("send")
+        return self._sock.send(data, *a)
+
+    def recv(self, bufsize, flags=0):
+        if not flags:
+            self._cross("recv")
+        return self._sock.recv(bufsize, flags)
+
+    def makefile(self, *a, **kw):
+        return _FaultyFile(self._sock.makefile(*a, **kw), self._plan,
+                           self._src, self._dst, self._sock.gettimeout())
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+
+class _FaultyHTTPConnection(http.client.HTTPConnection):
+    """HTTPConnection whose connect() dials through the factory (so
+    connect faults fire) and whose socket is plan-wrapped (so stream
+    faults fire)."""
+
+    def __init__(self, factory: "FaultySocketFactory", src: str,
+                 host: str, port: int, timeout: float, nodelay: bool):
+        super().__init__(host, port, timeout=timeout)
+        self._factory = factory
+        self._src = src
+        self._nodelay = nodelay
+
+    def connect(self):
+        self.sock = self._factory.create_connection(
+            self._src, (self.host, self.port), timeout=self.timeout)
+        if self._nodelay:
+            raw = getattr(self.sock, "_sock", self.sock)
+            raw.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
+class _FaultyResponse:
+    """urllib response proxy: reads and line iteration (the kubeclient
+    watch pump) cross the plan, so a partition can starve or RST a live
+    watch stream mid-replay."""
+
+    def __init__(self, resp, plan: NetFaultPlan, src: str, dst: str):
+        self._resp = resp
+        self._plan = plan
+        self._src = src
+        self._dst = dst
+
+    def _cross(self):
+        self._plan.check(self._src, self._dst, "recv")
+
+    def read(self, *a):
+        self._cross()
+        return self._resp.read(*a)
+
+    def readline(self, *a):
+        self._cross()
+        return self._resp.readline(*a)
+
+    def __iter__(self):
+        while True:
+            line = self.readline()
+            if not line:
+                return
+            yield line
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._resp.close()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._resp, name)
+
+
+class FaultySocketFactory(NetClient):
+    """The seam implementation: ``Gateway(net=FaultySocketFactory(plan))``
+    and every connect/send/recv that component performs crosses the
+    plan.  No monkeypatching — same contract as ``FaultyIO`` over
+    ``persistence.FileIO``."""
+
+    def __init__(self, plan: NetFaultPlan):
+        self.plan = plan
+
+    def create_connection(self, src: str, address: tuple, *,
+                          timeout: float):
+        dst = f"{address[0]}:{address[1]}"
+        self.plan.check(src, dst, "connect", timeout=timeout)
+        sock = socket.create_connection(address, timeout=timeout)
+        if self.plan.watches(src, dst):
+            return _FaultySocket(sock, self.plan, src, dst)
+        return sock
+
+    def http_connection(self, src: str, host: str, port: int, *,
+                        timeout: float, nodelay: bool = False):
+        return _FaultyHTTPConnection(self, src, host, port,
+                                     timeout=timeout, nodelay=nodelay)
+
+    def urlopen(self, src: str, request, *, timeout=None, context=None):
+        url = request.full_url if hasattr(request, "full_url") \
+            else str(request)
+        import urllib.parse
+
+        parts = urllib.parse.urlsplit(url)
+        dst = f"{parts.hostname}:{parts.port or (443 if parts.scheme == 'https' else 80)}"
+        self.plan.check(src, dst, "connect", timeout=timeout)
+        resp = urllib.request.urlopen(request, timeout=timeout,
+                                      context=context)
+        if self.plan.watches(src, dst):
+            return _FaultyResponse(resp, self.plan, src, dst)
+        return resp

@@ -271,6 +271,30 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         # state digest is seed-deterministic.  KF_SKIP_QOS=1 opts out.
         "qos_cmd": [sys.executable, "loadtest/load_tenancy.py", "--smoke"],
     },
+    "resilience": {
+        "include_dirs": ["kubeflow_tpu/gateway.py",
+                         "kubeflow_tpu/resilience.py",
+                         "kubeflow_tpu/core/net.py",
+                         "kubeflow_tpu/chaos/netfault.py",
+                         "kubeflow_tpu/core/kubeclient.py",
+                         "loadtest/load_partition.py"],
+        "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+                     "tests/test_netfault.py"],
+        # partition storm: 3 predictor backends + a replicated control
+        # plane while the seeded plan blackholes one backend, flaps
+        # another, and partitions a follower — asserts every submitted
+        # request ends in exactly one typed outcome (zero silent
+        # losses), well-behaved p99 during the single-backend blackhole
+        # stays under KF_PARTITION_CEIL (3x) of the healthy baseline,
+        # total backend attempts <= 2x submits (the retry budget held),
+        # the blackholed backend's breaker opens and re-closes within
+        # one half-open probe of the heal, the follower's cache digest
+        # matches the leader after the partition heals, zero orphan
+        # pages/pins after the drain, and the same seed reproduces the
+        # identical outcome + fault digest.  KF_SKIP_NETFAULT=1 opts out.
+        "netfault_cmd": [sys.executable, "loadtest/load_partition.py",
+                         "--smoke"],
+    },
     "analysis": {
         # the analyzer's own component: its unit tests plus the
         # full-tree sweep (which every other component also runs as
@@ -353,6 +377,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "fleet_cmd" in spec:
         steps.append({"name": "fleet", "run": spec["fleet_cmd"],
                       "depends": ["test"]})
+    if "netfault_cmd" in spec:
+        steps.append({"name": "partition", "run": spec["netfault_cmd"],
+                      "depends": ["test"]})
     if spec.get("image"):
         # kaniko executor (the reference's builder): --no-push is the
         # presubmit mode (ci/notebook_servers pattern)
@@ -426,6 +453,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "fleet_cmd" in spec
                 and os.environ.get("KF_SKIP_FLEET") != "1"):
             ok = subprocess.run(spec["fleet_cmd"]).returncode == 0
+        if (ok and "netfault_cmd" in spec
+                and os.environ.get("KF_SKIP_NETFAULT") != "1"):
+            ok = subprocess.run(spec["netfault_cmd"]).returncode == 0
         results[name] = ok
     return results
 

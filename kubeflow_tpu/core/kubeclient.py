@@ -82,11 +82,18 @@ _NO_NS = "_"
 class KubeStore:
     def __init__(self, base_url: str, *, user: str | None = None,
                  timeout: float = 10.0, token: str | None = None,
-                 cafile: str | None = None, insecure_tls: bool = False):
+                 cafile: str | None = None, insecure_tls: bool = False,
+                 net=None):
+        from kubeflow_tpu.core.net import DIRECT
+
         self.base_url = base_url.rstrip("/")
         self.user = user
         self.timeout = timeout
         self.token = token
+        # the outbound-connection seam (core.net): REST requests and the
+        # watch stream both dial through it, so chaos.netfault can RST a
+        # watch mid-replay or partition this client from the apiserver
+        self._net = net if net is not None else DIRECT
         self._watches: list[_HttpWatch] = []
         if base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=cafile)
@@ -106,8 +113,10 @@ class KubeStore:
             request.add_header("Authorization", f"Bearer {self.token}")
 
     def _open(self, request: urllib.request.Request, timeout=None):
-        return urllib.request.urlopen(request, timeout=timeout,
-                                      context=self._ssl_ctx)
+        # timeout=None is the watch stream's deliberate choice (a
+        # long-lived response); every plain request passes self.timeout
+        return self._net.urlopen("kubeclient", request, timeout=timeout,
+                                 context=self._ssl_ctx)
 
     def _req(self, method: str, path: str, body: dict | None = None):
         data = json.dumps(body).encode() if body is not None else None

@@ -1,0 +1,64 @@
+"""The outbound-connection seam: every socket the platform dials out.
+
+``persistence.FileIO`` gave the storage layer one injectable surface so
+``chaos/fsfault.py`` could injure disks without monkeypatching; this
+module is the same seam for the network.  Every component that dials a
+peer — the gateway's backend pool and websocket tunnel, the kubeclient's
+REST/watch requests, the predictor's decode-handoff and ``:pages``
+prefix fetches — takes a ``NetClient`` as a constructor argument and
+routes its connects through it.  Production passes :data:`DIRECT` (or
+nothing); ``chaos.netfault.FaultySocketFactory`` substitutes a seeded
+fault plan that can refuse, blackhole, reset, or delay any
+``(src_component, dst_host:port, op)`` crossing deterministically.
+
+Each call names its ``src`` component ("gateway", "kubeclient",
+"predictor", ...) so a fault plan can express asymmetric partitions:
+gateway→backend dead while backend→control-plane traffic flows.
+"""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import urllib.request
+
+
+class _NodelayConnection(http.client.HTTPConnection):
+    """Nagle off: on a keep-alive upstream connection, Nagle holding the
+    request's second write for the peer's delayed ACK costs ~40ms per
+    request."""
+
+    def connect(self):
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
+class NetClient:
+    """Direct (fault-free) implementation of the connection seam.
+
+    Subclasses override to interpose on connects and wrap sockets;
+    callers hold exactly one reference and never construct sockets
+    themselves, so there is nothing to monkeypatch."""
+
+    def http_connection(self, src: str, host: str, port: int, *,
+                        timeout: float, nodelay: bool = False):
+        """A fresh ``http.client.HTTPConnection`` toward ``host:port``
+        (not yet connected — the first request dials)."""
+        if nodelay:
+            return _NodelayConnection(host, port, timeout=timeout)
+        return http.client.HTTPConnection(host, port, timeout=timeout)
+
+    def create_connection(self, src: str, address: tuple, *,
+                          timeout: float):
+        """A connected raw socket (the gateway's websocket tunnel)."""
+        return socket.create_connection(address, timeout=timeout)
+
+    def urlopen(self, src: str, request, *, timeout=None, context=None):
+        """urllib-style open (the kubeclient's REST and watch paths).
+        ``timeout=None`` is a deliberate choice for long-lived watch
+        streams; plain requests pass a finite value."""
+        return urllib.request.urlopen(request, timeout=timeout,
+                                      context=context)
+
+
+DIRECT = NetClient()

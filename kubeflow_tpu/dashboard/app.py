@@ -82,6 +82,7 @@ class DashboardApp(CrudApp):
         self.add_route("GET", "/api/alerts", self.alerts_route)
         self.add_route("GET", "/api/qos", self.qos_route)
         self.add_route("GET", "/api/fleet", self.fleet_route)
+        self.add_route("GET", "/api/resilience", self.resilience_route)
         self.add_route("GET", "/api/dashboard-links", self.links,
                        no_auth=True)
         self.add_route("GET", "/api/dashboard-settings", self.settings,
@@ -244,6 +245,13 @@ class DashboardApp(CrudApp):
         coalescing counts, per-model residency rows, and each backend's
         advertised resident set."""
         return "200 OK", self.metrics.get_fleet_state()
+
+    def resilience_route(self, req: Request):
+        """Partition-tolerance standing (the resilience card):
+        per-backend circuit-breaker states and transitions, retry-budget
+        level and exhaustions, hedge outcome breakdown with win rate,
+        stale pooled connections retired, and injected net faults."""
+        return "200 OK", self.metrics.get_resilience_state()
 
     def metrics_route(self, req: Request):
         mtype = req.params["mtype"]

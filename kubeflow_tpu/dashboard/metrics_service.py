@@ -410,6 +410,60 @@ def fleet_state(server=None) -> dict:
     return state
 
 
+def resilience_state(server=None) -> dict:
+    """Partition-tolerance standing (the resilience card +
+    ``/dashboard/api/resilience``): per-backend circuit-breaker states
+    off the ``gateway_breaker_state`` gauge with the transition
+    breakdown, the retry budget's current token level and exhaustion
+    count, the hedged-request outcome breakdown with the hedge win rate,
+    stale pooled connections retired, and the chaos net-fault injection
+    breakdown (nonzero only under fault injection).  Entirely
+    process-local counters — ``server`` is accepted for service-surface
+    symmetry only."""
+    from kubeflow_tpu.utils.metrics import REGISTRY
+
+    def val(name: str) -> float:
+        m = REGISTRY.get_metric(name)
+        return m.get() if m is not None else 0.0
+
+    def breakdown(name: str) -> dict:
+        m = REGISTRY.get_metric(name)
+        if m is None:
+            return {}
+        return {",".join(k): v for k, v in sorted(m.series().items())}
+
+    code_names = {0: "closed", 1: "open", 2: "half_open"}
+    state = REGISTRY.get_metric("gateway_breaker_state")
+    breakers = {}
+    if state is not None:
+        breakers = {addr: code_names.get(int(code), str(code))
+                    for (addr,), code in sorted(state.series().items())}
+    hedges = REGISTRY.get_metric("gateway_hedged_requests_total")
+    won = hedges.get("hedge_won") if hedges else 0.0
+    lost = hedges.get("primary_won") if hedges else 0.0
+    launched = won + lost
+    return {
+        "breakers": breakers,
+        "open_backends": sum(1 for s in breakers.values() if s != "closed"),
+        "transitions": breakdown("gateway_breaker_transitions_total"),
+        "retry_budget": {
+            "level": val("gateway_retry_budget_level"),
+            "exhausted": val("gateway_retry_budget_exhausted_total"),
+        },
+        "hedges": {
+            "launched": launched,
+            "hedge_won": won,
+            "primary_won": lost,
+            "no_sibling": hedges.get("no_sibling") if hedges else 0.0,
+            "budget_exhausted": (hedges.get("budget_exhausted")
+                                 if hedges else 0.0),
+            "win_rate": (won / launched) if launched else 0.0,
+        },
+        "pool_stale_retired": val("gateway_pool_stale_retired_total"),
+        "net_faults": breakdown("chaos_net_faults_injected_total"),
+    }
+
+
 def cluster_health(server) -> dict:
     """Node heartbeat standing + failure-recovery counters (the
     robustness card): per-node heartbeat age/readiness straight from the
@@ -507,6 +561,8 @@ class MetricsService(Protocol):
 
     def get_fleet_state(self) -> dict: ...
 
+    def get_resilience_state(self) -> dict: ...
+
 
 class LocalMetricsService:
     """Derives series from the in-memory API server (pod counts as a proxy
@@ -579,6 +635,9 @@ class LocalMetricsService:
 
     def get_fleet_state(self) -> dict:
         return fleet_state(self.server)
+
+    def get_resilience_state(self) -> dict:
+        return resilience_state(self.server)
 
 
 class CloudMonitoringMetricsService:
@@ -676,6 +735,10 @@ class CloudMonitoringMetricsService:
         # the model pool and residency counters are process-local; the
         # per-backend residency map is collector-local
         return fleet_state(self.server)
+
+    def get_resilience_state(self):
+        # breaker/budget/hedge counters live in this process's gateway
+        return resilience_state(self.server)
 
 
 def make_metrics_service(server, project: str | None = None) -> MetricsService:

@@ -60,9 +60,11 @@ import time
 from dataclasses import dataclass, field
 
 from kubeflow_tpu import trace
+from kubeflow_tpu.core.net import DIRECT
 from kubeflow_tpu.core.store import APIServer, NotFound
 from kubeflow_tpu.qos import TenantLimiter, resolve_tenant, tenant_rate
 from kubeflow_tpu.qos.accounting import get_accountant
+from kubeflow_tpu.resilience import HEDGES, CircuitBreaker, RetryBudget
 # the fleet cold-start coalescing counter lives with the residency pool
 # (one registration; model_pool keeps jax imports lazy so this is cheap)
 from kubeflow_tpu.serving.model_pool import COLDSTART_COALESCED
@@ -90,6 +92,10 @@ PICKS = REGISTRY.counter(
     "gateway_backend_pick_total",
     "backend pick decisions by requested serving role and reason",
     labels=("role", "reason"))
+POOL_STALE = REGISTRY.counter(
+    "gateway_pool_stale_retired_total",
+    "pooled keep-alive connections retired at checkout (peer closed "
+    "or left unread bytes — a restarted backend's dead sockets)")
 REQUEST_SECONDS = REGISTRY.histogram(
     "gateway_request_duration_seconds",
     "time-to-last-byte of proxied requests; tail buckets carry trace-id "
@@ -173,42 +179,22 @@ def mark_draining(server: APIServer, name: str, namespace: str | None,
     return False
 
 
-class EjectionList:
-    """Outlier detection (Envoy's outlier ejection, minimal form): a
-    backend whose connect failed is taken out of rotation for ``ttl``
-    seconds so traffic shifts to healthy pods immediately, instead of
-    every request re-paying the full connect-retry budget against a dead
-    pod while the controller replaces it.  Entries expire (the address
-    may be reused) and a successful response clears the entry early."""
+def _note_open(host: str, port: int) -> None:
+    """Breaker open hook: keeps the PR-4 ejection counter and log line
+    continuous across the EjectionList→CircuitBreaker upgrade."""
+    EJECTIONS.inc()
+    log.warning("backend circuit opened (out of rotation)",
+                backend=f"{host}:{port}")
 
-    def __init__(self, ttl: float = 10.0):
-        import threading
 
-        self.ttl = ttl
-        self._until: dict[tuple, float] = {}
-        self._lock = threading.Lock()
-
-    def eject(self, host: str, port: int) -> None:
-        with self._lock:
-            self._until[(host, port)] = time.monotonic() + self.ttl
-        EJECTIONS.inc()
-        log.warning("backend ejected from rotation", backend=f"{host}:{port}",
-                    ttl=self.ttl)
-
-    def clear(self, host: str, port: int) -> None:
-        with self._lock:
-            self._until.pop((host, port), None)
-
-    def contains(self, host: str, port: int) -> bool:
-        now = time.monotonic()
-        with self._lock:
-            until = self._until.get((host, port))
-            if until is None:
-                return False
-            if until <= now:
-                del self._until[(host, port)]
-                return False
-            return True
+# Outlier detection, upgraded: PR 4's EjectionList was a TTL set — a
+# still-dead backend walked back into rotation every 10s and each
+# re-admission re-paid the connect-retry budget against it.  The
+# resilience.CircuitBreaker keeps the eject/clear/contains surface but
+# re-admits only through a half-open probe (backend_for_route routes
+# exactly one live request as the probe once backoff elapses).  The
+# alias keeps existing constructors/tests working.
+EjectionList = CircuitBreaker
 
 
 @dataclass
@@ -546,6 +532,18 @@ def backend_for_route(server: APIServer, route: Route, path: str,
 
     candidates = role_filter(candidates)
     role_label = role or "any"
+    if ejected is not None and ejected_pool:
+        # half-open probing: an open circuit whose backoff elapsed gets
+        # exactly ONE live request as its probe — try_probe is an atomic
+        # claim, so concurrent candidates lose the race and fall through
+        # to the healthy pick (fail over, never pile onto the suspect).
+        # This is the only way back into rotation: contains() never
+        # self-expires, so without a probe a healed backend would stay
+        # ejected forever.
+        for b in role_filter(ejected_pool):
+            if ejected.try_probe(b.host, b.port):
+                PICKS.labels(role_label, "probe").inc()
+                return b
     if not candidates:
         ejected_pool = role_filter(ejected_pool)
         if ejected_pool:
@@ -650,33 +648,24 @@ def _body_chunks(stream, length: int, chunk: int = 65536):
         yield data
 
 
-class _NodelayConnection(http.client.HTTPConnection):
-    """Nagle off: on a keep-alive upstream connection, Nagle holding the
-    request's second write for the backend's delayed ACK costs ~40ms per
-    proxied request."""
-
-    def connect(self):
-        import socket as socketlib
-
-        super().connect()
-        self.sock.setsockopt(socketlib.IPPROTO_TCP,
-                             socketlib.TCP_NODELAY, 1)
-
-
 class _BackendPool:
     """Keep-alive connections to backing pods (Envoy's upstream pool):
     with the front door itself serving HTTP/1.1 keepalive, a fresh TCP
     connect per proxied request became the dominant per-request cost.
     Idle entries expire after ``idle_ttl`` and expired/extinct backends
     are swept periodically — pods churn, and sockets to deleted pods
-    must not accumulate for the gateway's lifetime."""
+    must not accumulate for the gateway's lifetime.  Fresh connections
+    dial through the injected ``core.net`` seam (Nagle off — on a
+    keep-alive upstream, Nagle holding the request's second write for
+    the backend's delayed ACK costs ~40ms per proxied request)."""
 
     def __init__(self, max_idle_per_backend: int = 8,
-                 idle_ttl: float = 60.0):
+                 idle_ttl: float = 60.0, net=None):
         import threading
 
         self._idle: dict[tuple, list] = {}  # key -> [(conn, stored_at)]
         self._lock = threading.Lock()
+        self._net = net or DIRECT
         self.max_idle = max_idle_per_backend
         self.idle_ttl = idle_ttl
         self._last_sweep = time.monotonic()
@@ -699,9 +688,37 @@ class _BackendPool:
         for conn in dead:
             conn.close()
 
+    @staticmethod
+    def _stale(conn) -> bool:
+        """Peek-for-EOF on checkout: a backend that restarted while this
+        connection idled closed its end, and the first request on the
+        dead socket would surface a raw reset attributed to the NEW
+        healthy process.  A non-blocking 1-byte MSG_PEEK distinguishes
+        the cases: nothing to read (alive), EOF or leftover unread bytes
+        (unusable either way)."""
+        import socket as socketlib
+
+        sock = conn.sock
+        if sock is None:
+            return True
+        try:
+            sock.setblocking(False)
+            try:
+                data = sock.recv(1, socketlib.MSG_PEEK)
+            finally:
+                sock.setblocking(True)
+        except (BlockingIOError, InterruptedError):
+            return False          # nothing buffered: the healthy case
+        except OSError:
+            return True           # reset while idle
+        # EOF (b"") or stray response bytes: protocol state is gone
+        return True
+
     def get(self, host: str, port: int, timeout: float):
-        """-> (conn, reused): a pooled connection may be stale (pod
-        closed it); callers retry a failed REUSED conn on a fresh one."""
+        """-> (conn, reused): idle-aged and peeked-for-EOF on checkout
+        (stale entries are retired and counted, never handed out); a
+        reused conn can still go stale in flight — callers retry a
+        failed REUSED conn on a fresh one."""
         now = time.monotonic()
         with self._lock:
             self._sweep_locked(now)
@@ -711,10 +728,16 @@ class _BackendPool:
                 if now - stored >= self.idle_ttl:
                     conn.close()
                     continue
+                if self._stale(conn):
+                    POOL_STALE.inc()
+                    conn.close()
+                    continue
                 if conn.sock is not None:
                     conn.sock.settimeout(timeout)
                 return conn, True
-        return (_NodelayConnection(host, port, timeout=timeout), False)
+        return (self._net.http_connection("gateway", host, port,
+                                          timeout=timeout, nodelay=True),
+                False)
 
     def put(self, host: str, port: int, conn) -> None:
         now = time.monotonic()
@@ -738,7 +761,8 @@ class Gateway:
 
     def __init__(self, server: APIServer, *, connect_retries: int = 40,
                  retry_delay: float = 0.25, collector=None, activator=None,
-                 directory=None):
+                 directory=None, net=None, breaker=None,
+                 retry_budget=None, hedge_delay=None):
         self.server = server
         # cluster KV prefix directory (serving/kv_directory.py): when
         # set, :generate POSTs route by longest-prefix affinity — the
@@ -748,12 +772,27 @@ class Gateway:
         # port; a short connect-retry absorbs that startup race
         self.connect_retries = connect_retries
         self.retry_delay = retry_delay
-        self.pool = _BackendPool()
-        # outlier ejection: connect-failed backends leave rotation so
-        # traffic shifts to healthy pods while the controller replaces
-        # the dead one (instead of re-paying the connect-retry budget on
-        # every request)
-        self.ejections = EjectionList()
+        # the outbound-connection seam (core.net, injectable like
+        # persistence.FileIO): every socket this gateway dials — pooled
+        # fetches, fresh fetches, websocket tunnels — goes through it,
+        # so chaos.netfault can partition the gateway deterministically
+        self.net = net or DIRECT
+        self.pool = _BackendPool(net=self.net)
+        # circuit breaker (resilience.py): connect-failed backends leave
+        # rotation so traffic shifts to healthy pods while the
+        # controller replaces the dead one; re-admission is by
+        # half-open probe, never blind TTL expiry
+        self.ejections = breaker if breaker is not None \
+            else CircuitBreaker(on_open=_note_open)
+        # SRE retry budget: EVERY retry and hedge this gateway issues —
+        # connect-retry loop, shed sibling re-dispatch, hedged requests
+        # — draws from one bucket funded by primary traffic, so a
+        # partition cannot amplify into a retry storm
+        self.budget = retry_budget if retry_budget is not None \
+            else RetryBudget()
+        # hedge delay override in seconds (None = derived per request
+        # from the live p95 of gateway_request_duration_seconds)
+        self.hedge_delay = hedge_delay
         # autoscale integration: per-destination in-flight counts feed the
         # concurrency autoscaler, and the activator holds requests hitting
         # an autoscaled InferenceService at zero replicas (scale-from-zero)
@@ -821,13 +860,20 @@ class Gateway:
 
         target = backend.path + ("?" + query if query else "")
         sock = None
+        # every bounded phase of the upgrade — connect, handshake peek,
+        # pump-thread reclaim — runs under the ROUTE's timeout
+        # (Route.timeout_s via Backend), not an unrelated constant: a
+        # notebook route declaring a long timeout gets it end to end.
+        # The relay pumps themselves stay deadline-free (kernel channels
+        # idle for long stretches).
         # same bind-race absorption as the HTTP path: a pod reports
         # Running slightly before its process binds the port, and nothing
         # has been consumed from the client yet, so retries are safe
         for attempt in range(self.connect_retries):
             try:
-                sock = socketlib.create_connection(
-                    (backend.host, backend.port), timeout=10)
+                sock = self.net.create_connection(
+                    "gateway", (backend.host, backend.port),
+                    timeout=backend.timeout_s)
                 break
             except OSError:
                 if attempt + 1 == self.connect_retries:
@@ -868,11 +914,11 @@ class Gateway:
             return
         # peek the backend's status line before relaying so the metric
         # records the REAL upgrade outcome — a backend that refuses the
-        # upgrade (403/404) must not count as 101.  The handshake response
-        # is immediate, so a short deadline applies only here; the pump
-        # below runs deadline-free (kernel channels idle for long
-        # stretches).  Buffered bytes are relayed verbatim before pumping.
-        sock.settimeout(10)
+        # upgrade (403/404) must not count as 101.  The route timeout
+        # bounds only this handshake peek; the pump below runs
+        # deadline-free.  Buffered bytes are relayed verbatim before
+        # pumping.
+        sock.settimeout(backend.timeout_s)
         buf = b""
         try:
             while b"\r\n" not in buf and len(buf) < 4096:
@@ -932,7 +978,7 @@ class Gateway:
                                 daemon=True)
         t_up.start()
         pump(sock.recv, client)
-        t_up.join(timeout=5.0)
+        t_up.join(timeout=backend.timeout_s)
         sock.close()
 
     def __call__(self, environ, start_response):
@@ -1186,50 +1232,79 @@ class Gateway:
                 event.set()
 
     def _fetch(self, backend: Backend, method, url, headers, body,
-               retriable, idempotent):
+               retriable, idempotent, cancel_box=None):
         """The connect/retry loop against ONE backend.  Returns
         ``(conn, resp, None)`` on an answered request or
-        ``(None, None, error_bytes)`` after spending the retry budget
-        (the backend is ejected on the way out)."""
+        ``(None, None, error_bytes)`` after spending its attempts (a
+        request-level failure is recorded with the breaker on the way
+        out).  Every connect retry beyond the first attempt withdraws
+        from the gateway's retry budget — a mass outage drains the
+        bucket and later requests fail fast instead of stacking retry
+        storms.  ``cancel_box`` (the hedging path) carries the live
+        connection out so a losing attempt can be cancelled, and a
+        cancelled attempt records nothing: a hedge winner says nothing
+        about the loser's health."""
         force_fresh = False
+        # a non-closed circuit (half-open probe or panic fallback) fails
+        # fast: the connect-retry loop exists to absorb a HEALTHY pod's
+        # bind race, and burning it against a known-suspect backend only
+        # delays the failover by the whole retry budget
+        attempts = self.connect_retries
+        if self.ejections.state(backend.host, backend.port) != "closed":
+            attempts = 1
+
+        def cancelled() -> bool:
+            return cancel_box is not None and cancel_box.get("cancelled")
+
         # pooled keep-alive connections carry a replay hazard: a pod that
         # dies after committing but before responding makes the send look
         # stale-connection-shaped, and re-sending would execute the
         # operation twice.  Envoy/urllib3 draw the same line: only
         # idempotent methods ride (and retry on) reused connections.
-        for attempt in range(self.connect_retries):
+        for attempt in range(attempts):
+            if cancelled():
+                return None, None, b"hedge cancelled\n"
             # fresh connection when: a pooled one just went stale
             # (force_fresh), the method could replay a side effect
             # (not idempotent), or the body is an unreplayable stream
             # that must never gamble on a half-dead keep-alive socket
             # (not retriable)
             if force_fresh or not idempotent or not retriable:
-                conn, reused = (_NodelayConnection(
-                    backend.host, backend.port,
-                    timeout=backend.timeout_s), False)
+                conn, reused = (self.net.http_connection(
+                    "gateway", backend.host, backend.port,
+                    timeout=backend.timeout_s, nodelay=True), False)
             else:
                 conn, reused = self.pool.get(backend.host, backend.port,
                                              backend.timeout_s)
+            if cancel_box is not None:
+                cancel_box["conn"] = conn
             try:
                 conn.request(method, url, body=body, headers=headers)
                 return conn, conn.getresponse(), None
             except ConnectionRefusedError:
                 conn.close()
+                if cancelled():
+                    return None, None, b"hedge cancelled\n"
                 # a streamed (unbuffered) body may be partially consumed
                 # and cannot be replayed
-                if attempt + 1 == self.connect_retries or not retriable:
-                    self.ejections.eject(backend.host, backend.port)
+                if attempt + 1 == attempts or not retriable \
+                        or not self.budget.try_take():
+                    self.ejections.record_failure(backend.host,
+                                                  backend.port)
                     return None, None, b"backend connection refused\n"
                 time.sleep(self.retry_delay)
             except (OSError, http.client.HTTPException) as e:
                 conn.close()
-                if (reused and retriable
-                        and attempt + 1 < self.connect_retries):
+                if cancelled():
+                    return None, None, b"hedge cancelled\n"
+                if (reused and retriable and attempt + 1 < attempts):
                     # stale keep-alive connection (pod closed it while
-                    # idle): retry on a fresh connect, no backoff
+                    # idle): retry on a fresh connect, no backoff — local
+                    # socket hygiene, not a backend attempt, so it is
+                    # budget-free
                     force_fresh = True
                     continue
-                self.ejections.eject(backend.host, backend.port)
+                self.ejections.record_failure(backend.host, backend.port)
                 return None, None, f"backend error: {e}\n".encode()
         return None, None, b"backend unavailable\n"
 
@@ -1245,6 +1320,137 @@ class Gateway:
             self.pool.put(backend.host, backend.port, conn)
         else:
             conn.close()
+
+    def _hedge_delay_s(self) -> float | None:
+        """When to launch a hedge: the live p95 of gateway request
+        latency (Dean & Barroso's "tail at scale" — hedge only the
+        slowest ~5%, so hedge traffic is bounded at ~5% of load even
+        before the retry budget), clamped to [50ms, 5s].  None (no
+        hedging) until the histogram has enough samples for the p95 to
+        mean anything."""
+        if self.hedge_delay is not None:
+            return self.hedge_delay
+        if REQUEST_SECONDS.count() < 50:
+            return None
+        p95 = REQUEST_SECONDS.percentile(95)
+        if not p95 or p95 <= 0:
+            return None
+        return min(max(p95, 0.05), 5.0)
+
+    def _fetch_hedged(self, backend: Backend, method, qs, mk_headers,
+                      body, retriable, idempotent, can_hedge, route,
+                      environ, role, tried: set, span):
+        """One dispatch round: fetch from ``backend``, and if it has not
+        answered within the hedge delay, race ONE sibling against it —
+        first answer wins, the loser is cancelled (its connection
+        closed, its outcome discarded).  Returns
+        ``(winner_backend, conn, resp, err)``.
+
+        Hedges launch only pre-first-byte: both attempts here are whole
+        fetches whose responses have not streamed a byte to the client,
+        so abandoning the loser is always safe — once a response byte
+        streams, two interleaved bodies would corrupt the reply, which
+        is why mid-stream requests never hedge.  The hedge withdraws
+        from the same retry budget as every retry."""
+        def url_for(b: Backend) -> str:
+            return b.path + ("?" + qs if qs else "")
+
+        delay = self._hedge_delay_s() if can_hedge else None
+        if delay is None:
+            conn, resp, err = self._fetch(
+                backend, method, url_for(backend), mk_headers(backend),
+                body, retriable, idempotent)
+            return backend, conn, resp, err
+        import queue
+        import threading
+
+        results: queue.Queue = queue.Queue()
+        boxes = {"primary": {"cancelled": False, "conn": None},
+                 "hedge": {"cancelled": False, "conn": None}}
+
+        def attempt(tag: str, b: Backend) -> None:
+            try:
+                r = self._fetch(b, method, url_for(b), mk_headers(b),
+                                body, retriable, idempotent,
+                                cancel_box=boxes[tag])
+            except BaseException as e:  # never strand the waiter
+                r = (None, None, f"backend error: {e}\n".encode())
+            results.put((tag, b) + r)
+
+        threading.Thread(target=attempt, args=("primary", backend),
+                         daemon=True).start()
+        try:
+            first = results.get(timeout=delay)
+        except queue.Empty:
+            first = None
+        if first is not None:
+            # answered within the hedge delay — the common case pays one
+            # queue wait and no extra metric traffic
+            _, b, conn, resp, err = first
+            return b, conn, resp, err
+        # primary is past the p95: pick one sibling and race it
+        exclude = set(tried) | {(backend.host, backend.port)}
+        try:
+            sib = backend_for_route(self.server, route,
+                                    environ.get("PATH_INFO", "/"),
+                                    self.ejections, exclude=exclude,
+                                    role=role, collector=self.collector)
+        except NoBackend:
+            sib = None
+        if sib is None or not self.budget.try_take():
+            HEDGES.labels("no_sibling" if sib is None
+                          else "budget_exhausted").inc()
+            _, b, conn, resp, err = results.get()
+            return b, conn, resp, err
+        span.add_event("hedge_launched",
+                       primary=f"{backend.host}:{backend.port}",
+                       sibling=f"{sib.host}:{sib.port}")
+        threading.Thread(target=attempt, args=("hedge", sib),
+                         daemon=True).start()
+        done: list = []
+        winner = None
+        while len(done) < 2:
+            item = results.get()
+            done.append(item)
+            if item[4] is None:     # err is None: an answered response
+                winner = item
+                break
+        if winner is None:
+            winner = done[0]        # both failed: surface the first error
+        HEDGES.labels("hedge_won" if winner[0] == "hedge"
+                      else "primary_won").inc()
+        # cancel the loser: flag its box first (so its _fetch records no
+        # breaker failure — a cancelled attempt says nothing about
+        # health), then close its live connection to wake any blocked
+        # read; a still-running loser gets a reaper to close whatever it
+        # eventually returns
+        loser = "hedge" if winner[0] == "primary" else "primary"
+        boxes[loser]["cancelled"] = True
+        lconn = boxes[loser].get("conn")
+        if lconn is not None:
+            try:
+                lconn.close()
+            except OSError:
+                pass
+        finished = [i for i in done if i[0] == loser]
+        if finished:
+            for i in finished:
+                if i[2] is not None:
+                    try:
+                        i[2].close()
+                    except OSError:
+                        pass
+        else:
+            def reap():
+                item = results.get()
+                if item[2] is not None:
+                    try:
+                        item[2].close()
+                    except OSError:
+                        pass
+
+            threading.Thread(target=reap, daemon=True).start()
+        return winner[1], winner[2], winner[3], winner[4]
 
     def _proxy(self, backend: Backend, environ, start_response,
                route: Route | None = None, addr_ref: list | None = None,
@@ -1275,15 +1481,37 @@ class Gateway:
         # the cleared sampled flag so the backend doesn't re-roll and
         # record an orphan subtree (client ids preserved when parseable)
         fwd_ctx = trace.propagation_context(span, environ)
+        # this request funds the retry budget that every retry/hedge —
+        # here and everywhere else in the gateway — withdraws from
+        self.budget.note_request()
+        # hedge-eligible: replayable body AND a pick that is safe to
+        # duplicate — idempotent methods, or a :generate POST that has
+        # not produced a first byte (the engine's decode is wasted work
+        # when the loser finishes, never a double side effect)
+        can_hedge = (retriable and route is not None
+                     and (idempotent
+                          or (method == "POST" and ":generate"
+                              in environ.get("PATH_INFO", ""))))
+
+        def mk_headers(b: Backend) -> dict:
+            h = _request_headers(environ, b, trace_ctx=fwd_ctx,
+                                 request_id=request_id)
+            h["Content-Length"] = str(length)
+            return h
+
         tried: set[tuple] = set()
         while True:
-            url = backend.path + ("?" + qs if qs else "")
-            headers = _request_headers(environ, backend,
-                                       trace_ctx=fwd_ctx,
-                                       request_id=request_id)
-            headers["Content-Length"] = str(length)
-            conn, resp, err = self._fetch(backend, method, url, headers,
-                                          body, retriable, idempotent)
+            backend, conn, resp, err = self._fetch_hedged(
+                backend, method, qs, mk_headers, body, retriable,
+                idempotent, can_hedge, route, environ, role, tried, span)
+            if addr_ref is not None and self.collector is not None \
+                    and (backend.host, backend.port) != addr_ref[0]:
+                # a hedge (or shed re-dispatch) moved the response to a
+                # different pod: keep per-backend stream accounting on
+                # the pod that actually serves it
+                self.collector.dec_backend(addr_ref[0])
+                addr_ref[0] = (backend.host, backend.port)
+                self.collector.inc_backend(addr_ref[0])
             if err is not None:
                 PROXIED.labels("502").inc()
                 span.set_attribute("status", 502)
@@ -1298,20 +1526,23 @@ class Gateway:
                                           and retry_after is not None)
             if not shed:
                 break
-            # load shed is healthy-busy, NOT an outlier: no EjectionList
-            # entry (ejecting a busy pod under overload collapses the
-            # whole revision), counted separately from failures
+            # load shed is healthy-busy, NOT an outlier: never a breaker
+            # failure (tripping the circuit on a busy pod under overload
+            # collapses the whole revision), counted separately
             SHED.inc()
             span.add_event("shed_relayed", status=resp.status,
                            backend=f"{backend.host}:{backend.port}")
             alt = None
-            if retriable and route is not None and not tried:
+            if retriable and route is not None and not tried \
+                    and self.budget.try_take():
                 # a SIBLING pod may have queue room — re-dispatch is safe
                 # here and ONLY here: the shed response proves the backend
                 # executed nothing, the buffered body replays, and no
                 # response byte has been streamed to the client yet
                 # (start_response is still unfired); once a body streams,
-                # a re-dispatch would interleave two responses
+                # a re-dispatch would interleave two responses.  The
+                # re-dispatch is a retry: it draws from the budget, so a
+                # fleet-wide shed wave cannot double itself
                 tried.add((backend.host, backend.port))
                 with trace.get_tracer().start_span("gateway.sibling_retry",
                                                    span) as rsp:
@@ -1333,12 +1564,8 @@ class Gateway:
                 break  # relay the shed response, Retry-After intact
             self._finish_conn(backend, conn, resp)
             backend = alt
-            if addr_ref is not None and self.collector is not None:
-                # keep the per-backend stream accounting on the pod that
-                # actually serves the response
-                self.collector.dec_backend(addr_ref[0])
-                addr_ref[0] = (backend.host, backend.port)
-                self.collector.inc_backend(addr_ref[0])
+            # per-backend stream accounting moves at the loop top once
+            # the sibling actually answers
 
         out_headers = [(k, v) for k, v in resp.getheaders()
                        if k.lower() not in HOP_BY_HOP]

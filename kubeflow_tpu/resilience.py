@@ -1,0 +1,277 @@
+"""Resilience primitives for the front door: circuit breaker + retry
+budget.
+
+The gateway's PR-4 ``EjectionList`` was Envoy outlier ejection in
+minimal form — a TTL set of connect-failed backends.  Its fatal gap
+under a real partition: entries EXPIRE, so a still-dead backend walks
+back into rotation every ``ttl`` seconds and every re-admission pays
+the full connect-retry budget against it.  :class:`CircuitBreaker`
+replaces it with the real state machine:
+
+- **closed** — healthy; consecutive request-level failures (and
+  optionally a windowed error rate) are counted, and crossing the
+  threshold opens the circuit;
+- **open** — out of rotation; after ``backoff`` seconds the breaker
+  becomes probe-eligible but the backend stays OUT of normal rotation
+  (no blind re-admission);
+- **half-open** — exactly ONE live request is admitted as the probe
+  (:meth:`try_probe` is an atomic claim; concurrent candidates lose the
+  race and fail over to healthy siblings).  Probe success closes the
+  circuit; probe failure re-opens it with doubled backoff.
+
+:class:`RetryBudget` is the SRE-workbook rule that keeps retries and
+hedges from amplifying an outage into a retry storm: every primary
+request deposits ``ratio`` tokens, every retry/hedge withdraws one, so
+steady-state retry traffic is bounded at ``ratio`` × primary traffic
+no matter how many callers are failing at once.
+
+Both classes are clock-injected (kfvet clocks scope covers this module
+by decree): no method reads the wall clock, so every transition is
+property-testable on a fake clock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubeflow_tpu.utils.metrics import REGISTRY
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+BREAKER_STATE = REGISTRY.gauge(
+    "gateway_breaker_state",
+    "per-backend circuit state: 0 closed, 1 open, 2 half-open; the "
+    "label set is bounded by the pod count, not tenant data",
+    labels=("backend",))
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "gateway_breaker_transitions_total",
+    "circuit breaker state transitions",
+    labels=("from_state", "to_state"))
+RETRY_BUDGET_EXHAUSTED = REGISTRY.counter(
+    "gateway_retry_budget_exhausted_total",
+    "retries/hedges refused because the token-bucket retry budget was "
+    "empty (the anti-retry-storm valve closing)")
+RETRY_BUDGET_LEVEL = REGISTRY.gauge(
+    "gateway_retry_budget_level",
+    "current retry-budget token level")
+HEDGES = REGISTRY.counter(
+    "gateway_hedged_requests_total",
+    "hedged-request decisions: hedge_won/primary_won count launched "
+    "hedges by winner; no_sibling/budget_exhausted count hedge points "
+    "where none launched",
+    labels=("outcome",))
+
+
+class _Circuit:
+    __slots__ = ("state", "failures", "opened_at", "backoff", "probing",
+                 "probe_at", "outcomes")
+
+    def __init__(self, backoff: float):
+        self.state = CLOSED
+        self.failures = 0
+        self.opened_at = 0.0
+        self.backoff = backoff
+        self.probing = False
+        self.probe_at = 0.0
+        self.outcomes: list[bool] = []   # rolling request outcomes
+
+
+class CircuitBreaker:
+    """Per-backend circuit breaker keyed on ``(host, port)``.
+
+    Defaults mirror the EjectionList it replaces: one request-level
+    failure opens the circuit (``failure_threshold=1`` — a request that
+    exhausted its connect retries is already a high-confidence signal)
+    and the first probe is admitted after 10s.  ``eject``/``clear``/
+    ``contains`` keep the old call surface: eject records a failure,
+    clear records a success, contains means "out of normal rotation".
+
+    Load sheds (429 / busy-503) must NEVER reach ``record_failure`` —
+    shed-not-dead is the caller's classification line, and tripping the
+    breaker on a busy pod collapses the revision."""
+
+    def __init__(self, *, failure_threshold: int = 1,
+                 error_rate_threshold: float | None = None,
+                 window: int = 20, backoff: float = 10.0,
+                 max_backoff: float = 60.0, probe_ttl: float = 30.0,
+                 clock=time.monotonic, on_open=None):
+        self.failure_threshold = failure_threshold
+        self.error_rate_threshold = error_rate_threshold
+        self.window = window
+        self.base_backoff = backoff
+        self.max_backoff = max_backoff
+        self.probe_ttl = probe_ttl
+        self._clock = clock
+        self._on_open = on_open
+        self._lock = threading.Lock()
+        self._circuits: dict[tuple, _Circuit] = {}
+
+    # -- internals (lock held) ----------------------------------------------
+    def _to(self, key: tuple, c: _Circuit, new_state: str) -> None:
+        BREAKER_TRANSITIONS.labels(c.state, new_state).inc()
+        was = c.state
+        c.state = new_state
+        addr = f"{key[0]}:{key[1]}"
+        BREAKER_STATE.labels(addr).set(_STATE_CODE[new_state])
+        if new_state == OPEN and was != OPEN and self._on_open is not None:
+            self._on_open(key[0], key[1])
+
+    def _tripped(self, c: _Circuit) -> bool:
+        if c.failures >= self.failure_threshold:
+            return True
+        if self.error_rate_threshold is not None \
+                and len(c.outcomes) >= self.window:
+            rate = sum(1 for ok in c.outcomes if not ok) / len(c.outcomes)
+            return rate >= self.error_rate_threshold
+        return False
+
+    # -- recording -----------------------------------------------------------
+    def record_failure(self, host: str, port: int) -> None:
+        """One request-level failure (exhausted connect retries, reset
+        mid-request) against this backend."""
+        now = self._clock()
+        with self._lock:
+            key = (host, port)
+            c = self._circuits.setdefault(key,
+                                          _Circuit(self.base_backoff))
+            if c.state == HALF_OPEN:
+                # the probe failed: back to open, exponential backoff
+                c.backoff = min(c.backoff * 2, self.max_backoff)
+                c.probing = False
+                c.opened_at = now
+                self._to(key, c, OPEN)
+                return
+            if c.state == OPEN:
+                return  # a panic-fallback attempt failed; already open
+            c.failures += 1
+            c.outcomes.append(False)
+            del c.outcomes[:-self.window]
+            if self._tripped(c):
+                c.opened_at = now
+                c.backoff = self.base_backoff
+                self._to(key, c, OPEN)
+
+    def record_success(self, host: str, port: int) -> None:
+        """The backend answered (any HTTP response, sheds included —
+        shed means alive)."""
+        with self._lock:
+            key = (host, port)
+            c = self._circuits.get(key)
+            if c is None:
+                return
+            if c.state in (OPEN, HALF_OPEN):
+                # probe success (or a panic-fallback attempt landed):
+                # the backend is demonstrably alive — close
+                c.probing = False
+                self._to(key, c, CLOSED)
+            c.failures = 0
+            c.outcomes.append(True)
+            del c.outcomes[:-self.window]
+
+    # -- routing queries -----------------------------------------------------
+    def contains(self, host: str, port: int) -> bool:
+        """Out of normal rotation (open or half-open).  Unlike the
+        EjectionList this never self-expires: re-admission happens only
+        through a successful probe."""
+        with self._lock:
+            c = self._circuits.get((host, port))
+            return c is not None and c.state != CLOSED
+
+    def try_probe(self, host: str, port: int) -> bool:
+        """Atomically claim the half-open probe slot.  True means the
+        CALLER's request is the one probe this circuit admits; every
+        concurrent caller gets False and fails over.  A claimed probe
+        that never reports back is reclaimed after ``probe_ttl``."""
+        now = self._clock()
+        with self._lock:
+            c = self._circuits.get((host, port))
+            if c is None or c.state == CLOSED:
+                return False
+            key = (host, port)
+            if c.state == OPEN and now >= c.opened_at + c.backoff:
+                self._to(key, c, HALF_OPEN)
+                c.probing = True
+                c.probe_at = now
+                return True
+            if c.state == HALF_OPEN:
+                if not c.probing or now >= c.probe_at + self.probe_ttl:
+                    c.probing = True
+                    c.probe_at = now
+                    return True
+            return False
+
+    def state(self, host: str, port: int) -> str:
+        with self._lock:
+            c = self._circuits.get((host, port))
+            return CLOSED if c is None else c.state
+
+    def snapshot(self) -> dict[str, str]:
+        """``{"host:port": state}`` for every non-closed circuit plus
+        recently-closed ones still tracked (the dashboard card)."""
+        with self._lock:
+            return {f"{h}:{p}": c.state
+                    for (h, p), c in self._circuits.items()}
+
+    # -- compatibility surface (EjectionList call sites) ---------------------
+    def eject(self, host: str, port: int) -> None:
+        self.record_failure(host, port)
+
+    def clear(self, host: str, port: int) -> None:
+        self.record_success(host, port)
+
+    def reset(self) -> None:
+        """Forget every circuit (tests between phases)."""
+        with self._lock:
+            for (h, p) in self._circuits:
+                addr = f"{h}:{p}"  # bounded by the pod count
+                BREAKER_STATE.labels(addr).set(0)
+            self._circuits.clear()
+
+
+class RetryBudget:
+    """Token-bucket retry budget (SRE workbook "Addressing Cascading
+    Failures"): every primary request deposits ``ratio`` tokens, every
+    retry or hedge withdraws one.  When the bucket is dry, retries are
+    refused and the caller surfaces the primary failure — bounding
+    total backend attempts at ``(1 + ratio)`` × primary traffic in
+    steady state, which is what stops a partition from turning into a
+    self-sustaining retry storm.
+
+    ``initial`` pre-funds the bucket so cold-start bind-race retries
+    (the gateway's connect-retry loop) work before any traffic history
+    exists; ``cap`` bounds how much quiet-period credit can accumulate.
+    No clock: the budget is traffic-driven, so it is deterministic
+    under any request schedule."""
+
+    def __init__(self, *, ratio: float = 0.2, initial: float = 200.0,
+                 cap: float = 400.0):
+        self.ratio = ratio
+        self.cap = cap
+        self._tokens = min(initial, cap)
+        self._lock = threading.Lock()
+        RETRY_BUDGET_LEVEL.set(self._tokens)
+
+    def note_request(self) -> None:
+        """A primary request arrived: deposit."""
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+            RETRY_BUDGET_LEVEL.set(self._tokens)
+
+    def try_take(self) -> bool:
+        """Withdraw one token for a retry/hedge; False = refused."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                RETRY_BUDGET_LEVEL.set(self._tokens)
+                return True
+        RETRY_BUDGET_EXHAUSTED.inc()
+        return False
+
+    def level(self) -> float:
+        with self._lock:
+            return self._tokens

@@ -284,14 +284,20 @@ def resume_serialized(engine, body: dict, trace_ctx=None) -> list[int]:
 
 
 def http_post_json(addr: str, path: str, payload: dict,
-                   timeout: float = 300.0) -> dict:
+                   timeout: float = 300.0, *, net=None,
+                   src: str = "predictor") -> dict:
     """Default handoff transport: POST ``payload`` to ``addr`` and parse
-    the JSON response; non-2xx raises with the body as the message."""
-    import http.client
+    the JSON response; non-2xx raises with the body as the message.
+    ``net`` is the core.net connection seam (chaos.netfault injects
+    partitions between predictors through it); ``src`` names the calling
+    component for the fault plan's src matching."""
     import json
 
+    from kubeflow_tpu.core.net import DIRECT
+
     host, _, port = addr.partition(":")
-    conn = http.client.HTTPConnection(host, int(port or 80), timeout=timeout)
+    conn = (net or DIRECT).http_connection(src, host, int(port or 80),
+                                           timeout=timeout)
     try:
         conn.request("POST", path, body=json.dumps(payload).encode(),
                      headers={"Content-Type": "application/json"})

@@ -45,7 +45,8 @@ class GenerativePredictor:
                  kv_quant: bool = False, handoff_post=None,
                  tenant_shares: dict | None = None,
                  directory=None, engine_id: str | None = None,
-                 engine_addr: str = "", staging_mb: float = 64.0):
+                 engine_addr: str = "", staging_mb: float = 64.0,
+                 net=None):
         from kubeflow_tpu.models import registry
 
         self.name = model_name
@@ -56,6 +57,9 @@ class GenerativePredictor:
         # from :resume handoffs and owns the decode loop
         self.role = role
         self._handoff_post = handoff_post
+        # core.net seam for the peer-to-peer paths (:pages fetches and
+        # :resume handoffs) — chaos.netfault partitions predictors here
+        self._net = net
         self.log = get_logger("predictor", model=model_name, size=size)
         entry = registry.get(model_name)
         self.module = entry.make_model(size=size, **(model_config or {}))
@@ -319,6 +323,13 @@ class GenerativePredictor:
                 self._hand_cv.wait(min(remaining, 0.1))
             return self._handoffs.pop(id(req))
 
+    def _default_post(self, addr: str, path: str, payload: dict) -> dict:
+        """Handoff transport when no ``handoff_post`` override was given:
+        ``http_post_json`` dialed through this predictor's net seam."""
+        from kubeflow_tpu.serving.disagg import http_post_json
+
+        return http_post_json(addr, path, payload, net=self._net)
+
     def _fetch_pages(self, entry: dict, ids: list[int]) -> dict:
         """Engine fetch_fn: pull prefix pages peer-to-peer from the
         directory-advertised owner's ``:pages`` endpoint (handoff wire
@@ -327,7 +338,8 @@ class GenerativePredictor:
 
         return http_post_json(entry["addr"],
                               f"/v1/models/{self.name}:pages",
-                              {"ids": [int(t) for t in ids]}, timeout=30)
+                              {"ids": [int(t) for t in ids]}, timeout=30,
+                              net=self._net)
 
     def export_pages(self, ids: list[int]) -> dict:
         """``:pages`` verb: serialize the full prefix pages this engine's
@@ -357,7 +369,7 @@ class GenerativePredictor:
         try:
             full = disagg.forward_handoff(
                 state, self.engine.pool, decode_peer, self.name,
-                post_fn=self._handoff_post,
+                post_fn=self._handoff_post or self._default_post,
                 trace_ctx=r.span.context if r.span else None)
             disagg.complete_forwarded(r, full)
         except Exception as e:

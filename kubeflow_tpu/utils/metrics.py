@@ -52,6 +52,13 @@ class _Metric:
         with self._lock:
             return sum(self._values.values())
 
+    def series(self) -> dict[tuple, float]:
+        """Snapshot of every label set -> value (counters and gauges;
+        dashboard cards that render a breakdown rather than probing
+        known label values one by one)."""
+        with self._lock:
+            return dict(self._values)
+
     def expose(self, kind: str) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {kind}"]
         with self._lock:

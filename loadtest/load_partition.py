@@ -1,0 +1,573 @@
+"""Partition-tolerance loadtest (ISSUE 19: seeded netfault storm,
+circuit breakers with half-open probing, hedged requests under a retry
+budget).
+
+Three REAL tiny-llama predictor backends serve behind real HTTP servers
+through the real gateway, every outbound socket dialed through one
+seeded ``chaos.netfault.NetFaultPlan`` — plus a replicated control
+plane: the leader ``APIServer`` serves its REST API over HTTP and a
+follower mirrors it through the ``kubeclient`` watch pump, crossing the
+SAME fault plan.  Phases:
+
+- BASELINE: healthy traffic through the gateway establishes the p99 the
+  storm is judged against (and the latency history hedging derives its
+  delay from in production — here the delay is pinned for determinism).
+
+- STORM: one backend is blackholed (connect and recv — established
+  streams starve too), a second flaps (refuse+RST armed and disarmed on
+  a schedule), a gray-failure delay triggers a hedged request, and the
+  follower's control-plane link is partitioned the whole time while the
+  leader keeps churning ConfigMaps.
+
+- HEAL: every rule disarms.  The blackholed backend's circuit must
+  re-close on its FIRST half-open probe, and the follower's mirror must
+  converge to the leader's digest through watch resume/relist.
+
+- DIGEST: the same seeded sub-storm runs twice against fresh plans,
+  breakers, and gateways; the (outcomes, fault counts, fault trace,
+  breaker states) digest must be bit-identical — rule matching is call
+  order + budgets, never coin flips.
+
+Gates (hard asserts; ``--smoke`` is the CI entry, smaller counts):
+
+- every submitted request ends in exactly ONE typed outcome — zero
+  silent losses, zero unhandled exceptions;
+- well-behaved (200) p99 during the single-backend blackhole stays
+  under ``KF_PARTITION_CEIL`` (default 3x) of the healthy baseline;
+- total backend attempts (handler hits + connect-level faults) stay
+  under 2x submits — the retry budget's anti-storm bound;
+- the blackholed backend's breaker opens during the storm and re-closes
+  within ONE half-open probe of the heal (zero post-heal failures);
+- the follower's ConfigMap digest equals the leader's after the heal;
+- zero orphan KV pages and zero leaked prefix-cache pins after drain;
+- same seed => identical determinism digest across two runs.
+
+Usage: python loadtest/load_partition.py [--smoke] [--seed N]
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROMPT = [[5, 8, 13, 21]]
+MAX_NEW = 4
+
+
+def _pct(vals: list[float], p: float) -> float:
+    vals = sorted(vals)
+    return vals[min(int(len(vals) * p / 100), len(vals) - 1)]
+
+
+def _wait(pred, timeout: float, interval: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval)
+    return pred()
+
+
+class _FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class _NullCollector:
+    """Inert autoscale collector for the determinism runs: every
+    backend reads as zero in-flight, so the least-loaded pick always
+    resolves to the first candidate — stable across runs."""
+
+    def inc(self, key):
+        pass
+
+    def dec(self, key):
+        pass
+
+    def inc_backend(self, addr):
+        pass
+
+    def dec_backend(self, addr):
+        pass
+
+    def backend_inflight(self, addr) -> int:
+        return 0
+
+    def residency(self, addr):
+        return ()
+
+
+class _Counting:
+    """WSGI middleware counting requests that actually REACHED the
+    backend — the handler-side half of the attempts ledger (faults that
+    died at the seam are the other half, read from the plan's trace)."""
+
+    def __init__(self, app):
+        self.app = app
+        self.hits = 0
+        self._lock = threading.Lock()
+
+    def __call__(self, environ, start_response):
+        with self._lock:
+            self.hits += 1
+        return self.app(environ, start_response)
+
+
+class _Ledger:
+    """Exactly-one-typed-outcome accounting for every submit."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.outcomes: dict[str, int] = {}
+
+    def note(self, outcome: str) -> None:
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+
+    def total(self) -> int:
+        return sum(self.outcomes.values())
+
+
+def _post(gateway, path: str, payload: dict,
+          ledger: _Ledger | None = None) -> tuple[str, float]:
+    """One POST through the gateway's WSGI surface; fully consumes the
+    body (pool return + in-flight accounting both hinge on that) and
+    classifies the outcome into exactly one typed bucket."""
+    raw = json.dumps(payload).encode()
+    status: dict = {}
+
+    def start_response(s, headers):
+        status["code"] = s
+        status["headers"] = dict(headers)
+
+    environ = {"REQUEST_METHOD": "POST", "PATH_INFO": path,
+               "CONTENT_LENGTH": str(len(raw)),
+               "CONTENT_TYPE": "application/json",
+               "wsgi.input": io.BytesIO(raw)}
+    if ledger is not None:
+        ledger.submitted += 1
+    t0 = time.perf_counter()
+    try:
+        b"".join(gateway(environ, start_response))
+    except Exception:
+        if ledger is not None:
+            ledger.note("exception")
+        return "exception", time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    code = status.get("code", "???")
+    if code.startswith("2"):
+        outcome = "ok"
+    elif code.startswith("429") or (code.startswith("503")
+                                    and "Retry-After" in status["headers"]):
+        outcome = "shed"
+    else:
+        outcome = f"error_{code[:3]}"
+    if ledger is not None:
+        ledger.note(outcome)
+    return outcome, dt
+
+
+# -- stack ---------------------------------------------------------------------
+
+def _build_stack():
+    """Leader APIServer (watch-cached, REST-served) + three warmed
+    tiny-llama predictors behind real HTTP servers, routed by one
+    VirtualService."""
+    from kubeflow_tpu.core import APIServer, api_object, watchcache
+    from kubeflow_tpu.core.httpapi import RestAPI, serve
+    from kubeflow_tpu.serving.predictor import GenerativePredictor, \
+        PredictorApp
+
+    server = APIServer()
+    # wide event window: the follower's post-partition resume should
+    # replay the gap, not fall back to a relist (both converge; the
+    # resume path is the one a short partition takes in production)
+    watchcache.attach(server, window=1024)
+    api_httpd, _ = serve(RestAPI(server), 0)
+    leader_base = f"http://127.0.0.1:{api_httpd.server_address[1]}"
+
+    server.create(api_object("VirtualService", "llama", "default", spec={
+        "http": [{"match": [{"uri": {"prefix": "/serve/default/llama/"}}],
+                  "rewrite": {"uri": "/"},
+                  "timeout": "30s",
+                  "route": [{"destination": {"host": "llama.default.svc",
+                                             "port": {"number": 80}}}]}]}))
+    server.create(api_object("Service", "llama", "default", spec={
+        "selector": {"app": "llama"},
+        "ports": [{"port": 80, "targetPort": 8080}]}))
+
+    preds, counters, backends = [], [], []
+    for i in range(3):
+        p = GenerativePredictor("llama", size="tiny", max_batch=2,
+                                max_seq=64, seed=i)
+        p.generate(PROMPT, max_new_tokens=MAX_NEW)   # compile warm-up
+        counting = _Counting(PredictorApp({"llama": p}))
+        httpd, _ = serve(counting, 0)
+        port = httpd.server_address[1]
+        preds.append(p)
+        counters.append(counting)
+        backends.append((httpd, port))
+        name = f"pod-{i}"
+        server.create(api_object("Pod", name, "default",
+                                 labels={"app": "llama"},
+                                 spec={"containers": [{"name": "c"}]}))
+        server.patch_status("Pod", name, "default", {
+            "phase": "Running", "podIP": "127.0.0.1",
+            "portMap": {"8080": port}})
+    return server, api_httpd, leader_base, preds, counters, backends
+
+
+class _FollowerMirror:
+    """The replicated control plane's follower: a ConfigMap mirror fed
+    by the kubeclient watch pump, dialed through the fault plan."""
+
+    def __init__(self, leader_base: str, net):
+        from kubeflow_tpu.core.kubeclient import KubeStore
+
+        self._store = KubeStore(leader_base, net=net)
+        self._watch = self._store.watch(kinds=["ConfigMap"])
+        self._objects: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            ev = self._watch.next(timeout=0.5)
+            if ev is None:
+                continue
+            name = ev.object["metadata"]["name"]
+            with self._lock:
+                if ev.type == "DELETED":
+                    self._objects.pop(name, None)
+                else:
+                    self._objects[name] = ev.object
+
+    def digest(self) -> dict:
+        with self._lock:
+            return {n: (o.get("status") or {}).get("n")
+                    for n, o in self._objects.items()}
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._watch.stop()
+
+
+def _leader_digest(server) -> dict:
+    return {o["metadata"]["name"]: (o.get("status") or {}).get("n")
+            for o in server.list("ConfigMap")}
+
+
+# -- determinism digest --------------------------------------------------------
+
+def _digest_run(seed: int, server, ports: list[int], n: int) -> str:
+    """One seeded sub-storm against a FRESH plan/breaker/gateway with
+    every nondeterminism source pinned: sequential requests, a null
+    collector (stable first-candidate picks), a fake clock (no probe
+    timing), and a hedge delay no request lives long enough to reach.
+    Same seed + same traffic => identical digest."""
+    from kubeflow_tpu import gateway as gw
+    from kubeflow_tpu.chaos import FaultySocketFactory, NetFaultPlan
+    from kubeflow_tpu.resilience import CircuitBreaker, RetryBudget
+
+    plan = NetFaultPlan(seed=seed, record=True)
+    plan.BLACKHOLE_CAP_S = 0.2
+    p0, p1, p2 = ports
+    plan.refuse("gateway", f"127.0.0.1:{p0}", times=2)
+    plan.reset("gateway", f"127.0.0.1:{p1}", op="recv", times=1,
+               after_ops=2)
+    plan.delay("gateway", f"127.0.0.1:{p2}", 0.02, op="recv",
+               jitter=0.02, times=3)
+    breaker = CircuitBreaker(backoff=60.0, clock=_FakeClock(100.0))
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01,
+                         net=FaultySocketFactory(plan), breaker=breaker,
+                         retry_budget=RetryBudget(ratio=0.2, initial=5.0,
+                                                  cap=5.0),
+                         hedge_delay=30.0, collector=_NullCollector())
+    ledger = _Ledger()
+    for _ in range(n):
+        _post(gateway, "/serve/default/llama/v1/models/llama:generate",
+              {"ids": PROMPT, "max_new_tokens": MAX_NEW}, ledger)
+    payload = {"outcomes": ledger.outcomes,
+               "faults": plan.counts(),
+               "trace": plan.trace(),
+               "breaker": breaker.snapshot()}
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()).hexdigest()
+
+
+# -- main ----------------------------------------------------------------------
+
+def main() -> int:
+    smoke = "--smoke" in sys.argv
+    seed = 42
+    if "--seed" in sys.argv:
+        seed = int(sys.argv[sys.argv.index("--seed") + 1])
+    n_base = 60 if smoke else 200
+    n_blackhole = 24 if smoke else 80
+    flap_cycles = 2 if smoke else 4
+    n_cms = 8 if smoke else 20
+    n_digest = 10 if smoke else 16
+    ceil = float(os.environ.get("KF_PARTITION_CEIL", "3.0"))
+
+    from kubeflow_tpu import gateway as gw
+    from kubeflow_tpu.chaos import FaultySocketFactory, NetFaultPlan
+    from kubeflow_tpu.resilience import HEDGES, CircuitBreaker, RetryBudget
+
+    t_start = time.perf_counter()
+    failures: list[str] = []
+    server, api_httpd, leader_base, preds, counters, backends = \
+        _build_stack()
+    ports = [port for _, port in backends]
+    path = "/serve/default/llama/v1/models/llama:generate"
+    payload = {"ids": PROMPT, "max_new_tokens": MAX_NEW}
+
+    # one seeded plan runs the whole storm — data plane AND control
+    # plane.  Every rule exists (disarmed) before any component dials,
+    # so the factory wraps every stream it may later need to injure.
+    plan = NetFaultPlan(seed=seed, record=True)
+    plan.BLACKHOLE_CAP_S = 0.2
+    net = FaultySocketFactory(plan)
+    dead, flap, gray = (f"127.0.0.1:{p}" for p in ports)
+    hole_c = plan.blackhole("gateway", dead, "connect", armed=False)
+    hole_r = plan.blackhole("gateway", dead, "recv", armed=False)
+    flap_refuse = plan.refuse("gateway", flap, armed=False)
+    flap_rst = plan.reset("gateway", flap, op="recv", armed=False)
+    # gray failure on the FLAP backend's healthy stretches: slow, not
+    # dead — the case hedging exists for (armed only while flap is
+    # closed, so the slow primary has a healthy sibling to race)
+    gray_delay = plan.delay("gateway", flap, 0.5, op="recv", times=4,
+                            armed=False)
+    f_hole = plan.blackhole("kubeclient", "*", "connect", armed=False)
+    f_rst = plan.reset("kubeclient", "*", op="recv", times=1, armed=False)
+
+    breaker = CircuitBreaker(backoff=0.4, max_backoff=1.0, probe_ttl=5.0)
+    budget = RetryBudget(ratio=0.2, initial=20.0, cap=40.0)
+    # hedge delay pinned ABOVE the blackhole cap: a partitioned primary
+    # must surface its typed failure (and open its circuit) rather than
+    # be silently rescued every time; the gray-delay stretch still
+    # hedges because 0.5s of injected slowness crosses this line
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.05,
+                         net=net, breaker=breaker, retry_budget=budget,
+                         hedge_delay=0.35)
+    follower = _FollowerMirror(leader_base, net)
+    dead_addr = ("127.0.0.1", ports[0])
+    flap_addr = ("127.0.0.1", ports[1])
+
+    cm_names = [f"cm-{i}" for i in range(n_cms)]
+    cm_state = dict.fromkeys(cm_names, 0)
+    cm_cursor = [0]
+    from kubeflow_tpu.core import api_object
+
+    for name in cm_names:
+        server.create(api_object("ConfigMap", name, "default"))
+
+    def churn(k: int = 2) -> None:
+        # rotate through the set so every ConfigMap sees partition-era
+        # writes the follower must replay
+        for _ in range(k):
+            name = cm_names[cm_cursor[0] % len(cm_names)]
+            cm_cursor[0] += 1
+            cm_state[name] += 1
+            server.patch_status("ConfigMap", name, "default",
+                                {"n": cm_state[name]})
+
+    ledger = _Ledger()
+    hits0 = sum(c.hits for c in counters)
+
+    # -- BASELINE -------------------------------------------------------------
+    base_lat = []
+    for _ in range(n_base):
+        outcome, dt = _post(gateway, path, payload, ledger)
+        if outcome == "ok":
+            base_lat.append(dt)
+    if len(base_lat) < n_base:
+        failures.append(f"baseline not clean: {ledger.outcomes}")
+    # floor the reference: at sub-50ms baselines scheduler noise, not
+    # partition damage, would dominate a 3x multiplicative gate
+    p99_base = max(_pct(base_lat or [0.0], 99), 0.05)
+    storm_hits0 = sum(c.hits for c in counters)
+    storm_submit0 = ledger.submitted
+
+    # -- STORM: single-backend blackhole + follower partition -----------------
+    for r in (hole_c, hole_r, f_hole, f_rst):
+        r.arm()
+    blackhole_lat = []
+    for i in range(n_blackhole):
+        outcome, dt = _post(gateway, path, payload, ledger)
+        if outcome == "ok":
+            blackhole_lat.append(dt)
+        if i % 3 == 0:
+            churn()
+    if breaker.state(*dead_addr) == "closed":
+        failures.append("blackholed backend's circuit never opened")
+    p99_storm = _pct(blackhole_lat or [0.0], 99)
+    if not blackhole_lat:
+        failures.append("no well-behaved requests during the blackhole")
+    elif p99_storm > ceil * p99_base:
+        failures.append(
+            f"well-behaved p99 {p99_storm * 1e3:.1f}ms during the "
+            f"blackhole is over {ceil:.1f}x the healthy baseline "
+            f"{p99_base * 1e3:.1f}ms")
+
+    # -- STORM: flapping backend -----------------------------------------------
+    hedge0 = HEDGES.get("hedge_won") + HEDGES.get("primary_won")
+    for _cycle in range(flap_cycles):
+        flap_refuse.arm()
+        flap_rst.arm()
+        for _ in range(4):
+            _post(gateway, path, payload, ledger)
+            churn(1)
+        flap_refuse.disarm()
+        flap_rst.disarm()
+        for _ in range(4):
+            _post(gateway, path, payload, ledger)
+            churn(1)
+    # flapping over: keep probing until the flap circuit re-closes (its
+    # backoff may have doubled past the base after failed mid-flap
+    # probes, so this is a wait, not one fixed sleep)
+    deadline = time.monotonic() + 15
+    while breaker.state(*flap_addr) != "closed" \
+            and time.monotonic() < deadline:
+        time.sleep(0.25)
+        _post(gateway, path, payload, ledger)
+    if breaker.state(*flap_addr) != "closed":
+        failures.append("flap backend's circuit never re-closed after "
+                        "the flapping stopped")
+
+    # -- STORM: gray failure -> hedged requests -------------------------------
+    # the re-closed flap backend is again the first healthy pick; its
+    # injected 0.5s recv delay pushes past the 0.35s hedge delay, so a
+    # healthy sibling races it and the first answer wins
+    gray_delay.arm()
+    for _ in range(3):
+        _post(gateway, path, payload, ledger)
+    gray_delay.disarm()
+    hedges_launched = (HEDGES.get("hedge_won")
+                       + HEDGES.get("primary_won") - hedge0)
+    if hedges_launched < 1:
+        failures.append("gray-failure stretch launched no hedged request")
+
+    # -- HEAL: one-probe re-close + follower convergence ----------------------
+    plan.heal()
+    time.sleep(1.2)                 # max_backoff: every circuit is
+    # probe-eligible, so the FIRST post-heal request IS the probe
+    post_heal = _Ledger()
+    _post(gateway, path, payload, post_heal)
+    ledger.submitted += post_heal.submitted
+    for o, c in post_heal.outcomes.items():
+        for _ in range(c):
+            ledger.note(o)
+    if breaker.state(*dead_addr) != "closed":
+        failures.append(
+            "blackholed backend did not re-close on its first post-heal "
+            f"probe (state={breaker.state(*dead_addr)})")
+    heal_clean = _Ledger()
+    for _ in range(5):
+        _post(gateway, path, payload, heal_clean)
+    ledger.submitted += heal_clean.submitted
+    for o, c in heal_clean.outcomes.items():
+        for _ in range(c):
+            ledger.note(o)
+    bad_post_heal = sum(c for o, c in post_heal.outcomes.items()
+                        if o != "ok") \
+        + sum(c for o, c in heal_clean.outcomes.items() if o != "ok")
+    if bad_post_heal:
+        failures.append(f"{bad_post_heal} post-heal requests failed — "
+                        "re-close took more than one probe")
+    open_circuits = {a: s for a, s in breaker.snapshot().items()
+                     if s != "closed"}
+    if open_circuits:
+        failures.append(f"circuits still open after heal: {open_circuits}")
+
+    churn()                         # one post-heal write must replicate
+    converged = _wait(
+        lambda: follower.digest() == _leader_digest(server), timeout=30)
+    if not converged:
+        failures.append(
+            "follower digest diverged from leader after heal: "
+            f"follower={follower.digest()} leader={_leader_digest(server)}")
+
+    # -- ledgers --------------------------------------------------------------
+    if ledger.total() != ledger.submitted:
+        failures.append(
+            f"silent loss: {ledger.submitted} submitted but "
+            f"{ledger.total()} typed outcomes")
+    if ledger.outcomes.get("exception"):
+        failures.append(
+            f"{ledger.outcomes['exception']} requests died untyped")
+    storm_submits = ledger.submitted - storm_submit0
+    storm_hits = sum(c.hits for c in counters) - storm_hits0
+    connect_faults = sum(1 for fault, src, dst, op in plan.trace()
+                         if src == "gateway" and op == "connect")
+    attempts = storm_hits + connect_faults
+    if attempts > 2 * storm_submits:
+        failures.append(
+            f"retry amplification: {attempts} backend attempts for "
+            f"{storm_submits} storm submits (budget bound is 2x)")
+
+    # -- determinism digest ---------------------------------------------------
+    d1 = _digest_run(seed, server, ports, n_digest)
+    d2 = _digest_run(seed, server, ports, n_digest)
+    if d1 != d2:
+        failures.append(f"same-seed digests diverged: {d1} != {d2}")
+
+    # -- leak gates -----------------------------------------------------------
+    follower.stop()
+    orphans = pins = 0
+    for p in preds:
+        p.engine.drained(timeout=30)
+        stats = p.engine.stats()
+        orphans += stats["kv_pool"].get("orphan_pages", 0)
+        pins += stats.get("prefix_cache", {}).get("pinned", 0)
+    if orphans:
+        failures.append(f"{orphans} orphan KV pages after the storm")
+    if pins:
+        failures.append(f"{pins} leaked prefix-cache pins after the storm")
+
+    for p in preds:
+        p.engine.shutdown()
+    for httpd, _port in backends:
+        httpd.shutdown()
+    api_httpd.shutdown()
+
+    result = {
+        "smoke": smoke,
+        "seed": seed,
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "submits": ledger.submitted,
+        "outcomes": ledger.outcomes,
+        "baseline_p99_ms": round(p99_base * 1e3, 2),
+        "blackhole_p99_ms": round(p99_storm * 1e3, 2),
+        "partition_factor": round(p99_storm / p99_base, 2),
+        "storm_submits": storm_submits,
+        "backend_attempts": attempts,
+        "hedges_launched": int(hedges_launched),
+        "faults": plan.counts(),
+        "breaker": breaker.snapshot(),
+        "follower_converged": bool(converged),
+        "determinism_digest": d1[:16],
+        "orphan_pages": orphans,
+        "leaked_pins": pins,
+    }
+    print(json.dumps(result))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

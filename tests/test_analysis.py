@@ -806,3 +806,108 @@ from threading import local
 _tls = local()
 """)
     assert "handoff-threadlocal" in rules_of(tree.run())
+
+
+# -- pass 8: outbound http timeouts (ISSUE 19) ---------------------------------
+
+def test_http_timeout_fires_on_missing_timeout(tree):
+    tree("kubeflow_tpu/serving/m.py", """\
+import http.client
+
+def dial(host, port):
+    return http.client.HTTPConnection(host, port)
+""")
+    (f,) = tree.run()
+    assert f.rule == "http-timeout"
+    assert "HTTPConnection" in f.message
+
+
+def test_http_timeout_positional_does_not_count(tree):
+    """socket.create_connection(addr, 5) HAS a deadline, but the reader
+    can't tell a positional timeout from any other argument — the pass
+    demands the keyword spelling."""
+    tree("kubeflow_tpu/serving/m.py", """\
+import socket
+
+def dial(addr):
+    return socket.create_connection(addr, 5)
+""")
+    (f,) = tree.run()
+    assert f.rule == "http-timeout"
+
+
+def test_http_timeout_kwarg_and_seam_methods_clean(tree):
+    tree("kubeflow_tpu/serving/m.py", """\
+import http.client
+import socket
+import urllib.request
+
+def dial(net, host, port, req):
+    a = http.client.HTTPConnection(host, port, timeout=5.0)
+    b = socket.create_connection((host, port), timeout=5.0)
+    c = urllib.request.urlopen(req, timeout=2.0)
+    d = net.http_connection("gateway", host, port, timeout=5.0)
+    return a, b, c, d
+""")
+    assert tree.run() == []
+
+
+def test_http_timeout_seam_call_without_timeout_fires(tree):
+    tree("kubeflow_tpu/gateway.py", """\
+def dial(net, host, port):
+    return net.http_connection("gateway", host, port)
+""")
+    (f,) = tree.run()
+    assert f.rule == "http-timeout"
+
+
+def test_http_timeout_literal_none_flagged_and_suppressible(tree):
+    tree("kubeflow_tpu/core/kubeclient.py", """\
+import urllib.request
+
+def stream(req):
+    return urllib.request.urlopen(req, timeout=None)
+""")
+    (f,) = tree.run()
+    assert f.rule == "http-timeout"
+    assert "block forever" in f.message
+    tree("kubeflow_tpu/core/kubeclient.py", """\
+import urllib.request
+
+def stream(req):
+    # long-lived watch stream: no deadline by design
+    # kfvet: ignore[http-timeout]
+    return urllib.request.urlopen(req, timeout=None)
+""")
+    assert tree.run() == []
+
+
+def test_http_timeout_out_of_scope(tree):
+    tree("kubeflow_tpu/controllers/m.py", """\
+import http.client
+
+def dial(host, port):
+    return http.client.HTTPConnection(host, port)
+""")
+    assert tree.run() == []
+
+
+def test_resilience_and_netfault_clock_injected_by_decree(tree):
+    """The breaker's transitions and the fault plan's blackhole timing
+    are property-tested on fake clocks: a raw wall-clock read in either
+    module is a finding even with no ``clock`` parameter in sight."""
+    tree("kubeflow_tpu/resilience.py", """\
+import time
+
+def opened_at():
+    return time.monotonic()
+""")
+    assert "clock-injection" in rules_of(tree.run())
+    tree("kubeflow_tpu/resilience.py", "x = 1\n")
+    tree("kubeflow_tpu/chaos/netfault.py", """\
+import time
+
+def stamp():
+    return time.time()
+""")
+    assert "clock-injection" in rules_of(tree.run())

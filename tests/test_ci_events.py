@@ -29,6 +29,23 @@ def test_changed_components_path_filtering():
     assert both == ["fleet", "hpo", "serving"]
     assert changed_components(
         ["kubeflow_tpu/serving/model_pool.py"]) == ["fleet", "serving"]
+    # the partition-tolerance surfaces all route to resilience
+    assert "resilience" in changed_components(
+        ["kubeflow_tpu/chaos/netfault.py"])
+    assert "resilience" in changed_components(
+        ["kubeflow_tpu/resilience.py"])
+    assert "resilience" in changed_components(["kubeflow_tpu/gateway.py"])
+    assert "resilience" in changed_components(
+        ["kubeflow_tpu/core/kubeclient.py"])
+
+
+def test_resilience_workflow_runs_partition_smoke():
+    wf = generate_workflow("resilience")
+    steps = {s["name"]: s for s in wf["spec"]["steps"]}
+    assert "partition" in steps
+    assert "loadtest/load_partition.py" in steps["partition"]["run"]
+    assert steps["partition"]["depends"] == ["test"]
+    assert "tests/test_netfault.py" in steps["test"]["run"]
 
 
 def test_generate_workflow_dag():

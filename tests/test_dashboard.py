@@ -564,3 +564,34 @@ def test_fleet_route_reports_residency_and_coldstart(stack):
     finally:
         mp.set_model_pool(old)
         collector.set_residency(("10.0.0.7", 9000), ())
+
+
+def test_resilience_route_reports_breakers_budget_and_hedges(stack):
+    """/dashboard/api/resilience: per-backend circuit states off the
+    breaker gauge, retry-budget level, and the hedge outcome breakdown
+    with its win rate."""
+    from kubeflow_tpu import resilience
+
+    server, mgr, base = stack
+    br = resilience.CircuitBreaker(clock=lambda: 100.0)
+    br.record_failure("10.0.0.9", 9000)       # gauge: open
+    budget = resilience.RetryBudget(ratio=0.1, initial=7.0)
+    won0 = resilience.HEDGES.get("hedge_won")
+    resilience.HEDGES.labels("hedge_won").inc()
+    try:
+        code, state = req(base, "/dashboard/api/resilience",
+                          user="alice@corp.com")
+        assert code == 200
+        assert state["breakers"]["10.0.0.9:9000"] == "open"
+        assert state["open_backends"] >= 1
+        assert state["transitions"].get("closed,open", 0) >= 1
+        assert state["retry_budget"]["level"] == 7.0
+        h = state["hedges"]
+        assert h["hedge_won"] == won0 + 1
+        assert h["launched"] >= h["hedge_won"]
+        assert 0.0 <= h["win_rate"] <= 1.0
+        assert "pool_stale_retired" in state
+        assert "net_faults" in state
+    finally:
+        br.reset()
+        del budget

@@ -1155,11 +1155,70 @@ def test_connect_failed_backend_ejected_and_traffic_shifts():
         assert gw.EJECTIONS.get() == before + 1
         code, body = call()                  # traffic shifted, no retries
         assert code.startswith("200") and body == b"ok"
-        # expiry puts the backend back in rotation eventually
-        gateway.ejections._until.clear()
+        # the circuit never self-expires; a successful probe (here
+        # simulated via reset) is the only way back into rotation
+        gateway.ejections.reset()
         assert not gateway.ejections.contains("127.0.0.1", dead_port)
     finally:
         live.shutdown()
+
+
+def test_pooled_connection_survives_backend_restart():
+    """Regression: a pooled keep-alive connection whose backend restarted
+    between requests must be detected stale at checkout (peek-for-EOF),
+    retired, and replaced — not handed to the request to die on.  The
+    second request succeeds on a fresh connection and
+    gateway_pool_stale_retired_total counts the retirement."""
+    import socket
+    import threading
+    import time
+    from http.server import ThreadingHTTPServer
+
+    server, pods, stubs = _shed_stack(["ok"])
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01)
+
+    # track accepted sockets so the "restart" can sever them the way a
+    # dying process would (shutdown() alone leaves handler threads — and
+    # the pooled keep-alive socket — happily alive)
+    accepted = []
+    base = stubs[0].RequestHandlerClass
+
+    class Tracking(base):
+        def setup(self):
+            accepted.append(self.request)
+            base.setup(self)
+
+    stubs[0].RequestHandlerClass = Tracking
+    try:
+        code, _, body = _call(gateway)
+        assert code.startswith("200") and body == b"ok"
+        # restart the backend on the SAME port: the pooled socket now
+        # points at a dead peer (FIN waiting in its buffer)
+        port = stubs[0].server_address[1]
+        stubs[0].shutdown()
+        stubs[0].server_close()
+        for c in accepted:
+            # shutdown, not close: the handler's makefile objects still
+            # hold refs, and close() alone would never send the FIN
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        httpd = ThreadingHTTPServer(("127.0.0.1", port), base)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        stubs.append(httpd)
+        time.sleep(0.1)   # let the FIN land in the pooled socket
+        stale0 = gw.POOL_STALE.get()
+        code, _, body = _call(gateway)
+        assert code.startswith("200") and body == b"ok"
+        assert gw.POOL_STALE.get() == stale0 + 1
+        # the restart is socket hygiene, not a backend failure: the
+        # breaker must not have opened on the healthy restarted pod
+        assert not gateway.ejections.contains(*pods["pod-a"])
+    finally:
+        for s in stubs:
+            s.shutdown()
 
 
 # -- disaggregated role-aware routing (ISSUE 12) ------------------------------

@@ -1,0 +1,643 @@
+"""Partition tolerance (ISSUE 19): seeded network fault injection under
+the ``core.net`` seam, the gateway circuit breaker's state machine, the
+retry budget, hedged requests, and the kubeclient watch pump under
+injected partitions.
+
+Everything here is deterministic by construction: fault rules match by
+call order and per-rule budgets (never probability), breaker transitions
+run on injected fake clocks, and the plan's seed feeds only delay
+jitter — the acceptance gate is that the same seed produces the
+identical ``chaos_net_faults_injected_total`` breakdown twice.
+"""
+
+import io
+import socket
+import threading
+import time
+
+import pytest
+from conftest import poll_until as wait
+
+from kubeflow_tpu import gateway as gw
+from kubeflow_tpu import resilience
+from kubeflow_tpu.chaos import FaultySocketFactory, NetFaultPlan
+from kubeflow_tpu.chaos.netfault import NET_FAULTS
+from kubeflow_tpu.resilience import CircuitBreaker, RetryBudget
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- NetFaultPlan: rule semantics (no sockets) --------------------------------
+
+def test_refuse_matches_src_dst_op():
+    plan = NetFaultPlan(seed=1)
+    plan.refuse("gateway", "*:9000")
+    with pytest.raises(ConnectionRefusedError):
+        plan.check("gateway", "10.0.0.1:9000", "connect")
+    # wrong src, wrong port, wrong op: all pass uninjured
+    plan.check("kubeclient", "10.0.0.1:9000", "connect")
+    plan.check("gateway", "10.0.0.1:9001", "connect")
+    plan.check("gateway", "10.0.0.1:9000", "send")
+
+
+def test_blackhole_sleeps_full_timeout_then_raises():
+    slept = []
+    plan = NetFaultPlan(seed=1, sleep=slept.append)
+    plan.blackhole("gateway", "*")
+    with pytest.raises(socket.timeout):
+        plan.check("gateway", "b:1", "connect", timeout=3.0)
+    assert slept == [3.0]
+    # no finite timeout: capped, so a partition can't wedge the harness
+    with pytest.raises(socket.timeout):
+        plan.check("gateway", "b:1", "connect", timeout=None)
+    assert slept[1] == NetFaultPlan.BLACKHOLE_CAP_S
+
+
+def test_reset_after_ops_kills_the_nth_crossing():
+    plan = NetFaultPlan(seed=1)
+    plan.reset("predictor", "*", op="recv", after_ops=2, times=1)
+    plan.check("predictor", "p:1", "recv")   # 1st crossing: through
+    plan.check("predictor", "p:1", "recv")   # 2nd: through
+    with pytest.raises(ConnectionResetError):
+        plan.check("predictor", "p:1", "recv")  # 3rd: RST
+    plan.check("predictor", "p:1", "recv")   # budget (times=1) spent
+
+
+def test_partition_is_asymmetric_and_heals():
+    plan = NetFaultPlan(seed=1)
+    rules = plan.partition("a", "b:1")
+    with pytest.raises(socket.timeout):
+        plan.check("a", "b:1", "connect", timeout=0.0)
+    with pytest.raises(socket.timeout):
+        plan.check("a", "b:1", "recv", timeout=0.0)
+    # the reverse direction is simply not matched: b still reaches a
+    plan.check("b", "a:1", "connect")
+    plan.check("b", "a:1", "recv")
+    plan.heal(rules)
+    plan.check("a", "b:1", "connect")        # healed
+    assert plan.counts() == {"blackhole": 2}  # history preserved
+
+
+def test_same_seed_same_fault_breakdown():
+    """The determinism gate: two plans with the same seed, same rules,
+    same traffic inject the identical fault sequence — counts(), the
+    recorded trace, AND the jittered delay durations all match."""
+    def run(seed):
+        slept = []
+        plan = NetFaultPlan(seed=seed, record=True, sleep=slept.append)
+        plan.refuse("gateway", "*:9000", times=2)
+        plan.delay("gateway", "*:9001", 0.2, jitter=0.1, op="recv")
+        plan.reset("kubeclient", "*", op="recv", after_ops=1, times=1)
+        before = {f: NET_FAULTS.get(f)
+                  for f in ("refuse", "delay", "reset")}
+        for _ in range(4):
+            try:
+                plan.check("gateway", "10.0.0.1:9000", "connect")
+            except ConnectionRefusedError:
+                pass
+        for _ in range(3):
+            plan.check("gateway", "10.0.0.1:9001", "recv")
+        for _ in range(3):
+            try:
+                plan.check("kubeclient", "cp:80", "recv")
+            except ConnectionResetError:
+                pass
+        delta = {f: NET_FAULTS.get(f) - before[f]
+                 for f in ("refuse", "delay", "reset")}
+        return plan.counts(), plan.trace(), slept, delta
+
+    a = run(seed=42)
+    b = run(seed=42)
+    assert a == b
+    assert a[0] == {"refuse": 2, "delay": 3, "reset": 1}
+    assert a[3] == {"refuse": 2, "delay": 3, "reset": 1}
+
+
+# -- FaultySocketFactory: the seam over real sockets --------------------------
+
+def _echo_server():
+    """A minimal live HTTP backend; returns (httpd, port)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]
+
+
+def test_factory_injects_connect_refused_without_monkeypatching():
+    httpd, port = _echo_server()
+    try:
+        plan = NetFaultPlan(seed=1)
+        plan.refuse("gateway", f"127.0.0.1:{port}", times=1)
+        net = FaultySocketFactory(plan)
+        conn = net.http_connection("gateway", "127.0.0.1", port,
+                                   timeout=5.0)
+        with pytest.raises(ConnectionRefusedError):
+            conn.request("GET", "/")
+        # budget spent: the very next connect goes through for real
+        conn2 = net.http_connection("gateway", "127.0.0.1", port,
+                                    timeout=5.0)
+        conn2.request("GET", "/")
+        assert conn2.getresponse().read() == b"ok"
+        conn2.close()
+    finally:
+        httpd.shutdown()
+
+
+def test_factory_injects_midstream_reset_on_response_read():
+    httpd, port = _echo_server()
+    try:
+        plan = NetFaultPlan(seed=1)
+        plan.reset("gateway", f"127.0.0.1:{port}", op="recv", times=1)
+        net = FaultySocketFactory(plan)
+        conn = net.http_connection("gateway", "127.0.0.1", port,
+                                   timeout=5.0)
+        conn.request("GET", "/")
+        with pytest.raises(ConnectionResetError):
+            conn.getresponse()
+        conn.close()
+        assert plan.counts() == {"reset": 1}
+    finally:
+        httpd.shutdown()
+
+
+def test_nonblocking_peek_passes_uninjured():
+    """The gateway pool's staleness probe (MSG_PEEK, non-blocking) is
+    local hygiene, not traffic: a recv blackhole must not fault it."""
+    a, b = socket.socketpair()
+    try:
+        plan = NetFaultPlan(seed=1)
+        plan.blackhole("gateway", "*", op="recv")
+        from kubeflow_tpu.chaos.netfault import _FaultySocket
+
+        fs = _FaultySocket(a, plan, "gateway", "peer:1")
+        b.sendall(b"x")
+        wait(lambda: fs.recv(1, socket.MSG_PEEK) == b"x", timeout=5)
+        assert plan.counts() == {}
+        with pytest.raises(socket.timeout):
+            fs.settimeout(0.01)
+            fs.recv(1)           # a REAL read crosses and blackholes
+    finally:
+        a.close()
+        b.close()
+
+
+# -- CircuitBreaker: property tests on a fake clock ---------------------------
+
+def test_breaker_full_lifecycle_on_fake_clock():
+    clock = FakeClock()
+    br = CircuitBreaker(backoff=10.0, clock=clock)
+    assert br.state("b", 1) == "closed"
+    br.record_failure("b", 1)
+    assert br.state("b", 1) == "open"
+    assert br.contains("b", 1)
+    # open: no probe before the backoff elapses
+    clock.advance(9.9)
+    assert not br.try_probe("b", 1)
+    clock.advance(0.2)
+    assert br.try_probe("b", 1)
+    assert br.state("b", 1) == "half_open"
+    # probe succeeds: closed, fully back in rotation
+    br.record_success("b", 1)
+    assert br.state("b", 1) == "closed"
+    assert not br.contains("b", 1)
+
+
+def test_breaker_failed_probe_doubles_backoff():
+    clock = FakeClock()
+    br = CircuitBreaker(backoff=10.0, max_backoff=60.0, clock=clock)
+    br.record_failure("b", 1)
+    clock.advance(10.1)
+    assert br.try_probe("b", 1)
+    br.record_failure("b", 1)                # probe failed
+    assert br.state("b", 1) == "open"
+    clock.advance(10.1)                      # old backoff: not enough
+    assert not br.try_probe("b", 1)
+    clock.advance(10.0)                      # 20s total: doubled backoff
+    assert br.try_probe("b", 1)
+    # cap: repeated failures never exceed max_backoff
+    for _ in range(6):
+        br.record_failure("b", 1)
+        clock.advance(60.1)
+        assert br.try_probe("b", 1)
+
+
+def test_breaker_never_self_expires():
+    clock = FakeClock()
+    br = CircuitBreaker(backoff=10.0, clock=clock)
+    br.record_failure("b", 1)
+    clock.advance(3600.0)
+    assert br.contains("b", 1)   # still out of NORMAL rotation
+
+
+def test_half_open_admits_exactly_one_probe_under_race():
+    """The property the old EjectionList could not have: N threads race
+    try_probe the instant the circuit becomes probe-eligible, and
+    exactly ONE wins the claim."""
+    clock = FakeClock()
+    br = CircuitBreaker(backoff=1.0, clock=clock)
+    br.record_failure("b", 1)
+    clock.advance(1.1)
+    wins = []
+    barrier = threading.Barrier(16)
+
+    def racer():
+        barrier.wait()
+        if br.try_probe("b", 1):
+            wins.append(threading.get_ident())
+
+    threads = [threading.Thread(target=racer) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert br.state("b", 1) == "half_open"
+
+
+def test_leaked_probe_reclaimed_after_ttl():
+    clock = FakeClock()
+    br = CircuitBreaker(backoff=1.0, probe_ttl=30.0, clock=clock)
+    br.record_failure("b", 1)
+    clock.advance(1.1)
+    assert br.try_probe("b", 1)      # claimed... and the prober dies
+    assert not br.try_probe("b", 1)  # slot held
+    clock.advance(30.1)
+    assert br.try_probe("b", 1)      # reclaimed: the circuit can't wedge
+
+
+def test_error_rate_threshold_trips_on_window():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1000,   # consecutive path off
+                        error_rate_threshold=0.5, window=10, clock=clock)
+    for i in range(20):
+        (br.record_failure if i % 2 else br.record_success)("b", 1)
+        if br.state("b", 1) == "open":
+            break
+    assert br.state("b", 1) == "open"
+    assert i < 19   # tripped on the window crossing, not the loop end
+
+
+def test_open_backend_receives_no_traffic_except_the_probe():
+    """Routing property: with a healthy sibling present, an open backend
+    gets ZERO picks; once probe-eligible it gets exactly one (the
+    probe), then none again until the probe resolves."""
+    from kubeflow_tpu.core.objects import api_object
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    server.create(api_object("Service", "web", "default", spec={
+        "selector": {"app": "web"},
+        "ports": [{"port": 80, "targetPort": 8080}]}))
+    server.create(api_object(
+        "VirtualService", "web", "default",
+        spec={"hosts": ["*"],
+              "http": [{"match": [{"uri": {"prefix": "/web/default/"}}],
+                        "rewrite": {"uri": "/"},
+                        "route": [{"destination": {
+                            "host": "web.default.svc",
+                            "port": {"number": 80}}}]}]}))
+    for i in range(2):
+        name = f"pod-{i}"
+        server.create(api_object("Pod", name, "default",
+                                 labels={"app": "web"},
+                                 spec={"containers": [{"name": "c"}]}))
+        server.patch_status("Pod", name, "default", {
+            "phase": "Running", "podIP": "127.0.0.1",
+            "portMap": {"8080": 9000 + i}})
+    route = gw.match_route(server, "/web/default/x")
+    clock = FakeClock()
+    br = CircuitBreaker(backoff=10.0, clock=clock)
+    br.record_failure("127.0.0.1", 9000)
+
+    picks = [gw.backend_for_route(server, route, "/web/default/x",
+                                  ejected=br).port for _ in range(20)]
+    assert set(picks) == {9001}          # open backend: zero traffic
+    clock.advance(10.1)
+    picks = [gw.backend_for_route(server, route, "/web/default/x",
+                                  ejected=br).port for _ in range(20)]
+    assert picks.count(9000) == 1        # exactly the probe
+    assert picks[0] == 9000              # ...and it was the first pick
+    br.record_success("127.0.0.1", 9000)
+    picks = [gw.backend_for_route(server, route, "/web/default/x",
+                                  ejected=br).port for _ in range(20)]
+    assert 9000 in picks                 # closed: back in rotation
+
+
+# -- RetryBudget --------------------------------------------------------------
+
+def test_retry_budget_bounds_and_refills_from_traffic():
+    before = resilience.RETRY_BUDGET_EXHAUSTED.get()
+    budget = RetryBudget(ratio=0.5, initial=2.0, cap=3.0)
+    assert budget.try_take() and budget.try_take()
+    assert not budget.try_take()          # dry: retry refused
+    assert resilience.RETRY_BUDGET_EXHAUSTED.get() == before + 1
+    for _ in range(2):
+        budget.note_request()             # 2 primaries × 0.5 = 1 token
+    assert budget.try_take()
+    assert not budget.try_take()
+    # the cap bounds quiet-period credit
+    for _ in range(100):
+        budget.note_request()
+    assert budget.level() == 3.0
+
+
+# -- hedged requests ----------------------------------------------------------
+
+def _slow_stack(delays):
+    """Routed Service with one live backend per entry; each answers 200
+    after sleeping its delay."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubeflow_tpu.core import APIServer, api_object
+
+    server = APIServer()
+    server.create(api_object("VirtualService", "app", "default", spec={
+        "http": [{"match": [{"uri": {"prefix": "/web/default/app/"}}],
+                  "rewrite": {"uri": "/"},
+                  "route": [{"destination": {"host": "app.default.svc",
+                                             "port": {"number": 80}}}]}]}))
+    server.create(api_object("Service", "app", "default", spec={
+        "selector": {"app": "web"},
+        "ports": [{"port": 80, "targetPort": 8080}]}))
+
+    def make_handler(delay):
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _serve(self):
+                time.sleep(delay)
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = _serve
+
+            def log_message(self, *a):
+                pass
+        return H
+
+    stubs = []
+    for i, delay in enumerate(delays):
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(delay))
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        stubs.append(httpd)
+        name = f"pod-{i}"
+        server.create(api_object("Pod", name, "default",
+                                 labels={"app": "web"},
+                                 spec={"containers": [{"name": "c"}]}))
+        server.patch_status("Pod", name, "default", {
+            "phase": "Running", "podIP": "127.0.0.1",
+            "portMap": {"8080": httpd.server_address[1]}})
+    return server, stubs
+
+
+def _get(gateway, path="/web/default/app/x"):
+    status = {}
+    environ = {"REQUEST_METHOD": "GET", "PATH_INFO": path,
+               "wsgi.input": io.BytesIO(b""), "CONTENT_LENGTH": "0"}
+    body = b"".join(gateway(environ, lambda s, h: status.update(code=s)))
+    return status["code"], body
+
+
+def _hedge_counts():
+    return {o: resilience.HEDGES.get(o)
+            for o in ("hedge_won", "primary_won", "no_sibling",
+                      "budget_exhausted")}
+
+
+def test_hedge_launches_and_loser_cancellation_is_not_a_failure():
+    """Both backends slow past the hedge delay: a hedge launches, the
+    first response wins, the loser is cancelled — and neither backend's
+    circuit records a failure (a cancelled hedge is not an outage)."""
+    server, stubs = _slow_stack([0.4, 0.4])
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01,
+                         hedge_delay=0.05)
+    try:
+        before = _hedge_counts()
+        ej_before = gw.EJECTIONS.get()
+        code, body = _get(gateway)
+        assert code.startswith("200") and body == b"ok"
+        after = _hedge_counts()
+        launched = (after["hedge_won"] - before["hedge_won"]
+                    + after["primary_won"] - before["primary_won"])
+        assert launched == 1
+        # loser cancellation recorded no breaker failure anywhere
+        assert gw.EJECTIONS.get() == ej_before
+        assert gateway.ejections.snapshot() == {}
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+def test_hedge_refused_when_budget_dry():
+    server, stubs = _slow_stack([0.3, 0.3])
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01,
+                         hedge_delay=0.05,
+                         retry_budget=RetryBudget(ratio=0.0, initial=0.0))
+    try:
+        before = _hedge_counts()
+        code, body = _get(gateway)
+        assert code.startswith("200") and body == b"ok"  # primary answers
+        after = _hedge_counts()
+        assert after["budget_exhausted"] == before["budget_exhausted"] + 1
+        assert after["hedge_won"] == before["hedge_won"]
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+def test_hedge_without_sibling_blocks_on_primary():
+    server, stubs = _slow_stack([0.3])
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01,
+                         hedge_delay=0.05)
+    try:
+        before = _hedge_counts()
+        code, body = _get(gateway)
+        assert code.startswith("200") and body == b"ok"
+        after = _hedge_counts()
+        assert after["no_sibling"] == before["no_sibling"] + 1
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+def test_no_hedge_without_latency_history():
+    """With no override and fewer than 50 recorded requests, the p95 is
+    noise — the gateway must not hedge at all."""
+    server, stubs = _slow_stack([0.0, 0.0])
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01)
+    try:
+        assert gateway._hedge_delay_s() is None or \
+            gw.REQUEST_SECONDS.count() >= 50
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+# -- breaker + netfault end to end: open, probe, re-close ---------------------
+
+def test_breaker_opens_under_refused_connects_and_recloses_on_heal():
+    """Gateway + seeded fault plan, no monkeypatching: the fault plan
+    refuses every connect to one backend, its circuit opens; after the
+    heal, the first probe-eligible request probes it and the circuit
+    re-closes within that one probe."""
+    server, stubs = _slow_stack([0.0, 0.0])
+    plan = NetFaultPlan(seed=7)
+    dead_port = stubs[0].server_address[1]
+    rules = [plan.refuse("gateway", f"127.0.0.1:{dead_port}")]
+    clock = FakeClock(time.monotonic())
+    br = CircuitBreaker(backoff=0.2, clock=clock)
+    gateway = gw.Gateway(server, connect_retries=2, retry_delay=0.01,
+                         net=FaultySocketFactory(plan), breaker=br)
+    try:
+        # storm until the refused backend's circuit opens (the pick is
+        # load-balanced, so the first request may land on the healthy
+        # sibling)
+        wait(lambda: [_get(gateway)] and
+             br.state("127.0.0.1", dead_port) == "open", timeout=10)
+        # while open, every request lands on the sibling
+        for _ in range(5):
+            code, body = _get(gateway)
+            assert code.startswith("200")
+        plan.heal(rules)
+        clock.advance(0.3)               # backoff elapses -> probe
+        code, body = _get(gateway)       # this request IS the probe
+        assert code.startswith("200")
+        assert br.state("127.0.0.1", dead_port) == "closed"
+        assert plan.counts()["refuse"] >= 1
+    finally:
+        for s in stubs:
+            s.shutdown()
+
+
+# -- kubeclient watch pump under netfault -------------------------------------
+
+def _cm(name, n=None):
+    spec = {} if n is None else {"n": n}
+    return {"kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": name, "namespace": "d"}, "spec": spec}
+
+
+def test_watch_rst_mid_replay_resumes_without_gaps_or_duplicates():
+    """A mid-stream RST (injected through the seam, not a mock) drops
+    the watch; the pump reconnects with ``resourceVersion=resume_rv``
+    and the server replays the gap exactly — every event arrives exactly
+    once, and the resume counter (not the relist path) increments."""
+    from kubeflow_tpu.core import watchcache
+    from kubeflow_tpu.core.httpapi import RestAPI, serve
+    from kubeflow_tpu.core.kubeclient import WATCH_RESUMES, KubeStore
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    watchcache.attach(server, window=1024)   # wide window: resume path
+    httpd, _ = serve(RestAPI(server), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    plan = NetFaultPlan(seed=3)
+    # disarmed upfront so the factory wraps the watch stream from the
+    # start; armed later to RST it mid-life
+    rst = plan.reset("kubeclient", "*", op="recv", times=1, armed=False)
+    store = KubeStore(base, net=FaultySocketFactory(plan))
+    resumed0 = WATCH_RESUMES.get("resumed")
+    w = store.watch(kinds=["ConfigMap"])
+    try:
+        server.create(_cm("one"))
+        assert w.next(timeout=5).object["metadata"]["name"] == "one"
+        rst.arm()
+        # the RST fires on the next recv crossing; events created during
+        # the outage are the gap the resume must replay
+        server.create(_cm("two"))
+        server.create(_cm("three"))
+        seen = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and len(seen) < 2:
+            ev = w.next(timeout=1.0)
+            if ev is not None:
+                seen.append((ev.type, ev.object["metadata"]["name"]))
+        # exactly once each, in order, all ADDED (no synthesized
+        # MODIFIED — the relist path would emit those)
+        assert seen == [("ADDED", "two"), ("ADDED", "three")]
+        assert plan.counts() == {"reset": 1}
+        wait(lambda: WATCH_RESUMES.get("resumed") == resumed0 + 1,
+             timeout=5)
+        # stream is live again
+        server.create(_cm("four"))
+        got = wait(lambda: w.next(timeout=0.5), timeout=10)
+        assert got.object["metadata"]["name"] == "four"
+    finally:
+        w.stop()
+        httpd.shutdown()
+
+
+def test_watch_partition_past_window_takes_relist_path():
+    """A partition long enough for the server's event window to evict
+    the client's resume position: the resume gets 410 Gone, the pump
+    falls back to the re-list (synthesized events), and
+    ``kubeclient_watch_resumes_total{expired}`` increments."""
+    from kubeflow_tpu.core import watchcache
+    from kubeflow_tpu.core.httpapi import RestAPI, serve
+    from kubeflow_tpu.core.kubeclient import WATCH_RESUMES, KubeStore
+    from kubeflow_tpu.core.store import APIServer
+
+    server = APIServer()
+    watchcache.attach(server, window=1)      # tiny window: forced 410
+    httpd, _ = serve(RestAPI(server), 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    plan = NetFaultPlan(seed=3)
+    plan.BLACKHOLE_CAP_S = 0.2               # fast partition timeouts
+    rst = plan.reset("kubeclient", "*", op="recv", times=1, armed=False)
+    hole = plan.blackhole("kubeclient", "*", "connect", armed=False)
+    store = KubeStore(base, net=FaultySocketFactory(plan))
+    expired0 = WATCH_RESUMES.get("expired")
+    w = store.watch(kinds=["ConfigMap"])
+    try:
+        server.create(_cm("keep"))
+        assert w.next(timeout=5).object["metadata"]["name"] == "keep"
+        # partition: blackhole reconnects, then kill the live stream
+        hole.arm()
+        rst.arm()
+        server.patch_status("ConfigMap", "keep", "d", {"n": 1})
+        # wait until at least one reconnect attempt has been blackholed
+        # (the pump is now cycling in its backoff loop)
+        wait(lambda: plan.counts().get("blackhole", 0) >= 1, timeout=10)
+        # evict the client's position: window=1 keeps only the newest
+        server.patch_status("ConfigMap", "keep", "d", {"n": 2})
+        server.patch_status("ConfigMap", "keep", "d", {"n": 3})
+        plan.heal([hole])
+        wait(lambda: WATCH_RESUMES.get("expired") == expired0 + 1,
+             timeout=20)
+        # the relist synthesized the current state of the survivor
+        got = wait(lambda: next(
+            (e for e in iter(lambda: w.next(timeout=0.5), None)
+             if e.object["metadata"]["name"] == "keep"
+             and e.object["status"].get("n") == 3), None), timeout=15)
+        assert got is not None
+    finally:
+        w.stop()
+        httpd.shutdown()
