@@ -42,7 +42,11 @@ ALWAYS_INJECTED_SCOPE = ("kubeflow_tpu/qos/",
                          # netfault plan's blackhole timing are replayed
                          # on fake clocks by their property tests
                          "kubeflow_tpu/resilience.py",
-                         "kubeflow_tpu/chaos/netfault.py")
+                         "kubeflow_tpu/chaos/netfault.py",
+                         # follower staleness, self-fencing, and lease
+                         # failover replay on injected clocks in the HA
+                         # tests — wall-clock reads must stay injectable
+                         "kubeflow_tpu/core/watchcache.py")
 BANNED = {"time", "monotonic", "sleep"}
 
 

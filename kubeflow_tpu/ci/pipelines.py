@@ -277,9 +277,11 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                          "kubeflow_tpu/core/net.py",
                          "kubeflow_tpu/chaos/netfault.py",
                          "kubeflow_tpu/core/kubeclient.py",
-                         "loadtest/load_partition.py"],
+                         "kubeflow_tpu/core/watchcache.py",
+                         "loadtest/load_partition.py",
+                         "loadtest/load_ha.py"],
         "test_cmd": [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
-                     "tests/test_netfault.py"],
+                     "tests/test_netfault.py", "tests/test_ha.py"],
         # partition storm: 3 predictor backends + a replicated control
         # plane while the seeded plan blackholes one backend, flaps
         # another, and partitions a follower — asserts every submitted
@@ -294,6 +296,17 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         # identical outcome + fault digest.  KF_SKIP_NETFAULT=1 opts out.
         "netfault_cmd": [sys.executable, "loadtest/load_partition.py",
                          "--smoke"],
+        # HA failover storm: a cross-host follower mirrors a leader
+        # child process while seeded gray delays, a leader SIGKILL, and
+        # an asymmetric partition land under live write+watch traffic —
+        # asserts every acked write survives exactly once (WAL + mirror
+        # replay), promotion latency stays within a small lease-TTL
+        # multiple, every deposed-leader write bounces off the fencing
+        # epoch (zero silent merges), the watch stream crosses both
+        # failovers with no gap and no duplicate, follower digest ==
+        # final leader after heal, and the same seed reproduces the
+        # identical state digest.  KF_SKIP_HA=1 opts out.
+        "ha_cmd": [sys.executable, "loadtest/load_ha.py", "--smoke"],
     },
     "analysis": {
         # the analyzer's own component: its unit tests plus the
@@ -380,6 +393,9 @@ def generate_workflow(component: str, *, no_push: bool = True) -> dict:
     if "netfault_cmd" in spec:
         steps.append({"name": "partition", "run": spec["netfault_cmd"],
                       "depends": ["test"]})
+    if "ha_cmd" in spec:
+        steps.append({"name": "ha", "run": spec["ha_cmd"],
+                      "depends": ["test"]})
     if spec.get("image"):
         # kaniko executor (the reference's builder): --no-push is the
         # presubmit mode (ci/notebook_servers pattern)
@@ -456,6 +472,9 @@ def run_local(components: list[str], *, build: bool = True) -> dict[str, bool]:
         if (ok and "netfault_cmd" in spec
                 and os.environ.get("KF_SKIP_NETFAULT") != "1"):
             ok = subprocess.run(spec["netfault_cmd"]).returncode == 0
+        if (ok and "ha_cmd" in spec
+                and os.environ.get("KF_SKIP_HA") != "1"):
+            ok = subprocess.run(spec["ha_cmd"]).returncode == 0
         results[name] = ok
     return results
 

@@ -639,7 +639,12 @@ LEASE_TTL = 15.0
 def acquire_lease(server: APIServer, name: str, identity: str,
                   ttl: float = LEASE_TTL) -> bool:
     """Acquire or renew a lease object; returns True when ``identity`` holds
-    it (k8s coordination.k8s.io Lease semantics, simplified)."""
+    it (k8s coordination.k8s.io Lease semantics, simplified).  The lease
+    carries a monotonic ``epoch`` bumped on every HOLDERSHIP TRANSFER
+    (create or steal, never a same-holder renewal) — the fencing token of
+    the Chubby/DDIA recipe: whoever wins the lease wins a number no prior
+    holder ever had, and downstream writes stamped with an older number
+    are rejectable no matter how delayed they arrive."""
     from kubeflow_tpu.core.store import Conflict, NotFound
 
     now = time.time()
@@ -649,19 +654,34 @@ def acquire_lease(server: APIServer, name: str, identity: str,
         try:
             server.create(ob.api_object(
                 LEASE_KIND, name, "kube-system",
-                spec={"holder": identity, "renewTime": now, "ttl": ttl}))
+                spec={"holder": identity, "renewTime": now, "ttl": ttl,
+                      "epoch": 1}))
             return True
         except Conflict:
             return False
     spec = lease["spec"]
     if spec["holder"] != identity and now - spec["renewTime"] < spec["ttl"]:
         return False
+    if spec["holder"] != identity:
+        spec["epoch"] = int(spec.get("epoch", 0)) + 1
     spec.update(holder=identity, renewTime=now, ttl=ttl)
     try:
         server.update(lease)
         return True
     except Conflict:
         return False
+
+
+def lease_epoch(server: APIServer, name: str) -> int:
+    """The fencing epoch of ``name``'s lease (0 when it does not exist —
+    no leadership was ever established)."""
+    from kubeflow_tpu.core.store import NotFound
+
+    try:
+        lease = server.get(LEASE_KIND, name, "kube-system")
+    except NotFound:
+        return 0
+    return int(lease["spec"].get("epoch", 0))
 
 
 def release_lease(server: APIServer, name: str, identity: str) -> None:

@@ -24,8 +24,9 @@ import time
 from typing import Any, Callable
 from urllib.parse import parse_qs
 
-from kubeflow_tpu.core.store import APIServer, Conflict, Invalid, NotFound
-from kubeflow_tpu.core.watchcache import ResourceExpired
+from kubeflow_tpu.core.store import (
+    APIServer, Conflict, FencedWrite, Invalid, NotFound)
+from kubeflow_tpu.core.watchcache import FENCED_WRITES, ResourceExpired
 # one definition of the correlation id for every hop: the client's
 # X-Request-Id when sent (the gateway forwards it), a fresh one
 # otherwise — echoed on every response and stamped into the access-log
@@ -86,6 +87,15 @@ class RestAPI:
                 status, body = out
         except NotFound as e:
             status, body = "404 Not Found", {"error": str(e)}
+        except FencedWrite as e:
+            # typed 409: a write stamped with a deposed leader's epoch.
+            # Distinguished from plain optimistic-concurrency Conflict so
+            # routers/clients re-resolve the leader instead of re-reading
+            # the object and retrying into the same fence
+            FENCED_WRITES.inc()
+            status, body = "409 Conflict", {
+                "error": str(e), "reason": "FencedWrite",
+                "currentEpoch": e.current_epoch}
         except Conflict as e:
             status, body = "409 Conflict", {"error": str(e)}
         except ResourceExpired as e:
@@ -114,7 +124,13 @@ class RestAPI:
             ctype = "application/json"
         start_response(status, [("Content-Type", ctype),
                                 ("Content-Length", str(len(payload))),
-                                ("X-Request-Id", rid)]
+                                ("X-Request-Id", rid),
+                                # every response teaches the caller the
+                                # current fencing epoch, so clients learn
+                                # a failover from their next read instead
+                                # of their next rejected write
+                                ("X-KF-Fencing-Epoch",
+                                 str(getattr(self.server, "epoch", 0)))]
                        + extra_headers)
         return [payload]
 
@@ -146,6 +162,27 @@ class RestAPI:
             return ("503 Service Unavailable", {"error": DEGRADED_MSG},
                     [("Retry-After", "1")])
 
+        if method != "GET":
+            # fencing gate (before dispatch, after degraded): a mutation
+            # stamped with the epoch its writer learned from a leader
+            # must match THIS server's epoch — an old stamp means the
+            # writer trusts a deposed leader; a newer stamp means this
+            # server IS the deposed one.  Unstamped writes (legacy
+            # clients, direct tooling) pass; the fence targets writers
+            # that DID route through a leader.
+            raw_epoch = environ.get("HTTP_X_KF_FENCING_EPOCH")
+            write_epoch = None
+            if raw_epoch not in (None, ""):
+                try:
+                    write_epoch = int(raw_epoch)
+                except ValueError:
+                    raise Invalid(
+                        f"malformed X-KF-Fencing-Epoch: {raw_epoch!r}"
+                    ) from None
+            check = getattr(self.server, "check_epoch", None)
+            if check is not None:
+                check(write_epoch)
+
         if not parts and method == "GET":
             # kind discovery (k8s API-group discovery's role): a
             # kind-filterless watch client re-lists every kind after a
@@ -157,8 +194,13 @@ class RestAPI:
             self._authz(user, "list", "*", ns)
             # the ANSWER is scoped like the authz: a namespaced caller
             # sees only kinds with objects in its namespace (+ cluster-
-            # scoped kinds), not cluster-wide kind existence
-            return "200 OK", {"kinds": self.server.kinds(namespace=ns)}
+            # scoped kinds), not cluster-wide kind existence.  The
+            # store's newest committed resourceVersion rides along so an
+            # HTTP follower can measure replication lag without a
+            # dedicated endpoint.
+            return "200 OK", {
+                "kinds": self.server.kinds(namespace=ns),
+                "resourceVersion": str(self.server.current_rv())}
 
         version = qs.get("version", [None])[0]
         if len(parts) == 1:

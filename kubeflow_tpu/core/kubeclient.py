@@ -43,14 +43,17 @@ from __future__ import annotations
 
 import json
 import queue
+import random
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Iterable
 
 from kubeflow_tpu.core.store import (
     Conflict,
+    FencedWrite,
     Invalid,
     NotFound,
     WatchEvent,
@@ -79,11 +82,40 @@ _CONNECTED_COUNT = 0
 _NO_NS = "_"
 
 
+class _Backoff:
+    """Exponential backoff with seeded jitter for reconnect/relist retries.
+
+    ``next()`` yields ``min(cap, base * 2**attempt)`` scaled by a jitter
+    factor in [0.5, 1.0) drawn from the injected RNG — deterministic under
+    a seeded ``random.Random`` so chaos runs replay identically, while the
+    jitter still de-synchronises a fleet of clients hammering a recovering
+    server (no thundering herd on the same-millisecond retry).  ``reset()``
+    re-arms the ladder; callers reset only on observed PROGRESS (a line
+    read off the stream), not on a mere successful dial, so a flapping
+    server that accepts connections and instantly drops them still sees
+    the delays grow instead of a hot-spinning pump."""
+
+    def __init__(self, base: float = 0.2, cap: float = 5.0, rng=None):
+        self.base = base
+        self.cap = cap
+        self._rng = rng if rng is not None else random.Random()
+        self._attempt = 0
+
+    def next(self) -> float:
+        delay = min(self.cap, self.base * (2 ** self._attempt))
+        self._attempt += 1
+        return delay * (0.5 + self._rng.random() / 2)
+
+    def reset(self) -> None:
+        self._attempt = 0
+
+
 class KubeStore:
     def __init__(self, base_url: str, *, user: str | None = None,
                  timeout: float = 10.0, token: str | None = None,
                  cafile: str | None = None, insecure_tls: bool = False,
-                 net=None):
+                 net=None, seed: int | None = None,
+                 clock=time.monotonic):
         from kubeflow_tpu.core.net import DIRECT
 
         self.base_url = base_url.rstrip("/")
@@ -95,6 +127,14 @@ class KubeStore:
         # watch mid-replay or partition this client from the apiserver
         self._net = net if net is not None else DIRECT
         self._watches: list[_HttpWatch] = []
+        # reconnect-jitter RNG: seeded for deterministic chaos replays
+        self._rng = random.Random(seed)
+        self._clock = clock
+        # highest fencing epoch observed from any response
+        # (X-KF-Fencing-Epoch): stamped onto every mutation so a server
+        # that has moved on to a newer leadership epoch rejects us with a
+        # typed 409 instead of silently merging a deposed leader's write
+        self.epoch = 0
         if base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=cafile)
             if insecure_tls:
@@ -118,6 +158,17 @@ class KubeStore:
         return self._net.urlopen("kubeclient", request, timeout=timeout,
                                  context=self._ssl_ctx)
 
+    def _note_epoch(self, raw: str | None) -> None:
+        # epochs are monotonic by construction (lease transfers only bump),
+        # so max() learns a failover from any response and ignores a stale
+        # deposed leader still advertising the old epoch
+        try:
+            epoch = int(raw or 0)
+        except ValueError:
+            return
+        if epoch > self.epoch:
+            self.epoch = epoch
+
     def _req(self, method: str, path: str, body: dict | None = None):
         data = json.dumps(body).encode() if body is not None else None
         r = urllib.request.Request(self.base_url + path, data=data,
@@ -125,18 +176,30 @@ class KubeStore:
         self._headers(r)
         if data is not None:
             r.add_header("Content-Type", "application/json")
+        if method != "GET" and self.epoch:
+            r.add_header("X-KF-Fencing-Epoch", str(self.epoch))
         try:
             with self._open(r, timeout=self.timeout) as resp:
+                self._note_epoch(resp.headers.get("X-KF-Fencing-Epoch"))
                 return json.loads(resp.read() or b"null")
         except urllib.error.HTTPError as e:
-            detail = ""
+            payload: dict = {}
             try:
-                detail = json.loads(e.read() or b"{}").get("error", "")
+                payload = json.loads(e.read() or b"{}") or {}
             except (json.JSONDecodeError, OSError):
                 pass
+            detail = payload.get("error", "")
+            self._note_epoch(e.headers.get("X-KF-Fencing-Epoch"))
             if e.code == 404:
                 raise NotFound(detail or path)
             if e.code == 409:
+                if payload.get("reason") == "FencedWrite":
+                    # learn the current epoch from the rejection so the
+                    # caller's retry (after re-resolving the leader) is
+                    # stamped correctly on the first attempt
+                    current = int(payload.get("currentEpoch") or 0)
+                    self._note_epoch(str(current))
+                    raise FencedWrite(detail or path, current_epoch=current)
                 raise Conflict(detail or path)
             if e.code == 410:
                 raise ResourceExpired(detail or path)
@@ -276,9 +339,26 @@ class KubeStore:
         q = f"?namespace={namespace}" if namespace else ""
         return self._req("GET", f"/apis{q}")["kinds"]
 
+    def current_rv(self) -> int:
+        """The server's head resourceVersion (from /apis discovery) — an
+        HTTP follower's lag() is the distance between this and its own
+        applied position, same formula as the in-process mirror."""
+        return int(self._req("GET", "/apis").get("resourceVersion") or 0)
+
     def watch(self, kinds: Iterable[str] | None = None,
-              namespace: str | None = None) -> "_HttpWatch":
-        w = _HttpWatch(self, kinds, namespace)
+              namespace: str | None = None, *,
+              resource_version: int | None = None,
+              known: dict | None = None) -> "_HttpWatch":
+        """Open a watch stream.  ``resource_version`` resumes from a prior
+        position (the server replays the gap, or the client falls back to
+        the informer re-list on 410); ``known`` seeds the last-seen
+        metadata baseline so that re-list can synthesize DELETED events
+        for objects that vanished before this stream ever connected —
+        together they let a follower RESEAT its pump onto a freshly
+        promoted leader without losing the deletes that happened during
+        the failover."""
+        w = _HttpWatch(self, kinds, namespace,
+                       resume_rv=resource_version, known=known)
         self._watches.append(w)
         return w
 
@@ -303,12 +383,12 @@ class _HttpWatch:
     misconfiguration fails fast instead of silently retrying forever.
     """
 
-    RECONNECT_DELAYS = (0.2, 0.5, 1.0, 2.0, 5.0)
     # page size for the reconnect re-list: the server serves consistent
     # pages off one pinned snapshot instead of one huge response
     RELIST_PAGE = 500
 
-    def __init__(self, store: KubeStore, kinds, namespace):
+    def __init__(self, store: KubeStore, kinds, namespace,
+                 resume_rv: int | None = None, known: dict | None = None):
         self._kinds = sorted(set(kinds)) if kinds else None
         self._namespace = namespace
         query = []
@@ -322,18 +402,43 @@ class _HttpWatch:
         self._store = store
         self._queue: queue.Queue = queue.Queue()
         self._stopped = threading.Event()
+        # exponential reconnect/relist backoff with seeded jitter (shared
+        # RNG with the store so one seed fixes the whole client's timing)
+        self._backoff = _Backoff(rng=store._rng)
         # newest resourceVersion observed (events + BOOKMARKs): the
         # reconnect resume point.  None = never connected with a cacheable
-        # position; reconnects fall back to the full re-list.
-        self._resume_rv: int | None = None
+        # position; reconnects fall back to the full re-list.  A caller-
+        # supplied ``resume_rv`` (follower reseat) starts the stream at a
+        # prior position instead of the server's head.
+        self._resume_rv: int | None = resume_rv
         # key -> last-seen metadata for every object this watch observed
         # alive: the baseline that lets a post-reconnect re-list
         # synthesize DELETED for vanished objects.  Metadata (labels,
         # ownerReferences, uid) is cached so the synthesized event carries
         # enough for Controller.requests_for's owner mapping and
         # label-based watch_mappers to derive a Request (ADVICE r4).
-        self._known: dict[tuple, dict] = {}
-        self._resp = self._connect()  # synchronous: config errors raise
+        # ``known`` seeds it on reseat so deletes during a failover are
+        # still synthesized.
+        self._known: dict[tuple, dict] = dict(known or {})
+        # monotonic timestamp of the last stream progress (event or
+        # BOOKMARK): followers call staleness() against it to detect a
+        # leader that is up but no longer advancing (gray partition)
+        self.last_progress_at = store._clock()
+        needs_relist = False
+        try:
+            # synchronous: config errors raise (fail fast)
+            self._resp = self._connect(resume=True)
+        except urllib.error.HTTPError as e:
+            if e.code != 410 or self._resume_rv is None:
+                raise
+            # the requested resume point aged out of the server's window
+            # before we ever connected (long failover): connect at head
+            # and let the pump's first act be the informer re-list
+            WATCH_RESUMES.labels("expired").inc()
+            self._resume_rv = None
+            self._resp = self._connect()
+            needs_relist = True
+        self._needs_relist = needs_relist
         self._connected = False
         self._mark_connected(True)
         self._thread = threading.Thread(target=self._pump, daemon=True)
@@ -366,6 +471,7 @@ class _HttpWatch:
         self._queue.put(ev)
 
     def _note_rv(self, obj: dict) -> None:
+        self.last_progress_at = self._store._clock()
         try:
             rv = int(obj.get("metadata", {}).get("resourceVersion"))
         except (TypeError, ValueError):
@@ -374,11 +480,20 @@ class _HttpWatch:
             self._resume_rv = rv
 
     def _pump(self) -> None:
+        if self._needs_relist:
+            # the constructor's resume point was already expired: sync
+            # the gap before streaming (same as a 410 mid-stream)
+            self._needs_relist = False
+            self._relist()
         while not self._stopped.is_set():
             try:
                 for line in self._resp:
                     if self._stopped.is_set():
                         return
+                    # progress, not just an accepted dial: a flapping
+                    # server that RSTs before sending anything keeps the
+                    # reconnect backoff growing
+                    self._backoff.reset()
                     line = line.strip()
                     if not line or line == b"{}":  # heartbeat
                         continue
@@ -409,7 +524,8 @@ class _HttpWatch:
             WATCH_CONNECTED.set(_CONNECTED_COUNT)
 
     def _reconnect(self) -> bool:
-        """Reopen the stream (backoff, forever until stop()).
+        """Reopen the stream (seeded-jitter exponential backoff, forever
+        until stop()).
 
         RESUME first: reconnect with ``resourceVersion=<last seen>`` so
         the server replays the gap from its watch cache — no re-list, no
@@ -418,10 +534,18 @@ class _HttpWatch:
         informer re-list: synthesize MODIFIED for everything alive and
         DELETED for objects that vanished.  Ordering: the new watch opens
         BEFORE the re-list so no event in between is lost — duplicates
-        are harmless under level-triggered reconcile."""
+        are harmless under level-triggered reconcile.
+
+        The backoff is only re-armed by _pump on stream PROGRESS, so a
+        flapping server (accepts the dial, drops the stream before the
+        first heartbeat) sees the delays keep doubling across reconnect
+        cycles instead of a hot-spinning dial loop."""
         attempt = 0
         resumed = False
         while not self._stopped.is_set():
+            if self._stopped.wait(self._backoff.next()):
+                return False
+            attempt += 1
             try:
                 self._resp = self._connect(resume=True)
                 resumed = self._resume_rv is not None
@@ -429,34 +553,34 @@ class _HttpWatch:
             except urllib.error.HTTPError as e:
                 if e.code == 410 and self._resume_rv is not None:
                     # the window aged past our position: relist instead.
-                    # No backoff — the server is up, it just said so.
+                    # The server is up (it just answered), so re-arm the
+                    # backoff — the next delay is the minimum jitter.
                     WATCH_RESUMES.labels("expired").inc()
                     log.warning("watch resume expired; falling back to "
                                 "re-list", rv=self._resume_rv)
                     self._resume_rv = None
+                    self._backoff.reset()
                     continue
-                delay = self.RECONNECT_DELAYS[
-                    min(attempt, len(self.RECONNECT_DELAYS) - 1)]
-                attempt += 1
-                if self._stopped.wait(delay):
-                    return False
             except (OSError, urllib.error.URLError):
-                delay = self.RECONNECT_DELAYS[
-                    min(attempt, len(self.RECONNECT_DELAYS) - 1)]
-                attempt += 1
-                if self._stopped.wait(delay):
-                    return False
+                pass
         if self._stopped.is_set():
             return False
         WATCH_RECONNECTS.inc()
         self._mark_connected(True)
-        log.info("watch stream reconnected", attempts=attempt + 1,
+        log.info("watch stream reconnected", attempts=attempt,
                  resumed=resumed)
         if resumed:
             # the server replays the missed events in-stream: the gap is
             # covered exactly, no re-list needed
             WATCH_RESUMES.labels("resumed").inc()
             return True
+        self._relist()
+        return True
+
+    def _relist(self) -> None:
+        """The informer re-list: synthesize MODIFIED for every live
+        object and DELETED (vs the _known baseline) for the vanished —
+        the catch-up path when the exact event gap is unrecoverable."""
         alive: set[tuple] = set()
         try:
             if self._kinds is None:
@@ -482,29 +606,32 @@ class _HttpWatch:
                         break
                     except ResourceExpired:
                         # pin evicted mid-walk TWICE (list() already
-                        # retried once) — heavy churn; restart this kind,
+                        # retried once) — heavy churn; back off (seeded
+                        # jitter, not a hot retry) and restart this kind,
                         # never let the error kill the pump thread
                         if attempt == 2:
                             raise
+                        if self._stopped.wait(self._backoff.next()):
+                            return
                 for obj in objs:
                     alive.add(self._key(obj))
                     self._emit(WatchEvent("MODIFIED", obj))
         except (OSError, urllib.error.URLError, NotFound):
             # server flapping again: the pump loop will land back here
-            return True
+            return
         except ResourceExpired as e:
             # churn outran every retry: the stream itself is up, so keep
             # pumping — but the gap sync is lost and must be visible
             log.error("watch re-list kept expiring; events during the "
                       "gap are lost", error=str(e))
-            return True
+            return
         except PermissionError as e:
             # list permission denied (rotated token, watch-but-not-list
             # authorizer): the stream itself is up, so keep pumping — but
             # the gap sync is lost and must be visible
             log.error("watch re-list denied; events during the gap are "
                       "lost", error=str(e))
-            return True
+            return
         for key in set(self._known) - alive:
             kind, ns, name = key
             md = dict(self._known.get(key) or {})
@@ -512,7 +639,6 @@ class _HttpWatch:
             md.setdefault("name", name)
             self._emit(WatchEvent("DELETED", {"kind": kind,
                                               "metadata": md}))
-        return True
 
     def next(self, timeout: float | None = None) -> WatchEvent | None:
         try:

@@ -60,9 +60,13 @@ SIGKILLs a real subprocess at every write boundary the fault layer
 reports and proves recovery of everything acknowledged.
 
 Layout under ``data_dir``:
-    snapshot.json      {"rv": N, "objects": [...]} + ``#crc32:`` footer
+    snapshot.json      {"rv": N, "epoch": E, "objects": [...]} +
+                       ``#crc32:`` footer (``epoch`` absent at 0)
     snapshot.json.bak  the previous snapshot (corruption fallback)
-    wal.jsonl          one ``crc|{"op": ...}`` line per mutation since
+    wal.jsonl          one ``crc|{"op": ...}`` line per mutation since;
+                       records carry the fencing ``epoch`` once a
+                       control plane has elected (legacy epoch-less
+                       records replay as epoch 0; recovery keeps the max)
 
 Records are flushed per append (a liveness-probe restart loses nothing
 acknowledged); fsync per record is opt-in (``fsync=True``) for
@@ -445,8 +449,9 @@ def read_snapshot(path: str, io: FileIO | None = None) -> dict:
         raise SnapshotCorrupt(f"{path}: unparseable snapshot ({e})")
 
 
-def _snapshot_objects(data_dir: str, io: FileIO) -> list[dict]:
-    """Objects from the best available snapshot: the primary when it
+def _snapshot_objects(data_dir: str, io: FileIO) -> tuple[list[dict], int]:
+    """``(objects, fencing_epoch)`` from the best available snapshot
+    (legacy epoch-less snapshots read as epoch 0): the primary when it
     verifies, else ``snapshot.json.bak`` (kept by every compaction until
     the next succeeds) — corruption of BOTH is unrecoverable and raises.
 
@@ -468,11 +473,14 @@ def _snapshot_objects(data_dir: str, io: FileIO) -> list[dict]:
     primary_err: SnapshotCorrupt | None = None
     if os.path.exists(primary):
         try:
-            return read_snapshot(primary, io).get("objects", [])
+            data = read_snapshot(primary, io)
+            return data.get("objects", []), int(data.get("epoch", 0))
         except SnapshotCorrupt as e:
             primary_err = e
     if os.path.exists(bak):
-        objs = read_snapshot(bak, io).get("objects", [])  # may raise too
+        data = read_snapshot(bak, io)  # may raise too
+        objs = data.get("objects", [])
+        epoch = int(data.get("epoch", 0))
         SNAPSHOT_FALLBACKS.inc()
         if primary_err is not None:
             # sideline the corrupt primary BEFORE the boot compaction
@@ -496,30 +504,37 @@ def _snapshot_objects(data_dir: str, io: FileIO) -> list[dict]:
                         "snapshot renames); recovering from "
                         "snapshot.json.bak + its covered segments",
                         objects=len(objs))
-        return objs
+        return objs, epoch
     if primary_err is not None:
         raise primary_err
-    return []
+    return [], 0
 
 
 def _load_records(data_dir: str, io: FileIO | None = None):
-    """Yield ("put", obj) / ("del", (key, rv)) from snapshot (with ``.bak``
-    fallback), then any rotated WAL segments (a crash can leave them
-    mid-compaction; replaying records the snapshot already holds is
-    idempotent), then the live WAL.  Only the LAST existing log may end in
-    a tolerated torn tail; corruption anywhere else fails loud."""
+    """Yield ("put", obj, epoch) / ("del", (key, rv), epoch) from snapshot
+    (with ``.bak`` fallback), then any rotated WAL segments (a crash can
+    leave them mid-compaction; replaying records the snapshot already
+    holds is idempotent), then the live WAL.  Only the LAST existing log
+    may end in a tolerated torn tail; corruption anywhere else fails
+    loud.  ``epoch`` is the fencing epoch stamped on the record (legacy
+    epoch-less records and snapshots read as 0): recovery takes the max,
+    so a mixed-epoch log — records from before and after a failover —
+    rebuilds the fence at the newest leadership it ever acknowledged."""
     io = io or _IO
-    for obj in _snapshot_objects(data_dir, io):
-        yield "put", obj
+    snap_objs, snap_epoch = _snapshot_objects(data_dir, io)
+    for obj in snap_objs:
+        yield "put", obj, snap_epoch
     wal_files = [p for p in _wal_segments(data_dir)
                  + [os.path.join(data_dir, WAL)] if os.path.exists(p)]
     for i, wal_path in enumerate(wal_files):
         for rec in _iter_wal(wal_path, io, tail_ok=i == len(wal_files) - 1):
+            epoch = int(rec.get("epoch", 0))
             if rec.get("op") == "put":
-                yield "put", rec["obj"]
+                yield "put", rec["obj"], epoch
             elif rec.get("op") == "del":
                 # legacy records predate the rv field (treated as rv 0)
-                yield "del", (tuple(rec["key"]), int(rec.get("rv", 0)))
+                yield "del", (tuple(rec["key"]), int(rec.get("rv", 0))), \
+                    epoch
 
 
 def _journal_view(obj: dict) -> dict:
@@ -578,6 +593,13 @@ class Persister:
             # watch clients already hold as resume points
             key, rv = payload
             rec = {"op": "del", "key": list(key), "rv": rv}
+        # fencing epoch rides every record (journal runs under the store
+        # lock, so the read is consistent with the commit it frames);
+        # epoch 0 — no control plane ever elected — stays unstamped so
+        # single-node WALs keep the legacy byte shape
+        epoch = getattr(self.server, "epoch", 0)
+        if epoch:
+            rec["epoch"] = epoch
         if self.degraded:
             # the mutation already committed in memory and will be
             # acknowledged; dropping the record would silently lose
@@ -723,8 +745,14 @@ class Persister:
         ``.bak`` — whose rotated segments are still on disk."""
         snap_path = os.path.join(self.data_dir, SNAPSHOT)
         snap_tmp = snap_path + ".tmp"
-        body = json.dumps({"rv": rv,
-                           "objects": [_journal_view(o) for o in objs]})
+        snap = {"rv": rv, "objects": [_journal_view(o) for o in objs]}
+        # epoch is monotonic, so reading it at write time (possibly off
+        # the store lock) can only over-claim — safe: the snapshot asserts
+        # "this store had seen epoch N", never "these objects are older"
+        epoch = getattr(self.server, "epoch", 0)
+        if epoch:
+            snap["epoch"] = epoch
+        body = json.dumps(snap)
         f = self.io.open(snap_tmp, "w", encoding="utf-8")
         try:
             f.write(body)
@@ -835,9 +863,11 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
 
         objects: dict[tuple, dict] = {}
         max_rv = 0
+        max_epoch = 0
         count = 0
-        for op, payload in _load_records(data_dir, io):
+        for op, payload, rec_epoch in _load_records(data_dir, io):
             count += 1
+            max_epoch = max(max_epoch, rec_epoch)
             if op == "put":
                 try:
                     payload = _versions.to_storage(payload)
@@ -882,6 +912,11 @@ def attach(server: APIServer, data_dir: str, *, fsync: bool = False,
             server._objects.update(objects)
             server._rebuild_index()
             server._rv = max(server._rv, max_rv)
+            # the fence survives restarts: a recovered ex-leader comes
+            # back knowing the newest epoch it ever acknowledged, so a
+            # successor's higher epoch still wins and its own stale
+            # clients still bounce
+            server.epoch = max(getattr(server, "epoch", 0), max_epoch)
             if server.watch_cache is not None:
                 # the replay bypassed the commit stream: a watch cache
                 # attached before recovery must not claim it can replay

@@ -84,6 +84,19 @@ class Conflict(RuntimeError):
     pass
 
 
+class FencedWrite(Conflict):
+    """A write stamped with a stale fencing epoch (or submitted to a
+    self-fenced ex-leader).  Subclasses :class:`Conflict` so callers that
+    only know optimistic concurrency still treat it as a 409, but carries
+    ``current_epoch`` so routers/clients can re-resolve the leader instead
+    of retrying the same doomed write (the DDIA fencing-token recipe: the
+    resource rejects tokens older than the newest it has seen)."""
+
+    def __init__(self, msg: str, current_epoch: int = 0):
+        super().__init__(msg)
+        self.current_epoch = int(current_epoch)
+
+
 class Invalid(ValueError):
     pass
 
@@ -118,6 +131,14 @@ class WatchEvent:
 # kinds that live outside any namespace (mirrors k8s built-ins + our CRDs)
 CLUSTER_SCOPED = {"Namespace", "Profile", "ClusterRole", "PersistentVolume",
                   "Node"}
+
+
+def object_key(kind: str, namespace: str | None, name: str) -> tuple:
+    """Canonical index key for an object — shared by APIServer and the
+    HTTP follower mirror (which has no APIServer to ask)."""
+    if kind in CLUSTER_SCOPED:
+        return (kind, "", name)
+    return (kind, namespace or "default", name)
 
 _MISSING = object()  # sentinel: dotted path absent in a projected object
 
@@ -287,6 +308,54 @@ class APIServer(_LazySnapshots):
         # the window's order matches commit order exactly — the substrate
         # for watch resume, 410 semantics, and read replicas
         self.watch_cache = None
+        # monotonic fencing epoch (core.watchcache.ControlPlane): bumped
+        # by every leadership transfer of the apiserver-leader lease and
+        # stamped into WAL records and proxied writes.  0 = no control
+        # plane has ever claimed this store (single-node bootstrap).
+        self.epoch = 0
+        # self-fence latch: a leader that can no longer prove leadership
+        # (lease lost, every follower heartbeat stale) stops taking
+        # writes entirely rather than risk a split-brain merge
+        self.fenced = False
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the fencing epoch (monotonic; a lower value is a
+        no-op, never a rollback — a delayed message from a dead leader
+        must not regress the fence)."""
+        with self._lock:
+            if epoch > self.epoch:
+                self.epoch = int(epoch)
+
+    def check_epoch(self, write_epoch: int | None) -> None:
+        """Gate a mutation on its stamped fencing epoch.  ``None`` means
+        the writer predates fencing (in-process callers, legacy clients)
+        and is admitted — the fence exists to stop writers that DID go
+        through a deposed leader, not to break bootstrap.  A stamped
+        epoch must match exactly: older = the writer trusts a deposed
+        leader; newer = THIS server is the deposed one and must not ack."""
+        if self.fenced:
+            raise FencedWrite(
+                f"server self-fenced at epoch {self.epoch}; "
+                "re-resolve the leader", current_epoch=self.epoch)
+        if write_epoch is None:
+            return
+        if int(write_epoch) > self.epoch and self.epoch > 0:
+            # a write stamped from the FUTURE proves a newer leadership
+            # was elected while this server wasn't looking (GC pause,
+            # partition): latch the self-fence immediately instead of
+            # waiting for the heartbeat monitor to notice.  An epoch-0
+            # server was never elected, so it only rejects (below) —
+            # a stray stamped client must not brick a fresh store.
+            self.fenced = True
+            raise FencedWrite(
+                f"write stamped epoch {write_epoch} proves this server "
+                f"(epoch {self.epoch}) was deposed; self-fencing",
+                current_epoch=self.epoch)
+        if int(write_epoch) != self.epoch:
+            raise FencedWrite(
+                f"write stamped epoch {write_epoch} but current fencing "
+                f"epoch is {self.epoch}; re-resolve the leader",
+                current_epoch=self.epoch)
 
     def _record(self, op: str, payload) -> None:
         if self._journal is None:
@@ -409,9 +478,7 @@ class APIServer(_LazySnapshots):
 
     # -- helpers --------------------------------------------------------------
     def _key(self, kind: str, namespace: str | None, name: str):
-        if kind in CLUSTER_SCOPED:
-            return (kind, "", name)
-        return (kind, namespace or "default", name)
+        return object_key(kind, namespace, name)
 
     def _next_rv(self) -> str:
         self._rv += 1

@@ -28,14 +28,25 @@ storage/cacher):
     410-on-stale-continue behavior.
 
 ``FollowerCache`` / ``ControlPlane``
-    Horizontal read scale: follower replicas mirror the leader store
-    through a replica watch (initial snapshot sync + rv-compared event
-    application) and serve the whole read surface from their own cache;
-    mutations proxy to the leader.  ``ControlPlane`` elects the leader
-    with the platform's lease election (core.controller.acquire_lease)
-    and keeps renewing it; ``gateway.ControlPlaneRouter`` spreads reads
-    across replicas and pins continue tokens to the replica that minted
-    them.
+    Horizontal read scale AND availability (ARCHITECTURE decision 27):
+    follower replicas mirror the leader store through a replica watch
+    (initial snapshot sync + rv-compared event application) — in-process
+    via the leader's watch cache, or CROSS-HOST via a ``KubeStore`` watch
+    over ``core.net`` (bookmarks, rv resume, 410 relist) — and serve the
+    whole read surface, including ``?watch`` streams and paginated
+    lists, from their OWN window; mutations proxy to the leader.
+    ``ControlPlane`` elects the leader with the platform's lease
+    election (core.controller.acquire_lease), keeps renewing it, and
+    RE-RUNS the election when the renewer loses the lease: the promoted
+    replica takes over the store and the bumped lease epoch becomes the
+    store's fencing epoch, so a deposed leader's writes answer a typed
+    409 (store.FencedWrite) instead of silently merging.  Cross-host
+    promotion is :func:`promote` (persistence recovery + mirror-delta
+    replay + lease steal); :class:`SelfFence` is the deposed side of the
+    same contract (a leader that can no longer see ANY follower
+    heartbeat fences itself).  ``gateway.ControlPlaneRouter`` spreads
+    reads across replicas and pins continue tokens to the replica that
+    minted them.
 """
 
 from __future__ import annotations
@@ -54,11 +65,13 @@ from dataclasses import dataclass
 
 from kubeflow_tpu.core.store import (
     APIServer,
+    FencedWrite,
     Invalid,
     WatchEvent,
     _compile_fields,
     _jcopy,
     _LazySnapshots,
+    object_key,
     snapshot_match,
 )
 from kubeflow_tpu.utils.logging import get_logger
@@ -79,9 +92,28 @@ SCANNED = REGISTRY.counter(
     "apiserver_list_scanned_objects_total",
     "objects examined by paginated list scans (the does-not-rescan "
     "counter: a full paginated read should scan ~once, not once per page)")
+FAILOVERS = REGISTRY.counter(
+    "apiserver_failovers_total",
+    "leadership transfers executed by the control plane (re-election "
+    "after a lost lease, or an explicit cross-host promotion)")
+FENCED_WRITES = REGISTRY.counter(
+    "apiserver_fenced_writes_total",
+    "mutations rejected with the typed 409 for carrying a stale fencing "
+    "epoch (a deposed leader's write that was fenced, never merged)")
+PROMOTION_SECONDS = REGISTRY.histogram(
+    "apiserver_promotion_seconds",
+    "failover trigger to promoted-leader-holds-the-lease latency "
+    "(bounded by a small multiple of the lease TTL)")
+FOLLOWER_WATCHES = REGISTRY.counter(
+    "apiserver_follower_watches_total",
+    "watch streams served from a follower's own window instead of the "
+    "leader", labels=("replica",))
 
 # lease name the apiserver replica set elects its leader under
 APISERVER_LEASE = "apiserver-leader"
+# heartbeat lease each cross-host follower renews in the LEADER's store
+# (SelfFence watches their staleness to detect a partitioned leader)
+FOLLOWER_LEASE_PREFIX = "apiserver-follower-"
 
 # process-wide token-signing secret: shared by every paginator in the
 # process so the router can read a token's origin replica; pins stay
@@ -274,9 +306,13 @@ class _Paginator:
 
 class WatchCache:
     """Per-kind resourceVersion-ordered event windows over one store,
-    plus the leader's paginator.  Construct via :func:`attach`."""
+    plus its paginator.  Construct via :func:`attach` for the leader;
+    a :class:`FollowerCache` hosts its own instance (``origin`` names
+    the hosting replica in minted continue tokens) so followers serve
+    watches and paginated lists without a leader round-trip."""
 
-    def __init__(self, server: APIServer, window: int = 4096):
+    def __init__(self, server, window: int = 4096,
+                 origin: str = "leader"):
         self._server = server
         self.window = max(1, window)
         self._windows: dict[str, deque[CachedEvent]] = {}
@@ -290,7 +326,7 @@ class WatchCache:
         # lock so subscription is atomic with the commit stream
         self._subs: list[tuple] = []
         self.pager = _Paginator(server._snapshot_entry, server.current_rv,
-                                origin="leader")
+                                origin=origin)
 
     # -- commit-side (called under the server's write lock) -------------------
     def _record(self, etype: str, obj: dict) -> None:
@@ -466,54 +502,153 @@ class CacheWatch:
 
 class FollowerCache(_LazySnapshots):
     """A read replica of one leader store: the full read surface
-    (get/list/list_page/project/count/kinds) served from a local mirror
-    fed by a replica watch of the leader's watch cache; every mutation
-    proxies to the leader.  Reads follow the leader within the watch
-    pump's lag — the k8s any-apiserver-may-be-slightly-stale contract.
-    In-process the mirror SHARES object references with the leader
-    (objects are immutable after commit); a cross-host follower would
-    feed the same pump from a KubeStore watch instead.  The scan/filter
-    semantics are the leader's own code (``_LazySnapshots`` +
-    ``scan_snapshot``), not a reimplementation that could drift."""
+    (get/list/list_page/project/count/kinds/WATCH) served from a local
+    mirror fed by a replica watch of the leader; every mutation proxies
+    to the leader.  Reads follow the leader within the watch pump's lag
+    — the k8s any-apiserver-may-be-slightly-stale contract.
 
-    def __init__(self, server: APIServer, name: str = "follower"):
+    Two transports share one pump loop:
+
+    * **in-process** (``server=``): subscribes to the leader's watch
+      cache; the mirror SHARES object references with the leader
+      (objects are immutable after commit).
+    * **cross-host** (``remote=``, a ``KubeStore``): the pump rides the
+      kubeclient watch surface — bookmarks advance the resume point,
+      a dropped stream reconnects with rv resume, a 410 falls back to
+      the informer re-list — so the mirror survives everything the
+      network throws at it.  The follower renews an
+      ``apiserver-follower-<name>`` heartbeat Lease in the leader's
+      store (``heartbeat_ttl``); :class:`SelfFence` on the leader turns
+      those going stale into self-fencing.  ``reseat()`` repoints the
+      pump at a freshly promoted leader, resuming by resourceVersion
+      (with the mirror's metadata as the delete-synthesis baseline).
+
+    Either way the follower hosts its OWN :class:`WatchCache` window
+    over the mirror, so it can serve ``?watch`` streams and paginated
+    lists itself — the leader is not a hop on the follower's read path
+    (decision 27).  The scan/filter semantics are the leader's own code
+    (``_LazySnapshots`` + ``scan_snapshot``), not a reimplementation
+    that could drift."""
+
+    def __init__(self, server: APIServer | None = None,
+                 name: str = "follower", *, remote=None,
+                 window: int = 4096, heartbeat_ttl: float | None = None,
+                 clock=time.monotonic):
+        if (server is None) == (remote is None):
+            raise ValueError(
+                "FollowerCache needs exactly one of server= (in-process) "
+                "or remote= (a KubeStore for the leader)")
         self.name = name
         self._server = server
-        self._cache = attach(server)
+        self._remote = remote
+        self._clock = clock
         self._lock = threading.RLock()
         self._kinds: dict[str, dict[tuple, dict]] = {}
         self._gens: dict[str, int] = {}
         self._snapshots: dict[str, tuple[int, dict]] = {}
         self._applied_rv = 0
         self._stopped = threading.Event()
-        self.pager = _Paginator(self._snapshot_entry, self.current_rv,
-                                origin=name)
-        # subscribe FIRST, then bulk-copy the snapshots: events landing in
-        # between are buffered and the rv compare in _apply makes the
-        # overlap idempotent
-        self._watch = self._cache.watch()
-        for kind in server.kinds():
-            snap = server._snapshot(kind)
+        if remote is None:
+            self._cache = attach(server)
+            # subscribe FIRST, then bulk-copy the snapshots: events
+            # landing in between are buffered and the rv compare in
+            # _apply makes the overlap idempotent
+            self._watch = self._cache.watch()
+            for kind in server.kinds():
+                snap = server._snapshot(kind)
+                with self._lock:
+                    self._kinds[kind] = dict(snap)
+                    self._gens[kind] = self._gens.get(kind, 0) + 1
+            self._applied_rv = self._watch.start_rv
+            self._heartbeat_ttl = 0.0
+        else:
+            self._cache = None
+            # same subscribe-before-list discipline over HTTP: the rv
+            # head is captured after the stream opens, the lists reflect
+            # at-least that rv, and buffered events overlap idempotently
+            self._watch = remote.watch()
+            boot_rv = remote.current_rv()
+            self._bootstrap_http()
             with self._lock:
-                self._kinds[kind] = dict(snap)
-                self._gens[kind] = self._gens.get(kind, 0) + 1
-        self._applied_rv = self._watch.start_rv
+                self._applied_rv = max(self._applied_rv, boot_rv)
+            if heartbeat_ttl is None:
+                from kubeflow_tpu.core.controller import LEASE_TTL
+                heartbeat_ttl = LEASE_TTL
+            self._heartbeat_ttl = float(heartbeat_ttl)
+        self._next_heartbeat = 0.0
+        # the follower's own serve window: attached AFTER bootstrap so
+        # its attach rv == the mirror's baseline (a resume below it
+        # answers 410, exactly as on the leader)
+        self.watch_cache = WatchCache(self, window=window, origin=name)
+        self.pager = self.watch_cache.pager
         self._thread = threading.Thread(target=self._pump, daemon=True,
                                         name=f"{name}-pump")
         self._thread.start()
+        # heartbeats get their OWN thread: a renewal hanging against a
+        # dying/partitioned leader (it blocks for the client timeout)
+        # must never stall event application on the pump
+        self._hb_thread: threading.Thread | None = None
+        if self._remote is not None and self._heartbeat_ttl:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name=f"{name}-heartbeat")
+            self._hb_thread.start()
 
     # -- replication -----------------------------------------------------------
+    def _bootstrap_http(self) -> None:
+        from kubeflow_tpu.core.store import NotFound
+
+        for kind in self._remote.kinds():
+            try:
+                objs = self._remote.list(kind, limit=500)
+            except NotFound:
+                continue  # kind emptied between discovery and list
+            with self._lock:
+                tbl = self._kinds.setdefault(kind, {})
+                for obj in objs:
+                    md = obj.get("metadata", {})
+                    tbl[object_key(obj.get("kind", kind),
+                                   md.get("namespace"),
+                                   md.get("name"))] = obj
+                    try:
+                        rv = int(md.get("resourceVersion") or 0)
+                    except ValueError:
+                        rv = 0
+                    if rv > self._applied_rv:
+                        self._applied_rv = rv
+                self._gens[kind] = self._gens.get(kind, 0) + 1
+
     def _pump(self) -> None:
         while not self._stopped.is_set():
             ev = self._watch.next(timeout=0.2)
             if ev is not None:
                 self._apply(ev)
 
+    def _heartbeat_loop(self) -> None:
+        """Cross-host liveness: renew this follower's heartbeat Lease in
+        the leader's store so the leader's :class:`SelfFence` can tell
+        "my followers are gone" (partitioned => fence myself) apart from
+        "I never had any".  Failures are expected during a partition —
+        that silence IS the signal — so they only log."""
+        from kubeflow_tpu.core.controller import acquire_lease
+
+        while not self._stopped.wait(0.1):
+            now = self._clock()
+            if now < self._next_heartbeat:
+                continue
+            self._next_heartbeat = now + self._heartbeat_ttl / 3
+            remote = self._remote  # reseat swaps it; renew the current one
+            try:
+                acquire_lease(remote, FOLLOWER_LEASE_PREFIX + self.name,
+                              self.name, ttl=self._heartbeat_ttl)
+            except Exception as e:  # noqa: BLE001 — network faults by design
+                log.debug("follower heartbeat failed", follower=self.name,
+                          error=str(e))
+
     def _apply(self, ev: WatchEvent) -> None:
         obj = ev.object
         md = obj.get("metadata", {})
-        key = self._server._key(obj["kind"], md.get("namespace"),
-                                md.get("name"))
+        key = object_key(obj["kind"], md.get("namespace"), md.get("name"))
         try:
             rv = int(md.get("resourceVersion") or 0)
         except ValueError:
@@ -528,23 +663,99 @@ class FollowerCache(_LazySnapshots):
             cur = self._kinds.get(obj["kind"], {}).get(key)
             if cur is not None:
                 cur_rv = int(cur["metadata"].get("resourceVersion") or 0)
-                if rv <= cur_rv:
+                if rv and rv <= cur_rv:
                     return  # stale replay of a state the sync already has
             if ev.type == "DELETED":
                 self._kinds.get(obj["kind"], {}).pop(key, None)
             else:
                 self._kinds.setdefault(obj["kind"], {})[key] = obj
             self._gens[obj["kind"]] = self._gens.get(obj["kind"], 0) + 1
+            wc = getattr(self, "watch_cache", None)
+            if wc is not None:
+                if rv:
+                    # feed the follower's own serve window under the same
+                    # lock the window's watch() takes: commit order ==
+                    # window order, exactly the leader's invariant
+                    wc._record(ev.type, obj)
+                else:
+                    # a synthesized re-list event (no rv) means the exact
+                    # gap is unrecoverable: poison resumes across it
+                    # rather than silently replaying nothing
+                    wc._reset(self._applied_rv)
 
     def lag(self) -> int:
         """Leader rv minus the newest rv this replica has applied — 0
-        means caught up."""
-        return max(0, self._server.current_rv() - self._applied_rv)
+        means caught up.  Costs one discovery round-trip cross-host."""
+        head = (self._remote.current_rv() if self._remote is not None
+                else self._server.current_rv())
+        return max(0, head - self._applied_rv)
+
+    def staleness(self) -> float:
+        """Seconds since the replica watch last made progress (an event
+        or a BOOKMARK).  A cross-host follower uses this to detect a
+        leader that is reachable but no longer advancing — the gray
+        partition a dead-TCP-connection check misses.  In-process
+        followers share the leader's fate, so always 0."""
+        if self._remote is None:
+            return 0.0
+        last = getattr(self._watch, "last_progress_at", None)
+        if last is None:
+            return 0.0
+        return max(0.0, self._clock() - last)
+
+    def reseat(self, remote) -> None:
+        """Repoint a cross-host follower's pump at a different leader
+        (failover).  Resumes by resourceVersion — the new leader replays
+        the gap from its window, or answers 410 and the kubeclient
+        re-list (seeded with this mirror's metadata baseline) converges
+        the mirror, synthesizing DELETED for anything that vanished
+        across the failover.  When the new leader's history is BEHIND
+        this mirror (it recovered from an older snapshot and our extra
+        state was never durable on the surviving timeline), the mirror
+        re-bootstraps from scratch instead of keeping ghosts."""
+        if self._remote is None:
+            raise RuntimeError("reseat() applies to cross-host followers")
+        old_watch = self._watch
+        head = remote.current_rv()
+        with self._lock:
+            resume = self._applied_rv if self._applied_rv <= head else 0
+            known: dict[tuple, dict] = {}
+            if resume:
+                for kind, objs in self._kinds.items():
+                    for obj in objs.values():
+                        md = obj.get("metadata", {})
+                        known[(kind, md.get("namespace"),
+                               md.get("name"))] = {
+                            k: md[k] for k in
+                            ("namespace", "name", "uid", "labels",
+                             "ownerReferences") if k in md}
+            else:
+                self._kinds.clear()
+                self._snapshots.clear()
+                for kind in list(self._gens):
+                    self._gens[kind] += 1
+                self._applied_rv = 0
+                self.watch_cache._reset(0)
+            self._remote = remote
+        if resume:
+            self._watch = remote.watch(resource_version=resume,
+                                       known=known)
+        else:
+            self._watch = remote.watch()
+            self._bootstrap_http()
+            with self._lock:
+                self.watch_cache._reset(self._applied_rv)
+        self._next_heartbeat = 0.0  # announce ourselves to the new leader
+        old_watch.stop()
+        log.info("follower reseated", follower=self.name,
+                 resumed_rv=resume or None)
 
     def close(self) -> None:
         self._stopped.set()
         self._watch.stop()
         self._thread.join(timeout=5)
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
 
     # -- read surface (the leader's own code paths) ----------------------------
     def current_rv(self) -> int:
@@ -558,7 +769,7 @@ class FollowerCache(_LazySnapshots):
             ) -> dict:
         from kubeflow_tpu.core.store import NotFound
 
-        key = self._server._key(kind, namespace, name)
+        key = object_key(kind, namespace, name)
         obj = self._kinds.get(kind, {}).get(key)
         if obj is None:
             raise NotFound(f"{kind} {namespace}/{name} not found")
@@ -586,28 +797,65 @@ class FollowerCache(_LazySnapshots):
         return compute()
 
     # -- mutations proxy to the leader ----------------------------------------
+    @property
+    def _leader_store(self):
+        return self._remote if self._remote is not None else self._server
+
     def create(self, obj: dict) -> dict:
-        return self._server.create(obj)
+        return self._leader_store.create(obj)
 
     def update(self, obj: dict) -> dict:
-        return self._server.update(obj)
+        return self._leader_store.update(obj)
 
     def patch_status(self, kind: str, name: str, namespace: str | None,
                      status: dict) -> dict:
-        return self._server.patch_status(kind, name, namespace, status)
+        return self._leader_store.patch_status(kind, name, namespace,
+                                               status)
 
     def delete(self, kind: str, name: str, namespace: str | None = None,
                **kwargs) -> None:
-        return self._server.delete(kind, name, namespace, **kwargs)
+        return self._leader_store.delete(kind, name, namespace, **kwargs)
 
     def watch(self, kinds=None, namespace=None, resource_version=None):
-        # watches are served by the leader's window (a follower-local
-        # window would just mirror it one hop later)
-        return self._server.watch(kinds=kinds, namespace=namespace,
-                                  resource_version=resource_version)
+        # served from the follower's OWN window (decision 27): a watch
+        # client keeps streaming from this replica even when the leader
+        # is down, and the leader pays zero fan-out for follower-side
+        # watchers.  Resume semantics are the leader's code — below the
+        # local window answers the same 410.
+        FOLLOWER_WATCHES.labels(self.name).inc()  # kfvet: ignore[metric-label-cardinality] — followers are a bounded roster
+        return self.watch_cache.watch(kinds=kinds, namespace=namespace,
+                                      resource_version=resource_version)
+
+    @property
+    def epoch(self) -> int:
+        """The newest fencing epoch this replica knows (the leader's own
+        for in-process replicas, the learned response-header epoch for
+        cross-host ones) — stamped by the remote KubeStore onto every
+        proxied write."""
+        if self._remote is not None:
+            return getattr(self._remote, "epoch", 0)
+        return getattr(self._server, "epoch", 0)
+
+    def check_epoch(self, write_epoch: int | None) -> None:
+        """The follower-side fencing gate (httpapi calls this before
+        proxying any mutation): a client still stamping a PRIOR leader's
+        epoch gets the typed 409 here, without burning a round-trip to
+        the leader that would reject it anyway."""
+        if write_epoch is None:
+            return
+        current = self.epoch
+        if current and int(write_epoch) != current:
+            raise FencedWrite(
+                f"write stamped epoch {write_epoch} but current fencing "
+                f"epoch is {current}; re-resolve the leader",
+                current_epoch=current)
 
     @property
     def degraded(self) -> bool:
+        if self._remote is not None:
+            # a cross-host follower cannot cheaply know the leader's
+            # journal state; proxied writes surface the leader's own 503
+            return False
         return getattr(self._server, "degraded", False)
 
     def register_mutating_hook(self, hook) -> None:
@@ -626,28 +874,48 @@ class Replica:
 class ControlPlane:
     """N apiserver replicas over one backing store: the replica that wins
     the ``apiserver-leader`` lease serves the store directly (and keeps
-    renewing the lease); every other replica is a :class:`FollowerCache`.
-    Route through ``gateway.ControlPlaneRouter``."""
+    renewing the lease); every other replica is a :class:`FollowerCache`
+    — in-process by default, cross-host over HTTP when ``remote_url``
+    points at the leader's served REST facade (then the replica pumps
+    ride the network through ``net``, faultable by chaos.netfault).
+    Route through ``gateway.ControlPlaneRouter``.
+
+    Losing the lease re-runs the election (``_failover``): the winner
+    takes over the store, the lease's transfer-bumped epoch becomes the
+    store's fencing epoch, and ``generation`` ticks so routers drop any
+    pinned leader."""
 
     def __init__(self, server: APIServer, replicas: int = 1,
                  identity_prefix: str = "apiserver",
-                 lease: str = APISERVER_LEASE):
-        from kubeflow_tpu.core.controller import acquire_lease
+                 lease: str = APISERVER_LEASE,
+                 lease_ttl: float | None = None,
+                 remote_url: str | None = None, net=None,
+                 clock=time.monotonic, sleep=time.sleep):
+        from kubeflow_tpu.core.controller import (LEASE_TTL, acquire_lease,
+                                                  lease_epoch)
 
         self.server = server
         self.cache = attach(server)
         self._lease = lease
+        self._ttl = float(lease_ttl) if lease_ttl else LEASE_TTL
+        self._clock = clock
+        self._sleep = sleep
         self._stop = threading.Event()
+        self._remotes: list = []  # KubeStores this plane built (closed
+        # with the plane; reseated followers may hold others)
+        self.generation = 0  # bumps on every leadership change
         self.replicas: list[Replica] = []
         leader: Replica | None = None
         for i in range(max(1, replicas)):
             name = f"{identity_prefix}-{i}"
-            if leader is None and acquire_lease(server, lease, name):
+            if leader is None and acquire_lease(server, lease, name,
+                                                ttl=self._ttl):
                 leader = Replica(name, server, True)
                 self.replicas.append(leader)
             else:
                 self.replicas.append(
-                    Replica(name, FollowerCache(server, name), False))
+                    Replica(name, self._build_follower(name, remote_url,
+                                                       net), False))
         if leader is None:
             # failed election must not orphan the followers already
             # built: each one holds a pump thread and a live cache
@@ -658,19 +926,84 @@ class ControlPlane:
             raise RuntimeError(
                 f"no replica could acquire the {lease!r} lease")
         self.leader = leader
+        # the lease's epoch (bumped iff holdership transferred) IS the
+        # store's fencing epoch from here on
+        server.set_epoch(lease_epoch(server, lease))
         server.control_plane = self  # the dashboard's discovery hook
         self._renewer = threading.Thread(target=self._renew, daemon=True,
                                          name="apiserver-lease")
         self._renewer.start()
 
-    def _renew(self) -> None:
-        from kubeflow_tpu.core.controller import LEASE_TTL, acquire_lease
+    def _build_follower(self, name: str, remote_url: str | None, net):
+        if remote_url is None:
+            return FollowerCache(self.server, name)
+        from kubeflow_tpu.core.kubeclient import KubeStore
 
-        while not self._stop.wait(LEASE_TTL / 3):
-            if not acquire_lease(self.server, self._lease,
-                                 self.leader.name):
-                log.warning("apiserver leader lease renewal failed",
-                            holder=self.leader.name)
+        remote = KubeStore(remote_url, net=net, seed=len(self._remotes))
+        self._remotes.append(remote)
+        return FollowerCache(name=name, remote=remote,
+                             heartbeat_ttl=self._ttl, clock=self._clock)
+
+    def _renew(self) -> None:
+        from kubeflow_tpu.core.controller import acquire_lease, lease_epoch
+
+        while not self._stop.wait(self._ttl / 3):
+            if acquire_lease(self.server, self._lease, self.leader.name,
+                             ttl=self._ttl):
+                # a steal-BACK of an expired lease bumps its epoch even
+                # with the same plane leader; adopt it (max-only, so a
+                # plain same-holder renewal is a no-op)
+                self.server.set_epoch(lease_epoch(self.server,
+                                                  self._lease))
+                continue
+            # one quick retry before declaring the leader deposed: a
+            # single Conflict can be a racing reader, not a lost lease
+            if self._stop.wait(min(1.0, self._ttl / 10)):
+                return
+            if acquire_lease(self.server, self._lease, self.leader.name,
+                             ttl=self._ttl):
+                continue
+            log.warning("apiserver leader lost the lease; re-running "
+                        "election", holder=self.leader.name)
+            self._failover()
+
+    def _failover(self) -> None:
+        """Re-run the lease election and promote the winner.  Followers
+        are tried first (the deposed leader last — it just proved it
+        cannot hold the lease); whoever wins takes over the backing
+        store, the transfer-bumped lease epoch is adopted as the fencing
+        epoch, and the deposed leader is demoted to a follower.  Loops
+        until a replica wins or the plane is closed — the lease may be
+        held by an outside identity until its TTL expires, and that wait
+        is exactly the promotion-latency bound load_ha gates on."""
+        from kubeflow_tpu.core.controller import acquire_lease, lease_epoch
+
+        t0 = self._clock()
+        old = self.leader
+        while not self._stop.is_set():
+            for r in self.followers() + [old]:
+                if not acquire_lease(self.server, self._lease, r.name,
+                                     ttl=self._ttl):
+                    continue
+                if r is not old:
+                    r.store.close()
+                    r.store = self.server
+                    r.is_leader = True
+                    old.is_leader = False
+                    old.store = FollowerCache(self.server, old.name)
+                    self.leader = r
+                self.server.set_epoch(lease_epoch(self.server,
+                                                  self._lease))
+                self.generation += 1
+                FAILOVERS.inc()
+                PROMOTION_SECONDS.observe(
+                    max(0.0, self._clock() - t0))
+                log.info("apiserver leader elected", leader=r.name,
+                         epoch=self.server.epoch,
+                         failover=r is not old)
+                return
+            if self._stop.wait(self._ttl / 3):
+                return
 
     def followers(self) -> list[Replica]:
         return [r for r in self.replicas if not r.is_leader]
@@ -678,21 +1011,23 @@ class ControlPlane:
     def wait_synced(self, timeout: float = 30.0) -> bool:
         """Block until every follower has applied the leader's newest rv
         (loadtests call this before digest-comparing replicas)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
             if all(r.store.lag() == 0 for r in self.followers()):
                 return True
-            time.sleep(0.01)
+            self._sleep(0.01)
         return False
 
     def state(self) -> list[dict]:
         """Replica standing for the dashboard's control-plane card."""
         out = []
+        epoch = getattr(self.server, "epoch", 0)
         for r in self.replicas:
-            row = {"name": r.name, "leader": r.is_leader}
+            row = {"name": r.name, "leader": r.is_leader, "epoch": epoch}
             if not r.is_leader:
                 row["lag"] = r.store.lag()
                 row["applied_rv"] = r.store.current_rv()
+                row["watches_served"] = FOLLOWER_WATCHES.get(r.name)
             out.append(row)
         return out
 
@@ -701,8 +1036,159 @@ class ControlPlane:
         self._renewer.join(timeout=5)
         for r in self.followers():
             r.store.close()
+        for remote in self._remotes:
+            remote.close()
         from kubeflow_tpu.core.controller import release_lease
 
         release_lease(self.server, self._lease, self.leader.name)
         if getattr(self.server, "control_plane", None) is self:
             self.server.control_plane = None
+
+
+def promote(follower: FollowerCache, *, data_dir: str | None = None,
+            lease: str = APISERVER_LEASE, lease_ttl: float | None = None,
+            identity: str | None = None, timeout: float | None = None,
+            io=None, clock=time.monotonic, sleep=time.sleep) -> APIServer:
+    """Cross-host promotion: stand up a NEW leader from a follower's
+    mirror (decision 27's promotion protocol).
+
+    1. **Recover** — when ``data_dir`` (the dead leader's surviving data
+       dir, or a fresh one for the new leader) is given, replay its WAL/
+       snapshot: every fsynced ack and the old fencing epoch survive.
+    2. **Mirror-delta replay** — upsert every mirror object NEWER than
+       the recovered rv (the follower may have applied acks whose WAL
+       tail was lost); journal each so they are durable on the new
+       timeline.  Objects at or below the recovered rv are already
+       correct in the recovery — including their deletions — so they
+       are never resurrected from the mirror.
+    3. **Win the lease** — loop ``acquire_lease`` until the recovered
+       lease's TTL expires; that wait is the promotion-latency bound.
+       The steal bumps the lease epoch past every number the dead
+       leader ever held.
+    4. **Fence** — adopt the bumped epoch as the store's fencing epoch:
+       any write still stamped with the old epoch (a paused/partitioned
+       ex-leader flushing its queue) answers the typed 409.
+
+    Returns the new leader APIServer with a watch cache attached;
+    remaining followers ``reseat()`` onto it.
+    """
+    from kubeflow_tpu.core.controller import (LEASE_TTL, acquire_lease,
+                                              lease_epoch)
+
+    ttl = float(lease_ttl) if lease_ttl else LEASE_TTL
+    t0 = clock()
+    new = APIServer()
+    if data_dir is not None:
+        from kubeflow_tpu.core import persistence
+        kw = {"io": io} if io is not None else {}
+        persistence.attach(new, data_dir, **kw)
+    attach(new)
+    with follower._lock:
+        mirror = {kind: dict(objs)
+                  for kind, objs in follower._kinds.items()}
+        mirror_rv = follower._applied_rv
+    replayed = 0
+    with new._lock:
+        recovered_rv = new._rv
+        for kind, objs in mirror.items():
+            for key, obj in objs.items():
+                try:
+                    rv = int(obj["metadata"].get("resourceVersion") or 0)
+                except ValueError:
+                    rv = 0
+                if rv <= recovered_rv:
+                    continue  # recovery already has this state (or its
+                    # deletion) — never resurrect from the mirror
+                cur = new._objects.get(key)
+                if cur is not None and rv <= int(
+                        cur["metadata"].get("resourceVersion") or 0):
+                    continue
+                new._objects[key] = _jcopy(obj)
+                new._record("put", new._objects[key])
+                replayed += 1
+        new._rebuild_index()
+        new._rv = max(new._rv, mirror_rv)
+        if new.watch_cache is not None:
+            # bulk load bypassed the commit stream: resumes across it
+            # must relist, not silently replay nothing
+            new.watch_cache._reset(new._rv)
+    identity = identity or f"{follower.name}-promoted"
+    deadline = clock() + (timeout if timeout is not None else 4 * ttl)
+    while not acquire_lease(new, lease, identity, ttl=ttl):
+        if clock() >= deadline:
+            raise RuntimeError(
+                f"promotion of {follower.name!r} could not win the "
+                f"{lease!r} lease before the deadline")
+        sleep(min(0.05, ttl / 10))
+    new.set_epoch(lease_epoch(new, lease))
+    FAILOVERS.inc()
+    PROMOTION_SECONDS.observe(max(0.0, clock() - t0))
+    log.info("follower promoted to leader", follower=follower.name,
+             identity=identity, epoch=new.epoch,
+             recovered_rv=recovered_rv, mirror_rv=mirror_rv,
+             mirror_replayed=replayed)
+    return new
+
+
+class SelfFence:
+    """The deposed-leader side of the fencing contract: a leader that
+    serves cross-host followers watches their heartbeat Leases
+    (``apiserver-follower-*``, renewed by each FollowerCache pump) and
+    FENCES ITSELF — ``server.fenced = True``, every later mutation
+    answers the typed 409 — once EVERY heartbeat has gone stale past
+    ``ttl``.  A leader that cannot see any follower cannot tell "they
+    all crashed" from "I am on the minority side of a partition", and
+    only the second is survivable by continuing to serve; fencing is
+    the safe answer to both (Chubby's \"stop acting as master\").  The
+    latch is permanent for this process — a fenced ex-leader rejoins as
+    a follower of whoever was promoted, it never un-fences itself.
+
+    ``clock`` must be the wall clock the lease renewTimes were stamped
+    with (``time.time`` in production; tests inject)."""
+
+    def __init__(self, server: APIServer, *, ttl: float | None = None,
+                 interval: float | None = None, clock=time.time):
+        from kubeflow_tpu.core.controller import LEASE_TTL
+
+        self.server = server
+        self.ttl = float(ttl) if ttl else LEASE_TTL
+        self.interval = interval if interval is not None else self.ttl / 3
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "SelfFence":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="apiserver-selffence")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.check()
+
+    def check(self) -> bool:
+        """One evaluation (the thread calls this on ``interval``; tests
+        call it directly).  Returns the fenced state."""
+        if self.server.fenced:
+            return True
+        heartbeats = [
+            obj for obj in self.server.list("Lease",
+                                            namespace="kube-system")
+            if obj["metadata"]["name"].startswith(FOLLOWER_LEASE_PREFIX)]
+        if not heartbeats:
+            return False  # never had followers: nothing to lose quorum of
+        now = self._clock()
+        if all(now - float(h["spec"].get("renewTime") or 0) >= self.ttl
+               for h in heartbeats):
+            self.server.fenced = True
+            log.warning("leader self-fenced: every follower heartbeat "
+                        "is stale", followers=len(heartbeats),
+                        ttl=self.ttl)
+            return True
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -179,8 +179,10 @@ class DashboardApp(CrudApp):
     def control_plane_route(self, req: Request):
         """Control-plane-scale standing (the watch-cache card): event
         window sizes/floors, watch-resume outcomes, paginated-list
-        latency + scanned-objects counter, and apiserver replica
-        leadership/lag."""
+        latency + scanned-objects counter, apiserver replica
+        leadership/lag, and the HA block — fencing epoch/latch, failover
+        and fenced-write counters, promotion latency p99, per-follower
+        serve counts."""
         return "200 OK", self.metrics.get_control_plane_state()
 
     def query_route(self, req: Request):

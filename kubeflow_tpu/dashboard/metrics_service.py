@@ -254,6 +254,27 @@ def control_plane_state(server) -> dict:
         "watches_connected": val("kubeclient_watches_connected"),
         "watch_reconnects": val("kubeclient_watch_reconnects_total"),
     }
+    promo = REGISTRY.get_metric("apiserver_promotion_seconds")
+    serves = REGISTRY.get_metric("apiserver_follower_watches_total")
+    reqs = REGISTRY.get_metric("gateway_apiserver_requests_total")
+    state["ha"] = {
+        # the fence: which leadership epoch this server believes in, and
+        # whether it has latched itself out of the write path
+        "fencing_epoch": int(getattr(server, "epoch", 0)),
+        "fenced": bool(getattr(server, "fenced", False)),
+        "failovers": val("apiserver_failovers_total"),
+        "fenced_writes": val("apiserver_fenced_writes_total"),
+        "promotion_p99_s": promo.percentile(99) if promo else 0.0,
+        # per-replica serve counts: watches answered from a follower's
+        # own window, and routed requests by (replica, verb)
+        "follower_watches": ({name: count for (name,), count
+                              in serves.series().items()}
+                             if serves is not None else {}),
+        "replica_requests": ({f"{replica}/{verb}": count
+                              for (replica, verb), count
+                              in reqs.series().items()}
+                             if reqs is not None else {}),
+    }
     plane = getattr(server, "control_plane", None)
     if plane is not None:
         state["replicas"] = plane.state()

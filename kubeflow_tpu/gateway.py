@@ -1615,41 +1615,66 @@ class ControlPlaneRouter:
     always go to the lease holder: k8s gets are quorum reads, and a
     follower-served get would break read-your-writes for the very
     caller that just created the object (create → get → NotFound).
-    Watches go to the leader too (followers are read replicas, not
-    event sources).  A paginated
+    Watches round-robin across the replica set too — every follower
+    serves streams from its OWN window (ARCHITECTURE decision 27); a
+    resume only the leader's deeper window can replay falls back there
+    before answering 410.  A paginated
     list's continue token is STICKY to the replica that minted it (the
     pinned snapshot lives in that replica's memory); a token landing on
     a dead or wrong replica answers ResourceExpired and the client
     restarts the list, exactly the k8s stale-continue contract.
 
+    The leader is RESOLVED PER CALL from the plane, never pinned at
+    construction: after a failover the router follows ``plane.leader``
+    to the promoted replica instead of routing writes at the deposed
+    one forever.  A mutation that still catches the transfer mid-flight
+    (typed FencedWrite 409, or the dying leader's socket erroring) is
+    retried ONCE against the freshly resolved leader, paid for from a
+    ``resilience.RetryBudget`` so a persistent fencing loop degrades
+    into surfaced errors instead of a retry storm.
+
     Duck-types the store surface, so ``core.httpapi.RestAPI`` and the
     dashboard serve a replica set unchanged: RestAPI(ControlPlaneRouter(
     ControlPlane(server, replicas=3))) is a 3-replica apiserver."""
 
-    def __init__(self, plane):
+    def __init__(self, plane, retry_budget=None):
         import threading
 
+        from kubeflow_tpu.resilience import RetryBudget
+
         self._plane = plane
-        self._replicas = list(plane.replicas)
-        self._leader = plane.leader
+        self._budget = (retry_budget if retry_budget is not None
+                        else RetryBudget())
+        self._rr_lock = threading.Lock()
+        self._rr = 0
         # continue tokens embed the MINTING paginator's origin (the pin
         # lives in that replica's memory) — map origins, not replica
         # names: the leader's paginator says "leader", followers say
-        # their replica name
-        from kubeflow_tpu.core import watchcache
-
-        self._by_origin = {}
-        for r in self._replicas:
-            self._by_origin[watchcache.pager_for(r.store).origin] = r
-        self._rr_lock = threading.Lock()
-        self._rr = 0
+        # their replica name.  Cached per plane generation: a failover
+        # swaps stores underneath the replicas, so the map is rebuilt
+        # the first routing decision after promotion.
+        self._by_origin: dict | None = None
+        self._origin_gen = -1
 
     # -- picks -----------------------------------------------------------------
     def _pick(self):
+        replicas = self._plane.replicas
         with self._rr_lock:
-            r = self._replicas[self._rr % len(self._replicas)]
+            r = replicas[self._rr % len(replicas)]
             self._rr += 1
         return r
+
+    def _origin_map(self) -> dict:
+        from kubeflow_tpu.core import watchcache
+
+        gen = getattr(self._plane, "generation", 0)
+        with self._rr_lock:
+            if self._by_origin is None or self._origin_gen != gen:
+                self._by_origin = {
+                    watchcache.pager_for(r.store).origin: r
+                    for r in self._plane.replicas}
+                self._origin_gen = gen
+            return self._by_origin
 
     def _read(self, verb: str, *args, **kwargs):
         r = self._pick()
@@ -1658,8 +1683,21 @@ class ControlPlaneRouter:
         return getattr(r.store, verb)(*args, **kwargs)
 
     def _on_leader(self, verb: str, *args, **kwargs):
-        APISERVER_REQS.labels(self._leader.name, verb).inc()  # kfvet: ignore[metric-label-cardinality]
-        return getattr(self._leader.store, verb)(*args, **kwargs)
+        from kubeflow_tpu.core.store import FencedWrite
+
+        self._budget.note_request()
+        leader = self._plane.leader  # resolved per call, never pinned
+        APISERVER_REQS.labels(leader.name, verb).inc()  # kfvet: ignore[metric-label-cardinality]
+        try:
+            return getattr(leader.store, verb)(*args, **kwargs)
+        except (FencedWrite, ConnectionError, OSError):
+            current = self._plane.leader
+            if current is leader or not self._budget.try_take():
+                raise
+            # leadership moved between resolve and dispatch: one retry
+            # at the promoted leader, withdrawn from the retry budget
+            APISERVER_REQS.labels(current.name, verb).inc()  # kfvet: ignore[metric-label-cardinality]
+            return getattr(current.store, verb)(*args, **kwargs)
 
     # -- read surface ----------------------------------------------------------
     def get(self, *args, **kwargs):
@@ -1686,20 +1724,20 @@ class ControlPlaneRouter:
         cont = kw.get("continue_")
         r = None
         if cont:
-            r = self._by_origin.get(watchcache.continue_origin(cont) or "")
+            r = self._origin_map().get(watchcache.continue_origin(cont) or "")
         if r is None:
             r = self._pick()
         APISERVER_REQS.labels(r.name, "list_page").inc()  # kfvet: ignore[metric-label-cardinality]
         return watchcache.list_page_fn(r.store)(kind, **kw)
 
     def generation(self, kind: str) -> int:
-        return self._leader.store.generation(kind)
+        return self._plane.leader.store.generation(kind)
 
     def memo(self, kind: str, key, compute):
-        return self._leader.store.memo(kind, key, compute)
+        return self._plane.leader.store.memo(kind, key, compute)
 
     def current_rv(self) -> int:
-        return self._leader.store.current_rv()
+        return self._plane.leader.store.current_rv()
 
     # -- mutations + watch: leader only ---------------------------------------
     def create(self, *args, **kwargs):
@@ -1715,19 +1753,44 @@ class ControlPlaneRouter:
         return self._on_leader("delete", *args, **kwargs)
 
     def watch(self, kinds=None, namespace=None, resource_version=None):
-        APISERVER_REQS.labels(self._leader.name, "watch").inc()  # kfvet: ignore[metric-label-cardinality]
-        return self._leader.store.watch(kinds=kinds, namespace=namespace,
-                                        resource_version=resource_version)
+        from kubeflow_tpu.core.watchcache import ResourceExpired
+
+        # watch affinity (decision 27): followers serve streams from
+        # their own windows, so watches fan out like scans instead of
+        # funnelling into the leader
+        r = self._pick()
+        APISERVER_REQS.labels(r.name, "watch").inc()  # kfvet: ignore[metric-label-cardinality]
+        try:
+            return r.store.watch(kinds=kinds, namespace=namespace,
+                                 resource_version=resource_version)
+        except ResourceExpired:
+            leader = self._plane.leader
+            if r is leader or resource_version is None:
+                raise
+            # a follower's window starts at its bootstrap — a resume it
+            # can't replay may still live in the leader's deeper window
+            APISERVER_REQS.labels(leader.name, "watch").inc()  # kfvet: ignore[metric-label-cardinality]
+            return leader.store.watch(kinds=kinds, namespace=namespace,
+                                      resource_version=resource_version)
 
     def register_mutating_hook(self, hook) -> None:
-        self._leader.store.register_mutating_hook(hook)
+        self._plane.leader.store.register_mutating_hook(hook)
 
     def register_validating_hook(self, hook) -> None:
-        self._leader.store.register_validating_hook(hook)
+        self._plane.leader.store.register_validating_hook(hook)
+
+    @property
+    def epoch(self) -> int:
+        return getattr(self._plane.leader.store, "epoch", 0)
+
+    def check_epoch(self, write_epoch) -> None:
+        check = getattr(self._plane.leader.store, "check_epoch", None)
+        if check is not None:
+            check(write_epoch)
 
     @property
     def degraded(self) -> bool:
-        return getattr(self._leader.store, "degraded", False)
+        return getattr(self._plane.leader.store, "degraded", False)
 
     @property
     def watch_cache(self):

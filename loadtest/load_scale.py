@@ -55,7 +55,7 @@ def pod_name(gang: str, i: int) -> str:
 
 def run_once(n_pods: int, n_gangs: int, *, page: int, churn: int,
              replicas: int, workers: int, seed: int, budget: float,
-             window: int = 8192) -> dict:
+             window: int = 8192, http_followers: bool = False) -> dict:
     from kubeflow_tpu.controllers import scheduler  # noqa: F401 (import parity)
     from kubeflow_tpu.core import (APIServer, Controller, Manager, Request,
                                    Result, api_object, owner_ref)
@@ -108,7 +108,20 @@ def run_once(n_pods: int, n_gangs: int, *, page: int, churn: int,
 
     server = APIServer()
     cache = watchcache.attach(server, window=window)
-    plane = watchcache.ControlPlane(server, replicas=replicas)
+    httpd = None
+    if http_followers:
+        # cross-host shape (ISSUE 20): followers mirror the leader over
+        # the REST wire instead of the in-process commit stream — same
+        # assertions, so the digest gate proves the HTTP watch surface
+        # (bookmarks, rv resume, 410 relist) is transparent at scale
+        from kubeflow_tpu.core.httpapi import RestAPI, serve
+
+        httpd, _ = serve(RestAPI(server), 0)
+        plane = watchcache.ControlPlane(
+            server, replicas=replicas,
+            remote_url=f"http://127.0.0.1:{httpd.server_address[1]}")
+    else:
+        plane = watchcache.ControlPlane(server, replicas=replicas)
     router = ControlPlaneRouter(plane)
     tracker = GangTracker(server)
     mgr = Manager(server)
@@ -290,9 +303,13 @@ def run_once(n_pods: int, n_gangs: int, *, page: int, churn: int,
     mgr.stop()
     w_cont.stop()
     plane.close()
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
 
     result = {
         "pods": total_pods, "gangs": n_gangs, "replicas": replicas,
+        "transport": "http" if http_followers else "in-process",
         "workers": workers,
         "populate_s": round(populate_s, 3),
         "creates_per_s": round((total_pods + n_gangs) / populate_s, 1),
@@ -354,6 +371,16 @@ def main() -> int:
                   for w in sweep[1:]]
     if len({r["digest"] for r in by_replicas + by_workers}) != 1:
         print("FAIL: state digest differs across worker counts")
+        return 1
+    # cross-host followers over HTTP must land on the identical digest —
+    # the wire (bookmarks, resume, pagination) adds no divergence
+    over_http = run_once(n_pods, n_gangs, page=page, churn=churn,
+                         replicas=max(replica_counts),
+                         workers=base_workers, seed=args.seed,
+                         budget=budget, window=window,
+                         http_followers=True)
+    if over_http["digest"] != by_replicas[0]["digest"]:
+        print("FAIL: HTTP-follower digest diverged from in-process")
         return 1
     worst = max(r["reconcile_p99_s"] for r in by_replicas + by_workers)
     print(f"state bit-identical across {replica_counts} replicas and "

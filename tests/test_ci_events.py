@@ -48,6 +48,22 @@ def test_resilience_workflow_runs_partition_smoke():
     assert "tests/test_netfault.py" in steps["test"]["run"]
 
 
+def test_resilience_workflow_runs_ha_smoke():
+    """ISSUE 20: the resilience component owns the HA failover storm —
+    editing the control-plane HA surfaces routes to it, and the
+    workflow runs load_ha --smoke gated behind the shared test step."""
+    assert "resilience" in changed_components(
+        ["kubeflow_tpu/core/watchcache.py"])
+    assert "resilience" in changed_components(["loadtest/load_ha.py"])
+    wf = generate_workflow("resilience")
+    steps = {s["name"]: s for s in wf["spec"]["steps"]}
+    assert "ha" in steps
+    assert "loadtest/load_ha.py" in steps["ha"]["run"]
+    assert "--smoke" in steps["ha"]["run"]
+    assert steps["ha"]["depends"] == ["test"]
+    assert "tests/test_ha.py" in steps["test"]["run"]
+
+
 def test_generate_workflow_dag():
     wf = generate_workflow("core")
     names = [s["name"] for s in wf["spec"]["steps"]]

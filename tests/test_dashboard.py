@@ -402,6 +402,45 @@ def test_control_plane_route_reports_cache_replicas_and_pages(stack):
         plane.close()
 
 
+def test_control_plane_route_reports_ha_standing(stack):
+    """HA block of the control-plane card (ISSUE 20): the fencing epoch
+    and latch, failover/fenced-write counters, promotion latency p99,
+    and per-replica serve counts (follower-window watches + routed
+    requests by verb)."""
+    from kubeflow_tpu.core import watchcache
+    from kubeflow_tpu.gateway import ControlPlaneRouter
+
+    server, _mgr, base = stack
+    watchcache.attach(server)
+    plane = watchcache.ControlPlane(server, replicas=2)
+    router = ControlPlaneRouter(plane)
+    try:
+        server.set_epoch(3)
+        assert plane.wait_synced()
+        # a watch routed to a replica serves from the follower window
+        for _ in range(len(plane.replicas)):
+            router.watch(kinds=["CM"]).stop()
+        server.create(api_object("CM", "ha-cm", "team-a", spec={}))
+        code, state = req(base, "/dashboard/api/control-plane",
+                          user="alice@corp.com")
+        assert code == 200
+        ha = state["ha"]
+        assert ha["fencing_epoch"] == 3
+        assert ha["fenced"] is False
+        # counters/percentiles are process-wide monotone: present+numeric
+        assert ha["failovers"] >= 0 and ha["fenced_writes"] >= 0
+        assert ha["promotion_p99_s"] >= 0.0
+        # every follower that answered a watch shows up with its count
+        followers = [r.name for r in plane.replicas if r is not
+                     plane.leader]
+        assert any(ha["follower_watches"].get(n, 0) >= 1
+                   for n in followers)
+        assert any(key.endswith("/watch") and count >= 1
+                   for key, count in ha["replica_requests"].items())
+    finally:
+        plane.close()
+
+
 def test_nodes_route_surfaces_per_gang_elastic_state(stack):
     """The nodes (cluster robustness) card shows which gangs can absorb
     preemptions in place: live/min/max size, membership epoch, resizes,

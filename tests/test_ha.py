@@ -1,0 +1,222 @@
+"""Cross-host HA control plane (ISSUE 20): followers mirroring a leader
+over HTTP, lease-fenced failover with monotonic epochs, promotion from
+WAL + mirror replay, watch continuity across the failover, and the
+deposed leader's self-fence."""
+
+import time
+
+import pytest
+from conftest import poll_until as wait
+
+from kubeflow_tpu.core import APIServer, api_object, persistence, watchcache
+from kubeflow_tpu.core.controller import acquire_lease, lease_epoch
+from kubeflow_tpu.core.httpapi import RestAPI, serve
+from kubeflow_tpu.core.kubeclient import KubeStore
+from kubeflow_tpu.core.store import FencedWrite, NotFound, state_digest
+from kubeflow_tpu.core.watchcache import (
+    FOLLOWER_LEASE_PREFIX,
+    FollowerCache,
+    SelfFence,
+    promote,
+)
+
+
+@pytest.fixture()
+def leader():
+    """A served leader: APIServer + watch cache behind the REST facade."""
+    server = APIServer()
+    watchcache.attach(server)
+    httpd, _ = serve(RestAPI(server), 0)
+    yield server, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def _cm(name, ns="d", **spec):
+    return api_object("CM", name, ns, spec=spec)
+
+
+class TestHttpFollower:
+    def test_bootstrap_mirror_watch_and_heartbeat(self, leader):
+        server, _, base = leader
+        server.create(_cm("pre", x=1))
+        f = FollowerCache(name="f1", remote=KubeStore(base),
+                          heartbeat_ttl=1.0)
+        try:
+            # pre-existing state crossed the wire in the bootstrap list
+            assert f.get("CM", "pre", "d")["spec"] == {"x": 1}
+            # live events pump through; the follower serves its OWN watch
+            w = f.watch(kinds=["CM"])
+            server.create(_cm("live"))
+            ev = wait(lambda: w.next(timeout=0.5), timeout=10)
+            assert (ev.type, ev.object["metadata"]["name"]) == (
+                "ADDED", "live")
+            # mutations proxy over HTTP to the leader
+            f.create(_cm("via-f"))
+            assert server.get("CM", "via-f", "d")
+            f.delete("CM", "pre", "d")
+            with pytest.raises(NotFound):
+                server.get("CM", "pre", "d")
+            wait(lambda: f.lag() == 0 or None, timeout=10)
+            assert state_digest(f) == state_digest(server)
+            # the pump's heartbeat lease materialized on the leader —
+            # the signal SelfFence watches for
+            hb = wait(lambda: _lease(server, FOLLOWER_LEASE_PREFIX + "f1"),
+                      timeout=10)
+            assert hb["spec"]["holder"] == "f1"
+        finally:
+            f.close()
+
+    def test_follower_keeps_serving_reads_while_leader_down(self, leader):
+        server, httpd, base = leader
+        server.create(_cm("survives"))
+        f = FollowerCache(name="f1", remote=KubeStore(base))
+        try:
+            boot_rv = f.current_rv()  # the follower window's floor
+            server.create(_cm("during"))
+            wait(lambda: f.lag() == 0 or None, timeout=10)
+            httpd.shutdown()
+            httpd.server_close()
+            # reads and watches keep answering from the local mirror
+            assert f.get("CM", "survives", "d")
+            assert sorted(o["metadata"]["name"]
+                          for o in f.list("CM", namespace="d")) == [
+                "during", "survives"]
+            # a resume within the follower's own window replays with the
+            # leader entirely gone — streams don't die with the leader
+            w = f.watch(kinds=["CM"], resource_version=boot_rv)
+            ev = w.next(timeout=2)
+            assert ev is not None and ev.object[
+                "metadata"]["name"] == "during"
+        finally:
+            f.close()
+
+
+class TestPromotion:
+    def test_promote_replays_wal_plus_mirror_and_takes_lease(
+            self, leader, tmp_path):
+        """The failover protocol end to end: leader dies (WAL released),
+        the follower recovers persistence, replays its mirror delta,
+        steals the lease (epoch bump), and the follower reseats onto the
+        new leader with watch continuity."""
+        server, httpd, base = leader
+        persistence.attach(server, str(tmp_path))
+        assert acquire_lease(server, watchcache.APISERVER_LEASE, "old",
+                             ttl=0.3)
+        server.set_epoch(lease_epoch(server, watchcache.APISERVER_LEASE))
+        old_epoch = server.epoch
+        server.create(_cm("durable", n=1))
+        f = FollowerCache(name="f1", remote=KubeStore(base))
+        w = f.watch(kinds=["CM"])
+        try:
+            # a write that reached the WAL and the mirror but whose ack
+            # raced the crash — exactly once after promotion either way
+            server.create(_cm("inflight"))
+            wait(lambda: f.lag() == 0 or None, timeout=10)
+            # leader process dies: socket gone, WAL flock released
+            persistence.detach(server)
+            httpd.shutdown()
+            httpd.server_close()
+
+            new = promote(f, data_dir=str(tmp_path), lease_ttl=0.3,
+                          identity="f1", timeout=10)
+            assert new.epoch > old_epoch  # lease transfer bumped + adopted
+            assert new.get("CM", "durable", "d")["spec"] == {"n": 1}
+            assert new.get("CM", "inflight", "d")  # exactly once, not lost
+            lease = _lease(new, watchcache.APISERVER_LEASE)
+            assert lease["spec"]["holder"] == "f1"
+
+            # serve the new leader and reseat the follower onto it
+            httpd2, _ = serve(RestAPI(new), 0)
+            try:
+                f.reseat(KubeStore(
+                    f"http://127.0.0.1:{httpd2.server_address[1]}"))
+                new.create(_cm("after-failover"))
+                seen = wait(lambda: next(
+                    (e for e in iter(lambda: w.next(timeout=0.5), None)
+                     if e.object["metadata"]["name"] == "after-failover"),
+                    None), timeout=15)
+                assert seen.type == "ADDED"  # stream survived the failover
+                wait(lambda: f.lag() == 0 or None, timeout=10)
+                assert state_digest(f) == state_digest(new)
+            finally:
+                httpd2.shutdown()
+            persistence.detach(new)
+        finally:
+            f.close()
+
+    def test_promote_while_wal_still_locked_refuses(self, leader, tmp_path):
+        """Split-brain guard: promotion against a data dir whose writer is
+        still alive (flock held) must refuse, not fork the timeline."""
+        server, _, base = leader
+        persistence.attach(server, str(tmp_path))
+        f = FollowerCache(name="f1", remote=KubeStore(base))
+        try:
+            with pytest.raises((RuntimeError, OSError)):
+                promote(f, data_dir=str(tmp_path), lease_ttl=0.2,
+                        identity="f1", timeout=1)
+        finally:
+            f.close()
+            persistence.detach(server)
+
+
+class TestFencing:
+    def test_stale_epoch_write_over_http_answers_typed_409(self, leader):
+        server, _, base = leader
+        server.set_epoch(4)
+        store = KubeStore(base)
+        try:
+            store.create(_cm("a"))  # learns epoch 4 from the header
+            server.set_epoch(5)  # leadership moved
+            with pytest.raises(FencedWrite) as ei:
+                store.create(_cm("b"))
+            assert ei.value.current_epoch == 5
+            store.create(_cm("b"))  # learned 5: retry passes the gate
+        finally:
+            store.close()
+
+    def test_future_epoch_write_latches_deposed_leader_fence(self):
+        """A write stamped with a NEWER epoch proves this (elected)
+        server was deposed while partitioned: it latches the self-fence
+        so even un-stamped legacy writers bounce from then on."""
+        server = APIServer()
+        server.set_epoch(2)
+        with pytest.raises(FencedWrite):
+            server.check_epoch(3)
+        assert server.fenced
+        with pytest.raises(FencedWrite):
+            server.check_epoch(None)  # un-stamped writes fenced too
+
+    def test_never_elected_server_rejects_but_does_not_latch(self):
+        # an epoch-0 server was never elected: a stray stamped client
+        # must not brick a fresh store
+        server = APIServer()
+        with pytest.raises(FencedWrite):
+            server.check_epoch(7)
+        assert not server.fenced
+
+    def test_self_fence_when_all_follower_heartbeats_go_stale(self):
+        server = APIServer()
+        server.set_epoch(1)
+        # heartbeat renewTimes are wall-clock (lease convention), so the
+        # injected clock runs 30s ahead of the real one to age them
+        skew = [0.0]
+        fence = SelfFence(server, ttl=1.0,
+                          clock=lambda: time.time() + skew[0])
+        # no heartbeats at all: cannot distinguish "partitioned away"
+        # from "no followers deployed" — never fences
+        assert fence.check() is False
+        assert acquire_lease(server, FOLLOWER_LEASE_PREFIX + "f1", "f1",
+                             ttl=1.0)
+        assert fence.check() is False  # fresh heartbeat
+        skew[0] = 30.0  # every follower heartbeat stale: partitioned away
+        assert fence.check() is True
+        assert server.fenced
+        with pytest.raises(FencedWrite):
+            server.check_epoch(None)  # the mutation gate bounces everything
+
+
+def _lease(server, name):
+    try:
+        return server.get("Lease", name, "kube-system")
+    except NotFound:
+        return None

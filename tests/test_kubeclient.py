@@ -526,3 +526,149 @@ def test_delete_uid_precondition_over_http(make_remote):
     store.delete("ConfigMap", "c", "d", uid=second["metadata"]["uid"])
     with pytest.raises(NotFound):
         store.get("ConfigMap", "c", "d")
+
+
+# -- reconnect backoff + fencing epochs (ISSUE 20) -----------------------------
+
+class TestBackoff:
+    def test_seeded_jitter_exponential_and_capped(self):
+        import random
+
+        from kubeflow_tpu.core.kubeclient import _Backoff
+
+        a = _Backoff(rng=random.Random(7))
+        b = _Backoff(rng=random.Random(7))
+        seq = [a.next() for _ in range(12)]
+        assert seq == [b.next() for _ in range(12)]  # same seed, same run
+        # each delay jitters in [0.5, 1.0) of the exponential rung
+        for i, d in enumerate(seq):
+            rung = min(5.0, 0.2 * (2 ** i))
+            assert rung * 0.5 <= d < rung, (i, d)
+        assert max(seq) < 5.0  # capped
+        assert seq[5] > seq[0] * 4  # actually grows
+        a.reset()
+        nxt = a.next()
+        assert 0.1 <= nxt < 0.2  # reset re-arms the ladder
+
+    def test_flapping_server_backs_off_instead_of_hot_spinning(self):
+        """Regression (ISSUE 20 satellite): a leader that ACCEPTS the dial
+        but drops the stream before a single byte used to reset the old
+        fixed retry ladder on every successful connect — a hot-spinning
+        dial loop against a flapping leader.  The backoff now re-arms only
+        on stream PROGRESS, so accept-then-drop keeps the delays doubling
+        and the dial count over a fixed window stays small."""
+        import socket
+        import threading
+        import time as _t
+
+        server = APIServer()
+        httpd, _ = serve(RestAPI(server), 0)
+        port = httpd.server_address[1]
+        store = KubeStore(f"http://127.0.0.1:{port}", seed=3)
+        w = store.watch(kinds=["CM"])
+        try:
+            server.create({"kind": "CM", "apiVersion": "v1",
+                           "metadata": {"name": "c", "namespace": "d"},
+                           "spec": {}})
+            assert wait(lambda: w.next(timeout=1))  # stream progressed once
+            httpd.shutdown()
+            httpd.server_close()
+            w._resp.close()
+
+            # the flapper: same port, accepts and instantly drops
+            lsock = socket.socket()
+            lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            for _ in range(50):
+                try:
+                    lsock.bind(("127.0.0.1", port))
+                    break
+                except OSError:
+                    _t.sleep(0.05)
+            lsock.listen(64)
+            lsock.settimeout(0.1)
+            accepts = []
+            stop = threading.Event()
+
+            def flap():
+                while not stop.is_set():
+                    try:
+                        conn, _ = lsock.accept()
+                    except socket.timeout:
+                        continue
+                    except OSError:
+                        return
+                    accepts.append(_t.monotonic())
+                    conn.close()
+
+            t = threading.Thread(target=flap, daemon=True)
+            t.start()
+            try:
+                _t.sleep(1.5)
+                # worst case with backoff: delays >= 0.1, 0.2, 0.4, 0.8...
+                # so ~5 dials fit in 1.5s; a hot spin lands hundreds
+                assert 1 <= len(accepts) <= 10, len(accepts)
+            finally:
+                stop.set()
+                t.join()
+                lsock.close()
+        finally:
+            w.stop()
+            store.close()
+
+
+class TestFencingOverTheWire:
+    def test_client_learns_epoch_and_stamps_writes(self):
+        from kubeflow_tpu.core.store import FencedWrite
+
+        server = APIServer()
+        server.set_epoch(2)
+        httpd, _ = serve(RestAPI(server), 0)
+        store = KubeStore(f"http://127.0.0.1:{httpd.server_address[1]}")
+        try:
+            # first write is unstamped (client knows no epoch yet); the
+            # response header teaches it the current fencing epoch
+            store.create({"kind": "CM", "apiVersion": "v1",
+                          "metadata": {"name": "a", "namespace": "d"},
+                          "spec": {}})
+            assert store.epoch == 2
+            # stamped writes at the current epoch pass the gate
+            store.create({"kind": "CM", "apiVersion": "v1",
+                          "metadata": {"name": "b", "namespace": "d"},
+                          "spec": {}})
+            # leadership moves: the lease transfer bumps the epoch, and
+            # the client's stale stamp now answers a TYPED 409
+            server.set_epoch(3)
+            with pytest.raises(FencedWrite) as ei:
+                store.create({"kind": "CM", "apiVersion": "v1",
+                              "metadata": {"name": "c", "namespace": "d"},
+                              "spec": {}})
+            assert ei.value.current_epoch == 3
+            # ...which carried the new epoch: the retry succeeds
+            assert store.epoch == 3
+            store.create({"kind": "CM", "apiVersion": "v1",
+                          "metadata": {"name": "c", "namespace": "d"},
+                          "spec": {}})
+            assert server.get("CM", "c", "d")
+        finally:
+            store.close()
+            httpd.shutdown()
+
+    def test_epoch_learning_is_monotonic(self):
+        """A deposed leader still answering with its OLD epoch must not
+        walk the client's learned epoch backwards — max-only learning is
+        what stops a partitioned stale leader silently accepting writes
+        the new timeline never sees."""
+        server = APIServer()
+        server.set_epoch(5)
+        httpd, _ = serve(RestAPI(server), 0)
+        store = KubeStore(f"http://127.0.0.1:{httpd.server_address[1]}")
+        try:
+            store.create({"kind": "CM", "apiVersion": "v1",
+                          "metadata": {"name": "a", "namespace": "d"},
+                          "spec": {}})
+            assert store.epoch == 5
+            store._note_epoch("3")  # stale header from a deposed leader
+            assert store.epoch == 5
+        finally:
+            store.close()
+            httpd.shutdown()

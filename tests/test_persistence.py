@@ -723,3 +723,84 @@ def test_torn_tail_parsing_as_bare_scalar_is_tolerated(tmp_path):
         f.write("41\n" + intact)
     with pytest.raises(persistence.WALCorrupt, match="byte offset 0"):
         persistence.attach(APIServer(), str(tmp_path))
+
+
+def test_legacy_epochless_wal_replays_as_epoch_zero(tmp_path):
+    """ISSUE 20 WAL framing: records written before any control plane
+    elected carry no ``epoch`` field, and a recovered store stays at
+    epoch 0 — the fence must never invent an election that didn't
+    happen (epoch 0 means un-stamped legacy clients keep working)."""
+    with open(os.path.join(tmp_path, persistence.WAL), "w") as f:
+        f.write(json.dumps({"op": "put", "obj": {
+            "kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": "legacy", "namespace": "d",
+                         "resourceVersion": "1", "uid": "u0"},
+            "spec": {}}}) + "\n")
+    s1 = _attach(tmp_path)
+    assert s1.epoch == 0
+    s1.get("ConfigMap", "legacy", "d")
+    s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+               "metadata": {"name": "new", "namespace": "d"}, "spec": {}})
+    # the new record is framed but still epoch-less: never elected
+    line = open(os.path.join(tmp_path, persistence.WAL)).readline()
+    assert '"epoch"' not in line
+    persistence.detach(s1)
+
+
+def test_mixed_epoch_log_recovers_highest_epoch(tmp_path):
+    """A WAL spanning failovers holds records at several epochs (and
+    early ones at none).  Recovery adopts the MAX — the newest
+    leadership this store ever acknowledged — so a successor's fence
+    still wins and older stamped clients still bounce after a restart."""
+    s1 = _attach(tmp_path)
+    s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+               "metadata": {"name": "pre-election", "namespace": "d"},
+               "spec": {}})
+    s1.set_epoch(2)
+    s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+               "metadata": {"name": "epoch-2", "namespace": "d"},
+               "spec": {}})
+    s1.set_epoch(5)
+    s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+               "metadata": {"name": "epoch-5", "namespace": "d"},
+               "spec": {}})
+    epochs = [json.loads(line.split("|", 1)[1]).get("epoch")
+              for line in open(os.path.join(tmp_path, persistence.WAL))]
+    assert epochs == [None, 2, 5]  # stamped exactly per-record
+    s2 = _attach(tmp_path, prev=s1)
+    assert s2.epoch == 5
+    assert len(s2.list("ConfigMap", namespace="d")) == 3
+    persistence.detach(s2)
+
+
+def test_torn_record_at_epoch_boundary_keeps_state_and_fence(tmp_path):
+    """A crash tears the FIRST record of a new epoch mid-append (the
+    window right after a failover).  Recovery drops the torn tail, keeps
+    every intact record, and the adopted epoch comes from intact records
+    only — a half-written epoch stamp must not move the fence."""
+    s1 = _attach(tmp_path)
+    s1.set_epoch(3)
+    s1.create({"kind": "ConfigMap", "apiVersion": "v1",
+               "metadata": {"name": "pre-failover", "namespace": "d"},
+               "spec": {}})
+    persistence.detach(s1)
+    # the promotion bumped the epoch to 4; the first epoch-4 append is
+    # torn mid-json — the epoch stamp IS in the torn prefix, but the
+    # record fails its frame and must not be believed
+    payload = json.dumps({"op": "put", "epoch": 4, "obj": {
+        "kind": "ConfigMap", "apiVersion": "v1",
+        "metadata": {"name": "at-boundary", "namespace": "d",
+                     "resourceVersion": "9", "uid": "u9"}, "spec": {}}})
+    cut = payload.index('"obj"') + 8
+    torn_line = "deadbeef|" + payload[:cut]
+    assert '"epoch": 4' in torn_line  # the stamp survived the tear
+    with open(os.path.join(tmp_path, persistence.WAL), "a") as f:
+        f.write(torn_line)  # no newline: classic torn tail
+    torn = persistence.TORN_RECORDS.get()
+    s2 = _attach(tmp_path)
+    assert persistence.TORN_RECORDS.get() == torn + 1
+    s2.get("ConfigMap", "pre-failover", "d")  # intact records replayed
+    with pytest.raises(NotFound):
+        s2.get("ConfigMap", "at-boundary", "d")  # the torn record is gone
+    assert s2.epoch == 3  # intact epoch-3 records, not the torn stamp
+    persistence.detach(s2)

@@ -485,3 +485,140 @@ class TestStoreSemantics:
         assert reader.current_rv() >= rv_before
         created = reader.create(api_object("CM", "fresh", "d", spec={}))
         assert int(created["metadata"]["resourceVersion"]) > rv_before
+
+
+# -- HA: fencing epochs, failover, follower-served watches (ISSUE 20) ----------
+
+class TestHA:
+    def test_follower_serves_watch_from_its_own_window(self, server):
+        f = FollowerCache(server, "r1")
+        try:
+            before = watchcache.FOLLOWER_WATCHES.get("r1")
+            w = f.watch(kinds=["CM"])
+            server.create(api_object("CM", "c", "d", spec={}))
+            wait(lambda: f.lag() == 0 or None)
+            events = drain(w)
+            assert [(t, n) for t, n, _ in events] == [("ADDED", "c")]
+            assert watchcache.FOLLOWER_WATCHES.get("r1") == before + 1
+            # resume against the follower's window replays exactly
+            mid = events[-1][2]
+            server.patch_status("CM", "c", "d", {"ok": True})
+            wait(lambda: f.lag() == 0 or None)
+            resumed = drain(f.watch(kinds=["CM"], resource_version=mid))
+            assert [t for t, _, _ in resumed] == ["MODIFIED"]
+        finally:
+            f.close()
+
+    def test_router_resolves_leader_per_call_not_at_construction(self):
+        """Regression (ISSUE 20 satellite): the router used to pin
+        plane.leader at construction, so every mutation after a failover
+        kept landing on the deposed replica."""
+
+        class Replica:
+            def __init__(self, name, store):
+                self.name, self.store = name, store
+                self.is_leader = False
+
+        a, b = APIServer(), APIServer()
+
+        class PlaneStub:
+            replicas = [Replica("apiserver-0", a), Replica("apiserver-1", b)]
+            leader = replicas[0]
+            generation = 0
+
+        plane = PlaneStub()
+        router = ControlPlaneRouter(plane)
+        router.create(api_object("CM", "one", "d", spec={}))
+        assert a.get("CM", "one", "d")
+
+        plane.leader = plane.replicas[1]  # failover moves the lease
+        plane.generation += 1
+        router.create(api_object("CM", "two", "d", spec={}))
+        assert b.get("CM", "two", "d")  # a pinned router writes to `a`
+        with pytest.raises(NotFound):
+            a.get("CM", "two", "d")
+        assert router.get("CM", "two", "d")
+
+    def test_router_round_robins_watches_across_replicas(self, server):
+        from kubeflow_tpu.utils.metrics import REGISTRY
+
+        plane = ControlPlane(server, replicas=2)
+        router = ControlPlaneRouter(plane)
+        try:
+            assert plane.wait_synced()
+            picks = REGISTRY.get_metric("gateway_apiserver_requests_total")
+            names = [r.name for r in plane.replicas]
+            before = {n: picks.get(n, "watch") for n in names}
+            watches = [router.watch(kinds=["CM"]) for _ in range(4)]
+            # watches fan out: each replica served two (decision 27)
+            assert all(picks.get(n, "watch") == before[n] + 2
+                       for n in names)
+            server.create(api_object("CM", "c", "d", spec={}))
+            assert plane.wait_synced()
+            for w in watches:
+                assert [e[:2] for e in drain(w)] == [("ADDED", "c")]
+        finally:
+            plane.close()
+
+    def test_election_transfer_bumps_fencing_epoch(self, server):
+        from kubeflow_tpu.core.controller import lease_epoch
+
+        plane = ControlPlane(server, replicas=2)
+        try:
+            # first election: epoch 1, adopted by the backing store
+            assert lease_epoch(server, watchcache.APISERVER_LEASE) == 1
+            assert server.epoch == 1
+        finally:
+            plane.close()
+
+    def test_failover_promotes_follower_fences_old_epoch(self, server):
+        """A deposed leader's writes are fenced after failover: the lease
+        transfer bumps the epoch, the plane adopts it, and a write still
+        stamped with the old epoch answers the typed 409."""
+        import time as _time
+
+        from kubeflow_tpu.core.store import FencedWrite
+
+        plane = ControlPlane(server, replicas=2, lease_ttl=0.4)
+        router = ControlPlaneRouter(plane)
+        try:
+            old = plane.leader
+            old_epoch = server.epoch
+            router.create(api_object("CM", "pre", "d", spec={}))
+            # depose the leader: hand its lease to an outsider with a
+            # FRESH renewTime, so renewal fails and the renewer declares
+            # failover once the outsider's ttl expires
+            lease = server.get("Lease", watchcache.APISERVER_LEASE,
+                               "kube-system")
+            lease["spec"]["holder"] = "outsider"
+            lease["spec"]["renewTime"] = _time.time()
+            server.update(lease)
+            wait(lambda: (plane.leader is not old) or None, timeout=15)
+            assert plane.generation >= 1
+            assert server.epoch == old_epoch + 1  # transfer bumped
+            assert old.is_leader is False
+            assert isinstance(old.store, FollowerCache)  # demoted
+            # the router follows the promoted leader without rebuild
+            router.create(api_object("CM", "post", "d", spec={}))
+            assert server.get("CM", "post", "d")
+            # a write still stamped with the deposed epoch is fenced
+            with pytest.raises(FencedWrite) as ei:
+                server.check_epoch(old_epoch)
+            assert ei.value.current_epoch == server.epoch
+            assert plane.wait_synced()
+            want = state_digest(server)
+            for rep in plane.replicas:
+                assert state_digest(rep.store) == want
+        finally:
+            plane.close()
+
+    def test_plane_state_reports_epoch_and_watch_counts(self, server):
+        plane = ControlPlane(server, replicas=2)
+        try:
+            f = plane.followers()[0]
+            f.store.watch(kinds=["CM"])
+            rows = {r["name"]: r for r in plane.state()}
+            assert all(r["epoch"] == server.epoch for r in rows.values())
+            assert rows[f.name]["watches_served"] >= 1
+        finally:
+            plane.close()
